@@ -1,0 +1,21 @@
+"""cilium_tpu: a TPU-native policy-evaluation framework.
+
+A ground-up re-design of Cilium's security-policy stack (reference:
+/root/reference, v1.2.90) for TPU hardware: a host-side policy compiler
+lowers label/identity/CIDR/L4/L7 rules into dense tensors, and a
+JAX/XLA/Pallas verdict engine evaluates batched
+(src_identity, dst_identity, dport, proto, l7_features) tuples with
+allow/deny/redirect verdicts bit-identical to the reference semantics.
+
+Layering (see SURVEY.md):
+  labels / identity / policy.api  - the pure rule model ("what is allowed")
+  policy                         - repository + resolution (control plane)
+  ipcache                        - IP/CIDR -> identity resolution
+  compiler                       - rules -> tensors lowering
+  engine                         - jitted/Pallas verdict kernels (data plane)
+  parallel                       - mesh sharding, multi-chip/multi-host eval
+  runtime                        - endpoints, regeneration, kvstore, metrics
+  l7                             - HTTP/Kafka/generic L7 matching
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,102 @@
+"""Runtime configuration system.
+
+Re-design of /root/reference/pkg/option/{config.go,option.go}: a global
+DaemonConfig plus a bitmask-style mutable option set with per-option
+verify/parse hooks.  In the TPU framework, option values that affect
+verdict computation become part of the compiler cache key (the analog of
+config-as-#defines in the generated BPF headers, pkg/endpoint
+writeHeaderfile): changing them invalidates compiled tables.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+# Policy enforcement modes (pkg/option/config.go)
+DEFAULT_ENFORCEMENT = "default"
+ALWAYS_ENFORCE = "always"
+NEVER_ENFORCE = "never"
+
+# AllowLocalhost modes
+ALLOW_LOCALHOST_AUTO = "auto"
+ALLOW_LOCALHOST_ALWAYS = "always"
+ALLOW_LOCALHOST_POLICY = "policy"
+
+# Mutable boolean options (pkg/option/option.go library)
+POLICY_TRACING = "PolicyTracing"
+DEBUG = "Debug"
+DROP_NOTIFICATION = "DropNotification"
+TRACE_NOTIFICATION = "TraceNotification"
+POLICY_VERDICT_NOTIFICATION = "PolicyVerdictNotification"
+CONNTRACK = "Conntrack"
+CONNTRACK_ACCOUNTING = "ConntrackAccounting"
+
+KNOWN_OPTIONS = {
+    POLICY_TRACING,
+    DEBUG,
+    DROP_NOTIFICATION,
+    TRACE_NOTIFICATION,
+    POLICY_VERDICT_NOTIFICATION,
+    CONNTRACK,
+    CONNTRACK_ACCOUNTING,
+}
+
+
+class OptionMap(dict):
+    """Named boolean options with change tracking (option.go:41)."""
+
+    def is_enabled(self, name: str) -> bool:
+        return bool(self.get(name, False))
+
+    def apply(self, changes: Dict[str, bool],
+              changed_hook: Optional[Callable] = None) -> int:
+        n = 0
+        for k, v in changes.items():
+            if k not in KNOWN_OPTIONS:
+                raise ValueError(f"unknown option {k}")
+            if self.get(k, False) != v:
+                self[k] = v
+                n += 1
+                if changed_hook:
+                    changed_hook(k, v)
+        return n
+
+
+@dataclass
+class DaemonConfig:
+    """Global daemon configuration (pkg/option/config.go)."""
+
+    policy_enforcement: str = DEFAULT_ENFORCEMENT
+    allow_localhost: str = ALLOW_LOCALHOST_AUTO
+    # HostAllowsWorld: legacy 1.0 behaviour, world shares host policy
+    # (config.go:183).
+    host_allows_world: bool = False
+    dry_mode: bool = False
+    opts: OptionMap = field(default_factory=OptionMap)
+
+    # TPU-side knobs (compiler cache key components).
+    identity_pad: int = 1024          # pad identity axis to multiples
+    filter_pad: int = 64              # pad L4-filter axis to multiples
+    device_batch: int = 1 << 20       # tuples per device step
+
+    def always_allow_localhost(self) -> bool:
+        """config.go:277."""
+        return self.allow_localhost == ALLOW_LOCALHOST_ALWAYS
+
+    def tracing_enabled(self) -> bool:
+        return self.opts.is_enabled(POLICY_TRACING)
+
+    def cache_key(self) -> tuple:
+        """Verdict-affecting config as a hashable compiler cache key."""
+        return (
+            self.policy_enforcement,
+            self.allow_localhost,
+            self.host_allows_world,
+            self.identity_pad,
+            self.filter_pad,
+        )
+
+
+# The process-global config, mirroring option.Config.
+Config = DaemonConfig()

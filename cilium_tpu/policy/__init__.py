@@ -1,0 +1,3 @@
+"""Policy core: rule model, repository, resolution."""
+
+from cilium_tpu.policy.search import Decision, Port, SearchContext, Tracing  # noqa: F401

@@ -1,0 +1,28 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; sharding semantics are
+validated on XLA's host platform with 8 virtual devices (the driver
+separately dry-runs the multi-chip path via __graft_entry__.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_config():
+    """Reset the process-global DaemonConfig between tests."""
+    from cilium_tpu import option
+
+    saved = option.Config
+    option.Config = option.DaemonConfig()
+    yield
+    option.Config = saved

@@ -1,0 +1,307 @@
+"""L4/L7 merge semantics (reference: pkg/policy/rule_test.go
+TestMergeL4PolicyIngress, TestMergeL7PolicyIngress,
+TestWildcardL3RulesIngress, TestL4WildcardMerge)."""
+
+import pytest
+
+from cilium_tpu.labels import LabelArray, parse_select_label
+from cilium_tpu.policy.api import (
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PortProtocol,
+    PortRule,
+    PortRuleHTTP,
+    PortRuleKafka,
+    Rule,
+)
+from cilium_tpu.policy.api.selector import WILDCARD_SELECTOR
+from cilium_tpu.policy.l4 import PARSER_TYPE_HTTP, PARSER_TYPE_KAFKA
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.rule_resolve import L4MergeError
+from cilium_tpu.policy.search import SearchContext
+
+
+def es(*labels):
+    return EndpointSelector.from_labels(
+        *[parse_select_label(l) for l in labels]
+    )
+
+
+def to_ctx(*to):
+    return SearchContext(to_labels=LabelArray.parse_select(*to))
+
+
+def http_port_rule(port="80", method="GET", path="/"):
+    return PortRule(
+        ports=[PortProtocol(port, "TCP")],
+        rules=L7Rules(http=[PortRuleHTTP(method=method, path=path)]),
+    )
+
+
+def test_merge_l7_http_wildcard_and_selector():
+    """rule_test.go:418: L4-only + L7 + L7-with-fromEndpoints on the same
+    port merge into a single wildcard-L3 filter with per-selector L7."""
+    foo_selector = es("foo")
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[
+            IngressRule(
+                to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])]
+            ),
+            IngressRule(to_ports=[http_port_rule()]),
+            IngressRule(
+                from_endpoints=[foo_selector],
+                to_ports=[http_port_rule()],
+            ),
+        ],
+    ))
+    l4 = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    assert set(l4.keys()) == {"80/TCP"}
+    f = l4["80/TCP"]
+    assert f.port == 80 and f.protocol == "TCP" and f.u8proto == 6
+    assert f.ingress is True
+    assert f.l7_parser == PARSER_TYPE_HTTP
+    # first (L4-only) filter had wildcard L3; merge collapses endpoints
+    assert f.endpoints == [WILDCARD_SELECTOR]
+    assert set(f.l7_rules_per_ep.keys()) == {WILDCARD_SELECTOR, foo_selector}
+    assert len(f.l7_rules_per_ep[WILDCARD_SELECTOR].http) == 1
+    assert f.l7_rules_per_ep[foo_selector].http[0].method == "GET"
+    # 3 merges + 1 from the repository-level wildcardL3L4Rules pass (the
+    # L4-only ingress rule is an L3/L4 wildcard candidate and appends its
+    # labels once more, repository.go:162-163)
+    assert len(f.derived_from_rules) == 4
+
+
+def test_merge_l7_kafka():
+    foo_selector = es("foo")
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[
+            IngressRule(to_ports=[PortRule(
+                ports=[PortProtocol("9092", "TCP")],
+                rules=L7Rules(kafka=[PortRuleKafka(topic="foo")]),
+            )]),
+            IngressRule(
+                from_endpoints=[foo_selector],
+                to_ports=[PortRule(
+                    ports=[PortProtocol("9092", "TCP")],
+                    rules=L7Rules(kafka=[PortRuleKafka(topic="foo")]),
+                )],
+            ),
+        ],
+    ))
+    l4 = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    f = l4["9092/TCP"]
+    assert f.l7_parser == PARSER_TYPE_KAFKA
+    assert set(f.l7_rules_per_ep.keys()) == {WILDCARD_SELECTOR, foo_selector}
+
+
+def test_merge_parser_conflict():
+    """rule.go:55-57: conflicting L7 parsers on the same port error out."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[
+            IngressRule(to_ports=[PortRule(
+                ports=[PortProtocol("80", "TCP")],
+                rules=L7Rules(http=[PortRuleHTTP(path="/")]),
+            )]),
+            IngressRule(to_ports=[PortRule(
+                ports=[PortProtocol("80", "TCP")],
+                rules=L7Rules(kafka=[PortRuleKafka(topic="t")]),
+            )]),
+        ],
+    ))
+    with pytest.raises(L4MergeError):
+        repo.resolve_l4_ingress_policy(to_ctx("bar"))
+
+
+def test_merge_l7_dedup():
+    """mergeL4Port dedups identical L7 rules (rule.go:70-74)."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[
+            IngressRule(to_ports=[http_port_rule()]),
+            IngressRule(to_ports=[http_port_rule()]),
+        ],
+    ))
+    l4 = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    f = l4["80/TCP"]
+    assert len(f.l7_rules_per_ep[WILDCARD_SELECTOR].http) == 1
+
+
+def test_wildcard_l3_injects_l7_allow_all():
+    """repository.go:128-235 TestWildcardL3RulesIngress: an L3-only allow
+    for selector S adds an L7 allow-all for S on every L7 filter."""
+    foo_selector = es("foo")
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_endpoints=[foo_selector])],
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_endpoints=[es("baz")],
+            to_ports=[PortRule(
+                ports=[PortProtocol("80", "TCP")],
+                rules=L7Rules(http=[PortRuleHTTP(path="/admin")]),
+            )],
+        )],
+    ))
+    l4 = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    f = l4["80/TCP"]
+    # the L3-only foo selector got wildcarded into the HTTP filter
+    assert foo_selector in f.l7_rules_per_ep
+    wildcarded = f.l7_rules_per_ep[foo_selector]
+    assert len(wildcarded.http) == 1
+    assert wildcarded.http[0].path == ""  # allow-all HTTP rule
+    assert foo_selector in f.endpoints
+
+
+def test_wildcard_l3l4_injects_l7_allow_all_on_matching_port():
+    """L3/L4-only rule (port without L7) wildcards only matching port."""
+    foo_selector = es("foo")
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_endpoints=[foo_selector],
+            to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+        )],
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_endpoints=[es("baz")],
+            to_ports=[PortRule(
+                ports=[PortProtocol("80", "TCP")],
+                rules=L7Rules(http=[PortRuleHTTP(path="/admin")]),
+            )],
+        )],
+    ))
+    l4 = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    f = l4["80/TCP"]
+    assert foo_selector in f.l7_rules_per_ep
+    assert f.l7_rules_per_ep[foo_selector].http[0].path == ""
+
+
+def test_l3_only_rule_no_l7_filters_untouched():
+    """An L3-only allow does not touch plain (no-L7) L4 filters
+    (repository.go:134-135 ParserTypeNone -> continue)."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_endpoints=[es("foo")])],
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+        )],
+    ))
+    l4 = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    f = l4["80/TCP"]
+    assert f.l7_parser == ""
+    assert f.endpoints == [WILDCARD_SELECTOR]
+    assert len(f.l7_rules_per_ep) == 0
+
+
+def test_egress_merge():
+    """rule_test.go:364 TestMergeL4PolicyEgress."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("foo"),
+        egress=[
+            EgressRule(
+                to_endpoints=[es("bar")],
+                to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+            ),
+            EgressRule(
+                to_endpoints=[es("baz")],
+                to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+            ),
+        ],
+    ))
+    l4 = repo.resolve_l4_egress_policy(
+        SearchContext(from_labels=LabelArray.parse_select("foo"))
+    )
+    f = l4["80/TCP"]
+    assert f.ingress is False
+    assert len(f.endpoints) == 2
+
+
+def test_merge_does_not_corrupt_source_rules():
+    """Review regression: merging two rules must not mutate the stored
+    api.Rule objects (Go struct-copy semantics, l4.go:143)."""
+    rule_a = Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(to_ports=[PortRule(
+            ports=[PortProtocol("80", "TCP")],
+            rules=L7Rules(http=[PortRuleHTTP(method="GET", path="/foo")]),
+        )])],
+    )
+    rule_b = Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(to_ports=[PortRule(
+            ports=[PortProtocol("80", "TCP")],
+            rules=L7Rules(http=[PortRuleHTTP(method="POST", path="/bar")]),
+        )])],
+    )
+    repo = Repository()
+    repo.add(rule_a)
+    repo.add(rule_b)
+    l4 = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    assert len(l4["80/TCP"].l7_rules_per_ep[WILDCARD_SELECTOR].http) == 2
+    # source rules untouched
+    assert len(rule_a.ingress[0].to_ports[0].rules.http) == 1
+    assert len(rule_b.ingress[0].to_ports[0].rules.http) == 1
+    # resolving twice yields the same result (no accumulation)
+    l4_again = repo.resolve_l4_ingress_policy(to_ctx("bar"))
+    assert len(l4_again["80/TCP"].l7_rules_per_ep[WILDCARD_SELECTOR].http) == 2
+
+
+def test_merge_conflict_degrades_to_denied_verdict():
+    """Review regression: allows_ingress must not raise on a merge
+    conflict; it degrades to Denied (repository.go:374-391)."""
+    from cilium_tpu.policy.search import Port
+
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(to_ports=[PortRule(
+            ports=[PortProtocol("80", "TCP")],
+            rules=L7Rules(http=[PortRuleHTTP(path="/")]),
+        )])],
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(to_ports=[PortRule(
+            ports=[PortProtocol("80", "TCP")],
+            rules=L7Rules(kafka=[PortRuleKafka(topic="t")]),
+        )])],
+    ))
+    from cilium_tpu.policy.search import Decision, SearchContext
+    from cilium_tpu.labels import LabelArray
+
+    verdict = repo.allows_ingress(SearchContext(
+        from_labels=LabelArray.parse_select("foo"),
+        to_labels=LabelArray.parse_select("bar"),
+        dports=[Port(80, "TCP")],
+    ))
+    assert verdict == Decision.DENIED
+
+
+def test_go_octal_port_parse():
+    """Review regression: Go base-0 port parsing ("010" == 8)."""
+    p = PortProtocol("010", "TCP")
+    p.sanitize()
+    assert p.numeric_port() == 8
+    p = PortProtocol("0x50", "TCP")
+    p.sanitize()
+    assert p.numeric_port() == 80

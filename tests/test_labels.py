@@ -1,0 +1,85 @@
+"""Label model semantics (reference: pkg/labels tests)."""
+
+from cilium_tpu import labels as lbl
+from cilium_tpu.labels import Label, LabelArray, Labels, parse_label, parse_select_label
+
+
+def test_parse_label_sources():
+    assert parse_label("foo") == Label("foo", "", "unspec")
+    assert parse_label("foo=bar") == Label("foo", "bar", "unspec")
+    assert parse_label("k8s:foo=bar") == Label("foo", "bar", "k8s")
+    assert parse_label("container:foo") == Label("foo", "", "container")
+    # $ shorthand for reserved (labels.go:583)
+    assert parse_label("$host") == Label("host", "", "reserved")
+    assert parse_label("reserved:world") == Label("world", "", "reserved")
+
+
+def test_parse_select_label_defaults_any():
+    assert parse_select_label("foo").source == "any"
+    assert parse_select_label("k8s:foo").source == "k8s"
+
+
+def test_extended_keys():
+    assert lbl.get_extended_key_from("k8s:foo=bar") == "k8s.foo"
+    assert lbl.get_extended_key_from("foo=bar") == "any.foo"
+    assert lbl.get_cilium_key_from("k8s.foo") == "k8s:foo"
+    assert lbl.get_cilium_key_from("foo") == "any:foo"
+    assert parse_label("k8s:foo").get_extended_key() == "k8s.foo"
+
+
+def test_label_matches_any_source():
+    any_foo = parse_select_label("foo")
+    k8s_foo = parse_label("k8s:foo")
+    assert any_foo.matches(k8s_foo)  # any-source matches any source
+    assert not k8s_foo.matches(parse_label("container:foo"))
+    # reserved:all matches everything
+    assert parse_label("reserved:all").matches(parse_label("k8s:whatever=x"))
+
+
+def test_label_array_has_get():
+    arr = LabelArray.parse("k8s:app=web", "container:tier=db")
+    assert arr.has("any.app")
+    assert arr.get("any.app") == "web"
+    assert arr.has("k8s.app")
+    assert not arr.has("container.app")
+    assert arr.get("container.tier") == "db"
+    assert arr.get("any.missing") == ""
+
+
+def test_label_array_contains():
+    arr = LabelArray.parse("k8s:a=1", "k8s:b=2")
+    assert arr.contains(LabelArray.parse_select("a=1"))
+    assert not arr.contains(LabelArray.parse_select("a=2"))
+    assert arr.contains(LabelArray())  # empty needed => True
+
+
+def test_sorted_list_and_sha():
+    l1 = Labels.from_model(["k8s:b=2", "k8s:a=1"])
+    l2 = Labels.from_model(["k8s:a=1", "k8s:b=2"])
+    assert l1.sorted_list() == l2.sorted_list()
+    assert l1.sha256sum() == l2.sha256sum()
+    assert l1.sorted_list() == b"k8s:a=1;k8s:b=2;"
+
+
+def test_cidr_labels():
+    l = lbl.ip_string_to_label("10.0.0.0/8")
+    assert l.source == "cidr"
+    assert l.key == "10.0.0.0/8"
+    # bare IP becomes full-mask
+    l = lbl.ip_string_to_label("192.168.1.5")
+    assert l.key == "192.168.1.5/32"
+    # IPv6 colon translation + zero guard (cidr.go:36-44)
+    l = lbl.ip_string_to_label("::1/128")
+    assert l.key.startswith("0--1/")
+
+
+def test_cidr_label_expansion():
+    import ipaddress
+
+    labels = lbl.get_cidr_labels(ipaddress.ip_network("10.1.0.0/16"))
+    keys = {l.key for l in labels}
+    assert "world" in keys
+    assert "10.1.0.0/16" in keys
+    assert "10.0.0.0/8" in keys
+    assert "0.0.0.0/0" in keys
+    assert len([k for k in keys if "/" in k]) == 17

@@ -1,0 +1,286 @@
+"""Repository verdict semantics.
+
+Cases mirror /root/reference/pkg/policy/repository_test.go and
+rule_test.go (TestCanReachIngress, TestCanReachEgress, FromRequires
+precedence, L4 deferral, entity selectors).
+"""
+
+import pytest
+
+from cilium_tpu.labels import LabelArray, parse_select_label
+from cilium_tpu.policy.api import (
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.search import Decision, Port, SearchContext
+
+
+def es(*labels):
+    return EndpointSelector.from_labels(
+        *[parse_select_label(l) for l in labels]
+    )
+
+
+def ctx(frm, to, dports=()):
+    return SearchContext(
+        from_labels=LabelArray.parse_select(*frm),
+        to_labels=LabelArray.parse_select(*to),
+        dports=[Port(p, proto) for p, proto in dports],
+    )
+
+
+def test_empty_repo():
+    repo = Repository()
+    c = ctx(["foo"], ["bar"])
+    assert repo.can_reach_ingress(c) == Decision.UNDECIDED
+    assert repo.allows_ingress(c) == Decision.DENIED
+
+
+def test_can_reach_ingress_basic():
+    """repository_test.go:193 TestCanReachIngress."""
+    repo = Repository()
+    tag1 = LabelArray.parse("tag1")
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_endpoints=[es("foo")])],
+        labels=tag1,
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("groupA"),
+        ingress=[IngressRule(from_requires=[es("groupA")])],
+        labels=tag1,
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("bar2"),
+        ingress=[IngressRule(from_endpoints=[es("foo")])],
+        labels=tag1,
+    ))
+
+    assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.ALLOWED
+    assert repo.allows_ingress(ctx(["foo"], ["bar2"])) == Decision.ALLOWED
+    # foo in groupA => requires met
+    assert repo.allows_ingress(
+        ctx(["foo", "groupA"], ["bar", "groupA"])
+    ) == Decision.ALLOWED
+    # groupB can't talk to groupA: requires unmet => Denied
+    assert repo.allows_ingress(
+        ctx(["foo", "groupB"], ["bar", "groupA"])
+    ) == Decision.DENIED
+    # no restriction on groupB
+    assert repo.allows_ingress(
+        ctx(["foo", "groupB"], ["bar", "groupB"])
+    ) == Decision.ALLOWED
+    # no rule for bar3
+    assert repo.allows_ingress(ctx(["foo"], ["bar3"])) == Decision.DENIED
+
+
+def test_can_reach_egress_basic():
+    """repository_test.go:287."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("foo"),
+        egress=[EgressRule(to_endpoints=[es("bar")])],
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("groupA"),
+        egress=[EgressRule(to_requires=[es("groupA")])],
+    ))
+    assert repo.allows_egress(ctx(["foo"], ["bar"])) == Decision.ALLOWED
+    assert repo.allows_egress(
+        ctx(["foo", "groupA"], ["bar"])
+    ) == Decision.DENIED  # requires: bar lacks groupA
+    assert repo.allows_egress(
+        ctx(["foo", "groupA"], ["bar", "groupA"])
+    ) == Decision.ALLOWED
+    assert repo.allows_egress(ctx(["baz"], ["bar"])) == Decision.DENIED
+
+
+def test_requires_denies_even_with_later_allow():
+    """FromRequires deny-precedence: Denied breaks the rule loop
+    (repository.go:87-92)."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_requires=[es("groupA")])],
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_endpoints=[es("foo")])],
+    ))
+    assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.DENIED
+
+
+def test_l3_only_match_allows_but_toports_defers():
+    """rule.go:374-389: ToPorts presence defers to L4 stage."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_endpoints=[es("foo")],
+            to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+        )],
+    ))
+    # label-only: undecided (deferred), with ports: allowed on 80
+    assert repo.can_reach_ingress(ctx(["foo"], ["bar"])) == Decision.UNDECIDED
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(80, "TCP")])
+    ) == Decision.ALLOWED
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(81, "TCP")])
+    ) == Decision.DENIED
+    # no port context at all: denied (no L4 check possible)
+    assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.DENIED
+
+
+def test_l4_any_proto_expansion():
+    """ANY expands to TCP+UDP (rule.go:198-209)."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            to_ports=[PortRule(ports=[PortProtocol("53", "ANY")])],
+        )],
+    ))
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(53, "UDP")])
+    ) == Decision.ALLOWED
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(53, "TCP")])
+    ) == Decision.ALLOWED
+    # ANY port context matches either
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(53, "ANY")])
+    ) == Decision.ALLOWED
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(54, "ANY")])
+    ) == Decision.DENIED
+
+
+def test_l4_with_from_endpoints_label_filter():
+    """containsAllL3L4 checks filter endpoints against ctx.From
+    (l4.go:300-335)."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_endpoints=[es("foo")],
+            to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+        )],
+    ))
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(80, "TCP")])
+    ) == Decision.ALLOWED
+    assert repo.allows_ingress(
+        ctx(["baz"], ["bar"], [(80, "TCP")])
+    ) == Decision.DENIED
+
+
+def test_from_requires_injected_into_l4():
+    """FromRequires constrains L4-resolved filters too
+    (repository.go:252-266, rule.go:247-257)."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_endpoints=[es("foo")],
+            to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+        )],
+    ))
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_requires=[es("groupA")])],
+    ))
+    # foo without groupA: requires unmet => denied at label stage
+    assert repo.allows_ingress(
+        ctx(["foo"], ["bar"], [(80, "TCP")])
+    ) == Decision.DENIED
+    assert repo.allows_ingress(
+        ctx(["foo", "groupA"], ["bar"], [(80, "TCP")])
+    ) == Decision.ALLOWED
+
+
+def test_entities():
+    """Entity selectors (rule_test.go:1067 TestRuleCanReachFromEntity)."""
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_entities=["world", "host"])],
+    ))
+    assert repo.allows_ingress(
+        ctx(["reserved:world"], ["bar"])
+    ) == Decision.ALLOWED
+    assert repo.allows_ingress(
+        ctx(["reserved:host"], ["bar"])
+    ) == Decision.ALLOWED
+    assert repo.allows_ingress(ctx(["foo"], ["bar"])) == Decision.DENIED
+
+
+def test_entity_all():
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_entities=["all"])],
+    ))
+    assert repo.allows_ingress(ctx(["anything"], ["bar"])) == Decision.ALLOWED
+
+
+def test_add_search_delete():
+    """repository_test.go:29."""
+    repo = Repository()
+    lbls1 = LabelArray.parse("tag1", "tag2")
+    lbls2 = LabelArray.parse("tag3", "tag4")
+    rule1 = Rule(endpoint_selector=es("bar"), labels=lbls1)
+    rule2 = Rule(endpoint_selector=es("bar"), labels=lbls1)
+    rule3 = Rule(endpoint_selector=es("bar"), labels=lbls2)
+
+    assert repo.get_revision() == 1
+    rev = repo.add(rule1)
+    assert rev == 2
+    rev = repo.add(rule2)
+    rev = repo.add(rule3)
+    assert rev == 4
+
+    assert len(repo.search(lbls1)) == 2
+    assert len(repo.search(lbls2)) == 1
+    rev, n = repo.delete_by_labels(LabelArray.parse("tag2"))
+    assert n == 2
+    assert rev == 5
+    rev, n = repo.delete_by_labels(LabelArray.parse("tag2"))
+    assert n == 0
+    assert repo.num_rules() == 1
+
+
+def test_rules_matching():
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_endpoints=[es("foo")])],
+    ))
+    ing, eg = repo.get_rules_matching(LabelArray.parse_select("bar"))
+    assert ing and not eg
+    ing, eg = repo.get_rules_matching(LabelArray.parse_select("other"))
+    assert not ing and not eg
+
+
+def test_trace_output():
+    import io
+    from cilium_tpu.policy.search import Tracing
+
+    repo = Repository()
+    repo.add(Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_endpoints=[es("foo")])],
+    ))
+    c = ctx(["foo"], ["bar"])
+    c.trace = Tracing.ENABLED
+    c.logging = io.StringIO()
+    assert repo.allows_ingress(c) == Decision.ALLOWED
+    out = c.trace_output()
+    assert "Found allow rule" in out
+    assert "1/1 rules selected" in out
+    assert "Label verdict: allowed" in out

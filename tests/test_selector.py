@@ -1,0 +1,113 @@
+"""EndpointSelector semantics (reference: pkg/policy/api/selector_test.go)."""
+
+from cilium_tpu.labels import LabelArray, parse_select_label
+from cilium_tpu.policy.api.selector import (
+    EndpointSelector,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+    RESERVED_ENDPOINT_SELECTORS,
+    Requirement,
+    WILDCARD_SELECTOR,
+    selects_all_endpoints,
+)
+
+
+def es(*labels):
+    return EndpointSelector.from_labels(
+        *[parse_select_label(l) for l in labels]
+    )
+
+
+def test_match_labels_basic():
+    sel = es("role=backend")
+    assert sel.matches(LabelArray.parse("k8s:role=backend"))
+    assert sel.matches(LabelArray.parse("any:role=backend"))
+    assert not sel.matches(LabelArray.parse("k8s:role=frontend"))
+    assert not sel.matches(LabelArray())
+
+
+def test_source_specific_match():
+    sel = es("k8s:role=backend")
+    assert sel.matches(LabelArray.parse("k8s:role=backend"))
+    assert not sel.matches(LabelArray.parse("container:role=backend"))
+
+
+def test_wildcard_matches_everything():
+    assert WILDCARD_SELECTOR.matches(LabelArray.parse("k8s:x=y"))
+    assert WILDCARD_SELECTOR.matches(LabelArray())
+    assert WILDCARD_SELECTOR.is_wildcard()
+
+
+def test_reserved_all_short_circuits():
+    sel = es("reserved:all")
+    assert sel.matches(LabelArray.parse("anything=else"))
+    assert sel.matches(LabelArray())
+
+
+def test_match_expressions():
+    sel = EndpointSelector(
+        match_expressions=[Requirement("any.env", OP_IN, ["prod", "stage"])]
+    )
+    assert sel.matches(LabelArray.parse("k8s:env=prod"))
+    assert not sel.matches(LabelArray.parse("k8s:env=dev"))
+    assert not sel.matches(LabelArray())
+
+    sel = EndpointSelector(
+        match_expressions=[Requirement("any.env", OP_NOT_IN, ["dev"])]
+    )
+    assert sel.matches(LabelArray.parse("k8s:env=prod"))
+    assert sel.matches(LabelArray())  # key absent => NotIn matches
+    assert not sel.matches(LabelArray.parse("k8s:env=dev"))
+
+    sel = EndpointSelector(
+        match_expressions=[Requirement("any.env", OP_EXISTS)]
+    )
+    assert sel.matches(LabelArray.parse("k8s:env=dev"))
+    assert not sel.matches(LabelArray())
+
+    sel = EndpointSelector(
+        match_expressions=[Requirement("any.env", OP_DOES_NOT_EXIST)]
+    )
+    assert not sel.matches(LabelArray.parse("k8s:env=dev"))
+    assert sel.matches(LabelArray())
+
+
+def test_selects_all_endpoints():
+    assert selects_all_endpoints([])
+    assert selects_all_endpoints([WILDCARD_SELECTOR])
+    assert not selects_all_endpoints([es("a=b")])
+
+
+def test_reserved_selectors():
+    world = RESERVED_ENDPOINT_SELECTORS["world"]
+    assert world.matches(LabelArray.parse("reserved:world"))
+    assert not world.matches(LabelArray.parse("reserved:host"))
+
+
+def test_identity_keying():
+    # selectors hash by identity (reference: struct-pointer map keys)
+    a, b = es("x=y"), es("x=y")
+    assert a.deep_equal(b)
+    d = {a: 1}
+    assert b not in d
+    assert a in d
+
+
+def test_add_requirements_copy():
+    sel = es("role=backend")
+    sel2 = sel.add_requirements([Requirement("any.team", OP_IN, ["A"])])
+    # original unmodified
+    assert sel.matches(LabelArray.parse("k8s:role=backend"))
+    assert not sel2.matches(LabelArray.parse("k8s:role=backend"))
+    assert sel2.matches(LabelArray.parse("k8s:role=backend", "k8s:team=A"))
+
+
+def test_convert_to_requirements():
+    sel = es("role=backend")
+    reqs = sel.convert_to_requirements()
+    assert len(reqs) == 1
+    assert reqs[0].key == "any.role"
+    assert reqs[0].operator == OP_IN
+    assert reqs[0].values == ["backend"]

@@ -1,0 +1,197 @@
+"""Rule sanitization (reference: pkg/policy/api/rule_validation_test.go)."""
+
+import pytest
+
+from cilium_tpu.labels import LabelArray, parse_label, parse_select_label
+from cilium_tpu.policy.api import (
+    CIDRRule,
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    L7Rules,
+    PolicyValidationError,
+    PortProtocol,
+    PortRule,
+    PortRuleHTTP,
+    PortRuleKafka,
+    Rule,
+)
+
+
+def es(*labels):
+    return EndpointSelector.from_labels(
+        *[parse_select_label(l) for l in labels]
+    )
+
+
+def test_nil_endpoint_selector_rejected():
+    with pytest.raises(PolicyValidationError):
+        Rule(endpoint_selector=None).sanitize()
+
+
+def test_cilium_generated_labels_rejected():
+    r = Rule(
+        endpoint_selector=es("bar"),
+        labels=LabelArray([parse_label("cilium-generated:x")]),
+    )
+    with pytest.raises(PolicyValidationError):
+        r.sanitize()
+
+
+def test_l3_member_combination_rejected():
+    r = Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_endpoints=[es("foo")], from_cidr=["10.0.0.0/8"]
+        )],
+    )
+    with pytest.raises(PolicyValidationError):
+        r.sanitize()
+
+
+def test_from_cidr_with_toports_rejected():
+    r = Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(
+            from_cidr=["10.0.0.0/8"],
+            to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+        )],
+    )
+    with pytest.raises(PolicyValidationError):
+        r.sanitize()
+
+
+def test_egress_to_cidr_with_toports_allowed():
+    """Egress CIDR+L4 is supported (rule_validation.go:141-148)."""
+    r = Rule(
+        endpoint_selector=es("bar"),
+        egress=[EgressRule(
+            to_cidr=["10.0.0.0/8"],
+            to_ports=[PortRule(ports=[PortProtocol("80", "TCP")])],
+        )],
+    )
+    r.sanitize()
+
+
+def test_port_validation():
+    with pytest.raises(PolicyValidationError):
+        PortProtocol("0", "TCP").sanitize()
+    with pytest.raises(PolicyValidationError):
+        PortProtocol("", "TCP").sanitize()
+    with pytest.raises(PolicyValidationError):
+        PortProtocol("99999", "TCP").sanitize()
+    with pytest.raises(PolicyValidationError):
+        PortProtocol("80", "SCTP").sanitize()
+    p = PortProtocol("80", "tcp")
+    p.sanitize()
+    assert p.protocol == "TCP"
+    p = PortProtocol("80", "")
+    p.sanitize()
+    assert p.protocol == "ANY"
+
+
+def test_max_ports():
+    pr = PortRule(
+        ports=[PortProtocol(str(1000 + i), "TCP") for i in range(41)]
+    )
+    with pytest.raises(PolicyValidationError):
+        pr.sanitize()
+
+
+def test_l7_only_on_tcp():
+    pr = PortRule(
+        ports=[PortProtocol("80", "UDP")],
+        rules=L7Rules(http=[PortRuleHTTP(path="/")]),
+    )
+    with pytest.raises(PolicyValidationError):
+        pr.sanitize()
+
+
+def test_l7_multiple_types_rejected():
+    rules = L7Rules(
+        http=[PortRuleHTTP(path="/")], kafka=[PortRuleKafka(topic="t")]
+    )
+    with pytest.raises(PolicyValidationError):
+        rules.sanitize()
+
+
+def test_l7_without_l7proto_rejected():
+    from cilium_tpu.policy.api import PortRuleL7
+
+    rules = L7Rules(l7=[PortRuleL7({"key": "val"})])
+    with pytest.raises(PolicyValidationError):
+        rules.sanitize()
+
+
+def test_kafka_role_and_apikey_conflict():
+    k = PortRuleKafka(role="produce", api_key="fetch")
+    with pytest.raises(PolicyValidationError):
+        k.sanitize()
+
+
+def test_kafka_role_expansion():
+    k = PortRuleKafka(role="produce")
+    k.sanitize()
+    assert k.api_key_int == [0, 3, 18]
+    k = PortRuleKafka(role="consume")
+    k.sanitize()
+    assert set(k.api_key_int) == {1, 2, 3, 8, 9, 10, 11, 12, 13, 14, 18}
+
+
+def test_kafka_invalid_key():
+    with pytest.raises(PolicyValidationError):
+        PortRuleKafka(api_key="bogus").sanitize()
+
+
+def test_kafka_topic_validation():
+    with pytest.raises(PolicyValidationError):
+        PortRuleKafka(topic="x" * 256).sanitize()
+    with pytest.raises(PolicyValidationError):
+        PortRuleKafka(topic="bad topic!").sanitize()
+    PortRuleKafka(topic="good.topic_1-x").sanitize()
+
+
+def test_cidr_rule_except_containment():
+    r = CIDRRule(cidr="10.0.0.0/8", except_cidrs=["10.96.0.0/12"])
+    assert r.sanitize() == 8
+    r = CIDRRule(cidr="10.0.0.0/8", except_cidrs=["192.168.0.0/16"])
+    with pytest.raises(PolicyValidationError):
+        r.sanitize()
+
+
+def test_entity_validation():
+    r = Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_entities=["bogus"])],
+    )
+    with pytest.raises(PolicyValidationError):
+        r.sanitize()
+    Rule(
+        endpoint_selector=es("bar"),
+        ingress=[IngressRule(from_entities=["world", "host", "cluster"])],
+    ).sanitize()
+
+
+def test_http_regex_validation():
+    with pytest.raises(PolicyValidationError):
+        PortRuleHTTP(path="[invalid").sanitize()
+    PortRuleHTTP(path="/foo.*", method="GET|POST").sanitize()
+
+
+def test_cidr_except_expansion():
+    """ComputeResultantCIDRSet (api/cidr.go:115, utils_test.go)."""
+    from cilium_tpu.policy.api import compute_resultant_cidr_set
+
+    out = compute_resultant_cidr_set(
+        [CIDRRule(cidr="10.0.0.0/24", except_cidrs=["10.0.0.128/25"])]
+    )
+    assert out == ["10.0.0.0/25"]
+    out = compute_resultant_cidr_set(
+        [CIDRRule(cidr="10.0.0.0/24", except_cidrs=["10.0.0.64/26"])]
+    )
+    assert set(out) == {"10.0.0.0/26", "10.0.0.128/25"}
+    # full removal
+    out = compute_resultant_cidr_set(
+        [CIDRRule(cidr="10.0.0.0/24", except_cidrs=["10.0.0.0/24"])]
+    )
+    assert out == []
