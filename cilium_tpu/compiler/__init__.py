@@ -1,0 +1,23 @@
+"""The policy compiler: rule repository → dense device tensors.
+
+This is the TPU-first replacement for the reference's per-endpoint
+table generation (pkg/endpoint/policy.go computeDesiredPolicyMapState)
+plus the clang/llc datapath build (pkg/datapath/loader): instead of
+compiling C to BPF bytecode per endpoint, we lower the desired policy
+map state into padded integer tensors consumed by the jitted verdict
+engine (cilium_tpu.engine).
+"""
+
+from cilium_tpu.compiler.mapstate import compute_desired_policy_map_state
+from cilium_tpu.compiler.tables import (
+    PolicyTables,
+    compile_map_states,
+    lower_map_state,
+)
+
+__all__ = [
+    "compute_desired_policy_map_state",
+    "PolicyTables",
+    "compile_map_states",
+    "lower_map_state",
+]

@@ -1,0 +1,163 @@
+"""Desired policy-map-state computation (the compiler frontend).
+
+Behavioral port of /root/reference/pkg/endpoint/policy.go:
+  - resolveL4Policy (policy.go:222)
+  - convertL4FilterToPolicyMapKeys (policy.go:110)
+  - computeDesiredL4PolicyMapEntries (policy.go:143)
+  - determineAllowLocalhost / determineAllowFromWorld (policy.go:285,306)
+  - computeDesiredL3PolicyMapEntries (policy.go:318)
+
+This host-side pass is the *semantic spec* of the verdict tables: the
+engine's device output must be bit-identical to evaluating the map
+state returned here with the 3-probe lattice (engine.oracle).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from cilium_tpu import option
+from cilium_tpu.identity import (
+    RESERVED_HOST,
+    RESERVED_WORLD,
+    IdentityCache,
+)
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.maps.policymap import (
+    EGRESS,
+    INGRESS,
+    PolicyKey,
+    PolicyMapState,
+    PolicyMapStateEntry,
+)
+from cilium_tpu.policy.l4 import L4Filter, L4Policy, proxy_id
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.search import Decision, SearchContext
+
+# policy.go:49-60: unconditional ingress L3 allows.
+LOCALHOST_KEY = PolicyKey(identity=RESERVED_HOST, traffic_direction=INGRESS)
+WORLD_KEY = PolicyKey(identity=RESERVED_WORLD, traffic_direction=INGRESS)
+
+
+def _get_security_identities(labels_map: IdentityCache, selector) -> list:
+    """policy.go:92: all identity ids whose labels the selector selects."""
+    return [
+        num_id
+        for num_id, labels in labels_map.items()
+        if selector.matches(labels)
+    ]
+
+
+def _convert_l4_filter_to_keys(
+    labels_map: IdentityCache, f: L4Filter, direction: int
+) -> list:
+    """policy.go:110: one PolicyKey per (selected identity, port, proto)."""
+    keys = []
+    for sel in f.endpoints:
+        for num_id in _get_security_identities(labels_map, sel):
+            keys.append(
+                PolicyKey(
+                    identity=num_id,
+                    dest_port=f.port,
+                    nexthdr=f.u8proto,
+                    traffic_direction=direction,
+                )
+            )
+    return keys
+
+
+def resolve_l4_policy(
+    repo: Repository,
+    ep_labels: LabelArray,
+    ingress_enabled: bool = True,
+    egress_enabled: bool = True,
+) -> L4Policy:
+    """policy.go:222 resolveL4Policy."""
+    from cilium_tpu.policy.l4 import L4PolicyMap
+
+    ingress = (
+        repo.resolve_l4_ingress_policy(SearchContext(to_labels=ep_labels))
+        if ingress_enabled
+        else L4PolicyMap()
+    )
+    egress = (
+        repo.resolve_l4_egress_policy(SearchContext(from_labels=ep_labels))
+        if egress_enabled
+        else L4PolicyMap()
+    )
+    return L4Policy(ingress=ingress, egress=egress)
+
+
+def compute_desired_policy_map_state(
+    repo: Repository,
+    identity_cache: IdentityCache,
+    ep_labels: LabelArray,
+    *,
+    endpoint_id: int = 0,
+    ingress_enabled: bool = True,
+    egress_enabled: bool = True,
+    realized_redirects: Optional[Dict[str, int]] = None,
+    l4_policy: Optional[L4Policy] = None,
+) -> PolicyMapState:
+    """computeDesiredPolicyMapState (policy.go:273), phase-ordered as the
+    reference: L4 entries, then localhost/world overrides, then the
+    identity × label-verdict L3 loop.
+
+    `realized_redirects` maps proxy-id strings to allocated proxy ports;
+    redirect filters with no allocated port are skipped
+    (policy.go:157-166), exactly as the reference defers them to
+    addNewRedirectsFromMap.
+    """
+    desired: PolicyMapState = {}
+    if l4_policy is None:
+        l4_policy = resolve_l4_policy(
+            repo, ep_labels, ingress_enabled, egress_enabled
+        )
+    redirects = realized_redirects or {}
+
+    # --- computeDesiredL4PolicyMapEntries (policy.go:143) -------------------
+    for direction, l4map in (
+        (INGRESS, l4_policy.ingress),
+        (EGRESS, l4_policy.egress),
+    ):
+        for f in l4map.values():
+            proxy_port = 0
+            if f.is_redirect():
+                pid = proxy_id(endpoint_id, f.ingress, f.protocol, f.port)
+                proxy_port = redirects.get(pid, 0)
+                if proxy_port == 0:
+                    continue
+            for key in _convert_l4_filter_to_keys(identity_cache, f, direction):
+                desired[key] = PolicyMapStateEntry(proxy_port=proxy_port)
+
+    # --- determineAllowLocalhost (policy.go:285) ----------------------------
+    if option.Config.always_allow_localhost() or l4_policy.has_redirect():
+        desired[LOCALHOST_KEY] = PolicyMapStateEntry()
+
+    # --- determineAllowFromWorld (policy.go:306) ----------------------------
+    if option.Config.host_allows_world and LOCALHOST_KEY in desired:
+        desired[WORLD_KEY] = PolicyMapStateEntry()
+
+    # --- computeDesiredL3PolicyMapEntries (policy.go:318) -------------------
+    for num_id, labels in identity_cache.items():
+        if ingress_enabled:
+            ctx = SearchContext(from_labels=labels, to_labels=ep_labels)
+            ingress_access = repo.allows_ingress_label_access(ctx)
+        else:
+            ingress_access = Decision.ALLOWED
+        if ingress_access == Decision.ALLOWED:
+            desired[
+                PolicyKey(identity=num_id, traffic_direction=INGRESS)
+            ] = PolicyMapStateEntry()
+
+        if egress_enabled:
+            ctx = SearchContext(from_labels=ep_labels, to_labels=labels)
+            egress_access = repo.allows_egress_label_access(ctx)
+        else:
+            egress_access = Decision.ALLOWED
+        if egress_access == Decision.ALLOWED:
+            desired[
+                PolicyKey(identity=num_id, traffic_direction=EGRESS)
+            ] = PolicyMapStateEntry()
+
+    return desired

@@ -1,0 +1,254 @@
+"""Lowering PolicyMapState → dense, padded device tensors.
+
+TPU-first design replacing the per-endpoint BPF hash map
+(pkg/maps/policymap) with integer tensors:
+
+  * identity axis: raw u32 security identities are mapped to dense
+    indices through a sorted `id_table` (device-side searchsorted —
+    the analog of the hash-map key probe, O(log n) but fully
+    vectorized over the batch and MXU/VPU friendly);
+  * L4 axis: the distinct (dport, proto) keys of the endpoint's
+    filters, packed into u32 `dport << 8 | proto` (at most a few
+    hundred per endpoint; the reference caps total map entries at
+    16,384, policymap.go:37);
+  * allow sets: bit-packed u32 words over the identity axis, one row
+    per (direction, l4-key) plus an L3-only row pair — 32× smaller
+    than bool tensors, so a 64k-identity × 1k-filter endpoint table is
+    ~8 MB instead of 256 MB of HBM.
+
+All axes are padded to configurable buckets so that XLA compilation
+caches across table updates (SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.maps.policymap import (
+    EGRESS,
+    INGRESS,
+    PolicyKey,
+    PolicyMapState,
+)
+
+# Sentinel for padded slots of the sorted identity table: sorts above
+# every real identity, so searchsorted never aliases a real id.
+PAD_ID = np.uint32(0xFFFFFFFF)
+# Sentinel for padded / absent L4 key slots (a real packed key is at
+# most 0xFFFF << 8 | 0xFF < 0x01000000).
+PAD_PORTKEY = np.uint32(0xFFFFFFFF)
+
+NUM_DIRECTIONS = 2  # INGRESS, EGRESS
+
+
+def _round_up(n: int, mult: int) -> int:
+    return max(mult, ((n + mult - 1) // mult) * mult)
+
+
+def pack_port_proto(dport: int, proto: int) -> int:
+    """u32 key: dport<<8 | proto (both host byte order)."""
+    return (dport << 8) | proto
+
+
+@dataclass
+class PolicyTables:
+    """Stacked verdict tables for E endpoints — the device-resident
+    equivalent of E pinned policy maps plus the tail-call PROG_ARRAY
+    dispatch (bpf/bpf_lxc.c:1039: per-tuple gather along the endpoint
+    axis replaces the per-endpoint program jump).
+
+    Shapes (E endpoints, K padded L4 keys, N padded identities,
+    W = N // 32 words):
+      id_table       u32 [N]           sorted identity universe (shared)
+      l4_ports       u32 [E, 2, K]     packed (dport<<8|proto), PAD empty
+      l4_proxy       u16 [E, 2, K]     proxy port per L4 key
+      l4_allow_bits  u32 [E, 2, K, W]  per-identity allow bits (exact probe)
+      l4_wild        u8  [E, 2, K]     identity-0 wildcard slot (3rd probe)
+      l3_allow_bits  u32 [E, 2, W]     L3-only allow bits (2nd probe)
+    """
+
+    id_table: np.ndarray
+    l4_ports: np.ndarray
+    l4_proxy: np.ndarray
+    l4_allow_bits: np.ndarray
+    l4_wild: np.ndarray
+    l3_allow_bits: np.ndarray
+
+    @property
+    def num_endpoints(self) -> int:
+        return self.l4_ports.shape[0]
+
+    @property
+    def num_identities(self) -> int:
+        return self.id_table.shape[0]
+
+    @property
+    def num_l4_keys(self) -> int:
+        return self.l4_ports.shape[2]
+
+    def tree_flatten(self):
+        return (
+            (
+                self.id_table,
+                self.l4_ports,
+                self.l4_proxy,
+                self.l4_allow_bits,
+                self.l4_wild,
+                self.l3_allow_bits,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            PolicyTables,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: PolicyTables.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover - jax always present in CI
+        pass
+
+
+_register_pytree()
+
+
+def build_id_table(
+    identity_ids: Sequence[int], identity_pad: int = 1024
+) -> np.ndarray:
+    """Sorted, padded identity universe (the shape-defining snapshot,
+    reference getLabelsMap pkg/endpoint/policy.go:194)."""
+    ids = sorted(set(int(i) for i in identity_ids))
+    n = _round_up(len(ids), identity_pad)
+    # Identity axis must stay a multiple of 32 for bit packing.
+    n = _round_up(n, 32)
+    table = np.full((n,), PAD_ID, dtype=np.uint32)
+    table[: len(ids)] = np.asarray(ids, dtype=np.uint32)
+    return table
+
+
+def lower_map_state(
+    states: Sequence[PolicyMapState],
+    id_table: np.ndarray,
+    filter_pad: int = 64,
+) -> PolicyTables:
+    """Lower E desired map states onto a shared identity universe.
+
+    Any state entry whose identity is absent from `id_table` would be
+    unreachable in the reference too (the BPF map key could never be
+    probed with that source identity derived from ipcache); we assert
+    against it to surface compiler/universe skew early — the moral
+    equivalent of pkg/alignchecker.
+    """
+    id_list = id_table.tolist()
+    n = id_table.shape[0]
+    w = n // 32
+    id_index: Dict[int, int] = {}
+    for i, v in enumerate(id_list):
+        if v == int(PAD_ID):
+            break
+        id_index[v] = i
+
+    e_count = len(states)
+
+    # Collect per-endpoint distinct (dport, proto) key sets per direction.
+    per_ep_l4: List[Dict[Tuple[int, int, int], Dict]] = []
+    max_k = 1
+    for state in states:
+        l4: Dict[Tuple[int, int, int], Dict] = {}
+        for key, entry in state.items():
+            if key.is_l3_only():
+                continue
+            kk = (key.traffic_direction, key.dest_port, key.nexthdr)
+            slot = l4.setdefault(
+                kk, {"proxy": entry.proxy_port, "ids": [], "wild": False}
+            )
+            # proxy port is constant per (port,proto,dir): one L4Filter
+            # per L4PolicyMap key (pkg/policy/l4.go:276).  A state that
+            # violates this cannot be lowered without diverging from
+            # the per-entry oracle — refuse it.
+            if slot["proxy"] != entry.proxy_port:
+                raise ValueError(
+                    f"conflicting proxy ports for {kk}: "
+                    f"{slot['proxy']} vs {entry.proxy_port}"
+                )
+            if key.identity == 0:
+                slot["wild"] = True
+            else:
+                slot["ids"].append(key.identity)
+        per_ep_l4.append(l4)
+        for d in (INGRESS, EGRESS):
+            kcount = sum(1 for kk in l4 if kk[0] == d)
+            max_k = max(max_k, kcount)
+
+    k = _round_up(max_k, filter_pad)
+
+    l4_ports = np.full((e_count, 2, k), PAD_PORTKEY, dtype=np.uint32)
+    l4_proxy = np.zeros((e_count, 2, k), dtype=np.uint16)
+    l4_wild = np.zeros((e_count, 2, k), dtype=np.uint8)
+    # Bits are set directly into the packed words — never materialize
+    # the dense [E, 2, K, N] bool tensor (it would be 32× the size the
+    # packing exists to avoid).
+    l4_allow_bits = np.zeros((e_count, 2, k, w), dtype=np.uint32)
+    l3_allow_bits = np.zeros((e_count, 2, w), dtype=np.uint32)
+
+    def _id_idx(num_id: int) -> int:
+        idx = id_index.get(num_id)
+        if idx is None:
+            raise ValueError(
+                f"identity {num_id} in map state but not in the "
+                f"identity universe (universe/table skew)"
+            )
+        return idx
+
+    for e, (state, l4) in enumerate(zip(states, per_ep_l4)):
+        slot_idx = {INGRESS: 0, EGRESS: 0}
+        for (d, dport, proto), slot in sorted(l4.items()):
+            j = slot_idx[d]
+            slot_idx[d] += 1
+            l4_ports[e, d, j] = pack_port_proto(dport, proto)
+            l4_proxy[e, d, j] = slot["proxy"]
+            l4_wild[e, d, j] = 1 if slot["wild"] else 0
+            for num_id in slot["ids"]:
+                idx = _id_idx(num_id)
+                l4_allow_bits[e, d, j, idx >> 5] |= np.uint32(
+                    1 << (idx & 31)
+                )
+        for key in state:
+            if not key.is_l3_only():
+                continue
+            idx = _id_idx(key.identity)
+            l3_allow_bits[e, key.traffic_direction, idx >> 5] |= np.uint32(
+                1 << (idx & 31)
+            )
+
+    return PolicyTables(
+        id_table=id_table,
+        l4_ports=l4_ports,
+        l4_proxy=l4_proxy,
+        l4_allow_bits=l4_allow_bits,
+        l4_wild=l4_wild,
+        l3_allow_bits=l3_allow_bits,
+    )
+
+
+def compile_map_states(
+    states: Sequence[PolicyMapState],
+    identity_ids: Sequence[int],
+    identity_pad: int = 1024,
+    filter_pad: int = 64,
+) -> PolicyTables:
+    """One-shot: build the shared identity table and lower E states."""
+    return lower_map_state(
+        states, build_id_table(identity_ids, identity_pad), filter_pad
+    )

@@ -1,0 +1,24 @@
+"""The batched verdict engine — TPU replacement of the eBPF datapath's
+per-packet decision (`__policy_can_access`, bpf/lib/policy.h:46).
+
+`oracle` is the host-side NumPy/dict reference evaluator (the
+bit-exactness spec); `verdict` is the jitted JAX implementation,
+shardable over a device mesh along the batch axis.
+"""
+
+from cilium_tpu.engine.oracle import policy_can_access, evaluate_batch_oracle
+from cilium_tpu.engine.verdict import (
+    TupleBatch,
+    Verdicts,
+    evaluate_batch,
+    make_sharded_evaluator,
+)
+
+__all__ = [
+    "policy_can_access",
+    "evaluate_batch_oracle",
+    "TupleBatch",
+    "Verdicts",
+    "evaluate_batch",
+    "make_sharded_evaluator",
+]

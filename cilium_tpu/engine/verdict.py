@@ -1,0 +1,227 @@
+"""Jitted batched verdict kernel (the TPU datapath).
+
+Computes, for every (endpoint, identity, dport, proto, direction)
+tuple in a batch, the 3-probe lattice of bpf/lib/policy.h:46 against
+the compiled PolicyTables — fully vectorized:
+
+  * identity hash-probe  → searchsorted over the sorted id universe;
+  * L4 key hash-probe    → broadcast compare against the endpoint's
+    padded (dport<<8|proto) key row (K is small, so the [B, K] compare
+    is cheap VPU work and XLA fuses the argmax reduction into it);
+  * per-endpoint map selection (the PROG_ARRAY tail call,
+    bpf/bpf_lxc.c:1039) → gather along the endpoint axis.
+
+Everything is integer (u32/i32) — no floats anywhere near the verdict,
+so device results are bit-identical to the host oracle by construction
+(SURVEY.md §7 hard part 5).
+
+The batch axis is embarrassingly parallel (packets across nodes in the
+reference ≙ tuples across TPU chips): `make_sharded_evaluator` shards
+it over a `jax.sharding.Mesh` with the tables replicated, which keeps
+all collective traffic at zero during evaluation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cilium_tpu.compiler.tables import PolicyTables
+from cilium_tpu.engine.oracle import (
+    MATCH_FRAG_DROP,
+    MATCH_L3,
+    MATCH_L4,
+    MATCH_L4_WILD,
+    MATCH_NONE,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class TupleBatch:
+    """A batch of flow tuples (the SearchContext of the datapath)."""
+
+    ep_index: jax.Array  # i32 [B] index into the endpoint axis
+    identity: jax.Array  # u32 [B] src id (ingress) / dst id (egress)
+    dport: jax.Array  # i32 [B] destination port, host order
+    proto: jax.Array  # i32 [B] IP protocol number
+    direction: jax.Array  # i32 [B] 0=ingress 1=egress
+    is_fragment: jax.Array  # bool [B]
+
+    def tree_flatten(self):
+        return (
+            (
+                self.ep_index,
+                self.identity,
+                self.dport,
+                self.proto,
+                self.direction,
+                self.is_fragment,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def from_numpy(
+        ep_index,
+        identity,
+        dport,
+        proto,
+        direction,
+        is_fragment=None,
+    ) -> "TupleBatch":
+        b = len(ep_index)
+        if is_fragment is None:
+            is_fragment = np.zeros(b, dtype=bool)
+        return TupleBatch(
+            ep_index=jnp.asarray(ep_index, dtype=jnp.int32),
+            identity=jnp.asarray(identity, dtype=jnp.uint32),
+            dport=jnp.asarray(dport, dtype=jnp.int32),
+            proto=jnp.asarray(proto, dtype=jnp.int32),
+            direction=jnp.asarray(direction, dtype=jnp.int32),
+            is_fragment=jnp.asarray(is_fragment, dtype=bool),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Verdicts:
+    """Per-tuple results, dtype-stable for bit-compare with the oracle."""
+
+    allowed: jax.Array  # u8 [B] 0/1
+    proxy_port: jax.Array  # u16-valued i32 [B] (0 = plain allow)
+    match_kind: jax.Array  # u8 [B] MATCH_* codes
+
+    def tree_flatten(self):
+        return ((self.allowed, self.proxy_port, self.match_kind), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _verdict_kernel(tables: PolicyTables, batch: TupleBatch) -> Verdicts:
+    n = tables.id_table.shape[0]
+
+    # -- identity probe: raw u32 id → dense index ---------------------------
+    idx = jnp.searchsorted(tables.id_table, batch.identity)
+    idx = jnp.clip(idx, 0, n - 1).astype(jnp.int32)
+    known = tables.id_table[idx] == batch.identity
+    word = idx >> 5
+    bit = (idx & 31).astype(jnp.uint32)
+
+    # -- L4 key probe: match the endpoint's padded key row ------------------
+    portkey = (
+        (batch.dport.astype(jnp.uint32) << 8)
+        | batch.proto.astype(jnp.uint32)
+    )
+    key_rows = tables.l4_ports[batch.ep_index, batch.direction]  # [B, K]
+    key_match = key_rows == portkey[:, None]  # [B, K]
+    has_port = jnp.any(key_match, axis=1)
+    j = jnp.argmax(key_match, axis=1).astype(jnp.int32)  # first (only) hit
+
+    # -- probe 1: exact (identity, dport, proto) ----------------------------
+    exact_words = tables.l4_allow_bits[
+        batch.ep_index, batch.direction, j, word
+    ]
+    exact_bit = ((exact_words >> bit) & 1).astype(bool)
+    probe1 = known & has_port & exact_bit
+
+    # -- probe 2: L3-only (identity, 0, 0) ----------------------------------
+    l3_words = tables.l3_allow_bits[batch.ep_index, batch.direction, word]
+    probe2 = known & ((l3_words >> bit) & 1).astype(bool)
+
+    # -- probe 3: wildcard (0, dport, proto) --------------------------------
+    wild = tables.l4_wild[batch.ep_index, batch.direction, j].astype(bool)
+    probe3 = has_port & wild
+
+    # -- lattice combine (policy.h:62-109 order; fragments skip L4 probes) --
+    frag = batch.is_fragment
+    p1 = probe1 & ~frag
+    p3 = probe3 & ~frag
+    allowed = p1 | probe2 | p3
+
+    proxy = tables.l4_proxy[batch.ep_index, batch.direction, j].astype(
+        jnp.int32
+    )
+    proxy_out = jnp.where(p1 | (~probe2 & p3), proxy, 0)
+    proxy_out = jnp.where(allowed, proxy_out, 0)
+
+    kind = jnp.where(
+        p1,
+        MATCH_L4,
+        jnp.where(
+            probe2,
+            MATCH_L3,
+            jnp.where(
+                p3,
+                MATCH_L4_WILD,
+                jnp.where(frag, MATCH_FRAG_DROP, MATCH_NONE),
+            ),
+        ),
+    ).astype(jnp.uint8)
+
+    return Verdicts(
+        allowed=allowed.astype(jnp.uint8),
+        proxy_port=proxy_out,
+        match_kind=kind,
+    )
+
+
+evaluate_batch = jax.jit(_verdict_kernel)
+
+
+def make_sharded_evaluator(mesh: Optional[jax.sharding.Mesh] = None,
+                           batch_axis: str = "batch"):
+    """Return a jitted evaluator with the batch axis sharded over the
+    mesh and tables replicated (SURVEY.md §2.9: flow batches shard like
+    packets shard across nodes; tables replicate like BPF maps
+    replicate per node).
+
+    With `mesh=None` this degrades to the single-device evaluator.
+    """
+    if mesh is None:
+        return evaluate_batch
+
+    replicated = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()
+    )
+    batch_sharded = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(batch_axis)
+    )
+
+    table_shardings = PolicyTables(
+        id_table=replicated,
+        l4_ports=replicated,
+        l4_proxy=replicated,
+        l4_allow_bits=replicated,
+        l4_wild=replicated,
+        l3_allow_bits=replicated,
+    )
+    batch_shardings = TupleBatch(
+        ep_index=batch_sharded,
+        identity=batch_sharded,
+        dport=batch_sharded,
+        proto=batch_sharded,
+        direction=batch_sharded,
+        is_fragment=batch_sharded,
+    )
+    out_shardings = Verdicts(
+        allowed=batch_sharded,
+        proxy_port=batch_sharded,
+        match_kind=batch_sharded,
+    )
+    return jax.jit(
+        _verdict_kernel,
+        in_shardings=(table_shardings, batch_shardings),
+        out_shardings=out_shardings,
+    )

@@ -1,0 +1,6 @@
+"""Typed table models — the TPU analog of /root/reference/pkg/maps.
+
+In the reference these packages wrap pinned BPF maps (the kernel ABI).
+Here they model the host-side *desired state* tables that the policy
+compiler lowers into device tensors (cilium_tpu.compiler.tables).
+"""

@@ -1,0 +1,86 @@
+"""Per-endpoint policy verdict table model.
+
+Re-design of /root/reference/pkg/maps/policymap/policymap.go (PolicyKey
+policymap.go:64, PolicyEntry policymap.go:73) and the endpoint-side
+PolicyMapState (pkg/endpoint/endpoint.go:265).  In the reference a
+PolicyMapState is synced into a per-endpoint BPF hash map consumed by
+`__policy_can_access` (bpf/lib/policy.h:46); here it is the input of
+the tensor lowering in cilium_tpu.compiler.tables and the host oracle
+in cilium_tpu.engine.oracle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Tuple
+
+# Traffic direction (pkg/maps/policymap/trafficdirection: Ingress=0,
+# Egress=1; bpf side inverts into the `egress` bit, policy.h:57).
+INGRESS = 0
+EGRESS = 1
+
+# policymap.go:37: max entries of the per-endpoint verdict table.
+MAX_ENTRIES = 16384
+
+# policymap.go:46: port 0 means "all ports" (the L3-only slot).
+ALL_PORTS = 0
+
+
+@dataclass(frozen=True)
+class PolicyKey:
+    """policymap.go:64 — must stay a 4-tuple of ints (ABI contract
+    checked by cilium_tpu.native.alignchecker)."""
+
+    identity: int  # u32 source (ingress) / dest (egress) security id
+    dest_port: int = 0  # u16, host byte order; 0 = all ports
+    nexthdr: int = 0  # u8 IP protocol; 0 = any
+    traffic_direction: int = INGRESS  # u8
+
+    def is_l3_only(self) -> bool:
+        return self.dest_port == 0 and self.nexthdr == 0
+
+    def __str__(self) -> str:
+        d = "Ingress" if self.traffic_direction == INGRESS else "Egress"
+        return f"{d}: {self.identity} {self.dest_port}/{self.nexthdr}"
+
+
+@dataclass
+class PolicyMapStateEntry:
+    """policymap.go:73 (PolicyEntry) minus kernel padding.
+
+    proxy_port > 0 means the verdict is redirect-to-proxy; packets and
+    bytes are the per-entry counters the datapath accumulates
+    (policy.h:66-68), filled back from the device by the engine.
+    """
+
+    proxy_port: int = 0  # u16, host byte order
+    packets: int = 0
+    bytes: int = 0
+
+
+# pkg/endpoint/endpoint.go:265 — the desired/realized table of one
+# endpoint.
+PolicyMapState = Dict[PolicyKey, PolicyMapStateEntry]
+
+
+def sort_keys(state: PolicyMapState) -> List[PolicyKey]:
+    """Deterministic dump order (PolicyEntriesDump.Less,
+    policymap.go:96: direction then identity)."""
+    return sorted(
+        state.keys(),
+        key=lambda k: (k.traffic_direction, k.identity, k.dest_port, k.nexthdr),
+    )
+
+
+def diff_map_state(
+    realized: PolicyMapState, desired: PolicyMapState
+) -> Tuple[List[PolicyKey], List[PolicyKey]]:
+    """syncPolicyMap's delta (pkg/endpoint/endpoint.go:2572): returns
+    (keys_to_add_or_update, keys_to_delete)."""
+    to_add = [
+        k
+        for k, v in desired.items()
+        if k not in realized or realized[k].proxy_port != v.proxy_port
+    ]
+    to_delete = [k for k in realized if k not in desired]
+    return to_add, to_delete

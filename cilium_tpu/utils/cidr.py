@@ -39,10 +39,11 @@ def parse_cidr_or_ip_classful(s: str) -> IPNetwork:
     mask are zero, else /32.  This Go-stdlib behavior is load-bearing
     for key construction in the CIDR policy map.
     """
-    try:
+    # Go net.ParseCIDR only accepts "ip/len" strings; Python's
+    # ip_network also accepts bare IPs (as /32), which would shadow the
+    # classful path — so branch on the slash explicitly.
+    if "/" in s:
         return ipaddress.ip_network(s, strict=False)
-    except ValueError:
-        pass
     ip = ipaddress.ip_address(s)
     if ip.version == 6:
         return ipaddress.ip_network((ip, 128))

@@ -7,12 +7,19 @@ separately dry-runs the multi-chip path via __graft_entry__.py).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The CI environment presets JAX_PLATFORMS=axon (one real chip) and
+# pre-imports jax at interpreter startup, so env vars are too late:
+# force the platform through the config API before any backend
+# initializes.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

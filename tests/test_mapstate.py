@@ -1,0 +1,172 @@
+"""Desired policy-map-state computation semantics.
+
+Mirrors the DryMode daemon tests (reference daemon/policy_test.go:471):
+policy rules + identity universe → exact expected PolicyMap keys.
+"""
+
+import pytest
+
+from cilium_tpu import option
+from cilium_tpu.compiler.mapstate import (
+    LOCALHOST_KEY,
+    WORLD_KEY,
+    compute_desired_policy_map_state,
+)
+from cilium_tpu.identity import (
+    RESERVED_HOST,
+    RESERVED_WORLD,
+)
+from cilium_tpu.labels import LabelArray, parse_select_label
+from cilium_tpu.maps.policymap import (
+    EGRESS,
+    INGRESS,
+    PolicyKey,
+)
+from cilium_tpu.policy.api import (
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+from cilium_tpu.policy.api.rule import L7Rules, PortRuleHTTP
+from cilium_tpu.policy.repository import Repository
+
+
+def es(*labels):
+    return EndpointSelector.from_labels(
+        *[parse_select_label(l) for l in labels]
+    )
+
+
+def larr(*labels):
+    return LabelArray.parse_select(*labels)
+
+
+# identity universe: app=foo (256), app=bar (257), app=baz (258)
+CACHE = {
+    256: larr("app=foo"),
+    257: larr("app=bar"),
+    258: larr("app=baz"),
+}
+
+
+def test_l3_entries_for_allowed_identities():
+    repo = Repository()
+    repo.add(
+        Rule(
+            endpoint_selector=es("app=bar"),
+            ingress=[IngressRule(from_endpoints=[es("app=foo")])],
+        )
+    )
+    state = compute_desired_policy_map_state(repo, CACHE, larr("app=bar"))
+    assert PolicyKey(256, 0, 0, INGRESS) in state
+    assert PolicyKey(257, 0, 0, INGRESS) not in state
+    assert PolicyKey(258, 0, 0, INGRESS) not in state
+    # no egress rules select app=bar → no egress allows
+    assert not any(k.traffic_direction == EGRESS for k in state)
+
+
+def test_l4_entries_per_selected_identity():
+    repo = Repository()
+    repo.add(
+        Rule(
+            endpoint_selector=es("app=bar"),
+            ingress=[
+                IngressRule(
+                    from_endpoints=[es("app=foo")],
+                    to_ports=[
+                        PortRule(
+                            ports=[PortProtocol(port="80", protocol="TCP")]
+                        )
+                    ],
+                )
+            ],
+        )
+    )
+    state = compute_desired_policy_map_state(repo, CACHE, larr("app=bar"))
+    # L4 rule with ToPorts → per-identity (id, 80, 6) key, no L3-only key
+    assert PolicyKey(256, 80, 6, INGRESS) in state
+    assert state[PolicyKey(256, 80, 6, INGRESS)].proxy_port == 0
+    assert PolicyKey(257, 80, 6, INGRESS) not in state
+    # ToPorts present → label-level verdict defers to L4 → no L3 entry
+    assert PolicyKey(256, 0, 0, INGRESS) not in state
+
+
+def test_wildcard_l3_rule_enumerates_universe():
+    """An L3-only allow-from-all rule yields one L3 key per identity
+    (v1.2 enumerates the identity cache, pkg/endpoint/policy.go:92)."""
+    repo = Repository()
+    repo.add(
+        Rule(
+            endpoint_selector=es("app=bar"),
+            ingress=[IngressRule(from_endpoints=[EndpointSelector.from_labels()])],
+        )
+    )
+    state = compute_desired_policy_map_state(repo, CACHE, larr("app=bar"))
+    for num_id in CACHE:
+        assert PolicyKey(num_id, 0, 0, INGRESS) in state
+
+
+def test_redirect_without_allocated_port_is_skipped():
+    repo = Repository()
+    repo.add(
+        Rule(
+            endpoint_selector=es("app=bar"),
+            ingress=[
+                IngressRule(
+                    from_endpoints=[es("app=foo")],
+                    to_ports=[
+                        PortRule(
+                            ports=[PortProtocol(port="80", protocol="TCP")],
+                            rules=L7Rules(
+                                http=[PortRuleHTTP(method="GET", path="/")]
+                            ),
+                        )
+                    ],
+                )
+            ],
+        )
+    )
+    state = compute_desired_policy_map_state(
+        repo, CACHE, larr("app=bar"), endpoint_id=42
+    )
+    # no allocated proxy port → the L4 key is deferred (policy.go:157)
+    assert PolicyKey(256, 80, 6, INGRESS) not in state
+    # but HasRedirect() → allow localhost (determineAllowLocalhost)
+    assert LOCALHOST_KEY in state
+
+    state2 = compute_desired_policy_map_state(
+        repo,
+        CACHE,
+        larr("app=bar"),
+        endpoint_id=42,
+        realized_redirects={"42:ingress:TCP:80": 15001},
+    )
+    assert state2[PolicyKey(256, 80, 6, INGRESS)].proxy_port == 15001
+
+
+def test_host_allows_world():
+    repo = Repository()
+    option.Config.allow_localhost = option.ALLOW_LOCALHOST_ALWAYS
+    option.Config.host_allows_world = True
+    state = compute_desired_policy_map_state(repo, CACHE, larr("app=bar"))
+    assert LOCALHOST_KEY in state
+    assert WORLD_KEY in state
+    assert WORLD_KEY.identity == RESERVED_WORLD
+    assert LOCALHOST_KEY.identity == RESERVED_HOST
+
+
+def test_policy_disabled_allows_all():
+    repo = Repository()
+    state = compute_desired_policy_map_state(
+        repo,
+        CACHE,
+        larr("app=bar"),
+        ingress_enabled=False,
+        egress_enabled=False,
+    )
+    for num_id in CACHE:
+        assert PolicyKey(num_id, 0, 0, INGRESS) in state
+        assert PolicyKey(num_id, 0, 0, EGRESS) in state
