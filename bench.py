@@ -1,0 +1,176 @@
+"""Headline benchmark: policy verdicts/sec on one chip.
+
+Workload (BASELINE.md config 5 shape): mixed L3/L4 policy lowered to
+per-endpoint tables — 16 endpoints × (256 L4 keys + L3 allows) over a
+65,536-identity universe (≈70k map entries, >50k-rule scale), replayed
+with 1M-tuple batches of synthetic Hubble-style flow tuples.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is against the driver target of 100M verdicts/sec
+aggregate on v5e-8, i.e. 12.5M verdicts/sec/chip.
+
+A bit-identity spot check against the host oracle runs first (honesty
+gate); `--smoke` runs only that, on small shapes, from real rules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_PER_CHIP = 100e6 / 8  # driver target spread over v5e-8
+
+
+def build_synthetic_states(
+    n_endpoints: int, n_identities: int, n_l4_keys: int, rng
+):
+    """Synthesize desired map states at config-5 scale directly (the
+    control-plane path is exercised by tests and --smoke; the bench
+    measures the datapath)."""
+    from cilium_tpu.maps.policymap import (
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    identity_ids = np.arange(256, 256 + n_identities, dtype=np.uint64)
+    ports = rng.choice(np.arange(1, 30000), size=n_l4_keys, replace=False)
+    states = []
+    for _ in range(n_endpoints):
+        state = {}
+        for p in ports:
+            d = int(rng.integers(0, 2))
+            proto = int(rng.choice([6, 17]))
+            proxy = int(rng.choice([0, 0, 0, 15001]))
+            for num_id in rng.choice(identity_ids, size=12):
+                state[PolicyKey(int(num_id), int(p), proto, d)] = (
+                    PolicyMapStateEntry(proxy_port=proxy)
+                )
+            if rng.random() < 0.2:
+                state[PolicyKey(0, int(p), proto, d)] = (
+                    PolicyMapStateEntry(proxy_port=proxy)
+                )
+        for num_id in rng.choice(identity_ids, size=n_l4_keys):
+            d = int(rng.integers(0, 2))
+            state[PolicyKey(int(num_id), 0, 0, d)] = PolicyMapStateEntry()
+        states.append(state)
+    return states, identity_ids
+
+
+def make_batches(rng, n_batches, b, n_endpoints, identity_ids, ports):
+    from cilium_tpu.engine.verdict import TupleBatch
+
+    batches = []
+    for _ in range(n_batches):
+        batches.append(
+            TupleBatch.from_numpy(
+                ep_index=rng.integers(0, n_endpoints, size=b),
+                identity=rng.choice(identity_ids, size=b).astype(np.uint32),
+                dport=rng.choice(ports, size=b),
+                proto=rng.choice([6, 17], size=b),
+                direction=rng.integers(0, 2, size=b),
+            )
+        )
+    return batches
+
+
+def spot_check(states, tables, batch, n=2048):
+    """Oracle bit-identity on a subsample — abort the bench if the
+    device path diverges from the reference semantics."""
+    from cilium_tpu.engine.oracle import evaluate_batch_oracle
+    from cilium_tpu.engine.verdict import evaluate_batch
+
+    sub = {
+        "ep_index": np.asarray(batch.ep_index[:n]),
+        "identity": np.asarray(batch.identity[:n]),
+        "dport": np.asarray(batch.dport[:n]),
+        "proto": np.asarray(batch.proto[:n]),
+        "direction": np.asarray(batch.direction[:n]),
+    }
+    want_allow, want_proxy, want_kind = evaluate_batch_oracle(
+        copy.deepcopy(states), **sub
+    )
+    from cilium_tpu.engine.verdict import TupleBatch
+
+    got = evaluate_batch(tables, TupleBatch.from_numpy(**sub))
+    assert (np.asarray(got.allowed) == want_allow).all(), "allow mismatch"
+    assert (np.asarray(got.proxy_port) == want_proxy).all(), "proxy mismatch"
+    assert (np.asarray(got.match_kind) == want_kind).all(), "kind mismatch"
+
+
+def smoke() -> None:
+    """Small end-to-end from real rules, on whatever backend is up."""
+    import __graft_entry__
+    import jax
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    n = int(np.asarray(out.allowed).sum())
+    print(f"smoke OK: {n} allows on {out.allowed.shape[0]} tuples")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--endpoints", type=int, default=16)
+    ap.add_argument("--identities", type=int, default=65536)
+    ap.add_argument("--l4-keys", type=int, default=256)
+    args = ap.parse_args()
+
+    sys.path.insert(0, "/root/repo")
+    if args.smoke:
+        smoke()
+        return
+
+    import jax
+
+    from cilium_tpu.compiler import compile_map_states
+    from cilium_tpu.engine.verdict import evaluate_batch
+
+    rng = np.random.default_rng(7)
+    states, identity_ids = build_synthetic_states(
+        args.endpoints, args.identities, args.l4_keys, rng
+    )
+    tables = compile_map_states(states, identity_ids)
+    tables = jax.device_put(tables)
+
+    ports = np.arange(1, 30000)
+    batches = make_batches(
+        rng, 4, args.batch, args.endpoints, identity_ids, ports
+    )
+    batches = [jax.device_put(b) for b in batches]
+
+    spot_check(states, tables, batches[0])
+
+    # warmup / compile
+    jax.block_until_ready(evaluate_batch(tables, batches[0]))
+
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(args.steps):
+        outs.append(evaluate_batch(tables, batches[i % len(batches)]))
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+
+    total = args.steps * args.batch
+    vps = total / dt
+    print(
+        json.dumps(
+            {
+                "metric": "verdicts_per_sec_per_chip",
+                "value": round(vps),
+                "unit": "verdicts/s",
+                "vs_baseline": round(vps / BASELINE_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,23 +1,29 @@
 """Lowering PolicyMapState → dense, padded device tensors.
 
-TPU-first design replacing the per-endpoint BPF hash map
-(pkg/maps/policymap) with integer tensors:
+TPU-first design replacing the per-endpoint BPF hash maps
+(pkg/maps/policymap) with direct-indexed integer tensors.  The guiding
+constraint is that XLA-TPU executes random HBM gathers at ~100M/s per
+chip, so every probe must be O(1) gathers — no device-side binary
+search, no per-tuple scans:
 
-  * identity axis: raw u32 security identities are mapped to dense
-    indices through a sorted `id_table` (device-side searchsorted —
-    the analog of the hash-map key probe, O(log n) but fully
-    vectorized over the batch and MXU/VPU friendly);
-  * L4 axis: the distinct (dport, proto) keys of the endpoint's
-    filters, packed into u32 `dport << 8 | proto` (at most a few
-    hundred per endpoint; the reference caps total map entries at
-    16,384, policymap.go:37);
-  * allow sets: bit-packed u32 words over the identity axis, one row
-    per (direction, l4-key) plus an L3-only row pair — 32× smaller
-    than bool tensors, so a 64k-identity × 1k-filter endpoint table is
-    ~8 MB instead of 256 MB of HBM.
+  * identity probe — raw u32 security identity → dense index through
+    two direct tables: `id_lo` for cluster-scope ids (dense from 0)
+    and `id_local` for local CIDR identities (dense from
+    LOCAL_ID_BASE).  One 4-byte gather each, both from tables that fit
+    VMEM for realistic universes (512k ids = 2 MB; the reference's
+    ipcache cap, ipcache.go:36).
+  * L4 key probe — (proto, dport) → global filter slot through a
+    256-entry proto remap plus a [8, 65536] u16 slot table (1 MB).
+    This replaces the reference's per-endpoint hash-map key probe
+    (policy.h:54) with two gathers shared by all endpoints.
+  * allow sets — bit-packed u32 words over the identity axis, one row
+    per (endpoint, direction, slot) plus an L3-only row pair; 32×
+    smaller than bool tensors (64k ids × 256 slots × 16 endpoints
+    ≈ 64 MB instead of 2 GB).
 
-All axes are padded to configurable buckets so that XLA compilation
-caches across table updates (SURVEY.md §7 hard part 3).
+All axes are padded to configurable buckets so XLA compilation caches
+across table updates (SURVEY.md §7 hard part 3).  Identities, ports
+and verdict bits are integers end-to-end — no floats (hard part 5).
 """
 
 from __future__ import annotations
@@ -27,6 +33,7 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from cilium_tpu.identity import IdentityAllocator
 from cilium_tpu.maps.policymap import (
     EGRESS,
     INGRESS,
@@ -37,9 +44,19 @@ from cilium_tpu.maps.policymap import (
 # Sentinel for padded slots of the sorted identity table: sorts above
 # every real identity, so searchsorted never aliases a real id.
 PAD_ID = np.uint32(0xFFFFFFFF)
-# Sentinel for padded / absent L4 key slots (a real packed key is at
-# most 0xFFFF << 8 | 0xFF < 0x01000000).
-PAD_PORTKEY = np.uint32(0xFFFFFFFF)
+# Absent-entry marker in the direct identity index tables.
+NO_INDEX = np.uint32(0xFFFFFFFF)
+# Absent-slot marker in the (proto, dport) → L4 slot table.
+NO_SLOT = np.uint16(0xFFFF)
+# Cap on direct-table sizes (2^22 u32 = 16 MB).  Identity universes
+# with non-local ids above this would need a hash-probe fallback; the
+# reference caps at 512k ipcache entries (ipcache.go:36), well below.
+MAX_DIRECT = 1 << 22
+# Proto slots: index 7 is reserved as the "unknown proto" row, whose
+# port_slot entries are all NO_SLOT.
+NUM_PROTO_SLOTS = 8
+
+LOCAL_ID_BASE = IdentityAllocator.LOCAL_IDENTITY_BASE
 
 NUM_DIRECTIONS = 2  # INGRESS, EGRESS
 
@@ -48,9 +65,11 @@ def _round_up(n: int, mult: int) -> int:
     return max(mult, ((n + mult - 1) // mult) * mult)
 
 
-def pack_port_proto(dport: int, proto: int) -> int:
-    """u32 key: dport<<8 | proto (both host byte order)."""
-    return (dport << 8) | proto
+def _pow2_at_least(n: int, floor: int) -> int:
+    size = floor
+    while size < n:
+        size <<= 1
+    return size
 
 
 @dataclass
@@ -60,43 +79,56 @@ class PolicyTables:
     dispatch (bpf/bpf_lxc.c:1039: per-tuple gather along the endpoint
     axis replaces the per-endpoint program jump).
 
-    Shapes (E endpoints, K padded L4 keys, N padded identities,
-    W = N // 32 words):
-      id_table       u32 [N]           sorted identity universe (shared)
-      l4_ports       u32 [E, 2, K]     packed (dport<<8|proto), PAD empty
-      l4_proxy       u16 [E, 2, K]     proxy port per L4 key
-      l4_allow_bits  u32 [E, 2, K, W]  per-identity allow bits (exact probe)
-      l4_wild        u8  [E, 2, K]     identity-0 wildcard slot (3rd probe)
-      l3_allow_bits  u32 [E, 2, W]     L3-only allow bits (2nd probe)
+    Gather budget: the kernel spends ~20-30 ms per 1M-tuple random
+    gather on TPU regardless of table size, so fused layouts matter
+    more than compactness — identity index is ONE table (`id_direct` =
+    cluster-scope ids dense from 0, then local CIDR ids dense from
+    `id_lo_len`), and proxy-port + wildcard-bit are ONE u32 word
+    (`l4_meta` = proxy << 1 | wild).
+
+    Shapes (E endpoints, Kg padded global L4 slots, N padded
+    identities, W = N // 32 words):
+      id_table       u32 [N]            sorted identity universe
+      id_direct      u32 [LO+LL]        id → index (two dense regions)
+      id_lo_len      i32 scalar         split point of id_direct
+      proto_slot     u32 [256]          IP proto byte → proto slot
+      port_slot      u16 [8, 65536]     (proto slot, dport) → L4 slot
+      l4_meta        u32 [E, 2, Kg]     proxy_port << 1 | wildcard
+      l4_allow_bits  u32 [E, 2, Kg, W]  per-identity allow (exact probe)
+      l3_allow_bits  u32 [E, 2, W]      L3-only allow (2nd probe)
     """
 
     id_table: np.ndarray
-    l4_ports: np.ndarray
-    l4_proxy: np.ndarray
+    id_direct: np.ndarray
+    id_lo_len: np.ndarray
+    proto_slot: np.ndarray
+    port_slot: np.ndarray
+    l4_meta: np.ndarray
     l4_allow_bits: np.ndarray
-    l4_wild: np.ndarray
     l3_allow_bits: np.ndarray
 
     @property
     def num_endpoints(self) -> int:
-        return self.l4_ports.shape[0]
+        return self.l4_meta.shape[0]
 
     @property
     def num_identities(self) -> int:
         return self.id_table.shape[0]
 
     @property
-    def num_l4_keys(self) -> int:
-        return self.l4_ports.shape[2]
+    def num_l4_slots(self) -> int:
+        return self.l4_meta.shape[2]
 
     def tree_flatten(self):
         return (
             (
                 self.id_table,
-                self.l4_ports,
-                self.l4_proxy,
+                self.id_direct,
+                self.id_lo_len,
+                self.proto_slot,
+                self.port_slot,
+                self.l4_meta,
                 self.l4_allow_bits,
-                self.l4_wild,
                 self.l3_allow_bits,
             ),
             None,
@@ -137,6 +169,32 @@ def build_id_table(
     return table
 
 
+def _build_direct_index(id_table: np.ndarray) -> Tuple[np.ndarray, int]:
+    """One fused direct id→index table for the O(1) identity probe:
+    [0, lo_len) maps cluster-scope ids, [lo_len, end) maps local CIDR
+    ids offset by LOCAL_ID_BASE.  Returns (id_direct, lo_len)."""
+    ids = id_table[id_table != PAD_ID].astype(np.int64)
+    index = np.arange(len(ids), dtype=np.uint32)
+
+    local_mask = ids >= LOCAL_ID_BASE
+    lo_ids, lo_idx = ids[~local_mask], index[~local_mask]
+    local_ids, local_idx = ids[local_mask] - LOCAL_ID_BASE, index[local_mask]
+
+    lo_max = int(lo_ids.max()) + 1 if len(lo_ids) else 1
+    ll_max = int(local_ids.max()) + 1 if len(local_ids) else 1
+    if lo_max > MAX_DIRECT or ll_max > MAX_DIRECT:
+        raise ValueError(
+            f"identity id range too large for direct indexing "
+            f"(lo={lo_max}, local={ll_max}, cap={MAX_DIRECT})"
+        )
+    lo_len = _pow2_at_least(lo_max, 1024)
+    ll_len = _pow2_at_least(ll_max, 32)
+    id_direct = np.full(lo_len + ll_len, NO_INDEX, dtype=np.uint32)
+    id_direct[lo_ids] = lo_idx
+    id_direct[lo_len + local_ids] = local_idx
+    return id_direct, lo_len
+
+
 def lower_map_state(
     states: Sequence[PolicyMapState],
     id_table: np.ndarray,
@@ -146,60 +204,51 @@ def lower_map_state(
 
     Any state entry whose identity is absent from `id_table` would be
     unreachable in the reference too (the BPF map key could never be
-    probed with that source identity derived from ipcache); we assert
-    against it to surface compiler/universe skew early — the moral
+    probed with that source identity derived from ipcache); we raise
+    on it to surface compiler/universe skew early — the moral
     equivalent of pkg/alignchecker.
     """
-    id_list = id_table.tolist()
     n = id_table.shape[0]
     w = n // 32
     id_index: Dict[int, int] = {}
-    for i, v in enumerate(id_list):
+    for i, v in enumerate(id_table.tolist()):
         if v == int(PAD_ID):
             break
         id_index[v] = i
+    id_direct, id_lo_len = _build_direct_index(id_table)
 
     e_count = len(states)
 
-    # Collect per-endpoint distinct (dport, proto) key sets per direction.
-    per_ep_l4: List[Dict[Tuple[int, int, int], Dict]] = []
-    max_k = 1
-    for state in states:
-        l4: Dict[Tuple[int, int, int], Dict] = {}
-        for key, entry in state.items():
-            if key.is_l3_only():
-                continue
-            kk = (key.traffic_direction, key.dest_port, key.nexthdr)
-            slot = l4.setdefault(
-                kk, {"proxy": entry.proxy_port, "ids": [], "wild": False}
-            )
-            # proxy port is constant per (port,proto,dir): one L4Filter
-            # per L4PolicyMap key (pkg/policy/l4.go:276).  A state that
-            # violates this cannot be lowered without diverging from
-            # the per-entry oracle — refuse it.
-            if slot["proxy"] != entry.proxy_port:
-                raise ValueError(
-                    f"conflicting proxy ports for {kk}: "
-                    f"{slot['proxy']} vs {entry.proxy_port}"
-                )
-            if key.identity == 0:
-                slot["wild"] = True
-            else:
-                slot["ids"].append(key.identity)
-        per_ep_l4.append(l4)
-        for d in (INGRESS, EGRESS):
-            kcount = sum(1 for kk in l4 if kk[0] == d)
-            max_k = max(max_k, kcount)
+    # Global slot space: distinct (dport, proto) over all endpoints.
+    all_keys = sorted(
+        {
+            (k.dest_port, k.nexthdr)
+            for state in states
+            for k in state
+            if not k.is_l3_only()
+        }
+    )
+    protos = sorted({p for _, p in all_keys})
+    if len(protos) > NUM_PROTO_SLOTS - 1:
+        raise ValueError(
+            f"more than {NUM_PROTO_SLOTS - 1} distinct IP protocols in "
+            f"L4 keys: {protos}"
+        )
+    proto_to_pslot = {p: i for i, p in enumerate(protos)}
+    kg = _round_up(max(len(all_keys), 1), filter_pad)
+    slot_of = {key: j for j, key in enumerate(all_keys)}
 
-    k = _round_up(max_k, filter_pad)
+    proto_slot = np.full((256,), NUM_PROTO_SLOTS - 1, dtype=np.uint32)
+    for p, s in proto_to_pslot.items():
+        proto_slot[p] = s
+    port_slot = np.full((NUM_PROTO_SLOTS, 65536), NO_SLOT, dtype=np.uint16)
+    for (dport, proto), j in slot_of.items():
+        port_slot[proto_to_pslot[proto], dport] = j
 
-    l4_ports = np.full((e_count, 2, k), PAD_PORTKEY, dtype=np.uint32)
-    l4_proxy = np.zeros((e_count, 2, k), dtype=np.uint16)
-    l4_wild = np.zeros((e_count, 2, k), dtype=np.uint8)
+    l4_meta = np.zeros((e_count, 2, kg), dtype=np.uint32)
     # Bits are set directly into the packed words — never materialize
-    # the dense [E, 2, K, N] bool tensor (it would be 32× the size the
-    # packing exists to avoid).
-    l4_allow_bits = np.zeros((e_count, 2, k, w), dtype=np.uint32)
+    # the dense [E, 2, Kg, N] bool tensor.
+    l4_allow_bits = np.zeros((e_count, 2, kg, w), dtype=np.uint32)
     l3_allow_bits = np.zeros((e_count, 2, w), dtype=np.uint32)
 
     def _id_idx(num_id: int) -> int:
@@ -211,33 +260,44 @@ def lower_map_state(
             )
         return idx
 
-    for e, (state, l4) in enumerate(zip(states, per_ep_l4)):
-        slot_idx = {INGRESS: 0, EGRESS: 0}
-        for (d, dport, proto), slot in sorted(l4.items()):
-            j = slot_idx[d]
-            slot_idx[d] += 1
-            l4_ports[e, d, j] = pack_port_proto(dport, proto)
-            l4_proxy[e, d, j] = slot["proxy"]
-            l4_wild[e, d, j] = 1 if slot["wild"] else 0
-            for num_id in slot["ids"]:
-                idx = _id_idx(num_id)
+    # Track per-(e,d,slot) proxy consistency: one L4Filter per
+    # port/proto key in an L4PolicyMap (pkg/policy/l4.go:276), so one
+    # proxy port; conflicting states can't be lowered without
+    # diverging from the per-entry oracle.
+    proxy_seen: Dict[Tuple[int, int, int], int] = {}
+
+    for e, state in enumerate(states):
+        for key, entry in state.items():
+            d = key.traffic_direction
+            if key.is_l3_only():
+                idx = _id_idx(key.identity)
+                l3_allow_bits[e, d, idx >> 5] |= np.uint32(1 << (idx & 31))
+                continue
+            j = slot_of[(key.dest_port, key.nexthdr)]
+            prev = proxy_seen.setdefault((e, d, j), entry.proxy_port)
+            if prev != entry.proxy_port:
+                raise ValueError(
+                    f"conflicting proxy ports for endpoint {e} slot "
+                    f"{(key.dest_port, key.nexthdr, d)}: "
+                    f"{prev} vs {entry.proxy_port}"
+                )
+            l4_meta[e, d, j] |= np.uint32(entry.proxy_port << 1)
+            if key.identity == 0:
+                l4_meta[e, d, j] |= np.uint32(1)
+            else:
+                idx = _id_idx(key.identity)
                 l4_allow_bits[e, d, j, idx >> 5] |= np.uint32(
                     1 << (idx & 31)
                 )
-        for key in state:
-            if not key.is_l3_only():
-                continue
-            idx = _id_idx(key.identity)
-            l3_allow_bits[e, key.traffic_direction, idx >> 5] |= np.uint32(
-                1 << (idx & 31)
-            )
 
     return PolicyTables(
         id_table=id_table,
-        l4_ports=l4_ports,
-        l4_proxy=l4_proxy,
+        id_direct=id_direct,
+        id_lo_len=np.int32(id_lo_len),
+        proto_slot=proto_slot,
+        port_slot=port_slot,
+        l4_meta=l4_meta,
         l4_allow_bits=l4_allow_bits,
-        l4_wild=l4_wild,
         l3_allow_bits=l3_allow_bits,
     )
 

@@ -1,0 +1,149 @@
+"""Mesh-sharded verdict evaluation (SPMD over batch × identity axes).
+
+Two parallel axes, mirroring §2.9 of SURVEY.md:
+
+  * `batch` — data parallelism over flow tuples (packets shard across
+    nodes in the reference; zero-communication).
+  * `table` — the identity (bit-word) axis of the allow tensors is
+    sharded when the rule/identity tensors exceed a single chip's HBM
+    (a 512k-identity universe × 16k L4 slots would not fit).  The
+    small index tables (id_direct/proto_slot/port_slot) replicate
+    and resolve a tuple's *global* identity index; each shard then
+    tests only the bit-words it owns, and probe hits combine with a
+    psum over the axis — the "verdict lattice psum" described in
+    SURVEY.md §5 (0/1 hits, associative, order-safe).
+
+The step also accumulates per-entry packet counters (policy_entry
+packets, bpf/lib/policy.h:66-68): L4-slot counters replicate, L3
+per-identity counters stay sharded along `table`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cilium_tpu.compiler.tables import PolicyTables
+from cilium_tpu.engine.oracle import MATCH_L3, MATCH_L4, MATCH_L4_WILD
+from cilium_tpu.engine.verdict import (
+    TupleBatch,
+    Verdicts,
+    _combine,
+    _index,
+)
+
+try:  # jax>=0.4.30 moved shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def table_specs(batch_axis: str, table_axis: str) -> PolicyTables:
+    """PartitionSpecs for a PolicyTables pytree: allow-bit word axes
+    sharded along `table_axis`, index tables replicated."""
+    return PolicyTables(
+        id_table=P(),
+        id_direct=P(),
+        id_lo_len=P(),
+        proto_slot=P(),
+        port_slot=P(),
+        l4_meta=P(),
+        l4_allow_bits=P(None, None, None, table_axis),
+        l3_allow_bits=P(None, None, table_axis),
+    )
+
+
+def batch_specs(batch_axis: str) -> TupleBatch:
+    s = P(batch_axis)
+    return TupleBatch(
+        ep_index=s, identity=s, dport=s, proto=s, direction=s, is_fragment=s
+    )
+
+
+def make_mesh_evaluator(
+    mesh: Mesh, batch_axis: str = "batch", table_axis: str = "table"
+):
+    """Jitted full datapath step over a 2D (batch × table) mesh.
+
+    Returns fn(tables, batch) -> (Verdicts, l4_counts, l3_counts):
+      l4_counts u32 [E, 2, Kg]       replicated
+      l3_counts u32 [E, 2, N]        sharded along identity (table) axis
+    """
+    t_specs = table_specs(batch_axis, table_axis)
+    b_specs = batch_specs(batch_axis)
+    v_specs = Verdicts(
+        allowed=P(batch_axis),
+        proxy_port=P(batch_axis),
+        match_kind=P(batch_axis),
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(t_specs, b_specs),
+        out_specs=(v_specs, P(), P(None, None, table_axis)),
+        check_vma=False,
+    )
+    def step(tables_l: PolicyTables, batch_l: TupleBatch):
+        # Index resolution uses only replicated tables → global values.
+        idx, word, bit, known, j, has_port, proxy, wild = _index(
+            tables_l, batch_l
+        )
+
+        # This shard owns bit-words [off, off + w_local).
+        w_local = tables_l.l3_allow_bits.shape[-1]
+        off = jax.lax.axis_index(table_axis) * w_local
+        wl = word - off
+        in_shard = (wl >= 0) & (wl < w_local)
+        wl = jnp.clip(wl, 0, w_local - 1)
+
+        exact_words = tables_l.l4_allow_bits[
+            batch_l.ep_index, batch_l.direction, j, wl
+        ]
+        p1 = (
+            known
+            & has_port
+            & in_shard
+            & ((exact_words >> bit) & 1).astype(bool)
+        )
+        l3_words = tables_l.l3_allow_bits[
+            batch_l.ep_index, batch_l.direction, wl
+        ]
+        p2 = known & in_shard & ((l3_words >> bit) & 1).astype(bool)
+        p3 = wild & has_port  # identity-independent: same in all shards
+
+        # Combine probe hits across identity shards: each identity is
+        # resident in exactly one shard, so the sums are 0/1.
+        p1g = jax.lax.psum(p1.astype(jnp.int32), table_axis) > 0
+        p2g = jax.lax.psum(p2.astype(jnp.int32), table_axis) > 0
+
+        v = _combine(p1g, p2g, p3, proxy, batch_l.is_fragment)
+
+        # Counters.  L4-slot hits are determined by globally-combined
+        # bits, so every table shard computes the same array.
+        e_count, _, kg = tables_l.l4_meta.shape
+        hit_l4 = (v.match_kind == MATCH_L4) | (
+            v.match_kind == MATCH_L4_WILD
+        )
+        l4_counts = jnp.zeros((e_count, 2, kg), jnp.uint32).at[
+            batch_l.ep_index, batch_l.direction, j
+        ].add(hit_l4.astype(jnp.uint32))
+        # L3 hit whose identity bit-word lives in *this* shard.
+        l3_hit_here = p2 & (v.match_kind == MATCH_L3)
+        idx_l = jnp.clip(idx - off * 32, 0, w_local * 32 - 1)
+        l3_counts = jnp.zeros((e_count, 2, w_local * 32), jnp.uint32).at[
+            batch_l.ep_index, batch_l.direction, idx_l
+        ].add(l3_hit_here.astype(jnp.uint32))
+
+        l4_counts = jax.lax.psum(l4_counts, batch_axis)
+        l3_counts = jax.lax.psum(l3_counts, batch_axis)
+        return v, l4_counts, l3_counts
+
+    in_shardings = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s), t_specs),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), b_specs),
+    )
+    return jax.jit(step, in_shardings=in_shardings)
