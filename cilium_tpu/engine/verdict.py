@@ -247,6 +247,28 @@ def _verdict_kernel_with_counters(tables: PolicyTables, batch: TupleBatch):
 evaluate_batch = jax.jit(_verdict_kernel)
 
 
+def _verdict_kernel_from_ips(lpm_tables, policy_tables, src_ips, batch):
+    """Fused datapath: derive the source identity from the raw IP via
+    the DIR-24-8 ipcache (bpf_netdev.c's identity derivation before
+    the tail call into the policy program), then run the lattice.
+    IPs that miss the ipcache resolve to identity 0 (unknown)."""
+    from cilium_tpu.ipcache.lpm import _lookup_kernel
+
+    ids = _lookup_kernel(lpm_tables, src_ips.astype(jnp.uint32))
+    resolved = TupleBatch(
+        ep_index=batch.ep_index,
+        identity=ids,
+        dport=batch.dport,
+        proto=batch.proto,
+        direction=batch.direction,
+        is_fragment=batch.is_fragment,
+    )
+    return _verdict_kernel(policy_tables, resolved)
+
+
+evaluate_batch_from_ips = jax.jit(_verdict_kernel_from_ips)
+
+
 def make_sharded_evaluator(mesh: Optional[jax.sharding.Mesh] = None,
                            batch_axis: str = "batch"):
     """Return a jitted evaluator with the batch axis sharded over the

@@ -1,0 +1,28 @@
+"""IP/CIDR → security-identity resolution.
+
+Host side (`ipcache.IPCache`) re-designs /root/reference/pkg/ipcache:
+source-priority overwrite, endpoint-IP-shadows-CIDR, prefix-length
+refcounts, listener fan-out.  Device side (`lpm`) replaces the kernel
+LPM trie (bpf/lib/eps.h) with a DIR-24-8 two-level direct table:
+longest-prefix match in exactly two gathers per lookup.
+"""
+
+from cilium_tpu.ipcache.ipcache import (
+    FROM_AGENT_LOCAL,
+    FROM_K8S,
+    FROM_KVSTORE,
+    IPCache,
+    IPIdentity,
+)
+from cilium_tpu.ipcache.lpm import LPMTables, build_lpm, lpm_lookup
+
+__all__ = [
+    "IPCache",
+    "IPIdentity",
+    "FROM_K8S",
+    "FROM_KVSTORE",
+    "FROM_AGENT_LOCAL",
+    "LPMTables",
+    "build_lpm",
+    "lpm_lookup",
+]

@@ -1,0 +1,190 @@
+"""Device longest-prefix-match: DIR-24-8 two-level direct tables.
+
+TPU-first replacement for the kernel's `cilium_ipcache` LPM trie
+(bpf/lib/eps.h:70 ipcache_lookup4; unrolled fallback eps.h:86-108).
+Instead of a trie walk or a per-prefix-length probe loop (bounded at
+40 lengths, rule_validation.go:29), the classic DIR-24-8 router layout
+gives LPM in exactly TWO gathers per lookup:
+
+  l1  u32 [2^24]       indexed by ip >> 8:
+                         bit31 clear → identity for all of ip>>8
+                         bit31 set   → block index into l2
+  l2  u32 [blocks, 256] indexed by (block, ip & 0xFF) → identity
+
+Identity 0 (IdentityUnknown) marks "no entry", matching the datapath's
+WORLD_ID fallback decision happening elsewhere (bpf_netdev.c derives
+identity, defaulting to world when the ipcache misses).
+
+Build is host-side NumPy range-painting, shortest prefix first, so
+longer prefixes overwrite — exactly longest-match semantics.  IPv6
+uses the same structure on the top 24 bits of a host-side-hashed /64?
+No: IPv6 is resolved host-side for now (the reference's LPM map is
+v4+v6; v6 flow volume is the minority path) — device v6 tables are a
+TODO tracked in SURVEY §7.
+
+The `LPMBuilder` listener subscribes to the host IPCache and mirrors
+pkg/datapath/ipcache/listener.go:78 (BPFListener): it accumulates the
+listener-visible mappings and lowers them to device tables on flush.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+L1_BITS = 24
+L1_SIZE = 1 << L1_BITS
+BLOCK_FLAG = np.uint32(1 << 31)
+# ipcache.go:36 MaxEntries — table capacity envelope of the reference.
+MAX_ENTRIES = 512_000
+
+
+@dataclass
+class LPMTables:
+    """Device-resident DIR-24-8 tables (pytree)."""
+
+    l1: np.ndarray  # u32 [2^24]
+    l2: np.ndarray  # u32 [n_blocks, 256]
+
+    def tree_flatten(self):
+        return ((self.l1, self.l2), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            LPMTables,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: LPMTables.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+def build_lpm(prefix_to_id: Dict[str, int]) -> LPMTables:
+    """Lower {ipv4 cidr string → identity} to DIR-24-8 tables.
+
+    Prefixes are painted shortest-first; each /24 cell that contains a
+    >24-bit prefix is expanded into a 256-entry L2 block seeded with
+    the best ≤24-bit cover.
+    """
+    parsed = []
+    for cidr, num_id in prefix_to_id.items():
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 4:
+            continue  # v6 resolved host-side (module docstring)
+        if num_id >= 1 << 31:
+            raise ValueError(f"identity {num_id} exceeds 31-bit LPM range")
+        parsed.append((net.prefixlen, int(net.network_address), num_id))
+    parsed.sort()
+
+    l1 = np.zeros(L1_SIZE, dtype=np.uint32)
+    blocks = []  # list of np.ndarray(256, u32)
+    block_of_cell: Dict[int, int] = {}
+
+    for plen, base, num_id in parsed:
+        if plen <= L1_BITS:
+            lo = base >> (32 - L1_BITS)
+            span = 1 << (L1_BITS - plen)
+            cells = np.arange(lo, lo + span)
+            # Paint plain cells; descend into already-expanded blocks.
+            ptr_mask = (l1[cells] & BLOCK_FLAG) != 0
+            l1[cells[~ptr_mask]] = num_id
+            for cell in cells[ptr_mask]:
+                blocks[int(l1[cell] & ~BLOCK_FLAG)][:] = num_id
+        else:
+            cell = base >> 8
+            bi = block_of_cell.get(cell)
+            if bi is None:
+                bi = len(blocks)
+                seed = l1[cell]
+                if seed & BLOCK_FLAG:
+                    raise AssertionError("cell already a block")
+                blocks.append(np.full(256, seed, dtype=np.uint32))
+                block_of_cell[cell] = bi
+                l1[cell] = BLOCK_FLAG | np.uint32(bi)
+            lo = base & 0xFF
+            span = 1 << (32 - plen)
+            blocks[bi][lo : lo + span] = num_id
+
+    l2 = (
+        np.stack(blocks)
+        if blocks
+        else np.zeros((1, 256), dtype=np.uint32)
+    )
+    return LPMTables(l1=l1, l2=l2)
+
+
+def _lookup_kernel(tables: LPMTables, ips):
+    import jax.numpy as jnp
+
+    v1 = tables.l1[(ips >> 8).astype(jnp.int32)]
+    is_block = (v1 & BLOCK_FLAG) != 0
+    block = jnp.where(is_block, v1 & ~BLOCK_FLAG, 0).astype(jnp.int32)
+    v2 = tables.l2[block, (ips & 0xFF).astype(jnp.int32)]
+    return jnp.where(is_block, v2, v1)
+
+
+def lpm_lookup(tables: LPMTables, ips) -> "jax.Array":
+    """Batched IPv4 → identity (u32; 0 = no entry).  Two gathers."""
+    import jax
+
+    return jax.jit(_lookup_kernel)(tables, ips)
+
+
+def lookup_host(prefix_to_id: Dict[str, int], ip: str) -> int:
+    """Host reference LPM (the oracle for build_lpm/lpm_lookup)."""
+    addr = ipaddress.ip_address(ip)
+    best_len, best_id = -1, 0
+    for cidr, num_id in prefix_to_id.items():
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != addr.version:
+            continue
+        if addr in net and net.prefixlen > best_len:
+            best_len, best_id = net.prefixlen, num_id
+    return best_id
+
+
+class LPMBuilder:
+    """IPCache listener accumulating the listener-visible CIDR→identity
+    view and lowering it to device tables — the analog of the
+    BPFListener keeping `cilium_ipcache` in sync
+    (pkg/datapath/ipcache/listener.go:78)."""
+
+    def __init__(self) -> None:
+        self.mappings: Dict[str, int] = {}
+        self._dirty = True
+        self._tables: Optional[LPMTables] = None
+
+    def __call__(
+        self,
+        modification: str,
+        cidr: str,
+        old_host_ip,
+        new_host_ip,
+        old_id,
+        new_id: int,
+    ) -> None:
+        if modification == "upsert":
+            self.mappings[cidr] = new_id
+        else:
+            self.mappings.pop(cidr, None)
+        self._dirty = True
+
+    def tables(self) -> LPMTables:
+        if self._dirty or self._tables is None:
+            self._tables = build_lpm(self.mappings)
+            self._dirty = False
+        return self._tables

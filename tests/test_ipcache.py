@@ -1,0 +1,269 @@
+"""IPCache host semantics + DIR-24-8 device LPM bit-identity.
+
+Host cases mirror /root/reference/pkg/ipcache/ipcache_test.go
+(TestIPCache shadowing sequences) and the source-priority rules
+(ipcache.go:183).
+"""
+
+import ipaddress
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.ipcache import (
+    FROM_AGENT_LOCAL,
+    FROM_K8S,
+    FROM_KVSTORE,
+    IPCache,
+    IPIdentity,
+    build_lpm,
+    lpm_lookup,
+)
+from cilium_tpu.ipcache.lpm import LPMBuilder, lookup_host
+
+
+class Recorder:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, mod, cidr, old_host, new_host, old_id, new_id):
+        self.events.append((mod, cidr, old_id, new_id))
+
+
+def test_source_priority():
+    c = IPCache()
+    assert c.upsert("1.1.1.1", IPIdentity(100, FROM_KVSTORE))
+    # k8s may not overwrite kvstore
+    assert not c.upsert("1.1.1.1", IPIdentity(200, FROM_K8S))
+    ident, ok = c.lookup_by_ip("1.1.1.1")
+    assert ok and ident.id == 100
+    # agent-local may overwrite kvstore
+    assert c.upsert("1.1.1.1", IPIdentity(300, FROM_AGENT_LOCAL))
+    # kvstore may not overwrite agent-local
+    assert not c.upsert("1.1.1.1", IPIdentity(400, FROM_KVSTORE))
+    # k8s is overwritten by anyone
+    c2 = IPCache()
+    assert c2.upsert("2.2.2.2", IPIdentity(1, FROM_K8S))
+    assert c2.upsert("2.2.2.2", IPIdentity(2, FROM_K8S))
+
+
+def test_endpoint_ip_shadows_cidr():
+    """Upsert CIDR then its /32-equivalent endpoint IP: listeners see
+    the endpoint IP take over; re-upserting the CIDR is silent; and
+    deleting the endpoint IP revives the CIDR (ipcache.go:247-405)."""
+    c = IPCache()
+    rec = Recorder()
+    c.add_listener(rec)
+
+    c.upsert("10.0.0.5/32", IPIdentity(100, FROM_KVSTORE))
+    assert rec.events[-1] == ("upsert", "10.0.0.5/32", None, 100)
+
+    # endpoint IP with different identity starts shadowing
+    c.upsert("10.0.0.5", IPIdentity(200, FROM_AGENT_LOCAL))
+    assert rec.events[-1] == ("upsert", "10.0.0.5/32", 100, 200)
+
+    # CIDR upsert while shadowed: cache updated, listeners silent
+    n = len(rec.events)
+    c.upsert("10.0.0.5/32", IPIdentity(101, FROM_KVSTORE))
+    assert len(rec.events) == n
+
+    # deleting the endpoint IP revives the CIDR mapping as an upsert
+    c.delete("10.0.0.5")
+    assert rec.events[-1] == ("upsert", "10.0.0.5/32", 200, 101)
+
+    # deleting the CIDR now notifies a delete
+    c.delete("10.0.0.5/32")
+    assert rec.events[-1] == ("delete", "10.0.0.5/32", None, 101)
+
+
+def test_shadow_same_identity_is_silent():
+    c = IPCache()
+    rec = Recorder()
+    c.add_listener(rec)
+    c.upsert("10.0.0.7/32", IPIdentity(100, FROM_KVSTORE))
+    n = len(rec.events)
+    # same identity, same (no) host ip → nothing for listeners
+    c.upsert("10.0.0.7", IPIdentity(100, FROM_AGENT_LOCAL))
+    assert len(rec.events) == n
+    c.delete("10.0.0.7")
+    assert len(rec.events) == n
+
+
+def test_prefix_length_refcounts():
+    c = IPCache()
+    c.upsert("10.0.0.0/8", IPIdentity(1, FROM_KVSTORE))
+    c.upsert("10.1.0.0/16", IPIdentity(2, FROM_KVSTORE))
+    c.upsert("10.2.0.0/16", IPIdentity(3, FROM_KVSTORE))
+    assert c.v4_prefix_lengths == {8: 1, 16: 2}
+    c.delete("10.1.0.0/16")
+    assert c.v4_prefix_lengths == {8: 1, 16: 1}
+    c.upsert("f00d::/64", IPIdentity(4, FROM_KVSTORE))
+    assert c.v6_prefix_lengths == {64: 1}
+
+
+def test_lookup_by_prefix_full_tries_endpoint_ip():
+    c = IPCache()
+    c.upsert("3.3.3.3", IPIdentity(7, FROM_AGENT_LOCAL))
+    ident, ok = c.lookup_by_prefix("3.3.3.3/32")
+    assert ok and ident.id == 7
+
+
+def test_lookup_by_identity():
+    c = IPCache()
+    c.upsert("4.4.4.4", IPIdentity(9, FROM_AGENT_LOCAL))
+    c.upsert("4.4.4.0/24", IPIdentity(9, FROM_KVSTORE))
+    ips, ok = c.lookup_by_identity(9)
+    assert ok and ips == {"4.4.4.4", "4.4.4.0/24"}
+
+
+# ---------------------------------------------------------------------------
+# device LPM
+# ---------------------------------------------------------------------------
+
+
+def _ip(n):
+    return str(ipaddress.IPv4Address(n))
+
+
+def test_lpm_basic():
+    mapping = {
+        "0.0.0.0/0": 2,  # world
+        "10.0.0.0/8": 100,
+        "10.1.0.0/16": 200,
+        "10.1.2.0/24": 300,
+        "10.1.2.3/32": 400,
+        "192.168.0.0/25": 500,
+    }
+    t = build_lpm(mapping)
+    ips = np.array(
+        [
+            int(ipaddress.IPv4Address(a))
+            for a in [
+                "10.2.3.4",  # /8 → 100
+                "10.1.9.9",  # /16 → 200
+                "10.1.2.99",  # /24 → 300
+                "10.1.2.3",  # /32 → 400
+                "192.168.0.77",  # /25 → 500
+                "192.168.0.200",  # outside /25 → default 2
+                "8.8.8.8",  # default → 2
+            ]
+        ],
+        dtype=np.uint32,
+    )
+    got = np.asarray(lpm_lookup(t, jnp.asarray(ips)))
+    assert got.tolist() == [100, 200, 300, 400, 500, 2, 2]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_lpm_fuzz_vs_host_oracle(seed):
+    rng = np.random.default_rng(seed)
+    mapping = {}
+    for _ in range(200):
+        plen = int(rng.integers(0, 33))
+        base = int(rng.integers(0, 1 << 32)) & (
+            ~((1 << (32 - plen)) - 1) & 0xFFFFFFFF
+        )
+        mapping[f"{_ip(base)}/{plen}"] = int(rng.integers(1, 1 << 20))
+    t = build_lpm(mapping)
+
+    # probe: random ips + perturbations of prefix bases
+    probes = [int(rng.integers(0, 1 << 32)) for _ in range(64)]
+    for cidr in list(mapping)[:32]:
+        net = ipaddress.ip_network(cidr)
+        probes.append(int(net.network_address))
+        probes.append(int(net.broadcast_address))
+    ips = np.array(probes, dtype=np.uint32)
+    got = np.asarray(lpm_lookup(t, jnp.asarray(ips)))
+    want = np.array(
+        [lookup_host(mapping, _ip(p)) for p in probes], dtype=np.uint32
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lpm_builder_follows_ipcache():
+    c = IPCache()
+    b = LPMBuilder()
+    c.add_listener(b)
+    c.upsert("10.0.0.0/8", IPIdentity(100, FROM_KVSTORE))
+    c.upsert("10.1.0.0/16", IPIdentity(200, FROM_KVSTORE))
+    c.upsert("7.7.7.7", IPIdentity(300, FROM_AGENT_LOCAL))  # endpoint IP
+
+    t = b.tables()
+    ips = np.array(
+        [
+            int(ipaddress.IPv4Address(a))
+            for a in ["10.9.9.9", "10.1.1.1", "7.7.7.7", "9.9.9.9"]
+        ],
+        dtype=np.uint32,
+    )
+    got = np.asarray(lpm_lookup(t, jnp.asarray(ips)))
+    assert got.tolist() == [100, 200, 300, 0]
+
+    # shadowing: CIDR behind an endpoint IP never reaches the builder
+    c.upsert("7.7.7.7/32", IPIdentity(400, FROM_KVSTORE))
+    got = np.asarray(lpm_lookup(b.tables(), jnp.asarray(ips)))
+    assert got.tolist() == [100, 200, 300, 0]
+    # removing the endpoint IP revives the CIDR view
+    c.delete("7.7.7.7")
+    got = np.asarray(lpm_lookup(b.tables(), jnp.asarray(ips)))
+    assert got.tolist() == [100, 200, 400, 0]
+
+
+def test_allocate_cidrs_end_to_end():
+    """CIDR policy prefix → local identity + ipcache mapping + device
+    LPM + verdict on raw IPs (BASELINE config 2 slice)."""
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.engine.verdict import (
+        TupleBatch,
+        evaluate_batch_from_ips,
+    )
+    from cilium_tpu.identity import IdentityAllocator
+    from cilium_tpu.ipcache.cidr import allocate_cidrs, release_cidrs
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    cache = IPCache()
+    builder = LPMBuilder()
+    cache.add_listener(builder)
+    alloc = IdentityAllocator()
+
+    idents = allocate_cidrs(cache, alloc, ["10.0.0.0/8", "192.168.1.0/24"])
+    assert all(i.id >= IdentityAllocator.LOCAL_IDENTITY_BASE for i in idents)
+    # idempotent: same CIDR → same identity
+    again = allocate_cidrs(cache, alloc, ["10.0.0.0/8"])
+    assert again[0].id == idents[0].id
+
+    # policy: allow ingress from 10.0.0.0/8 on 80/tcp
+    state = {
+        PolicyKey(idents[0].id, 80, 6, INGRESS): PolicyMapStateEntry(),
+    }
+    tables = compile_map_states(
+        [state], [i.id for i in idents], identity_pad=32, filter_pad=8
+    )
+    ips = np.array(
+        [
+            int(ipaddress.IPv4Address(a))
+            for a in ["10.1.2.3", "192.168.1.5", "8.8.8.8"]
+        ],
+        dtype=np.uint32,
+    )
+    b = TupleBatch.from_numpy(
+        ep_index=[0, 0, 0],
+        identity=[0, 0, 0],  # overridden by LPM resolution
+        dport=[80, 80, 80],
+        proto=[6, 6, 6],
+        direction=[INGRESS] * 3,
+    )
+    got = evaluate_batch_from_ips(builder.tables(), tables, jnp.asarray(ips), b)
+    assert np.asarray(got.allowed).tolist() == [1, 0, 0]
+
+    # release: refcount drops; second release removes mapping
+    release_cidrs(cache, alloc, ["10.0.0.0/8"])
+    assert cache.lookup_by_prefix("10.0.0.0/8")[1]  # still held (refcount)
+    release_cidrs(cache, alloc, ["10.0.0.0/8"])
+    assert not cache.lookup_by_prefix("10.0.0.0/8")[1]
