@@ -1,0 +1,14 @@
+"""L7 protocol matchers (the proxy verdict path).
+
+In the reference, L7 matching runs in Envoy C++ filters
+(envoy/cilium_l7policy.cc) / the Go Kafka proxy (pkg/proxy/kafka.go),
+fed by NPDS policy (pkg/envoy/server.go getHTTPRule: Path/Method/Host
+become Envoy regex HeaderMatchers — i.e. FULL-string matches).
+
+Here the hot path is tensorized: HTTP rules compile to per-field
+union DFAs with per-rule accept bitmasks (`regex_dfa`), evaluated by
+the device engine over padded request byte tensors (`http`); Kafka
+rules compile to field-equality tables (`kafka`).  Pathological
+regexes and header constraints fall back to host evaluation, like the
+reference keeps Envoy host-side.
+"""

@@ -1,0 +1,295 @@
+"""HTTP L7 policy: rules → DFA tables → batched device matching.
+
+Reference semantics being reproduced (bit-identically):
+  * pkg/envoy/server.go:316 getHTTPRule — Path/Method/Host become
+    Envoy regex HeaderMatchers, which FULL-match the value; all fields
+    of one PortRuleHTTP must match (AND); a request is allowed if ANY
+    rule of the relevant L7Rules matches (OR) — envoy route semantics
+    in cilium_l7policy.cc (deny → 403).
+  * pkg/policy/l4.go:118 GetRelevantRules — rules apply per remote
+    identity through their selector; an entry with EMPTY L7Rules is an
+    L7 allow-all for the selected identities (wildcardL3L4Rules,
+    repository.go:170).
+  * Header constraints (PortRuleHTTP.Headers) are exact present-match
+    pairs; they stay host-evaluated (like Envoy evaluates them in C++
+    on the host CPU) — rules carrying headers are excluded from the
+    device tables and merged back by `evaluate_with_host_fallback`.
+
+Device layout (R ≤ 32 rules per port filter):
+  method/path/host DFAs — union DFAs with per-rule accept bits;
+  absent_<field> u32     — rules that omit the field (auto-match);
+  ident_rules   u32 [N]  — bit r set ⟺ rule r's selector admits
+                           identity index n (includes allow-all
+                           pseudo-rules, which also have all fields
+                           absent).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.l7.regex_dfa import (
+    DFA,
+    RegexTooComplex,
+    RegexUnsupported,
+    compile_union,
+)
+
+MAX_RULES = 32
+
+
+@dataclass
+class HTTPRuleSpec:
+    """One (selector-scope, PortRuleHTTP) pair, pre-resolved: the
+    identity indices the selector admits over the current universe."""
+
+    identity_indices: Sequence[int]  # indices into the padded universe
+    path: str = ""
+    method: str = ""
+    host: str = ""
+    headers: Tuple[str, ...] = ()
+
+
+@dataclass
+class HTTPTables:
+    """Device tables for one (endpoint, port, direction) HTTP filter."""
+
+    # DFAs (trans u16 [S,C], accept u32 [S], classes u8 [256], start)
+    method_dfa: DFA
+    path_dfa: DFA
+    host_dfa: DFA
+    absent_method: np.ndarray  # u32 scalar bitmask
+    absent_path: np.ndarray
+    absent_host: np.ndarray
+    ident_rules: np.ndarray  # u32 [N] per-identity rule bits
+    n_rules: int
+
+
+@dataclass
+class HTTPPolicy:
+    """Compiled HTTP policy + host-fallback rules."""
+
+    tables: HTTPTables
+    host_rules: List[HTTPRuleSpec]  # header-carrying rules
+
+
+def specs_from_filter(l4_filter, identity_cache, id_index) -> List["HTTPRuleSpec"]:
+    """L4Filter.l7_rules_per_ep (selector → L7Rules, pkg/policy/l4.go:31)
+    → flat HTTPRuleSpec list over the identity universe.
+
+    A selector entry with EMPTY L7Rules becomes an allow-all
+    pseudo-rule (all fields absent ⇒ matches every request) — the
+    L3-override / wildcard entries of createL4IngressFilter
+    (l4.go:209) and wildcardL3L4Rules (repository.go:170).
+    """
+    specs: List[HTTPRuleSpec] = []
+    for selector, l7 in l4_filter.l7_rules_per_ep.items():
+        indices = [
+            id_index[num_id]
+            for num_id, labels in identity_cache.items()
+            if selector.matches(labels) and num_id in id_index
+        ]
+        http_rules = l7.http or []
+        if not http_rules:
+            specs.append(HTTPRuleSpec(identity_indices=indices))
+            continue
+        for rule in http_rules:
+            specs.append(
+                HTTPRuleSpec(
+                    identity_indices=indices,
+                    path=rule.path or "",
+                    method=rule.method or "",
+                    host=rule.host or "",
+                    headers=tuple(rule.headers or ()),
+                )
+            )
+    return specs
+
+
+def compile_http_rules(
+    rules: Sequence[HTTPRuleSpec],
+    n_identities: int,
+    max_states: int = 4096,
+) -> HTTPPolicy:
+    """Split rules into device/host sets and build the union DFAs."""
+    device_rules: List[HTTPRuleSpec] = []
+    host_rules: List[HTTPRuleSpec] = []
+    for rule in rules:
+        if rule.headers:
+            host_rules.append(rule)
+            continue
+        device_rules.append(rule)
+    if len(device_rules) > MAX_RULES:
+        raise RegexTooComplex(
+            f"more than {MAX_RULES} device HTTP rules per filter"
+        )
+
+    def union_for(field: str) -> Tuple[DFA, int]:
+        """DFA over the present patterns; absent bitmask for the rest.
+        Pattern bit positions == rule positions (absent patterns
+        compile as never-matching placeholders via the absent mask)."""
+        patterns = []
+        absent = 0
+        for i, rule in enumerate(device_rules):
+            pattern = getattr(rule, field)
+            if pattern == "":
+                absent |= 1 << i
+                patterns.append("[^\\x00-\\xff]")  # matches nothing
+            else:
+                patterns.append(pattern)
+        try:
+            dfa = compile_union(patterns, max_states=max_states)
+        except (RegexUnsupported, RegexTooComplex):
+            raise
+        return dfa, absent
+
+    method_dfa, absent_method = union_for("method")
+    path_dfa, absent_path = union_for("path")
+    host_dfa, absent_host = union_for("host")
+
+    ident_rules = np.zeros(n_identities, dtype=np.uint32)
+    for i, rule in enumerate(device_rules):
+        for idx in rule.identity_indices:
+            ident_rules[idx] |= np.uint32(1 << i)
+
+    tables = HTTPTables(
+        method_dfa=method_dfa,
+        path_dfa=path_dfa,
+        host_dfa=host_dfa,
+        absent_method=np.uint32(absent_method),
+        absent_path=np.uint32(absent_path),
+        absent_host=np.uint32(absent_host),
+        ident_rules=ident_rules,
+        n_rules=len(device_rules),
+    )
+    return HTTPPolicy(tables=tables, host_rules=host_rules)
+
+
+# ---------------------------------------------------------------------------
+# device kernel
+# ---------------------------------------------------------------------------
+
+
+def _dfa_scan(dfa: DFA, data, lengths):
+    """Step a [B, L] u8 byte tensor through the DFA; returns accept
+    bitmask u32 [B].  One [B]-gather per position via lax.scan — the
+    'dense take_along_axis stepping' of SURVEY §7 step 3."""
+    import jax
+    import jax.numpy as jnp
+
+    trans = jnp.asarray(dfa.trans.astype(np.int32))
+    classes = jnp.asarray(dfa.classes.astype(np.int32))
+    accept = jnp.asarray(dfa.accept)
+    n_classes = trans.shape[1]
+    flat = trans.reshape(-1)
+
+    b, l = data.shape
+    state0 = jnp.full((b,), dfa.start, dtype=jnp.int32)
+
+    def step(state, inputs):
+        byte_col, pos = inputs
+        c = classes[byte_col.astype(jnp.int32)]
+        nxt = flat[state * n_classes + c]
+        state = jnp.where(pos < lengths, nxt, state)
+        return state, None
+
+    cols = jnp.moveaxis(data, 1, 0)  # [L, B]
+    state, _ = jax.lax.scan(
+        step, state0, (cols, jnp.arange(l, dtype=jnp.int32))
+    )
+    return accept[state]
+
+
+def evaluate_http_batch(
+    tables: HTTPTables,
+    method: "np.ndarray",  # u8 [B, Lm]
+    method_len: "np.ndarray",  # i32 [B]
+    path: "np.ndarray",
+    path_len: "np.ndarray",
+    host: "np.ndarray",
+    host_len: "np.ndarray",
+    ident_idx: "np.ndarray",  # i32 [B] identity index (from engine._index)
+    known: "np.ndarray",  # bool [B]
+):
+    """Returns (allowed bool [B], matched_rules u32 [B])."""
+    import jax.numpy as jnp
+
+    acc_m = _dfa_scan(tables.method_dfa, method, method_len)
+    acc_p = _dfa_scan(tables.path_dfa, path, path_len)
+    acc_h = _dfa_scan(tables.host_dfa, host, host_len)
+
+    matched = (
+        (acc_m | jnp.uint32(tables.absent_method))
+        & (acc_p | jnp.uint32(tables.absent_path))
+        & (acc_h | jnp.uint32(tables.absent_host))
+    )
+    ident_bits = jnp.asarray(tables.ident_rules)[
+        jnp.clip(ident_idx, 0, tables.ident_rules.shape[0] - 1)
+    ]
+    matched = matched & ident_bits & jnp.where(
+        known, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+    )
+    return matched != 0, matched
+
+
+# ---------------------------------------------------------------------------
+# host oracle + fallback
+# ---------------------------------------------------------------------------
+
+
+def http_rule_matches_host(
+    rule: HTTPRuleSpec,
+    method: bytes,
+    path: bytes,
+    host: bytes,
+    headers: Optional[Dict[str, str]] = None,
+) -> bool:
+    """Host reference matcher (Python re.fullmatch ≙ Envoy regex
+    HeaderMatcher full-match)."""
+    import re
+
+    if rule.method and not re.fullmatch(
+        rule.method.encode(), method, re.DOTALL
+    ):
+        return False
+    if rule.path and not re.fullmatch(rule.path.encode(), path, re.DOTALL):
+        return False
+    if rule.host and not re.fullmatch(rule.host.encode(), host, re.DOTALL):
+        return False
+    for header in rule.headers:
+        # "Name: value" exact or "Name" presence (server.go:352-366)
+        if ":" in header:
+            name, _, value = header.partition(":")
+            want = value.strip()
+        else:
+            name, want = header, None
+        got = (headers or {}).get(name.strip().lower())
+        if got is None:
+            return False
+        if want is not None and got != want:
+            return False
+    return True
+
+
+def pad_requests(
+    requests: Sequence[Tuple[bytes, bytes, bytes]],
+    lm: int = 16,
+    lp: int = 128,
+    lh: int = 64,
+):
+    """(method, path, host) bytes → padded u8 tensors + lengths."""
+    b = len(requests)
+    method = np.zeros((b, lm), dtype=np.uint8)
+    path = np.zeros((b, lp), dtype=np.uint8)
+    host = np.zeros((b, lh), dtype=np.uint8)
+    lens = np.zeros((3, b), dtype=np.int32)
+    for i, (m, p, h) in enumerate(requests):
+        m, p, h = m[:lm], p[:lp], h[:lh]
+        method[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        path[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        host[i, : len(h)] = np.frombuffer(h, dtype=np.uint8)
+        lens[0, i], lens[1, i], lens[2, i] = len(m), len(p), len(h)
+    return method, lens[0], path, lens[1], host, lens[2]
