@@ -1,0 +1,187 @@
+"""Kafka L7 matcher: device vs host oracle (exact MatchesRule port,
+pkg/kafka/policy.go:200) and role expansion semantics."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.l7.kafka import (
+    CLIENT_CHECKED_KINDS,
+    KafkaRequest,
+    KafkaRuleSpec,
+    TOPIC_API_KEYS,
+    compile_kafka_rules,
+    evaluate_kafka_batch,
+    matches_rules_host,
+    pad_kafka_requests,
+    rule_spec_from_port_rule,
+)
+
+
+def run_device(tables, requests, ident_idx):
+    arrays = pad_kafka_requests(tables, requests)
+    allowed = evaluate_kafka_batch(
+        tables,
+        *arrays,
+        ident_idx=np.asarray(ident_idx, dtype=np.int32),
+        known=np.ones(len(requests), dtype=bool),
+    )
+    return np.asarray(allowed).astype(bool).tolist()
+
+
+def test_topic_all_must_be_allowed():
+    """policy.go:200: every topic of the request must be allowed."""
+    specs = [
+        KafkaRuleSpec(identity_indices=[0], api_keys=(0,), topic="t1"),
+        KafkaRuleSpec(identity_indices=[0], api_keys=(0,), topic="t2"),
+    ]
+    tables = compile_kafka_rules(specs, n_identities=4)
+    reqs = [
+        KafkaRequest(kind=0, version=0, topics=("t1",)),
+        KafkaRequest(kind=0, version=0, topics=("t1", "t2")),
+        KafkaRequest(kind=0, version=0, topics=("t1", "t3")),
+        KafkaRequest(kind=1, version=0, topics=("t1",)),  # wrong key
+    ]
+    assert run_device(tables, reqs, [0, 0, 0, 0]) == [
+        True, True, False, False,
+    ]
+    for request, want in zip(reqs, [True, True, False, False]):
+        assert matches_rules_host(request, specs, 0) == want
+
+
+def test_wildcard_rule_allows_everything():
+    specs = [KafkaRuleSpec(identity_indices=[1])]
+    tables = compile_kafka_rules(specs, n_identities=4)
+    reqs = [
+        KafkaRequest(kind=0, version=3, topics=("x",)),
+        KafkaRequest(kind=18, version=0),
+    ]
+    assert run_device(tables, reqs, [1, 1]) == [True, True]
+    assert run_device(tables, reqs, [0, 0]) == [False, False]
+
+
+def test_version_and_client_checks():
+    specs = [
+        KafkaRuleSpec(
+            identity_indices=[0],
+            api_keys=(0,),
+            api_version=2,
+            client_id="app1",
+        ),
+    ]
+    tables = compile_kafka_rules(specs, n_identities=2)
+    reqs = [
+        KafkaRequest(kind=0, version=2, client_id="app1", topics=("t",)),
+        KafkaRequest(kind=0, version=3, client_id="app1", topics=("t",)),
+        KafkaRequest(kind=0, version=2, client_id="app2", topics=("t",)),
+        # ConsumerMetadata (10) carries no checked ClientID: the rule's
+        # client constraint is ignored for it (policy.go:183 default)
+        KafkaRequest(kind=10, version=2, client_id="zzz"),
+    ]
+    want = [True, False, False, False]
+    # kind 10 not in api_keys(0,) → False anyway; use wildcard keys:
+    specs2 = [
+        KafkaRuleSpec(
+            identity_indices=[0], api_version=2, client_id="app1"
+        ),
+    ]
+    tables2 = compile_kafka_rules(specs2, n_identities=2)
+    got = run_device(tables, reqs, [0, 0, 0, 0])
+    assert got == want
+    for request, w in zip(reqs, want):
+        assert matches_rules_host(request, specs, 0) == w
+    # client ignored for kind 10 (not in CLIENT_CHECKED_KINDS)
+    assert run_device(tables2, [reqs[3]], [0]) == [True]
+    assert matches_rules_host(reqs[3], specs2, 0)
+
+
+def test_unparsed_request_semantics():
+    """matchNonTopicRequests: topic rules can't match unparsed
+    topic-kind requests; client is NOT checked (GH-3097)."""
+    specs = [
+        KafkaRuleSpec(identity_indices=[0], topic="t1"),
+        KafkaRuleSpec(identity_indices=[1], client_id="c1"),
+    ]
+    tables = compile_kafka_rules(specs, n_identities=4)
+    unparsed_topic_kind = KafkaRequest(
+        kind=0, version=0, parsed=False, topics=()
+    )
+    unparsed_heartbeat = KafkaRequest(
+        kind=12, version=0, parsed=False, topics=()
+    )
+    # identity 0 (topic rule): produce-kind can't match, heartbeat can
+    assert run_device(
+        tables, [unparsed_topic_kind, unparsed_heartbeat], [0, 0]
+    ) == [False, True]
+    # identity 1 (client rule): client not checked when unparsed
+    assert run_device(
+        tables, [unparsed_topic_kind, unparsed_heartbeat], [1, 1]
+    ) == [True, True]
+    for request, idx, want in [
+        (unparsed_topic_kind, 0, False),
+        (unparsed_heartbeat, 0, True),
+        (unparsed_topic_kind, 1, True),
+        (unparsed_heartbeat, 1, True),
+    ]:
+        assert matches_rules_host(request, specs, idx) == want
+
+
+def test_role_expansion_via_port_rule():
+    from cilium_tpu.policy.api.rule import PortRuleKafka
+
+    produce = PortRuleKafka(role="produce", topic="logs")
+    produce.sanitize()
+    spec = rule_spec_from_port_rule(produce, [0])
+    assert set(spec.api_keys) == {0, 3, 18}  # produce, metadata, apiversions
+
+    consume = PortRuleKafka(role="consume")
+    consume.sanitize()
+    spec2 = rule_spec_from_port_rule(consume, [0])
+    assert 1 in spec2.api_keys and 9 in spec2.api_keys
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_kafka_fuzz_device_vs_host(seed):
+    rng = np.random.default_rng(seed)
+    topics_pool = ["t1", "t2", "t3", "t4"]
+    clients_pool = ["c1", "c2", ""]
+    kinds_pool = [0, 1, 3, 9, 10, 12, 18, 19]
+
+    specs = []
+    for _ in range(8):
+        specs.append(
+            KafkaRuleSpec(
+                identity_indices=list(
+                    rng.choice(4, size=int(rng.integers(1, 3)), replace=False)
+                ),
+                api_keys=tuple(
+                    rng.choice(kinds_pool, size=int(rng.integers(0, 3)), replace=False)
+                ),
+                api_version=(
+                    int(rng.integers(0, 3)) if rng.random() < 0.3 else None
+                ),
+                client_id=str(rng.choice(clients_pool)),
+                topic=str(rng.choice(topics_pool + [""])),
+            )
+        )
+    tables = compile_kafka_rules(specs, n_identities=4)
+
+    requests, idents = [], []
+    for _ in range(256):
+        n_topics = int(rng.integers(0, 4))
+        requests.append(
+            KafkaRequest(
+                kind=int(rng.choice(kinds_pool)),
+                version=int(rng.integers(0, 3)),
+                client_id=str(rng.choice(["c1", "c2", "cX"])),
+                topics=tuple(
+                    rng.choice(topics_pool + ["tX"], size=n_topics, replace=False)
+                ),
+                parsed=bool(rng.random() < 0.9),
+            )
+        )
+        idents.append(int(rng.integers(0, 4)))
+
+    got = run_device(tables, requests, idents)
+    for i, (request, idx) in enumerate(zip(requests, idents)):
+        want = matches_rules_host(request, specs, idx)
+        assert got[i] == want, (i, request, idx)
