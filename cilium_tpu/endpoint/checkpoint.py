@@ -1,0 +1,147 @@
+"""Endpoint checkpoint / restore.
+
+Re-design of the reference's state-dir persistence: per-endpoint JSON
+(the C header file becomes the serialized realized map state — config
+IS data here, not generated code) written via the current→next→failed
+directory shuffle of pkg/endpoint/policy.go:738-775, and boot-time
+restore (daemon/state.go restoreOldEndpoints: re-allocate identities
+from labels, mark restoring, regenerate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import List, Optional
+
+from cilium_tpu.endpoint.endpoint import (
+    STATE_RESTORING,
+    STATE_WAITING_TO_REGENERATE,
+    Endpoint,
+)
+from cilium_tpu.identity import IdentityAllocator
+from cilium_tpu.labels import Label, Labels
+from cilium_tpu.maps.policymap import (
+    PolicyKey,
+    PolicyMapState,
+    PolicyMapStateEntry,
+)
+
+STATE_FILE = "ep_state.json"
+
+
+def _map_state_to_json(state: PolicyMapState) -> list:
+    return [
+        {
+            "identity": k.identity,
+            "dest_port": k.dest_port,
+            "nexthdr": k.nexthdr,
+            "dir": k.traffic_direction,
+            "proxy_port": v.proxy_port,
+            "packets": v.packets,
+            "bytes": v.bytes,
+        }
+        for k, v in state.items()
+    ]
+
+
+def _map_state_from_json(items: list) -> PolicyMapState:
+    return {
+        PolicyKey(
+            item["identity"], item["dest_port"], item["nexthdr"], item["dir"]
+        ): PolicyMapStateEntry(
+            proxy_port=item["proxy_port"],
+            packets=item.get("packets", 0),
+            bytes=item.get("bytes", 0),
+        )
+        for item in items
+    }
+
+
+def save_endpoint(endpoint: Endpoint, state_dir: str) -> str:
+    """Write <state_dir>/<ep id>/ep_state.json atomically (write to a
+    temp file, rename — the reference's directory-shuffle transaction
+    reduced to a file swap)."""
+    ep_dir = os.path.join(state_dir, str(endpoint.id))
+    os.makedirs(ep_dir, exist_ok=True)
+    doc = {
+        "id": endpoint.id,
+        "name": endpoint.name,
+        "ipv4": endpoint.ipv4,
+        "labels": (
+            [
+                {"key": l.key, "value": l.value, "source": l.source}
+                for l in endpoint.security_identity.labels.values()
+            ]
+            if endpoint.security_identity
+            else []
+        ),
+        "policy_revision": endpoint.policy_revision,
+        "realized_map_state": _map_state_to_json(
+            endpoint.realized_map_state
+        ),
+        "realized_redirects": endpoint.realized_redirects,
+    }
+    fd, tmp = tempfile.mkstemp(dir=ep_dir, prefix=".tmp_state")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(ep_dir, STATE_FILE))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return os.path.join(ep_dir, STATE_FILE)
+
+
+def restore_endpoints(
+    state_dir: str, allocator: Optional[IdentityAllocator] = None
+) -> List[Endpoint]:
+    """restoreOldEndpoints (daemon/state.go): parse the state dir,
+    re-allocate identities from the checkpointed labels (ids may
+    change across restarts — the labels are the durable key), mark
+    restoring → waiting-to-regenerate.  Unparseable directories are
+    skipped, as the reference skips and logs."""
+    endpoints: List[Endpoint] = []
+    if not os.path.isdir(state_dir):
+        return endpoints
+    for entry in sorted(os.listdir(state_dir)):
+        path = os.path.join(state_dir, entry, STATE_FILE)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            endpoint = Endpoint(
+                endpoint_id=int(doc["id"]),
+                ipv4=doc.get("ipv4"),
+                name=doc.get("name", ""),
+            )
+            endpoint.set_state(STATE_RESTORING, "restoring")
+            endpoint.policy_revision = doc.get("policy_revision", 0)
+            endpoint.realized_map_state = _map_state_from_json(
+                doc.get("realized_map_state", [])
+            )
+            endpoint.realized_redirects = dict(
+                doc.get("realized_redirects", {})
+            )
+            if allocator is not None and doc.get("labels"):
+                labels = Labels(
+                    {
+                        item["key"]: Label(
+                            key=item["key"],
+                            value=item.get("value", ""),
+                            source=item.get("source", "unspec"),
+                        )
+                        for item in doc["labels"]
+                    }
+                )
+                ident, _ = allocator.allocate(labels)
+                endpoint.set_identity(ident)
+            endpoint.set_state(
+                STATE_WAITING_TO_REGENERATE, "restored"
+            )
+            endpoints.append(endpoint)
+        except (ValueError, KeyError, json.JSONDecodeError):
+            continue
+    return endpoints

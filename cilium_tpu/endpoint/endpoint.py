@@ -1,0 +1,255 @@
+"""The Endpoint object and its policy regeneration pipeline.
+
+Re-design of /root/reference/pkg/endpoint/{endpoint.go,policy.go}:
+  - state machine (endpoint.go:227-258, SetStateLocked endpoint.go:1983
+    transition matrix reproduced verbatim);
+  - regeneratePolicy (policy.go:506): identity snapshot, revision-gated
+    skip, ComputePolicyEnforcement (policy.go:643), resolveL4Policy,
+    ResolveCIDRPolicy, computeDesiredPolicyMapState;
+  - syncPolicyMap (endpoint.go:2572): desired→realized diffing, with
+    per-entry counters preserved across updates.
+
+What the reference realizes into a per-endpoint BPF map + compiled C
+program, we realize into the endpoint's `realized_map_state`; the
+EndpointManager lowers all realized states into one stacked
+PolicyTables (manager.py) — the datapath "reload".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from cilium_tpu import option
+from cilium_tpu.compiler.mapstate import (
+    compute_desired_policy_map_state,
+    resolve_l4_policy,
+)
+from cilium_tpu.identity import Identity, IdentityCache
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.maps.policymap import (
+    PolicyMapState,
+    PolicyMapStateEntry,
+    diff_map_state,
+)
+from cilium_tpu.policy.l3 import CIDRPolicy
+from cilium_tpu.policy.l4 import L4Policy
+from cilium_tpu.policy.search import SearchContext
+
+# endpoint.go:227-258
+STATE_CREATING = "creating"
+STATE_WAITING_FOR_IDENTITY = "waiting-for-identity"
+STATE_READY = "ready"
+STATE_WAITING_TO_REGENERATE = "waiting-to-regenerate"
+STATE_REGENERATING = "regenerating"
+STATE_DISCONNECTING = "disconnecting"
+STATE_DISCONNECTED = "disconnected"
+STATE_RESTORING = "restoring"
+
+# SetStateLocked transition matrix (endpoint.go:1983-2037).
+_TRANSITIONS = {
+    "": {STATE_WAITING_FOR_IDENTITY, STATE_RESTORING},
+    STATE_CREATING: {
+        STATE_DISCONNECTING,
+        STATE_WAITING_FOR_IDENTITY,
+        STATE_RESTORING,
+    },
+    STATE_WAITING_FOR_IDENTITY: {STATE_READY, STATE_DISCONNECTING},
+    STATE_READY: {
+        STATE_WAITING_FOR_IDENTITY,
+        STATE_DISCONNECTING,
+        STATE_WAITING_TO_REGENERATE,
+        STATE_RESTORING,
+    },
+    STATE_DISCONNECTING: {STATE_DISCONNECTED},
+    STATE_DISCONNECTED: set(),
+    STATE_WAITING_TO_REGENERATE: {
+        STATE_WAITING_FOR_IDENTITY,
+        STATE_DISCONNECTING,
+        STATE_RESTORING,
+    },
+    STATE_REGENERATING: {
+        STATE_WAITING_FOR_IDENTITY,
+        STATE_DISCONNECTING,
+        STATE_WAITING_TO_REGENERATE,
+        STATE_RESTORING,
+    },
+    STATE_RESTORING: {
+        STATE_DISCONNECTING,
+        STATE_WAITING_TO_REGENERATE,
+        STATE_RESTORING,
+    },
+}
+
+# BuilderSetStateLocked (endpoint.go:2077): only the builder moves an
+# endpoint into/out of regenerating.
+_BUILDER_TRANSITIONS = {
+    STATE_WAITING_TO_REGENERATE: {STATE_REGENERATING},
+    STATE_REGENERATING: {STATE_READY, STATE_WAITING_TO_REGENERATE},
+}
+
+
+class Endpoint:
+    """pkg/endpoint.Endpoint, reduced to the policy-relevant core."""
+
+    def __init__(
+        self,
+        endpoint_id: int,
+        ipv4: Optional[str] = None,
+        name: str = "",
+    ) -> None:
+        self.id = endpoint_id
+        self.ipv4 = ipv4
+        self.name = name
+        self.state = ""
+        self.security_identity: Optional[Identity] = None
+
+        # policy computation state (endpoint.go:265 + policy.go:506)
+        self.policy_revision = 0
+        self.next_policy_revision = 0
+        self.prev_identity_cache: Optional[IdentityCache] = None
+        self.force_policy_compute = False
+        self.ingress_policy_enabled = False
+        self.egress_policy_enabled = False
+        self.desired_l4_policy: Optional[L4Policy] = None
+        self.l3_policy: Optional[CIDRPolicy] = None
+        self.desired_map_state: PolicyMapState = {}
+        self.realized_map_state: PolicyMapState = {}
+        self.realized_redirects: Dict[str, int] = {}
+
+        self.lock = threading.RLock()
+        self.build_lock = threading.Lock()
+
+    # -- state machine -------------------------------------------------------
+
+    def set_state(self, to_state: str, reason: str = "") -> bool:
+        """SetStateLocked (endpoint.go:1983): invalid transitions are
+        skipped, not raised."""
+        with self.lock:
+            if to_state in _TRANSITIONS.get(self.state, set()):
+                self.state = to_state
+                return True
+            return False
+
+    def builder_set_state(self, to_state: str, reason: str = "") -> bool:
+        """BuilderSetStateLocked (endpoint.go:2077)."""
+        with self.lock:
+            if to_state in _BUILDER_TRANSITIONS.get(self.state, set()):
+                self.state = to_state
+                return True
+            return False
+
+    # -- identity ------------------------------------------------------------
+
+    def set_identity(self, identity: Identity) -> None:
+        with self.lock:
+            self.security_identity = identity
+
+    def is_init(self) -> bool:
+        """IsInit (reserved:init label present, policy.go:655)."""
+        if self.security_identity is None:
+            return False
+        return any(
+            l.source == "reserved" and l.key == "init"
+            for l in self.security_identity.label_array
+        )
+
+    # -- policy computation (policy.go:506 regeneratePolicy) ----------------
+
+    def compute_policy_enforcement(self, repo) -> Tuple[bool, bool]:
+        """ComputePolicyEnforcement (policy.go:643)."""
+        mode = option.Config.policy_enforcement
+        if mode == option.ALWAYS_ENFORCE:
+            return True, True
+        if mode == option.DEFAULT_ENFORCEMENT:
+            if self.is_init():
+                return True, True
+            return repo.get_rules_matching(
+                self.security_identity.label_array
+            )
+        return False, False
+
+    def regenerate_policy(self, repo, identity_cache: IdentityCache) -> bool:
+        """regeneratePolicy (policy.go:506).  Returns whether the
+        desired state may have changed (False = revision-gated skip)."""
+        if self.security_identity is None:
+            return False
+
+        # Use the previous snapshot object when contents are equal
+        # (policy.go:530-533) so the skip below can compare by "is".
+        if (
+            self.prev_identity_cache is not None
+            and self.prev_identity_cache == identity_cache
+        ):
+            identity_cache = self.prev_identity_cache
+
+        revision = repo.get_revision()
+        if (
+            not self.force_policy_compute
+            and self.next_policy_revision >= revision
+            and identity_cache is self.prev_identity_cache
+        ):
+            return False
+
+        self.prev_identity_cache = identity_cache
+        (
+            self.ingress_policy_enabled,
+            self.egress_policy_enabled,
+        ) = self.compute_policy_enforcement(repo)
+
+        ep_labels = self.security_identity.label_array
+        self.desired_l4_policy = resolve_l4_policy(
+            repo,
+            ep_labels,
+            self.ingress_policy_enabled,
+            self.egress_policy_enabled,
+        )
+
+        # regenerateL3Policy (policy.go:392)
+        new_l3 = repo.resolve_cidr_policy(
+            SearchContext(to_labels=ep_labels)
+        )
+        new_l3.validate()
+        self.l3_policy = new_l3
+
+        self.desired_map_state = compute_desired_policy_map_state(
+            repo,
+            identity_cache,
+            ep_labels,
+            endpoint_id=self.id,
+            ingress_enabled=self.ingress_policy_enabled,
+            egress_enabled=self.egress_policy_enabled,
+            realized_redirects=self.realized_redirects,
+            l4_policy=self.desired_l4_policy,
+        )
+
+        self.force_policy_compute = False
+        self.next_policy_revision = revision
+        return True
+
+    # -- realization (endpoint.go:2572 syncPolicyMap) ------------------------
+
+    def sync_policy_map(self) -> Tuple[int, int]:
+        """Apply desired→realized delta; preserves counters of entries
+        that stay.  Returns (n_added_or_updated, n_deleted)."""
+        with self.lock:
+            to_add, to_delete = diff_map_state(
+                self.realized_map_state, self.desired_map_state
+            )
+            for key in to_delete:
+                del self.realized_map_state[key]
+            for key in to_add:
+                old = self.realized_map_state.get(key)
+                entry = PolicyMapStateEntry(
+                    proxy_port=self.desired_map_state[key].proxy_port,
+                    packets=old.packets if old else 0,
+                    bytes=old.bytes if old else 0,
+                )
+                self.realized_map_state[key] = entry
+            return len(to_add), len(to_delete)
+
+    def bump_policy_revision(self) -> None:
+        """policy.go:790-804: realized revision catches up after a
+        successful regeneration."""
+        with self.lock:
+            self.policy_revision = self.next_policy_revision

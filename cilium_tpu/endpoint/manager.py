@@ -1,0 +1,151 @@
+"""Endpoint registry, parallel build queue, fleet table compilation.
+
+Re-design of /root/reference/pkg/endpointmanager/manager.go (registry,
+RegenerateAllEndpoints manager.go:271) and the daemon's builder pool
+(daemon/daemon.go:209 QueueEndpointBuild, daemon.go:235
+StartEndpointBuilders: builds serialize per endpoint via the build
+lock, N run in parallel fleet-wide).
+
+The TPU twist: realization is fleet-wide — after endpoints sync their
+realized map states, `compile_fleet` lowers ALL of them into one
+stacked PolicyTables (the endpoint axis replaces per-endpoint BPF
+programs + the tail-call PROG_ARRAY) and publishes it with a
+double-buffered version flip, the device analog of the realized/
+backup/pending map shuffle in pkg/datapath/ipcache/listener.go:167.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor, wait
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cilium_tpu.compiler.tables import PolicyTables, compile_map_states
+from cilium_tpu.endpoint.endpoint import (
+    STATE_READY,
+    STATE_REGENERATING,
+    STATE_WAITING_TO_REGENERATE,
+    Endpoint,
+)
+from cilium_tpu.identity import IdentityCache
+
+
+class EndpointManager:
+    """pkg/endpointmanager: lookup by id / name / IP + regeneration."""
+
+    def __init__(self, num_workers: int = 4) -> None:
+        self._lock = threading.RLock()
+        self.by_id: Dict[int, Endpoint] = {}
+        self.by_ip: Dict[str, Endpoint] = {}
+        self.by_name: Dict[str, Endpoint] = {}
+        self._pool = ThreadPoolExecutor(max_workers=max(num_workers, 1))
+        # published tables: (version, tables, ep_id → endpoint axis idx)
+        self._published: Tuple[int, Optional[PolicyTables], Dict[int, int]] = (
+            0,
+            None,
+            {},
+        )
+
+    # -- registry (manager.go Insert/Lookup*) --------------------------------
+
+    def insert(self, endpoint: Endpoint) -> None:
+        with self._lock:
+            self.by_id[endpoint.id] = endpoint
+            if endpoint.ipv4:
+                self.by_ip[endpoint.ipv4] = endpoint
+            if endpoint.name:
+                self.by_name[endpoint.name] = endpoint
+
+    def remove(self, endpoint: Endpoint) -> None:
+        with self._lock:
+            self.by_id.pop(endpoint.id, None)
+            if endpoint.ipv4:
+                self.by_ip.pop(endpoint.ipv4, None)
+            if endpoint.name:
+                self.by_name.pop(endpoint.name, None)
+
+    def lookup(self, endpoint_id: int) -> Optional[Endpoint]:
+        with self._lock:
+            return self.by_id.get(endpoint_id)
+
+    def endpoints(self) -> List[Endpoint]:
+        with self._lock:
+            return list(self.by_id.values())
+
+    # -- regeneration (manager.go:271 RegenerateAllEndpoints) ---------------
+
+    def regenerate_endpoint(
+        self, endpoint: Endpoint, repo, identity_cache: IdentityCache
+    ) -> bool:
+        """One build: the regenerate→regenerateBPF tail of §3.2 (CT
+        scrub and proxy ACKs are owned by their subsystems; here:
+        policy calc + map sync + revision bump).  Serialized per
+        endpoint via build_lock (QueueEndpointBuild daemon.go:209)."""
+        with endpoint.build_lock:
+            if not endpoint.builder_set_state(
+                STATE_REGENERATING, "regenerating"
+            ):
+                # not queued for regeneration (e.g. disconnecting)
+                return False
+            try:
+                endpoint.regenerate_policy(repo, identity_cache)
+                endpoint.sync_policy_map()
+                endpoint.bump_policy_revision()
+                endpoint.builder_set_state(STATE_READY, "regenerated")
+                return True
+            except Exception:
+                # failed builds fall back to waiting-to-regenerate
+                # (policy.go:770-775 keeps old state, retries later)
+                endpoint.builder_set_state(
+                    STATE_WAITING_TO_REGENERATE, "regeneration failed"
+                )
+                raise
+
+    def regenerate_all(
+        self, repo, identity_cache: IdentityCache, reason: str = ""
+    ) -> int:
+        """RegenerateAllEndpoints: mark + rebuild every endpoint (N
+        builders in parallel), then publish fresh fleet tables."""
+        eps = self.endpoints()
+        for endpoint in eps:
+            endpoint.set_state(STATE_WAITING_TO_REGENERATE, reason)
+        futures = [
+            self._pool.submit(
+                self.regenerate_endpoint, endpoint, repo, identity_cache
+            )
+            for endpoint in eps
+        ]
+        wait(futures)
+        n = sum(1 for f in futures if not f.exception() and f.result())
+        self.publish_tables(identity_cache)
+        return n
+
+    # -- fleet realization ---------------------------------------------------
+
+    def compile_fleet(
+        self, identity_cache: IdentityCache
+    ) -> Tuple[PolicyTables, Dict[int, int]]:
+        """Lower every endpoint's REALIZED map state into one stacked
+        PolicyTables; returns (tables, ep_id → endpoint-axis index)."""
+        eps = sorted(self.endpoints(), key=lambda e: e.id)
+        states = [e.realized_map_state for e in eps]
+        index = {e.id: i for i, e in enumerate(eps)}
+        if not states:
+            states = [{}]
+        tables = compile_map_states(states, list(identity_cache))
+        return tables, index
+
+    def publish_tables(self, identity_cache: IdentityCache) -> int:
+        """Double-buffered flip: compile the new version, then swap the
+        published pointer atomically (consumers holding the old tables
+        keep a consistent snapshot — the ACK-gated versioned flip of
+        SURVEY §5)."""
+        tables, index = self.compile_fleet(identity_cache)
+        with self._lock:
+            version = self._published[0] + 1
+            self._published = (version, tables, index)
+            return version
+
+    def published(self) -> Tuple[int, Optional[PolicyTables], Dict[int, int]]:
+        with self._lock:
+            return self._published

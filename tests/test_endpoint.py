@@ -1,0 +1,215 @@
+"""Endpoint lifecycle: state machine, regeneration pipeline,
+desired/realized sync, fleet compile, checkpoint/restore.
+
+Mirrors the DryMode daemon tests (reference daemon/policy_test.go:471):
+policy add → regenerate → exact map state, without a datapath.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu import option
+from cilium_tpu.endpoint import (
+    STATE_DISCONNECTED,
+    STATE_DISCONNECTING,
+    STATE_READY,
+    STATE_REGENERATING,
+    STATE_RESTORING,
+    STATE_WAITING_FOR_IDENTITY,
+    STATE_WAITING_TO_REGENERATE,
+    Endpoint,
+    EndpointManager,
+)
+from cilium_tpu.endpoint.checkpoint import restore_endpoints, save_endpoint
+from cilium_tpu.identity import IdentityAllocator
+from cilium_tpu.labels import Label, LabelArray, Labels, parse_select_label
+from cilium_tpu.maps.policymap import EGRESS, INGRESS, PolicyKey
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+from cilium_tpu.policy.repository import Repository
+
+
+def es(label):
+    return EndpointSelector.from_labels(parse_select_label(label))
+
+
+def make_identity(alloc, *label_strs):
+    labels = Labels(
+        {
+            l.key: l
+            for l in (parse_select_label(s) for s in label_strs)
+        }
+    )
+    # parse_select_label yields source "any" for bare k=v; use unspec
+    labels = Labels(
+        {
+            l.key: Label(key=l.key, value=l.value, source="unspec")
+            for l in labels.values()
+        }
+    )
+    ident, _ = alloc.allocate(labels)
+    return ident
+
+
+def test_state_machine_matrix():
+    e = Endpoint(1)
+    assert e.state == ""
+    assert e.set_state(STATE_READY) is False  # not a valid initial move
+    assert e.set_state(STATE_WAITING_FOR_IDENTITY)
+    assert e.set_state(STATE_READY)
+    assert e.set_state(STATE_WAITING_TO_REGENERATE)
+    # only the builder moves into regenerating
+    assert e.set_state(STATE_REGENERATING) is False
+    assert e.builder_set_state(STATE_REGENERATING)
+    assert e.builder_set_state(STATE_READY)
+    assert e.set_state(STATE_DISCONNECTING)
+    assert e.set_state(STATE_DISCONNECTED)
+    # terminal
+    assert e.set_state(STATE_READY) is False
+
+
+def build_world():
+    alloc = IdentityAllocator()
+    repo = Repository()
+    id_client = make_identity(alloc, "app=client")
+    id_server = make_identity(alloc, "app=server")
+    id_other = make_identity(alloc, "app=other")
+    repo.add(
+        Rule(
+            endpoint_selector=es("app=server"),
+            ingress=[
+                IngressRule(
+                    from_endpoints=[es("app=client")],
+                    to_ports=[
+                        PortRule(
+                            ports=[PortProtocol(port="80", protocol="TCP")]
+                        )
+                    ],
+                ),
+            ],
+        )
+    )
+    repo.bump_revision()
+    return alloc, repo, id_client, id_server, id_other
+
+
+def test_regeneration_pipeline():
+    alloc, repo, id_client, id_server, _ = build_world()
+    e = Endpoint(42, ipv4="10.0.0.42", name="server-1")
+    e.set_state(STATE_WAITING_FOR_IDENTITY)
+    e.set_identity(id_server)
+    e.set_state(STATE_READY)
+    e.set_state(STATE_WAITING_TO_REGENERATE)
+
+    mgr = EndpointManager(num_workers=2)
+    mgr.insert(e)
+    cache = alloc.identity_cache()
+    assert mgr.regenerate_endpoint(e, repo, cache)
+    assert e.state == STATE_READY
+    assert PolicyKey(id_client.id, 80, 6, INGRESS) in e.realized_map_state
+    # enforcement: rules select server on ingress only → egress open →
+    # all identities allowed on egress
+    assert e.ingress_policy_enabled and not e.egress_policy_enabled
+    assert PolicyKey(id_client.id, 0, 0, EGRESS) in e.realized_map_state
+
+    # revision-gated skip: same revision + same identity cache → no-op
+    assert e.regenerate_policy(repo, alloc.identity_cache()) is False
+    # new revision → recompute
+    repo.bump_revision()
+    assert e.regenerate_policy(repo, alloc.identity_cache()) is True
+
+
+def test_sync_preserves_counters():
+    alloc, repo, id_client, id_server, _ = build_world()
+    e = Endpoint(1)
+    e.set_identity(id_server)
+    cache = alloc.identity_cache()
+    e.regenerate_policy(repo, cache)
+    e.sync_policy_map()
+    key = PolicyKey(id_client.id, 80, 6, INGRESS)
+    e.realized_map_state[key].packets = 99
+
+    repo.bump_revision()
+    e.force_policy_compute = True
+    e.regenerate_policy(repo, cache)
+    added, deleted = e.sync_policy_map()
+    assert e.realized_map_state[key].packets == 99  # counters survive
+
+
+def test_regenerate_all_and_fleet_tables():
+    alloc, repo, id_client, id_server, id_other = build_world()
+    mgr = EndpointManager(num_workers=4)
+    eps = []
+    for i in range(5):
+        e = Endpoint(100 + i, ipv4=f"10.0.0.{i}")
+        e.set_state(STATE_WAITING_FOR_IDENTITY)
+        e.set_identity(id_server if i % 2 == 0 else id_other)
+        e.set_state(STATE_READY)
+        mgr.insert(e)
+        eps.append(e)
+
+    n = mgr.regenerate_all(repo, alloc.identity_cache(), "policy import")
+    assert n == 5
+    version, tables, index = mgr.published()
+    assert version == 1 and tables is not None
+    assert len(index) == 5
+
+    # evaluate: client → server-endpoints on 80/tcp allowed
+    from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+
+    b = TupleBatch.from_numpy(
+        ep_index=[index[100], index[101]],
+        identity=[id_client.id, id_client.id],
+        dport=[80, 80],
+        proto=[6, 6],
+        direction=[INGRESS, INGRESS],
+    )
+    got = evaluate_batch(tables, b)
+    # ep 100 = server (rule applies), ep 101 = other (no rules select
+    # it → enforcement off → L3 allow-all entries)
+    assert np.asarray(got.allowed).tolist() == [1, 1]
+
+    # now always-enforce: ep 101 has no allowing rules → drop
+    option.Config.policy_enforcement = option.ALWAYS_ENFORCE
+    repo.bump_revision()
+    mgr.regenerate_all(repo, alloc.identity_cache(), "config change")
+    _, tables2, index2 = mgr.published()
+    got2 = evaluate_batch(tables2, b)
+    assert np.asarray(got2.allowed).tolist() == [1, 0]
+
+
+def test_checkpoint_restore_roundtrip(tmp_path):
+    alloc, repo, id_client, id_server, _ = build_world()
+    e = Endpoint(7, ipv4="10.0.0.7", name="svc")
+    e.set_state(STATE_WAITING_FOR_IDENTITY)
+    e.set_identity(id_server)
+    e.set_state(STATE_READY)
+    e.regenerate_policy(repo, alloc.identity_cache())
+    e.sync_policy_map()
+    e.bump_policy_revision()
+    save_endpoint(e, str(tmp_path))
+
+    # fresh world: new allocator (ids re-allocated from labels)
+    alloc2 = IdentityAllocator()
+    restored = restore_endpoints(str(tmp_path), alloc2)
+    assert len(restored) == 1
+    r = restored[0]
+    assert r.id == 7 and r.ipv4 == "10.0.0.7" and r.name == "svc"
+    assert r.state == STATE_WAITING_TO_REGENERATE
+    assert r.security_identity is not None
+    assert (
+        r.security_identity.labels.sorted_list()
+        == id_server.labels.sorted_list()
+    )
+    # realized state survived (counters included)
+    assert r.realized_map_state == e.realized_map_state
+
+    # corrupted dir entries are skipped
+    (tmp_path / "999").mkdir()
+    (tmp_path / "999" / "ep_state.json").write_text("{broken")
+    assert len(restore_endpoints(str(tmp_path), alloc2)) == 1
