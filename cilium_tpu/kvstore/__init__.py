@@ -1,0 +1,40 @@
+"""Distributed state: watchable kvstore, cluster-wide ID allocator,
+ipcache sync, clustermesh.
+
+Re-design of /root/reference/pkg/kvstore (+allocator/, store/),
+pkg/clustermesh and the kvstore halves of pkg/identity / pkg/ipcache.
+The reference's inter-node "communication backend" is etcd/consul
+watch — no NCCL/MPI (SURVEY §2.7).  Here the same versioned-watch
+semantics run over an in-process backend (`KVStore`) that mirrors
+BackendOperations (backend.go:92); an etcd adapter can implement the
+same five primitives when real multi-host deployment needs it.  Device
+table replication across hosts rides this control plane (tables are
+recompiled per host from watched state), while batch evaluation within
+a pod slice uses XLA collectives (engine.sharded).
+"""
+
+from cilium_tpu.kvstore.store import KVStore, KVEvent
+from cilium_tpu.kvstore.allocator import Allocator
+from cilium_tpu.kvstore.ipsync import (
+    IPIdentityWatcher,
+    delete_ip_mapping,
+    upsert_ip_mapping,
+)
+from cilium_tpu.kvstore.clustermesh import ClusterMesh, RemoteCluster
+
+__all__ = [
+    "KVStore",
+    "KVEvent",
+    "Allocator",
+    "IPIdentityWatcher",
+    "upsert_ip_mapping",
+    "delete_ip_mapping",
+    "ClusterMesh",
+    "RemoteCluster",
+]
+
+# kvstore key layout (pkg/kvstore/kvstore.go BaseKeyPrefix + consumers)
+BASE_KEY_PREFIX = "cilium"
+IDENTITIES_PATH = "cilium/state/identities/v1"
+IP_IDENTITIES_PATH = "cilium/state/ip/v1"
+NODES_PATH = "cilium/state/nodes/v1"

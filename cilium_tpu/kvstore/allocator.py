@@ -1,0 +1,178 @@
+"""Cluster-wide ID allocator over the kvstore.
+
+Behavioral port of /root/reference/pkg/kvstore/allocator/allocator.go:
+  key layout:
+    <prefix>/id/<id>            master key: id → key string (CAS)
+    <prefix>/value/<key>/<node> slave key: refcount + lease holder
+  protocol (lockedAllocate, allocator.go:423):
+    1. GetPrefix(/value/<key>/) — an existing master mapping wins;
+       create our slave key and reuse the id.
+    2. Else pick a free id from the local pool, lock the key path,
+       CAS-create the master key; on CAS failure (another node won)
+       retry; then create the slave key.
+  release (allocator.go Release): refcounted locally; the last local
+  ref deletes the slave key.  Master keys are garbage collected when
+  no slave keys remain (RunGC in the reference; `gc()` here).
+
+The same numeric id is therefore agreed upon by every node for the
+same label-set key — the consensus that makes identities meaningful
+cluster-wide.  Events from watching <prefix>/id/ feed remote caches
+(cache.go) and clustermesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cilium_tpu.kvstore.store import KVEvent, KVStore
+
+
+class Allocator:
+    def __init__(
+        self,
+        store: KVStore,
+        prefix: str,
+        node: str,
+        id_min: int = 256,
+        id_max: int = (1 << 24) - 1,
+        cluster_id: int = 0,
+    ) -> None:
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+        self.node = node
+        self.id_min = id_min
+        self.id_max = id_max
+        # ClusterID partitioning (numericidentity.go:162): ids carry
+        # the cluster id in bits 16-23.
+        self.cluster_id = cluster_id
+        self._lock = threading.RLock()
+        # local refcounts per key (localKeys, allocator.go)
+        self._refs: Dict[str, int] = {}
+        self._ids: Dict[str, int] = {}
+        self._next_probe = id_min
+
+    # -- paths ---------------------------------------------------------------
+
+    def _id_path(self, num_id: int) -> str:
+        return f"{self.prefix}/id/{num_id}"
+
+    def _value_prefix(self, key: str) -> str:
+        return f"{self.prefix}/value/{key}/"
+
+    def _slave_path(self, key: str) -> str:
+        return f"{self.prefix}/value/{key}/{self.node}"
+
+    def _mask_id(self, num_id: int) -> int:
+        return num_id | (self.cluster_id << 16)
+
+    # -- protocol ------------------------------------------------------------
+
+    def get(self, key: str) -> int:
+        """Existing cluster-wide id for key, or 0."""
+        got = self.store.get_prefix(self._value_prefix(key))
+        return int(got[1]) if got else 0
+
+    def _select_available_id(self) -> int:
+        for _ in range(self.id_max - self.id_min + 1):
+            candidate = self._mask_id(self._next_probe)
+            self._next_probe += 1
+            if self._next_probe > self.id_max:
+                self._next_probe = self.id_min
+            if self.store.get(self._id_path(candidate)) is None:
+                return candidate
+        return 0
+
+    def allocate(self, key: str) -> int:
+        """Idempotent, refcounted, cluster-consistent (allocator.go:534
+        Allocate → lockedAllocate)."""
+        with self._lock:
+            if key in self._ids:
+                self._refs[key] += 1
+                return self._ids[key]
+
+        for _ in range(16):  # kvstore CAS retry budget
+            existing = self.get(key)
+            if existing:
+                self.store.set(
+                    self._slave_path(key),
+                    str(existing).encode(),
+                    session=self.node,
+                )
+                with self._lock:
+                    self._ids[key] = existing
+                    self._refs[key] = self._refs.get(key, 0) + 1
+                return existing
+
+            with self._lock:
+                candidate = self._select_available_id()
+            if candidate == 0:
+                raise RuntimeError("no more available IDs")
+
+            path_lock = self.store.lock_path(key)
+            with path_lock:
+                if not self.store.create_only(
+                    self._id_path(candidate), key.encode()
+                ):
+                    continue  # another writer took the id: retry
+                self.store.set(
+                    self._slave_path(key),
+                    str(candidate).encode(),
+                    session=self.node,
+                )
+            with self._lock:
+                self._ids[key] = candidate
+                self._refs[key] = self._refs.get(key, 0) + 1
+            return candidate
+        raise RuntimeError(f"allocation of key {key!r} keeps failing")
+
+    def release(self, key: str) -> bool:
+        """True when this node's last reference is gone."""
+        with self._lock:
+            if key not in self._refs:
+                return False
+            self._refs[key] -= 1
+            if self._refs[key] > 0:
+                return False
+            del self._refs[key]
+            del self._ids[key]
+        self.store.delete(self._slave_path(key))
+        return True
+
+    def gc(self) -> int:
+        """Master keys with no remaining slave keys are collected
+        (allocator RunGC)."""
+        removed = 0
+        for path, value in self.store.list_prefix(f"{self.prefix}/id/").items():
+            key = value.decode()
+            if not self.store.list_prefix(self._value_prefix(key)):
+                if self.store.delete(path):
+                    removed += 1
+        return removed
+
+    # -- events (cache.go) ---------------------------------------------------
+
+    def watch(
+        self, handler: Callable[[str, int, str], None]
+    ) -> Callable[[], None]:
+        """Watch master keys: handler(kind, id, key)."""
+
+        def on_event(event: KVEvent) -> None:
+            num_id = int(event.key.rsplit("/", 1)[1])
+            handler(event.kind, num_id, event.value.decode())
+
+        return self.store.watch_prefix(f"{self.prefix}/id/", on_event)
+
+
+class IdentityBackendAdapter:
+    """Adapter wiring this allocator as the `backend` of
+    cilium_tpu.identity.IdentityAllocator (sorted-label-bytes key)."""
+
+    def __init__(self, allocator: Allocator) -> None:
+        self.allocator = allocator
+
+    def allocate(self, key: bytes) -> int:
+        return self.allocator.allocate(key.decode("utf-8", "replace"))
+
+    def release(self, key: bytes) -> None:
+        self.allocator.release(key.decode("utf-8", "replace"))

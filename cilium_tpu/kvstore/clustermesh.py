@@ -1,0 +1,100 @@
+"""ClusterMesh: multi-cluster state fan-in.
+
+Behavioral port of /root/reference/pkg/clustermesh: each remote
+cluster is a kvstore endpoint (config per remote, clustermesh.go /
+remote_cluster.go); the agent watches the remote cluster's identities
+(identity.WatchRemoteIdentities, pkg/identity/allocator.go:191) and
+ipcache prefix, merging them into the local caches.  ClusterID
+partitions the identity space (NumericIdentity.ClusterID,
+numericidentity.go:162: bits 16-23) so ids never collide across
+clusters.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from cilium_tpu.ipcache.ipcache import IPCache
+from cilium_tpu.kvstore.ipsync import IPIdentityWatcher
+from cilium_tpu.kvstore.store import KVStore
+
+CLUSTER_ID_SHIFT = 16
+CLUSTER_ID_MAX = 255
+
+
+def cluster_id_of(num_id: int) -> int:
+    """numericidentity.go:162."""
+    return (num_id >> CLUSTER_ID_SHIFT) & CLUSTER_ID_MAX
+
+
+class RemoteCluster:
+    """pkg/clustermesh/remote_cluster.go: one connected remote."""
+
+    def __init__(
+        self,
+        name: str,
+        store: KVStore,
+        local_ipcache: IPCache,
+        identities_path: str = "cilium/state/identities/v1",
+        on_identity: Optional[Callable[[str, int, str], None]] = None,
+    ) -> None:
+        self.name = name
+        self.store = store
+        # remote identities → local identity event stream
+        self._remote_ids: Dict[int, str] = {}
+        self._on_identity = on_identity
+
+        def handler(event) -> None:
+            num_id = int(event.key.rsplit("/", 1)[1])
+            key = event.value.decode()
+            if event.kind == "delete":
+                self._remote_ids.pop(num_id, None)
+            else:
+                self._remote_ids[num_id] = key
+            if self._on_identity is not None:
+                self._on_identity(event.kind, num_id, key)
+
+        self._unsub_ids = store.watch_prefix(
+            f"{identities_path}/id/", handler
+        )
+        # remote ipcache → local IPCache (source=kvstore)
+        self._ip_watcher = IPIdentityWatcher(store, local_ipcache)
+
+    def remote_identities(self) -> Dict[int, str]:
+        return dict(self._remote_ids)
+
+    def close(self) -> None:
+        self._unsub_ids()
+        self._ip_watcher.close()
+
+
+class ClusterMesh:
+    """pkg/clustermesh/clustermesh.go: the set of connected remotes,
+    keyed by cluster name (config-dir watching replaced by explicit
+    add/remove — the config watcher belongs to the daemon shell)."""
+
+    def __init__(self, local_ipcache: IPCache) -> None:
+        self.local_ipcache = local_ipcache
+        self.clusters: Dict[str, RemoteCluster] = {}
+
+    def add_cluster(
+        self,
+        name: str,
+        store: KVStore,
+        on_identity: Optional[Callable[[str, int, str], None]] = None,
+    ) -> RemoteCluster:
+        if name in self.clusters:
+            self.remove_cluster(name)
+        remote = RemoteCluster(
+            name, store, self.local_ipcache, on_identity=on_identity
+        )
+        self.clusters[name] = remote
+        return remote
+
+    def remove_cluster(self, name: str) -> None:
+        remote = self.clusters.pop(name, None)
+        if remote is not None:
+            remote.close()
+
+    def num_connected(self) -> int:
+        return len(self.clusters)

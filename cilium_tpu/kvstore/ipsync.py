@@ -1,0 +1,90 @@
+"""ipcache ↔ kvstore synchronisation.
+
+Behavioral port of /root/reference/pkg/ipcache/kvstore.go: each agent
+publishes its local endpoint IP → identity mappings under
+`cilium/state/ip/v1/<address space>/<ip>` (UpsertIPToKVStore
+kvstore.go:159, lease-scoped so dead nodes' IPs expire), and every
+agent watches the whole prefix (InitIPIdentityWatcher kvstore.go:393)
+to feed its IPCache with source=kvstore — which then fans out to the
+device LPM builder.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional
+
+from cilium_tpu.ipcache.ipcache import FROM_KVSTORE, IPCache, IPIdentity
+from cilium_tpu.kvstore.store import KVEvent, KVStore
+
+DEFAULT_ADDRESS_SPACE = "default"  # kvstore.go AddressSpace
+
+
+def _ip_path(base: str, address_space: str, ip: str) -> str:
+    return f"{base}/{address_space}/{ip}"
+
+
+def upsert_ip_mapping(
+    store: KVStore,
+    ip: str,
+    identity: int,
+    host_ip: Optional[str] = None,
+    node: Optional[str] = None,
+    base: str = "cilium/state/ip/v1",
+    address_space: str = DEFAULT_ADDRESS_SPACE,
+) -> None:
+    """UpsertIPToKVStore (kvstore.go:159): JSON payload {IP, ID, Host}
+    under a node lease."""
+    payload = json.dumps(
+        {"IP": ip, "ID": identity, "Host": host_ip}
+    ).encode()
+    store.set(
+        _ip_path(base, address_space, ip), payload, session=node
+    )
+
+
+def delete_ip_mapping(
+    store: KVStore,
+    ip: str,
+    base: str = "cilium/state/ip/v1",
+    address_space: str = DEFAULT_ADDRESS_SPACE,
+) -> None:
+    store.delete(_ip_path(base, address_space, ip))
+
+
+class IPIdentityWatcher:
+    """InitIPIdentityWatcher (kvstore.go:393): replay + stream kvstore
+    IP mappings into the local IPCache with source=kvstore (so local
+    agent entries keep precedence, ipcache.go:183)."""
+
+    def __init__(
+        self,
+        store: KVStore,
+        ipcache: IPCache,
+        base: str = "cilium/state/ip/v1",
+        address_space: str = DEFAULT_ADDRESS_SPACE,
+    ) -> None:
+        self.ipcache = ipcache
+        prefix = f"{base}/{address_space}/"
+        self._unsubscribe = store.watch_prefix(prefix, self._on_event)
+
+    def _on_event(self, event: KVEvent) -> None:
+        ip = event.key.rsplit("/", 1)[1]
+        if event.kind == "delete":
+            cached, ok = self.ipcache.lookup_by_prefix(ip)
+            # only remove kvstore-owned entries (never agent-local)
+            if ok and cached.source == FROM_KVSTORE:
+                self.ipcache.delete(ip)
+            return
+        try:
+            payload = json.loads(event.value.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        self.ipcache.upsert(
+            ip,
+            IPIdentity(int(payload["ID"]), FROM_KVSTORE),
+            host_ip=payload.get("Host"),
+        )
+
+    def close(self) -> None:
+        self._unsubscribe()

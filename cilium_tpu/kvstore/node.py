@@ -1,0 +1,99 @@
+"""Node registry + discovery over the kvstore.
+
+Behavioral port of /root/reference/pkg/node (+ pkg/kvstore/store's
+shared-store sync): each agent publishes its own Node object under
+`cilium/state/nodes/v1/<cluster>/<name>` with a lease (dead nodes
+disappear on expiry); every agent watches the prefix to learn the
+cluster topology — node IPs, per-node pod CIDRs (feeding tunnel/route
+decisions) and health targets.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from cilium_tpu.kvstore.store import KVEvent, KVStore
+
+NODES_PATH = "cilium/state/nodes/v1"
+
+
+@dataclass
+class Node:
+    """pkg/node/node.go Node: identity + addressing."""
+
+    name: str
+    cluster: str = "default"
+    internal_ip: Optional[str] = None
+    ipv4_alloc_cidr: Optional[str] = None  # per-node pod CIDR
+    ipv6_alloc_cidr: Optional[str] = None
+
+    def path(self) -> str:
+        return f"{NODES_PATH}/{self.cluster}/{self.name}"
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "Name": self.name,
+                "Cluster": self.cluster,
+                "IP": self.internal_ip,
+                "IPv4AllocCIDR": self.ipv4_alloc_cidr,
+                "IPv6AllocCIDR": self.ipv6_alloc_cidr,
+            }
+        ).encode()
+
+    @staticmethod
+    def from_json(data: bytes) -> "Node":
+        doc = json.loads(data.decode())
+        return Node(
+            name=doc["Name"],
+            cluster=doc.get("Cluster", "default"),
+            internal_ip=doc.get("IP"),
+            ipv4_alloc_cidr=doc.get("IPv4AllocCIDR"),
+            ipv6_alloc_cidr=doc.get("IPv6AllocCIDR"),
+        )
+
+
+def register_node(store: KVStore, node: Node) -> None:
+    """Publish under the node's own lease (store.go key ownership)."""
+    store.set(node.path(), node.to_json(), session=node.name)
+
+
+def unregister_node(store: KVStore, node: Node) -> None:
+    store.delete(node.path())
+
+
+class NodeWatcher:
+    """Discovery: maintains the cluster's node set from the kvstore,
+    invoking on_change(kind, node) per event."""
+
+    def __init__(
+        self,
+        store: KVStore,
+        cluster: str = "default",
+        on_change: Optional[Callable[[str, Node], None]] = None,
+    ) -> None:
+        self.nodes: Dict[str, Node] = {}
+        self._on_change = on_change
+        self._unsubscribe = store.watch_prefix(
+            f"{NODES_PATH}/{cluster}/", self._on_event
+        )
+
+    def _on_event(self, event: KVEvent) -> None:
+        name = event.key.rsplit("/", 1)[1]
+        if event.kind == "delete":
+            node = self.nodes.pop(name, None)
+            if node is not None and self._on_change:
+                self._on_change("delete", node)
+            return
+        try:
+            node = Node.from_json(event.value)
+        except (ValueError, KeyError):
+            return
+        self.nodes[name] = node
+        if self._on_change:
+            self._on_change(event.kind, node)
+
+    def close(self) -> None:
+        self._unsubscribe()

@@ -1,0 +1,178 @@
+"""In-process watchable kvstore with etcd-like semantics.
+
+Implements the BackendOperations surface of
+/root/reference/pkg/kvstore/backend.go:92 — Get/GetPrefix/Set/Delete/
+CreateOnly/CreateIfExists/ListPrefix/DeletePrefix/LockPath/Watch —
+plus lease semantics: keys created with a `session` are removed en
+masse when that session expires (etcd lease expiry ≙ dead node state
+cleanup, pkg/kvstore/keepalive.go).
+
+Watchers follow the reference's ListAndWatch contract (etcd.go):
+subscribing replays the current prefix contents as `create` events
+then streams subsequent modifications in order.  Every mutation gets a
+monotonically increasing mod-revision.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class KVEvent:
+    """kvstore.KeyValueEvent: create | modify | delete."""
+
+    kind: str
+    key: str
+    value: bytes
+    revision: int
+
+
+Watcher = Callable[[KVEvent], None]
+
+
+class KVStore:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._data: Dict[str, bytes] = {}
+        self._sessions: Dict[str, set] = {}  # session → keys
+        self._key_session: Dict[str, str] = {}
+        self._revision = 0
+        self._watchers: List[Tuple[str, Watcher]] = []
+        self._locks: Dict[str, threading.RLock] = {}
+
+    # -- primitives ----------------------------------------------------------
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def get_prefix(self, prefix: str) -> Optional[Tuple[str, bytes]]:
+        """First key matching the prefix (backend.go GetPrefix)."""
+        with self._lock:
+            for k in sorted(self._data):
+                if k.startswith(prefix):
+                    return k, self._data[k]
+            return None
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        with self._lock:
+            return {
+                k: v for k, v in self._data.items() if k.startswith(prefix)
+            }
+
+    def set(self, key: str, value: bytes, session: Optional[str] = None) -> int:
+        with self._lock:
+            kind = "modify" if key in self._data else "create"
+            self._data[key] = value
+            self._attach_session(key, session)
+            return self._emit(kind, key, value)
+
+    def create_only(
+        self, key: str, value: bytes, session: Optional[str] = None
+    ) -> bool:
+        """CAS create: False when the key already exists."""
+        with self._lock:
+            if key in self._data:
+                return False
+            self._data[key] = value
+            self._attach_session(key, session)
+            self._emit("create", key, value)
+            return True
+
+    def create_if_exists(
+        self, cond_key: str, key: str, value: bytes,
+        session: Optional[str] = None,
+    ) -> bool:
+        with self._lock:
+            if cond_key not in self._data:
+                return False
+            self._data[key] = value
+            self._attach_session(key, session)
+            self._emit("create", key, value)
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            if key not in self._data:
+                return False
+            value = self._data.pop(key)
+            self._detach_session(key)
+            self._emit("delete", key, value)
+            return True
+
+    def delete_prefix(self, prefix: str) -> int:
+        with self._lock:
+            keys = [k for k in self._data if k.startswith(prefix)]
+            for k in keys:
+                self.delete(k)
+            return len(keys)
+
+    # -- locks (backend.go LockPath) ----------------------------------------
+
+    def lock_path(self, path: str) -> threading.RLock:
+        with self._lock:
+            return self._locks.setdefault(path, threading.RLock())
+
+    # -- sessions / leases ---------------------------------------------------
+
+    def _attach_session(self, key: str, session: Optional[str]) -> None:
+        old = self._key_session.pop(key, None)
+        if old is not None:
+            self._sessions.get(old, set()).discard(key)
+        if session is not None:
+            self._sessions.setdefault(session, set()).add(key)
+            self._key_session[key] = session
+
+    def _detach_session(self, key: str) -> None:
+        old = self._key_session.pop(key, None)
+        if old is not None:
+            self._sessions.get(old, set()).discard(key)
+
+    def expire_session(self, session: str) -> int:
+        """Lease expiry: all keys of the session vanish (with delete
+        events) — how a dead node's state leaves the cluster."""
+        with self._lock:
+            keys = sorted(self._sessions.pop(session, set()))
+            for key in keys:
+                self._key_session.pop(key, None)
+                if key in self._data:
+                    value = self._data.pop(key)
+                    self._emit("delete", key, value)
+            return len(keys)
+
+    # -- watch (ListAndWatch) ------------------------------------------------
+
+    def watch_prefix(self, prefix: str, watcher: Watcher) -> Callable[[], None]:
+        """Replay current contents as `create` events, then stream.
+        Returns an unsubscribe function."""
+        with self._lock:
+            for k in sorted(self._data):
+                if k.startswith(prefix):
+                    watcher(
+                        KVEvent("create", k, self._data[k], self._revision)
+                    )
+            entry = (prefix, watcher)
+            self._watchers.append(entry)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return unsubscribe
+
+    def _emit(self, kind: str, key: str, value: bytes) -> int:
+        self._revision += 1
+        event = KVEvent(kind, key, value, self._revision)
+        for prefix, watcher in list(self._watchers):
+            if key.startswith(prefix):
+                watcher(event)
+        return self._revision
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._revision

@@ -1,0 +1,214 @@
+"""kvstore, allocator consensus, ipcache sync, clustermesh."""
+
+import ipaddress
+
+import numpy as np
+import pytest
+
+from cilium_tpu.ipcache import FROM_AGENT_LOCAL, IPCache, IPIdentity
+from cilium_tpu.kvstore import (
+    Allocator,
+    ClusterMesh,
+    IDENTITIES_PATH,
+    IPIdentityWatcher,
+    KVStore,
+    delete_ip_mapping,
+    upsert_ip_mapping,
+)
+from cilium_tpu.kvstore.allocator import IdentityBackendAdapter
+from cilium_tpu.kvstore.clustermesh import cluster_id_of
+
+
+def test_store_basics_and_watch():
+    s = KVStore()
+    events = []
+    s.set("a/x", b"1")
+    unsub = s.watch_prefix("a/", events.append)
+    # replay of existing contents
+    assert [(e.kind, e.key) for e in events] == [("create", "a/x")]
+    s.set("a/y", b"2")
+    s.set("a/x", b"3")
+    s.delete("a/y")
+    s.set("b/z", b"9")  # outside prefix
+    kinds = [(e.kind, e.key) for e in events]
+    assert kinds == [
+        ("create", "a/x"),
+        ("create", "a/y"),
+        ("modify", "a/x"),
+        ("delete", "a/y"),
+    ]
+    unsub()
+    s.set("a/w", b"0")
+    assert len(events) == 4
+    # CAS
+    assert s.create_only("c", b"1")
+    assert not s.create_only("c", b"2")
+    assert s.get("c") == b"1"
+
+
+def test_session_expiry_removes_leased_keys():
+    s = KVStore()
+    events = []
+    s.watch_prefix("ip/", events.append)
+    s.set("ip/10.0.0.1", b"x", session="node1")
+    s.set("ip/10.0.0.2", b"y", session="node1")
+    s.set("ip/10.0.0.3", b"z", session="node2")
+    assert s.expire_session("node1") == 2
+    assert s.get("ip/10.0.0.1") is None
+    assert s.get("ip/10.0.0.3") == b"z"
+    assert [(e.kind, e.key) for e in events[-2:]] == [
+        ("delete", "ip/10.0.0.1"),
+        ("delete", "ip/10.0.0.2"),
+    ]
+
+
+def test_allocator_cluster_consensus():
+    """Two nodes sharing a store agree on ids; refcounted release;
+    master-key GC after the last slave key is gone."""
+    s = KVStore()
+    a1 = Allocator(s, IDENTITIES_PATH, node="node1")
+    a2 = Allocator(s, IDENTITIES_PATH, node="node2")
+
+    id1 = a1.allocate("labels;app=foo;")
+    id2 = a2.allocate("labels;app=foo;")
+    assert id1 == id2  # consensus
+    id3 = a2.allocate("labels;app=bar;")
+    assert id3 != id1
+
+    # both nodes hold slave keys
+    slaves = s.list_prefix(f"{IDENTITIES_PATH}/value/labels;app=foo;/")
+    assert len(slaves) == 2
+
+    # idempotent local allocate bumps refcount; release is refcounted
+    a1.allocate("labels;app=foo;")
+    assert not a1.release("labels;app=foo;")
+    assert a1.release("labels;app=foo;")
+    assert a1.gc() == 0  # node2 still holds a slave key
+    assert a2.release("labels;app=foo;")
+    assert a1.gc() == 1
+    assert s.get(a1._id_path(id1)) is None
+
+
+def test_allocator_node_death_cleans_slave_keys():
+    s = KVStore()
+    a1 = Allocator(s, IDENTITIES_PATH, node="node1")
+    num_id = a1.allocate("k")
+    assert s.list_prefix(f"{IDENTITIES_PATH}/value/k/")
+    s.expire_session("node1")
+    assert not s.list_prefix(f"{IDENTITIES_PATH}/value/k/")
+    assert a1.gc() == 1
+
+
+def test_cluster_id_partitioning():
+    s = KVStore()
+    a = Allocator(s, IDENTITIES_PATH, node="n", cluster_id=3)
+    num_id = a.allocate("x")
+    assert cluster_id_of(num_id) == 3
+    assert num_id & 0xFFFF >= 256
+
+
+def test_identity_backend_adapter():
+    from cilium_tpu.identity import IdentityAllocator
+    from cilium_tpu.labels import Label, Labels
+
+    s = KVStore()
+    backend1 = IdentityBackendAdapter(Allocator(s, IDENTITIES_PATH, "n1"))
+    backend2 = IdentityBackendAdapter(Allocator(s, IDENTITIES_PATH, "n2"))
+    alloc1 = IdentityAllocator(backend=backend1)
+    alloc2 = IdentityAllocator(backend=backend2)
+
+    labels = Labels({"app": Label("app", "web", "k8s")})
+    i1, new1 = alloc1.allocate(labels)
+    i2, new2 = alloc2.allocate(labels)
+    assert i1.id == i2.id  # cluster-wide agreement via kvstore
+
+
+def test_ip_sync_and_lpm_end_to_end():
+    """Node A publishes an endpoint IP; node B's ipcache + device LPM
+    observe it (the §3.5 propagation path)."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.ipcache.lpm import LPMBuilder, lpm_lookup
+
+    store = KVStore()
+    cache_b = IPCache()
+    builder = LPMBuilder()
+    cache_b.add_listener(builder)
+    IPIdentityWatcher(store, cache_b)
+
+    upsert_ip_mapping(store, "10.0.1.5", 4242, host_ip="192.168.0.1",
+                      node="nodeA")
+    ident, ok = cache_b.lookup_by_ip("10.0.1.5")
+    assert ok and ident.id == 4242 and ident.source == "kvstore"
+
+    ips = np.array([int(ipaddress.IPv4Address("10.0.1.5"))], dtype=np.uint32)
+    assert np.asarray(lpm_lookup(builder.tables(), jnp.asarray(ips)))[0] == 4242
+
+    # agent-local entries keep precedence over kvstore updates
+    cache_b.upsert("10.0.1.5", IPIdentity(7, FROM_AGENT_LOCAL))
+    upsert_ip_mapping(store, "10.0.1.5", 9999, node="nodeA")
+    ident, _ = cache_b.lookup_by_ip("10.0.1.5")
+    assert ident.id == 7
+
+    # node death: lease expiry removes the mapping downstream
+    upsert_ip_mapping(store, "10.0.2.2", 5555, node="nodeA")
+    store.expire_session("nodeA")
+    assert not cache_b.lookup_by_ip("10.0.2.2")[1]
+
+
+def test_clustermesh_remote_fanin():
+    local_ipcache = IPCache()
+    mesh = ClusterMesh(local_ipcache)
+
+    remote_store = KVStore()
+    remote_alloc = Allocator(
+        remote_store, IDENTITIES_PATH, node="r1", cluster_id=2
+    )
+    remote_id = remote_alloc.allocate("labels;app=remote;")
+    upsert_ip_mapping(remote_store, "172.16.0.9", remote_id, node="r1")
+
+    seen = []
+    remote = mesh.add_cluster(
+        "cluster-2", remote_store, on_identity=lambda *a: seen.append(a)
+    )
+    assert mesh.num_connected() == 1
+    # replayed identity + ip mapping
+    assert remote.remote_identities() == {remote_id: "labels;app=remote;"}
+    assert seen and seen[0][1] == remote_id
+    ident, ok = local_ipcache.lookup_by_ip("172.16.0.9")
+    assert ok and ident.id == remote_id
+    assert cluster_id_of(ident.id) == 2
+
+    mesh.remove_cluster("cluster-2")
+    assert mesh.num_connected() == 0
+
+
+def test_node_discovery():
+    from cilium_tpu.kvstore.node import (
+        Node,
+        NodeWatcher,
+        register_node,
+        unregister_node,
+    )
+
+    store = KVStore()
+    n1 = Node(name="node1", internal_ip="192.168.0.1",
+              ipv4_alloc_cidr="10.1.0.0/16")
+    register_node(store, n1)
+
+    changes = []
+    w = NodeWatcher(store, on_change=lambda k, n: changes.append((k, n.name)))
+    assert set(w.nodes) == {"node1"}
+
+    n2 = Node(name="node2", internal_ip="192.168.0.2")
+    register_node(store, n2)
+    assert set(w.nodes) == {"node1", "node2"}
+    assert w.nodes["node1"].ipv4_alloc_cidr == "10.1.0.0/16"
+
+    # node death via lease expiry
+    store.expire_session("node2")
+    assert set(w.nodes) == {"node1"}
+    assert changes[-1] == ("delete", "node2")
+
+    unregister_node(store, n1)
+    assert not w.nodes
