@@ -1,0 +1,29 @@
+"""Connection tracking (the CT map of bpf/lib/conntrack.h).
+
+`table` is the authoritative host-side CT state machine;
+`device` compiles snapshots into open-addressed hash tensors for
+batched device lookups, with new-flow/counter updates applied back on
+host (the BPF map ↔ userspace async-handoff pattern of SURVEY §2.9).
+"""
+
+from cilium_tpu.ct.table import (
+    CT_ESTABLISHED,
+    CT_NEW,
+    CT_RELATED,
+    CT_REPLY,
+    CTEntry,
+    CTKey,
+    CTMap,
+    CTTuple,
+)
+
+__all__ = [
+    "CTMap",
+    "CTKey",
+    "CTEntry",
+    "CTTuple",
+    "CT_NEW",
+    "CT_ESTABLISHED",
+    "CT_REPLY",
+    "CT_RELATED",
+]

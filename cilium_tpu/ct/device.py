@@ -1,0 +1,202 @@
+"""Device conntrack lookup over compiled snapshots.
+
+The host CTMap stays authoritative (it mutates); batches evaluate
+against a compiled snapshot in a fixed number of gathers, and the
+results (new flows, counters) are applied back on host — the same
+split as the reference, where the BPF map is written by the kernel and
+read/GC'd from userspace asynchronously.
+
+Lookup reproduces ct_lookup4's probe order under the batch: reverse
+tuple first (REPLY/RELATED precedence), then forward, else NEW.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from cilium_tpu.ct.table import (
+    CT_ESTABLISHED,
+    CT_INGRESS,
+    CT_EGRESS,
+    CT_NEW,
+    CT_RELATED,
+    CT_REPLY,
+    CTMap,
+    CTTuple,
+    TUPLE_F_IN,
+    TUPLE_F_OUT,
+    TUPLE_F_RELATED,
+    TUPLE_F_SERVICE,
+)
+from cilium_tpu.engine.hashtable import (
+    HashTable,
+    build_hash_table,
+    lookup_batch,
+)
+
+
+def _pack_key(t: CTTuple) -> Tuple[int, int, int, int]:
+    """CTTuple → 4 u32 words (daddr, saddr, dport<<16|sport,
+    nexthdr<<8|flags) — the struct layout of common.h:359 collapsed."""
+    return (
+        t.daddr & 0xFFFFFFFF,
+        t.saddr & 0xFFFFFFFF,
+        ((t.dport & 0xFFFF) << 16) | (t.sport & 0xFFFF),
+        ((t.nexthdr & 0xFF) << 8) | (t.flags & 0xFF),
+    )
+
+
+@dataclass
+class CTSnapshot:
+    """Compiled CT table: hash table over packed tuple words +
+    per-entry state needed by the datapath."""
+
+    table: HashTable
+    rev_nat_index: np.ndarray  # u16 [N]
+    slave: np.ndarray  # u16 [N]
+    related: np.ndarray  # u8 [N] entry carries TUPLE_F_RELATED
+
+    def tree_flatten(self):
+        return (
+            (self.table, self.rev_nat_index, self.slave, self.related),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            CTSnapshot,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: CTSnapshot.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+def compile_ct(ct: CTMap) -> CTSnapshot:
+    entries = list(ct.entries.items())
+    if entries:
+        keys = np.array(
+            [_pack_key(k) for k, _ in entries], dtype=np.uint32
+        )
+    else:
+        keys = np.zeros((0, 4), dtype=np.uint32)
+    table = build_hash_table(keys)
+    rev_nat = np.array(
+        [e.rev_nat_index for _, e in entries] or [0], dtype=np.uint16
+    )
+    slave = np.array([e.slave for _, e in entries] or [0], dtype=np.uint16)
+    related = np.array(
+        [1 if (k.flags & TUPLE_F_RELATED) else 0 for k, _ in entries]
+        or [0],
+        dtype=np.uint8,
+    )
+    return CTSnapshot(
+        table=table, rev_nat_index=rev_nat, slave=slave, related=related
+    )
+
+
+def _pack_batch(daddr, saddr, dport, sport, proto, flags):
+    import jax.numpy as jnp
+
+    w2 = (dport.astype(jnp.uint32) << 16) | sport.astype(jnp.uint32)
+    w3 = (proto.astype(jnp.uint32) << 8) | flags.astype(jnp.uint32)
+    return jnp.stack(
+        [daddr.astype(jnp.uint32), saddr.astype(jnp.uint32), w2, w3],
+        axis=1,
+    )
+
+
+def ct_lookup_batch(
+    snapshot: CTSnapshot,
+    daddr,
+    saddr,
+    dport,
+    sport,
+    proto,
+    direction,  # i32 [B]: 0=ingress 1=egress 2=service
+):
+    """Returns (result u8 [B]: CT_NEW/ESTABLISHED/REPLY/RELATED,
+    rev_nat u16-as-i32 [B], slave i32 [B])."""
+    import jax.numpy as jnp
+
+    base_flags = jnp.where(
+        direction == CT_INGRESS,
+        TUPLE_F_OUT,
+        jnp.where(direction == CT_EGRESS, TUPLE_F_IN, TUPLE_F_SERVICE),
+    ).astype(jnp.uint32)
+
+    # reverse probe: swapped addrs/ports, IN flag flipped
+    rev_flags = base_flags ^ jnp.uint32(TUPLE_F_IN)
+    rev_q = _pack_batch(saddr, daddr, sport, dport, proto, rev_flags)
+    fwd_q = _pack_batch(daddr, saddr, dport, sport, proto, base_flags)
+
+    rev_found, rev_idx = lookup_batch(snapshot.table, rev_q)
+    fwd_found, fwd_idx = lookup_batch(snapshot.table, fwd_q)
+
+    related = jnp.asarray(snapshot.related)
+    rev_related = related[rev_idx].astype(bool) & rev_found
+    result = jnp.where(
+        rev_found,
+        jnp.where(rev_related, CT_RELATED, CT_REPLY),
+        jnp.where(fwd_found, CT_ESTABLISHED, CT_NEW),
+    ).astype(jnp.uint8)
+
+    idx = jnp.where(rev_found, rev_idx, fwd_idx)
+    hit = rev_found | fwd_found
+    rev_nat = jnp.where(
+        hit, jnp.asarray(snapshot.rev_nat_index)[idx], 0
+    ).astype(jnp.int32)
+    slave = jnp.where(hit, jnp.asarray(snapshot.slave)[idx], 0).astype(
+        jnp.int32
+    )
+    return result, rev_nat, slave
+
+
+def apply_new_flows(
+    ct: CTMap,
+    results: np.ndarray,
+    daddr,
+    saddr,
+    dport,
+    sport,
+    proto,
+    direction,
+    now: int = 0,
+) -> int:
+    """Create host CT entries for batch tuples that resolved CT_NEW
+    and were allowed (caller pre-filters) — ct_create4 on CT_NEW
+    (bpf_lxc.c:844).  Duplicates within the batch collapse."""
+    n = 0
+    for i in np.nonzero(results == CT_NEW)[0]:
+        tup = CTTuple(
+            int(daddr[i]), int(saddr[i]), int(dport[i]), int(sport[i]),
+            int(proto[i]),
+        )
+        d = int(direction[i])
+        key_flags = (
+            TUPLE_F_OUT if d == CT_INGRESS
+            else TUPLE_F_IN if d == CT_EGRESS else TUPLE_F_SERVICE
+        )
+        key = CTTuple(
+            tup.daddr, tup.saddr, tup.dport, tup.sport, tup.nexthdr,
+            key_flags,
+        )
+        if key in ct.entries:
+            continue
+        ct.create(tup, d, now=now)
+        n += 1
+    return n

@@ -1,0 +1,277 @@
+"""Host connection-tracking table.
+
+Behavioral port of /root/reference/bpf/lib/conntrack.h and
+pkg/maps/ctmap:
+  - tuple layout (common.h:359): (daddr, saddr, dport, sport, nexthdr,
+    flags) where flags carries direction (TUPLE_F_OUT/IN) and RELATED;
+  - lookup order (ct_lookup4, conntrack.h:314-466): the REVERSE tuple
+    is probed first because REPLY/RELATED take precedence over
+    ESTABLISHED for policy purposes; then the forward tuple; else NEW;
+  - timeouts (ct_update_timeout conntrack.h:190-207): TCP entries that
+    have seen a non-SYN packet get CT_LIFETIME_TCP, SYN-only get
+    CT_SYN_TIMEOUT, non-TCP get CT_LIFETIME_NONTCP; closing entries
+    (FIN/RST, ACTION_CLOSE) get CT_CLOSE_TIMEOUT once dead;
+  - accounting: rx on ingress, tx on egress (conntrack.h:247-255);
+  - GC by expired lifetime (pkg/maps/ctmap GC).
+
+Capacity envelope: 64k entries per endpoint-local map
+(pkg/maps/ctmap/ctmap.go:71).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+# conntrack.h:55-66
+TUPLE_F_OUT = 0
+TUPLE_F_IN = 1
+TUPLE_F_RELATED = 2
+TUPLE_F_SERVICE = 4
+
+# lookup results (conntrack.h CT_*)
+CT_NEW = 0
+CT_ESTABLISHED = 1
+CT_REPLY = 2
+CT_RELATED = 3
+
+# directions (common.h CT_INGRESS/CT_EGRESS/CT_SERVICE)
+CT_INGRESS = 0
+CT_EGRESS = 1
+CT_SERVICE = 2
+
+# default lifetimes in seconds (bpf/lib/conntrack.h defaults)
+CT_DEFAULT_LIFETIME_TCP = 21600
+CT_DEFAULT_LIFETIME_NONTCP = 60
+CT_SYN_TIMEOUT = 60
+CT_CLOSE_TIMEOUT = 10
+
+IPPROTO_TCP = 6
+
+# pkg/maps/ctmap/ctmap.go:71
+MAX_ENTRIES_LOCAL = 65536
+
+
+@dataclass(frozen=True)
+class CTTuple:
+    """ipv4_ct_tuple (common.h:359), addresses as u32 host ints."""
+
+    daddr: int
+    saddr: int
+    dport: int
+    sport: int
+    nexthdr: int
+    flags: int = TUPLE_F_OUT
+
+    def reverse(self) -> "CTTuple":
+        """ipv4_ct_tuple_reverse (conntrack.h:286): swap addrs+ports,
+        flip IN flag."""
+        flags = self.flags
+        if flags & TUPLE_F_IN:
+            flags &= ~TUPLE_F_IN
+        else:
+            flags |= TUPLE_F_IN
+        return CTTuple(
+            daddr=self.saddr,
+            saddr=self.daddr,
+            dport=self.sport,
+            sport=self.dport,
+            nexthdr=self.nexthdr,
+            flags=flags,
+        )
+
+
+CTKey = CTTuple
+
+
+@dataclass
+class CTEntry:
+    """ct_entry (common.h:380)."""
+
+    lifetime: int = 0
+    rx_packets: int = 0
+    rx_bytes: int = 0
+    tx_packets: int = 0
+    tx_bytes: int = 0
+    rev_nat_index: int = 0
+    slave: int = 0
+    lb_loopback: bool = False
+    seen_non_syn: bool = False
+    rx_closing: bool = False
+    tx_closing: bool = False
+
+    def alive(self) -> bool:
+        """ct_entry_alive: neither side closed."""
+        return not (self.rx_closing or self.tx_closing)
+
+
+@dataclass
+class CTState:
+    """ct_state handed back to the datapath."""
+
+    rev_nat_index: int = 0
+    loopback: bool = False
+    slave: int = 0
+
+
+class CTMap:
+    def __init__(self, max_entries: int = MAX_ENTRIES_LOCAL) -> None:
+        self.entries: Dict[CTTuple, CTEntry] = {}
+        self.max_entries = max_entries
+
+    # -- timeout logic (conntrack.h:190-207) --------------------------------
+
+    def _update_timeout(
+        self, entry: CTEntry, is_tcp: bool, dir: int, syn: bool, now: int
+    ) -> None:
+        lifetime = CT_DEFAULT_LIFETIME_NONTCP
+        if is_tcp:
+            entry.seen_non_syn |= not syn
+            lifetime = (
+                CT_DEFAULT_LIFETIME_TCP
+                if entry.seen_non_syn
+                else CT_SYN_TIMEOUT
+            )
+        entry.lifetime = now + lifetime
+
+    # -- __ct_lookup (conntrack.h:221) --------------------------------------
+
+    def _probe(
+        self,
+        tup: CTTuple,
+        action: str,
+        dir: int,
+        now: int,
+        pkt_len: int,
+        is_tcp: bool,
+        syn: bool,
+        ct_state: Optional[CTState],
+    ) -> int:
+        entry = self.entries.get(tup)
+        if entry is None:
+            return CT_NEW
+        if entry.alive():
+            self._update_timeout(entry, is_tcp, dir, syn, now)
+        if ct_state is not None:
+            ct_state.rev_nat_index = entry.rev_nat_index
+            ct_state.loopback = entry.lb_loopback
+            ct_state.slave = entry.slave
+        if dir == CT_INGRESS:
+            entry.rx_packets += 1
+            entry.rx_bytes += pkt_len
+        else:
+            entry.tx_packets += 1
+            entry.tx_bytes += pkt_len
+        if action == "create":
+            if entry.rx_closing or entry.tx_closing:
+                # connection being reopened (conntrack.h:259-264)
+                entry.rx_closing = False
+                entry.tx_closing = False
+                self._update_timeout(entry, is_tcp, dir, syn, now)
+        elif action == "close":
+            if dir == CT_INGRESS:
+                entry.rx_closing = True
+            else:
+                entry.tx_closing = True
+            if not entry.alive():
+                entry.lifetime = now + CT_CLOSE_TIMEOUT
+        return CT_ESTABLISHED
+
+    # -- ct_lookup4 (conntrack.h:468) ---------------------------------------
+
+    def lookup(
+        self,
+        tup: CTTuple,
+        dir: int,
+        now: int = 0,
+        pkt_len: int = 0,
+        tcp_syn: bool = False,
+        tcp_fin_or_rst: bool = False,
+        related_icmp: bool = False,
+        ct_state: Optional[CTState] = None,
+    ) -> int:
+        """Returns CT_NEW / CT_ESTABLISHED / CT_REPLY / CT_RELATED.
+
+        `tup` is the on-wire tuple; direction flags are derived from
+        `dir` as the datapath does (conntrack.h:330-336)."""
+        if dir == CT_INGRESS:
+            flags = TUPLE_F_OUT
+        elif dir == CT_EGRESS:
+            flags = TUPLE_F_IN
+        else:
+            flags = TUPLE_F_SERVICE
+        base = CTTuple(
+            tup.daddr, tup.saddr, tup.dport, tup.sport, tup.nexthdr, flags
+        )
+        if related_icmp:
+            base = CTTuple(
+                base.daddr, base.saddr, base.dport, base.sport,
+                base.nexthdr, base.flags | TUPLE_F_RELATED,
+            )
+
+        is_tcp = tup.nexthdr == IPPROTO_TCP
+        action = "unspec"
+        if is_tcp:
+            if tcp_fin_or_rst:
+                action = "close"
+            elif tcp_syn:
+                action = "create"
+
+        # Reverse tuple first: REPLY/RELATED precedence
+        # (conntrack.h:318-327).
+        rev = base.reverse()
+        ret = self._probe(
+            rev, action, dir, now, pkt_len, is_tcp, tcp_syn, ct_state
+        )
+        if ret != CT_NEW:
+            return (
+                CT_RELATED if rev.flags & TUPLE_F_RELATED else CT_REPLY
+            )
+        ret = self._probe(
+            base, action, dir, now, pkt_len, is_tcp, tcp_syn, ct_state
+        )
+        if ret != CT_NEW:
+            return (
+                CT_RELATED if base.flags & TUPLE_F_RELATED else
+                CT_ESTABLISHED
+            )
+        return CT_NEW
+
+    # -- ct_create4 (conntrack.h:500) ---------------------------------------
+
+    def create(
+        self,
+        tup: CTTuple,
+        dir: int,
+        now: int = 0,
+        rev_nat_index: int = 0,
+        slave: int = 0,
+        loopback: bool = False,
+        tcp_syn: bool = False,
+    ) -> CTEntry:
+        if dir == CT_INGRESS:
+            flags = TUPLE_F_OUT
+        elif dir == CT_EGRESS:
+            flags = TUPLE_F_IN
+        else:
+            flags = TUPLE_F_SERVICE
+        key = CTTuple(
+            tup.daddr, tup.saddr, tup.dport, tup.sport, tup.nexthdr, flags
+        )
+        if len(self.entries) >= self.max_entries and key not in self.entries:
+            raise OverflowError("CT map full")
+        entry = CTEntry(
+            rev_nat_index=rev_nat_index, slave=slave, lb_loopback=loopback
+        )
+        is_tcp = tup.nexthdr == IPPROTO_TCP
+        self._update_timeout(entry, is_tcp, dir, tcp_syn, now)
+        self.entries[key] = entry
+        return entry
+
+    # -- GC (pkg/maps/ctmap conntrack GC) -----------------------------------
+
+    def gc(self, now: int) -> int:
+        dead = [k for k, v in self.entries.items() if v.lifetime < now]
+        for k in dead:
+            del self.entries[k]
+        return len(dead)

@@ -1,0 +1,133 @@
+"""Generic device hash table: host-built open addressing, batched
+device lookup in a bounded number of gathers.
+
+The device analog of BPF_MAP_TYPE_HASH for multi-word keys (CT tuples,
+LB service keys).  Build keeps load factor ≤ 0.5 and records the
+maximum linear displacement, so the device probe loop is a FIXED
+unroll (max_disp + 1 slots) — bounded like the kernel's map probe,
+no data-dependent control flow under jit.
+
+Key layout: u32 [C, KW]; empty slots hold the all-ones key (callers
+must never insert it).  Hash: FNV-1a over the key words, computed
+identically on host (build) and device (probe).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import numpy as np
+
+EMPTY = np.uint32(0xFFFFFFFF)
+
+FNV_OFFSET = np.uint32(2166136261)
+FNV_PRIME = np.uint32(16777619)
+
+
+def _fnv1a_host(words: np.ndarray) -> np.ndarray:
+    """FNV-1a over u32 words, vectorized: words [N, KW] → u32 [N]."""
+    h = np.full(words.shape[0], FNV_OFFSET, dtype=np.uint64)
+    for w in range(words.shape[1]):
+        for shift in (0, 8, 16, 24):
+            byte = (words[:, w].astype(np.uint64) >> shift) & 0xFF
+            h = ((h ^ byte) * np.uint64(int(FNV_PRIME))) & 0xFFFFFFFF
+    return h.astype(np.uint32)
+
+
+def fnv1a_device(words) -> "jax.Array":
+    """Same hash under jit: words u32 [B, KW] → u32 [B]."""
+    import jax.numpy as jnp
+
+    h = jnp.full(words.shape[0], FNV_OFFSET, dtype=jnp.uint32)
+    for w in range(words.shape[1]):
+        for shift in (0, 8, 16, 24):
+            byte = (words[:, w] >> shift) & jnp.uint32(0xFF)
+            h = (h ^ byte) * FNV_PRIME
+    return h
+
+
+@dataclass
+class HashTable:
+    """Pytree: keys u32 [C, KW], value_index i32 [C], plus the static
+    probe bound."""
+
+    keys: np.ndarray
+    value_index: np.ndarray
+    max_probes: int
+
+    def tree_flatten(self):
+        return ((self.keys, self.value_index), self.max_probes)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            HashTable,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: HashTable.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+def build_hash_table(keys: np.ndarray, min_capacity: int = 16) -> HashTable:
+    """keys u32 [N, KW] (unique) → open-addressed table, linear
+    probing, load ≤ 0.5.  value_index[slot] = row in `keys`."""
+    n, kw = keys.shape
+    capacity = min_capacity
+    while capacity < 2 * max(n, 1):
+        capacity *= 2
+    mask = capacity - 1
+
+    table_keys = np.full((capacity, kw), EMPTY, dtype=np.uint32)
+    value_index = np.full(capacity, -1, dtype=np.int32)
+    hashes = _fnv1a_host(keys.astype(np.uint32))
+    max_disp = 0
+    for i in range(n):
+        slot = int(hashes[i]) & mask
+        disp = 0
+        while value_index[slot] >= 0:
+            slot = (slot + 1) & mask
+            disp += 1
+        table_keys[slot] = keys[i]
+        value_index[slot] = i
+        max_disp = max(max_disp, disp)
+    return HashTable(
+        keys=table_keys, value_index=value_index, max_probes=max_disp + 1
+    )
+
+
+def lookup_batch(table: HashTable, query: "jax.Array"):
+    """query u32 [B, KW] → (found bool [B], index i32 [B]).
+
+    Fixed max_probes-step linear probe; each step is KW gathers + a
+    compare.  `index` is the row passed to build_hash_table (-1-safe:
+    callers must gate on `found`)."""
+    import jax.numpy as jnp
+
+    capacity, kw = table.keys.shape
+    mask = jnp.uint32(capacity - 1)
+    h = fnv1a_device(query) & mask
+
+    found = jnp.zeros(query.shape[0], dtype=bool)
+    index = jnp.zeros(query.shape[0], dtype=jnp.int32)
+    keys = jnp.asarray(table.keys)
+    value_index = jnp.asarray(table.value_index)
+    slot = h.astype(jnp.int32)
+    for _ in range(table.max_probes):
+        row = keys[slot]  # [B, KW]
+        hit = jnp.all(row == query, axis=1) & ~found
+        index = jnp.where(hit, value_index[slot], index)
+        found = found | hit
+        slot = (slot + 1) & jnp.int32(capacity - 1)
+    return found, index

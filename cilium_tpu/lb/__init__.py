@@ -1,0 +1,17 @@
+"""Service load balancing (bpf/lib/lb.h + pkg/loadbalancer/service).
+
+Host side manages frontends/backends with service-ID allocation;
+device side selects backends and produces DNAT rewrites for batches.
+"""
+
+from cilium_tpu.lb.service import L3n4Addr, Service, ServiceManager
+from cilium_tpu.lb.device import LBTables, compile_lb, lb_select_batch
+
+__all__ = [
+    "L3n4Addr",
+    "Service",
+    "ServiceManager",
+    "LBTables",
+    "compile_lb",
+    "lb_select_batch",
+]

@@ -1,0 +1,170 @@
+"""Device LB: batched service lookup + backend selection + DNAT.
+
+Reproduces the datapath semantics of bpf/lib/lb.h:
+  - lb4_lookup_service (lb.h:604): exact (vip, dport, proto) match —
+    here a device hash-table probe;
+  - lb4_select_slave (lb.h:158): `slave = (hash % count) + 1` on the
+    flow hash (lb.h:185).  The kernel uses skb->hash (kernel jhash);
+    we use the same FNV-1a flow hash as the CT table — the invariant
+    that matters (stable per-flow backend, uniform spread) is
+    preserved, the exact hash function is kernel-internal either way;
+  - established flows reuse ct_state.slave instead of re-hashing
+    (lb.h lb4_local path) — pass `ct_slave` from the CT lookup;
+  - DNAT: daddr/dport rewritten to the chosen backend; rev_nat_index
+    returned for the CT entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+from cilium_tpu.engine.hashtable import (
+    HashTable,
+    build_hash_table,
+    fnv1a_device,
+    lookup_batch,
+)
+from cilium_tpu.lb.service import ServiceManager
+
+MAX_BACKENDS = 64
+
+
+@dataclass
+class LBTables:
+    """svc hash table over (vip, port<<8|proto) + backend matrix."""
+
+    table: HashTable
+    svc_rev_nat: np.ndarray  # u16 [S]
+    svc_count: np.ndarray  # i32 [S] backend count
+    backend_ip: np.ndarray  # u32 [S, MAX_BACKENDS]
+    backend_port: np.ndarray  # u16 [S, MAX_BACKENDS]
+
+    def tree_flatten(self):
+        return (
+            (
+                self.table,
+                self.svc_rev_nat,
+                self.svc_count,
+                self.backend_ip,
+                self.backend_port,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            LBTables,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: LBTables.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+def compile_lb(mgr: ServiceManager) -> LBTables:
+    services = sorted(mgr.by_frontend.values(), key=lambda s: s.id)
+    s = max(len(services), 1)
+    keys = np.zeros((len(services), 2), dtype=np.uint32)
+    rev_nat = np.zeros(s, dtype=np.uint16)
+    count = np.zeros(s, dtype=np.int32)
+    backend_ip = np.zeros((s, MAX_BACKENDS), dtype=np.uint32)
+    backend_port = np.zeros((s, MAX_BACKENDS), dtype=np.uint16)
+    for i, svc in enumerate(services):
+        if len(svc.backends) > MAX_BACKENDS:
+            raise ValueError(
+                f"service {svc.frontend} has more than {MAX_BACKENDS} "
+                f"backends"
+            )
+        keys[i, 0] = svc.frontend.ip_u32()
+        keys[i, 1] = (svc.frontend.port << 8) | svc.frontend.protocol
+        rev_nat[i] = svc.id
+        count[i] = len(svc.backends)
+        for j, backend in enumerate(svc.backends):
+            backend_ip[i, j] = backend.addr.ip_u32()
+            backend_port[i, j] = backend.addr.port
+    table = build_hash_table(keys)
+    return LBTables(
+        table=table,
+        svc_rev_nat=rev_nat,
+        svc_count=count,
+        backend_ip=backend_ip,
+        backend_port=backend_port,
+    )
+
+
+def flow_hash(saddr, daddr, sport, dport, proto):
+    """The flow hash used for slave selection (≙ get_hash_recalc)."""
+    import jax.numpy as jnp
+
+    words = jnp.stack(
+        [
+            saddr.astype(jnp.uint32),
+            daddr.astype(jnp.uint32),
+            (sport.astype(jnp.uint32) << 16) | dport.astype(jnp.uint32),
+            proto.astype(jnp.uint32),
+        ],
+        axis=1,
+    )
+    return fnv1a_device(words)
+
+
+def lb_select_batch(
+    tables: LBTables,
+    saddr,
+    daddr,
+    sport,
+    dport,
+    proto,
+    ct_slave=None,
+):
+    """Returns (is_service bool [B], slave i32 [B], new_daddr u32 [B],
+    new_dport i32 [B], rev_nat i32 [B]).  Non-service flows pass
+    through with their original daddr/dport and rev_nat 0."""
+    import jax.numpy as jnp
+
+    query = jnp.stack(
+        [
+            daddr.astype(jnp.uint32),
+            (dport.astype(jnp.uint32) << 8) | proto.astype(jnp.uint32),
+        ],
+        axis=1,
+    )
+    found, svc_idx = lookup_batch(tables.table, query)
+    count = jnp.asarray(tables.svc_count)[svc_idx]
+    found = found & (count > 0)
+
+    h = flow_hash(saddr, daddr, sport, dport, proto)
+    slave = (h % jnp.maximum(count, 1).astype(jnp.uint32)).astype(
+        jnp.int32
+    ) + 1
+    if ct_slave is not None:
+        # established flows stick to their backend (lb4_local)
+        reuse = (ct_slave > 0) & (ct_slave <= count)
+        slave = jnp.where(reuse, ct_slave, slave)
+
+    backend = jnp.clip(slave - 1, 0, MAX_BACKENDS - 1)
+    new_daddr = jnp.asarray(tables.backend_ip)[svc_idx, backend]
+    new_dport = jnp.asarray(tables.backend_port)[svc_idx, backend].astype(
+        jnp.int32
+    )
+    rev_nat = jnp.asarray(tables.svc_rev_nat)[svc_idx].astype(jnp.int32)
+
+    new_daddr = jnp.where(found, new_daddr, daddr.astype(jnp.uint32))
+    new_dport = jnp.where(found, new_dport, dport.astype(jnp.int32))
+    rev_nat = jnp.where(found, rev_nat, 0)
+    slave = jnp.where(found, slave, 0)
+    return found, slave, new_daddr, new_dport, rev_nat

@@ -1,0 +1,75 @@
+"""Host service model.
+
+Behavioral port of /root/reference/pkg/loadbalancer (L3n4Addr,
+LBSVC), pkg/service (service ID allocation) and the lbmap layout
+(pkg/maps/lbmap: master slot 0 holds the backend count, slots 1..N
+hold backends; RevNAT map id → frontend for reply rewriting).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class L3n4Addr:
+    """pkg/loadbalancer L3n4Addr: ip + port + proto."""
+
+    ip: str
+    port: int
+    protocol: int = 6
+
+    def ip_u32(self) -> int:
+        return int(ipaddress.IPv4Address(self.ip))
+
+
+@dataclass
+class Backend:
+    addr: L3n4Addr
+    weight: int = 0
+
+
+@dataclass
+class Service:
+    frontend: L3n4Addr
+    backends: List[Backend] = field(default_factory=list)
+    id: int = 0  # service / rev-NAT id
+
+
+class ServiceManager:
+    """pkg/service: frontend → service with stable id allocation (the
+    id doubles as the rev_nat_index stored in CT entries)."""
+
+    def __init__(self) -> None:
+        self.by_frontend: Dict[L3n4Addr, Service] = {}
+        self.by_id: Dict[int, Service] = {}
+        self._next_id = 1
+
+    def upsert(
+        self, frontend: L3n4Addr, backends: List[L3n4Addr]
+    ) -> Service:
+        svc = self.by_frontend.get(frontend)
+        if svc is None:
+            svc = Service(frontend=frontend, id=self._next_id)
+            self._next_id += 1
+            self.by_frontend[frontend] = svc
+            self.by_id[svc.id] = svc
+        svc.backends = [Backend(b) for b in backends]
+        return svc
+
+    def delete(self, frontend: L3n4Addr) -> bool:
+        svc = self.by_frontend.pop(frontend, None)
+        if svc is None:
+            return False
+        self.by_id.pop(svc.id, None)
+        return True
+
+    def lookup(self, frontend: L3n4Addr) -> Optional[Service]:
+        return self.by_frontend.get(frontend)
+
+    def rev_nat(self, rev_nat_index: int) -> Optional[L3n4Addr]:
+        """RevNAT map: id → frontend (reply-path source rewrite)."""
+        svc = self.by_id.get(rev_nat_index)
+        return svc.frontend if svc else None

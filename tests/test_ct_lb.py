@@ -1,0 +1,264 @@
+"""Conntrack state machine + device CT snapshot + LB selection."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.ct import (
+    CT_ESTABLISHED,
+    CT_NEW,
+    CT_RELATED,
+    CT_REPLY,
+    CTMap,
+    CTTuple,
+)
+from cilium_tpu.ct.device import (
+    apply_new_flows,
+    compile_ct,
+    ct_lookup_batch,
+)
+from cilium_tpu.ct.table import (
+    CT_CLOSE_TIMEOUT,
+    CT_DEFAULT_LIFETIME_TCP,
+    CT_EGRESS,
+    CT_INGRESS,
+    CT_SYN_TIMEOUT,
+    CTState,
+)
+from cilium_tpu.engine.hashtable import build_hash_table, lookup_batch
+from cilium_tpu.lb import (
+    L3n4Addr,
+    ServiceManager,
+    compile_lb,
+    lb_select_batch,
+)
+
+
+def tup(daddr=0x0A000001, saddr=0x0A000002, dport=80, sport=5555, proto=6):
+    return CTTuple(daddr, saddr, dport, sport, proto)
+
+
+def test_ct_new_create_established_reply():
+    ct = CTMap()
+    t = tup()
+    assert ct.lookup(t, CT_INGRESS, now=100) == CT_NEW
+    ct.create(t, CT_INGRESS, now=100, rev_nat_index=3, tcp_syn=True)
+    assert ct.lookup(t, CT_INGRESS, now=101) == CT_ESTABLISHED
+
+    # reply direction: the reverse packet (egress from the responder)
+    reply = CTTuple(t.saddr, t.daddr, t.sport, t.dport, t.nexthdr)
+    state = CTState()
+    assert (
+        ct.lookup(reply, CT_EGRESS, now=102, ct_state=state) == CT_REPLY
+    )
+    assert state.rev_nat_index == 3
+
+
+def test_ct_tcp_timeout_progression():
+    ct = CTMap()
+    t = tup()
+    entry = ct.create(t, CT_INGRESS, now=100, tcp_syn=True)
+    assert entry.lifetime == 100 + CT_SYN_TIMEOUT  # SYN-only
+    ct.lookup(t, CT_INGRESS, now=110, tcp_syn=False)  # data packet
+    assert entry.seen_non_syn
+    assert entry.lifetime == 110 + CT_DEFAULT_LIFETIME_TCP
+
+    # FIN/RST closes both sides → CLOSE timeout
+    ct.lookup(t, CT_INGRESS, now=120, tcp_fin_or_rst=True)
+    reply = CTTuple(t.saddr, t.daddr, t.sport, t.dport, t.nexthdr)
+    ct.lookup(reply, CT_EGRESS, now=121, tcp_fin_or_rst=True)
+    assert entry.rx_closing and entry.tx_closing
+    assert entry.lifetime == 121 + CT_CLOSE_TIMEOUT
+
+    # GC reaps expired entries
+    assert ct.gc(now=entry.lifetime + 1) == 1
+    assert not ct.entries
+
+
+def test_ct_related_icmp():
+    ct = CTMap()
+    t = tup(proto=6)
+    ct.create(t, CT_INGRESS, now=0)
+    # ICMP error about the reverse flow → RELATED
+    icmp = CTTuple(t.saddr, t.daddr, t.sport, t.dport, t.nexthdr)
+    # related entries are probed with the RELATED flag; create one:
+    from cilium_tpu.ct.table import TUPLE_F_OUT, TUPLE_F_RELATED
+
+    rel_key = CTTuple(
+        t.daddr, t.saddr, t.dport, t.sport, t.nexthdr,
+        TUPLE_F_OUT | TUPLE_F_RELATED,
+    )
+    from cilium_tpu.ct.table import CTEntry
+
+    ct.entries[rel_key] = CTEntry(lifetime=1000)
+    got = ct.lookup(icmp, CT_EGRESS, now=1, related_icmp=True)
+    assert got == CT_RELATED
+
+
+def test_ct_accounting_directions():
+    ct = CTMap()
+    t = tup()
+    entry = ct.create(t, CT_INGRESS, now=0)
+    ct.lookup(t, CT_INGRESS, now=1, pkt_len=100)
+    assert (entry.rx_packets, entry.rx_bytes) == (1, 100)
+    reply = CTTuple(t.saddr, t.daddr, t.sport, t.dport, t.nexthdr)
+    ct.lookup(reply, CT_EGRESS, now=2, pkt_len=60)
+    assert (entry.tx_packets, entry.tx_bytes) == (1, 60)
+
+
+def test_hashtable_roundtrip():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 32, size=(500, 4), dtype=np.uint64).astype(
+        np.uint32
+    )
+    keys = np.unique(keys, axis=0)
+    table = build_hash_table(keys)
+    found, idx = lookup_batch(table, jnp.asarray(keys))
+    assert bool(np.asarray(found).all())
+    np.testing.assert_array_equal(
+        np.asarray(idx), np.arange(len(keys))
+    )
+    # misses
+    miss = keys.copy()
+    miss[:, 0] ^= 0xDEADBEEF
+    found2, _ = lookup_batch(table, jnp.asarray(miss))
+    # (collision with a real key is astronomically unlikely here)
+    assert not bool(np.asarray(found2).any())
+
+
+def test_ct_device_snapshot_matches_host():
+    rng = np.random.default_rng(1)
+    ct = CTMap()
+    flows = []
+    for _ in range(64):
+        t = tup(
+            daddr=int(rng.integers(1, 1 << 32)),
+            saddr=int(rng.integers(1, 1 << 32)),
+            dport=int(rng.integers(1, 65536)),
+            sport=int(rng.integers(1, 65536)),
+        )
+        d = int(rng.integers(0, 2))
+        ct.create(t, d, now=0)
+        flows.append((t, d))
+
+    snapshot = compile_ct(ct)
+    b = 256
+    probes = []
+    for _ in range(b):
+        if rng.random() < 0.5:
+            t, d = flows[int(rng.integers(0, len(flows)))]
+            if rng.random() < 0.5:
+                # reply-direction probe
+                t = CTTuple(t.saddr, t.daddr, t.sport, t.dport, t.nexthdr)
+                d = 1 - d
+        else:
+            t = tup(daddr=int(rng.integers(1, 1 << 32)))
+            d = int(rng.integers(0, 2))
+        probes.append((t, d))
+
+    daddr = np.array([t.daddr for t, _ in probes], dtype=np.uint32)
+    saddr = np.array([t.saddr for t, _ in probes], dtype=np.uint32)
+    dport = np.array([t.dport for t, _ in probes], dtype=np.int32)
+    sport = np.array([t.sport for t, _ in probes], dtype=np.int32)
+    proto = np.array([t.nexthdr for t, _ in probes], dtype=np.int32)
+    direction = np.array([d for _, d in probes], dtype=np.int32)
+
+    result, rev_nat, slave = ct_lookup_batch(
+        snapshot,
+        jnp.asarray(daddr), jnp.asarray(saddr), jnp.asarray(dport),
+        jnp.asarray(sport), jnp.asarray(proto), jnp.asarray(direction),
+    )
+    got = np.asarray(result)
+    import copy
+
+    for i, (t, d) in enumerate(probes):
+        want = copy.deepcopy(ct).lookup(t, d, now=1)
+        assert got[i] == want, (i, t, d)
+
+
+def test_apply_new_flows_dedupes():
+    ct = CTMap()
+    results = np.array([CT_NEW, CT_NEW, CT_ESTABLISHED], dtype=np.uint8)
+    daddr = np.array([1, 1, 2], dtype=np.uint32)
+    saddr = np.array([9, 9, 9], dtype=np.uint32)
+    dport = np.array([80, 80, 80])
+    sport = np.array([5, 5, 5])
+    proto = np.array([6, 6, 6])
+    direction = np.array([0, 0, 0])
+    n = apply_new_flows(
+        ct, results, daddr, saddr, dport, sport, proto, direction, now=0
+    )
+    assert n == 1 and len(ct.entries) == 1
+
+
+def test_lb_selection_and_dnat():
+    mgr = ServiceManager()
+    svc = mgr.upsert(
+        L3n4Addr("10.96.0.10", 80),
+        [L3n4Addr("10.0.1.1", 8080), L3n4Addr("10.0.1.2", 8080),
+         L3n4Addr("10.0.1.3", 8080)],
+    )
+    mgr.upsert(L3n4Addr("10.96.0.11", 443), [L3n4Addr("10.0.2.1", 8443)])
+    tables = compile_lb(mgr)
+
+    import ipaddress
+
+    vip = int(ipaddress.IPv4Address("10.96.0.10"))
+    other = int(ipaddress.IPv4Address("8.8.8.8"))
+    b = 512
+    rng = np.random.default_rng(0)
+    saddr = rng.integers(1, 1 << 32, size=b).astype(np.uint32)
+    daddr = np.full(b, vip, dtype=np.uint32)
+    daddr[::8] = other  # non-service flows pass through
+    sport = rng.integers(1024, 65535, size=b).astype(np.int32)
+    dport = np.full(b, 80, dtype=np.int32)
+    proto = np.full(b, 6, dtype=np.int32)
+
+    is_svc, slave, new_daddr, new_dport, rev_nat = lb_select_batch(
+        tables,
+        jnp.asarray(saddr), jnp.asarray(daddr), jnp.asarray(sport),
+        jnp.asarray(dport), jnp.asarray(proto),
+    )
+    is_svc = np.asarray(is_svc)
+    slave = np.asarray(slave)
+    new_daddr = np.asarray(new_daddr)
+    rev_nat = np.asarray(rev_nat)
+
+    assert not is_svc[::8].any() and is_svc[1::8].all()
+    # pass-through untouched
+    np.testing.assert_array_equal(new_daddr[::8], daddr[::8])
+    assert (rev_nat[::8] == 0).all()
+    # service flows: slave in 1..3, daddr rewritten to a backend,
+    # rev_nat = service id
+    sel = is_svc
+    assert ((slave[sel] >= 1) & (slave[sel] <= 3)).all()
+    backends = {
+        int(ipaddress.IPv4Address(a))
+        for a in ("10.0.1.1", "10.0.1.2", "10.0.1.3")
+    }
+    assert set(new_daddr[sel].tolist()) <= backends
+    assert (rev_nat[sel] == svc.id).all()
+    # spread: all three backends used
+    assert len(set(slave[sel].tolist())) == 3
+
+    # same flow → same backend (determinism)
+    is_svc2, slave2, *_ = lb_select_batch(
+        tables,
+        jnp.asarray(saddr), jnp.asarray(daddr), jnp.asarray(sport),
+        jnp.asarray(dport), jnp.asarray(proto),
+    )
+    np.testing.assert_array_equal(slave, np.asarray(slave2))
+
+    # established flows stick to ct_state.slave
+    ct_slave = np.full(b, 2, dtype=np.int32)
+    _, slave3, new_daddr3, _, _ = lb_select_batch(
+        tables,
+        jnp.asarray(saddr), jnp.asarray(daddr), jnp.asarray(sport),
+        jnp.asarray(dport), jnp.asarray(proto),
+        ct_slave=jnp.asarray(ct_slave),
+    )
+    assert (np.asarray(slave3)[sel] == 2).all()
+
+    # rev-NAT map
+    assert mgr.rev_nat(svc.id) == L3n4Addr("10.96.0.10", 80)
