@@ -33,9 +33,9 @@ MATCH_L3 = 2  # probe 2 hit
 MATCH_L4_WILD = 3  # probe 3 hit
 MATCH_FRAG_DROP = 4  # DROP_FRAG_NOSUPPORT
 
-# Drop reason codes (bpf/lib/common.h drop codes, negative returns).
+# Drop reason codes (bpf/lib/common.h:240,264; negative returns).
 DROP_POLICY = -133
-DROP_FRAG_NOSUPPORT = -138
+DROP_FRAG_NOSUPPORT = -157
 
 
 @dataclass

@@ -1,0 +1,186 @@
+"""Metrics registry.
+
+Re-design of /root/reference/pkg/metrics/metrics.go: the same metric
+names and label sets, over a minimal in-process registry with
+Prometheus text exposition (an HTTP exporter can serve `expose()`
+verbatim; no prometheus client dependency in the image).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+NAMESPACE = "cilium"
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str, labels: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = labels
+        self._values: Dict[Tuple[str, ...], float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, *label_values: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._values[label_values] += value
+
+    def get(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values[label_values]
+
+    def expose(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+        ]
+        with self._lock:
+            for labels, value in sorted(self._values.items()):
+                sel = ",".join(
+                    f'{k}="{v}"' for k, v in zip(self.label_names, labels)
+                )
+                suffix = f"{{{sel}}}" if sel else ""
+                lines.append(f"{self.name}{suffix} {value}")
+        return lines
+
+
+class Gauge(Counter):
+    def set(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            self._values[label_values] = float(value)
+
+    def dec(self, *label_values: str) -> None:
+        self.inc(*label_values, value=-1.0)
+
+    def expose(self) -> List[str]:
+        lines = super().expose()
+        lines[1] = f"# TYPE {self.name} gauge"
+        return lines
+
+
+class Histogram:
+    """Fixed-bucket histogram (regeneration seconds etc.)."""
+
+    DEFAULT_BUCKETS = (
+        0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+    )
+
+    def __init__(self, name: str, help_text: str, buckets=None):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def expose(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cumulative = 0
+        with self._lock:
+            for b, c in zip(self.buckets, self._counts):
+                cumulative += c
+                lines.append(
+                    f'{self.name}_bucket{{le="{b}"}} {cumulative}'
+                )
+            lines.append(
+                f'{self.name}_bucket{{le="+Inf"}} {self._n}'
+            )
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._n}")
+        return lines
+
+
+class Registry:
+    """pkg/metrics/metrics.go:120-278 metric set."""
+
+    def __init__(self) -> None:
+        ns = NAMESPACE
+        self.endpoint_count_regenerating = Gauge(
+            f"{ns}_endpoint_regenerating",
+            "Number of endpoints currently regenerating",
+        )
+        self.endpoint_regenerations = Counter(
+            f"{ns}_endpoint_regenerations",
+            "Count of all endpoint regenerations that have completed",
+            ("outcome",),
+        )
+        self.endpoint_regeneration_seconds = Histogram(
+            f"{ns}_endpoint_regeneration_seconds",
+            "Endpoint regeneration time",
+        )
+        self.endpoint_state_count = Gauge(
+            f"{ns}_endpoint_state",
+            "Count of all endpoints by state",
+            ("endpoint_state",),
+        )
+        self.policy_count = Gauge(
+            f"{ns}_policy_count", "Number of policies currently loaded"
+        )
+        self.policy_regeneration_count = Counter(
+            f"{ns}_policy_regeneration_total",
+            "Total number of policies regenerated successfully",
+        )
+        self.policy_revision = Gauge(
+            f"{ns}_policy_max_revision",
+            "Highest policy revision number in the agent",
+        )
+        self.policy_import_errors = Counter(
+            f"{ns}_policy_import_errors",
+            "Number of times a policy import has failed",
+        )
+        self.proxy_redirects = Gauge(
+            f"{ns}_proxy_redirects",
+            "Number of redirects installed for endpoints",
+            ("protocol",),
+        )
+        self.policy_l7_total = Counter(
+            f"{ns}_policy_l7_total",
+            "Number of total L7 requests/responses",
+            ("rule",),  # received|forwarded|denied|parse_errors
+        )
+        self.drop_count = Counter(
+            f"{ns}_drop_count_total",
+            "Total dropped packets by reason and direction",
+            ("reason", "direction"),
+        )
+        self.forward_count = Counter(
+            f"{ns}_forward_count_total",
+            "Total forwarded packets by direction",
+            ("direction",),
+        )
+        self.event_ts = Gauge(
+            f"{ns}_event_ts",
+            "Last timestamp when we received an event",
+            ("source",),
+        )
+        self.verdict_throughput = Gauge(
+            f"{ns}_verdicts_per_second",
+            "Device verdict throughput (TPU-native metric)",
+        )
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for attr in vars(self).values():
+            if isinstance(attr, (Counter, Gauge, Histogram)):
+                lines.extend(attr.expose())
+        return "\n".join(lines) + "\n"
+
+
+# process-global registry, like pkg/metrics's default registry
+registry = Registry()

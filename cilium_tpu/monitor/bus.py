@@ -1,0 +1,114 @@
+"""The monitor event bus + verdict-batch folding.
+
+Fan-out mirrors monitor/monitor.go: the node monitor reads the perf
+ring and multiplexes to subscribed listeners; slow listeners in the
+reference get disconnected — here `lost_events` counts what a bounded
+subscriber queue dropped (the perf ring's lost-samples counter,
+pkg/bpf/perf.go).
+
+`verdicts_to_events` folds a batched engine output into DropNotify /
+PolicyVerdictNotify events host-side.  The datapath stays batched; the
+event bus is a control-plane consumer, so the per-event Python cost
+only applies to the (sampled or denied) slice that gets folded.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+import numpy as np
+
+from cilium_tpu.engine.oracle import MATCH_NONE, MATCH_FRAG_DROP
+from cilium_tpu.monitor.events import (
+    DropNotify,
+    PolicyVerdictNotify,
+)
+
+DROP_POLICY_CODE = 133  # magnitude of DROP_POLICY (common.h:240)
+DROP_FRAG_CODE = 157  # magnitude of DROP_FRAG_NOSUPPORT (common.h:264)
+
+
+class MonitorBus:
+    def __init__(self, queue_size: int = 65536) -> None:
+        self._lock = threading.Lock()
+        self._subscribers: List[Deque] = []
+        self._callbacks: List[Callable] = []
+        self.queue_size = queue_size
+        self.lost_events = 0
+
+    def subscribe_queue(self) -> Deque:
+        """Bounded queue subscriber; overflow counts lost events."""
+        q: Deque = deque(maxlen=self.queue_size)
+        with self._lock:
+            self._subscribers.append(q)
+        return q
+
+    def subscribe(self, fn: Callable) -> None:
+        with self._lock:
+            self._callbacks.append(fn)
+
+    def publish(self, event) -> None:
+        with self._lock:
+            for q in self._subscribers:
+                if len(q) == q.maxlen:
+                    self.lost_events += 1
+                q.append(event)
+            callbacks = list(self._callbacks)
+        for fn in callbacks:
+            fn(event)
+
+
+def verdicts_to_events(
+    bus: MonitorBus,
+    verdicts,
+    ep_ids: np.ndarray,
+    identities: np.ndarray,
+    dports: np.ndarray,
+    protos: np.ndarray,
+    directions: np.ndarray,
+    emit_allowed: bool = False,
+) -> int:
+    """Fold a batch: denied tuples → DropNotify (+ verdict events when
+    PolicyVerdictNotification is on / emit_allowed).  Returns the
+    number of events published."""
+    allowed = np.asarray(verdicts.allowed)
+    kind = np.asarray(verdicts.match_kind)
+    proxy = np.asarray(verdicts.proxy_port)
+    n = 0
+    idx = (
+        np.arange(len(allowed))
+        if emit_allowed
+        else np.nonzero(allowed == 0)[0]
+    )
+    for i in idx:
+        if allowed[i]:
+            bus.publish(
+                PolicyVerdictNotify(
+                    source=int(ep_ids[i]),
+                    src_label=int(identities[i]),
+                    dst_label=0,
+                    dport=int(dports[i]),
+                    proto=int(protos[i]),
+                    ingress=int(directions[i]) == 0,
+                    allowed=True,
+                    proxy_port=int(proxy[i]),
+                    match_kind=int(kind[i]),
+                )
+            )
+        else:
+            reason = (
+                DROP_FRAG_CODE
+                if kind[i] == MATCH_FRAG_DROP
+                else DROP_POLICY_CODE
+            )
+            bus.publish(
+                DropNotify(
+                    source=int(ep_ids[i]),
+                    src_label=int(identities[i]),
+                    reason=reason,
+                )
+            )
+        n += 1
+    return n

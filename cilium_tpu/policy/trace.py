@@ -1,0 +1,84 @@
+"""Policy trace / explain mode.
+
+The reference exposes decision tracing at two levels, both kept here:
+  - rule-level: `cilium policy trace` / GET /policy/resolve
+    (daemon/policy.go:66) runs the repository verdict with
+    SearchContext.Trace enabled and returns the decision plus the
+    human-readable trace buffer (pkg/policy/policy.go:39-61);
+  - datapath-level: per-tuple attribution — which policy-map entry
+    (exact / L3-only / wildcard probe) produced the verdict
+    (the per-entry counters of bpf/lib/policy.h:66 made queryable).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Tuple
+
+from cilium_tpu.engine.oracle import (
+    MATCH_FRAG_DROP,
+    MATCH_L3,
+    MATCH_L4,
+    MATCH_L4_WILD,
+    policy_can_access,
+)
+from cilium_tpu.maps.policymap import PolicyMapState
+from cilium_tpu.policy.search import Decision, SearchContext, Tracing
+
+
+def trace_policy(repo, ctx: SearchContext, verbose: bool = False):
+    """GET /policy/resolve (daemon/policy.go:66): ingress verdict with
+    a populated trace buffer.  Returns (Decision, trace_text)."""
+    ctx.trace = Tracing.VERBOSE if verbose else Tracing.ENABLED
+    if ctx.logging is None:
+        ctx.logging = io.StringIO()
+    verdict = repo.allows_ingress(ctx)
+    return verdict, ctx.trace_output()
+
+
+def explain_tuple(
+    state: PolicyMapState,
+    identity: int,
+    dport: int,
+    proto: int,
+    direction: int,
+    is_fragment: bool = False,
+) -> Tuple[bool, str]:
+    """Datapath attribution for one tuple against one endpoint's map
+    state: which probe of the 3-probe lattice decided, and on which
+    entry."""
+    import copy
+
+    verdict = policy_can_access(
+        copy.deepcopy(state), identity, dport, proto, direction,
+        is_fragment,
+    )
+    direction_name = "ingress" if direction == 0 else "egress"
+    if verdict.match_kind == MATCH_L4:
+        why = (
+            f"L4 exact entry ({identity}, {dport}/{proto}, "
+            f"{direction_name})"
+            + (
+                f" → proxy port {verdict.proxy_port}"
+                if verdict.proxy_port
+                else ""
+            )
+        )
+    elif verdict.match_kind == MATCH_L3:
+        why = f"L3-only entry ({identity}, {direction_name})"
+    elif verdict.match_kind == MATCH_L4_WILD:
+        why = (
+            f"L4 wildcard entry (any identity, {dport}/{proto}, "
+            f"{direction_name})"
+            + (
+                f" → proxy port {verdict.proxy_port}"
+                if verdict.proxy_port
+                else ""
+            )
+        )
+    elif verdict.match_kind == MATCH_FRAG_DROP:
+        why = "fragment without L3-only allow (DROP_FRAG_NOSUPPORT)"
+    else:
+        why = "no matching entry (DROP_POLICY)"
+    action = "ALLOW" if verdict.allowed else "DENY"
+    return verdict.allowed, f"{action}: {why}"

@@ -1,0 +1,56 @@
+"""Duration accumulators for regeneration phases.
+
+Port of /root/reference/pkg/spanstat: Start/End accumulate success and
+failure totals separately; pkg/endpoint/policy.go:689-699 logs one
+SpanStat per regeneration phase (policy calculation, map sync, table
+compile, total).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class SpanStat:
+    def __init__(self) -> None:
+        self.success_total = 0.0
+        self.failure_total = 0.0
+        self.num_success = 0
+        self.num_failure = 0
+        self._start: Optional[float] = None
+
+    def start(self) -> "SpanStat":
+        self._start = time.perf_counter()
+        return self
+
+    def end(self, success: bool = True) -> "SpanStat":
+        if self._start is None:
+            return self
+        d = time.perf_counter() - self._start
+        self._start = None
+        if success:
+            self.success_total += d
+            self.num_success += 1
+        else:
+            self.failure_total += d
+            self.num_failure += 1
+        return self
+
+    def total(self) -> float:
+        return self.success_total + self.failure_total
+
+    def seconds(self) -> float:
+        return self.total()
+
+
+class SpanStats(dict):
+    """Named phase map (regenerationStatistics, pkg/endpoint/policy.go)."""
+
+    def span(self, name: str) -> SpanStat:
+        if name not in self:
+            self[name] = SpanStat()
+        return self[name]
+
+    def report(self) -> Dict[str, float]:
+        return {name: s.total() for name, s in self.items()}

@@ -1,0 +1,144 @@
+"""Monitor bus, metrics registry, spanstat, policy trace/explain."""
+
+import numpy as np
+
+from cilium_tpu.metrics import Registry
+from cilium_tpu.monitor import (
+    DropNotify,
+    MonitorBus,
+    PolicyVerdictNotify,
+    drop_reason_name,
+    verdicts_to_events,
+)
+from cilium_tpu.spanstat import SpanStat, SpanStats
+
+
+def test_drop_reason_names():
+    assert drop_reason_name(-133) == "Policy denied (L3)"
+    assert drop_reason_name(-157) == "Fragmentation needed"
+    assert "unknown" in drop_reason_name(-999)
+
+
+def test_bus_fanout_and_loss_accounting():
+    bus = MonitorBus(queue_size=2)
+    q = bus.subscribe_queue()
+    seen = []
+    bus.subscribe(seen.append)
+    for i in range(5):
+        bus.publish(DropNotify(source=i))
+    assert len(seen) == 5
+    assert len(q) == 2  # bounded
+    assert bus.lost_events == 3  # perf-ring lost counter analog
+
+
+def test_verdicts_to_events():
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    state = {PolicyKey(256, 80, 6, INGRESS): PolicyMapStateEntry()}
+    tables = compile_map_states([state], [256], 32, 8)
+    batch = TupleBatch.from_numpy(
+        ep_index=[0, 0],
+        identity=[256, 256],
+        dport=[80, 443],
+        proto=[6, 6],
+        direction=[INGRESS, INGRESS],
+    )
+    verdicts = evaluate_batch(tables, batch)
+
+    bus = MonitorBus()
+    events = []
+    bus.subscribe(events.append)
+    n = verdicts_to_events(
+        bus,
+        verdicts,
+        ep_ids=np.array([42, 42]),
+        identities=np.array([256, 256]),
+        dports=np.array([80, 443]),
+        protos=np.array([6, 6]),
+        directions=np.array([0, 0]),
+        emit_allowed=True,
+    )
+    assert n == 2
+    assert isinstance(events[0], PolicyVerdictNotify) and events[0].allowed
+    assert isinstance(events[1], DropNotify)
+    assert events[1].reason == 133 and events[1].src_label == 256
+
+
+def test_metrics_registry_exposition():
+    r = Registry()
+    r.endpoint_regenerations.inc("success")
+    r.endpoint_regenerations.inc("success")
+    r.endpoint_regenerations.inc("fail")
+    r.drop_count.inc("Policy denied (L3)", "ingress", value=7)
+    r.endpoint_regeneration_seconds.observe(0.2)
+    r.policy_count.set(3)
+    text = r.expose()
+    assert 'cilium_endpoint_regenerations{outcome="success"} 2.0' in text
+    assert 'cilium_drop_count_total{reason="Policy denied (L3)",direction="ingress"} 7.0' in text
+    assert "cilium_endpoint_regeneration_seconds_count 1" in text
+    assert "cilium_policy_count 3.0" in text
+
+
+def test_spanstat():
+    s = SpanStat()
+    s.start()
+    s.end(success=True)
+    s.start()
+    s.end(success=False)
+    assert s.num_success == 1 and s.num_failure == 1
+    assert s.total() >= 0
+
+    stats = SpanStats()
+    stats.span("policyCalculation").start()
+    stats.span("policyCalculation").end()
+    assert "policyCalculation" in stats.report()
+
+
+def test_trace_policy_and_explain():
+    from cilium_tpu.labels import LabelArray, parse_select_label
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+    from cilium_tpu.policy.api import EndpointSelector, IngressRule, Rule
+    from cilium_tpu.policy.repository import Repository
+    from cilium_tpu.policy.search import Decision, SearchContext
+    from cilium_tpu.policy.trace import explain_tuple, trace_policy
+
+    def es(label):
+        return EndpointSelector.from_labels(parse_select_label(label))
+
+    repo = Repository()
+    repo.add(
+        Rule(
+            endpoint_selector=es("app=bar"),
+            ingress=[IngressRule(from_endpoints=[es("app=foo")])],
+        )
+    )
+    ctx = SearchContext(
+        from_labels=LabelArray.parse_select("app=foo"),
+        to_labels=LabelArray.parse_select("app=bar"),
+    )
+    verdict, text = trace_policy(repo, ctx)
+    assert verdict == Decision.ALLOWED
+    assert "Found allow rule" in text or "allow" in text.lower()
+
+    state = {
+        PolicyKey(256, 80, 6, INGRESS): PolicyMapStateEntry(proxy_port=15001),
+        PolicyKey(300, 0, 0, INGRESS): PolicyMapStateEntry(),
+    }
+    allowed, why = explain_tuple(state, 256, 80, 6, INGRESS)
+    assert allowed and "L4 exact" in why and "15001" in why
+    allowed, why = explain_tuple(state, 300, 9999, 6, INGRESS)
+    assert allowed and "L3-only" in why
+    allowed, why = explain_tuple(state, 999, 80, 6, INGRESS)
+    assert not allowed and "DROP_POLICY" in why
+    allowed, why = explain_tuple(state, 256, 80, 6, INGRESS, is_fragment=True)
+    assert not allowed and "fragment" in why.lower()
