@@ -1,0 +1,26 @@
+"""Native runtime components (C++ via ctypes).
+
+Build-on-demand: the shared library compiles with g++ the first time
+it's needed and is cached next to the source (the reference compiles
+its datapath C at runtime too — pkg/datapath/loader/compile.go).  If
+the toolchain is unavailable the pure-NumPy fallbacks in
+`loader` keep everything functional (DryMode analog).
+"""
+
+from cilium_tpu.native.loader import (
+    NativeUnavailable,
+    alignment_check,
+    decode_flow_records,
+    encode_flow_records,
+    native_available,
+    parse_packets,
+)
+
+__all__ = [
+    "decode_flow_records",
+    "encode_flow_records",
+    "parse_packets",
+    "alignment_check",
+    "native_available",
+    "NativeUnavailable",
+]

@@ -1,0 +1,157 @@
+// Native flow-tuple decoder: packets / flow records → SoA tuple arrays.
+//
+// TPU-native equivalent of the reference's native parsing layers: the
+// eBPF header parse of bpf/bpf_lxc.c:718-760 (ethertype dispatch, IPv4
+// header walk, fragment detection, L4 port extraction) and the
+// monitor's payload decoding (pkg/monitor/dissect.go), done in C++ so
+// the replay/ingest path feeds the device at memory bandwidth instead
+// of Python-loop speed.  Compiled by cilium_tpu.native at import time
+// (g++ -O2 -shared), bound via ctypes — no pybind11 in the image.
+//
+// ABI contract: all functions use plain C types over SoA arrays; the
+// struct layouts below are mirrored by ctypes in
+// cilium_tpu/native/loader.py and verified by the alignchecker
+// (analog of pkg/alignchecker: Go-vs-C struct layout verification).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Hubble-style binary flow record, little-endian, 24 bytes.
+struct flow_record {
+    uint32_t ep_id;
+    uint32_t identity;
+    uint32_t saddr;
+    uint32_t daddr;
+    uint16_t sport;
+    uint16_t dport;
+    uint8_t proto;
+    uint8_t direction;
+    uint8_t flags;  // bit0: is_fragment
+    uint8_t pad;
+};
+
+// layout probes for the alignchecker
+size_t flow_record_size() { return sizeof(struct flow_record); }
+size_t flow_record_offset(int field) {
+    switch (field) {
+        case 0: return offsetof(struct flow_record, ep_id);
+        case 1: return offsetof(struct flow_record, identity);
+        case 2: return offsetof(struct flow_record, saddr);
+        case 3: return offsetof(struct flow_record, daddr);
+        case 4: return offsetof(struct flow_record, sport);
+        case 5: return offsetof(struct flow_record, dport);
+        case 6: return offsetof(struct flow_record, proto);
+        case 7: return offsetof(struct flow_record, direction);
+        case 8: return offsetof(struct flow_record, flags);
+        default: return (size_t)-1;
+    }
+}
+
+// Decode n fixed-size flow records into SoA arrays.
+// Returns the number of records decoded.
+size_t decode_flow_records(const uint8_t* buf, size_t n,
+                           uint32_t* ep_id, uint32_t* identity,
+                           uint32_t* saddr, uint32_t* daddr,
+                           uint16_t* sport, uint16_t* dport,
+                           uint8_t* proto, uint8_t* direction,
+                           uint8_t* is_fragment) {
+    const struct flow_record* rec =
+        reinterpret_cast<const struct flow_record*>(buf);
+    for (size_t i = 0; i < n; i++) {
+        ep_id[i] = rec[i].ep_id;
+        identity[i] = rec[i].identity;
+        saddr[i] = rec[i].saddr;
+        daddr[i] = rec[i].daddr;
+        sport[i] = rec[i].sport;
+        dport[i] = rec[i].dport;
+        proto[i] = rec[i].proto;
+        direction[i] = rec[i].direction;
+        is_fragment[i] = rec[i].flags & 1;
+    }
+    return n;
+}
+
+static inline uint16_t load_be16(const uint8_t* p) {
+    return (uint16_t)((p[0] << 8) | p[1]);
+}
+static inline uint32_t load_be32(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+#define ETH_HLEN 14
+#define ETH_P_IP 0x0800
+#define IP_MF_AND_OFFSET 0x3FFF  // IP_MF | IP_OFFSET mask
+
+// Parse n raw Ethernet frames (offsets[i]..offsets[i+1] in buf) into
+// tuple arrays — the from-container parse (bpf_lxc.c:718: ethertype
+// validate → IPv4 header → fragment check → L4 ports; fragments get
+// zeroed ports, matching the datapath's is_fragment handling).
+// Non-IPv4 / truncated frames get proto 0 and valid[i] = 0.
+size_t parse_packets(const uint8_t* buf, const uint64_t* offsets,
+                     size_t n, uint32_t* saddr, uint32_t* daddr,
+                     uint16_t* sport, uint16_t* dport, uint8_t* proto,
+                     uint8_t* is_fragment, uint8_t* valid,
+                     uint32_t* pkt_len) {
+    size_t ok = 0;
+    for (size_t i = 0; i < n; i++) {
+        const uint8_t* pkt = buf + offsets[i];
+        size_t len = (size_t)(offsets[i + 1] - offsets[i]);
+        saddr[i] = daddr[i] = 0;
+        sport[i] = dport[i] = 0;
+        proto[i] = 0;
+        is_fragment[i] = 0;
+        valid[i] = 0;
+        pkt_len[i] = (uint32_t)len;
+        if (len < ETH_HLEN + 20) continue;
+        if (load_be16(pkt + 12) != ETH_P_IP) continue;
+        const uint8_t* ip = pkt + ETH_HLEN;
+        uint8_t ihl = (uint8_t)(ip[0] & 0x0F);
+        if ((ip[0] >> 4) != 4 || ihl < 5) continue;
+        size_t ip_hlen = (size_t)ihl * 4;
+        if (len < ETH_HLEN + ip_hlen) continue;
+        uint16_t frag_off = load_be16(ip + 6);
+        proto[i] = ip[9];
+        saddr[i] = load_be32(ip + 12);
+        daddr[i] = load_be32(ip + 16);
+        if ((frag_off & IP_MF_AND_OFFSET) != 0) {
+            is_fragment[i] = 1;
+        } else if ((proto[i] == 6 || proto[i] == 17) &&
+                   len >= ETH_HLEN + ip_hlen + 4) {
+            const uint8_t* l4 = ip + ip_hlen;
+            sport[i] = load_be16(l4);
+            dport[i] = load_be16(l4 + 2);
+        }
+        valid[i] = 1;
+        ok++;
+    }
+    return ok;
+}
+
+// Encode flow records (test/bench harness generator, C-side so large
+// replay files are produced at full speed too).
+void encode_flow_records(uint8_t* buf, size_t n, const uint32_t* ep_id,
+                         const uint32_t* identity, const uint32_t* saddr,
+                         const uint32_t* daddr, const uint16_t* sport,
+                         const uint16_t* dport, const uint8_t* proto,
+                         const uint8_t* direction,
+                         const uint8_t* is_fragment) {
+    struct flow_record* rec = reinterpret_cast<struct flow_record*>(buf);
+    for (size_t i = 0; i < n; i++) {
+        rec[i].ep_id = ep_id[i];
+        rec[i].identity = identity[i];
+        rec[i].saddr = saddr[i];
+        rec[i].daddr = daddr[i];
+        rec[i].sport = sport[i];
+        rec[i].dport = dport[i];
+        rec[i].proto = proto[i];
+        rec[i].direction = direction[i];
+        rec[i].flags = is_fragment[i] ? 1 : 0;
+        rec[i].pad = 0;
+    }
+}
+
+}  // extern "C"
