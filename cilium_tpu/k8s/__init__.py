@@ -1,0 +1,20 @@
+"""Kubernetes intent translation.
+
+Re-design of /root/reference/pkg/k8s: NetworkPolicy (networking/v1)
+and CiliumNetworkPolicy objects — as plain JSON dicts, since the
+framework has no kube client dependency — translate into api.Rule
+lists; Service/Endpoints rewrite ToServices egress rules into
+ToCIDRSet (RuleTranslator).
+"""
+
+from cilium_tpu.k8s.network_policy import (
+    parse_cilium_network_policy,
+    parse_network_policy,
+)
+from cilium_tpu.k8s.rule_translate import RuleTranslator
+
+__all__ = [
+    "parse_network_policy",
+    "parse_cilium_network_policy",
+    "RuleTranslator",
+]

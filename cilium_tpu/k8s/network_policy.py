@@ -1,0 +1,253 @@
+"""k8s NetworkPolicy / CiliumNetworkPolicy → api.Rule.
+
+Behavioral port of /root/reference/pkg/k8s/network_policy.go
+(ParseNetworkPolicy network_policy.go:127) over JSON dicts:
+  - pod selectors are namespace-scoped by injecting the
+    io.kubernetes.pod.namespace matchLabel (network_policy.go:103,239);
+  - namespace selectors prefix keys with the namespace-meta label
+    space io.cilium.k8s.namespace.labels (network_policy.go:73-80),
+    and an EMPTY namespaceSelector becomes an Exists requirement on
+    the pod-namespace label (select all namespaces, :87-89);
+  - empty from/to matches everything → reserved:all selector (:164);
+  - ipBlock → CIDRRule with excepts (:258);
+  - the k8s default-deny convention (podSelector + policyTypes with
+    no rules) becomes an empty IngressRule/EgressRule (:215-231);
+  - ports: one PortRule per NetworkPolicyPort, TCP default (:264).
+
+CiliumNetworkPolicy (pkg/k8s/apis/cilium.io/v2): spec/specs hold
+api.Rule JSON directly; policy labels identify name+namespace+
+derived-from for deletion by label (GetPolicyLabels, utils.go:54).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from cilium_tpu import labels as lbl
+from cilium_tpu.labels import Label, LabelArray
+from cilium_tpu.policy.api import (
+    CIDRRule,
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+from cilium_tpu.policy.api.parse import rule_from_dict
+from cilium_tpu.policy.api.selector import Requirement, OP_EXISTS
+
+# pkg/k8s/apis/cilium.io/const.go
+POD_NAMESPACE_LABEL = "io.kubernetes.pod.namespace"
+POD_NAMESPACE_META_LABELS = "io.cilium.k8s.namespace.labels"
+POLICY_LABEL_NAME = "io.cilium.k8s.policy.name"
+POLICY_LABEL_NAMESPACE = "io.cilium.k8s.policy.namespace"
+POLICY_LABEL_DERIVED_FROM = "io.cilium.k8s.policy.derived-from"
+
+K8S_PREFIX = lbl.SOURCE_K8S_KEY_PREFIX
+
+
+def _es_from_k8s_selector(*selectors: Optional[dict]) -> EndpointSelector:
+    """NewESFromK8sLabelSelector: merge selectors, prefix keys with the
+    k8s source (selector.go:190)."""
+    match_labels: Dict[str, str] = {}
+    match_expressions: List[Requirement] = []
+    for selector in selectors:
+        if not selector:
+            continue
+        for k, v in (selector.get("matchLabels") or {}).items():
+            match_labels[K8S_PREFIX + k] = v
+        for e in selector.get("matchExpressions") or []:
+            match_expressions.append(
+                Requirement(
+                    K8S_PREFIX + e["key"],
+                    e["operator"],
+                    e.get("values") or [],
+                )
+            )
+    return EndpointSelector(
+        match_labels=match_labels, match_expressions=match_expressions
+    )
+
+
+def _parse_peer(namespace: str, peer: dict) -> Optional[EndpointSelector]:
+    """parseNetworkPolicyPeer (network_policy.go:63)."""
+    ns_sel = peer.get("namespaceSelector")
+    pod_sel = peer.get("podSelector")
+    if ns_sel is not None:
+        prefixed = {
+            "matchLabels": {
+                f"{POD_NAMESPACE_META_LABELS}.{k}": v
+                for k, v in (ns_sel.get("matchLabels") or {}).items()
+            },
+            "matchExpressions": [
+                {**e, "key": f"{POD_NAMESPACE_META_LABELS}.{e['key']}"}
+                for e in ns_sel.get("matchExpressions") or []
+            ],
+        }
+        if not prefixed["matchLabels"] and not prefixed["matchExpressions"]:
+            # empty namespaceSelector = all namespaces (:87)
+            prefixed["matchExpressions"] = [
+                {"key": POD_NAMESPACE_LABEL, "operator": OP_EXISTS}
+            ]
+        return _es_from_k8s_selector(prefixed, pod_sel)
+    if pod_sel is not None:
+        scoped = {
+            "matchLabels": {
+                **(pod_sel.get("matchLabels") or {}),
+                POD_NAMESPACE_LABEL: namespace,
+            },
+            "matchExpressions": pod_sel.get("matchExpressions") or [],
+        }
+        return _es_from_k8s_selector(scoped)
+    return None
+
+
+def _parse_ports(ports: List[dict]) -> List[PortRule]:
+    """parsePorts (network_policy.go:264): one PortRule per entry."""
+    out = []
+    for p in ports:
+        if p.get("protocol") is None and p.get("port") is None:
+            continue
+        protocol = str(p.get("protocol") or "TCP").upper()
+        port = str(p.get("port") or "")
+        out.append(
+            PortRule(
+                ports=[PortProtocol(port=port, protocol=protocol)]
+            )
+        )
+    return out
+
+
+def _ip_block_to_cidr_rule(block: dict) -> CIDRRule:
+    return CIDRRule(
+        cidr=block["cidr"],
+        except_cidrs=list(block.get("except") or []),
+    )
+
+
+def _all_selector() -> EndpointSelector:
+    return EndpointSelector.from_labels(
+        Label(lbl.ID_NAME_ALL, "", lbl.SOURCE_RESERVED)
+    )
+
+
+def get_policy_labels(
+    namespace: str, name: str, derived_from: str
+) -> LabelArray:
+    """utils.go:54 GetPolicyLabels."""
+    return LabelArray(
+        [
+            Label(POLICY_LABEL_NAME, name, "k8s"),
+            Label(POLICY_LABEL_NAMESPACE, namespace, "k8s"),
+            Label(POLICY_LABEL_DERIVED_FROM, derived_from, "k8s"),
+        ]
+    )
+
+
+def parse_network_policy(np: dict) -> List[Rule]:
+    """ParseNetworkPolicy (network_policy.go:127) over the JSON form."""
+    meta = np.get("metadata") or {}
+    namespace = meta.get("namespace") or "default"
+    name = meta.get("name") or ""
+    spec = np.get("spec") or {}
+    policy_types = spec.get("policyTypes") or []
+
+    ingresses: List[IngressRule] = []
+    egresses: List[EgressRule] = []
+
+    for i_rule in spec.get("ingress") or []:
+        ingress = IngressRule()
+        if i_rule.get("ports"):
+            ingress.to_ports = _parse_ports(i_rule["ports"])
+        if i_rule.get("from"):
+            for peer in i_rule["from"]:
+                selector = _parse_peer(namespace, peer)
+                if selector is not None:
+                    ingress.from_endpoints.append(selector)
+                if peer.get("ipBlock"):
+                    ingress.from_cidr_set.append(
+                        _ip_block_to_cidr_rule(peer["ipBlock"])
+                    )
+        else:
+            # empty from = all sources (network_policy.go:160)
+            ingress.from_endpoints.append(_all_selector())
+        ingresses.append(ingress)
+
+    for e_rule in spec.get("egress") or []:
+        egress = EgressRule()
+        if e_rule.get("to"):
+            for peer in e_rule["to"]:
+                if (
+                    peer.get("namespaceSelector") is not None
+                    or peer.get("podSelector") is not None
+                ):
+                    selector = _parse_peer(namespace, peer)
+                    if selector is not None:
+                        egress.to_endpoints.append(selector)
+                if peer.get("ipBlock"):
+                    egress.to_cidr_set.append(
+                        _ip_block_to_cidr_rule(peer["ipBlock"])
+                    )
+        else:
+            egress.to_endpoints.append(_all_selector())
+        if e_rule.get("ports"):
+            egress.to_ports = _parse_ports(e_rule["ports"])
+        elif not e_rule.get("to"):
+            # quirk reproduced: the reference appends the wildcard
+            # selector AGAIN for portless+peerless egress rules
+            # (network_policy.go:201-208)
+            egress.to_endpoints.append(_all_selector())
+        egresses.append(egress)
+
+    # k8s default-deny convention (network_policy.go:215-231)
+    has_ingress_type = "Ingress" in policy_types
+    has_egress_type = "Egress" in policy_types
+    if not ingresses and (has_ingress_type or not has_egress_type):
+        ingresses = [IngressRule()]
+    if not egresses and has_egress_type:
+        egresses = [EgressRule()]
+
+    pod_selector = dict(spec.get("podSelector") or {})
+    pod_selector.setdefault("matchLabels", {})
+    pod_selector = {
+        "matchLabels": {
+            **(pod_selector.get("matchLabels") or {}),
+            POD_NAMESPACE_LABEL: namespace,
+        },
+        "matchExpressions": pod_selector.get("matchExpressions") or [],
+    }
+
+    rule = Rule(
+        endpoint_selector=_es_from_k8s_selector(pod_selector),
+        ingress=ingresses,
+        egress=egresses,
+        labels=get_policy_labels(namespace, name, "NetworkPolicy"),
+    )
+    rule.sanitize()
+    return [rule]
+
+
+def parse_cilium_network_policy(cnp: dict) -> List[Rule]:
+    """CNP (pkg/k8s/apis/cilium.io/v2): spec / specs are api.Rule
+    JSON; rules get the policy identification labels appended."""
+    meta = cnp.get("metadata") or {}
+    namespace = meta.get("namespace") or "default"
+    name = meta.get("name") or ""
+    docs = []
+    if cnp.get("spec"):
+        docs.append(cnp["spec"])
+    docs.extend(cnp.get("specs") or [])
+
+    rules = []
+    for doc in docs:
+        rule = rule_from_dict(doc)
+        rule.labels = LabelArray(
+            list(rule.labels)
+            + list(
+                get_policy_labels(namespace, name, "CiliumNetworkPolicy")
+            )
+        )
+        rule.sanitize()
+        rules.append(rule)
+    return rules

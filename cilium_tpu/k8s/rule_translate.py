@@ -1,0 +1,106 @@
+"""ToServices → ToCIDRSet rewriting.
+
+Behavioral port of /root/reference/pkg/k8s/rule_translate.go
+(RuleTranslator rule_translate.go:44, TranslateEgress :56): when a
+k8s Service's Endpoints change, egress rules naming that service get
+their generated ToCIDRSet repopulated with the endpoints' backend IPs
+(marked Generated so depopulation removes only what translation
+added).  Repository.translate_rules drives this over all rules
+(pkg/policy/repository TranslateRules).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Set
+
+from cilium_tpu.policy.api.rule import CIDRRule, EgressRule, Rule, Service
+
+
+@dataclass
+class K8sServiceInfo:
+    """loadbalancer.K8sServiceNamespace + its endpoints."""
+
+    name: str
+    namespace: str
+    backend_ips: Set[str] = field(default_factory=set)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+class RuleTranslator:
+    """policy.Translator implementation (rule_translate.go:41)."""
+
+    def __init__(self, service: K8sServiceInfo, revert: bool = False):
+        self.service = service
+        self.revert = revert
+
+    # Translator protocol: Repository.translate_rules calls this per
+    # rule (repository.go TranslateRules).
+    def translate(self, rule: Rule) -> None:
+        for egress in rule.egress:
+            self.translate_egress(egress)
+
+    def translate_egress(self, egress: EgressRule) -> None:
+        self._depopulate(egress)
+        if not self.revert:
+            self._populate(egress)
+
+    def _service_matches(self, service: Service) -> bool:
+        """rule_translate.go:96 serviceMatches."""
+        if service.k8s_service_selector is not None:
+            # {"selector": {matchLabels...}, "namespace": str}
+            spec = service.k8s_service_selector
+            from cilium_tpu.labels import Label, LabelArray
+            from cilium_tpu.policy.api.selector import EndpointSelector
+
+            selector = EndpointSelector.from_dict(
+                spec.get("selector") or {}
+            )
+            arr = LabelArray(
+                [
+                    Label(k, v, "k8s")
+                    for k, v in sorted(self.service.labels.items())
+                ]
+            )
+            if not selector.matches(arr):
+                return False
+            return spec.get("namespace", "") in (
+                "", self.service.namespace,
+            )
+        if service.k8s_service is not None:
+            return (
+                service.k8s_service.service_name == self.service.name
+                and service.k8s_service.namespace
+                in ("", self.service.namespace)
+            )
+        return False
+
+    def _populate(self, egress: EgressRule) -> None:
+        """generateToCidrFromEndpoint (rule_translate.go:113): one /32
+        generated CIDRRule per backend IP, skipping those already
+        covered."""
+        if not any(self._service_matches(s) for s in egress.to_services):
+            return
+        import ipaddress
+
+        for ip in sorted(self.service.backend_ips):
+            addr = ipaddress.ip_address(ip)
+            plen = 32 if addr.version == 4 else 128
+            cidr = f"{ip}/{plen}"
+            if any(c.cidr == cidr for c in egress.to_cidr_set):
+                continue
+            egress.to_cidr_set.append(CIDRRule(cidr=cidr, generated=True))
+
+    def _depopulate(self, egress: EgressRule) -> None:
+        """deleteToCidrFromEndpoint: remove only Generated entries for
+        this service's backends."""
+        if not any(self._service_matches(s) for s in egress.to_services):
+            return
+        backends = {
+            f"{ip}/32" for ip in self.service.backend_ips
+        } | {f"{ip}/128" for ip in self.service.backend_ips}
+        egress.to_cidr_set = [
+            c
+            for c in egress.to_cidr_set
+            if not (c.generated and c.cidr in backends)
+        ]

@@ -1,0 +1,14 @@
+"""L7 proxy redirect management.
+
+Re-design of /root/reference/pkg/proxy: the redirect manager allocates
+proxy ports (10000-20000, daemon/daemon.go:236) and instantiates the
+right L7 matcher per parser type — the reference picks the Go Kafka
+proxy or Envoy (proxy.go:217-225); here every parser compiles to
+device tables (l7.http / l7.kafka), and request batches are verdicted
+by the engine, with access-log records published on the monitor bus
+(≙ Envoy access-log socket → pkg/proxy/logger).
+"""
+
+from cilium_tpu.proxy.proxy import Proxy, Redirect
+
+__all__ = ["Proxy", "Redirect"]

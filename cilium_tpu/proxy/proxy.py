@@ -1,0 +1,205 @@
+"""Redirect manager + batched L7 request verdicts.
+
+Port of /root/reference/pkg/proxy/proxy.go:
+  - proxy-port allocation from a fixed range with reuse per proxy ID
+    (allocatePort; the range comes from StartProxySupport,
+    daemon/daemon.go:236: 10000-20000);
+  - CreateOrUpdateRedirect (proxy.go:153,217-225): parser type picks
+    the implementation — kafka → Kafka matcher, http & default →
+    HTTP/DFA matcher (where the reference spawns Envoy);
+  - RemoveRedirect releases the port;
+  - access records → MonitorBus LogRecordNotify (pkg/proxy/logger).
+
+The returned proxy ports feed the endpoint's realized_redirects, which
+computeDesiredPolicyMapState writes into L4 entries (the redirect
+loop of pkg/endpoint/bpf.go:488).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from cilium_tpu.identity import IdentityCache
+from cilium_tpu.l7.http import HTTPPolicy, compile_http_rules, specs_from_filter
+from cilium_tpu.l7.kafka import (
+    KafkaTables,
+    compile_kafka_rules,
+    rule_spec_from_port_rule,
+)
+from cilium_tpu.monitor.bus import MonitorBus
+from cilium_tpu.monitor.events import LogRecordNotify
+from cilium_tpu.policy.l4 import L4Filter, proxy_id
+
+PORT_MIN = 10000  # daemon/daemon.go:236
+PORT_MAX = 20000
+
+PARSER_HTTP = "http"
+PARSER_KAFKA = "kafka"
+
+
+@dataclass
+class Redirect:
+    """proxy.go Redirect."""
+
+    id: str  # proxy ID string (epID:direction:proto:port)
+    proxy_port: int
+    parser: str
+    endpoint_id: int
+    ingress: bool
+    http_policy: Optional[HTTPPolicy] = None
+    kafka_tables: Optional[KafkaTables] = None
+
+
+class Proxy:
+    def __init__(
+        self,
+        monitor: Optional[MonitorBus] = None,
+        port_min: int = PORT_MIN,
+        port_max: int = PORT_MAX,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.redirects: Dict[str, Redirect] = {}
+        self.monitor = monitor
+        self._port_min = port_min
+        self._port_max = port_max
+        self._next_port = port_min
+        self._ports_in_use: set = set()
+
+    # -- port allocation (proxy.go allocatePort) ----------------------------
+
+    def _allocate_port(self) -> int:
+        for _ in range(self._port_max - self._port_min + 1):
+            port = self._next_port
+            self._next_port += 1
+            if self._next_port > self._port_max:
+                self._next_port = self._port_min
+            if port not in self._ports_in_use:
+                self._ports_in_use.add(port)
+                return port
+        raise RuntimeError("no available proxy ports")
+
+    # -- redirects -----------------------------------------------------------
+
+    def create_or_update_redirect(
+        self,
+        l4: L4Filter,
+        pid: str,
+        endpoint_id: int,
+        identity_cache: IdentityCache,
+        id_index: Dict[int, int],
+        n_identities: int,
+    ) -> Redirect:
+        """proxy.go:153: compile (or recompile) the L7 matcher for one
+        redirect; the proxy port is stable across updates."""
+        with self._lock:
+            existing = self.redirects.get(pid)
+            port = (
+                existing.proxy_port if existing else self._allocate_port()
+            )
+            redirect = Redirect(
+                id=pid,
+                proxy_port=port,
+                parser=l4.l7_parser or PARSER_HTTP,
+                endpoint_id=endpoint_id,
+                ingress=l4.ingress,
+            )
+            if redirect.parser == PARSER_KAFKA:
+                specs = []
+                for selector, l7 in l4.l7_rules_per_ep.items():
+                    indices = [
+                        id_index[num_id]
+                        for num_id, labels in identity_cache.items()
+                        if selector.matches(labels) and num_id in id_index
+                    ]
+                    if not (l7.kafka or []):
+                        # empty rules = L7 allow-all: wildcard spec
+                        from cilium_tpu.l7.kafka import KafkaRuleSpec
+
+                        specs.append(
+                            KafkaRuleSpec(identity_indices=indices)
+                        )
+                    for rule in l7.kafka or []:
+                        specs.append(
+                            rule_spec_from_port_rule(rule, indices)
+                        )
+                redirect.kafka_tables = compile_kafka_rules(
+                    specs, n_identities
+                )
+            else:
+                specs = specs_from_filter(l4, identity_cache, id_index)
+                redirect.http_policy = compile_http_rules(
+                    specs, n_identities
+                )
+            self.redirects[pid] = redirect
+            return redirect
+
+    def remove_redirect(self, pid: str) -> bool:
+        """proxy.go RemoveRedirect."""
+        with self._lock:
+            redirect = self.redirects.pop(pid, None)
+            if redirect is None:
+                return False
+            self._ports_in_use.discard(redirect.proxy_port)
+            return True
+
+    def redirect_for(
+        self, endpoint_id: int, ingress: bool, protocol: str, port: int
+    ) -> Optional[Redirect]:
+        return self.redirects.get(
+            proxy_id(endpoint_id, ingress, protocol, port)
+        )
+
+    # -- endpoint integration (pkg/endpoint/bpf.go:488) ---------------------
+
+    def update_endpoint_redirects(
+        self,
+        endpoint,
+        identity_cache: IdentityCache,
+        id_index: Dict[int, int],
+        n_identities: int,
+    ) -> Dict[str, int]:
+        """addNewRedirects/removeOldRedirects for one endpoint; returns
+        the realized proxy-id → port map to feed back into the next
+        computeDesiredPolicyMapState."""
+        realized: Dict[str, int] = {}
+        l4_policy = endpoint.desired_l4_policy
+        wanted = set()
+        if l4_policy is not None:
+            for l4map in (l4_policy.ingress, l4_policy.egress):
+                for f in l4map.values():
+                    if not f.is_redirect():
+                        continue
+                    pid = proxy_id(
+                        endpoint.id, f.ingress, f.protocol, f.port
+                    )
+                    redirect = self.create_or_update_redirect(
+                        f, pid, endpoint.id, identity_cache, id_index,
+                        n_identities,
+                    )
+                    realized[pid] = redirect.proxy_port
+                    wanted.add(pid)
+        for pid in [
+            p
+            for p, r in self.redirects.items()
+            if r.endpoint_id == endpoint.id and p not in wanted
+        ]:
+            self.remove_redirect(pid)
+        endpoint.realized_redirects = realized
+        return realized
+
+    # -- access logging (pkg/proxy/logger) -----------------------------------
+
+    def log_record(
+        self, endpoint_id: int, l7_proto: str, verdict: str, info: str = ""
+    ) -> None:
+        if self.monitor is not None:
+            self.monitor.publish(
+                LogRecordNotify(
+                    endpoint_id=endpoint_id,
+                    l7_proto=l7_proto,
+                    verdict=verdict,
+                    info=info,
+                )
+            )

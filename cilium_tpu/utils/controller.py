@@ -1,0 +1,119 @@
+"""Named periodic reconciliation loops with backoff.
+
+Port of /root/reference/pkg/controller/controller.go:127,175: every
+resilient background task is a named controller with RunInterval,
+exponential error backoff, success/failure bookkeeping surfaced by
+`cilium status` — the framework's failure-detection backbone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+
+@dataclass
+class ControllerStatus:
+    success_count: int = 0
+    failure_count: int = 0
+    consecutive_failures: int = 0
+    last_error: Optional[str] = None
+    last_success: Optional[float] = None
+    last_failure: Optional[float] = None
+
+
+class Controller:
+    def __init__(
+        self,
+        name: str,
+        do_func: Callable[[], None],
+        run_interval: float = 0.0,
+        error_retry_base: float = 0.05,
+        max_backoff: float = 30.0,
+    ) -> None:
+        self.name = name
+        self.do_func = do_func
+        self.run_interval = run_interval
+        self.error_retry_base = error_retry_base
+        self.max_backoff = max_backoff
+        self.status = ControllerStatus()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.do_func()
+                self.status.success_count += 1
+                self.status.consecutive_failures = 0
+                self.status.last_error = None
+                self.status.last_success = time.time()
+                delay = self.run_interval
+                if delay <= 0:
+                    break  # one-shot controller
+            except Exception as exc:  # controller.go:175 retry w/ backoff
+                self.status.failure_count += 1
+                self.status.consecutive_failures += 1
+                self.status.last_error = str(exc)
+                self.status.last_failure = time.time()
+                delay = min(
+                    self.error_retry_base
+                    * (2 ** (self.status.consecutive_failures - 1)),
+                    self.max_backoff,
+                )
+            self._wake.wait(timeout=delay)
+            self._wake.clear()
+
+    def start(self) -> "Controller":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"ctrl-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def trigger(self) -> None:
+        self._wake.set()
+
+    def stop(self, wait: bool = True) -> None:
+        self._stop.set()
+        self._wake.set()
+        if wait and self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class ControllerManager:
+    """pkg/controller Manager: UpdateController replaces by name."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.controllers: Dict[str, Controller] = {}
+
+    def update_controller(self, controller: Controller) -> Controller:
+        with self._lock:
+            old = self.controllers.get(controller.name)
+            if old is not None:
+                old.stop(wait=False)
+            self.controllers[controller.name] = controller
+        return controller.start()
+
+    def remove_controller(self, name: str) -> None:
+        with self._lock:
+            controller = self.controllers.pop(name, None)
+        if controller is not None:
+            controller.stop(wait=False)
+
+    def statuses(self) -> Dict[str, ControllerStatus]:
+        with self._lock:
+            return {
+                name: c.status for name, c in self.controllers.items()
+            }
+
+    def stop_all(self) -> None:
+        with self._lock:
+            controllers = list(self.controllers.values())
+            self.controllers.clear()
+        for c in controllers:
+            c.stop(wait=False)

@@ -1,0 +1,68 @@
+"""Debounced trigger with MinInterval batching.
+
+Port of /root/reference/pkg/trigger/trigger.go:151: N rapid
+TriggerWithReason calls collapse into one invocation no more often
+than min_interval, with the collected reasons passed through —
+how policy updates batch endpoint regenerations.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class Trigger:
+    def __init__(
+        self,
+        trigger_func: Callable[[List[str]], None],
+        min_interval: float = 0.0,
+        name: str = "",
+    ) -> None:
+        self.trigger_func = trigger_func
+        self.min_interval = min_interval
+        self.name = name
+        self._lock = threading.Lock()
+        self._reasons: List[str] = []
+        self._pending = False
+        self._last_run = 0.0
+        self._timer: Optional[threading.Timer] = None
+        self._closed = False
+
+    def trigger_with_reason(self, reason: str) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._reasons.append(reason)
+            if self._pending:
+                return
+            wait = max(
+                0.0, self.min_interval - (time.time() - self._last_run)
+            )
+            self._pending = True
+            self._timer = threading.Timer(wait, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def trigger(self) -> None:
+        self.trigger_with_reason("")
+
+    def _fire(self) -> None:
+        with self._lock:
+            reasons = [r for r in self._reasons if r]
+            self._reasons = []
+            self._pending = False
+            self._last_run = time.time()
+        self.trigger_func(reasons)
+
+    def close(self, wait: bool = True) -> None:
+        with self._lock:
+            self._closed = True
+            timer = self._timer
+        if timer is not None:
+            if wait:
+                # let a scheduled run finish deterministically
+                timer.join(timeout=5)
+            else:
+                timer.cancel()
