@@ -1,0 +1,77 @@
+"""State-dump archiver.
+
+Port of /root/reference/bugtool (cilium-bugtool): collect the agent's
+observable state — status, endpoints with map states, policy rules,
+ipcache, identities, metrics, prefix lengths — into a JSON tree +
+tar.gz archive for offline debugging.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tarfile
+import time
+from typing import Optional
+
+from cilium_tpu.metrics import registry as metrics
+
+
+def collect(daemon, out_dir: str) -> str:
+    """Write the dump tree and return the archive path."""
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    root = os.path.join(out_dir, f"cilium-tpu-bugtool-{stamp}")
+    os.makedirs(root, exist_ok=True)
+
+    def write(name: str, obj) -> None:
+        with open(os.path.join(root, name), "w") as f:
+            json.dump(obj, f, indent=2, default=str)
+
+    write("status.json", daemon.status())
+    write(
+        "endpoints.json",
+        [
+            {
+                "id": e.id,
+                "name": e.name,
+                "ipv4": e.ipv4,
+                "state": e.state,
+                "identity": (
+                    e.security_identity.id if e.security_identity else None
+                ),
+                "policy_revision": e.policy_revision,
+                "map_entries": len(e.realized_map_state),
+                "redirects": e.realized_redirects,
+            }
+            for e in daemon.endpoint_manager.endpoints()
+        ],
+    )
+    write(
+        "policy.json",
+        {
+            "revision": daemon.repo.get_revision(),
+            "num_rules": daemon.repo.num_rules(),
+        },
+    )
+    write(
+        "ipcache.json",
+        {
+            ip: {"id": ident.id, "source": ident.source}
+            for ip, ident in daemon.ipcache.ip_to_identity.items()
+        },
+    )
+    write(
+        "identities.json",
+        {
+            str(num_id): [str(l) for l in labels]
+            for num_id, labels in daemon.identity_cache().items()
+        },
+    )
+    write("prefix_lengths.json", dict(daemon.prefix_lengths))
+    with open(os.path.join(root, "metrics.prom"), "w") as f:
+        f.write(metrics.expose())
+
+    archive = root + ".tar.gz"
+    with tarfile.open(archive, "w:gz") as tar:
+        tar.add(root, arcname=os.path.basename(root))
+    return archive

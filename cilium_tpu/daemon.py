@@ -100,6 +100,14 @@ class Daemon:
         self.policy_trigger = Trigger(
             self._regenerate_for_reasons, name="policy_update"
         )
+        # ToFQDNs poller (daemon.go NewDaemon: d.dnsPoller); resolver
+        # injectable — None disables generation (DryMode-ish default)
+        from cilium_tpu.fqdn import DNSPoller
+
+        self.dns_poller = DNSPoller(
+            policy_add=lambda rules: self.policy_add(rules, replace=True),
+            resolver=lambda name: [],
+        )
         # CIDR prefix-length refcounts (daemon.go createPrefixLengthCounter)
         self.prefix_lengths: _Counter = _Counter()
 
@@ -128,6 +136,8 @@ class Daemon:
             except Exception:
                 metrics.policy_import_errors.inc()
                 raise
+            # MarkToFQDNRules (daemon/policy.go:172)
+            self.dns_poller.mark_to_fqdn_rules(rules)
             prefixes = get_cidr_prefixes(rules)
             import ipaddress
 

@@ -1,0 +1,127 @@
+"""ToFQDNs DNS poller.
+
+Behavioral port of /root/reference/pkg/fqdn (dnspoller.go) and its
+daemon wiring (daemon/policy.go:172 MarkToFQDNRules + NewDaemon's
+DNSPoller with AddGeneratedRules → PolicyAdd):
+  - rules with ToFQDNs.MatchName are marked and tracked;
+  - the poller periodically resolves each name (resolver injectable —
+    the reference uses net.LookupIP; tests use a fake) and, when the
+    IP set changes, regenerates the rule's ToCIDRSet with generated
+    /32 entries and re-injects the rule via PolicyAdd(Replace);
+  - generated rules carry the cilium-generated label so deletes and
+    reverts stay scoped.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from cilium_tpu.labels import Label, LabelArray
+from cilium_tpu.policy.api.rule import CIDRRule, Rule
+
+# dnspoller.go DNSPollerInterval default
+DEFAULT_POLL_INTERVAL = 5.0
+
+GENERATED_LABEL = Label(
+    "ToFQDN-UUID", "", "cilium-generated"
+)
+
+Resolver = Callable[[str], List[str]]  # name → IPs
+
+
+def has_to_fqdns(rule: Rule) -> bool:
+    return any(e.to_fqdns for e in rule.egress)
+
+
+class DNSPoller:
+    def __init__(
+        self,
+        policy_add: Callable[[List[Rule]], int],
+        resolver: Resolver,
+        poll_interval: float = DEFAULT_POLL_INTERVAL,
+    ) -> None:
+        self.policy_add = policy_add
+        self.resolver = resolver
+        self.poll_interval = poll_interval
+        self._lock = threading.Lock()
+        # MarkToFQDNRules: tracked source rules keyed by their label
+        # string (dnspoller.go's uuid association)
+        self._rules: Dict[str, Rule] = {}
+        self._last_ips: Dict[str, Set[str]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registration (daemon/policy.go:172) --------------------------------
+
+    def mark_to_fqdn_rules(self, rules: List[Rule]) -> None:
+        with self._lock:
+            for rule in rules:
+                if has_to_fqdns(rule):
+                    key = ",".join(str(l) for l in rule.labels)
+                    self._rules[key] = copy.deepcopy(rule)
+
+    def stop_managing(self, label_key: str) -> None:
+        with self._lock:
+            self._rules.pop(label_key, None)
+
+    # -- polling -------------------------------------------------------------
+
+    def poll_once(self) -> int:
+        """One resolution pass; returns the number of rules
+        re-injected."""
+        with self._lock:
+            rules = dict(self._rules)
+        updated = 0
+        for key, rule in rules.items():
+            names = [
+                sel.match_name
+                for egress in rule.egress
+                for sel in egress.to_fqdns
+            ]
+            changed = False
+            resolved: Dict[str, List[str]] = {}
+            for name in names:
+                try:
+                    ips = sorted(self.resolver(name))
+                except Exception:
+                    continue  # resolution errors keep old state
+                resolved[name] = ips
+                if set(ips) != self._last_ips.get(f"{key}/{name}", set()):
+                    changed = True
+            if not changed:
+                continue
+            generated = copy.deepcopy(rule)
+            for egress in generated.egress:
+                if not egress.to_fqdns:
+                    continue
+                egress.to_cidr_set = [
+                    c for c in egress.to_cidr_set if not c.generated
+                ]
+                for sel in egress.to_fqdns:
+                    for ip in resolved.get(sel.match_name, []):
+                        plen = 128 if ":" in ip else 32
+                        egress.to_cidr_set.append(
+                            CIDRRule(cidr=f"{ip}/{plen}", generated=True)
+                        )
+            # AddGeneratedRules → PolicyAdd(Replace) keyed by labels
+            self.policy_add([generated])
+            for name, ips in resolved.items():
+                self._last_ips[f"{key}/{name}"] = set(ips)
+            updated += 1
+        return updated
+
+    def start(self) -> "DNSPoller":
+        def loop() -> None:
+            while not self._stop.wait(self.poll_interval):
+                self.poll_once()
+
+        self._thread = threading.Thread(
+            target=loop, name="fqdn-poller", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

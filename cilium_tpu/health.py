@@ -1,0 +1,66 @@
+"""Health probing.
+
+Re-design of /root/reference/cilium-health + pkg/health: the reference
+launches a synthetic health endpoint per node and probes ICMP/TCP
+reachability across the mesh (pkg/health/server/prober.go).  Here the
+"datapath" is the verdict engine, so the synthetic probe sends
+health-identity tuples through the PUBLISHED device tables per
+endpoint — a self-test that the realized policy actually admits the
+health identity (reserved id 4) — and node liveness rides the kvstore
+node registry (dead nodes drop out on lease expiry).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cilium_tpu.identity import RESERVED_HEALTH
+
+
+@dataclass
+class ProbeResult:
+    endpoint_id: int
+    ingress_allowed: bool
+    egress_allowed: bool
+
+
+def probe_endpoints(manager, dport: int = 4240, proto: int = 6) -> List[ProbeResult]:
+    """Evaluate health-identity tuples against every endpoint's
+    published tables (the cilium-health TCP probe port 4240)."""
+    from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+
+    version, tables, index = manager.published()
+    if tables is None or not index:
+        return []
+    ep_ids = sorted(index)
+    rows = []
+    for ep_id in ep_ids:
+        rows.append((index[ep_id], 0))  # ingress
+        rows.append((index[ep_id], 1))  # egress
+    batch = TupleBatch.from_numpy(
+        ep_index=np.array([r[0] for r in rows], np.int32),
+        identity=np.full(len(rows), RESERVED_HEALTH, np.uint32),
+        dport=np.full(len(rows), dport, np.int32),
+        proto=np.full(len(rows), proto, np.int32),
+        direction=np.array([r[1] for r in rows], np.int32),
+    )
+    allowed = np.asarray(evaluate_batch(tables, batch).allowed)
+    out = []
+    for i, ep_id in enumerate(ep_ids):
+        out.append(
+            ProbeResult(
+                endpoint_id=ep_id,
+                ingress_allowed=bool(allowed[2 * i]),
+                egress_allowed=bool(allowed[2 * i + 1]),
+            )
+        )
+    return out
+
+
+def node_health(node_watcher) -> Dict[str, bool]:
+    """Node liveness view from the registry (lease-expired nodes are
+    already gone — everything present is alive)."""
+    return {name: True for name in node_watcher.nodes}

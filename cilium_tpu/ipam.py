@@ -1,0 +1,57 @@
+"""Host-scope IP allocation for endpoints.
+
+Port of /root/reference/pkg/ipam: IPs come from the node's pod
+allocation CIDR (node.ipv4_alloc_cidr), first-free with explicit
+reservation support; the network/broadcast and router addresses are
+excluded as the reference excludes them.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from typing import Optional, Set
+
+
+class IPAMError(ValueError):
+    pass
+
+
+class IPAM:
+    def __init__(self, alloc_cidr: str) -> None:
+        self.cidr = ipaddress.ip_network(alloc_cidr, strict=False)
+        self._lock = threading.Lock()
+        self._allocated: Set[int] = set()
+        # network + broadcast + first host (router) excluded
+        base = int(self.cidr.network_address)
+        self._reserved = {base, int(self.cidr.broadcast_address), base + 1}
+
+    def allocate(self, ip: Optional[str] = None) -> str:
+        with self._lock:
+            if ip is not None:
+                addr = ipaddress.ip_address(ip)
+                v = int(addr)
+                if addr not in self.cidr:
+                    raise IPAMError(f"{ip} not in {self.cidr}")
+                if v in self._allocated or v in self._reserved:
+                    raise IPAMError(f"{ip} already allocated")
+                self._allocated.add(v)
+                return str(addr)
+            base = int(self.cidr.network_address)
+            for v in range(base, int(self.cidr.broadcast_address) + 1):
+                if v not in self._allocated and v not in self._reserved:
+                    self._allocated.add(v)
+                    return str(ipaddress.ip_address(v))
+            raise IPAMError(f"pool {self.cidr} exhausted")
+
+    def release(self, ip: str) -> bool:
+        with self._lock:
+            v = int(ipaddress.ip_address(ip))
+            if v in self._allocated:
+                self._allocated.remove(v)
+                return True
+            return False
+
+    def in_use(self) -> int:
+        with self._lock:
+            return len(self._allocated)

@@ -1,0 +1,62 @@
+"""XDP-style CIDR prefilter.
+
+Port of /root/reference/pkg/policy/prefilter.go (+ daemon/prefilter.go,
+bpf/bpf_xdp.c): a deny-by-CIDR stage that drops flows BEFORE the
+policy engine runs — the reference compiles CIDR4_*_MAPs consulted by
+XDP; here the prefix set lowers onto the same DIR-24-8 structure and
+the engine applies the drop mask ahead of the verdict lattice.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Set, Tuple
+
+from cilium_tpu.ipcache.lpm import LPMTables, build_lpm
+
+# marker identity for "listed in the prefilter" (any nonzero works:
+# lpm misses resolve to 0)
+_LISTED = 1
+
+
+class PreFilter:
+    """prefilter.go PreFilter: insert/delete CIDRs, compile to device
+    tables, per-batch drop mask."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cidrs: Set[str] = set()
+        self._revision = 0
+        self._tables: Tuple[int, LPMTables] = (0, build_lpm({}))
+
+    def insert(self, cidrs: List[str]) -> int:
+        with self._lock:
+            self._cidrs.update(cidrs)
+            self._revision += 1
+            return self._revision
+
+    def delete(self, cidrs: List[str]) -> int:
+        with self._lock:
+            for c in cidrs:
+                self._cidrs.discard(c)
+            self._revision += 1
+            return self._revision
+
+    def dump(self) -> List[str]:
+        with self._lock:
+            return sorted(self._cidrs)
+
+    def tables(self) -> LPMTables:
+        with self._lock:
+            version, tables = self._tables
+            if version != self._revision:
+                tables = build_lpm({c: _LISTED for c in self._cidrs})
+                self._tables = (self._revision, tables)
+            return tables
+
+
+def prefilter_batch(tables: LPMTables, src_ips):
+    """bool [B]: True = drop before policy (XDP_DROP)."""
+    from cilium_tpu.ipcache.lpm import _lookup_kernel
+
+    return _lookup_kernel(tables, src_ips) != 0

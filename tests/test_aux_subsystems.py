@@ -1,0 +1,156 @@
+"""fqdn poller, ipam, completion, prefilter, health, bugtool."""
+
+import ipaddress
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.fqdn import DNSPoller
+from cilium_tpu.health import probe_endpoints
+from cilium_tpu.ipam import IPAM, IPAMError
+from cilium_tpu.labels import Label, LabelArray, Labels
+from cilium_tpu.prefilter import PreFilter, prefilter_batch
+from cilium_tpu.utils.completion import WaitGroup
+
+
+def k8s_labels(**kv):
+    return Labels({k: Label(k, v, "k8s") for k, v in kv.items()})
+
+
+def test_fqdn_poller_generates_cidr_rules():
+    from cilium_tpu.policy.api import EgressRule, EndpointSelector, Rule
+    from cilium_tpu.policy.api.rule import FQDNSelector
+    from cilium_tpu.labels import parse_select_label
+
+    def es(label):
+        return EndpointSelector.from_labels(parse_select_label(label))
+
+    injected = []
+
+    dns = {"db.example.com": ["10.1.1.1", "10.1.1.2"]}
+    poller = DNSPoller(
+        policy_add=lambda rules: injected.extend(rules) or 1,
+        resolver=lambda name: dns[name],
+    )
+    rule = Rule(
+        endpoint_selector=es("app=client"),
+        egress=[
+            EgressRule(
+                to_fqdns=[FQDNSelector(match_name="db.example.com")]
+            )
+        ],
+        labels=LabelArray.parse("fqdn-rule"),
+    )
+    poller.mark_to_fqdn_rules([rule])
+    assert poller.poll_once() == 1
+    cidrs = sorted(c.cidr for c in injected[0].egress[0].to_cidr_set)
+    assert cidrs == ["10.1.1.1/32", "10.1.1.2/32"]
+    assert all(c.generated for c in injected[0].egress[0].to_cidr_set)
+
+    # no change → no re-injection; change → re-inject with new set
+    assert poller.poll_once() == 0
+    dns["db.example.com"] = ["10.1.1.3"]
+    assert poller.poll_once() == 1
+    assert [c.cidr for c in injected[1].egress[0].to_cidr_set] == [
+        "10.1.1.3/32"
+    ]
+
+
+def test_ipam():
+    pool = IPAM("10.5.0.0/29")  # 8 addrs, 3 reserved
+    got = {pool.allocate() for _ in range(5)}
+    assert len(got) == 5
+    with pytest.raises(IPAMError):
+        pool.allocate()
+    ip = next(iter(got))
+    assert pool.release(ip)
+    assert pool.allocate(ip) == ip
+    with pytest.raises(IPAMError):
+        pool.allocate(ip)  # double alloc
+    with pytest.raises(IPAMError):
+        pool.allocate("192.168.0.1")  # outside pool
+
+
+def test_completion_waitgroup():
+    wg = WaitGroup()
+    c1 = wg.add_completion()
+    c2 = wg.add_completion()
+    assert not wg.wait(timeout=0.01)  # ACKs outstanding
+    c1.complete()
+    c2.complete()
+    assert wg.wait(timeout=0.1)
+
+
+def test_prefilter():
+    pf = PreFilter()
+    pf.insert(["203.0.113.0/24", "198.51.100.7/32"])
+    ips = np.array(
+        [
+            int(ipaddress.IPv4Address(a))
+            for a in ["203.0.113.9", "198.51.100.7", "8.8.8.8"]
+        ],
+        dtype=np.uint32,
+    )
+    drop = np.asarray(prefilter_batch(pf.tables(), jnp.asarray(ips)))
+    assert drop.tolist() == [True, True, False]
+    pf.delete(["203.0.113.0/24"])
+    drop = np.asarray(prefilter_batch(pf.tables(), jnp.asarray(ips)))
+    assert drop.tolist() == [False, True, False]
+    assert pf.dump() == ["198.51.100.7/32"]
+
+
+def test_health_probe_through_tables():
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        Rule,
+    )
+
+    d = Daemon()
+    d.create_endpoint(1, k8s_labels(app="a"))
+    # reserved:health is allowed in by a rule selecting everything
+    from cilium_tpu.labels import parse_select_label
+
+    rule = Rule(
+        endpoint_selector=EndpointSelector(
+            match_labels={"k8s.app": "a"}
+        ),
+        ingress=[
+            IngressRule(
+                from_endpoints=[
+                    EndpointSelector.from_labels(
+                        parse_select_label("reserved:health")
+                    )
+                ]
+            )
+        ],
+        labels=LabelArray.parse("allow-health"),
+    )
+    d.policy_add([rule])
+    d.policy_trigger.close(wait=True)
+
+    results = probe_endpoints(d.endpoint_manager)
+    assert len(results) == 1
+    assert results[0].ingress_allowed  # health admitted
+    # egress: no rules select the endpoint → enforcement off → allowed
+    assert results[0].egress_allowed
+
+
+def test_bugtool_collect(tmp_path):
+    import tarfile
+
+    from cilium_tpu import bugtool
+
+    d = Daemon()
+    d.create_endpoint(1, k8s_labels(app="x"), ipv4="10.0.0.1")
+    archive = bugtool.collect(d, str(tmp_path))
+    assert os.path.exists(archive)
+    with tarfile.open(archive) as tar:
+        names = tar.getnames()
+    assert any("status.json" in n for n in names)
+    assert any("endpoints.json" in n for n in names)
+    assert any("metrics.prom" in n for n in names)
