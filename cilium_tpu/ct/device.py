@@ -128,6 +128,7 @@ def ct_lookup_batch(
     sport,
     proto,
     direction,  # i32 [B]: 0=ingress 1=egress 2=service
+    related_icmp=None,  # bool [B]: ICMP-error tuples (conntrack.h:349)
 ):
     """Returns (result u8 [B]: CT_NEW/ESTABLISHED/REPLY/RELATED,
     rev_nat u16-as-i32 [B], slave i32 [B])."""
@@ -138,6 +139,12 @@ def ct_lookup_batch(
         TUPLE_F_OUT,
         jnp.where(direction == CT_EGRESS, TUPLE_F_IN, TUPLE_F_SERVICE),
     ).astype(jnp.uint32)
+    if related_icmp is not None:
+        # ICMP errors probe the RELATED-flagged tuple, exactly as the
+        # host lookup sets TUPLE_F_RELATED before probing
+        base_flags = base_flags | jnp.where(
+            jnp.asarray(related_icmp), jnp.uint32(TUPLE_F_RELATED), 0
+        ).astype(jnp.uint32)
 
     # reverse probe: swapped addrs/ports, IN flag flipped
     rev_flags = base_flags ^ jnp.uint32(TUPLE_F_IN)
@@ -149,10 +156,15 @@ def ct_lookup_batch(
 
     related = jnp.asarray(snapshot.related)
     rev_related = related[rev_idx].astype(bool) & rev_found
+    fwd_related = related[fwd_idx].astype(bool) & fwd_found
     result = jnp.where(
         rev_found,
         jnp.where(rev_related, CT_RELATED, CT_REPLY),
-        jnp.where(fwd_found, CT_ESTABLISHED, CT_NEW),
+        jnp.where(
+            fwd_found,
+            jnp.where(fwd_related, CT_RELATED, CT_ESTABLISHED),
+            CT_NEW,
+        ),
     ).astype(jnp.uint8)
 
     idx = jnp.where(rev_found, rev_idx, fwd_idx)

@@ -93,6 +93,14 @@ class DNSPoller:
             if not changed:
                 continue
             generated = copy.deepcopy(rule)
+            # tag the re-injected rule (dnspoller.go: generated rules
+            # carry a cilium-generated ToFQDN label for scoping)
+            if not any(
+                l.source == "cilium-generated" for l in generated.labels
+            ):
+                generated.labels = LabelArray(
+                    list(generated.labels) + [GENERATED_LABEL]
+                )
             for egress in generated.egress:
                 if not egress.to_fqdns:
                     continue

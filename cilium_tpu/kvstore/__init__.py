@@ -33,8 +33,10 @@ __all__ = [
     "RemoteCluster",
 ]
 
-# kvstore key layout (pkg/kvstore/kvstore.go BaseKeyPrefix + consumers)
-BASE_KEY_PREFIX = "cilium"
-IDENTITIES_PATH = "cilium/state/identities/v1"
-IP_IDENTITIES_PATH = "cilium/state/ip/v1"
-NODES_PATH = "cilium/state/nodes/v1"
+from cilium_tpu.kvstore.paths import (  # noqa: E402
+    BASE_KEY_PREFIX,
+    CLUSTER_ID_SHIFT,
+    IDENTITIES_PATH,
+    IP_IDENTITIES_PATH,
+    NODES_PATH,
+)

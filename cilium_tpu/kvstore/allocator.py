@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from cilium_tpu.kvstore.paths import CLUSTER_ID_SHIFT
 from cilium_tpu.kvstore.store import KVEvent, KVStore
 
 
@@ -64,7 +65,7 @@ class Allocator:
         return f"{self.prefix}/value/{key}/{self.node}"
 
     def _mask_id(self, num_id: int) -> int:
-        return num_id | (self.cluster_id << 16)
+        return num_id | (self.cluster_id << CLUSTER_ID_SHIFT)
 
     # -- protocol ------------------------------------------------------------
 
@@ -111,6 +112,14 @@ class Allocator:
 
             path_lock = self.store.lock_path(key)
             with path_lock:
+                # Re-check under the key lock: another writer may have
+                # won the race since the unlocked Get above
+                # (lockedAllocate re-runs Get inside the lock,
+                # allocator.go:427-452) — without this, two nodes can
+                # mint DIFFERENT master ids for the same key.
+                existing = self.get(key)
+                if existing:
+                    continue  # outer loop reuses it via the fast path
                 if not self.store.create_only(
                     self._id_path(candidate), key.encode()
                 ):

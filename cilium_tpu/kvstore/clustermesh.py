@@ -16,11 +16,12 @@ from typing import Callable, Dict, List, Optional
 
 from cilium_tpu.ipcache.ipcache import IPCache
 from cilium_tpu.kvstore.ipsync import IPIdentityWatcher
+from cilium_tpu.kvstore.paths import (
+    CLUSTER_ID_MAX,
+    CLUSTER_ID_SHIFT,
+    IDENTITIES_PATH,
+)
 from cilium_tpu.kvstore.store import KVStore
-
-CLUSTER_ID_SHIFT = 16
-CLUSTER_ID_MAX = 255
-
 
 def cluster_id_of(num_id: int) -> int:
     """numericidentity.go:162."""
@@ -35,7 +36,7 @@ class RemoteCluster:
         name: str,
         store: KVStore,
         local_ipcache: IPCache,
-        identities_path: str = "cilium/state/identities/v1",
+        identities_path: str = IDENTITIES_PATH,
         on_identity: Optional[Callable[[str, int, str], None]] = None,
     ) -> None:
         self.name = name

@@ -15,6 +15,7 @@ import json
 from typing import Callable, Optional
 
 from cilium_tpu.ipcache.ipcache import FROM_KVSTORE, IPCache, IPIdentity
+from cilium_tpu.kvstore.paths import IP_IDENTITIES_PATH
 from cilium_tpu.kvstore.store import KVEvent, KVStore
 
 DEFAULT_ADDRESS_SPACE = "default"  # kvstore.go AddressSpace
@@ -30,7 +31,7 @@ def upsert_ip_mapping(
     identity: int,
     host_ip: Optional[str] = None,
     node: Optional[str] = None,
-    base: str = "cilium/state/ip/v1",
+    base: str = IP_IDENTITIES_PATH,
     address_space: str = DEFAULT_ADDRESS_SPACE,
 ) -> None:
     """UpsertIPToKVStore (kvstore.go:159): JSON payload {IP, ID, Host}
@@ -46,7 +47,7 @@ def upsert_ip_mapping(
 def delete_ip_mapping(
     store: KVStore,
     ip: str,
-    base: str = "cilium/state/ip/v1",
+    base: str = IP_IDENTITIES_PATH,
     address_space: str = DEFAULT_ADDRESS_SPACE,
 ) -> None:
     store.delete(_ip_path(base, address_space, ip))
@@ -61,7 +62,7 @@ class IPIdentityWatcher:
         self,
         store: KVStore,
         ipcache: IPCache,
-        base: str = "cilium/state/ip/v1",
+        base: str = IP_IDENTITIES_PATH,
         address_space: str = DEFAULT_ADDRESS_SPACE,
     ) -> None:
         self.ipcache = ipcache

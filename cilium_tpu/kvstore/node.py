@@ -14,9 +14,8 @@ import json
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from cilium_tpu.kvstore.paths import NODES_PATH
 from cilium_tpu.kvstore.store import KVEvent, KVStore
-
-NODES_PATH = "cilium/state/nodes/v1"
 
 
 @dataclass

@@ -1,0 +1,16 @@
+"""kvstore key layout — single source of truth.
+
+pkg/kvstore BaseKeyPrefix + the per-subsystem prefixes
+(pkg/identity/allocator.go:57, pkg/ipcache/kvstore.go:43,
+pkg/node store paths).  A layout bump here reaches every writer and
+watcher at once.
+"""
+
+BASE_KEY_PREFIX = "cilium"
+IDENTITIES_PATH = f"{BASE_KEY_PREFIX}/state/identities/v1"
+IP_IDENTITIES_PATH = f"{BASE_KEY_PREFIX}/state/ip/v1"
+NODES_PATH = f"{BASE_KEY_PREFIX}/state/nodes/v1"
+
+# NumericIdentity.ClusterID partitioning (numericidentity.go:162).
+CLUSTER_ID_SHIFT = 16
+CLUSTER_ID_MAX = 255

@@ -68,13 +68,22 @@ def _build() -> Optional[ctypes.CDLL]:
 
 
 def _get_lib() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, _build_failed
     with _lock:
         if _lib is None and not _build_failed:
-            _lib = _build()
-            if _lib is not None:
-                _configure(_lib)
-                alignment_check(_lib)
+            lib = _build()
+            if lib is not None:
+                _configure(lib)
+                try:
+                    alignment_check(lib)
+                except NativeUnavailable:
+                    # ABI skew: never serve the mismatched library —
+                    # permanently fall back to the NumPy path (first
+                    # call raises so the skew is loud, later calls
+                    # degrade safely)
+                    _build_failed = True
+                    raise
+                _lib = lib
         return _lib
 
 

@@ -262,3 +262,34 @@ def test_lb_selection_and_dnat():
 
     # rev-NAT map
     assert mgr.rev_nat(svc.id) == L3n4Addr("10.96.0.10", 80)
+
+
+def test_ct_device_related_icmp_matches_host():
+    """RELATED entries are reachable on device via the related_icmp
+    probe input (review fix: the flags bit must reach the packed key)."""
+    from cilium_tpu.ct.table import CTEntry, TUPLE_F_OUT, TUPLE_F_RELATED
+
+    ct = CTMap()
+    t = tup()
+    rel_key = CTTuple(
+        t.daddr, t.saddr, t.dport, t.sport, t.nexthdr,
+        TUPLE_F_OUT | TUPLE_F_RELATED,
+    )
+    ct.entries[rel_key] = CTEntry(lifetime=1000)
+    snapshot = compile_ct(ct)
+
+    # the ICMP error travels in the reply direction (egress probe)
+    icmp = CTTuple(t.saddr, t.daddr, t.sport, t.dport, t.nexthdr)
+    result, _, _ = ct_lookup_batch(
+        snapshot,
+        jnp.asarray(np.array([icmp.daddr], np.uint32)),
+        jnp.asarray(np.array([icmp.saddr], np.uint32)),
+        jnp.asarray(np.array([icmp.dport], np.int32)),
+        jnp.asarray(np.array([icmp.sport], np.int32)),
+        jnp.asarray(np.array([icmp.nexthdr], np.int32)),
+        jnp.asarray(np.array([1], np.int32)),  # egress
+        related_icmp=np.array([True]),
+    )
+    assert int(np.asarray(result)[0]) == CT_RELATED
+    want = ct.lookup(icmp, 1, now=1, related_icmp=True)
+    assert want == CT_RELATED

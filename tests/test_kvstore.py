@@ -212,3 +212,37 @@ def test_node_discovery():
 
     unregister_node(store, n1)
     assert not w.nodes
+
+
+def test_allocator_concurrent_same_key_single_id():
+    """The locked re-check prevents two writers minting different
+    master ids for one key (allocator.go:427 re-Get under lock)."""
+    import threading
+
+    s = KVStore()
+    allocators = [
+        Allocator(s, IDENTITIES_PATH, node=f"n{i}") for i in range(8)
+    ]
+    results = [None] * len(allocators)
+
+    barrier = threading.Barrier(len(allocators))
+
+    def run(i):
+        barrier.wait()
+        results[i] = allocators[i].allocate("labels;race;")
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(allocators))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(results)) == 1, results
+    # exactly one master key for the key string
+    masters = [
+        v for v in s.list_prefix(f"{IDENTITIES_PATH}/id/").values()
+        if v == b"labels;race;"
+    ]
+    assert len(masters) == 1
