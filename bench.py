@@ -116,8 +116,8 @@ def smoke() -> None:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
-    ap.add_argument("--batch", type=int, default=1 << 20)
-    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=1 << 22)
+    ap.add_argument("--steps", type=int, default=16)
     ap.add_argument("--endpoints", type=int, default=16)
     ap.add_argument("--identities", type=int, default=65536)
     ap.add_argument("--l4-keys", type=int, default=256)

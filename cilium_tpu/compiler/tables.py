@@ -52,9 +52,6 @@ NO_SLOT = np.uint16(0xFFFF)
 # with non-local ids above this would need a hash-probe fallback; the
 # reference caps at 512k ipcache entries (ipcache.go:36), well below.
 MAX_DIRECT = 1 << 22
-# Proto slots: index 7 is reserved as the "unknown proto" row, whose
-# port_slot entries are all NO_SLOT.
-NUM_PROTO_SLOTS = 8
 
 LOCAL_ID_BASE = IdentityAllocator.LOCAL_IDENTITY_BASE
 
@@ -91,8 +88,9 @@ class PolicyTables:
       id_table       u32 [N]            sorted identity universe
       id_direct      u32 [LO+LL]        id → index (two dense regions)
       id_lo_len      i32 scalar         split point of id_direct
-      proto_slot     u32 [256]          IP proto byte → proto slot
-      port_slot      u16 [8, 65536]     (proto slot, dport) → L4 slot
+      port_slot      u16 [256, 65536]   (proto, dport) → L4 slot; one
+                                        row per raw IP proto byte — 32
+                                        MB buys one fewer gather/tuple
       l4_meta        u32 [E, 2, Kg]     proxy_port << 1 | wildcard
       l4_allow_bits  u32 [E, 2, Kg, W]  per-identity allow (exact probe)
       l3_allow_bits  u32 [E, 2, W]      L3-only allow (2nd probe)
@@ -101,7 +99,6 @@ class PolicyTables:
     id_table: np.ndarray
     id_direct: np.ndarray
     id_lo_len: np.ndarray
-    proto_slot: np.ndarray
     port_slot: np.ndarray
     l4_meta: np.ndarray
     l4_allow_bits: np.ndarray
@@ -125,7 +122,6 @@ class PolicyTables:
                 self.id_table,
                 self.id_direct,
                 self.id_lo_len,
-                self.proto_slot,
                 self.port_slot,
                 self.l4_meta,
                 self.l4_allow_bits,
@@ -228,22 +224,12 @@ def lower_map_state(
             if not k.is_l3_only()
         }
     )
-    protos = sorted({p for _, p in all_keys})
-    if len(protos) > NUM_PROTO_SLOTS - 1:
-        raise ValueError(
-            f"more than {NUM_PROTO_SLOTS - 1} distinct IP protocols in "
-            f"L4 keys: {protos}"
-        )
-    proto_to_pslot = {p: i for i, p in enumerate(protos)}
     kg = _round_up(max(len(all_keys), 1), filter_pad)
     slot_of = {key: j for j, key in enumerate(all_keys)}
 
-    proto_slot = np.full((256,), NUM_PROTO_SLOTS - 1, dtype=np.uint32)
-    for p, s in proto_to_pslot.items():
-        proto_slot[p] = s
-    port_slot = np.full((NUM_PROTO_SLOTS, 65536), NO_SLOT, dtype=np.uint16)
+    port_slot = np.full((256, 65536), NO_SLOT, dtype=np.uint16)
     for (dport, proto), j in slot_of.items():
-        port_slot[proto_to_pslot[proto], dport] = j
+        port_slot[proto & 0xFF, dport] = j
 
     l4_meta = np.zeros((e_count, 2, kg), dtype=np.uint32)
     # Bits are set directly into the packed words — never materialize
@@ -294,7 +280,6 @@ def lower_map_state(
         id_table=id_table,
         id_direct=id_direct,
         id_lo_len=np.int32(id_lo_len),
-        proto_slot=proto_slot,
         port_slot=port_slot,
         l4_meta=l4_meta,
         l4_allow_bits=l4_allow_bits,

@@ -7,7 +7,7 @@ Two parallel axes, mirroring §2.9 of SURVEY.md:
   * `table` — the identity (bit-word) axis of the allow tensors is
     sharded when the rule/identity tensors exceed a single chip's HBM
     (a 512k-identity universe × 16k L4 slots would not fit).  The
-    small index tables (id_direct/proto_slot/port_slot) replicate
+    small index tables (id_direct/port_slot) replicate
     and resolve a tuple's *global* identity index; each shard then
     tests only the bit-words it owns, and probe hits combine with a
     psum over the axis — the "verdict lattice psum" described in
@@ -48,7 +48,6 @@ def table_specs(batch_axis: str, table_axis: str) -> PolicyTables:
         id_table=P(),
         id_direct=P(),
         id_lo_len=P(),
-        proto_slot=P(),
         port_slot=P(),
         l4_meta=P(),
         l4_allow_bits=P(None, None, None, table_axis),
