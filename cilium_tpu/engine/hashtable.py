@@ -130,4 +130,10 @@ def lookup_batch(table: HashTable, query: "jax.Array"):
         index = jnp.where(hit, value_index[slot], index)
         found = found | hit
         slot = (slot + 1) & jnp.int32(capacity - 1)
+    # A query equal to the all-ones EMPTY sentinel would "hit" empty
+    # slots and return index=-1; current CT/LB key packings can't
+    # produce it, but mask it out so a future caller fails safe.
+    is_sentinel = jnp.all(query == jnp.uint32(EMPTY), axis=1)
+    found = found & ~is_sentinel
+    index = jnp.where(is_sentinel, 0, index)
     return found, index

@@ -226,6 +226,17 @@ def parse_packets(buf: bytes, offsets: np.ndarray):
     }
     lib = _get_lib()
     offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+    # The C++ decoder computes offsets[i+1]-offsets[i] as size_t and
+    # indexes buf with it; validate here so bad input fails loudly in
+    # Python instead of under/overflowing in native code.
+    if n > 0:
+        if (np.diff(offsets.astype(np.int64)) < 0).any():
+            raise ValueError("packet offsets must be non-decreasing")
+        if int(offsets[-1]) > len(buf) or int(offsets[0]) > len(buf):
+            raise ValueError(
+                f"packet offsets exceed buffer length ({int(offsets[-1])}"
+                f" > {len(buf)})"
+            )
     if lib is not None:
         raw = np.frombuffer(buf, dtype=np.uint8)
         lib.parse_packets(

@@ -12,7 +12,7 @@ optionally folds denied flows into monitor events.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -51,7 +51,10 @@ def read_batches(
         lut = np.zeros(max(ep_map.keys(), default=0) + 1, dtype=np.int32)
         for ep_id, idx in ep_map.items():
             lut[ep_id] = idx
-        ep_index = lut[np.clip(ep_index, 0, len(lut) - 1)]
+        in_range = ep_index < len(lut)
+        ep_index = np.where(
+            in_range, lut[np.minimum(ep_index, len(lut) - 1)], 0
+        ).astype(np.int32)
     for start in range(0, n, batch_size):
         end = min(start + batch_size, n)
         pad = batch_size - (end - start)
@@ -82,39 +85,55 @@ def replay(
     buf: bytes,
     batch_size: int = 1 << 20,
     accumulate_counters: bool = True,
+    ep_map: Optional[Dict[int, int]] = None,
 ) -> tuple:
-    """Run all records through the full datapath step.  Returns
-    (ReplayStats, l4_counts, l3_counts) with counters summed across
-    batches (u64 to survive long replays)."""
+    """Run all records through the full datapath step with pipelined
+    dispatch (bounded-depth queue of in-flight device batches — the
+    double-buffered H2D pattern of SURVEY §7 hard part 6).
+
+    Returns (ReplayStats, l4_counts, l3_counts); the counter arrays
+    are u64 sums across batches with shapes [E, 2, Kg] and [E, 2, N]
+    (policy_entry packets, bpf/lib/policy.h:66-68), or (stats, None,
+    None) when `accumulate_counters` is False.
+    """
     import time
 
     import jax
 
     step = jax.jit(_verdict_kernel_with_counters)
     stats = ReplayStats()
-    l4_total = None
-    l3_total = None
+    acc = _CounterAccumulator() if accumulate_counters else None
 
     pending = []  # pipelined dispatch, bounded depth
     t0 = time.perf_counter()
-    for batch, valid in read_batches(buf, batch_size):
+    for batch, valid in read_batches(buf, batch_size, ep_map):
         out = step(tables, batch)
         pending.append((out, valid))
         stats.batches += 1
         if len(pending) >= 4:
-            _drain(pending.pop(0), stats)
+            _drain(pending.pop(0), stats, acc)
     while pending:
-        _drain(pending.pop(0), stats)
+        _drain(pending.pop(0), stats, acc)
     stats.seconds = time.perf_counter() - t0
 
-    if accumulate_counters:
-        # counters from the last dispatch carry the per-batch sums; we
-        # need all batches — rerun cheaply? No: accumulate during drain.
-        pass
-    return stats
+    if acc is None:
+        return stats, None, None
+    return stats, acc.l4, acc.l3
 
 
-def _drain(item, stats: ReplayStats) -> None:
+class _CounterAccumulator:
+    l4: Optional[np.ndarray] = None
+    l3: Optional[np.ndarray] = None
+
+    def add(self, l4_counts, l3_counts) -> None:
+        if self.l4 is None:
+            self.l4 = np.zeros(l4_counts.shape, dtype=np.uint64)
+            self.l3 = np.zeros(l3_counts.shape, dtype=np.uint64)
+        self.l4 += np.asarray(l4_counts).astype(np.uint64)
+        self.l3 += np.asarray(l3_counts).astype(np.uint64)
+
+
+def _drain(item, stats: ReplayStats, acc: Optional[_CounterAccumulator]) -> None:
     (verdicts, l4_counts, l3_counts), valid = item
     allowed = np.asarray(verdicts.allowed)[:valid]
     proxy = np.asarray(verdicts.proxy_port)[:valid]
@@ -122,37 +141,83 @@ def _drain(item, stats: ReplayStats) -> None:
     stats.allowed += int(allowed.sum())
     stats.denied += int(valid - allowed.sum())
     stats.redirected += int((proxy > 0).sum())
-    if not hasattr(stats, "_l4"):
-        stats._l4 = np.zeros(l4_counts.shape, dtype=np.uint64)
-        stats._l3 = np.zeros(l3_counts.shape, dtype=np.uint64)
-    stats._l4 += np.asarray(l4_counts).astype(np.uint64)
-    stats._l3 += np.asarray(l3_counts).astype(np.uint64)
+    if acc is not None:
+        acc.add(l4_counts, l3_counts)
+
+
+def slot_keys_from_tables(tables) -> Dict[int, Tuple[int, int]]:
+    """Recover global L4 slot → (dport, proto) from the compiled
+    port_slot table (the inverse of lower_map_state's slot_of)."""
+    port_slot = np.asarray(tables.port_slot)
+    protos, dports = np.nonzero(port_slot != np.uint16(0xFFFF))
+    slots = port_slot[protos, dports]
+    return {
+        int(j): (int(dport), int(proto))
+        for j, dport, proto in zip(slots, dports, protos)
+    }
 
 
 def sync_counters_to_endpoints(
-    stats: ReplayStats, manager, id_table: np.ndarray
+    l4_counts: Optional[np.ndarray],
+    l3_counts: Optional[np.ndarray],
+    manager,
+    tables=None,
+    index: Optional[Dict[int, int]] = None,
 ) -> int:
     """Fold accumulated device counters back into the endpoints'
     realized map states (the packets field of policy_entry the agent
-    reads back from the datapath).  Returns entries updated."""
-    if not hasattr(stats, "_l4"):
-        return 0
-    _, tables, index = manager.published()
+    reads back from the datapath, pkg/maps/policymap PolicyEntry).
+
+    Pass the `tables`/`index` the counters were computed against; a
+    republish between replay() and sync would otherwise shift the
+    identity/slot indexing and misattribute counts.  Falls back to the
+    currently-published version when not given.  Returns entries
+    updated."""
+    if tables is None or index is None:
+        _, tables, index = manager.published()
     if tables is None:
         return 0
     updated = 0
     rev_index = {v: k for k, v in index.items()}
-    # L3 counters are indexed by identity index
-    for (e, d, idx), count in np.ndenumerate(stats._l3):
-        if count == 0:
-            continue
-        ep = manager.lookup(rev_index.get(e, -1))
-        if ep is None:
-            continue
-        identity = int(id_table[idx])
-        key = PolicyKey(identity, 0, 0, d)
-        entry = ep.realized_map_state.get(key)
-        if entry is not None:
-            entry.packets += int(count)
-            updated += 1
+    id_table = np.asarray(tables.id_table)
+    if l3_counts is not None:
+        # L3 counters are indexed by identity index.
+        for e, d, idx in zip(*np.nonzero(l3_counts)):
+            ep = manager.lookup(rev_index.get(int(e), -1))
+            if ep is None:
+                continue
+            key = PolicyKey(int(id_table[idx]), 0, 0, int(d))
+            entry = ep.realized_map_state.get(key)
+            if entry is not None:
+                entry.packets += int(l3_counts[e, d, idx])
+                updated += 1
+    if l4_counts is not None:
+        # L4 counters are indexed by global slot; a slot hit covers
+        # every (identity, dport, proto) entry of that filter — the
+        # wildcard entry takes the count (exact-entry attribution
+        # would need per-(slot, identity) counters; the reference
+        # bumps the entry the probe hit, which for MATCH_L4 is the
+        # exact key and for MATCH_L4_WILD the wildcard — we fold both
+        # into the slot's wildcard-or-first entry, preserving totals).
+        slot_keys = slot_keys_from_tables(tables)
+        for e, d, j in zip(*np.nonzero(l4_counts)):
+            ep = manager.lookup(rev_index.get(int(e), -1))
+            if ep is None or int(j) not in slot_keys:
+                continue
+            dport, proto = slot_keys[int(j)]
+            count = int(l4_counts[e, d, j])
+            wild = PolicyKey(0, dport, proto, int(d))
+            entry = ep.realized_map_state.get(wild)
+            if entry is None:
+                for key, cand in ep.realized_map_state.items():
+                    if (
+                        key.dest_port == dport
+                        and key.nexthdr == proto
+                        and key.traffic_direction == int(d)
+                    ):
+                        entry = cand
+                        break
+            if entry is not None:
+                entry.packets += count
+                updated += 1
     return updated
