@@ -1,0 +1,349 @@
+"""Fused per-packet datapath: one jitted step over the whole pipeline.
+
+The reference's hot path is a single BPF program per packet —
+prefilter (bpf/bpf_xdp.c), LB service DNAT + conntrack + identity
+derivation + policy verdict (`handle_ipv4_from_lxc`
+bpf/bpf_lxc.c:440 egress, `ipv4_policy` bpf_lxc.c:899 ingress) — not
+a chain of separately-invoked kernels.  This module is the TPU
+equivalent: every stage is already a fixed number of gathers, so the
+whole pipeline fuses into ONE jit (XLA overlaps the gathers; no
+host↔device round trips between stages).
+
+Stage order (mirrors the C):
+
+  1. XDP prefilter on the remote (source) address — bpf_xdp.c,
+     CIDR4_*_MAP deny sets.
+  2. Egress only: LB service probe on the original (daddr, dport,
+     proto), backend stickiness via the CT service-scope entry, DNAT
+     rewrite — lb4_lookup_service/lb4_local (bpf_lxc.c:486-492).
+  3. Conntrack lookup on the (possibly DNATed) tuple, reverse probe
+     first — ct_lookup4 (bpf_lxc.c:933, :509).
+  4. Identity derivation: ingress takes the ipcache LPM of saddr (what
+     bpf_netdev.c derives before the policy tail-call), egress the
+     ipcache of the post-DNAT daddr, falling back to WORLD_ID
+     (bpf_lxc.c:520-531).
+  5. Policy lattice — policy_can_access_ingress / policy_can_egress4
+     3-probe verdict (lib/policy.h:46), *always* evaluated.
+  6. Combine — REPLY/RELATED bypass a deny verdict; an ESTABLISHED
+     flow that is now denied is dropped and its CT entry flagged for
+     deletion; NEW+allowed flows are flagged for CT creation; a
+     proxy_port verdict redirects only NEW/ESTABLISHED flows
+     (bpf_lxc.c:962-985).
+
+CT state mutation (create/delete) happens host-side after the batch
+(`apply_ct_writeback`) — the same split as the agent reading/GC'ing
+the kernel-owned CT map asynchronously.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cilium_tpu.compiler.tables import PolicyTables
+from cilium_tpu.ct.device import CTSnapshot, ct_lookup_batch
+from cilium_tpu.ct.table import (
+    CT_EGRESS,
+    CT_ESTABLISHED,
+    CT_INGRESS,
+    CT_NEW,
+    CT_RELATED,
+    CT_REPLY,
+    CT_SERVICE,
+    CTMap,
+    CTTuple,
+    TUPLE_F_IN,
+    TUPLE_F_OUT,
+)
+from cilium_tpu.engine.verdict import TupleBatch, _verdict_kernel
+from cilium_tpu.identity import RESERVED_WORLD
+from cilium_tpu.ipcache.lpm import LPMTables, _lookup_kernel
+from cilium_tpu.lb.device import LBTables, lb_select_batch
+from cilium_tpu.maps.policymap import INGRESS
+
+
+def _register(cls):
+    try:
+        jax.tree_util.register_pytree_node(
+            cls,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: cls.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+    return cls
+
+
+@_register
+@dataclass
+class DatapathTables:
+    """Everything the fused step consumes, as one pytree — the set of
+    pinned maps a bpf_lxc program sees (lib/maps.h)."""
+
+    prefilter: LPMTables
+    ipcache: LPMTables
+    ct: CTSnapshot
+    lb: LBTables
+    policy: PolicyTables
+
+    def tree_flatten(self):
+        return (
+            (self.prefilter, self.ipcache, self.ct, self.lb, self.policy),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@_register
+@dataclass
+class FlowBatch:
+    """Raw 5-tuple flows (pre identity resolution) — what arrives on
+    the wire, as opposed to TupleBatch which is post-ipcache."""
+
+    ep_index: jax.Array  # i32 [B]
+    saddr: jax.Array  # u32 [B]
+    daddr: jax.Array  # u32 [B]
+    sport: jax.Array  # i32 [B]
+    dport: jax.Array  # i32 [B]
+    proto: jax.Array  # i32 [B]
+    direction: jax.Array  # i32 [B] 0=ingress 1=egress
+    is_fragment: jax.Array  # bool [B]
+
+    def tree_flatten(self):
+        return (
+            (
+                self.ep_index,
+                self.saddr,
+                self.daddr,
+                self.sport,
+                self.dport,
+                self.proto,
+                self.direction,
+                self.is_fragment,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def from_numpy(
+        ep_index, saddr, daddr, sport, dport, proto, direction,
+        is_fragment=None,
+    ) -> "FlowBatch":
+        b = len(ep_index)
+        if is_fragment is None:
+            is_fragment = np.zeros(b, dtype=bool)
+        return FlowBatch(
+            ep_index=jnp.asarray(ep_index, jnp.int32),
+            saddr=jnp.asarray(np.asarray(saddr, np.uint32)),
+            daddr=jnp.asarray(np.asarray(daddr, np.uint32)),
+            sport=jnp.asarray(sport, jnp.int32),
+            dport=jnp.asarray(dport, jnp.int32),
+            proto=jnp.asarray(proto, jnp.int32),
+            direction=jnp.asarray(direction, jnp.int32),
+            is_fragment=jnp.asarray(is_fragment, bool),
+        )
+
+
+@_register
+@dataclass
+class DatapathVerdicts:
+    """Per-flow outcome of the fused step plus the CT writeback
+    intents the host applies after the batch."""
+
+    allowed: jax.Array  # u8 [B]
+    proxy_port: jax.Array  # i32 [B]
+    match_kind: jax.Array  # u8 [B] MATCH_* of the lattice
+    ct_result: jax.Array  # u8 [B] CT_NEW/ESTABLISHED/REPLY/RELATED
+    pre_dropped: jax.Array  # bool [B] killed by the XDP prefilter
+    sec_id: jax.Array  # u32 [B] derived peer identity
+    final_daddr: jax.Array  # u32 [B] post-DNAT dst address
+    final_dport: jax.Array  # i32 [B] post-DNAT dst port
+    rev_nat: jax.Array  # i32 [B] rev-NAT index for CT create
+    lb_slave: jax.Array  # i32 [B] chosen backend (0 = not a service)
+    ct_create: jax.Array  # bool [B] NEW + allowed → host CT create
+    ct_delete: jax.Array  # bool [B] ESTABLISHED + denied → host delete
+
+    def tree_flatten(self):
+        return (
+            (
+                self.allowed,
+                self.proxy_port,
+                self.match_kind,
+                self.ct_result,
+                self.pre_dropped,
+                self.sec_id,
+                self.final_daddr,
+                self.final_dport,
+                self.rev_nat,
+                self.lb_slave,
+                self.ct_create,
+                self.ct_delete,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _datapath_kernel(
+    tables: DatapathTables, flows: FlowBatch
+) -> DatapathVerdicts:
+    ingress = flows.direction == INGRESS
+
+    # -- 1. XDP prefilter (deny-by-CIDR before everything) ------------------
+    pre_drop = _lookup_kernel(tables.prefilter, flows.saddr) != 0
+
+    # -- 2. LB service DNAT (egress; lb4_local, bpf_lxc.c:486) --------------
+    # Backend stickiness comes from the CT service-scope entry the
+    # reference keeps per (vip, sport) — probe it, then select.
+    svc_dir = jnp.full_like(flows.direction, CT_SERVICE)
+    _, _, svc_slave = ct_lookup_batch(
+        tables.ct,
+        flows.daddr,
+        flows.saddr,
+        flows.dport,
+        flows.sport,
+        flows.proto,
+        svc_dir,
+    )
+    svc_found, slave, lb_daddr, lb_dport, lb_rev = lb_select_batch(
+        tables.lb,
+        flows.saddr,
+        flows.daddr,
+        flows.sport,
+        flows.dport,
+        flows.proto,
+        ct_slave=svc_slave,
+    )
+    do_lb = (~ingress) & svc_found
+    eff_daddr = jnp.where(do_lb, lb_daddr, flows.daddr.astype(jnp.uint32))
+    eff_dport = jnp.where(do_lb, lb_dport, flows.dport)
+    rev_nat = jnp.where(do_lb, lb_rev, 0)
+    lb_slave = jnp.where(do_lb, slave, 0)
+
+    # -- 3. conntrack on the effective tuple (ct_lookup4) -------------------
+    ct_res, ct_rev, _ = ct_lookup_batch(
+        tables.ct,
+        eff_daddr,
+        flows.saddr,
+        eff_dport,
+        flows.sport,
+        flows.proto,
+        flows.direction,
+    )
+
+    # -- 4. identity derivation (ipcache LPM; WORLD fallback) ---------------
+    sec_ip = jnp.where(
+        ingress, flows.saddr.astype(jnp.uint32), eff_daddr
+    )
+    looked = _lookup_kernel(tables.ipcache, sec_ip)
+    sec_id = jnp.where(
+        looked == 0, jnp.uint32(RESERVED_WORLD), looked
+    ).astype(jnp.uint32)
+
+    # -- 5. policy lattice (always evaluated, bpf_lxc.c:959) ----------------
+    v = _verdict_kernel(
+        tables.policy,
+        TupleBatch(
+            ep_index=flows.ep_index,
+            identity=sec_id,
+            dport=eff_dport,
+            proto=flows.proto,
+            direction=flows.direction,
+            is_fragment=flows.is_fragment,
+        ),
+    )
+
+    # -- 6. combine (bpf_lxc.c:962-985) -------------------------------------
+    pol_allow = v.allowed.astype(bool)
+    pass_ct = (ct_res == CT_REPLY) | (ct_res == CT_RELATED)
+    allowed = (~pre_drop) & (pass_ct | pol_allow)
+    ct_delete = (
+        (ct_res == CT_ESTABLISHED) & ~pol_allow & ~pass_ct & ~pre_drop
+    )
+    ct_create = (ct_res == CT_NEW) & allowed
+    proxy = jnp.where(
+        pol_allow
+        & ((ct_res == CT_NEW) | (ct_res == CT_ESTABLISHED))
+        & allowed,
+        v.proxy_port,
+        0,
+    )
+
+    return DatapathVerdicts(
+        allowed=allowed.astype(jnp.uint8),
+        proxy_port=proxy,
+        match_kind=v.match_kind,
+        ct_result=ct_res,
+        pre_dropped=pre_drop,
+        sec_id=sec_id,
+        final_daddr=eff_daddr,
+        final_dport=eff_dport,
+        rev_nat=rev_nat,
+        lb_slave=lb_slave,
+        ct_create=ct_create,
+        ct_delete=ct_delete,
+    )
+
+
+datapath_step = jax.jit(_datapath_kernel)
+
+
+def apply_ct_writeback(
+    ct: CTMap, out: DatapathVerdicts, flows: FlowBatch, now: int = 0
+) -> tuple:
+    """Host-side CT mutation after a batch: create entries for
+    NEW+allowed flows (ct_create4, bpf_lxc.c:978) and delete
+    ESTABLISHED-but-now-denied entries (ct_delete4, bpf_lxc.c:968).
+    Returns (created, deleted)."""
+    create = np.asarray(out.ct_create)
+    delete = np.asarray(out.ct_delete)
+    daddr = np.asarray(out.final_daddr)
+    dport = np.asarray(out.final_dport)
+    saddr = np.asarray(flows.saddr)
+    sport = np.asarray(flows.sport)
+    proto = np.asarray(flows.proto)
+    direction = np.asarray(flows.direction)
+    rev_nat = np.asarray(out.rev_nat)
+    slave = np.asarray(out.lb_slave)
+
+    created = deleted = 0
+    for i in np.nonzero(create)[0]:
+        d = int(direction[i])
+        tup = CTTuple(
+            int(daddr[i]), int(saddr[i]), int(dport[i]), int(sport[i]),
+            int(proto[i]),
+        )
+        flags = TUPLE_F_OUT if d == CT_INGRESS else TUPLE_F_IN
+        key = CTTuple(
+            tup.daddr, tup.saddr, tup.dport, tup.sport, tup.nexthdr, flags
+        )
+        if key in ct.entries:
+            continue  # duplicate within the batch
+        ct.create(
+            tup, d, now=now, rev_nat_index=int(rev_nat[i]),
+            slave=int(slave[i]),
+        )
+        created += 1
+    for i in np.nonzero(delete)[0]:
+        d = int(direction[i])
+        flags = TUPLE_F_OUT if d == CT_INGRESS else TUPLE_F_IN
+        key = CTTuple(
+            int(daddr[i]), int(saddr[i]), int(dport[i]), int(sport[i]),
+            int(proto[i]), flags,
+        )
+        if ct.entries.pop(key, None) is not None:
+            deleted += 1
+    return created, deleted

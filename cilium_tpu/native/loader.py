@@ -232,7 +232,7 @@ def parse_packets(buf: bytes, offsets: np.ndarray):
     if n > 0:
         if (np.diff(offsets.astype(np.int64)) < 0).any():
             raise ValueError("packet offsets must be non-decreasing")
-        if int(offsets[-1]) > len(buf) or int(offsets[0]) > len(buf):
+        if int(offsets[-1]) > len(buf):
             raise ValueError(
                 f"packet offsets exceed buffer length ({int(offsets[-1])}"
                 f" > {len(buf)})"

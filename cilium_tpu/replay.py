@@ -46,7 +46,8 @@ def read_batches(
     (unknown endpoints map to 0 — callers should pre-filter)."""
     rec = decode_flow_records(buf)
     n = len(rec["ep_id"])
-    ep_index = rec["ep_id"].astype(np.int32)
+    # int64: a u32 ep_id near 2^32 must not wrap negative pre-LUT
+    ep_index = rec["ep_id"].astype(np.int64)
     if ep_map is not None:
         lut = np.zeros(max(ep_map.keys(), default=0) + 1, dtype=np.int32)
         for ep_id, idx in ep_map.items():
@@ -54,7 +55,8 @@ def read_batches(
         in_range = ep_index < len(lut)
         ep_index = np.where(
             in_range, lut[np.minimum(ep_index, len(lut) - 1)], 0
-        ).astype(np.int32)
+        )
+    ep_index = ep_index.astype(np.int32)
     for start in range(0, n, batch_size):
         end = min(start + batch_size, n)
         pad = batch_size - (end - start)
@@ -98,9 +100,7 @@ def replay(
     """
     import time
 
-    import jax
-
-    step = jax.jit(_verdict_kernel_with_counters)
+    step = _replay_step()
     stats = ReplayStats()
     acc = _CounterAccumulator() if accumulate_counters else None
 
@@ -145,11 +145,27 @@ def _drain(item, stats: ReplayStats, acc: Optional[_CounterAccumulator]) -> None
         acc.add(l4_counts, l3_counts)
 
 
+_REPLAY_STEP = None
+
+
+def _replay_step():
+    """Module-level jitted datapath step (one compilation cache across
+    replay() calls, like engine.verdict.evaluate_batch)."""
+    global _REPLAY_STEP
+    if _REPLAY_STEP is None:
+        import jax
+
+        _REPLAY_STEP = jax.jit(_verdict_kernel_with_counters)
+    return _REPLAY_STEP
+
+
 def slot_keys_from_tables(tables) -> Dict[int, Tuple[int, int]]:
     """Recover global L4 slot → (dport, proto) from the compiled
     port_slot table (the inverse of lower_map_state's slot_of)."""
+    from cilium_tpu.compiler.tables import NO_SLOT
+
     port_slot = np.asarray(tables.port_slot)
-    protos, dports = np.nonzero(port_slot != np.uint16(0xFFFF))
+    protos, dports = np.nonzero(port_slot != NO_SLOT)
     slots = port_slot[protos, dports]
     return {
         int(j): (int(dport), int(proto))
