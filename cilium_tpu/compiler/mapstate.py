@@ -71,21 +71,71 @@ def resolve_l4_policy(
     ep_labels: LabelArray,
     ingress_enabled: bool = True,
     egress_enabled: bool = True,
+    rules=None,
 ) -> L4Policy:
-    """policy.go:222 resolveL4Policy."""
+    """policy.go:222 resolveL4Policy.  `rules` restricts the walk to
+    the endpoint's relevant sublist (RuleIndex invariant)."""
     from cilium_tpu.policy.l4 import L4PolicyMap
 
     ingress = (
-        repo.resolve_l4_ingress_policy(SearchContext(to_labels=ep_labels))
+        repo.resolve_l4_ingress_policy(
+            SearchContext(to_labels=ep_labels), rules
+        )
         if ingress_enabled
         else L4PolicyMap()
     )
     egress = (
-        repo.resolve_l4_egress_policy(SearchContext(from_labels=ep_labels))
+        repo.resolve_l4_egress_policy(
+            SearchContext(from_labels=ep_labels), rules
+        )
         if egress_enabled
         else L4PolicyMap()
     )
     return L4Policy(ingress=ingress, egress=egress)
+
+
+def _l3_allowed_identities(
+    repo: Repository,
+    selector_cache,
+    ep_labels: LabelArray,
+    ingress: bool,
+    rules=None,
+) -> frozenset:
+    """The set of identities whose label-only verdict is ALLOWED,
+    computed with set algebra over the SelectorCache instead of the
+    per-identity can_reach walk.
+
+    Derivation from the reference lattice (repository.go:80 +
+    rule.go:352-391): iterating rules, the first DENIED (an unmet
+    FromRequires of any rule selecting the endpoint) terminates with
+    Denied, and ALLOWED (an L3-only allow match) is remembered
+    otherwise — so the final label verdict for an identity is ALLOWED
+    iff (a) no relevant rule's requires reject it, and (b) some
+    relevant rule's L3-only (no ToPorts) block selects it.  Both are
+    unions/intersections of selector match sets.
+    """
+    universe = selector_cache.identities()
+    allowed: set = set()
+    denied: set = set()
+    for r in repo.rules if rules is None else rules:
+        if not r.endpoint_selector.matches(ep_labels):
+            continue
+        blocks = r.rule.ingress if ingress else r.rule.egress
+        for b in blocks:
+            requires = b.from_requires if ingress else b.to_requires
+            for sel in requires:
+                denied |= universe - selector_cache.matches(sel)
+        for b in blocks:
+            if len(b.to_ports) != 0:
+                continue
+            sels = (
+                b.get_source_endpoint_selectors()
+                if ingress
+                else b.get_destination_endpoint_selectors()
+            )
+            for sel in sels:
+                allowed |= selector_cache.matches(sel)
+    return frozenset(allowed - denied)
 
 
 def compute_desired_policy_map_state(
@@ -98,6 +148,8 @@ def compute_desired_policy_map_state(
     egress_enabled: bool = True,
     realized_redirects: Optional[Dict[str, int]] = None,
     l4_policy: Optional[L4Policy] = None,
+    selector_cache=None,
+    rules=None,
 ) -> PolicyMapState:
     """computeDesiredPolicyMapState (policy.go:273), phase-ordered as the
     reference: L4 entries, then localhost/world overrides, then the
@@ -107,13 +159,26 @@ def compute_desired_policy_map_state(
     redirect filters with no allocated port are skipped
     (policy.go:157-166), exactly as the reference defers them to
     addNewRedirectsFromMap.
+
+    `selector_cache` (a synced compiler.selectorcache.SelectorCache)
+    switches selector→identity resolution and the L3 loop to indexed
+    set algebra — same results, O(selectors) instead of
+    O(identities × selectors).
     """
     desired: PolicyMapState = {}
     if l4_policy is None:
         l4_policy = resolve_l4_policy(
-            repo, ep_labels, ingress_enabled, egress_enabled
+            repo, ep_labels, ingress_enabled, egress_enabled, rules
         )
     redirects = realized_redirects or {}
+    if selector_cache is not None and len(
+        selector_cache.identities()
+    ) != len(identity_cache):
+        # cheap guard only — full sync is the caller's contract
+        raise ValueError(
+            "selector_cache universe is out of sync with identity_cache; "
+            "call selector_cache.sync(identity_cache) first"
+        )
 
     # --- computeDesiredL4PolicyMapEntries (policy.go:143) -------------------
     for direction, l4map in (
@@ -127,8 +192,24 @@ def compute_desired_policy_map_state(
                 proxy_port = redirects.get(pid, 0)
                 if proxy_port == 0:
                     continue
-            for key in _convert_l4_filter_to_keys(identity_cache, f, direction):
-                desired[key] = PolicyMapStateEntry(proxy_port=proxy_port)
+            if selector_cache is not None:
+                for sel in f.endpoints:
+                    for num_id in selector_cache.matches(sel):
+                        desired[
+                            PolicyKey(
+                                identity=num_id,
+                                dest_port=f.port,
+                                nexthdr=f.u8proto,
+                                traffic_direction=direction,
+                            )
+                        ] = PolicyMapStateEntry(proxy_port=proxy_port)
+            else:
+                for key in _convert_l4_filter_to_keys(
+                    identity_cache, f, direction
+                ):
+                    desired[key] = PolicyMapStateEntry(
+                        proxy_port=proxy_port
+                    )
 
     # --- determineAllowLocalhost (policy.go:285) ----------------------------
     if option.Config.always_allow_localhost() or l4_policy.has_redirect():
@@ -139,6 +220,31 @@ def compute_desired_policy_map_state(
         desired[WORLD_KEY] = PolicyMapStateEntry()
 
     # --- computeDesiredL3PolicyMapEntries (policy.go:318) -------------------
+    if selector_cache is not None:
+        ing_allowed = (
+            _l3_allowed_identities(
+                repo, selector_cache, ep_labels, True, rules
+            )
+            if ingress_enabled
+            else frozenset(identity_cache)
+        )
+        eg_allowed = (
+            _l3_allowed_identities(
+                repo, selector_cache, ep_labels, False, rules
+            )
+            if egress_enabled
+            else frozenset(identity_cache)
+        )
+        for num_id in ing_allowed:
+            desired[
+                PolicyKey(identity=num_id, traffic_direction=INGRESS)
+            ] = PolicyMapStateEntry()
+        for num_id in eg_allowed:
+            desired[
+                PolicyKey(identity=num_id, traffic_direction=EGRESS)
+            ] = PolicyMapStateEntry()
+        return desired
+
     for num_id, labels in identity_cache.items():
         if ingress_enabled:
             ctx = SearchContext(from_labels=labels, to_labels=ep_labels)

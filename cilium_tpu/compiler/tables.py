@@ -297,3 +297,316 @@ def compile_map_states(
     return lower_map_state(
         states, build_id_table(identity_ids, identity_pad), filter_pad
     )
+
+
+class FleetCompiler:
+    """Incremental fleet lowering — the delta-compilation seam.
+
+    The one-shot path rebuilds everything per policy event: the 32 MB
+    `port_slot`, the direct identity index, and every endpoint's bit
+    rows — O(fleet) per event (SURVEY §7 hard part 4; the reference
+    gates this per-endpoint with revision checks,
+    pkg/endpoint/policy.go:540-552).  This compiler caches each piece
+    keyed on what actually invalidates it:
+
+      * identity universe — arrival-ordered, append-only: adding an
+        identity appends an index instead of re-sorting, so existing
+        bit rows stay valid.  Removing one forces a full reset (rare:
+        identity GC).
+      * L4 slot space — monotonic: new (dport, proto) keys append new
+        slots; `port_slot` is copied-on-write only when keys appear.
+      * per-endpoint rows — relowered only when the endpoint's
+        `state_token` changes (the endpoint bumps it in
+        sync_policy_map); stacked rows are padded up lazily when the
+        identity/slot buckets grow.
+
+    The produced PolicyTables are bit-compatible with the engine but
+    NOT byte-identical to compile_map_states (slot and identity order
+    differ); verdicts are identical — tests compare through the
+    engine/oracle, never raw tables.
+    """
+
+    def __init__(
+        self, identity_pad: int = 1024, filter_pad: int = 64
+    ) -> None:
+        self.identity_pad = identity_pad
+        self.filter_pad = filter_pad
+        self._reset()
+
+    def _reset(self) -> None:
+        self._id_list: List[int] = []
+        self._id_index: Dict[int, int] = {}
+        self._slot_of: Dict[Tuple[int, int], int] = {}
+        self._slot_list: List[Tuple[int, int]] = []  # arrival order
+        # double-buffered port_slot: each buffer tracks how many slots
+        # it has applied; updates write only the new cells
+        self._port_slot_bufs = [
+            {
+                "arr": np.full((256, 65536), NO_SLOT, dtype=np.uint16),
+                "applied": 0,
+            }
+            for _ in range(2)
+        ]
+        self._port_slot_flip = 0
+        # cached per-endpoint rows: ep_id → dict(token, kg, w, meta,
+        # l4, l3)
+        self._rows: Dict[int, dict] = {}
+        # double-buffered stacked tensors (the realized/backup map
+        # shuffle of pkg/datapath/ipcache/listener.go:167): each
+        # buffer records the token its copy of every endpoint's rows
+        # reflects, so a delta compile copies only rows that moved
+        # since THIS buffer was last published.  Consumers may hold
+        # the previously-published tables safely for one flip.
+        self._stack_bufs: List[Optional[dict]] = [None, None]
+        self._stack_flip = 0
+        self._id_table: np.ndarray = None  # rebuilt lazily
+        self._id_direct: np.ndarray = None
+        self._id_lo_len: int = 0
+        self._id_tables_dirty = True
+
+    # -- identity universe ---------------------------------------------------
+
+    def _sync_universe(self, identity_ids: Sequence[int]) -> None:
+        want = set(int(i) for i in identity_ids)
+        have = self._id_index.keys()
+        if not want >= have:
+            # removal: indices would shift — full reset
+            self._reset()
+            want = set(int(i) for i in identity_ids)
+        new = want - self._id_index.keys()
+        if new:
+            for num_id in sorted(new):
+                self._id_index[num_id] = len(self._id_list)
+                self._id_list.append(num_id)
+            self._id_tables_dirty = True
+
+    def _padded_n(self) -> int:
+        n = _round_up(
+            max(len(self._id_list), 1), self.identity_pad
+        )
+        return _round_up(n, 32)
+
+    def identity_index(self) -> Tuple[Dict[int, int], int]:
+        """(identity id → dense index, padded identity count) for the
+        CURRENT universe — the same index space as the produced
+        tables' id_direct.  Consumers compiling parallel per-identity
+        tensors (the L7 ident_rules) MUST use this, not a sorted
+        rebuild, or their identity axes diverge from the engine's."""
+        return dict(self._id_index), self._padded_n()
+
+    def _ensure_id_tables(self) -> None:
+        if not self._id_tables_dirty and self._id_table is not None:
+            return
+        n = self._padded_n()
+        table = np.full((n,), PAD_ID, dtype=np.uint32)
+        table[: len(self._id_list)] = np.asarray(
+            self._id_list, dtype=np.uint32
+        )
+        # arrival order ≠ sorted: build the direct index from the
+        # arrival-ordered table (never via build_id_table, which sorts)
+        ids = np.asarray(self._id_list, dtype=np.int64)
+        index = np.arange(len(ids), dtype=np.uint32)
+        local_mask = ids >= LOCAL_ID_BASE
+        lo_ids, lo_idx = ids[~local_mask], index[~local_mask]
+        local_ids = ids[local_mask] - LOCAL_ID_BASE
+        local_idx = index[local_mask]
+        lo_max = int(lo_ids.max()) + 1 if len(lo_ids) else 1
+        ll_max = int(local_ids.max()) + 1 if len(local_ids) else 1
+        if lo_max > MAX_DIRECT or ll_max > MAX_DIRECT:
+            raise ValueError(
+                f"identity id range too large for direct indexing "
+                f"(lo={lo_max}, local={ll_max}, cap={MAX_DIRECT})"
+            )
+        lo_len = _pow2_at_least(lo_max, 1024)
+        ll_len = _pow2_at_least(ll_max, 32)
+        direct = np.full(lo_len + ll_len, NO_INDEX, dtype=np.uint32)
+        direct[lo_ids] = lo_idx
+        direct[lo_len + local_ids] = local_idx
+        self._id_table = table
+        self._id_direct = direct
+        self._id_lo_len = lo_len
+        self._id_tables_dirty = False
+
+    # -- slot space ----------------------------------------------------------
+
+    def _ensure_slots(self, state: PolicyMapState) -> bool:
+        """Append slots for unseen (dport, proto) keys.  Returns True
+        if the slot space grew."""
+        grew = False
+        for k in state:
+            if k.is_l3_only():
+                continue
+            key = (k.dest_port, k.nexthdr)
+            if key not in self._slot_of:
+                self._slot_of[key] = len(self._slot_list)
+                self._slot_list.append(key)
+                grew = True
+        return grew
+
+    def _current_port_slot(self) -> np.ndarray:
+        """Flip to the standby port_slot buffer and catch it up with
+        the slots appended since it was last published (cells are
+        written exactly once, so catching up is O(new slots))."""
+        buf = self._port_slot_bufs[self._port_slot_flip]
+        if buf["applied"] == len(self._slot_list):
+            return buf["arr"]
+        self._port_slot_flip ^= 1
+        buf = self._port_slot_bufs[self._port_slot_flip]
+        for j in range(buf["applied"], len(self._slot_list)):
+            dport, proto = self._slot_list[j]
+            buf["arr"][proto & 0xFF, dport] = j
+        buf["applied"] = len(self._slot_list)
+        return buf["arr"]
+
+    def _padded_kg(self) -> int:
+        return _round_up(
+            max(len(self._slot_of), 1), self.filter_pad
+        )
+
+    # -- per-endpoint rows ---------------------------------------------------
+
+    def _lower_rows(self, state: PolicyMapState) -> dict:
+        n = self._padded_n()
+        w = n // 32
+        kg = self._padded_kg()
+        meta = np.zeros((2, kg), dtype=np.uint32)
+        l4 = np.zeros((2, kg, w), dtype=np.uint32)
+        l3 = np.zeros((2, w), dtype=np.uint32)
+        proxy_seen: Dict[Tuple[int, int], int] = {}
+        for key, entry in state.items():
+            d = key.traffic_direction
+            if key.is_l3_only():
+                idx = self._id_index.get(key.identity)
+                if idx is None:
+                    raise ValueError(
+                        f"identity {key.identity} in map state but not "
+                        f"in the identity universe (universe/table skew)"
+                    )
+                l3[d, idx >> 5] |= np.uint32(1 << (idx & 31))
+                continue
+            j = self._slot_of[(key.dest_port, key.nexthdr)]
+            prev = proxy_seen.setdefault((d, j), entry.proxy_port)
+            if prev != entry.proxy_port:
+                raise ValueError(
+                    f"conflicting proxy ports for slot "
+                    f"{(key.dest_port, key.nexthdr, d)}: "
+                    f"{prev} vs {entry.proxy_port}"
+                )
+            meta[d, j] |= np.uint32(entry.proxy_port << 1)
+            if key.identity == 0:
+                meta[d, j] |= np.uint32(1)
+            else:
+                idx = self._id_index.get(key.identity)
+                if idx is None:
+                    raise ValueError(
+                        f"identity {key.identity} in map state but not "
+                        f"in the identity universe (universe/table skew)"
+                    )
+                l4[d, j, idx >> 5] |= np.uint32(1 << (idx & 31))
+        return {"kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3}
+
+    @staticmethod
+    def _pad_rows(rows: dict, kg: int, w: int) -> dict:
+        """Grow cached rows to the current buckets (zero columns for
+        new slots / identity words keep old bits valid)."""
+        if rows["kg"] == kg and rows["w"] == w:
+            return rows
+        dk, dw = kg - rows["kg"], w - rows["w"]
+        rows = dict(
+            rows,
+            kg=kg,
+            w=w,
+            meta=np.pad(rows["meta"], ((0, 0), (0, dk))),
+            l4=np.pad(rows["l4"], ((0, 0), (0, dk), (0, dw))),
+            l3=np.pad(rows["l3"], ((0, 0), (0, dw))),
+        )
+        return rows
+
+    # -- compile -------------------------------------------------------------
+
+    def compile(
+        self,
+        endpoints: Sequence[Tuple[int, PolicyMapState, int]],
+        identity_ids: Sequence[int],
+    ) -> Tuple[PolicyTables, Dict[int, int]]:
+        """Lower the fleet incrementally.
+
+        `endpoints` is [(ep_id, realized_map_state, state_token)];
+        rows are relowered only when the token differs from the cached
+        one.  Returns (tables, ep_id → endpoint-axis index).
+        """
+        self._sync_universe(identity_ids)
+
+        live = {ep_id for ep_id, _, _ in endpoints}
+        for gone in set(self._rows) - live:
+            del self._rows[gone]
+
+        dirty = []
+        for ep_id, state, token in endpoints:
+            cached = self._rows.get(ep_id)
+            if cached is None or cached["token"] != token:
+                dirty.append((ep_id, state, token))
+                self._ensure_slots(state)
+
+        self._ensure_id_tables()
+        n = self._padded_n()
+        w = n // 32
+        kg = self._padded_kg()
+
+        for ep_id, state, token in dirty:
+            rows = self._lower_rows(state)
+            rows["token"] = token
+            self._rows[ep_id] = rows
+
+        order = sorted(live)
+        index = {ep_id: i for i, ep_id in enumerate(order)}
+        if order:
+            for ep_id in order:
+                self._rows[ep_id] = self._pad_rows(
+                    self._rows[ep_id], kg, w
+                )
+            l4_meta, l4_bits, l3_bits = self._stacked(order, kg, w)
+        else:
+            l4_meta = np.zeros((1, 2, kg), dtype=np.uint32)
+            l4_bits = np.zeros((1, 2, kg, w), dtype=np.uint32)
+            l3_bits = np.zeros((1, 2, w), dtype=np.uint32)
+
+        tables = PolicyTables(
+            id_table=self._id_table,
+            id_direct=self._id_direct,
+            id_lo_len=np.int32(self._id_lo_len),
+            port_slot=self._current_port_slot(),
+            l4_meta=l4_meta,
+            l4_allow_bits=l4_bits,
+            l3_allow_bits=l3_bits,
+        )
+        return tables, index
+
+    def _stacked(self, order: List[int], kg: int, w: int):
+        """Write rows into the standby stacked buffer, copying only
+        endpoints whose token differs from what this buffer already
+        holds.  A full np.stack happens only when the endpoint set or
+        the padded shapes change."""
+        self._stack_flip ^= 1
+        buf = self._stack_bufs[self._stack_flip]
+        shape_key = (tuple(order), kg, w)
+        if buf is None or buf["shape_key"] != shape_key:
+            e = len(order)
+            buf = {
+                "shape_key": shape_key,
+                "meta": np.empty((e, 2, kg), dtype=np.uint32),
+                "l4": np.empty((e, 2, kg, w), dtype=np.uint32),
+                "l3": np.empty((e, 2, w), dtype=np.uint32),
+                "tokens": {},
+            }
+            self._stack_bufs[self._stack_flip] = buf
+        tokens = buf["tokens"]
+        for i, ep_id in enumerate(order):
+            rows = self._rows[ep_id]
+            if tokens.get(ep_id) == rows["token"]:
+                continue
+            buf["meta"][i] = rows["meta"]
+            buf["l4"][i] = rows["l4"]
+            buf["l3"][i] = rows["l3"]
+            tokens[ep_id] = rows["token"]
+        return buf["meta"], buf["l4"], buf["l3"]

@@ -93,6 +93,14 @@ class Daemon:
         if kvstore is not None:
             self._ip_watcher = IPIdentityWatcher(kvstore, self.ipcache)
         self.clustermesh = ClusterMesh(self.ipcache)
+        # indexed selector -> identity-set resolution for the compiler
+        from cilium_tpu.compiler.selectorcache import RuleIndex, SelectorCache
+
+        self.selector_cache = SelectorCache()
+        self.rule_index = RuleIndex()
+        # endpoint selectors of rules changed since the last sweep;
+        # None = a non-policy reason forced a full sweep
+        self._pending_rule_selectors: Optional[list] = []
         self.monitor = MonitorBus()
         self.proxy = Proxy(monitor=self.monitor)
         self.controllers = ControllerManager()
@@ -118,7 +126,7 @@ class Daemon:
             ):
                 self.endpoint_manager.insert(endpoint)
             if self.endpoint_manager.endpoints():
-                self.trigger_policy_updates("restore")
+                self.trigger_policy_updates("restore", full=True)
 
     # -- identity snapshot ---------------------------------------------------
 
@@ -151,7 +159,11 @@ class Daemon:
                 )
             if replace:
                 for rule in rules:
+                    for old in self.repo.search(rule.labels):
+                        self._note_rule_change(old.endpoint_selector)
                     self.repo.delete_by_labels(rule.labels)
+            for rule in rules:
+                self._note_rule_change(rule.endpoint_selector)
             revision = self.repo.add_list(list(rules))
             metrics.policy_count.set(self.repo.num_rules())
             metrics.policy_revision.set(revision)
@@ -162,6 +174,8 @@ class Daemon:
         """PolicyDelete (daemon/policy.go:240)."""
         with self.lock:
             deleted_rules = self.repo.search(labels)
+            for old in deleted_rules:
+                self._note_rule_change(old.endpoint_selector)
             prefixes = get_cidr_prefixes(deleted_rules)
             revision, n_deleted = self.repo.delete_by_labels(labels)
             if n_deleted:
@@ -188,7 +202,17 @@ class Daemon:
 
     # -- regeneration (daemon/policy.go:47 TriggerPolicyUpdates) ------------
 
-    def trigger_policy_updates(self, reason: str) -> None:
+    def _note_rule_change(self, endpoint_selector) -> None:
+        """Record a changed rule's endpoint selector for delta-scoped
+        regeneration (a rule affects only endpoints it selects)."""
+        if self._pending_rule_selectors is not None:
+            self._pending_rule_selectors.append(endpoint_selector)
+
+    def trigger_policy_updates(self, reason: str, full: bool = False) -> None:
+        if full:
+            # non-policy reason (endpoint/identity/config change):
+            # next sweep must not be delta-scoped
+            self._pending_rule_selectors = None
         self.policy_trigger.trigger_with_reason(reason)
 
     def _regenerate_for_reasons(self, reasons: List[str]) -> None:
@@ -198,23 +222,44 @@ class Daemon:
         stats = SpanStats()
         stats.span("total").start()
         cache = self.identity_cache()
+        prev_version = self.selector_cache.version
+        universe_version = self.selector_cache.sync(cache)
+        # Swap the pending set and snapshot the repo revision under
+        # the daemon lock: a concurrent policy_add after the swap must
+        # not be fast-forwarded past (its selector isn't in `pending`).
+        with self.lock:
+            pending, self._pending_rule_selectors = (
+                self._pending_rule_selectors,
+                [],
+            )
+            affected_revision = self.repo.get_revision()
+        affected = None
+        if pending is not None and universe_version == prev_version:
+            affected = frozenset().union(
+                *(
+                    self.selector_cache.matches(sel)
+                    for sel in pending
+                ),
+            ) if pending else frozenset()
+        self.rule_index.build(self.repo, self.selector_cache)
         n = self.endpoint_manager.regenerate_all(
-            self.repo, cache, reason
+            self.repo,
+            cache,
+            reason,
+            selector_cache=self.selector_cache,
+            rule_index=self.rule_index,
+            universe_version=universe_version,
+            affected_identities=affected,
+            affected_revision=affected_revision,
         )
         # Two-phase redirect realization (pkg/endpoint/bpf.go:488 +
         # policy.go:157-166): the first pass computes desired L4
         # policy; redirects then get proxy ports allocated; endpoints
         # whose redirects changed recompute so the L4 entries carry
-        # the allocated ports.
-        from cilium_tpu.compiler.tables import build_id_table, PAD_ID
-
-        id_table = build_id_table(list(cache))
-        id_index = {
-            int(v): i
-            for i, v in enumerate(id_table.tolist())
-            if v != int(PAD_ID)
-        }
-        n_identities = id_table.shape[0]
+        # the allocated ports.  The L7 tables' identity axis MUST be
+        # the fleet compiler's index space (the published tables'
+        # id_direct), not a sorted rebuild.
+        id_index, n_identities = self.endpoint_manager.identity_index()
         dirty = False
         for endpoint in self.endpoint_manager.endpoints():
             l4 = endpoint.desired_l4_policy
@@ -233,7 +278,12 @@ class Daemon:
                 dirty = True
         if dirty:
             self.endpoint_manager.regenerate_all(
-                self.repo, cache, reason + " (redirects realized)"
+                self.repo,
+                cache,
+                reason + " (redirects realized)",
+                selector_cache=self.selector_cache,
+                rule_index=self.rule_index,
+                universe_version=universe_version,
             )
         metrics.policy_regeneration_count.inc(value=n)
         stats.span("total").end()
@@ -271,7 +321,9 @@ class Daemon:
                 upsert_ip_mapping(
                     self.kvstore, ipv4, ident.id, node=self.node_name
                 )
-        self.trigger_policy_updates(f"endpoint {endpoint_id} created")
+        self.trigger_policy_updates(
+            f"endpoint {endpoint_id} created", full=True
+        )
         return endpoint
 
     def delete_endpoint(self, endpoint_id: int) -> bool:

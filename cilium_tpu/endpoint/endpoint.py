@@ -17,6 +17,7 @@ PolicyTables (manager.py) — the datapath "reload".
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -89,6 +90,9 @@ _BUILDER_TRANSITIONS = {
 }
 
 
+_ENDPOINT_NONCE = itertools.count(1)
+
+
 class Endpoint:
     """pkg/endpoint.Endpoint, reduced to the policy-relevant core."""
 
@@ -108,6 +112,7 @@ class Endpoint:
         self.policy_revision = 0
         self.next_policy_revision = 0
         self.prev_identity_cache: Optional[IdentityCache] = None
+        self.prev_universe_version: Optional[int] = None
         self.force_policy_compute = False
         self.ingress_policy_enabled = False
         self.egress_policy_enabled = False
@@ -115,6 +120,13 @@ class Endpoint:
         self.l3_policy: Optional[CIDRPolicy] = None
         self.desired_map_state: PolicyMapState = {}
         self.realized_map_state: PolicyMapState = {}
+        # bumped whenever realized_map_state content changes; combined
+        # with the per-instance nonce it forms the content token the
+        # incremental fleet compiler keys cached rows on (the nonce
+        # keeps tokens unique across endpoint re-creation with a
+        # recycled id)
+        self.map_state_revision = 0
+        self.instance_nonce = next(_ENDPOINT_NONCE)
         self.realized_redirects: Dict[str, int] = {}
 
         self.lock = threading.RLock()
@@ -156,7 +168,9 @@ class Endpoint:
 
     # -- policy computation (policy.go:506 regeneratePolicy) ----------------
 
-    def compute_policy_enforcement(self, repo) -> Tuple[bool, bool]:
+    def compute_policy_enforcement(
+        self, repo, rules=None
+    ) -> Tuple[bool, bool]:
         """ComputePolicyEnforcement (policy.go:643)."""
         mode = option.Config.policy_enforcement
         if mode == option.ALWAYS_ENFORCE:
@@ -165,37 +179,88 @@ class Endpoint:
             if self.is_init():
                 return True, True
             return repo.get_rules_matching(
-                self.security_identity.label_array
+                self.security_identity.label_array, rules
             )
         return False, False
 
-    def regenerate_policy(self, repo, identity_cache: IdentityCache) -> bool:
+    def regenerate_policy(
+        self,
+        repo,
+        identity_cache: IdentityCache,
+        selector_cache=None,
+        rule_index=None,
+        universe_version=None,
+        affected_identities=None,
+        affected_revision=None,
+    ) -> bool:
         """regeneratePolicy (policy.go:506).  Returns whether the
-        desired state may have changed (False = revision-gated skip)."""
+        desired state may have changed (False = revision-gated skip).
+
+        With `universe_version` (the SelectorCache version at snapshot
+        time) the identity-snapshot comparison is O(1) instead of a
+        full dict compare.  With `affected_identities` (the union of
+        changed rules' endpoint-selector matches) an endpoint whose
+        identity is unaffected skips recomputation entirely and just
+        fast-forwards its revision — the precise form of the
+        reference's revision gating (policy.go:540-552): a rule can
+        only change an endpoint's policy if its endpoint_selector
+        selects it."""
         if self.security_identity is None:
             return False
 
-        # Use the previous snapshot object when contents are equal
-        # (policy.go:530-533) so the skip below can compare by "is".
-        if (
-            self.prev_identity_cache is not None
-            and self.prev_identity_cache == identity_cache
-        ):
-            identity_cache = self.prev_identity_cache
+        if universe_version is not None:
+            universe_unchanged = (
+                self.prev_universe_version == universe_version
+            )
+        else:
+            # Use the previous snapshot object when contents are equal
+            # (policy.go:530-533) so the skip below can compare by "is".
+            if (
+                self.prev_identity_cache is not None
+                and self.prev_identity_cache == identity_cache
+            ):
+                identity_cache = self.prev_identity_cache
+            universe_unchanged = identity_cache is self.prev_identity_cache
 
         revision = repo.get_revision()
         if (
             not self.force_policy_compute
             and self.next_policy_revision >= revision
-            and identity_cache is self.prev_identity_cache
+            and universe_unchanged
         ):
             return False
 
+        if (
+            affected_identities is not None
+            and universe_unchanged
+            and not self.force_policy_compute
+            and self.desired_l4_policy is not None
+            and self.security_identity.id not in affected_identities
+        ):
+            # No changed rule selects this endpoint: the desired state
+            # cannot have moved — realize the revision without work.
+            # Fast-forward only to the revision snapshotted WITH the
+            # pending-selector swap: a rule added concurrently after
+            # the swap isn't in `affected_identities` and must not be
+            # marked realized here.
+            self.next_policy_revision = (
+                min(revision, affected_revision)
+                if affected_revision is not None
+                else revision
+            )
+            return False
+
         self.prev_identity_cache = identity_cache
+        self.prev_universe_version = universe_version
+        rules = (
+            rule_index.relevant(self.security_identity.id)
+            if rule_index is not None
+            else None
+        )
         (
             self.ingress_policy_enabled,
             self.egress_policy_enabled,
-        ) = self.compute_policy_enforcement(repo)
+        ) = self.compute_policy_enforcement(repo, rules)
 
         ep_labels = self.security_identity.label_array
         self.desired_l4_policy = resolve_l4_policy(
@@ -203,11 +268,12 @@ class Endpoint:
             ep_labels,
             self.ingress_policy_enabled,
             self.egress_policy_enabled,
+            rules,
         )
 
         # regenerateL3Policy (policy.go:392)
         new_l3 = repo.resolve_cidr_policy(
-            SearchContext(to_labels=ep_labels)
+            SearchContext(to_labels=ep_labels), rules
         )
         new_l3.validate()
         self.l3_policy = new_l3
@@ -221,6 +287,8 @@ class Endpoint:
             egress_enabled=self.egress_policy_enabled,
             realized_redirects=self.realized_redirects,
             l4_policy=self.desired_l4_policy,
+            selector_cache=selector_cache,
+            rules=rules,
         )
 
         self.force_policy_compute = False
@@ -246,6 +314,10 @@ class Endpoint:
                     bytes=old.bytes if old else 0,
                 )
                 self.realized_map_state[key] = entry
+            if to_add or to_delete:
+                # content token for the incremental fleet compiler:
+                # rows relower only when this changes
+                self.map_state_revision += 1
             return len(to_add), len(to_delete)
 
     def bump_policy_revision(self) -> None:

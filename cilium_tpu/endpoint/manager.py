@@ -20,7 +20,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Callable, Dict, List, Optional, Tuple
 
-from cilium_tpu.compiler.tables import PolicyTables, compile_map_states
+from cilium_tpu.compiler.tables import FleetCompiler, PolicyTables
 from cilium_tpu.endpoint.endpoint import (
     STATE_READY,
     STATE_REGENERATING,
@@ -45,6 +45,9 @@ class EndpointManager:
             None,
             {},
         )
+        # incremental lowering: caches identity/slot tables and
+        # per-endpoint rows across publishes (delta compilation)
+        self._fleet_compiler = FleetCompiler()
 
     # -- registry (manager.go Insert/Lookup*) --------------------------------
 
@@ -75,7 +78,15 @@ class EndpointManager:
     # -- regeneration (manager.go:271 RegenerateAllEndpoints) ---------------
 
     def regenerate_endpoint(
-        self, endpoint: Endpoint, repo, identity_cache: IdentityCache
+        self,
+        endpoint: Endpoint,
+        repo,
+        identity_cache: IdentityCache,
+        selector_cache=None,
+        rule_index=None,
+        universe_version=None,
+        affected_identities=None,
+        affected_revision=None,
     ) -> bool:
         """One build: the regenerate→regenerateBPF tail of §3.2 (CT
         scrub and proxy ACKs are owned by their subsystems; here:
@@ -88,8 +99,17 @@ class EndpointManager:
                 # not queued for regeneration (e.g. disconnecting)
                 return False
             try:
-                endpoint.regenerate_policy(repo, identity_cache)
-                endpoint.sync_policy_map()
+                changed = endpoint.regenerate_policy(
+                    repo,
+                    identity_cache,
+                    selector_cache=selector_cache,
+                    rule_index=rule_index,
+                    universe_version=universe_version,
+                    affected_identities=affected_identities,
+                    affected_revision=affected_revision,
+                )
+                if changed:
+                    endpoint.sync_policy_map()
                 endpoint.bump_policy_revision()
                 endpoint.builder_set_state(STATE_READY, "regenerated")
                 return True
@@ -102,7 +122,15 @@ class EndpointManager:
                 raise
 
     def regenerate_all(
-        self, repo, identity_cache: IdentityCache, reason: str = ""
+        self,
+        repo,
+        identity_cache: IdentityCache,
+        reason: str = "",
+        selector_cache=None,
+        rule_index=None,
+        universe_version=None,
+        affected_identities=None,
+        affected_revision=None,
     ) -> int:
         """RegenerateAllEndpoints: mark + rebuild every endpoint (N
         builders in parallel), then publish fresh fleet tables."""
@@ -111,7 +139,15 @@ class EndpointManager:
             endpoint.set_state(STATE_WAITING_TO_REGENERATE, reason)
         futures = [
             self._pool.submit(
-                self.regenerate_endpoint, endpoint, repo, identity_cache
+                self.regenerate_endpoint,
+                endpoint,
+                repo,
+                identity_cache,
+                selector_cache,
+                rule_index,
+                universe_version,
+                affected_identities,
+                affected_revision,
             )
             for endpoint in eps
         ]
@@ -126,14 +162,21 @@ class EndpointManager:
         self, identity_cache: IdentityCache
     ) -> Tuple[PolicyTables, Dict[int, int]]:
         """Lower every endpoint's REALIZED map state into one stacked
-        PolicyTables; returns (tables, ep_id → endpoint-axis index)."""
+        PolicyTables; returns (tables, ep_id → endpoint-axis index).
+
+        Incremental: unchanged endpoints (by map_state_revision) reuse
+        their cached rows; identity/slot tables rebuild only when the
+        universe or key set changes (SURVEY §7 hard part 4)."""
         eps = sorted(self.endpoints(), key=lambda e: e.id)
-        states = [e.realized_map_state for e in eps]
-        index = {e.id: i for i, e in enumerate(eps)}
-        if not states:
-            states = [{}]
-        tables = compile_map_states(states, list(identity_cache))
-        return tables, index
+        entries = [
+            (
+                e.id,
+                e.realized_map_state,
+                (e.instance_nonce, e.map_state_revision),
+            )
+            for e in eps
+        ]
+        return self._fleet_compiler.compile(entries, list(identity_cache))
 
     def publish_tables(self, identity_cache: IdentityCache) -> int:
         """Double-buffered flip: compile the new version, then swap the
@@ -149,3 +192,8 @@ class EndpointManager:
     def published(self) -> Tuple[int, Optional[PolicyTables], Dict[int, int]]:
         with self._lock:
             return self._published
+
+    def identity_index(self) -> Tuple[Dict[int, int], int]:
+        """Identity index space of the (last-compiled) fleet tables —
+        see FleetCompiler.identity_index."""
+        return self._fleet_compiler.identity_index()

@@ -118,12 +118,12 @@ class Repository:
     # -- L4 resolution -------------------------------------------------------
 
     def _collect_ingress_requirements(
-        self, ctx: SearchContext
+        self, ctx: SearchContext, rules=None
     ) -> List[Requirement]:
         """repository.go:252-266: flatten all FromRequires of rules
         selecting ctx.To into selector requirements."""
         reqs: List[Requirement] = []
-        for r in self.rules:
+        for r in self.rules if rules is None else rules:
             for ingress_rule in r.rule.ingress:
                 if r.endpoint_selector.matches(ctx.to_labels):
                     for requirement in ingress_rule.from_requires:
@@ -131,28 +131,35 @@ class Repository:
         return reqs
 
     def _collect_egress_requirements(
-        self, ctx: SearchContext
+        self, ctx: SearchContext, rules=None
     ) -> List[Requirement]:
         """repository.go:297-311."""
         reqs: List[Requirement] = []
-        for r in self.rules:
+        for r in self.rules if rules is None else rules:
             for egress_rule in r.rule.egress:
                 if r.endpoint_selector.matches(ctx.from_labels):
                     for requirement in egress_rule.to_requires:
                         reqs.extend(requirement.convert_to_requirements())
         return reqs
 
-    def resolve_l4_ingress_policy(self, ctx: SearchContext) -> L4PolicyMap:
-        """ResolveL4IngressPolicy (repository.go:245)."""
+    def resolve_l4_ingress_policy(
+        self, ctx: SearchContext, rules=None
+    ) -> L4PolicyMap:
+        """ResolveL4IngressPolicy (repository.go:245).
+
+        `rules` restricts the walk to an ordered subset; callers must
+        guarantee it contains every rule whose endpoint_selector
+        matches ctx.to_labels (the RuleIndex invariant) — other rules
+        are no-ops in this resolution."""
         result = L4Policy()
         ctx.policy_trace("\n")
         ctx.policy_trace(
             "Resolving ingress port policy for %+s\n", ctx.to_labels
         )
         state = TraceState()
-        requirements = self._collect_ingress_requirements(ctx)
+        requirements = self._collect_ingress_requirements(ctx, rules)
 
-        for r in self.rules:
+        for r in self.rules if rules is None else rules:
             found = r.resolve_l4_ingress_policy(
                 ctx, state, result, requirements
             )
@@ -160,20 +167,22 @@ class Repository:
             if found is not None:
                 state.matched_rules += 1
 
-        self._wildcard_l3l4_rules(ctx, True, result.ingress)
+        self._wildcard_l3l4_rules(ctx, True, result.ingress, rules)
         self._trace(state, ctx)
         return result.ingress
 
-    def resolve_l4_egress_policy(self, ctx: SearchContext) -> L4PolicyMap:
+    def resolve_l4_egress_policy(
+        self, ctx: SearchContext, rules=None
+    ) -> L4PolicyMap:
         """ResolveL4EgressPolicy (repository.go:291)."""
         result = L4Policy()
         ctx.policy_trace("\n")
         ctx.policy_trace(
             "Resolving egress port policy for %+s\n", ctx.to_labels
         )
-        requirements = self._collect_egress_requirements(ctx)
+        requirements = self._collect_egress_requirements(ctx, rules)
         state = TraceState()
-        for i, r in enumerate(self.rules):
+        for i, r in enumerate(self.rules if rules is None else rules):
             state.rule_id = i
             found = r.resolve_l4_egress_policy(
                 ctx, state, result, requirements
@@ -183,7 +192,7 @@ class Repository:
                 state.matched_rules += 1
 
         result.revision = self.revision
-        self._wildcard_l3l4_rules(ctx, False, result.egress)
+        self._wildcard_l3l4_rules(ctx, False, result.egress, rules)
         self._trace(state, ctx)
         return result.egress
 
@@ -222,10 +231,14 @@ class Repository:
             l4_policy[k] = f
 
     def _wildcard_l3l4_rules(
-        self, ctx: SearchContext, ingress: bool, l4_policy: L4PolicyMap
+        self,
+        ctx: SearchContext,
+        ingress: bool,
+        l4_policy: L4PolicyMap,
+        rules=None,
     ) -> None:
         """repository.go:170."""
-        for r in self.rules:
+        for r in self.rules if rules is None else rules:
             if ingress:
                 if not r.endpoint_selector.matches(ctx.to_labels):
                     continue
@@ -287,12 +300,14 @@ class Repository:
 
     # -- CIDR ----------------------------------------------------------------
 
-    def resolve_cidr_policy(self, ctx: SearchContext) -> CIDRPolicy:
+    def resolve_cidr_policy(
+        self, ctx: SearchContext, rules=None
+    ) -> CIDRPolicy:
         """ResolveCIDRPolicy (repository.go:340)."""
         result = CIDRPolicy()
         ctx.policy_trace("Resolving L3 (CIDR) policy for %+s\n", ctx.to_labels)
         state = TraceState()
-        for r in self.rules:
+        for r in self.rules if rules is None else rules:
             r.resolve_cidr_policy(ctx, state, result)
             state.rule_id += 1
         self._trace(state, ctx)
@@ -406,12 +421,18 @@ class Repository:
                 return False
         return True
 
-    def get_rules_matching(self, labels: LabelArray) -> Tuple[bool, bool]:
-        """repository.go:624: (ingress_match, egress_match)."""
+    def get_rules_matching(
+        self, labels: LabelArray, rules=None
+    ) -> Tuple[bool, bool]:
+        """repository.go:624: (ingress_match, egress_match).  `rules`
+        restricts the walk to a pre-matched sublist (the RuleIndex
+        invariant: every rule in it selects `labels`), and the
+        per-rule selector check is SKIPPED in that case — callers must
+        not pass a superset."""
         ingress_match = False
         egress_match = False
-        for r in self.rules:
-            if r.endpoint_selector.matches(labels):
+        for r in self.rules if rules is None else rules:
+            if rules is not None or r.endpoint_selector.matches(labels):
                 if len(r.rule.ingress) > 0:
                     ingress_match = True
                 if len(r.rule.egress) > 0:

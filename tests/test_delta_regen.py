@@ -1,0 +1,189 @@
+"""Delta-scoped regeneration correctness.
+
+A policy change regenerates only endpoints the changed rules select
+(endpoint.py regenerate_policy affected_identities fast-forward); the
+published tables must nevertheless be verdict-identical to a fresh
+daemon that imported all rules at once — the reference's guarantee
+that revision bookkeeping never changes policy outcomes
+(pkg/endpoint/policy.go:540-552).
+"""
+
+import numpy as np
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+from cilium_tpu.labels import Label, LabelArray, Labels
+from cilium_tpu.maps.policymap import INGRESS
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+
+
+def es(app):
+    return EndpointSelector(match_labels={"k8s.app": app})
+
+
+def k8s_labels(app):
+    return Labels({"app": Label("app", app, "k8s")})
+
+
+def make_rule(i, sel_app, from_app, port):
+    return Rule(
+        endpoint_selector=es(sel_app),
+        ingress=[
+            IngressRule(
+                from_endpoints=[es(from_app)],
+                to_ports=[
+                    PortRule(
+                        ports=[
+                            PortProtocol(port=str(port), protocol="TCP")
+                        ]
+                    )
+                ],
+            )
+        ],
+        labels=LabelArray.parse(f"rule{i}"),
+    )
+
+
+def build_daemon(n_eps=8):
+    d = Daemon()
+    d.policy_trigger.close(wait=True)
+    for i in range(n_eps):
+        d.create_endpoint(
+            100 + i, k8s_labels(f"app{i}"), name=f"ep{i}"
+        )
+    return d
+
+
+def test_delta_add_matches_full_import():
+    base = [make_rule(i, f"app{i % 8}", f"app{(i + 1) % 8}", 1000 + i)
+            for i in range(32)]
+    extra = make_rule(99, "app3", "app5", 7777)
+    for r in base + [extra]:
+        r.sanitize()
+
+    # daemon A: base rules, then delta-add extra
+    da = build_daemon()
+    for r in base:
+        da._note_rule_change(r.endpoint_selector)
+    da.repo.add_list(base)
+    da.regenerate_all("initial")
+    with da.lock:
+        da._note_rule_change(extra.endpoint_selector)
+        da.repo.add_list([extra])
+    da.regenerate_all("delta")
+
+    # daemon B: everything at once
+    db = build_daemon()
+    for r in base + [extra]:
+        db._note_rule_change(r.endpoint_selector)
+    db.repo.add_list(base + [extra])
+    db.regenerate_all("initial")
+
+    _, ta, ia = da.endpoint_manager.published()
+    _, tb, ib = db.endpoint_manager.published()
+    assert ia.keys() == ib.keys()
+
+    # identities align across daemons (same allocation order)
+    ids_a = {
+        e.id: e.security_identity.id
+        for e in da.endpoint_manager.endpoints()
+    }
+    ids_b = {
+        e.id: e.security_identity.id
+        for e in db.endpoint_manager.endpoints()
+    }
+    assert ids_a == ids_b
+
+    rng = np.random.default_rng(0)
+    n = 512
+    t = dict(
+        ep_index=rng.integers(0, len(ia), size=n),
+        identity=rng.choice(
+            np.asarray(list(ids_a.values()), np.uint32), size=n
+        ),
+        dport=rng.choice([1000, 1005, 1031, 7777, 9999], size=n),
+        proto=np.full(n, 6),
+        direction=np.full(n, INGRESS),
+    )
+    va = evaluate_batch(ta, TupleBatch.from_numpy(**t))
+    vb = evaluate_batch(tb, TupleBatch.from_numpy(**t))
+    np.testing.assert_array_equal(
+        np.asarray(va.allowed), np.asarray(vb.allowed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(va.proxy_port), np.asarray(vb.proxy_port)
+    )
+    # the delta actually enabled the new flow
+    ep3 = ia[103]
+    id5 = ids_a[105]
+    probe = TupleBatch.from_numpy(
+        ep_index=[ep3], identity=[id5], dport=[7777], proto=[6],
+        direction=[INGRESS],
+    )
+    assert np.asarray(evaluate_batch(ta, probe).allowed).tolist() == [1]
+
+
+def test_unaffected_endpoints_fast_forward():
+    base = [make_rule(i, f"app{i % 8}", f"app{(i + 1) % 8}", 1000 + i)
+            for i in range(32)]
+    extra = make_rule(99, "app3", "app5", 7777)
+    for r in base + [extra]:
+        r.sanitize()
+    d = build_daemon()
+    for r in base:
+        d._note_rule_change(r.endpoint_selector)
+    d.repo.add_list(base)
+    d.regenerate_all("initial")
+
+    tokens = {
+        e.id: e.map_state_revision
+        for e in d.endpoint_manager.endpoints()
+    }
+    with d.lock:
+        d._note_rule_change(extra.endpoint_selector)
+        d.repo.add_list([extra])
+    d.regenerate_all("delta")
+
+    rev = d.repo.get_revision()
+    for e in d.endpoint_manager.endpoints():
+        # every endpoint realized the new revision...
+        assert e.next_policy_revision == rev
+        # ...but only the selected one's map state moved
+        if e.id == 103:  # app3
+            assert e.map_state_revision != tokens[e.id]
+        else:
+            assert e.map_state_revision == tokens[e.id]
+
+
+def test_full_sweep_after_identity_change():
+    """A new endpoint (identity allocation) voids the delta scope: the
+    next sweep is full, and new identities appear in everyone's L3
+    sets when allowed."""
+    d = build_daemon(n_eps=2)
+    rule = Rule(
+        endpoint_selector=es("app0"),
+        ingress=[IngressRule(from_endpoints=[es("appX")])],
+        labels=LabelArray.parse("l3rule"),
+    )
+    rule.sanitize()
+    d._note_rule_change(rule.endpoint_selector)
+    d.repo.add_list([rule])
+    d.regenerate_all("initial")
+
+    ep_new = d.create_endpoint(200, k8s_labels("appX"), name="epX")
+    d.regenerate_all("endpoint created")
+    _, tables, index = d.endpoint_manager.published()
+    probe = TupleBatch.from_numpy(
+        ep_index=[index[100]],
+        identity=[ep_new.security_identity.id],
+        dport=[80],
+        proto=[6],
+        direction=[INGRESS],
+    )
+    assert np.asarray(evaluate_batch(tables, probe).allowed).tolist() == [1]

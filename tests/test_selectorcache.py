@@ -1,0 +1,323 @@
+"""SelectorCache + fast mapstate + incremental FleetCompiler.
+
+Three layers of the delta-compilation stack, each checked against its
+brute-force/slow-path twin:
+
+  * SelectorCache.matches == per-identity EndpointSelector.matches
+    over randomized universes (multi-source labels, duplicate keys,
+    all four expression operators, reserved:all, wildcard);
+  * compute_desired_policy_map_state(selector_cache=...) ==
+    the per-identity slow path over randomized rule sets (requires,
+    L3-only blocks, L4 blocks);
+  * FleetCompiler.compile produces verdict-identical tables to the
+    one-shot compile_map_states across incremental updates (endpoint
+    add/change/remove, identity growth, slot growth) while reusing
+    unchanged endpoints' cached rows.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.compiler.mapstate import compute_desired_policy_map_state
+from cilium_tpu.compiler.selectorcache import SelectorCache
+from cilium_tpu.compiler.tables import FleetCompiler, compile_map_states
+from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+from cilium_tpu.labels import Label, LabelArray
+from cilium_tpu.policy.api import EndpointSelector, IngressRule, Rule
+from cilium_tpu.policy.api import PortProtocol, PortRule
+from cilium_tpu.policy.api.rule import EgressRule
+from cilium_tpu.policy.api.selector import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+    Requirement,
+)
+from cilium_tpu.policy.repository import Repository
+
+SOURCES = ["k8s", "container", "any", "unspec"]
+KEYS = ["app", "env", "tier", "zone", "io.kubernetes.pod.namespace"]
+VALUES = ["a", "b", "c", "", "prod"]
+
+
+def random_labels(rng) -> LabelArray:
+    n = int(rng.integers(1, 5))
+    labels = []
+    for _ in range(n):
+        labels.append(
+            Label(
+                key=str(rng.choice(KEYS)),
+                value=str(rng.choice(VALUES)),
+                source=str(rng.choice(["k8s", "container", "unspec"])),
+            )
+        )
+    return LabelArray(labels)
+
+
+def random_selector(rng) -> EndpointSelector:
+    r = rng.random()
+    ml = {}
+    mes = []
+    if r < 0.1:
+        return EndpointSelector()  # wildcard
+    if r < 0.15:
+        return EndpointSelector(match_labels={"reserved.all": ""})
+    n_ml = int(rng.integers(0, 3))
+    for _ in range(n_ml):
+        src = str(rng.choice(SOURCES))
+        key = str(rng.choice(KEYS))
+        form = ("any." if src in ("any", "unspec") else src + ".") + key
+        ml[form] = str(rng.choice(VALUES))
+    n_me = int(rng.integers(0, 3))
+    for _ in range(n_me):
+        op = str(
+            rng.choice([OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST])
+        )
+        src = str(rng.choice(SOURCES))
+        key = str(rng.choice(KEYS))
+        form = ("any." if src in ("any", "unspec") else src + ".") + key
+        values = (
+            [str(v) for v in rng.choice(VALUES, size=2)]
+            if op in (OP_IN, OP_NOT_IN)
+            else []
+        )
+        mes.append(Requirement(form, op, values))
+    return EndpointSelector(match_labels=ml, match_expressions=mes)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_selector_cache_matches_bruteforce(seed):
+    rng = np.random.default_rng(seed)
+    universe = {
+        256 + i: random_labels(rng) for i in range(60)
+    }
+    cache = SelectorCache()
+    cache.sync(universe)
+
+    for _ in range(40):
+        sel = random_selector(rng)
+        want = frozenset(
+            i for i, labels in universe.items() if sel.matches(labels)
+        )
+        assert cache.matches(sel) == want, (
+            sel.match_labels,
+            [(e.key, e.operator, e.values) for e in sel.match_expressions],
+        )
+
+
+def test_selector_cache_incremental_updates():
+    rng = np.random.default_rng(42)
+    universe = {256 + i: random_labels(rng) for i in range(30)}
+    cache = SelectorCache()
+    cache.sync(universe)
+    sel = EndpointSelector(match_labels={"any.app": "a"})
+    v0 = cache.version
+    base = cache.matches(sel)
+
+    # add
+    new_labels = LabelArray([Label("app", "a", "k8s")])
+    cache.upsert_identity(999, new_labels)
+    assert cache.version > v0
+    assert 999 in cache.matches(sel)
+    # change
+    cache.upsert_identity(999, LabelArray([Label("app", "b", "k8s")]))
+    assert 999 not in cache.matches(sel)
+    # remove
+    cache.remove_identity(999)
+    assert cache.matches(sel) == base
+    # no-op upsert doesn't bump the version
+    v1 = cache.version
+    some_id = next(iter(universe))
+    cache.upsert_identity(some_id, universe[some_id])
+    assert cache.version == v1
+
+
+def _es(**kv):
+    return EndpointSelector(
+        match_labels={f"any.{k}": v for k, v in kv.items()}
+    )
+
+
+def random_rule(rng) -> Rule:
+    def maybe_ports():
+        if rng.random() < 0.5:
+            return [
+                PortRule(
+                    ports=[
+                        PortProtocol(
+                            port=str(int(rng.choice([53, 80, 443]))),
+                            protocol="TCP",
+                        )
+                    ]
+                )
+            ]
+        return []
+
+    ingress = []
+    for _ in range(int(rng.integers(0, 3))):
+        ingress.append(
+            IngressRule(
+                from_endpoints=[random_selector(rng)],
+                from_requires=(
+                    [random_selector(rng)] if rng.random() < 0.3 else []
+                ),
+                to_ports=maybe_ports(),
+            )
+        )
+    egress = []
+    for _ in range(int(rng.integers(0, 2))):
+        egress.append(
+            EgressRule(
+                to_endpoints=[random_selector(rng)],
+                to_requires=(
+                    [random_selector(rng)] if rng.random() < 0.3 else []
+                ),
+                to_ports=maybe_ports(),
+            )
+        )
+    return Rule(
+        endpoint_selector=random_selector(rng),
+        ingress=ingress,
+        egress=egress,
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_fast_mapstate_matches_slow(seed):
+    rng = np.random.default_rng(seed)
+    universe = {256 + i: random_labels(rng) for i in range(40)}
+    repo = Repository()
+    for _ in range(12):
+        r = random_rule(rng)
+        r.sanitize()
+        repo.add(r)
+
+    cache = SelectorCache()
+    cache.sync(universe)
+
+    for _ in range(4):
+        ep_labels = random_labels(rng)
+        slow = compute_desired_policy_map_state(repo, universe, ep_labels)
+        fast = compute_desired_policy_map_state(
+            repo, universe, ep_labels, selector_cache=cache
+        )
+        assert slow == fast
+
+
+def test_fast_mapstate_rejects_stale_cache():
+    universe = {256: LabelArray([Label("app", "a", "k8s")])}
+    cache = SelectorCache()
+    cache.sync(universe)
+    bigger = dict(universe)
+    bigger[300] = LabelArray([Label("app", "b", "k8s")])
+    with pytest.raises(ValueError, match="out of sync"):
+        compute_desired_policy_map_state(
+            Repository(), bigger, LabelArray(), selector_cache=cache
+        )
+
+
+# ---------------------------------------------------------------------------
+# FleetCompiler
+# ---------------------------------------------------------------------------
+
+from cilium_tpu.maps.policymap import (  # noqa: E402
+    PolicyKey,
+    PolicyMapStateEntry,
+)
+from tests.test_verdict_engine import random_map_state, random_tuples  # noqa: E402
+
+IDS = [1, 2, 3, 4, 5, 256, 257, 300, 1000, 65536]
+
+
+def _verdicts(tables, t):
+    got = evaluate_batch(tables, TupleBatch.from_numpy(**t))
+    return (
+        np.asarray(got.allowed),
+        np.asarray(got.proxy_port),
+        np.asarray(got.match_kind),
+    )
+
+
+def test_fleet_compiler_matches_oneshot():
+    rng = np.random.default_rng(0)
+    states = [random_map_state(rng, IDS) for _ in range(3)]
+    fc = FleetCompiler(identity_pad=32, filter_pad=8)
+    tables, index = fc.compile(
+        [(10 + i, s, 0) for i, s in enumerate(states)], IDS
+    )
+    assert index == {10: 0, 11: 1, 12: 2}
+
+    ref = compile_map_states(states, IDS, 32, 8)
+    t = random_tuples(rng, 512, 3, IDS)
+    np.testing.assert_array_equal(
+        _verdicts(tables, t)[0], _verdicts(ref, t)[0]
+    )
+    np.testing.assert_array_equal(
+        _verdicts(tables, t)[1], _verdicts(ref, t)[1]
+    )
+    np.testing.assert_array_equal(
+        _verdicts(tables, t)[2], _verdicts(ref, t)[2]
+    )
+
+
+def test_fleet_compiler_incremental_reuse_and_growth():
+    rng = np.random.default_rng(1)
+    states = [random_map_state(rng, IDS) for _ in range(3)]
+    fc = FleetCompiler(identity_pad=32, filter_pad=8)
+    fc.compile([(i, s, 0) for i, s in enumerate(states)], IDS)
+    rows_before = {i: fc._rows[i] for i in range(3)}
+
+    # change only endpoint 1 (new token + a new port → slot growth)
+    states[1] = dict(states[1])
+    states[1][PolicyKey(256, 12345, 6, 0)] = PolicyMapStateEntry()
+    tables, _ = fc.compile(
+        [(0, states[0], 0), (1, states[1], 1), (2, states[2], 0)], IDS
+    )
+    # endpoints 0/2 rows were not relowered (identity or padded copy)
+    assert fc._rows[0]["l4"] is not rows_before[1]["l4"]
+    ref = compile_map_states(states, IDS, 32, 8)
+    t = random_tuples(rng, 512, 3, IDS)
+    t["dport"] = rng.choice([53, 80, 443, 12345], size=512)
+    for a, b in zip(_verdicts(tables, t), _verdicts(ref, t)):
+        np.testing.assert_array_equal(a, b)
+
+    # identity growth: new id appended, everyone gets new L3 entries
+    ids2 = IDS + [70000, 70001]
+    for s in states:
+        s[PolicyKey(70000, 0, 0, 0)] = PolicyMapStateEntry()
+    tables2, _ = fc.compile(
+        [(0, states[0], 1), (1, states[1], 2), (2, states[2], 1)], ids2
+    )
+    ref2 = compile_map_states(states, ids2, 32, 8)
+    t2 = random_tuples(rng, 512, 3, ids2)
+    for a, b in zip(_verdicts(tables2, t2), _verdicts(ref2, t2)):
+        np.testing.assert_array_equal(a, b)
+
+    # identity removal forces a clean reset, still correct
+    ids3 = [i for i in ids2 if i != 1000]
+    for s in states:
+        for k in [k for k in s if k.identity == 1000]:
+            del s[k]
+    tables3, _ = fc.compile(
+        [(0, states[0], 2), (1, states[1], 3), (2, states[2], 2)], ids3
+    )
+    ref3 = compile_map_states(states, ids3, 32, 8)
+    t3 = random_tuples(rng, 512, 3, ids3)
+    for a, b in zip(_verdicts(tables3, t3), _verdicts(ref3, t3)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fleet_compiler_endpoint_departure():
+    rng = np.random.default_rng(2)
+    states = [random_map_state(rng, IDS) for _ in range(3)]
+    fc = FleetCompiler(identity_pad=32, filter_pad=8)
+    fc.compile([(i, s, 0) for i, s in enumerate(states)], IDS)
+    tables, index = fc.compile(
+        [(0, states[0], 0), (2, states[2], 0)], IDS
+    )
+    assert index == {0: 0, 2: 1}
+    assert 1 not in fc._rows
+    ref = compile_map_states([states[0], states[2]], IDS, 32, 8)
+    t = random_tuples(rng, 256, 2, IDS)
+    for a, b in zip(_verdicts(tables, t), _verdicts(ref, t)):
+        np.testing.assert_array_equal(a, b)
