@@ -102,6 +102,13 @@ class SelectorCache:
             self._exists_index.setdefault(form, set()).add(num_id)
             posted.append((form, v))
         for ek, v in ext_first.items():
+            # The 'any.<key>' index form is fed ONLY by the bare-key
+            # first-occurrence map above: LabelArray.get('any.<key>')
+            # returns the first bare-key value in array order, so an
+            # any-source label shadowed by an earlier same-key label of
+            # another source must not post under 'any.<key>'.
+            if ek.split(PATH_DELIMITER, 1)[0] == SOURCE_ANY:
+                continue
             self._val_index.setdefault((ek, v), set()).add(num_id)
             self._exists_index.setdefault(ek, set()).add(num_id)
             posted.append((ek, v))

@@ -55,6 +55,11 @@ MAX_DIRECT = 1 << 22
 
 LOCAL_ID_BASE = IdentityAllocator.LOCAL_IDENTITY_BASE
 
+# FleetCompiler instance nonces for generation-stamp scoping.
+import itertools as _itertools
+
+_COMPILER_NONCE = _itertools.count(1)
+
 NUM_DIRECTIONS = 2  # INGRESS, EGRESS
 
 
@@ -103,6 +108,11 @@ class PolicyTables:
     l4_meta: np.ndarray
     l4_allow_bits: np.ndarray
     l3_allow_bits: np.ndarray
+    # publish-generation stamp (FleetCompiler): a pytree CHILD (scalar
+    # u64: compiler-instance nonce << 32 | publish counter) so it
+    # survives device_put/flatten round trips without becoming a jit
+    # cache key; 0 = unstamped (hand-built tables)
+    generation: np.ndarray = np.uint64(0)
 
     @property
     def num_endpoints(self) -> int:
@@ -126,6 +136,7 @@ class PolicyTables:
                 self.l4_meta,
                 self.l4_allow_bits,
                 self.l3_allow_bits,
+                self.generation,
             ),
             None,
         )
@@ -331,6 +342,14 @@ class FleetCompiler:
     ) -> None:
         self.identity_pad = identity_pad
         self.filter_pad = filter_pad
+        # publish generation: tables one generation old are intact
+        # (double buffering); older ones may have been mutated in
+        # place.  Survives _reset() — it counts publishes, not state.
+        # The instance nonce scopes stamps to THIS compiler: stamps
+        # from another FleetCompiler are not comparable and the check
+        # must not apply its arithmetic to them.
+        self._generation = 0
+        self._instance_nonce = next(_COMPILER_NONCE)
         self._reset()
 
     def _reset(self) -> None:
@@ -580,7 +599,38 @@ class FleetCompiler:
             l4_allow_bits=l4_bits,
             l3_allow_bits=l3_bits,
         )
+        self._generation += 1
+        tables.generation = np.uint64(
+            (self._instance_nonce << 32) | self._generation
+        )
         return tables, index
+
+    def check_tables_current(self, tables) -> None:
+        """Enforce the documented one-flip staleness window on the
+        STACKED tensors (l4_meta/l4_allow_bits/l3_allow_bits): tables
+        produced two or more compiles ago share stack buffers that
+        have been rewritten in place — evaluating flows against them
+        returns wrong verdicts silently.  (id_table/id_direct are
+        freshly allocated per rebuild and port_slot cells are
+        write-once, so *reading the index tables* of a stale snapshot
+        stays safe; the hazard is the per-endpoint rows.)
+
+        Raises ValueError on violation; tables without a generation
+        stamp (hand-built via lower_map_state, generation=0) or
+        stamped by a different FleetCompiler instance are accepted —
+        the stamp is instance-scoped.  It is a pytree child, so it
+        survives device_put / flatten round trips."""
+        raw = getattr(tables, "generation", None)
+        stamp = int(np.asarray(raw)) if raw is not None else 0
+        if stamp == 0 or (stamp >> 32) != self._instance_nonce:
+            return
+        gen = stamp & 0xFFFFFFFF
+        if self._generation - gen > 1:
+            raise ValueError(
+                f"stale PolicyTables: generation {gen} is "
+                f"{self._generation - gen} publishes old (max 1 — "
+                f"double-buffered rows have been overwritten)"
+            )
 
     def _stacked(self, order: List[int], kg: int, w: int):
         """Write rows into the standby stacked buffer, copying only

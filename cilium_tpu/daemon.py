@@ -284,6 +284,7 @@ class Daemon:
                 selector_cache=self.selector_cache,
                 rule_index=self.rule_index,
                 universe_version=universe_version,
+                affected_revision=affected_revision,
             )
         metrics.policy_regeneration_count.inc(value=n)
         stats.span("total").end()

@@ -292,7 +292,16 @@ class Endpoint:
         )
 
         self.force_policy_compute = False
-        self.next_policy_revision = revision
+        # When computing from a rule_index sublist, the sublist was
+        # frozen when the index was built; a rule added concurrently
+        # between the build and our get_revision() read is absent from
+        # the sublist and must not be marked realized (the next sweep's
+        # revision gate would silently skip it).  Cap at the revision
+        # snapshotted with the index build.
+        if rules is not None and affected_revision is not None:
+            self.next_policy_revision = min(revision, affected_revision)
+        else:
+            self.next_policy_revision = revision
         return True
 
     # -- realization (endpoint.go:2572 syncPolicyMap) ------------------------

@@ -193,6 +193,11 @@ class EndpointManager:
         with self._lock:
             return self._published
 
+    def check_tables_current(self, tables) -> None:
+        """See FleetCompiler.check_tables_current: raises if `tables`
+        is more than one publish old (its buffers have been reused)."""
+        self._fleet_compiler.check_tables_current(tables)
+
     def identity_index(self) -> Tuple[Dict[int, int], int]:
         """Identity index space of the (last-compiled) fleet tables —
         see FleetCompiler.identity_index."""

@@ -52,6 +52,7 @@ def table_specs(batch_axis: str, table_axis: str) -> PolicyTables:
         l4_meta=P(),
         l4_allow_bits=P(None, None, None, table_axis),
         l3_allow_bits=P(None, None, table_axis),
+        generation=P(),
     )
 
 

@@ -297,6 +297,7 @@ def make_sharded_evaluator(mesh: Optional[jax.sharding.Mesh] = None,
         l4_meta=replicated,
         l4_allow_bits=replicated,
         l3_allow_bits=replicated,
+        generation=replicated,
     )
     batch_shardings = TupleBatch(
         ep_index=batch_sharded,

@@ -88,6 +88,7 @@ def replay(
     batch_size: int = 1 << 20,
     accumulate_counters: bool = True,
     ep_map: Optional[Dict[int, int]] = None,
+    manager=None,
 ) -> tuple:
     """Run all records through the full datapath step with pipelined
     dispatch (bounded-depth queue of in-flight device batches — the
@@ -100,6 +101,12 @@ def replay(
     """
     import time
 
+    if manager is not None:
+        # stale-table guard at the layer that actually reads the
+        # stacked per-endpoint rows: tables 2+ publishes old have had
+        # those rows rewritten in place (FleetCompiler double
+        # buffering) and would return wrong verdicts silently
+        manager.check_tables_current(tables)
     step = _replay_step()
     stats = ReplayStats()
     acc = _CounterAccumulator() if accumulate_counters else None
@@ -193,6 +200,11 @@ def sync_counters_to_endpoints(
         _, tables, index = manager.published()
     if tables is None:
         return 0
+    # NOTE: no staleness guard needed here — this function reads only
+    # tables.id_table (freshly allocated per rebuild) and
+    # tables.port_slot (write-once cells), both of which stay valid in
+    # arbitrarily old snapshots.  The in-place-mutation hazard is the
+    # stacked per-endpoint rows, guarded at replay()/evaluation time.
     updated = 0
     rev_index = {v: k for k, v in index.items()}
     id_table = np.asarray(tables.id_table)

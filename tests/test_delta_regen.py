@@ -161,6 +161,53 @@ def test_unaffected_endpoints_fast_forward():
             assert e.map_state_revision == tokens[e.id]
 
 
+def test_concurrent_rule_add_not_marked_realized():
+    """Advisor r2 high: a rule added between the rule-index build and
+    an endpoint's full compute must not be marked realized — the
+    realized revision is capped at the index-build snapshot so the
+    next sweep still applies the rule."""
+    base = [make_rule(0, "app0", "app1", 1000)]
+    for r in base:
+        r.sanitize()
+    d = build_daemon(n_eps=2)
+    d.repo.add_list(base)
+    d.regenerate_all("initial")
+
+    ep = d.endpoint_manager.lookup(100)
+    cache = d.identity_cache()
+    d.selector_cache.sync(cache)
+    d.rule_index.build(d.repo, d.selector_cache)
+    rev_at_build = d.repo.get_revision()
+
+    # a rule lands after the index build (the sublist is stale)
+    extra = make_rule(99, "app0", "app1", 7777)
+    extra.sanitize()
+    d.repo.add_list([extra])
+    assert d.repo.get_revision() > rev_at_build
+
+    ep.force_policy_compute = True
+    ep.regenerate_policy(
+        d.repo,
+        cache,
+        selector_cache=d.selector_cache,
+        rule_index=d.rule_index,
+        affected_revision=rev_at_build,
+    )
+    # capped at the snapshot, NOT the live (post-add) revision
+    assert ep.next_policy_revision == rev_at_build
+
+    # the next sweep therefore recomputes and applies the new rule
+    d.regenerate_all("sweep")
+    assert ep.next_policy_revision == d.repo.get_revision()
+    _, tables, index = d.endpoint_manager.published()
+    src = d.endpoint_manager.lookup(101).security_identity.id
+    probe = TupleBatch.from_numpy(
+        ep_index=[index[100]], identity=[src], dport=[7777],
+        proto=[6], direction=[INGRESS],
+    )
+    assert np.asarray(evaluate_batch(tables, probe).allowed).tolist() == [1]
+
+
 def test_full_sweep_after_identity_change():
     """A new endpoint (identity allocation) voids the delta scope: the
     next sweep is full, and new identities appear in everyone's L3

@@ -48,7 +48,7 @@ def random_labels(rng) -> LabelArray:
             Label(
                 key=str(rng.choice(KEYS)),
                 value=str(rng.choice(VALUES)),
-                source=str(rng.choice(["k8s", "container", "unspec"])),
+                source=str(rng.choice(SOURCES)),
             )
         )
     return LabelArray(labels)
@@ -103,6 +103,32 @@ def test_selector_cache_matches_bruteforce(seed):
             sel.match_labels,
             [(e.key, e.operator, e.values) for e in sel.match_expressions],
         )
+
+
+def test_selector_cache_any_source_shadowed_by_earlier_key():
+    """Advisor r2 medium: an any-source label shadowed by an earlier
+    same-key label of another source must not feed the 'any.<key>'
+    index — LabelArray.get('any.role') returns the FIRST bare-key
+    value in array order."""
+    labels = LabelArray(
+        [Label("role", "frontend", "k8s"), Label("role", "backend", "any")]
+    )
+    cache = SelectorCache()
+    cache.sync({256: labels})
+
+    sel_backend = EndpointSelector(match_labels={"any.role": "backend"})
+    sel_frontend = EndpointSelector(match_labels={"any.role": "frontend"})
+    assert not sel_backend.matches(labels)
+    assert cache.matches(sel_backend) == frozenset()
+    assert sel_frontend.matches(labels)
+    assert cache.matches(sel_frontend) == frozenset({256})
+    # the k8s-source view is unaffected by the any-source label
+    sel_k8s = EndpointSelector(match_labels={"k8s.role": "frontend"})
+    assert cache.matches(sel_k8s) == frozenset({256})
+    # an UNshadowed any-source label still matches through any.<key>
+    labels2 = LabelArray([Label("role", "backend", "any")])
+    cache.upsert_identity(257, labels2)
+    assert cache.matches(sel_backend) == frozenset({257})
 
 
 def test_selector_cache_incremental_updates():
@@ -305,6 +331,37 @@ def test_fleet_compiler_incremental_reuse_and_growth():
     t3 = random_tuples(rng, 512, 3, ids3)
     for a, b in zip(_verdicts(tables3, t3), _verdicts(ref3, t3)):
         np.testing.assert_array_equal(a, b)
+
+
+def test_fleet_compiler_stale_tables_guard():
+    """Advisor r2 low: tables two or more publishes old share buffers
+    that have been rewritten in place — check_tables_current enforces
+    the documented one-flip window."""
+    rng = np.random.default_rng(7)
+    states = [random_map_state(rng, IDS)]
+    fc = FleetCompiler(identity_pad=32, filter_pad=8)
+    t1, _ = fc.compile([(0, states[0], 0)], IDS)
+    t2, _ = fc.compile([(0, states[0], 1)], IDS)
+    fc.check_tables_current(t1)  # one flip old: fine
+    fc.check_tables_current(t2)
+    t3, _ = fc.compile([(0, states[0], 2)], IDS)
+    fc.check_tables_current(t2)
+    with pytest.raises(ValueError, match="stale PolicyTables"):
+        fc.check_tables_current(t1)  # two flips old: buffers reused
+    # the stamp is a pytree child: it survives flatten round trips
+    # (device_put and friends), so the guard still fires
+    import jax
+
+    with pytest.raises(ValueError, match="stale PolicyTables"):
+        fc.check_tables_current(jax.tree.map(lambda x: x, t1))
+    # hand-built tables (no stamp) are accepted
+    ref = compile_map_states(states, IDS, 32, 8)
+    fc.check_tables_current(ref)
+    # stamps are instance-scoped: another compiler's tables are not
+    # comparable and must be accepted
+    fc2 = FleetCompiler(identity_pad=32, filter_pad=8)
+    other, _ = fc2.compile([(0, states[0], 0)], IDS)
+    fc.check_tables_current(other)
 
 
 def test_fleet_compiler_endpoint_departure():
