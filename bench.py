@@ -1,22 +1,35 @@
-"""Headline benchmark: policy verdicts/sec on one chip.
+"""Benchmark ladder: BASELINE.md configs 1-5 on one chip.
 
-Workload (BASELINE.md config 5 shape): mixed L3/L4 policy lowered to
-per-endpoint tables — 16 endpoints × (256 L4 keys + L3 allows) over a
-65,536-identity universe (≈70k map entries, >50k-rule scale), replayed
-with 1M-tuple batches of synthetic Hubble-style flow tuples.
+Config 5 (headline, printed LAST so the driver's tail-parse picks it
+up) is the real workload end-to-end: a ≥50k-rule mixed L3/L4/L7 policy
+compiled through the actual control plane (policy_add → regeneration →
+FleetCompiler), then ≥10M Hubble-style raw 5-tuple flows replayed
+through the FUSED datapath step (prefilter → LB/DNAT → CT → ipcache
+LPM → policy lattice in ONE jit, engine/datapath.py — the analog of
+bpf_lxc.c:440/899 being one program).  A composed-host-oracle
+bit-identity gate runs on a subsample before timing; divergence aborts
+the bench.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is against the driver target of 100M verdicts/sec
-aggregate on v5e-8, i.e. 12.5M verdicts/sec/chip.
+Configs 1-4 (one JSON line each):
+  1. L3/L4 identity-pair allowlist from real rules, 1k tuples — the
+     minimum end-to-end slice, oracle-gated.
+  2. CIDR ruleset: DIR-24-8 ipcache LPM identity derivation feeding
+     the lattice, 100k-unique-tuple replay.
+  3. HTTP L7: regex→DFA device matching, 1M requests, host re.fullmatch
+     oracle subsample.
+  4. Kafka L7: field-equality tensors, 1M requests, MatchesRule host
+     oracle subsample.
 
-A bit-identity spot check against the host oracle runs first (honesty
-gate); `--smoke` runs only that, on small shapes, from real rules.
+Output: one JSON line per config; the final line is
+{"metric": "verdicts_per_sec_per_chip", ...} for config 5 through the
+fused path.  vs_baseline is against the driver target of 100M
+verdicts/sec aggregate on v5e-8, i.e. 12.5M verdicts/sec/chip.
 """
 
 from __future__ import annotations
 
 import argparse
-import copy
+import ipaddress
 import json
 import sys
 import time
@@ -26,86 +39,936 @@ import numpy as np
 BASELINE_PER_CHIP = 100e6 / 8  # driver target spread over v5e-8
 
 
-def build_synthetic_states(
-    n_endpoints: int, n_identities: int, n_l4_keys: int, rng
-):
-    """Synthesize desired map states at config-5 scale directly (the
-    control-plane path is exercised by tests and --smoke; the bench
-    measures the datapath)."""
+def emit(metric: str, value, unit: str, vs_baseline=None, **extra) -> None:
+    line = {"metric": metric, "value": value, "unit": unit}
+    if vs_baseline is not None:
+        line["vs_baseline"] = vs_baseline
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def ip_u32(s: str) -> int:
+    return int(ipaddress.ip_address(s))
+
+
+class HostLPM:
+    """Fast host-side LPM oracle: /32s in a dict, other prefixes
+    scanned longest-first (their count stays small in the bench
+    worlds, unlike the /32 population)."""
+
+    def __init__(self, mapping):
+        self.exact = {}
+        self.ranges = []
+        for cidr, num_id in mapping.items():
+            net = ipaddress.ip_network(cidr, strict=False)
+            if net.version != 4:
+                continue
+            if net.prefixlen == 32:
+                self.exact[int(net.network_address)] = num_id
+            else:
+                self.ranges.append(
+                    (
+                        net.prefixlen,
+                        int(net.network_address),
+                        int(net.netmask),
+                        num_id,
+                    )
+                )
+        self.ranges.sort(key=lambda r: -r[0])
+
+    def lookup(self, ip: int) -> int:
+        hit = self.exact.get(ip)
+        if hit is not None:
+            return hit
+        for _, base, mask, num_id in self.ranges:
+            if (ip & mask) == base:
+                return num_id
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# config 5: full control plane + fused datapath
+# ---------------------------------------------------------------------------
+
+
+def build_rules(rng, n_rules, n_endpoints, n_teams):
+    """A mixed 50k-rule policy: plain L4 (84%), L3-only (8%), CIDR
+    (4%), HTTP L7 (3%), Kafka L7 (1%) — every rule selects one app
+    (endpoint) and allows one team (identity group)."""
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.rule import (
+        CIDRRule,
+        L7Rules,
+        PortRuleHTTP,
+        PortRuleKafka,
+    )
+
+    def es(key, value):
+        return EndpointSelector(match_labels={f"k8s.{key}": value})
+
+    plain_ports = rng.choice(
+        np.arange(1000, 30000), size=224, replace=False
+    )
+    http_ports = list(range(8000, 8016))
+    kafka_ports = list(range(9090, 9098))
+
+    rules = []
+    for i in range(n_rules):
+        app = f"app{i % n_endpoints}"
+        team = f"t{int(rng.integers(0, n_teams))}"
+        kind = rng.random()
+        sel = es("app", app)
+        src = es("team", team)
+        if kind < 0.84:
+            port = int(plain_ports[int(rng.integers(0, len(plain_ports)))])
+            proto = "TCP" if rng.random() < 0.7 else "UDP"
+            ingress = IngressRule(
+                from_endpoints=[src],
+                to_ports=[
+                    PortRule(
+                        ports=[PortProtocol(port=str(port), protocol=proto)]
+                    )
+                ],
+            )
+        elif kind < 0.92:
+            ingress = IngressRule(from_endpoints=[src])  # L3-only
+        elif kind < 0.96:
+            block = int(rng.integers(0, 256))
+            ingress = IngressRule(
+                from_cidr_set=[CIDRRule(cidr=f"198.18.{block}.0/24")]
+            )
+        elif kind < 0.99:
+            port = http_ports[int(rng.integers(0, len(http_ports)))]
+            ingress = IngressRule(
+                from_endpoints=[src],
+                to_ports=[
+                    PortRule(
+                        ports=[
+                            PortProtocol(port=str(port), protocol="TCP")
+                        ],
+                        rules=L7Rules(
+                            http=[
+                                PortRuleHTTP(
+                                    method="GET",
+                                    path=f"/api/v{i % 4}/[a-z]+",
+                                )
+                            ]
+                        ),
+                    )
+                ],
+            )
+        else:
+            port = kafka_ports[int(rng.integers(0, len(kafka_ports)))]
+            ingress = IngressRule(
+                from_endpoints=[src],
+                to_ports=[
+                    PortRule(
+                        ports=[
+                            PortProtocol(port=str(port), protocol="TCP")
+                        ],
+                        rules=L7Rules(
+                            kafka=[
+                                PortRuleKafka(topic=f"topic{i % 32}")
+                            ]
+                        ),
+                    )
+                ],
+            )
+        rules.append(
+            Rule(
+                endpoint_selector=sel,
+                ingress=[ingress],
+                labels=LabelArray.parse(f"bench-rule-{i}"),
+            )
+        )
+    all_ports = (
+        [(int(p), 6) for p in plain_ports if True]
+        + [(int(p), 17) for p in plain_ports]
+        + [(p, 6) for p in http_ports]
+        + [(p, 6) for p in kafka_ports]
+    )
+    return rules, all_ports
+
+
+def build_config5(args, rng):
+    """Returns (daemon, DatapathTables, index, flow pool arrays,
+    oracle context, timings)."""
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.ct.table import CTMap
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.engine.datapath import DatapathTables
+    from cilium_tpu.ipcache.ipcache import IPIdentity
+    from cilium_tpu.labels import Label, Labels
+    from cilium_tpu.lb.device import compile_lb
+    from cilium_tpu.lb.service import L3n4Addr, ServiceManager
+
+    timings = {}
+
+    d = Daemon(num_workers=8)
+    d.policy_trigger.close(wait=True)  # explicit sweeps
+
+    # endpoints: one per app
+    t0 = time.perf_counter()
+    ep_ip = {}
+    for i in range(args.endpoints):
+        ip = f"10.250.{i // 256}.{i % 256}"
+        ep_ip[100 + i] = ip_u32(ip)
+        d.create_endpoint(
+            100 + i,
+            Labels({"app": Label("app", f"app{i}", "k8s")}),
+            ipv4=ip,
+            name=f"ep{i}",
+        )
+
+    # identity universe: n_identities cluster-scope ids in teams of
+    # ~identities/teams; each gets one /32 in the ipcache
+    n_teams = max(args.identities // 16, 1)
+    id_ips = []
+    ids = []
+    for i in range(args.identities - args.endpoints):
+        labels = Labels(
+            {
+                "team": Label("team", f"t{i % n_teams}", "k8s"),
+                "svc": Label("svc", f"s{i}", "k8s"),
+            }
+        )
+        ident, _ = d.identity_allocator.allocate(labels)
+        ip = 0x0A000000 | (i + 1)  # 10.0.0.0/8, dense
+        id_ips.append(ip)
+        ids.append(ident.id)
+        d.ipcache.upsert(
+            str(ipaddress.ip_address(ip)),
+            IPIdentity(ident.id, "kvstore"),
+        )
+    timings["identity_setup_s"] = time.perf_counter() - t0
+
+    # policy: n_rules mixed rules through the real policy_add path
+    t0 = time.perf_counter()
+    rules, all_ports = build_rules(
+        rng, args.rules, args.endpoints, n_teams
+    )
+    d.policy_add(rules)
+    timings["policy_add_s"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    d.regenerate_all("bench import")
+    timings["regenerate_s"] = time.perf_counter() - t0
+
+    _, policy_tables, index = d.endpoint_manager.published()
+
+    # prefilter: one denied CIDR
+    prefilter_map = {"203.0.113.0/24": 1}
+    from cilium_tpu.ipcache.lpm import build_lpm
+
+    # services: VIPs load-balancing onto endpoint IPs
+    mgr = ServiceManager()
+    vips = []
+    for i in range(16):
+        vip = f"172.16.0.{i + 1}"
+        backends = [
+            L3n4Addr(
+                str(ipaddress.ip_address(ep_ip[100 + int(b)])),
+                int(all_ports[i][0]),
+                6,
+            )
+            for b in rng.choice(args.endpoints, size=2, replace=False)
+        ]
+        mgr.upsert(L3n4Addr(vip, 80, 6), backends)
+        vips.append(ip_u32(vip))
+
+    ct = CTMap()
+    ipcache_tables = d.lpm_builder.tables()
+    tables = DatapathTables(
+        prefilter=build_lpm(prefilter_map),
+        ipcache=ipcache_tables,
+        ct=compile_ct(ct),
+        lb=compile_lb(mgr),
+        policy=policy_tables,
+    )
+
+    oracle_ctx = {
+        "prefilter": HostLPM(prefilter_map),
+        "ipcache": HostLPM(dict(d.lpm_builder.mappings)),
+        "ct": ct,
+        "mgr": mgr,
+        "daemon": d,
+        "index": index,
+    }
+    pool = make_flow_pool(
+        args, rng, ep_ip, np.asarray(id_ips, np.uint32), vips, all_ports,
+        index,
+    )
+    return d, tables, index, pool, oracle_ctx, timings, ct, mgr
+
+
+def make_flow_pool(args, rng, ep_ip, id_ips, vips, all_ports, index):
+    """A pool of unique flows (CT-friendly: 10M replay tuples sample
+    from `pool_size` unique flows, like real traffic repeats flows)."""
+    n = args.pool
+    ep_ids = np.asarray(sorted(ep_ip), np.int64)
+    ep_axis = np.asarray([index[int(e)] for e in ep_ids], np.int32)
+    ep_addr = np.asarray([ep_ip[int(e)] for e in ep_ids], np.uint32)
+
+    pick_ep = rng.integers(0, len(ep_ids), size=n)
+    direction = (rng.random(n) < 0.5).astype(np.uint8)  # 0=in 1=eg
+    peer_ip = id_ips[rng.integers(0, len(id_ips), size=n)]
+    # 2% prefiltered sources, 3% world (unknown) sources
+    pre = rng.random(n) < 0.02
+    world = rng.random(n) < 0.03
+    peer_ip = np.where(
+        pre,
+        ip_u32("203.0.113.0") + rng.integers(0, 256, size=n),
+        np.where(
+            world,
+            ip_u32("8.8.0.0") + rng.integers(0, 1 << 16, size=n),
+            peer_ip,
+        ),
+    ).astype(np.uint32)
+    # egress: 10% of destinations are service VIPs (LB DNAT)
+    to_vip = (direction == 1) & (rng.random(n) < 0.10)
+    vip_arr = np.asarray(vips, np.uint32)
+    vip_pick = vip_arr[rng.integers(0, len(vip_arr), size=n)]
+
+    saddr = np.where(direction == 0, peer_ip, ep_addr[pick_ep])
+    daddr = np.where(
+        direction == 0,
+        ep_addr[pick_ep],
+        np.where(to_vip, vip_pick, peer_ip),
+    )
+    ports = np.asarray([p for p, _ in all_ports], np.int64)
+    protos = np.asarray([pr for _, pr in all_ports], np.int64)
+    pick_port = rng.integers(0, len(ports), size=n)
+    dport = ports[pick_port]
+    proto = protos[pick_port]
+    # 10% junk ports (miss the slot table), VIP flows probe port 80
+    junk = rng.random(n) < 0.10
+    dport = np.where(junk, rng.integers(30000, 65536, size=n), dport)
+    dport = np.where(to_vip, 80, dport).astype(np.uint16)
+    proto = np.where(junk, 6, proto)
+    proto = np.where(to_vip, 6, proto).astype(np.uint8)
+    sport = rng.integers(1024, 65536, size=n).astype(np.uint16)
+    frag = (rng.random(n) < 0.02).astype(np.uint8)
+
+    return {
+        "ep_index": ep_axis[pick_ep].astype(np.uint32),
+        "saddr": saddr.astype(np.uint32),
+        "daddr": daddr.astype(np.uint32),
+        "sport": sport,
+        "dport": dport,
+        "proto": proto,
+        "direction": direction,
+        "is_fragment": frag,
+    }
+
+
+def encode_pool_sample(pool, picks):
+    from cilium_tpu.native import encode_flow_records
+
+    n = len(picks)
+    return encode_flow_records(
+        ep_id=pool["ep_index"][picks],
+        identity=np.zeros(n, np.uint32),
+        saddr=pool["saddr"][picks],
+        daddr=pool["daddr"][picks],
+        sport=pool["sport"][picks],
+        dport=pool["dport"][picks],
+        proto=pool["proto"][picks],
+        direction=pool["direction"][picks],
+        is_fragment=pool["is_fragment"][picks],
+    )
+
+
+def composed_oracle(ctx, states, flows_dict, idx_list):
+    """The test-suite's composed host oracle (tests/test_datapath.py
+    _host_oracle), over the bench world's host components.  Returns
+    (allowed, proxy, sec_id) arrays for the sampled indices."""
+    from cilium_tpu.ct.table import (
+        CT_EGRESS,
+        CT_ESTABLISHED,
+        CT_INGRESS,
+        CT_NEW,
+        CT_RELATED,
+        CT_REPLY,
+        CT_SERVICE,
+        CTTuple,
+        TUPLE_F_SERVICE,
+    )
+    from cilium_tpu.engine.hashtable import _fnv1a_host
+    from cilium_tpu.engine.oracle import policy_can_access
+    from cilium_tpu.identity import RESERVED_WORLD
+    from cilium_tpu.lb.service import L3n4Addr
+    from cilium_tpu.maps.policymap import INGRESS
+
+    pre, ipc, ct, mgr = (
+        ctx["prefilter"], ctx["ipcache"], ctx["ct"], ctx["mgr"],
+    )
+    out_allow = np.zeros(len(idx_list), np.uint8)
+    out_proxy = np.zeros(len(idx_list), np.int32)
+    out_sec = np.zeros(len(idx_list), np.uint32)
+    f = flows_dict
+    for row, i in enumerate(idx_list):
+        ep = int(f["ep_index"][i])
+        saddr, daddr = int(f["saddr"][i]), int(f["daddr"][i])
+        sport, dport = int(f["sport"][i]), int(f["dport"][i])
+        proto = int(f["proto"][i])
+        direction = int(f["direction"][i])
+        frag = bool(f["is_fragment"][i])
+
+        pre_drop = pre.lookup(saddr) != 0
+
+        eff_daddr, eff_dport = daddr, dport
+        if direction != INGRESS:
+            svc = mgr.lookup(
+                L3n4Addr(str(ipaddress.ip_address(daddr)), dport, proto)
+            )
+            if svc is not None and svc.backends:
+                slave = 0
+                st_res = ct.lookup(
+                    CTTuple(daddr, saddr, dport, sport, proto), CT_SERVICE
+                )
+                if st_res in (CT_ESTABLISHED, CT_REPLY):
+                    for key in (
+                        CTTuple(saddr, daddr, sport, dport, proto,
+                                TUPLE_F_SERVICE | 1),
+                        CTTuple(daddr, saddr, dport, sport, proto,
+                                TUPLE_F_SERVICE),
+                        CTTuple(saddr, daddr, sport, dport, proto,
+                                TUPLE_F_SERVICE),
+                        CTTuple(daddr, saddr, dport, sport, proto,
+                                TUPLE_F_SERVICE | 1),
+                    ):
+                        e = ct.entries.get(key)
+                        if e is not None:
+                            slave = e.slave
+                            break
+                if not (0 < slave <= len(svc.backends)):
+                    words = np.array(
+                        [[saddr, daddr, (sport << 16) | dport, proto]],
+                        dtype=np.uint32,
+                    )
+                    slave = (
+                        int(_fnv1a_host(words)[0]) % len(svc.backends)
+                    ) + 1
+                b = svc.backends[slave - 1]
+                eff_daddr = b.addr.ip_u32()
+                eff_dport = b.addr.port
+
+        ct_res = ct.lookup(
+            CTTuple(eff_daddr, saddr, eff_dport, sport, proto),
+            CT_INGRESS if direction == INGRESS else CT_EGRESS,
+        )
+
+        sec_ip = saddr if direction == INGRESS else eff_daddr
+        sec_id = ipc.lookup(sec_ip)
+        if sec_id == 0:
+            sec_id = RESERVED_WORLD
+
+        v = policy_can_access(
+            states[ep], sec_id, eff_dport, proto, direction, frag
+        )
+        pass_ct = ct_res in (CT_REPLY, CT_RELATED)
+        allowed = (not pre_drop) and (pass_ct or v.allowed)
+        proxy = (
+            v.proxy_port
+            if v.allowed and ct_res in (CT_NEW, CT_ESTABLISHED) and allowed
+            else 0
+        )
+        out_allow[row] = 1 if allowed else 0
+        out_proxy[row] = proxy
+        out_sec[row] = sec_id
+    return out_allow, out_proxy, out_sec
+
+
+def run_config5(args) -> None:
+    import jax
+
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.engine.datapath import DatapathTables
+    from cilium_tpu.replay import read_flow_batches, replay
+
+    rng = np.random.default_rng(7)
+    t_build = time.perf_counter()
+    (d, tables, index, pool, oracle_ctx, timings, ct, mgr) = (
+        build_config5(args, rng)
+    )
+    timings["total_build_s"] = time.perf_counter() - t_build
+    n_entries = sum(
+        len(e.realized_map_state)
+        for e in d.endpoint_manager.endpoints()
+    )
+    emit(
+        "control_plane_compile_seconds",
+        round(timings["total_build_s"], 2),
+        "s",
+        rules=args.rules,
+        endpoints=args.endpoints,
+        identities=args.identities,
+        map_entries=n_entries,
+        phases={k: round(v, 2) for k, v in timings.items()},
+    )
+
+    # --- seed CT: one churn pass over 2 batches of the pool ----------------
+    picks = rng.integers(0, args.pool, size=2 * args.batch)
+    seed_buf = encode_pool_sample(pool, picks)
+    t0 = time.perf_counter()
+    seed_stats, _, _ = replay(
+        tables, seed_buf, batch_size=args.batch, ct_map=ct,
+        accumulate_counters=False,
+    )
+    churn_s = time.perf_counter() - t0
+    tables = DatapathTables(
+        prefilter=tables.prefilter,
+        ipcache=tables.ipcache,
+        ct=compile_ct(ct),
+        lb=tables.lb,
+        policy=tables.policy,
+    )
+    emit(
+        "ct_churn_tuples_per_sec",
+        round(seed_stats.total / churn_s),
+        "tuples/s",
+        ct_created=seed_stats.ct_created,
+        note="fused replay with per-batch CT writeback + snapshot rebuild",
+    )
+
+    # --- bit-identity gate vs composed host oracle -------------------------
+    states = [None] * len(index)
+    for ep in d.endpoint_manager.endpoints():
+        states[index[ep.id]] = ep.realized_map_state
+    sample = rng.integers(0, args.pool, size=args.oracle_sample)
+    got_buf = encode_pool_sample(pool, sample)
+    flows = next(read_flow_batches(got_buf, len(sample)))[0]
+    from cilium_tpu.engine.datapath import datapath_step
+
+    got = datapath_step(tables, flows)
+    want_allow, want_proxy, want_sec = composed_oracle(
+        oracle_ctx, states, pool, list(sample)
+    )
+    assert (np.asarray(got.allowed) == want_allow).all(), (
+        "fused datapath diverges from composed oracle (allow)"
+    )
+    assert (np.asarray(got.proxy_port) == want_proxy).all(), (
+        "fused datapath diverges from composed oracle (proxy)"
+    )
+    assert (np.asarray(got.sec_id) == want_sec).all(), (
+        "fused datapath diverges from composed oracle (sec_id)"
+    )
+
+    # --- timed fused replay: args.tuples sampled from the pool -------------
+    tables = jax.device_put(tables)
+    n_batches = max(args.tuples // args.batch, 1)
+    batch_picks = [
+        rng.integers(0, args.pool, size=args.batch)
+        for _ in range(min(n_batches, 4))
+    ]
+    from cilium_tpu.engine.datapath import datapath_step_with_counters
+
+    flow_batches = [
+        jax.device_put(
+            next(
+                read_flow_batches(
+                    encode_pool_sample(pool, p), args.batch
+                )
+            )[0]
+        )
+        for p in batch_picks
+    ]
+    # warmup/compile
+    jax.block_until_ready(
+        datapath_step_with_counters(tables, flow_batches[0])
+    )
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(n_batches):
+        outs.append(
+            datapath_step_with_counters(
+                tables, flow_batches[i % len(flow_batches)]
+            )
+        )
+        if len(outs) > 4:
+            jax.block_until_ready(outs.pop(0))
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    total = n_batches * args.batch
+    vps = total / dt
+
+    # secondary: the bare lattice on the same tables (round 1/2 metric)
+    from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+
+    lat_batch = TupleBatch(
+        ep_index=flow_batches[0].ep_index,
+        identity=jax.device_put(
+            np.random.default_rng(1).integers(
+                256, 256 + args.identities, size=args.batch
+            ).astype(np.uint32)
+        ),
+        dport=flow_batches[0].dport,
+        proto=flow_batches[0].proto,
+        direction=flow_batches[0].direction,
+        is_fragment=flow_batches[0].is_fragment,
+    )
+    jax.block_until_ready(evaluate_batch(tables.policy, lat_batch))
+    t0 = time.perf_counter()
+    louts = [
+        evaluate_batch(tables.policy, lat_batch) for _ in range(8)
+    ]
+    jax.block_until_ready(louts)
+    lat_vps = 8 * args.batch / (time.perf_counter() - t0)
+    emit(
+        "lattice_verdicts_per_sec_per_chip",
+        round(lat_vps),
+        "verdicts/s",
+        vs_baseline=round(lat_vps / BASELINE_PER_CHIP, 3),
+    )
+
+    p50_ms = dt / n_batches * 1000
+    emit(
+        "verdicts_per_sec_per_chip",
+        round(vps),
+        "verdicts/s",
+        vs_baseline=round(vps / BASELINE_PER_CHIP, 3),
+        tuples=total,
+        batch=args.batch,
+        p50_batch_ms=round(p50_ms, 1),
+        pipeline="fused: prefilter+LB/DNAT+CT+LPM+lattice+counters",
+    )
+
+
+# ---------------------------------------------------------------------------
+# config 1: minimum end-to-end slice
+# ---------------------------------------------------------------------------
+
+
+def config1() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import __graft_entry__
+    from cilium_tpu.engine.oracle import evaluate_batch_oracle
+    from cilium_tpu.engine.verdict import _verdict_kernel
+
+    n = 1024
+    tables, batch, state = __graft_entry__._build_example(
+        batch=n, return_state=True
+    )
+    step = jax.jit(_verdict_kernel)
+    out = step(tables, batch)  # warmup/compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = step(tables, batch)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    want_allow, want_proxy, want_kind = evaluate_batch_oracle(
+        [state],
+        ep_index=np.asarray(batch.ep_index),
+        identity=np.asarray(batch.identity),
+        dport=np.asarray(batch.dport),
+        proto=np.asarray(batch.proto),
+        direction=np.asarray(batch.direction),
+    )
+    assert (np.asarray(out.allowed) == want_allow).all(), (
+        "config1 allow divergence vs oracle"
+    )
+    assert (np.asarray(out.proxy_port) == want_proxy).all()
+    assert (np.asarray(out.match_kind) == want_kind).all()
+    emit(
+        "config1_l3l4_1k_tuples_ms",
+        round(dt * 1000, 2),
+        "ms",
+        tuples=n,
+        allows=int(np.asarray(out.allowed).sum()),
+        bit_identical=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# config 2: CIDR LPM
+# ---------------------------------------------------------------------------
+
+
+def config2(args) -> None:
+    import jax
+
+    from cilium_tpu.engine.verdict import (
+        TupleBatch,
+        evaluate_batch_from_ips,
+    )
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.engine.oracle import policy_can_access
+    from cilium_tpu.ipcache.lpm import build_lpm
     from cilium_tpu.maps.policymap import (
+        INGRESS,
         PolicyKey,
         PolicyMapStateEntry,
     )
 
-    identity_ids = np.arange(256, 256 + n_identities, dtype=np.uint64)
-    ports = rng.choice(np.arange(1, 30000), size=n_l4_keys, replace=False)
-    states = []
-    for _ in range(n_endpoints):
-        state = {}
-        for p in ports:
-            d = int(rng.integers(0, 2))
-            proto = int(rng.choice([6, 17]))
-            proxy = int(rng.choice([0, 0, 0, 15001]))
-            for num_id in rng.choice(identity_ids, size=12):
-                state[PolicyKey(int(num_id), int(p), proto, d)] = (
-                    PolicyMapStateEntry(proxy_port=proxy)
-                )
-            if rng.random() < 0.2:
-                state[PolicyKey(0, int(p), proto, d)] = (
-                    PolicyMapStateEntry(proxy_port=proxy)
-                )
-        for num_id in rng.choice(identity_ids, size=n_l4_keys):
-            d = int(rng.integers(0, 2))
-            state[PolicyKey(int(num_id), 0, 0, d)] = PolicyMapStateEntry()
-        states.append(state)
-    return states, identity_ids
+    rng = np.random.default_rng(11)
+    base_local = 1 << 24
+    # 20k prefixes: /16s, /24s and /32s over 10.0.0.0/8
+    mapping = {}
+    ids = []
+    for i in range(64):
+        mapping[f"10.{i}.0.0/16"] = base_local + len(ids)
+        ids.append(base_local + len(ids))
+    for i in range(4096):
+        mapping[f"10.{64 + i // 256}.{i % 256}.0/24"] = base_local + len(ids)
+        ids.append(base_local + len(ids))
+    for i in range(16384):
+        a, b = 128 + i // 8192, (i // 32) % 256
+        mapping[f"10.{a}.{b}.{i % 32 * 8}/32"] = base_local + len(ids)
+        ids.append(base_local + len(ids))
+    lpm = build_lpm(mapping)
+
+    # one endpoint allowing half the CIDR identities on port 443 + L3
+    state = {}
+    for num_id in ids[::2]:
+        state[PolicyKey(num_id, 443, 6, INGRESS)] = PolicyMapStateEntry()
+    for num_id in ids[::5]:
+        state[PolicyKey(num_id, 0, 0, INGRESS)] = PolicyMapStateEntry()
+    tables = compile_map_states([state], ids, identity_pad=1024)
+
+    n = args.cidr_tuples
+    src = (
+        0x0A000000 | rng.integers(0, 1 << 24, size=n)
+    ).astype(np.uint32)
+    batch = TupleBatch.from_numpy(
+        ep_index=np.zeros(n, np.int32),
+        identity=np.zeros(n, np.uint32),
+        dport=rng.choice([443, 80], size=n),
+        proto=np.full(n, 6),
+        direction=np.zeros(n, np.int64),
+    )
+    src_d = jax.device_put(src)
+    tables_d = jax.device_put(tables)
+    lpm_d = jax.device_put(lpm)
+    out = evaluate_batch_from_ips(lpm_d, tables_d, src_d, batch)
+    jax.block_until_ready(out)
+
+    # oracle subsample
+    host = HostLPM(mapping)
+    sample = rng.integers(0, n, size=512)
+    allowed = np.asarray(out.allowed)
+    dports = np.asarray(batch.dport)
+    for i in sample:
+        sec = host.lookup(int(src[i]))
+        v = policy_can_access(state, sec, int(dports[i]), 6, INGRESS)
+        assert bool(allowed[i]) == v.allowed, (
+            f"CIDR config divergence at {i}"
+        )
+
+    steps = 16
+    t0 = time.perf_counter()
+    outs = [
+        evaluate_batch_from_ips(lpm_d, tables_d, src_d, batch)
+        for _ in range(steps)
+    ]
+    jax.block_until_ready(outs)
+    vps = steps * n / (time.perf_counter() - t0)
+    emit(
+        "config2_cidr_verdicts_per_sec",
+        round(vps),
+        "verdicts/s",
+        prefixes=len(mapping),
+        tuples=n,
+        bit_identical=True,
+    )
 
 
-def make_batches(rng, n_batches, b, n_endpoints, identity_ids, ports):
-    from cilium_tpu.engine.verdict import TupleBatch
+# ---------------------------------------------------------------------------
+# config 3: HTTP L7
+# ---------------------------------------------------------------------------
 
-    batches = []
-    for _ in range(n_batches):
-        batches.append(
-            TupleBatch.from_numpy(
-                ep_index=rng.integers(0, n_endpoints, size=b),
-                identity=rng.choice(identity_ids, size=b).astype(np.uint32),
-                dport=rng.choice(ports, size=b),
-                proto=rng.choice([6, 17], size=b),
-                direction=rng.integers(0, 2, size=b),
+
+def config3(args) -> None:
+    import jax
+
+    from cilium_tpu.l7.http import (
+        HTTPRuleSpec,
+        compile_http_rules,
+        evaluate_http_batch,
+        http_rule_matches_host,
+        pad_requests,
+    )
+
+    rng = np.random.default_rng(13)
+    n_ident = 1024
+    specs = []
+    for i in range(24):
+        specs.append(
+            HTTPRuleSpec(
+                identity_indices=list(
+                    rng.integers(0, n_ident, size=64)
+                ),
+                method="GET|POST" if i % 3 else "GET",
+                path=f"/api/v{i % 4}/[a-z]+(/[0-9]+)?",
+                host="" if i % 2 else r"svc[0-9]+\.cluster\.local",
             )
         )
-    return batches
+    policy = compile_http_rules(specs, n_ident)
 
+    # request templates → padded tensors once, then gather to 1M
+    templates = []
+    for i in range(256):
+        method = rng.choice(["GET", "POST", "PUT", "DELETE"])
+        path = rng.choice(
+            [
+                f"/api/v{i % 4}/users/{i}",
+                f"/api/v{i % 4}/items",
+                f"/health",
+                f"/api/v9/nope",
+                f"/api/v{i % 4}/x" + "y" * int(rng.integers(0, 40)),
+            ]
+        )
+        host = rng.choice(
+            [f"svc{i % 8}.cluster.local", "evil.example.com", ""]
+        )
+        templates.append(
+            (method.encode(), path.encode(), host.encode())
+        )
+    tm, tml, tp, tpl, th, thl = pad_requests(templates)
+    n = args.l7_requests
+    pick = rng.integers(0, len(templates), size=n)
+    ident = rng.integers(0, n_ident, size=n).astype(np.int32)
+    known = np.ones(n, dtype=bool)
 
-def spot_check(states, tables, batch, n=2048):
-    """Oracle bit-identity on a subsample — abort the bench if the
-    device path diverges from the reference semantics."""
-    from cilium_tpu.engine.oracle import evaluate_batch_oracle
-    from cilium_tpu.engine.verdict import evaluate_batch
+    tbl = policy.tables
+    # tables enter as jit constants (HTTPTables is host-side metadata,
+    # not a pytree)
+    step = jax.jit(lambda *t: evaluate_http_batch(tbl, *t))
+    dev = [
+        jax.device_put(x)
+        for x in (
+            tm[pick], tml[pick], tp[pick], tpl[pick], th[pick],
+            thl[pick], ident, known,
+        )
+    ]
+    out = step(*dev)
+    jax.block_until_ready(out)
 
-    sub = {
-        "ep_index": np.asarray(batch.ep_index[:n]),
-        "identity": np.asarray(batch.identity[:n]),
-        "dport": np.asarray(batch.dport[:n]),
-        "proto": np.asarray(batch.proto[:n]),
-        "direction": np.asarray(batch.direction[:n]),
-    }
-    want_allow, want_proxy, want_kind = evaluate_batch_oracle(
-        copy.deepcopy(states), **sub
+    # host oracle subsample
+    allowed = np.asarray(out[0])
+    sample = rng.integers(0, n, size=256)
+    for i in sample:
+        m, p, h = templates[int(pick[i])]
+        want = any(
+            int(ident[i]) in spec.identity_indices
+            and http_rule_matches_host(spec, m, p, h)
+            for spec in specs
+        )
+        assert bool(allowed[i]) == want, f"HTTP divergence at {i}"
+
+    steps = 8
+    t0 = time.perf_counter()
+    outs = [step(*dev) for _ in range(steps)]
+    jax.block_until_ready(outs)
+    rps = steps * n / (time.perf_counter() - t0)
+    emit(
+        "config3_http_requests_per_sec",
+        round(rps),
+        "requests/s",
+        rules=len(specs),
+        requests=n,
+        bit_identical=True,
     )
-    from cilium_tpu.engine.verdict import TupleBatch
 
-    got = evaluate_batch(tables, TupleBatch.from_numpy(**sub))
-    assert (np.asarray(got.allowed) == want_allow).all(), "allow mismatch"
-    assert (np.asarray(got.proxy_port) == want_proxy).all(), "proxy mismatch"
-    assert (np.asarray(got.match_kind) == want_kind).all(), "kind mismatch"
+
+# ---------------------------------------------------------------------------
+# config 4: Kafka L7
+# ---------------------------------------------------------------------------
+
+
+def config4(args) -> None:
+    import jax
+
+    from cilium_tpu.l7.kafka import (
+        KafkaRequest,
+        KafkaRuleSpec,
+        compile_kafka_rules,
+        evaluate_kafka_batch,
+        matches_rules_host,
+        pad_kafka_requests,
+    )
+
+    rng = np.random.default_rng(17)
+    n_ident = 1024
+    specs = []
+    for i in range(24):
+        specs.append(
+            KafkaRuleSpec(
+                identity_indices=frozenset(
+                    int(x) for x in rng.integers(0, n_ident, size=64)
+                ),
+                api_keys=(0,) if i % 2 else (1, 2, 3),
+                topic=f"topic{i % 16}" if i % 3 else "",
+            )
+        )
+    tables = compile_kafka_rules(specs, n_ident)
+
+    templates = []
+    for i in range(256):
+        kind = int(rng.choice([0, 1, 2, 3, 8, 9]))
+        topics = [f"topic{int(t)}" for t in rng.integers(0, 24,
+                  size=int(rng.integers(0, 3)))]
+        templates.append(
+            KafkaRequest(
+                kind=kind,
+                version=0,
+                client_id=f"client{i % 4}",
+                topics=tuple(topics),
+                parsed=True,
+            )
+        )
+    packed = pad_kafka_requests(tables, templates)
+    n = args.l7_requests
+    pick = rng.integers(0, len(templates), size=n)
+    ident = rng.integers(0, n_ident, size=n).astype(np.int32)
+    known = np.ones(n, dtype=bool)
+    dev = [jax.device_put(np.asarray(a)[pick]) for a in packed]
+    dev += [jax.device_put(ident), jax.device_put(known)]
+
+    # tables enter as jit constants (KafkaTables is host metadata)
+    step = jax.jit(lambda *t: evaluate_kafka_batch(tables, *t))
+    out = step(*dev)
+    jax.block_until_ready(out)
+
+    allowed = np.asarray(out)
+    sample = rng.integers(0, n, size=256)
+    for i in sample:
+        req = templates[int(pick[i])]
+        want = matches_rules_host(req, specs, int(ident[i]))
+        assert bool(allowed[i]) == want, f"Kafka divergence at {i}"
+
+    steps = 8
+    t0 = time.perf_counter()
+    outs = [step(*dev) for _ in range(steps)]
+    jax.block_until_ready(outs)
+    rps = steps * n / (time.perf_counter() - t0)
+    emit(
+        "config4_kafka_requests_per_sec",
+        round(rps),
+        "requests/s",
+        rules=len(specs),
+        requests=n,
+        bit_identical=True,
+    )
+
+
+# ---------------------------------------------------------------------------
 
 
 def smoke() -> None:
     """Small end-to-end from real rules, on whatever backend is up."""
-    import __graft_entry__
     import jax
+
+    import __graft_entry__
 
     fn, args = __graft_entry__.entry()
     out = jax.jit(fn)(*args)
@@ -116,11 +979,19 @@ def smoke() -> None:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
-    ap.add_argument("--batch", type=int, default=1 << 22)
-    ap.add_argument("--steps", type=int, default=16)
-    ap.add_argument("--endpoints", type=int, default=16)
-    ap.add_argument("--identities", type=int, default=65536)
-    ap.add_argument("--l4-keys", type=int, default=256)
+    ap.add_argument(
+        "--configs", default="1,2,3,4,5",
+        help="comma-separated subset of 1-5",
+    )
+    ap.add_argument("--rules", type=int, default=50_000)
+    ap.add_argument("--endpoints", type=int, default=32)
+    ap.add_argument("--identities", type=int, default=65_536)
+    ap.add_argument("--tuples", type=int, default=10_000_000)
+    ap.add_argument("--pool", type=int, default=50_000)
+    ap.add_argument("--batch", type=int, default=1 << 20)
+    ap.add_argument("--oracle-sample", type=int, default=2048)
+    ap.add_argument("--cidr-tuples", type=int, default=100_000)
+    ap.add_argument("--l7-requests", type=int, default=1_000_000)
     args = ap.parse_args()
 
     sys.path.insert(0, "/root/repo")
@@ -128,48 +999,17 @@ def main() -> None:
         smoke()
         return
 
-    import jax
-
-    from cilium_tpu.compiler import compile_map_states
-    from cilium_tpu.engine.verdict import evaluate_batch
-
-    rng = np.random.default_rng(7)
-    states, identity_ids = build_synthetic_states(
-        args.endpoints, args.identities, args.l4_keys, rng
-    )
-    tables = compile_map_states(states, identity_ids)
-    tables = jax.device_put(tables)
-
-    ports = np.arange(1, 30000)
-    batches = make_batches(
-        rng, 4, args.batch, args.endpoints, identity_ids, ports
-    )
-    batches = [jax.device_put(b) for b in batches]
-
-    spot_check(states, tables, batches[0])
-
-    # warmup / compile
-    jax.block_until_ready(evaluate_batch(tables, batches[0]))
-
-    t0 = time.perf_counter()
-    outs = []
-    for i in range(args.steps):
-        outs.append(evaluate_batch(tables, batches[i % len(batches)]))
-    jax.block_until_ready(outs)
-    dt = time.perf_counter() - t0
-
-    total = args.steps * args.batch
-    vps = total / dt
-    print(
-        json.dumps(
-            {
-                "metric": "verdicts_per_sec_per_chip",
-                "value": round(vps),
-                "unit": "verdicts/s",
-                "vs_baseline": round(vps / BASELINE_PER_CHIP, 3),
-            }
-        )
-    )
+    configs = {c.strip() for c in args.configs.split(",")}
+    if "1" in configs:
+        config1()
+    if "2" in configs:
+        config2(args)
+    if "3" in configs:
+        config3(args)
+    if "4" in configs:
+        config4(args)
+    if "5" in configs:
+        run_config5(args)  # headline, prints LAST
 
 
 if __name__ == "__main__":

@@ -58,7 +58,11 @@ from cilium_tpu.ct.table import (
     TUPLE_F_IN,
     TUPLE_F_OUT,
 )
-from cilium_tpu.engine.verdict import TupleBatch, _verdict_kernel
+from cilium_tpu.engine.verdict import (
+    TupleBatch,
+    _verdict_kernel,
+    _verdict_kernel_with_counters,
+)
 from cilium_tpu.identity import RESERVED_WORLD
 from cilium_tpu.ipcache.lpm import LPMTables, _lookup_kernel
 from cilium_tpu.lb.device import LBTables, lb_select_batch
@@ -197,9 +201,9 @@ class DatapathVerdicts:
         return cls(*children)
 
 
-def _datapath_kernel(
-    tables: DatapathTables, flows: FlowBatch
-) -> DatapathVerdicts:
+def _datapath_core(
+    tables: DatapathTables, flows: FlowBatch, with_counters: bool
+):
     ingress = flows.direction == INGRESS
 
     # -- 1. XDP prefilter (deny-by-CIDR before everything) ------------------
@@ -254,17 +258,20 @@ def _datapath_kernel(
     ).astype(jnp.uint32)
 
     # -- 5. policy lattice (always evaluated, bpf_lxc.c:959) ----------------
-    v = _verdict_kernel(
-        tables.policy,
-        TupleBatch(
-            ep_index=flows.ep_index,
-            identity=sec_id,
-            dport=eff_dport,
-            proto=flows.proto,
-            direction=flows.direction,
-            is_fragment=flows.is_fragment,
-        ),
+    resolved = TupleBatch(
+        ep_index=flows.ep_index,
+        identity=sec_id,
+        dport=eff_dport,
+        proto=flows.proto,
+        direction=flows.direction,
+        is_fragment=flows.is_fragment,
     )
+    if with_counters:
+        v, l4_counts, l3_counts = _verdict_kernel_with_counters(
+            tables.policy, resolved
+        )
+    else:
+        v = _verdict_kernel(tables.policy, resolved)
 
     # -- 6. combine (bpf_lxc.c:962-985) -------------------------------------
     pol_allow = v.allowed.astype(bool)
@@ -282,7 +289,7 @@ def _datapath_kernel(
         0,
     )
 
-    return DatapathVerdicts(
+    out = DatapathVerdicts(
         allowed=allowed.astype(jnp.uint8),
         proxy_port=proxy,
         match_kind=v.match_kind,
@@ -296,9 +303,41 @@ def _datapath_kernel(
         ct_create=ct_create,
         ct_delete=ct_delete,
     )
+    if with_counters:
+        return out, l4_counts, l3_counts
+    return out
+
+
+def _datapath_kernel(
+    tables: DatapathTables, flows: FlowBatch
+) -> DatapathVerdicts:
+    return _datapath_core(tables, flows, with_counters=False)
+
+
+def _datapath_kernel_with_counters(
+    tables: DatapathTables, flows: FlowBatch
+):
+    """Fused step + per-entry packet counters (policy.h:66-68), same
+    counter semantics as the lattice-only counters kernel: a counter
+    bump per lattice hit, indexed in the published tables' slot and
+    identity axes."""
+    return _datapath_core(tables, flows, with_counters=True)
 
 
 datapath_step = jax.jit(_datapath_kernel)
+datapath_step_with_counters = jax.jit(_datapath_kernel_with_counters)
+
+
+def _unique_rows(cols: list, sel: np.ndarray) -> np.ndarray:
+    """Stack selected rows of the given columns and dedupe — the
+    columns are packed into one u64-pair view so np.unique sorts a
+    contiguous array instead of doing per-row tuple compares."""
+    rows = np.stack(
+        [np.asarray(c)[sel].astype(np.uint64) for c in cols], axis=1
+    )
+    if rows.shape[0] == 0:
+        return rows
+    return np.unique(rows, axis=0)
 
 
 def apply_ct_writeback(
@@ -307,7 +346,12 @@ def apply_ct_writeback(
     """Host-side CT mutation after a batch: create entries for
     NEW+allowed flows (ct_create4, bpf_lxc.c:978) and delete
     ESTABLISHED-but-now-denied entries (ct_delete4, bpf_lxc.c:968).
-    Returns (created, deleted)."""
+    Returns (created, deleted).
+
+    Vectorized: flagged rows are deduplicated with one np.unique over
+    packed tuple columns, so host dict work is O(unique flows), not
+    O(batch) — a 1M-tuple batch over a 64k-flow universe touches the
+    dict at most 64k times regardless of batch size."""
     create = np.asarray(out.ct_create)
     delete = np.asarray(out.ct_delete)
     daddr = np.asarray(out.final_daddr)
@@ -320,30 +364,28 @@ def apply_ct_writeback(
     slave = np.asarray(out.lb_slave)
 
     created = deleted = 0
-    for i in np.nonzero(create)[0]:
-        d = int(direction[i])
-        tup = CTTuple(
-            int(daddr[i]), int(saddr[i]), int(dport[i]), int(sport[i]),
-            int(proto[i]),
-        )
-        flags = TUPLE_F_OUT if d == CT_INGRESS else TUPLE_F_IN
-        key = CTTuple(
-            tup.daddr, tup.saddr, tup.dport, tup.sport, tup.nexthdr, flags
-        )
+    create_cols = [
+        daddr, saddr, dport, sport, proto, direction, rev_nat, slave
+    ]
+    for row in _unique_rows(create_cols, create):
+        (c_daddr, c_saddr, c_dport, c_sport, c_proto, c_dir,
+         c_rev, c_slave) = (int(v) for v in row)
+        flags = TUPLE_F_OUT if c_dir == CT_INGRESS else TUPLE_F_IN
+        key = CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto, flags)
         if key in ct.entries:
             continue  # duplicate within the batch
         ct.create(
-            tup, d, now=now, rev_nat_index=int(rev_nat[i]),
-            slave=int(slave[i]),
+            CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto),
+            c_dir, now=now, rev_nat_index=c_rev, slave=c_slave,
         )
         created += 1
-    for i in np.nonzero(delete)[0]:
-        d = int(direction[i])
-        flags = TUPLE_F_OUT if d == CT_INGRESS else TUPLE_F_IN
-        key = CTTuple(
-            int(daddr[i]), int(saddr[i]), int(dport[i]), int(sport[i]),
-            int(proto[i]), flags,
+    delete_cols = [daddr, saddr, dport, sport, proto, direction]
+    for row in _unique_rows(delete_cols, delete):
+        c_daddr, c_saddr, c_dport, c_sport, c_proto, c_dir = (
+            int(v) for v in row
         )
+        flags = TUPLE_F_OUT if c_dir == CT_INGRESS else TUPLE_F_IN
+        key = CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto, flags)
         if ct.entries.pop(key, None) is not None:
             deleted += 1
     return created, deleted

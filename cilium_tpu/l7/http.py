@@ -109,6 +109,31 @@ def specs_from_filter(l4_filter, identity_cache, id_index) -> List["HTTPRuleSpec
     return specs
 
 
+def _dedupe_specs(rules: List[HTTPRuleSpec]) -> List[HTTPRuleSpec]:
+    """Rules with identical patterns are one device rule with the
+    union of their identity sets — allowed = OR over rules, so this
+    is semantics-preserving.  The dominant case is the allow-all
+    pseudo-rules that every L3-only rule wildcards into each L7
+    filter (repository.go:170): they all collapse to one."""
+    merged: Dict[Tuple[str, str, str], set] = {}
+    order: List[Tuple[str, str, str]] = []
+    for rule in rules:
+        key = (rule.method, rule.path, rule.host)
+        if key not in merged:
+            merged[key] = set()
+            order.append(key)
+        merged[key].update(rule.identity_indices)
+    return [
+        HTTPRuleSpec(
+            identity_indices=sorted(merged[key]),
+            method=key[0],
+            path=key[1],
+            host=key[2],
+        )
+        for key in order
+    ]
+
+
 def compile_http_rules(
     rules: Sequence[HTTPRuleSpec],
     n_identities: int,
@@ -122,6 +147,7 @@ def compile_http_rules(
             host_rules.append(rule)
             continue
         device_rules.append(rule)
+    device_rules = _dedupe_specs(device_rules)
     if len(device_rules) > MAX_RULES:
         raise RegexTooComplex(
             f"more than {MAX_RULES} device HTTP rules per filter"

@@ -108,9 +108,40 @@ def rule_spec_from_port_rule(rule, identity_indices) -> KafkaRuleSpec:
     )
 
 
+def _dedupe_specs(specs: Sequence[KafkaRuleSpec]) -> List[KafkaRuleSpec]:
+    """Specs with identical match fields are one device rule with the
+    union of their identity sets (allowed = OR over rules) — collapses
+    the per-selector allow-all pseudo-rules that L3-only rules
+    wildcard into every L7 filter (repository.go:170)."""
+    merged: Dict[tuple, set] = {}
+    order: List[tuple] = []
+    for spec in specs:
+        key = (
+            tuple(sorted(spec.api_keys)),
+            spec.api_version,
+            spec.client_id,
+            spec.topic,
+        )
+        if key not in merged:
+            merged[key] = set()
+            order.append(key)
+        merged[key].update(spec.identity_indices)
+    return [
+        KafkaRuleSpec(
+            identity_indices=sorted(merged[key]),
+            api_keys=key[0],
+            api_version=key[1],
+            client_id=key[2],
+            topic=key[3],
+        )
+        for key in order
+    ]
+
+
 def compile_kafka_rules(
     specs: Sequence[KafkaRuleSpec], n_identities: int
 ) -> KafkaTables:
+    specs = _dedupe_specs(specs)
     if len(specs) > MAX_RULES:
         raise ValueError(f"more than {MAX_RULES} Kafka rules per filter")
     r = max(len(specs), 1)

@@ -3,10 +3,17 @@
 The framework's data-loader (SURVEY §7 step 5: "flow-replay harness,
 Hubble-tuple reader"): reads fixed 24-byte flow records (decoded by
 the native C++ decoder at memory bandwidth), streams fixed-size padded
-batches through the verdict engine with pipelined dispatch (the
-double-buffered H2D pattern of SURVEY §7 hard part 6), accumulates
-per-entry counters back into the endpoints' realized map states, and
-optionally folds denied flows into monitor events.
+batches through the FUSED datapath step — prefilter → LB/DNAT → CT →
+ipcache LPM → policy lattice in one jit (engine/datapath.py, the
+analog of bpf_lxc.c:440/899 being ONE program) — with pipelined
+dispatch (the double-buffered H2D pattern of SURVEY §7 hard part 6),
+accumulates per-entry counters back into the endpoints' realized map
+states, and optionally applies CT writeback between batches so NEW
+flows become ESTABLISHED mid-replay (sustained-churn mode).
+
+`replay_lattice` keeps the bare policy-lattice path for callers that
+have only compiled PolicyTables (no CT/LB/ipcache state) — identity
+comes pre-resolved from the record, as in a Hubble post-hoc replay.
 """
 
 from __future__ import annotations
@@ -32,20 +39,15 @@ class ReplayStats:
     redirected: int = 0
     batches: int = 0
     seconds: float = 0.0
+    ct_created: int = 0
+    ct_deleted: int = 0
 
     @property
     def verdicts_per_sec(self) -> float:
         return self.total / self.seconds if self.seconds else 0.0
 
 
-def read_batches(
-    buf: bytes, batch_size: int, ep_map: Optional[Dict[int, int]] = None
-) -> Iterator[TupleBatch]:
-    """Decode flow records and yield padded TupleBatches.  `ep_map`
-    translates record endpoint ids to table endpoint-axis indices
-    (unknown endpoints map to 0 — callers should pre-filter)."""
-    rec = decode_flow_records(buf)
-    n = len(rec["ep_id"])
+def _ep_index_of(rec, ep_map: Optional[Dict[int, int]]) -> np.ndarray:
     # int64: a u32 ep_id near 2^32 must not wrap negative pre-LUT
     ep_index = rec["ep_id"].astype(np.int64)
     if ep_map is not None:
@@ -56,27 +58,72 @@ def read_batches(
         ep_index = np.where(
             in_range, lut[np.minimum(ep_index, len(lut) - 1)], 0
         )
-    ep_index = ep_index.astype(np.int32)
+    return ep_index.astype(np.int32)
+
+
+def _batch_slices(n: int, batch_size: int):
     for start in range(0, n, batch_size):
-        end = min(start + batch_size, n)
-        pad = batch_size - (end - start)
-        def padded(a, fill=0):
-            chunk = a[start:end]
-            if pad:
-                chunk = np.concatenate(
-                    [chunk, np.full(pad, fill, dtype=a.dtype)]
-                )
-            return chunk
+        yield start, min(start + batch_size, n)
+
+
+def _padded(a: np.ndarray, start: int, end: int, size: int, fill=0):
+    chunk = a[start:end]
+    pad = size - (end - start)
+    if pad:
+        chunk = np.concatenate(
+            [chunk, np.full(pad, fill, dtype=a.dtype)]
+        )
+    return chunk
+
+
+def read_batches(
+    buf: bytes, batch_size: int, ep_map: Optional[Dict[int, int]] = None
+) -> Iterator[Tuple[TupleBatch, int]]:
+    """Decode flow records and yield padded TupleBatches (identity
+    pre-resolved from the record).  `ep_map` translates record
+    endpoint ids to table endpoint-axis indices (unknown endpoints map
+    to 0 — callers should pre-filter)."""
+    rec = decode_flow_records(buf)
+    n = len(rec["ep_id"])
+    ep_index = _ep_index_of(rec, ep_map)
+    for start, end in _batch_slices(n, batch_size):
+        p = lambda a, fill=0: _padded(a, start, end, batch_size, fill)
         yield (
             TupleBatch.from_numpy(
-                ep_index=padded(ep_index),
-                identity=padded(rec["identity"]),
-                dport=padded(rec["dport"].astype(np.int32)),
-                proto=padded(rec["proto"].astype(np.int32)),
-                direction=padded(rec["direction"].astype(np.int32)),
-                is_fragment=padded(
-                    rec["is_fragment"].astype(bool), fill=False
-                ),
+                ep_index=p(ep_index),
+                identity=p(rec["identity"]),
+                dport=p(rec["dport"].astype(np.int32)),
+                proto=p(rec["proto"].astype(np.int32)),
+                direction=p(rec["direction"].astype(np.int32)),
+                is_fragment=p(rec["is_fragment"].astype(bool), fill=False),
+            ),
+            end - start,
+        )
+
+
+def read_flow_batches(
+    buf: bytes, batch_size: int, ep_map: Optional[Dict[int, int]] = None
+) -> Iterator[tuple]:
+    """Decode flow records and yield padded FlowBatches (raw 5-tuples
+    with addresses — identity resolution happens on device via the
+    ipcache LPM inside the fused step)."""
+    from cilium_tpu.engine.datapath import FlowBatch
+
+    rec = decode_flow_records(buf)
+    n = len(rec["ep_id"])
+    ep_index = _ep_index_of(rec, ep_map)
+    for start, end in _batch_slices(n, batch_size):
+        p = lambda a, fill=0: _padded(a, start, end, batch_size, fill)
+        yield (
+            FlowBatch.from_numpy(
+                ep_index=p(ep_index),
+                saddr=p(rec["saddr"]),
+                daddr=p(rec["daddr"]),
+                sport=p(rec["sport"].astype(np.int32)),
+                dport=p(rec["dport"].astype(np.int32)),
+                proto=p(rec["proto"].astype(np.int32)),
+                direction=p(rec["direction"].astype(np.int32)),
+                is_fragment=p(rec["is_fragment"].astype(bool), fill=False),
             ),
             end - start,
         )
@@ -89,10 +136,20 @@ def replay(
     accumulate_counters: bool = True,
     ep_map: Optional[Dict[int, int]] = None,
     manager=None,
+    ct_map=None,
 ) -> tuple:
-    """Run all records through the full datapath step with pipelined
-    dispatch (bounded-depth queue of in-flight device batches — the
-    double-buffered H2D pattern of SURVEY §7 hard part 6).
+    """Run all records through the FULL fused datapath step
+    (engine/datapath.datapath_step_with_counters) with pipelined
+    dispatch.
+
+    `tables` is a DatapathTables (prefilter/ipcache/CT/LB/policy).
+    With `ct_map` (the authoritative host CTMap) replay runs in
+    sustained-churn mode: batches are drained in order, CT writeback
+    (create/delete intents) is applied after each batch, and the
+    device CT snapshot is recompiled whenever it changed — so a flow
+    created by batch i is ESTABLISHED from batch i+1 on, mirroring the
+    kernel datapath seeing its own CT writes.  Without it batches
+    evaluate against the fixed snapshot and stay pipelined.
 
     Returns (ReplayStats, l4_counts, l3_counts); the counter arrays
     are u64 sums across batches with shapes [E, 2, Kg] and [E, 2, N]
@@ -101,11 +158,72 @@ def replay(
     """
     import time
 
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.engine.datapath import (
+        DatapathTables,
+        apply_ct_writeback,
+        datapath_step_with_counters,
+    )
+
     if manager is not None:
         # stale-table guard at the layer that actually reads the
         # stacked per-endpoint rows: tables 2+ publishes old have had
         # those rows rewritten in place (FleetCompiler double
         # buffering) and would return wrong verdicts silently
+        manager.check_tables_current(tables.policy)
+
+    stats = ReplayStats()
+    acc = _CounterAccumulator() if accumulate_counters else None
+
+    pending = []  # pipelined dispatch, bounded depth
+    t0 = time.perf_counter()
+    for flows, valid in read_flow_batches(buf, batch_size, ep_map):
+        out = datapath_step_with_counters(tables, flows)
+        if ct_map is not None:
+            # sustained churn: drain in order, fold intents back, and
+            # refresh the snapshot the next batch probes
+            _drain_fused((out, valid), stats, acc)
+            verdicts = out[0]
+            created, deleted = apply_ct_writeback(ct_map, verdicts, flows)
+            stats.ct_created += created
+            stats.ct_deleted += deleted
+            stats.batches += 1
+            if created or deleted:
+                tables = DatapathTables(
+                    prefilter=tables.prefilter,
+                    ipcache=tables.ipcache,
+                    ct=compile_ct(ct_map),
+                    lb=tables.lb,
+                    policy=tables.policy,
+                )
+            continue
+        pending.append((out, valid))
+        stats.batches += 1
+        if len(pending) >= 4:
+            _drain_fused(pending.pop(0), stats, acc)
+    while pending:
+        _drain_fused(pending.pop(0), stats, acc)
+    stats.seconds = time.perf_counter() - t0
+
+    if acc is None:
+        return stats, None, None
+    return stats, acc.l4, acc.l3
+
+
+def replay_lattice(
+    tables,
+    buf: bytes,
+    batch_size: int = 1 << 20,
+    accumulate_counters: bool = True,
+    ep_map: Optional[Dict[int, int]] = None,
+    manager=None,
+) -> tuple:
+    """Replay through the bare policy lattice (PolicyTables only,
+    identity pre-resolved from the record) — the post-hoc Hubble
+    audit path.  Same return shape as replay()."""
+    import time
+
+    if manager is not None:
         manager.check_tables_current(tables)
     step = _replay_step()
     stats = ReplayStats()
@@ -140,24 +258,31 @@ class _CounterAccumulator:
         self.l3 += np.asarray(l3_counts).astype(np.uint64)
 
 
-def _drain(item, stats: ReplayStats, acc: Optional[_CounterAccumulator]) -> None:
-    (verdicts, l4_counts, l3_counts), valid = item
+def _tally(verdicts, valid, stats: ReplayStats) -> None:
     allowed = np.asarray(verdicts.allowed)[:valid]
     proxy = np.asarray(verdicts.proxy_port)[:valid]
     stats.total += int(valid)
     stats.allowed += int(allowed.sum())
     stats.denied += int(valid - allowed.sum())
     stats.redirected += int((proxy > 0).sum())
+
+
+def _drain(item, stats: ReplayStats, acc: Optional[_CounterAccumulator]) -> None:
+    (verdicts, l4_counts, l3_counts), valid = item
+    _tally(verdicts, valid, stats)
     if acc is not None:
         acc.add(l4_counts, l3_counts)
+
+
+_drain_fused = _drain  # fused output tuples share the drain shape
 
 
 _REPLAY_STEP = None
 
 
 def _replay_step():
-    """Module-level jitted datapath step (one compilation cache across
-    replay() calls, like engine.verdict.evaluate_batch)."""
+    """Module-level jitted lattice step (one compilation cache across
+    replay_lattice() calls, like engine.verdict.evaluate_batch)."""
     global _REPLAY_STEP
     if _REPLAY_STEP is None:
         import jax

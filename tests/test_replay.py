@@ -17,7 +17,9 @@ from cilium_tpu.maps.policymap import INGRESS, PolicyKey
 from cilium_tpu.native import encode_flow_records
 from cilium_tpu.replay import (
     read_batches,
+    read_flow_batches,
     replay,
+    replay_lattice,
     slot_keys_from_tables,
     sync_counters_to_endpoints,
 )
@@ -94,7 +96,7 @@ def test_replay_matches_direct_eval():
     cid = client.security_identity.id
     buf = _make_buf(rng, n, [10], [cid, 12345])
 
-    stats, l4c, l3c = replay(
+    stats, l4c, l3c = replay_lattice(
         tables, buf, batch_size=256, ep_map={10: index[10]}
     )
     assert stats.total == n
@@ -117,7 +119,7 @@ def test_replay_no_counters_contract():
     _, tables, index = d.endpoint_manager.published()
     rng = np.random.default_rng(1)
     buf = _make_buf(rng, 100, [10], [client.security_identity.id])
-    stats, l4c, l3c = replay(
+    stats, l4c, l3c = replay_lattice(
         tables, buf, batch_size=64, accumulate_counters=False,
         ep_map={10: index[10]},
     )
@@ -130,6 +132,92 @@ def test_slot_keys_roundtrip():
     _, tables, _ = d.endpoint_manager.published()
     keys = slot_keys_from_tables(tables)
     assert (80, 6) in keys.values()
+
+
+def _fused_world():
+    from tests.test_datapath import _build_world
+
+    return _build_world(11)
+
+
+def _encode_flows(f, identities=None):
+    n = len(f["ep_index"])
+    return encode_flow_records(
+        ep_id=np.asarray(f["ep_index"], np.uint32),
+        identity=(
+            np.asarray(identities, np.uint32)
+            if identities is not None
+            else np.zeros(n, np.uint32)
+        ),
+        saddr=np.asarray(f["saddr"], np.uint32),
+        daddr=np.asarray(f["daddr"], np.uint32),
+        sport=np.asarray(f["sport"], np.uint16),
+        dport=np.asarray(f["dport"], np.uint16),
+        proto=np.asarray(f["proto"], np.uint8),
+        direction=np.asarray(f["direction"], np.uint8),
+        is_fragment=np.asarray(f["is_fragment"], np.uint8),
+    )
+
+
+def test_fused_replay_matches_direct_datapath_step():
+    """replay() routes records through the FULL fused datapath step:
+    multi-batch pipelined stats equal a one-shot datapath_step run."""
+    from cilium_tpu.engine.datapath import datapath_step
+    from tests.test_datapath import _random_flows
+
+    (rng, _, _, ct, _, states, tables, n_eps) = _fused_world()
+    n = 512
+    f = _random_flows(rng, n, n_eps)
+    buf = _encode_flows(f)
+
+    stats, l4c, l3c = replay(tables, buf, batch_size=128)
+    assert stats.total == n
+    assert stats.batches == 4
+    assert l4c is not None and l3c is not None
+
+    flows = list(read_flow_batches(buf, n))[0][0]
+    ref = datapath_step(tables, flows)
+    ref_allowed = int(np.asarray(ref.allowed).sum())
+    ref_redirected = int((np.asarray(ref.proxy_port) > 0).sum())
+    assert stats.allowed == ref_allowed
+    assert stats.denied == n - ref_allowed
+    assert stats.redirected == ref_redirected
+
+
+def test_fused_replay_sustained_churn():
+    """With ct_map, replay applies CT writeback between batches: a
+    flow NEW in batch i is ESTABLISHED when batch j>i repeats it."""
+    from cilium_tpu.ct.table import CT_NEW
+    from cilium_tpu.engine.datapath import datapath_step
+    from tests.test_datapath import _random_flows
+
+    (rng, _, _, ct, _, states, tables, n_eps) = _fused_world()
+    n = 128
+    f = _random_flows(rng, n, n_eps)
+    # repeat the same flows in a second half: NEW→ESTABLISHED
+    f2 = {k: np.concatenate([v, v]) for k, v in f.items()}
+    buf = _encode_flows(f2)
+
+    before = len(ct.entries)
+    stats, _, _ = replay(tables, buf, batch_size=n, ct_map=ct)
+    assert stats.total == 2 * n
+    assert stats.ct_created > 0
+    assert len(ct.entries) == before + stats.ct_created - stats.ct_deleted
+
+    # after the replay, re-running the first half must see no NEW
+    # among flows that were created
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.engine.datapath import DatapathTables, FlowBatch
+
+    tables2 = DatapathTables(
+        prefilter=tables.prefilter, ipcache=tables.ipcache,
+        ct=compile_ct(ct), lb=tables.lb, policy=tables.policy,
+    )
+    flows = FlowBatch.from_numpy(**f)
+    out1 = datapath_step(tables, flows)   # against original snapshot
+    out2 = datapath_step(tables2, flows)  # against post-replay snapshot
+    was_created = np.asarray(out1.ct_create)
+    assert not np.any(np.asarray(out2.ct_result)[was_created] == CT_NEW)
 
 
 def test_counters_sync_l3_and_l4():
@@ -152,7 +240,7 @@ def test_counters_sync_l3_and_l4():
         direction=np.zeros(n_l4 + n_l3, np.uint8),
         is_fragment=np.zeros(n_l4 + n_l3, np.uint8),
     )
-    stats, l4c, l3c = replay(
+    stats, l4c, l3c = replay_lattice(
         tables, buf, batch_size=8, ep_map={10: index[10]}
     )
     assert stats.allowed == n_l4 + n_l3
