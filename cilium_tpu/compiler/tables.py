@@ -28,6 +28,7 @@ and verdict bits are integers end-to-end — no floats (hard part 5).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Sequence, Tuple
 
@@ -350,6 +351,12 @@ class FleetCompiler:
         # must not apply its arithmetic to them.
         self._generation = 0
         self._instance_nonce = next(_COMPILER_NONCE)
+        # compile() mutates every cache (slot table, universe, row
+        # cache, stack buffers); callers run from both the daemon's
+        # trigger thread and test/bench main threads, so serialize —
+        # a concurrent _reset() mid-_lower_rows otherwise drops slots
+        # out from under the lowering loop.
+        self._compile_lock = threading.Lock()
         self._reset()
 
     def _reset(self) -> None:
@@ -554,6 +561,14 @@ class FleetCompiler:
         rows are relowered only when the token differs from the cached
         one.  Returns (tables, ep_id → endpoint-axis index).
         """
+        with self._compile_lock:
+            return self._compile_locked(endpoints, identity_ids)
+
+    def _compile_locked(
+        self,
+        endpoints: Sequence[Tuple[int, PolicyMapState, int]],
+        identity_ids: Sequence[int],
+    ) -> Tuple[PolicyTables, Dict[int, int]]:
         self._sync_universe(identity_ids)
 
         live = {ep_id for ep_id, _, _ in endpoints}

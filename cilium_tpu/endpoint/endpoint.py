@@ -313,20 +313,27 @@ class Endpoint:
             to_add, to_delete = diff_map_state(
                 self.realized_map_state, self.desired_map_state
             )
+            if not to_add and not to_delete:
+                return 0, 0
+            # Copy-on-write: the fleet compiler (and any stale-table
+            # consumer) may be iterating the current dict from another
+            # thread; publish a fresh dict atomically instead of
+            # mutating in place.
+            realized = dict(self.realized_map_state)
             for key in to_delete:
-                del self.realized_map_state[key]
+                del realized[key]
             for key in to_add:
-                old = self.realized_map_state.get(key)
+                old = realized.get(key)
                 entry = PolicyMapStateEntry(
                     proxy_port=self.desired_map_state[key].proxy_port,
                     packets=old.packets if old else 0,
                     bytes=old.bytes if old else 0,
                 )
-                self.realized_map_state[key] = entry
-            if to_add or to_delete:
-                # content token for the incremental fleet compiler:
-                # rows relower only when this changes
-                self.map_state_revision += 1
+                realized[key] = entry
+            self.realized_map_state = realized
+            # content token for the incremental fleet compiler:
+            # rows relower only when this changes
+            self.map_state_revision += 1
             return len(to_add), len(to_delete)
 
     def bump_policy_revision(self) -> None:

@@ -168,14 +168,20 @@ class EndpointManager:
         their cached rows; identity/slot tables rebuild only when the
         universe or key set changes (SURVEY §7 hard part 4)."""
         eps = sorted(self.endpoints(), key=lambda e: e.id)
-        entries = [
-            (
-                e.id,
-                e.realized_map_state,
-                (e.instance_nonce, e.map_state_revision),
-            )
-            for e in eps
-        ]
+        entries = []
+        for e in eps:
+            # (state, token) must be read atomically: sync_policy_map
+            # publishes a fresh dict and bumps the revision under the
+            # same lock; pairing a new dict with an old token would
+            # wrongly reuse cached rows.
+            with e.lock:
+                entries.append(
+                    (
+                        e.id,
+                        e.realized_map_state,
+                        (e.instance_nonce, e.map_state_revision),
+                    )
+                )
         return self._fleet_compiler.compile(entries, list(identity_cache))
 
     def publish_tables(self, identity_cache: IdentityCache) -> int:
