@@ -833,7 +833,7 @@ def config3(args) -> None:
         templates.append(
             (method.encode(), path.encode(), host.encode())
         )
-    tm, tml, tp, tpl, th, thl = pad_requests(templates)
+    tm, tml, tp, tpl, th, thl, _ = pad_requests(templates)
     n = args.l7_requests
     pick = rng.integers(0, len(templates), size=n)
     ident = rng.integers(0, n_ident, size=n).astype(np.int32)
@@ -926,7 +926,7 @@ def config4(args) -> None:
                 parsed=True,
             )
         )
-    packed = pad_kafka_requests(tables, templates)
+    packed = pad_kafka_requests(tables, templates)[:-1]
     n = args.l7_requests
     pick = rng.integers(0, len(templates), size=n)
     ident = rng.integers(0, n_ident, size=n).astype(np.int32)

@@ -15,18 +15,26 @@ Reference semantics being reproduced (bit-identically):
     on the host CPU) — rules carrying headers are excluded from the
     device tables and merged back by `evaluate_with_host_fallback`.
 
-Device layout (R ≤ 32 rules per port filter):
-  method/path/host DFAs — union DFAs with per-rule accept bits;
-  absent_<field> u32     — rules that omit the field (auto-match);
-  ident_rules   u32 [N]  — bit r set ⟺ rule r's selector admits
+Device layout (R rules per port filter, W = ceil(R/32) mask words —
+rule r lives in bit r%32 of word r//32; no 32-rule cap):
+  method/path/host DFAs — union DFAs with per-rule accept bits
+                           (accept u32 [S, W]);
+  absent_<field> u32 [W] — rules that omit the field (auto-match);
+  ident_rules u32 [N, W] — bit r set ⟺ rule r's selector admits
                            identity index n (includes allow-all
                            pseudo-rules, which also have all fields
                            absent).
+
+Requests whose method/path/host exceed the padded field budgets are
+FLAGGED (`overflow`) and re-evaluated host-side by
+`evaluate_with_host_fallback` — never silently truncated: a truncated
+byte tensor could both falsely full-match a prefix-shaped pattern and
+miss a long-match, in either direction.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -38,7 +46,11 @@ from cilium_tpu.l7.regex_dfa import (
     compile_union,
 )
 
-MAX_RULES = 32
+# Sanity ceiling only (accept masks are multi-word): guards against a
+# pathological compile blowing up accept-table width, not a semantic
+# limit — the reference's per-filter rule count is bounded by policy
+# size, not a constant.
+MAX_RULES = 4096
 
 
 @dataclass
@@ -57,15 +69,16 @@ class HTTPRuleSpec:
 class HTTPTables:
     """Device tables for one (endpoint, port, direction) HTTP filter."""
 
-    # DFAs (trans u16 [S,C], accept u32 [S], classes u8 [256], start)
+    # DFAs (trans u16 [S,C], accept u32 [S,W], classes u8 [256], start)
     method_dfa: DFA
     path_dfa: DFA
     host_dfa: DFA
-    absent_method: np.ndarray  # u32 scalar bitmask
+    absent_method: np.ndarray  # u32 [W] bitmask
     absent_path: np.ndarray
     absent_host: np.ndarray
-    ident_rules: np.ndarray  # u32 [N] per-identity rule bits
+    ident_rules: np.ndarray  # u32 [N, W] per-identity rule bits
     n_rules: int
+    n_words: int
 
 
 @dataclass
@@ -74,6 +87,10 @@ class HTTPPolicy:
 
     tables: HTTPTables
     host_rules: List[HTTPRuleSpec]  # header-carrying rules
+    # Deduped device rules retained for the host path: overflowed
+    # requests (fields beyond the padded budgets) re-evaluate against
+    # these with re.fullmatch instead of the truncated tensors.
+    device_rules: List[HTTPRuleSpec] = field(default_factory=list)
 
 
 def specs_from_filter(l4_filter, identity_cache, id_index) -> List["HTTPRuleSpec"]:
@@ -152,15 +169,22 @@ def compile_http_rules(
         raise RegexTooComplex(
             f"more than {MAX_RULES} device HTTP rules per filter"
         )
+    n_words = max(1, -(-len(device_rules) // 32))
 
-    def union_for(field: str) -> Tuple[DFA, int]:
+    def _to_words(mask: int) -> np.ndarray:
+        return np.array(
+            [(mask >> (32 * w)) & 0xFFFFFFFF for w in range(n_words)],
+            dtype=np.uint32,
+        )
+
+    def union_for(field_name: str) -> Tuple[DFA, np.ndarray]:
         """DFA over the present patterns; absent bitmask for the rest.
         Pattern bit positions == rule positions (absent patterns
         compile as never-matching placeholders via the absent mask)."""
         patterns = []
         absent = 0
         for i, rule in enumerate(device_rules):
-            pattern = getattr(rule, field)
+            pattern = getattr(rule, field_name)
             if pattern == "":
                 absent |= 1 << i
                 patterns.append("[^\\x00-\\xff]")  # matches nothing
@@ -170,28 +194,31 @@ def compile_http_rules(
             dfa = compile_union(patterns, max_states=max_states)
         except (RegexUnsupported, RegexTooComplex):
             raise
-        return dfa, absent
+        return dfa, _to_words(absent)
 
     method_dfa, absent_method = union_for("method")
     path_dfa, absent_path = union_for("path")
     host_dfa, absent_host = union_for("host")
 
-    ident_rules = np.zeros(n_identities, dtype=np.uint32)
+    ident_rules = np.zeros((n_identities, n_words), dtype=np.uint32)
     for i, rule in enumerate(device_rules):
         for idx in rule.identity_indices:
-            ident_rules[idx] |= np.uint32(1 << i)
+            ident_rules[idx, i // 32] |= np.uint32(1 << (i % 32))
 
     tables = HTTPTables(
         method_dfa=method_dfa,
         path_dfa=path_dfa,
         host_dfa=host_dfa,
-        absent_method=np.uint32(absent_method),
-        absent_path=np.uint32(absent_path),
-        absent_host=np.uint32(absent_host),
+        absent_method=absent_method,
+        absent_path=absent_path,
+        absent_host=absent_host,
         ident_rules=ident_rules,
         n_rules=len(device_rules),
+        n_words=n_words,
     )
-    return HTTPPolicy(tables=tables, host_rules=host_rules)
+    return HTTPPolicy(
+        tables=tables, host_rules=host_rules, device_rules=device_rules
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -201,7 +228,7 @@ def compile_http_rules(
 
 def _dfa_scan(dfa: DFA, data, lengths):
     """Step a [B, L] u8 byte tensor through the DFA; returns accept
-    bitmask u32 [B].  One [B]-gather per position via lax.scan — the
+    bitmask u32 [B, W].  One [B]-gather per position via lax.scan — the
     'dense take_along_axis stepping' of SURVEY §7 step 3."""
     import jax
     import jax.numpy as jnp
@@ -240,25 +267,25 @@ def evaluate_http_batch(
     ident_idx: "np.ndarray",  # i32 [B] identity index (from engine._index)
     known: "np.ndarray",  # bool [B]
 ):
-    """Returns (allowed bool [B], matched_rules u32 [B])."""
+    """Returns (allowed bool [B], matched_rules u32 [B, W])."""
     import jax.numpy as jnp
 
-    acc_m = _dfa_scan(tables.method_dfa, method, method_len)
+    acc_m = _dfa_scan(tables.method_dfa, method, method_len)  # [B, W]
     acc_p = _dfa_scan(tables.path_dfa, path, path_len)
     acc_h = _dfa_scan(tables.host_dfa, host, host_len)
 
     matched = (
-        (acc_m | jnp.uint32(tables.absent_method))
-        & (acc_p | jnp.uint32(tables.absent_path))
-        & (acc_h | jnp.uint32(tables.absent_host))
+        (acc_m | jnp.asarray(tables.absent_method)[None, :])
+        & (acc_p | jnp.asarray(tables.absent_path)[None, :])
+        & (acc_h | jnp.asarray(tables.absent_host)[None, :])
     )
     ident_bits = jnp.asarray(tables.ident_rules)[
         jnp.clip(ident_idx, 0, tables.ident_rules.shape[0] - 1)
-    ]
+    ]  # [B, W]
     matched = matched & ident_bits & jnp.where(
         known, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
-    )
-    return matched != 0, matched
+    )[:, None]
+    return jnp.any(matched != 0, axis=1), matched
 
 
 # ---------------------------------------------------------------------------
@@ -306,16 +333,85 @@ def pad_requests(
     lp: int = 128,
     lh: int = 64,
 ):
-    """(method, path, host) bytes → padded u8 tensors + lengths."""
+    """(method, path, host) bytes → padded u8 tensors + lengths +
+    overflow flags.
+
+    A field longer than its budget is NOT silently truncated into the
+    tensors-with-shorter-length (that would corrupt full-match
+    semantics in both directions); the row is flagged `overflow` and
+    must be routed to the host matcher (evaluate_with_host_fallback
+    does this).  The tensor row still carries the truncated prefix so
+    shapes stay static, but its device verdict is discarded."""
     b = len(requests)
     method = np.zeros((b, lm), dtype=np.uint8)
     path = np.zeros((b, lp), dtype=np.uint8)
     host = np.zeros((b, lh), dtype=np.uint8)
     lens = np.zeros((3, b), dtype=np.int32)
+    overflow = np.zeros(b, dtype=bool)
     for i, (m, p, h) in enumerate(requests):
+        overflow[i] = len(m) > lm or len(p) > lp or len(h) > lh
         m, p, h = m[:lm], p[:lp], h[:lh]
         method[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
         path[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
         host[i, : len(h)] = np.frombuffer(h, dtype=np.uint8)
         lens[0, i], lens[1, i], lens[2, i] = len(m), len(p), len(h)
-    return method, lens[0], path, lens[1], host, lens[2]
+    return method, lens[0], path, lens[1], host, lens[2], overflow
+
+
+def evaluate_with_host_fallback(
+    policy: HTTPPolicy,
+    requests: Sequence[Tuple[bytes, bytes, bytes]],
+    ident_idx: "np.ndarray",  # i32 [B] identity index
+    known: "np.ndarray",  # bool [B]
+    headers: Optional[Sequence[Optional[Dict[str, str]]]] = None,
+    lm: int = 16,
+    lp: int = 128,
+    lh: int = 64,
+) -> np.ndarray:
+    """Full HTTP policy verdict: device DFAs + host-side merge.
+
+    Reference semantics (pkg/envoy/server.go:316,448 +
+    envoy/cilium_l7policy.cc): a request is allowed if ANY rule of the
+    filter matches — including header-carrying rules, which the device
+    tables exclude.  Three host merges over the device verdict:
+
+      1. header rules (policy.host_rules): evaluated with re.fullmatch
+         + header present/exact checks, OR-ed into the device verdict;
+      2. overflow rows (fields beyond the padded budgets): the device
+         verdict for those rows is discarded and recomputed from
+         policy.device_rules host-side — never decided from truncated
+         bytes;
+      3. unknown identities stay denied.
+
+    Returns allowed bool [B].
+    """
+    packed = pad_requests(requests, lm=lm, lp=lp, lh=lh)
+    m, mlen, p, plen, h, hlen, overflow = packed
+    allowed_dev, _ = evaluate_http_batch(
+        policy.tables, m, mlen, p, plen, h, hlen, ident_idx, known
+    )
+    allowed = np.asarray(allowed_dev).copy()
+    ident_idx = np.asarray(ident_idx)
+    known = np.asarray(known)
+
+    # 2: overflowed rows re-evaluate the device rules host-side.
+    for i in np.nonzero(overflow)[0]:
+        mm, pp, hh = requests[i]
+        allowed[i] = bool(known[i]) and any(
+            int(ident_idx[i]) in spec.identity_indices
+            and http_rule_matches_host(spec, mm, pp, hh)
+            for spec in policy.device_rules
+        )
+
+    # 1: header rules can only widen (OR semantics across rules).
+    if policy.host_rules:
+        for i in np.nonzero(~allowed & known)[0]:
+            mm, pp, hh = requests[i]
+            hdrs = headers[i] if headers is not None else None
+            if any(
+                int(ident_idx[i]) in spec.identity_indices
+                and http_rule_matches_host(spec, mm, pp, hh, hdrs)
+                for spec in policy.host_rules
+            ):
+                allowed[i] = True
+    return allowed

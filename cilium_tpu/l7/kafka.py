@@ -25,7 +25,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-MAX_RULES = 32
+# Sanity ceiling only (ident_rules masks are multi-word; base matrix
+# is [B, R] regardless) — not a semantic limit.
+MAX_RULES = 4096
 MAX_TOPICS = 8  # topics per request tensor row (excess → host path)
 
 # api/kafka.go:110-133 — API keys whose REQUEST carries topics.
@@ -92,9 +94,12 @@ class KafkaTables:
     rule_version: np.ndarray  # i32 [R]; -1 = wildcard
     rule_client: np.ndarray  # u32 [R]; 0 = wildcard
     rule_topic: np.ndarray  # u32 [R]; 0 = wildcard
-    ident_rules: np.ndarray  # u32 [N] per-identity rule bits
+    ident_rules: np.ndarray  # u32 [N, W] per-identity rule bits
     n_rules: int
     interner: Interner = field(default_factory=Interner)
+    # Deduped specs retained for the host path (requests with more
+    # topics than the tensor rows hold re-run MatchesRule host-side).
+    specs: List[KafkaRuleSpec] = field(default_factory=list)
 
 
 def rule_spec_from_port_rule(rule, identity_indices) -> KafkaRuleSpec:
@@ -145,6 +150,7 @@ def compile_kafka_rules(
     if len(specs) > MAX_RULES:
         raise ValueError(f"more than {MAX_RULES} Kafka rules per filter")
     r = max(len(specs), 1)
+    n_words = max(1, -(-r // 32))
     interner = Interner()
     keys_lo = np.zeros(r, dtype=np.uint32)
     keys_hi = np.zeros(r, dtype=np.uint32)
@@ -152,7 +158,7 @@ def compile_kafka_rules(
     version = np.full(r, -1, dtype=np.int32)
     client = np.zeros(r, dtype=np.uint32)
     topic = np.zeros(r, dtype=np.uint32)
-    ident = np.zeros(n_identities, dtype=np.uint32)
+    ident = np.zeros((n_identities, n_words), dtype=np.uint32)
 
     for i, spec in enumerate(specs):
         if not spec.api_keys:
@@ -169,7 +175,7 @@ def compile_kafka_rules(
         client[i] = interner.intern(spec.client_id)
         topic[i] = interner.intern(spec.topic)
         for idx in spec.identity_indices:
-            ident[idx] |= np.uint32(1 << i)
+            ident[idx, i // 32] |= np.uint32(1 << (i % 32))
 
     return KafkaTables(
         rule_keys_lo=keys_lo,
@@ -181,6 +187,7 @@ def compile_kafka_rules(
         ident_rules=ident,
         n_rules=len(specs),
         interner=interner,
+        specs=list(specs),
     )
 
 
@@ -188,7 +195,12 @@ def pad_kafka_requests(
     tables: KafkaTables, requests: Sequence[KafkaRequest]
 ):
     """Requests → integer tensors (strings resolved via the tables'
-    interner; unseen strings become 0 ≠ any rule value)."""
+    interner; unseen strings become 0 ≠ any rule value).
+
+    A request with more unique topics than the tensor row holds is
+    FLAGGED `overflow` (last return) — its device verdict must be
+    discarded and the request re-run through matches_rules_host
+    (evaluate_with_host_fallback does this)."""
     b = len(requests)
     kind = np.zeros(b, dtype=np.int32)
     version = np.zeros(b, dtype=np.int32)
@@ -200,17 +212,16 @@ def pad_kafka_requests(
     topic_count = np.zeros(b, dtype=np.int32)
     parsed = np.zeros(b, dtype=bool)
     checks_client = np.zeros(b, dtype=bool)
+    overflow = np.zeros(b, dtype=bool)
     for i, request in enumerate(requests):
-        if len(request.topics) > MAX_TOPICS:
-            raise ValueError(
-                f"request with more than {MAX_TOPICS} topics needs the "
-                f"host path"
-            )
         kind[i] = request.kind
         version[i] = request.version
         client[i] = tables.interner.lookup(request.client_id)
         # MatchesRule dedupes topics via reqTopicsMap (policy.go:205)
         uniq = list(dict.fromkeys(request.topics))
+        if len(uniq) > MAX_TOPICS:
+            overflow[i] = True
+            uniq = uniq[:MAX_TOPICS]
         for j, t in enumerate(uniq):
             topics[i, j] = tables.interner.lookup(t)
         topic_count[i] = len(uniq)
@@ -218,7 +229,32 @@ def pad_kafka_requests(
         checks_client[i] = request.parsed and (
             request.kind in CLIENT_CHECKED_KINDS
         )
-    return kind, version, client, topics, topic_count, parsed, checks_client
+    return (
+        kind, version, client, topics, topic_count, parsed,
+        checks_client, overflow,
+    )
+
+
+def evaluate_with_host_fallback(
+    tables: KafkaTables,
+    requests: Sequence[KafkaRequest],
+    ident_idx,
+    known,
+) -> np.ndarray:
+    """Full Kafka verdict: device tensors + host re-run for requests
+    whose topic list exceeds the tensor rows.  Returns allowed bool [B]."""
+    packed = pad_kafka_requests(tables, requests)
+    overflow = packed[-1]
+    allowed = np.asarray(
+        evaluate_kafka_batch(tables, *packed[:-1], ident_idx, known)
+    ).copy()
+    ident_idx = np.asarray(ident_idx)
+    known = np.asarray(known)
+    for i in np.nonzero(overflow)[0]:
+        allowed[i] = bool(known[i]) and matches_rules_host(
+            requests[i], tables.specs, int(ident_idx[i])
+        )
+    return allowed
 
 
 def evaluate_kafka_batch(
@@ -278,10 +314,11 @@ def evaluate_kafka_batch(
 
     ident_bits = jnp.asarray(tables.ident_rules)[
         jnp.clip(jnp.asarray(ident_idx), 0, tables.ident_rules.shape[0] - 1)
-    ]
-    rule_bit = (
-        ident_bits[:, None] >> jnp.arange(base.shape[1], dtype=jnp.uint32)
-    ) & 1
+    ]  # [B, W]
+    r = base.shape[1]
+    word_of_rule = jnp.arange(r) // 32
+    bit_of_rule = (jnp.arange(r) % 32).astype(jnp.uint32)
+    rule_bit = (ident_bits[:, word_of_rule] >> bit_of_rule[None, :]) & 1
     base = base & rule_bit.astype(bool) & jnp.asarray(known)[:, None]
 
     # MatchesRule: topic-less rule (or topic-less request) matching →

@@ -1,0 +1,303 @@
+"""Kafka wire-format request parsing (+ response correlation).
+
+Behavioral analog of /root/reference/pkg/kafka/request.go:88
+(ReadRequest → topic extraction per API key) and
+correlation_cache.go:97 (correlation-ID cache pairing responses with
+their requests).  The reference parses frames with a vendored
+Sarama-style decoder; here a minimal big-endian struct reader covers
+the v0 request layouts of the topic-carrying keys the policy engine
+checks (Produce/Fetch/ListOffsets/Metadata/OffsetCommit/OffsetFetch).
+
+A frame that cannot be structurally parsed — unknown API key,
+unsupported version, short buffer — still yields a KafkaRequest when
+the generic header decodes: `parsed=False`, topics empty.  That is
+exactly the reference's degraded mode, where `matchNonTopicRequests`
+(policy.go:54) refuses topic rules for topic-typed keys and skips the
+ClientID check (GH-3097 quirk, reproduced in kafka.py).
+
+Wire layout (all big-endian):
+  frame   := size:i32 body
+  body    := api_key:i16 api_version:i16 correlation_id:i32
+             client_id:nullable_string payload
+  string  := len:i16 bytes           (len == -1 → null)
+  array   := count:i32 element*
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cilium_tpu.l7.kafka import KafkaRequest
+
+MAX_FRAME = 64 * 1024 * 1024  # sarama MaxRequestSize analog
+
+
+class KafkaParseError(ValueError):
+    pass
+
+
+class _Reader:
+    __slots__ = ("buf", "off", "end")
+
+    def __init__(self, buf: bytes, off: int, end: int) -> None:
+        self.buf = buf
+        self.off = off
+        self.end = end
+
+    def _take(self, n: int) -> bytes:
+        if self.off + n > self.end:
+            raise KafkaParseError("short buffer")
+        out = self.buf[self.off : self.off + n]
+        self.off += n
+        return out
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n == -1:
+            return None
+        if n < 0:
+            raise KafkaParseError("negative string length")
+        return self._take(n).decode("utf-8", "replace")
+
+    def kbytes(self) -> Optional[bytes]:
+        n = self.i32()
+        if n == -1:
+            return None
+        if n < 0:
+            raise KafkaParseError("negative bytes length")
+        return self._take(n)
+
+    def array_count(self) -> int:
+        n = self.i32()
+        if n < 0 or n > (self.end - self.off):
+            raise KafkaParseError("bad array count")
+        return n
+
+
+def _topics_produce(r: _Reader) -> List[str]:
+    r.i16()  # required_acks
+    r.i32()  # timeout
+    topics = []
+    for _ in range(r.array_count()):
+        topics.append(r.string() or "")
+        for _ in range(r.array_count()):  # partitions
+            r.i32()  # partition
+            r.kbytes()  # message set
+    return topics
+
+
+def _topics_fetch(r: _Reader) -> List[str]:
+    r.i32()  # replica_id
+    r.i32()  # max_wait_time
+    r.i32()  # min_bytes
+    topics = []
+    for _ in range(r.array_count()):
+        topics.append(r.string() or "")
+        for _ in range(r.array_count()):
+            r.i32()  # partition
+            r.i64()  # fetch_offset
+            r.i32()  # max_bytes
+    return topics
+
+
+def _topics_list_offsets(r: _Reader) -> List[str]:
+    r.i32()  # replica_id
+    topics = []
+    for _ in range(r.array_count()):
+        topics.append(r.string() or "")
+        for _ in range(r.array_count()):
+            r.i32()  # partition
+            r.i64()  # timestamp
+            r.i32()  # max_num_offsets
+    return topics
+
+
+def _topics_metadata(r: _Reader) -> List[str]:
+    return [r.string() or "" for _ in range(r.array_count())]
+
+
+def _topics_offset_commit(r: _Reader) -> List[str]:
+    r.string()  # group id
+    topics = []
+    for _ in range(r.array_count()):
+        topics.append(r.string() or "")
+        for _ in range(r.array_count()):
+            r.i32()  # partition
+            r.i64()  # offset
+            r.string()  # metadata
+    return topics
+
+
+def _topics_offset_fetch(r: _Reader) -> List[str]:
+    r.string()  # group id
+    topics = []
+    for _ in range(r.array_count()):
+        topics.append(r.string() or "")
+        for _ in range(r.array_count()):
+            r.i32()  # partition
+    return topics
+
+
+# api_key → (max structurally-supported version, payload parser)
+_PARSERS = {
+    0: (0, _topics_produce),
+    1: (0, _topics_fetch),
+    2: (0, _topics_list_offsets),
+    3: (0, _topics_metadata),
+    8: (0, _topics_offset_commit),
+    9: (0, _topics_offset_fetch),
+}
+
+
+def decode_request(buf: bytes, off: int = 0) -> Tuple[KafkaRequest, int, int]:
+    """One framed request starting at `buf[off]`.
+
+    Returns (request, correlation_id, next_offset).  Raises
+    KafkaParseError only when even the generic header is unreadable
+    (the connection-fatal case in the reference proxy); a readable
+    header with an unparseable payload degrades to parsed=False.
+    """
+    if off + 4 > len(buf):
+        raise KafkaParseError("short frame header")
+    size = struct.unpack(">i", buf[off : off + 4])[0]
+    if size < 8 or size > MAX_FRAME or off + 4 + size > len(buf):
+        raise KafkaParseError(f"bad frame size {size}")
+    end = off + 4 + size
+    r = _Reader(buf, off + 4, end)
+    api_key = r.i16()
+    api_version = r.i16()
+    correlation_id = r.i32()
+    client_id = r.string() or ""
+
+    parsed = False
+    topics: Sequence[str] = ()
+    entry = _PARSERS.get(api_key)
+    if entry is not None and api_version <= entry[0]:
+        try:
+            topics = tuple(entry[1](r))
+            parsed = True
+        except KafkaParseError:
+            parsed = False
+            topics = ()
+    return (
+        KafkaRequest(
+            kind=api_key,
+            version=api_version,
+            client_id=client_id,
+            topics=tuple(topics),
+            parsed=parsed,
+        ),
+        correlation_id,
+        end,
+    )
+
+
+def decode_stream(buf: bytes) -> List[Tuple[KafkaRequest, int]]:
+    """All complete frames in a connection buffer → [(request, correlation_id)].
+    Trailing partial frames are ignored (a real proxy would keep them
+    buffered until more bytes arrive)."""
+    out = []
+    off = 0
+    while off + 4 <= len(buf):
+        try:
+            req, cid, off = decode_request(buf, off)
+        except KafkaParseError:
+            break
+        out.append((req, cid))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# encoding (for tests / bench workload synthesis and deny responses)
+# ---------------------------------------------------------------------------
+
+
+def _enc_string(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    raw = s.encode()
+    return struct.pack(">h", len(raw)) + raw
+
+
+def encode_request(
+    request: KafkaRequest, correlation_id: int = 0
+) -> bytes:
+    """KafkaRequest → wire frame (v0 layouts, empty partition arrays —
+    partitions don't affect policy)."""
+    body = struct.pack(
+        ">hhi", request.kind, request.version, correlation_id
+    ) + _enc_string(request.client_id or None)
+    entry = _PARSERS.get(request.kind)
+    if entry is not None and request.version <= entry[0]:
+        if request.kind == 0:
+            body += struct.pack(">hi", 1, 1000)  # acks, timeout
+            body += struct.pack(">i", len(request.topics))
+            for t in request.topics:
+                body += _enc_string(t) + struct.pack(">i", 0)
+        elif request.kind == 1:
+            body += struct.pack(">iii", -1, 100, 1)
+            body += struct.pack(">i", len(request.topics))
+            for t in request.topics:
+                body += _enc_string(t) + struct.pack(">i", 0)
+        elif request.kind == 2:
+            body += struct.pack(">i", -1)
+            body += struct.pack(">i", len(request.topics))
+            for t in request.topics:
+                body += _enc_string(t) + struct.pack(">i", 0)
+        elif request.kind == 3:
+            body += struct.pack(">i", len(request.topics))
+            for t in request.topics:
+                body += _enc_string(t)
+        elif request.kind in (8, 9):
+            body += _enc_string("group")
+            body += struct.pack(">i", len(request.topics))
+            for t in request.topics:
+                body += _enc_string(t) + struct.pack(">i", 0)
+    return struct.pack(">i", len(body)) + body
+
+
+def encode_deny_response(request: KafkaRequest, correlation_id: int) -> bytes:
+    """Minimal error response for a denied request — the
+    'broker-in-the-middle' deny of pkg/proxy/kafka.go (the reference
+    synthesizes a per-kind error response; error code 29 =
+    TopicAuthorizationFailed)."""
+    body = struct.pack(">i", correlation_id)
+    if request.kind == 0:  # produce v0: [topic [partition err offset]]
+        body += struct.pack(">i", len(request.topics))
+        for t in request.topics:
+            body += _enc_string(t) + struct.pack(">i", 0)
+    else:
+        body += struct.pack(">h", 29)
+    return struct.pack(">i", len(body)) + body
+
+
+class CorrelationCache:
+    """correlation_cache.go:97 — outstanding request bookkeeping so
+    responses (which carry only the correlation id) can be matched
+    back to the request that the policy verdict was computed for."""
+
+    def __init__(self, max_outstanding: int = 4096) -> None:
+        self._pending: Dict[int, KafkaRequest] = {}
+        self._max = max_outstanding
+
+    def record(self, correlation_id: int, request: KafkaRequest) -> None:
+        if len(self._pending) >= self._max:
+            raise KafkaParseError("too many outstanding requests")
+        self._pending[correlation_id] = request
+
+    def match(self, correlation_id: int) -> Optional[KafkaRequest]:
+        return self._pending.pop(correlation_id, None)
+
+    def __len__(self) -> int:
+        return len(self._pending)

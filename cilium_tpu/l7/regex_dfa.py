@@ -448,7 +448,8 @@ class DFA:
     """Dense byte-class DFA.
 
     trans  u16 [n_states, n_classes]   state 0 = dead (all self-loops)
-    accept u32 [n_states]              per-pattern accept bitmask
+    accept u32 [n_states, n_words]     per-pattern accept bitmask,
+                                       pattern i → word i//32 bit i%32
     classes u8 [256]                   byte → class
     start  int
     """
@@ -462,21 +463,30 @@ class DFA:
     def n_states(self) -> int:
         return self.trans.shape[0]
 
+    @property
+    def n_words(self) -> int:
+        return self.accept.shape[1]
+
     def run(self, data: bytes) -> int:
-        """Host reference stepping; returns the accept bitmask."""
+        """Host reference stepping; returns the accept bitmask (an
+        arbitrary-width python int assembled from the accept words)."""
         s = self.start
         for b in data:
             s = int(self.trans[s, self.classes[b]])
-        return int(self.accept[s])
+        out = 0
+        for w in range(self.accept.shape[1]):
+            out |= int(self.accept[s, w]) << (32 * w)
+        return out
 
 
 def compile_union(
     patterns: Sequence[str], max_states: int = DEFAULT_MAX_STATES
 ) -> DFA:
-    """One DFA accepting the union of ≤32 full-match patterns, accept
-    states labeled with the bitmask of patterns matched."""
-    if len(patterns) > 32:
-        raise RegexTooComplex("more than 32 patterns per union DFA")
+    """One DFA accepting the union of full-match patterns, accept
+    states labeled with the bitmask of patterns matched (multi-word:
+    pattern i sets bit i%32 of accept word i//32 — there is no
+    32-pattern cap; wide unions cost accept-table width, not states)."""
+    n_words = max(1, -(-len(patterns) // 32))
 
     nfa = _NFA()
     start = nfa.new_state()
@@ -552,7 +562,10 @@ def compile_union(
         rows.append(row)
 
     trans = np.array(rows, dtype=np.uint16)
-    accept = np.array(accepts, dtype=np.uint32)
+    accept = np.zeros((len(accepts), n_words), dtype=np.uint32)
+    for s, acc in enumerate(accepts):
+        for w in range(n_words):
+            accept[s, w] = (acc >> (32 * w)) & 0xFFFFFFFF
 
     return _minimize(
         DFA(trans=trans, accept=accept, classes=classes, start=1)
@@ -568,7 +581,7 @@ def _minimize(dfa: DFA) -> DFA:
     part = {}
     block = np.zeros(n, dtype=np.int64)
     for s in range(n):
-        key = int(dfa.accept[s])
+        key = tuple(int(w) for w in dfa.accept[s])
         if key not in part:
             part[key] = len(part)
         block[s] = part[key]
@@ -596,7 +609,7 @@ def _minimize(dfa: DFA) -> DFA:
             remap[b] = len(remap)
     m = len(remap)
     trans = np.zeros((m, c), dtype=np.uint16)
-    accept = np.zeros(m, dtype=np.uint32)
+    accept = np.zeros((m, dfa.accept.shape[1]), dtype=np.uint32)
     for s in range(n):
         b = remap[int(block[s])]
         trans[b] = [remap[int(block[t])] for t in dfa.trans[s]]

@@ -173,7 +173,7 @@ def test_daemon_l7_redirect_two_phase():
     # the redirect's HTTP policy allows the right requests
     from cilium_tpu.l7.http import evaluate_http_batch, pad_requests
 
-    m, ml, p, pl, h, hl = pad_requests(
+    m, ml, p, pl, h, hl, _ = pad_requests(
         [(b"GET", b"/v1/x", b""), (b"POST", b"/v1/x", b"")]
     )
     # identity index: resolve via daemon's published universe

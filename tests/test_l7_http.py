@@ -162,7 +162,7 @@ def test_http_device_matcher_end_to_end():
         (b"POST", b"/api/v12", b""),            # no rule (full match!)
         (b"DELETE", b"/x", b""),                # only allow-all
     ]
-    m, ml, p, pl, h, hl = pad_requests(requests)
+    m, ml, p, pl, h, hl, _ = pad_requests(requests)
 
     cases = [
         # (ident_idx, expected allowed per request)
@@ -207,7 +207,7 @@ def test_http_host_rule_split_and_headers():
 def test_http_unknown_identity_denied():
     rules = [HTTPRuleSpec(identity_indices=[0], method="GET")]
     policy = compile_http_rules(rules, n_identities=4)
-    m, ml, p, pl, h, hl = pad_requests([(b"GET", b"/", b"")])
+    m, ml, p, pl, h, hl, _ = pad_requests([(b"GET", b"/", b"")])
     allowed, _ = evaluate_http_batch(
         policy.tables, m, ml, p, pl, h, hl,
         ident_idx=np.zeros(1, dtype=np.int32),
@@ -259,7 +259,7 @@ def test_specs_from_l4_filter():
     specs = specs_from_filter(f, cache, id_index)
     policy = compile_http_rules(specs, n_identities=4)
 
-    m, ml, p, pl, h, hl = pad_requests(
+    m, ml, p, pl, h, hl, _ = pad_requests(
         [(b"GET", b"/public/a", b""), (b"PUT", b"/public/a", b"")]
     )
     allowed, _ = evaluate_http_batch(
@@ -300,7 +300,7 @@ def test_http_device_vs_host_oracle_fuzz(seed):
             b"",
         ))
         idents.append(int(rng.integers(0, 4)))
-    m, ml, p, pl, h, hl = pad_requests(reqs)
+    m, ml, p, pl, h, hl, _ = pad_requests(reqs)
     allowed, _ = evaluate_http_batch(
         policy.tables, m, ml, p, pl, h, hl,
         ident_idx=np.array(idents, dtype=np.int32),
