@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+import numpy as np
+
 from cilium_tpu import option
 from cilium_tpu.identity import (
     RESERVED_HOST,
@@ -26,6 +28,7 @@ from cilium_tpu.labels import LabelArray
 from cilium_tpu.maps.policymap import (
     EGRESS,
     INGRESS,
+    MapStateArrays,
     PolicyKey,
     PolicyMapState,
     PolicyMapStateEntry,
@@ -165,21 +168,33 @@ def compute_desired_policy_map_state(
     set algebra — same results, O(selectors) instead of
     O(identities × selectors).
     """
-    desired: PolicyMapState = {}
     if l4_policy is None:
         l4_policy = resolve_l4_policy(
             repo, ep_labels, ingress_enabled, egress_enabled, rules
         )
     redirects = realized_redirects or {}
-    if selector_cache is not None and len(
-        selector_cache.identities()
-    ) != len(identity_cache):
-        # cheap guard only — full sync is the caller's contract
-        raise ValueError(
-            "selector_cache universe is out of sync with identity_cache; "
-            "call selector_cache.sync(identity_cache) first"
+    if selector_cache is not None:
+        if len(selector_cache.identities()) != len(identity_cache):
+            # cheap guard only — full sync is the caller's contract
+            raise ValueError(
+                "selector_cache universe is out of sync with "
+                "identity_cache; call selector_cache.sync(identity_cache) "
+                "first"
+            )
+        return _compute_desired_arrays(
+            repo,
+            identity_cache,
+            ep_labels,
+            endpoint_id,
+            ingress_enabled,
+            egress_enabled,
+            redirects,
+            l4_policy,
+            selector_cache,
+            rules,
         )
 
+    desired: PolicyMapState = {}
     # --- computeDesiredL4PolicyMapEntries (policy.go:143) -------------------
     for direction, l4map in (
         (INGRESS, l4_policy.ingress),
@@ -192,24 +207,12 @@ def compute_desired_policy_map_state(
                 proxy_port = redirects.get(pid, 0)
                 if proxy_port == 0:
                     continue
-            if selector_cache is not None:
-                for sel in f.endpoints:
-                    for num_id in selector_cache.matches(sel):
-                        desired[
-                            PolicyKey(
-                                identity=num_id,
-                                dest_port=f.port,
-                                nexthdr=f.u8proto,
-                                traffic_direction=direction,
-                            )
-                        ] = PolicyMapStateEntry(proxy_port=proxy_port)
-            else:
-                for key in _convert_l4_filter_to_keys(
-                    identity_cache, f, direction
-                ):
-                    desired[key] = PolicyMapStateEntry(
-                        proxy_port=proxy_port
-                    )
+            for key in _convert_l4_filter_to_keys(
+                identity_cache, f, direction
+            ):
+                desired[key] = PolicyMapStateEntry(
+                    proxy_port=proxy_port
+                )
 
     # --- determineAllowLocalhost (policy.go:285) ----------------------------
     if option.Config.always_allow_localhost() or l4_policy.has_redirect():
@@ -220,31 +223,6 @@ def compute_desired_policy_map_state(
         desired[WORLD_KEY] = PolicyMapStateEntry()
 
     # --- computeDesiredL3PolicyMapEntries (policy.go:318) -------------------
-    if selector_cache is not None:
-        ing_allowed = (
-            _l3_allowed_identities(
-                repo, selector_cache, ep_labels, True, rules
-            )
-            if ingress_enabled
-            else frozenset(identity_cache)
-        )
-        eg_allowed = (
-            _l3_allowed_identities(
-                repo, selector_cache, ep_labels, False, rules
-            )
-            if egress_enabled
-            else frozenset(identity_cache)
-        )
-        for num_id in ing_allowed:
-            desired[
-                PolicyKey(identity=num_id, traffic_direction=INGRESS)
-            ] = PolicyMapStateEntry()
-        for num_id in eg_allowed:
-            desired[
-                PolicyKey(identity=num_id, traffic_direction=EGRESS)
-            ] = PolicyMapStateEntry()
-        return desired
-
     for num_id, labels in identity_cache.items():
         if ingress_enabled:
             ctx = SearchContext(from_labels=labels, to_labels=ep_labels)
@@ -267,3 +245,105 @@ def compute_desired_policy_map_state(
             ] = PolicyMapStateEntry()
 
     return desired
+
+
+def _ids_to_keys(
+    ids, dest_port: int, nexthdr: int, direction: int
+) -> np.ndarray:
+    """identity set → packed u64 PolicyKeys (one np op per filter
+    instead of one PolicyKey object per identity)."""
+    from cilium_tpu.maps.policymap import pack_keys
+
+    return pack_keys(
+        np.fromiter(ids, np.uint64, count=len(ids)),
+        dest_port,
+        nexthdr,
+        direction,
+    )
+
+
+def _compute_desired_arrays(
+    repo,
+    identity_cache,
+    ep_labels,
+    endpoint_id,
+    ingress_enabled,
+    egress_enabled,
+    redirects,
+    l4_policy,
+    selector_cache,
+    rules,
+) -> MapStateArrays:
+    """The vectorized computeDesiredPolicyMapState (policy.go:273):
+    selector match sets come from the SelectorCache postings and the
+    per-(identity, filter) key expansion is array math — O(selectors +
+    output entries) with no per-entry Python objects.  Entry order
+    (and therefore duplicate-key overwrite) mirrors the dict path:
+    L4, localhost, world, then L3; MapStateArrays.build keeps the
+    last occurrence."""
+    key_chunks = []
+    proxy_chunks = []
+
+    # --- computeDesiredL4PolicyMapEntries (policy.go:143) -------------------
+    for direction, l4map in (
+        (INGRESS, l4_policy.ingress),
+        (EGRESS, l4_policy.egress),
+    ):
+        for f in l4map.values():
+            proxy_port = 0
+            if f.is_redirect():
+                pid = proxy_id(endpoint_id, f.ingress, f.protocol, f.port)
+                proxy_port = redirects.get(pid, 0)
+                if proxy_port == 0:
+                    continue
+            ids: set = set()
+            for sel in f.endpoints:
+                ids |= selector_cache.matches(sel)
+            if not ids:
+                continue
+            keys = _ids_to_keys(ids, f.port, f.u8proto, direction)
+            key_chunks.append(keys)
+            proxy_chunks.append(
+                np.full(len(keys), proxy_port, np.uint32)
+            )
+
+    # --- determineAllowLocalhost / AllowFromWorld (policy.go:285,306) -------
+    allow_localhost = (
+        option.Config.always_allow_localhost() or l4_policy.has_redirect()
+    )
+    if allow_localhost:
+        key_chunks.append(
+            _ids_to_keys([RESERVED_HOST], 0, 0, INGRESS)
+        )
+        proxy_chunks.append(np.zeros(1, np.uint32))
+        if option.Config.host_allows_world:
+            key_chunks.append(
+                _ids_to_keys([RESERVED_WORLD], 0, 0, INGRESS)
+            )
+            proxy_chunks.append(np.zeros(1, np.uint32))
+
+    # --- computeDesiredL3PolicyMapEntries (policy.go:318) -------------------
+    ing_allowed = (
+        _l3_allowed_identities(repo, selector_cache, ep_labels, True, rules)
+        if ingress_enabled
+        else frozenset(identity_cache)
+    )
+    eg_allowed = (
+        _l3_allowed_identities(repo, selector_cache, ep_labels, False, rules)
+        if egress_enabled
+        else frozenset(identity_cache)
+    )
+    if ing_allowed:
+        key_chunks.append(_ids_to_keys(ing_allowed, 0, 0, INGRESS))
+        proxy_chunks.append(np.zeros(len(ing_allowed), np.uint32))
+    if eg_allowed:
+        key_chunks.append(_ids_to_keys(eg_allowed, 0, 0, EGRESS))
+        proxy_chunks.append(np.zeros(len(eg_allowed), np.uint32))
+
+    if not key_chunks:
+        return MapStateArrays(
+            np.zeros(0, np.uint64), np.zeros(0, np.uint32)
+        )
+    return MapStateArrays.build(
+        np.concatenate(key_chunks), np.concatenate(proxy_chunks)
+    )

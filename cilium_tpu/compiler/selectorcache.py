@@ -169,36 +169,55 @@ class SelectorCache:
         for k in selector.match_labels:
             if k == lbl.SOURCE_RESERVED_KEY_PREFIX + lbl.ID_NAME_ALL:
                 return frozenset(self._all)
-        candidates: Set[int] = set(self._all)
+        # Gather positive constraint sets first and seed from the
+        # smallest, so resolving a narrow selector (the common case:
+        # one match_labels pair selecting a handful of ids) never
+        # copies the whole universe — intersection and subtraction
+        # commute, so positives-first is order-equivalent to the
+        # requirement walk.
+        positive: List[Set[int]] = []
+        negative: List[Set[int]] = []
+        fallback_reqs = []
         for ext_key, value in selector.match_labels.items():
             _, form = _split_key_form(ext_key)
-            candidates &= self._val_index.get((form, value), set())
-            if not candidates:
-                return frozenset()
+            positive.append(self._val_index.get((form, value), set()))
         for req in selector.match_expressions:
             _, form = _split_key_form(req.key)
             if req.operator == OP_IN:
                 hit: Set[int] = set()
                 for v in req.values:
                     hit |= self._val_index.get((form, v), set())
-                candidates &= hit
+                positive.append(hit)
             elif req.operator == OP_NOT_IN:
                 miss: Set[int] = set()
                 for v in req.values:
                     miss |= self._val_index.get((form, v), set())
-                candidates -= miss
+                negative.append(miss)
             elif req.operator == OP_EXISTS:
-                candidates &= self._exists_index.get(form, set())
+                positive.append(self._exists_index.get(form, set()))
             elif req.operator == OP_DOES_NOT_EXIST:
-                candidates -= self._exists_index.get(form, set())
+                negative.append(self._exists_index.get(form, set()))
             else:  # pragma: no cover - sanitize rejects unknown ops
-                candidates = {
-                    i
-                    for i in candidates
-                    if req.matches(self._universe[i])
-                }
+                fallback_reqs.append(req)
+        if positive:
+            seed = min(positive, key=len)
+            candidates = set(seed)
+            for s in positive:
+                if s is seed:
+                    continue
+                candidates &= s
+                if not candidates:
+                    return frozenset()
+        else:
+            candidates = set(self._all)
+        for s in negative:
+            candidates -= s
             if not candidates:
                 return frozenset()
+        for req in fallback_reqs:  # pragma: no cover
+            candidates = {
+                i for i in candidates if req.matches(self._universe[i])
+            }
         return frozenset(candidates)
 
     def matches(self, selector: EndpointSelector) -> FrozenSet[int]:

@@ -38,8 +38,10 @@ from cilium_tpu.identity import IdentityAllocator
 from cilium_tpu.maps.policymap import (
     EGRESS,
     INGRESS,
+    MapStateArrays,
     PolicyKey,
     PolicyMapState,
+    unpack_keys,
 )
 
 # Sentinel for padded slots of the sorted identity table: sorts above
@@ -364,6 +366,8 @@ class FleetCompiler:
         self._id_index: Dict[int, int] = {}
         self._slot_of: Dict[Tuple[int, int], int] = {}
         self._slot_list: List[Tuple[int, int]] = []  # arrival order
+        # (len, sorted_pairs, order) cache for _slot_pair_lut
+        self._slot_lut_cache = None
         # double-buffered port_slot: each buffer tracks how many slots
         # it has applied; updates write only the new cells
         self._port_slot_bufs = [
@@ -459,6 +463,19 @@ class FleetCompiler:
         """Append slots for unseen (dport, proto) keys.  Returns True
         if the slot space grew."""
         grew = False
+        if isinstance(state, MapStateArrays):
+            _, dport, proto, _ = unpack_keys(state.keys_packed)
+            nonl3 = (dport != 0) | (proto != 0)
+            pairs = np.unique(
+                (dport[nonl3].astype(np.int64) << 8) | proto[nonl3]
+            )
+            for p in pairs.tolist():
+                key = (p >> 8, p & 0xFF)
+                if key not in self._slot_of:
+                    self._slot_of[key] = len(self._slot_list)
+                    self._slot_list.append(key)
+                    grew = True
+            return grew
         for k in state:
             if k.is_l3_only():
                 continue
@@ -489,9 +506,117 @@ class FleetCompiler:
             max(len(self._slot_of), 1), self.filter_pad
         )
 
+    def _slot_pair_lut(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(sorted packed (dport<<8|proto) pairs, slot index by sorted
+        position) for the vectorized slot lookup — rebuilt only when
+        the append-only slot list grows."""
+        cached = self._slot_lut_cache
+        if cached is not None and cached[0] == len(self._slot_list):
+            return cached[1], cached[2]
+        pairs = np.asarray(
+            [(dp << 8) | pr for dp, pr in self._slot_list], np.int64
+        )
+        order = np.argsort(pairs, kind="stable")
+        self._slot_lut_cache = (len(self._slot_list), pairs[order], order)
+        return self._slot_lut_cache[1], self._slot_lut_cache[2]
+
     # -- per-endpoint rows ---------------------------------------------------
 
+    def _lower_rows_arrays(self, state: MapStateArrays) -> dict:
+        """Vectorized row lowering: the per-entry bit-set loop of
+        _lower_rows as array scatters (np.bitwise_or.at) — no Python
+        per-entry work.  Bit-identical rows to the dict path."""
+        n = self._padded_n()
+        w = n // 32
+        kg = self._padded_kg()
+        meta = np.zeros((2, kg), dtype=np.uint32)
+        l4 = np.zeros((2, kg, w), dtype=np.uint32)
+        l3 = np.zeros((2, w), dtype=np.uint32)
+        m = len(state.keys_packed)
+        if m == 0:
+            return {"kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3}
+
+        ident, dport, proto, d = unpack_keys(state.keys_packed)
+        l3_mask = (dport == 0) & (proto == 0)
+        wild_mask = (ident == 0) & ~l3_mask
+
+        # identity → dense index through the arrival-ordered direct
+        # table (same derivation as the device _index kernel)
+        ident64 = ident.astype(np.int64)
+        is_local = ident64 >= LOCAL_ID_BASE
+        pos = np.where(
+            is_local,
+            self._id_lo_len + ident64 - LOCAL_ID_BASE,
+            ident64,
+        )
+        idx = np.full(m, NO_INDEX, dtype=np.uint32)
+        in_range = (pos >= 0) & (pos < len(self._id_direct))
+        idx[in_range] = self._id_direct[pos[in_range]]
+        need_idx = ~wild_mask
+        bad = need_idx & (idx == NO_INDEX)
+        if bad.any():
+            missing = int(ident[np.argmax(bad)])
+            raise ValueError(
+                f"identity {missing} in map state but not in the "
+                f"identity universe (universe/table skew)"
+            )
+        word = (idx >> 5).astype(np.int64)
+        bit = (np.uint32(1) << (idx & np.uint32(31))).astype(np.uint32)
+
+        # -- L3-only rows -------------------------------------------------
+        sel3 = l3_mask
+        np.bitwise_or.at(
+            l3.reshape(-1),
+            d[sel3].astype(np.int64) * w + word[sel3],
+            bit[sel3],
+        )
+
+        # -- L4 slots -----------------------------------------------------
+        sel4 = ~l3_mask
+        if sel4.any():
+            sorted_pairs, order = self._slot_pair_lut()
+            pair = (dport[sel4].astype(np.int64) << 8) | proto[sel4]
+            j = order[np.searchsorted(sorted_pairs, pair)].astype(
+                np.int64
+            )
+            dj = d[sel4].astype(np.int64) * kg + j
+            proxy4 = state.proxy[sel4].astype(np.int64)
+            # proxy-consistency check (one L4Filter per slot): any slot
+            # carrying two distinct proxy values is a lowering error
+            uniq_pairs = np.unique(
+                np.stack([dj, proxy4], axis=1), axis=0
+            )
+            if len(np.unique(uniq_pairs[:, 0])) != len(uniq_pairs):
+                dup = uniq_pairs[:, 0][
+                    np.nonzero(np.diff(uniq_pairs[:, 0]) == 0)[0][0]
+                ]
+                jj = int(dup) % kg
+                dd = int(dup) // kg
+                dpp, prr = self._slot_list[jj]
+                raise ValueError(
+                    f"conflicting proxy ports for slot "
+                    f"{(dpp, prr, dd)}"
+                )
+            np.bitwise_or.at(
+                meta.reshape(-1),
+                dj,
+                (proxy4.astype(np.uint32) << np.uint32(1))
+                | wild_mask[sel4].astype(np.uint32),
+            )
+            setbits = sel4 & ~wild_mask
+            if setbits.any():
+                dj_all = np.full(m, -1, np.int64)
+                dj_all[sel4] = dj
+                np.bitwise_or.at(
+                    l4.reshape(-1),
+                    (dj_all[setbits]) * w + word[setbits],
+                    bit[setbits],
+                )
+        return {"kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3}
+
     def _lower_rows(self, state: PolicyMapState) -> dict:
+        if isinstance(state, MapStateArrays):
+            return self._lower_rows_arrays(state)
         n = self._padded_n()
         w = n // 32
         kg = self._padded_kg()

@@ -266,12 +266,14 @@ class Daemon:
             if l4 is None or not l4.has_redirect():
                 if endpoint.realized_redirects:
                     self.proxy.update_endpoint_redirects(
-                        endpoint, cache, id_index, n_identities
+                        endpoint, cache, id_index, n_identities,
+                        self.selector_cache,
                     )
                 continue
             before = dict(endpoint.realized_redirects)
             realized = self.proxy.update_endpoint_redirects(
-                endpoint, cache, id_index, n_identities
+                endpoint, cache, id_index, n_identities,
+                self.selector_cache,
             )
             if realized != before:
                 endpoint.force_policy_compute = True

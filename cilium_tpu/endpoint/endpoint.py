@@ -29,9 +29,11 @@ from cilium_tpu.compiler.mapstate import (
 from cilium_tpu.identity import Identity, IdentityCache
 from cilium_tpu.labels import LabelArray
 from cilium_tpu.maps.policymap import (
+    MapStateArrays,
     PolicyMapState,
     PolicyMapStateEntry,
     diff_map_state,
+    sync_map_arrays,
 )
 from cilium_tpu.policy.l3 import CIDRPolicy
 from cilium_tpu.policy.l4 import L4Policy
@@ -310,6 +312,26 @@ class Endpoint:
         """Apply desired→realized delta; preserves counters of entries
         that stay.  Returns (n_added_or_updated, n_deleted)."""
         with self.lock:
+            if isinstance(self.desired_map_state, MapStateArrays) or (
+                isinstance(self.realized_map_state, MapStateArrays)
+            ):
+                # vectorized sync: counters carry over for persisting
+                # keys into a FRESH instance — counter writers must
+                # re-read realized_map_state under self.lock (see
+                # replay.sync_counters_to_endpoints) or their
+                # increments land in the superseded snapshot
+                realized = MapStateArrays.from_dict(
+                    self.realized_map_state
+                )
+                desired = MapStateArrays.from_dict(self.desired_map_state)
+                new_realized, n_add, n_del = sync_map_arrays(
+                    realized, desired
+                )
+                if n_add == 0 and n_del == 0:
+                    return 0, 0
+                self.realized_map_state = new_realized
+                self.map_state_revision += 1
+                return n_add, n_del
             to_add, to_delete = diff_map_state(
                 self.realized_map_state, self.desired_map_state
             )

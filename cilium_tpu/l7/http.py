@@ -93,7 +93,29 @@ class HTTPPolicy:
     device_rules: List[HTTPRuleSpec] = field(default_factory=list)
 
 
-def specs_from_filter(l4_filter, identity_cache, id_index) -> List["HTTPRuleSpec"]:
+def resolve_selector_indices(
+    selector, identity_cache, id_index, selector_cache=None
+) -> List[int]:
+    """selector → dense identity indices.  With a SelectorCache the
+    resolution is one memoized set lookup (O(matched)); without, it
+    falls back to the per-identity matches() walk — identical result,
+    O(identities) (compiler/selectorcache.py docstring derivation)."""
+    if selector_cache is not None:
+        return [
+            id_index[num_id]
+            for num_id in selector_cache.matches(selector)
+            if num_id in id_index
+        ]
+    return [
+        id_index[num_id]
+        for num_id, labels in identity_cache.items()
+        if selector.matches(labels) and num_id in id_index
+    ]
+
+
+def specs_from_filter(
+    l4_filter, identity_cache, id_index, selector_cache=None
+) -> List["HTTPRuleSpec"]:
     """L4Filter.l7_rules_per_ep (selector → L7Rules, pkg/policy/l4.go:31)
     → flat HTTPRuleSpec list over the identity universe.
 
@@ -104,11 +126,9 @@ def specs_from_filter(l4_filter, identity_cache, id_index) -> List["HTTPRuleSpec
     """
     specs: List[HTTPRuleSpec] = []
     for selector, l7 in l4_filter.l7_rules_per_ep.items():
-        indices = [
-            id_index[num_id]
-            for num_id, labels in identity_cache.items()
-            if selector.matches(labels) and num_id in id_index
-        ]
+        indices = resolve_selector_indices(
+            selector, identity_cache, id_index, selector_cache
+        )
         http_rules = l7.http or []
         if not http_rules:
             specs.append(HTTPRuleSpec(identity_indices=indices))

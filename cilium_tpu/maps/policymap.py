@@ -14,6 +14,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Tuple
 
+import numpy as np
+
 # Traffic direction (pkg/maps/policymap/trafficdirection: Ingress=0,
 # Egress=1; bpf side inverts into the `egress` bit, policy.h:57).
 INGRESS = 0
@@ -84,3 +86,278 @@ def diff_map_state(
     ]
     to_delete = [k for k in realized if k not in desired]
     return to_add, to_delete
+
+
+# ---------------------------------------------------------------------------
+# Array-backed map state (the vectorized control-plane representation)
+# ---------------------------------------------------------------------------
+#
+# At the 50k-rule / 65k-identity envelope a PolicyMapState holds tens
+# of thousands of entries per endpoint; building, diffing and lowering
+# them as Python dicts of PolicyKey dataclasses is the control-plane
+# hot loop (the analog of computeDesiredPolicyMapState's O(N·R) walk,
+# pkg/endpoint/policy.go:273 — which the reference runs in compiled
+# Go).  MapStateArrays stores the same state as sorted packed-u64 key
+# arrays + parallel value arrays, so build/diff/sync/lower become
+# NumPy array ops, while READ access stays dict-compatible (get /
+# [] / in / items / len / ==) for the oracle, checkpoint, replay
+# counter-writeback and tests.
+
+_KEY_DTYPE = np.uint64
+
+
+def pack_keys(
+    identity: np.ndarray,
+    dest_port: np.ndarray,
+    nexthdr: np.ndarray,
+    direction: np.ndarray,
+) -> np.ndarray:
+    """PolicyKey → u64: identity<<32 | dport<<16 | proto<<8 | dir."""
+    return (
+        (np.asarray(identity, np.uint64) << np.uint64(32))
+        | (np.asarray(dest_port, np.uint64) << np.uint64(16))
+        | (np.asarray(nexthdr, np.uint64) << np.uint64(8))
+        | np.asarray(direction, np.uint64)
+    )
+
+
+def _pack_one(key: PolicyKey) -> np.uint64:
+    return np.uint64(
+        (key.identity << 32)
+        | (key.dest_port << 16)
+        | (key.nexthdr << 8)
+        | key.traffic_direction
+    )
+
+
+def unpack_keys(packed: np.ndarray):
+    """u64 array → (identity, dest_port, nexthdr, direction) arrays."""
+    packed = np.asarray(packed, np.uint64)
+    identity = (packed >> np.uint64(32)).astype(np.uint32)
+    dport = ((packed >> np.uint64(16)) & np.uint64(0xFFFF)).astype(np.int32)
+    proto = ((packed >> np.uint64(8)) & np.uint64(0xFF)).astype(np.int32)
+    direction = (packed & np.uint64(0xFF)).astype(np.int32)
+    return identity, dport, proto, direction
+
+
+class _EntryView:
+    """A PolicyMapStateEntry view into the arrays: counter writes
+    (replay's packets += fold-back) land in the backing store."""
+
+    __slots__ = ("_state", "_pos")
+
+    def __init__(self, state: "MapStateArrays", pos: int) -> None:
+        self._state = state
+        self._pos = pos
+
+    @property
+    def proxy_port(self) -> int:
+        return int(self._state.proxy[self._pos])
+
+    @property
+    def packets(self) -> int:
+        return int(self._state.packets[self._pos])
+
+    @packets.setter
+    def packets(self, v: int) -> None:
+        self._state.packets[self._pos] = v
+
+    @property
+    def bytes(self) -> int:
+        return int(self._state.bytes[self._pos])
+
+    @bytes.setter
+    def bytes(self, v: int) -> None:
+        self._state.bytes[self._pos] = v
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, (PolicyMapStateEntry, _EntryView)):
+            return (
+                self.proxy_port == other.proxy_port
+                and self.packets == other.packets
+                and self.bytes == other.bytes
+            )
+        return NotImplemented
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"_EntryView(proxy_port={self.proxy_port}, "
+            f"packets={self.packets}, bytes={self.bytes})"
+        )
+
+
+class MapStateArrays:
+    """Sorted packed-key array map state (see module note above).
+
+    Invariants: `keys_packed` is strictly increasing u64; `proxy`,
+    `packets`, `bytes` are parallel.  Mutation model: counters mutate
+    in place (through _EntryView); the KEY SET is immutable — sync
+    builds a fresh instance (copy-on-write, same contract as the dict
+    path so concurrent fleet-compile readers keep a stable snapshot).
+    """
+
+    __slots__ = ("keys_packed", "proxy", "packets", "bytes")
+
+    def __init__(
+        self,
+        keys_packed: np.ndarray,
+        proxy: np.ndarray,
+        packets: np.ndarray = None,
+        bytes_: np.ndarray = None,
+    ) -> None:
+        m = len(keys_packed)
+        self.keys_packed = np.asarray(keys_packed, _KEY_DTYPE)
+        self.proxy = np.asarray(proxy, np.uint32)
+        self.packets = (
+            np.zeros(m, np.int64) if packets is None else packets
+        )
+        self.bytes = np.zeros(m, np.int64) if bytes_ is None else bytes_
+
+    # -- construction ---------------------------------------------------------
+
+    @staticmethod
+    def build(keys_packed: np.ndarray, proxy: np.ndarray) -> "MapStateArrays":
+        """Sort + dedupe unsorted key/proxy arrays.  Duplicate keys
+        take the LAST occurrence's value — the same overwrite
+        semantics as sequential dict insertion in the dict path."""
+        keys_packed = np.asarray(keys_packed, _KEY_DTYPE)
+        proxy = np.asarray(proxy, np.uint32)
+        uniq, first_rev = np.unique(keys_packed[::-1], return_index=True)
+        last = len(keys_packed) - 1 - first_rev
+        return MapStateArrays(uniq, proxy[last])
+
+    @staticmethod
+    def from_dict(state: PolicyMapState) -> "MapStateArrays":
+        if isinstance(state, MapStateArrays):
+            return state
+        items = sorted(
+            (int(_pack_one(k)), v) for k, v in state.items()
+        )
+        keys = np.asarray([k for k, _ in items], _KEY_DTYPE)
+        proxy = np.asarray(
+            [v.proxy_port for _, v in items], np.uint32
+        )
+        packets = np.asarray([v.packets for _, v in items], np.int64)
+        bytes_ = np.asarray([v.bytes for _, v in items], np.int64)
+        return MapStateArrays(keys, proxy, packets, bytes_)
+
+    def to_dict(self) -> PolicyMapState:
+        return {
+            key: PolicyMapStateEntry(
+                proxy_port=int(self.proxy[i]),
+                packets=int(self.packets[i]),
+                bytes=int(self.bytes[i]),
+            )
+            for i, key in enumerate(self._iter_keys())
+        }
+
+    # -- dict-compatible read access ------------------------------------------
+
+    def _find(self, key: PolicyKey) -> int:
+        packed = _pack_one(key)
+        pos = int(np.searchsorted(self.keys_packed, packed))
+        if (
+            pos < len(self.keys_packed)
+            and self.keys_packed[pos] == packed
+        ):
+            return pos
+        return -1
+
+    def get(self, key: PolicyKey, default=None):
+        pos = self._find(key)
+        return _EntryView(self, pos) if pos >= 0 else default
+
+    def __getitem__(self, key: PolicyKey) -> _EntryView:
+        pos = self._find(key)
+        if pos < 0:
+            raise KeyError(key)
+        return _EntryView(self, pos)
+
+    def __contains__(self, key: PolicyKey) -> bool:
+        return self._find(key) >= 0
+
+    def __len__(self) -> int:
+        return len(self.keys_packed)
+
+    def _iter_keys(self) -> Iterable[PolicyKey]:
+        ident, dport, proto, direction = unpack_keys(self.keys_packed)
+        for i in range(len(self.keys_packed)):
+            yield PolicyKey(
+                int(ident[i]), int(dport[i]), int(proto[i]),
+                int(direction[i]),
+            )
+
+    def __iter__(self):
+        return self._iter_keys()
+
+    def keys(self):
+        return list(self._iter_keys())
+
+    def values(self):
+        return [_EntryView(self, i) for i in range(len(self))]
+
+    def items(self):
+        return [
+            (key, _EntryView(self, i))
+            for i, key in enumerate(self._iter_keys())
+        ]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, MapStateArrays):
+            return (
+                np.array_equal(self.keys_packed, other.keys_packed)
+                and np.array_equal(self.proxy, other.proxy)
+                and np.array_equal(self.packets, other.packets)
+                and np.array_equal(self.bytes, other.bytes)
+            )
+        if isinstance(other, dict):
+            if len(other) != len(self):
+                return False
+            for key, entry in other.items():
+                mine = self.get(key)
+                if mine is None or mine != entry:
+                    return False
+            return True
+        return NotImplemented
+
+    def __bool__(self) -> bool:
+        return len(self.keys_packed) > 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"MapStateArrays({len(self)} entries)"
+
+
+def sync_map_arrays(
+    realized: "MapStateArrays", desired: "MapStateArrays"
+) -> Tuple["MapStateArrays", int, int]:
+    """Vectorized syncPolicyMap (endpoint.go:2572): returns
+    (new_realized, n_added_or_updated, n_deleted).  Counters of keys
+    present in both states carry over (including proxy-port changes,
+    matching the dict path's old.packets preservation)."""
+    nd, nr = len(desired.keys_packed), len(realized.keys_packed)
+    if nr:
+        pos = np.searchsorted(realized.keys_packed, desired.keys_packed)
+        pos_c = np.minimum(pos, nr - 1)
+        present = realized.keys_packed[pos_c] == desired.keys_packed
+        changed = ~present | (
+            present & (realized.proxy[pos_c] != desired.proxy)
+        )
+        packets = np.where(present, realized.packets[pos_c], 0)
+        bytes_ = np.where(present, realized.bytes[pos_c], 0)
+    else:
+        changed = np.ones(nd, bool)
+        packets = np.zeros(nd, np.int64)
+        bytes_ = np.zeros(nd, np.int64)
+    n_add = int(changed.sum())
+    # deletions: realized keys absent from desired
+    if nd and nr:
+        rpos = np.searchsorted(desired.keys_packed, realized.keys_packed)
+        rpos_c = np.minimum(rpos, nd - 1)
+        still = desired.keys_packed[rpos_c] == realized.keys_packed
+        n_del = int((~still).sum())
+    else:
+        n_del = nr
+    new = MapStateArrays(
+        desired.keys_packed, desired.proxy.copy(), packets, bytes_
+    )
+    return new, n_add, n_del

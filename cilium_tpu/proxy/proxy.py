@@ -30,7 +30,12 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from cilium_tpu.identity import IdentityCache
-from cilium_tpu.l7.http import HTTPPolicy, compile_http_rules, specs_from_filter
+from cilium_tpu.l7.http import (
+    HTTPPolicy,
+    compile_http_rules,
+    resolve_selector_indices,
+    specs_from_filter,
+)
 from cilium_tpu.l7.kafka import (
     KafkaTables,
     compile_kafka_rules,
@@ -98,6 +103,7 @@ class Proxy:
         identity_cache: IdentityCache,
         id_index: Dict[int, int],
         n_identities: int,
+        selector_cache=None,
     ) -> Redirect:
         """proxy.go:153: compile (or recompile) the L7 matcher for one
         redirect; the proxy port is stable across updates."""
@@ -116,11 +122,9 @@ class Proxy:
             if redirect.parser == PARSER_KAFKA:
                 specs = []
                 for selector, l7 in l4.l7_rules_per_ep.items():
-                    indices = [
-                        id_index[num_id]
-                        for num_id, labels in identity_cache.items()
-                        if selector.matches(labels) and num_id in id_index
-                    ]
+                    indices = resolve_selector_indices(
+                        selector, identity_cache, id_index, selector_cache
+                    )
                     if not (l7.kafka or []):
                         # empty rules = L7 allow-all: wildcard spec
                         from cilium_tpu.l7.kafka import KafkaRuleSpec
@@ -136,7 +140,9 @@ class Proxy:
                     specs, n_identities
                 )
             else:
-                specs = specs_from_filter(l4, identity_cache, id_index)
+                specs = specs_from_filter(
+                    l4, identity_cache, id_index, selector_cache
+                )
                 redirect.http_policy = compile_http_rules(
                     specs, n_identities
                 )
@@ -246,6 +252,7 @@ class Proxy:
         identity_cache: IdentityCache,
         id_index: Dict[int, int],
         n_identities: int,
+        selector_cache=None,
     ) -> Dict[str, int]:
         """addNewRedirects/removeOldRedirects for one endpoint; returns
         the realized proxy-id → port map to feed back into the next
@@ -263,7 +270,7 @@ class Proxy:
                     )
                     redirect = self.create_or_update_redirect(
                         f, pid, endpoint.id, identity_cache, id_index,
-                        n_identities,
+                        n_identities, selector_cache,
                     )
                     realized[pid] = redirect.proxy_port
                     wanted.add(pid)

@@ -334,16 +334,21 @@ def sync_counters_to_endpoints(
     rev_index = {v: k for k, v in index.items()}
     id_table = np.asarray(tables.id_table)
     if l3_counts is not None:
-        # L3 counters are indexed by identity index.
+        # L3 counters are indexed by identity index.  Re-read the
+        # realized state under the endpoint lock per update: a
+        # concurrent sync_policy_map publishes a NEW array-backed
+        # state (copy-on-write), and an increment applied through a
+        # pre-sync view would land in the superseded snapshot.
         for e, d, idx in zip(*np.nonzero(l3_counts)):
             ep = manager.lookup(rev_index.get(int(e), -1))
             if ep is None:
                 continue
             key = PolicyKey(int(id_table[idx]), 0, 0, int(d))
-            entry = ep.realized_map_state.get(key)
-            if entry is not None:
-                entry.packets += int(l3_counts[e, d, idx])
-                updated += 1
+            with ep.lock:
+                entry = ep.realized_map_state.get(key)
+                if entry is not None:
+                    entry.packets += int(l3_counts[e, d, idx])
+                    updated += 1
     if l4_counts is not None:
         # L4 counters are indexed by global slot; a slot hit covers
         # every (identity, dport, proto) entry of that filter — the
@@ -360,17 +365,18 @@ def sync_counters_to_endpoints(
             dport, proto = slot_keys[int(j)]
             count = int(l4_counts[e, d, j])
             wild = PolicyKey(0, dport, proto, int(d))
-            entry = ep.realized_map_state.get(wild)
-            if entry is None:
-                for key, cand in ep.realized_map_state.items():
-                    if (
-                        key.dest_port == dport
-                        and key.nexthdr == proto
-                        and key.traffic_direction == int(d)
-                    ):
-                        entry = cand
-                        break
-            if entry is not None:
-                entry.packets += count
-                updated += 1
+            with ep.lock:
+                entry = ep.realized_map_state.get(wild)
+                if entry is None:
+                    for key, cand in ep.realized_map_state.items():
+                        if (
+                            key.dest_port == dport
+                            and key.nexthdr == proto
+                            and key.traffic_direction == int(d)
+                        ):
+                            entry = cand
+                            break
+                if entry is not None:
+                    entry.packets += count
+                    updated += 1
     return updated

@@ -170,3 +170,169 @@ def test_policy_disabled_allows_all():
     for num_id in CACHE:
         assert PolicyKey(num_id, 0, 0, INGRESS) in state
         assert PolicyKey(num_id, 0, 0, EGRESS) in state
+
+
+# -- array-backed map state (MapStateArrays) --------------------------------
+
+
+def test_map_state_arrays_roundtrip_and_eq():
+    import numpy as np
+
+    from cilium_tpu.maps.policymap import (
+        MapStateArrays,
+        PolicyMapStateEntry,
+        pack_keys,
+        unpack_keys,
+    )
+
+    d = {
+        PolicyKey(256, 80, 6, INGRESS): PolicyMapStateEntry(proxy_port=0),
+        PolicyKey(257, 0, 0, EGRESS): PolicyMapStateEntry(
+            proxy_port=0, packets=7
+        ),
+        PolicyKey(0, 443, 6, INGRESS): PolicyMapStateEntry(
+            proxy_port=15001
+        ),
+    }
+    a = MapStateArrays.from_dict(d)
+    assert len(a) == 3
+    assert a == d and (a.to_dict() == d)
+    assert a[PolicyKey(257, 0, 0, EGRESS)].packets == 7
+    assert a.get(PolicyKey(999, 1, 1, INGRESS)) is None
+    # counter mutation writes through
+    a[PolicyKey(256, 80, 6, INGRESS)].packets = 5
+    assert a[PolicyKey(256, 80, 6, INGRESS)].packets == 5
+    # pack/unpack identity
+    ks = a.keys_packed
+    i, p, pr, dd = unpack_keys(ks)
+    assert np.array_equal(pack_keys(i, p, pr, dd), ks)
+
+
+def test_map_state_arrays_build_last_wins():
+    import numpy as np
+
+    from cilium_tpu.maps.policymap import MapStateArrays, pack_keys
+
+    keys = pack_keys(
+        np.asarray([256, 256, 257]),
+        np.asarray([80, 80, 80]),
+        np.asarray([6, 6, 6]),
+        np.asarray([INGRESS, INGRESS, INGRESS]),
+    )
+    proxy = np.asarray([11, 22, 33], np.uint32)
+    a = MapStateArrays.build(keys, proxy)
+    assert len(a) == 2
+    # dict-insertion overwrite: the later duplicate wins
+    assert a[PolicyKey(256, 80, 6, INGRESS)].proxy_port == 22
+    assert a[PolicyKey(257, 80, 6, INGRESS)].proxy_port == 33
+
+
+def test_sync_map_arrays_counters_carry():
+    from cilium_tpu.maps.policymap import (
+        MapStateArrays,
+        PolicyMapStateEntry,
+        sync_map_arrays,
+    )
+
+    realized = MapStateArrays.from_dict(
+        {
+            PolicyKey(256, 80, 6, INGRESS): PolicyMapStateEntry(
+                proxy_port=0, packets=100
+            ),
+            PolicyKey(258, 0, 0, INGRESS): PolicyMapStateEntry(
+                proxy_port=0, packets=9
+            ),
+        }
+    )
+    desired = MapStateArrays.from_dict(
+        {
+            # persisting key with a proxy-port change: counters carry
+            PolicyKey(256, 80, 6, INGRESS): PolicyMapStateEntry(
+                proxy_port=15001
+            ),
+            PolicyKey(259, 0, 0, EGRESS): PolicyMapStateEntry(),
+        }
+    )
+    new, n_add, n_del = sync_map_arrays(realized, desired)
+    assert (n_add, n_del) == (2, 1)  # proxy change + new key; 258 gone
+    assert new[PolicyKey(256, 80, 6, INGRESS)].proxy_port == 15001
+    assert new[PolicyKey(256, 80, 6, INGRESS)].packets == 100
+    assert new[PolicyKey(259, 0, 0, EGRESS)].packets == 0
+    assert PolicyKey(258, 0, 0, INGRESS) not in new
+    # empty-realized and empty-desired edges
+    empty = MapStateArrays.from_dict({})
+    n2, a2, d2 = sync_map_arrays(empty, desired)
+    assert (a2, d2) == (2, 0) and len(n2) == 2
+    n3, a3, d3 = sync_map_arrays(desired, empty)
+    assert (a3, d3) == (0, 2) and len(n3) == 0
+
+
+def test_desired_arrays_matches_dict_path():
+    """The selector-cache (array) path and the dict path must produce
+    identical desired states, including proxy ports."""
+    from cilium_tpu.compiler.selectorcache import SelectorCache
+
+    repo = Repository()
+    repo.add_list(
+        [
+            Rule(
+                endpoint_selector=es("app=bar"),
+                ingress=[
+                    IngressRule(from_endpoints=[es("app=foo")]),
+                    IngressRule(
+                        from_endpoints=[es("app=baz")],
+                        to_ports=[
+                            PortRule(
+                                ports=[
+                                    PortProtocol(
+                                        port="8080", protocol="TCP"
+                                    )
+                                ]
+                            )
+                        ],
+                    ),
+                    IngressRule(
+                        from_endpoints=[es("app=foo")],
+                        to_ports=[
+                            PortRule(
+                                ports=[
+                                    PortProtocol(
+                                        port="80", protocol="TCP"
+                                    )
+                                ],
+                                rules=L7Rules(
+                                    http=[
+                                        PortRuleHTTP(
+                                            method="GET", path="/"
+                                        )
+                                    ]
+                                ),
+                            )
+                        ],
+                    ),
+                ],
+                egress=[
+                    EgressRule(to_endpoints=[es("app=baz")]),
+                ],
+            )
+        ]
+    )
+    cache = SelectorCache()
+    cache.sync(CACHE)
+    for redirects in ({}, {"42:ingress:TCP:80": 15001}):
+        want = compute_desired_policy_map_state(
+            repo,
+            CACHE,
+            larr("app=bar"),
+            endpoint_id=42,
+            realized_redirects=redirects,
+        )
+        got = compute_desired_policy_map_state(
+            repo,
+            CACHE,
+            larr("app=bar"),
+            endpoint_id=42,
+            realized_redirects=redirects,
+            selector_cache=cache,
+        )
+        assert got == want
