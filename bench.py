@@ -38,12 +38,18 @@ import numpy as np
 
 BASELINE_PER_CHIP = 100e6 / 8  # driver target spread over v5e-8
 
+# the headline config5 line, kept for re-emission as the LAST line
+_HEADLINE = None
+
 
 def emit(metric: str, value, unit: str, vs_baseline=None, **extra) -> None:
+    global _HEADLINE
     line = {"metric": metric, "value": value, "unit": unit}
     if vs_baseline is not None:
         line["vs_baseline"] = vs_baseline
     line.update(extra)
+    if metric == "verdicts_per_sec_per_chip":
+        _HEADLINE = line
     print(json.dumps(line), flush=True)
 
 
@@ -568,7 +574,8 @@ def run_config5(args) -> None:
         rng.integers(0, args.pool, size=args.batch)
         for _ in range(min(n_batches, 4))
     ]
-    from cilium_tpu.engine.datapath import datapath_step_with_counters
+    from cilium_tpu.engine.datapath import datapath_step_accum
+    from cilium_tpu.engine.verdict import make_counter_buffers
 
     flow_batches = [
         jax.device_put(
@@ -580,24 +587,31 @@ def run_config5(args) -> None:
         )
         for p in batch_picks
     ]
-    # warmup/compile
-    jax.block_until_ready(
-        datapath_step_with_counters(tables, flow_batches[0])
+    # warmup/compile (counters scatter into carried donated buffers)
+    l4_acc, l3_acc = jax.device_put(make_counter_buffers(tables.policy))
+    out, l4_acc, l3_acc = datapath_step_accum(
+        tables, flow_batches[0], l4_acc, l3_acc
     )
+    jax.block_until_ready((out, l4_acc, l3_acc))
+    # fresh buffers so counter_hits reflects exactly the timed tuples
+    l4_acc, l3_acc = jax.device_put(make_counter_buffers(tables.policy))
     t0 = time.perf_counter()
     outs = []
     for i in range(n_batches):
-        outs.append(
-            datapath_step_with_counters(
-                tables, flow_batches[i % len(flow_batches)]
-            )
+        out, l4_acc, l3_acc = datapath_step_accum(
+            tables, flow_batches[i % len(flow_batches)], l4_acc, l3_acc
         )
+        outs.append(out)
         if len(outs) > 4:
             jax.block_until_ready(outs.pop(0))
     jax.block_until_ready(outs)
+    jax.block_until_ready((l4_acc, l3_acc))
     dt = time.perf_counter() - t0
     total = n_batches * args.batch
     vps = total / dt
+    counter_total = int(np.asarray(l4_acc).sum()) + int(
+        np.asarray(l3_acc).sum()
+    )
 
     # secondary: the bare lattice on the same tables (round 1/2 metric)
     from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
@@ -629,6 +643,16 @@ def run_config5(args) -> None:
     )
 
     p50_ms = dt / n_batches * 1000
+    # achieved HBM gather traffic of the headline loop (roofline
+    # context for regressions): bytes actually gathered per tuple —
+    # 3×4B lattice probes + 4 CT windowed probes (svc + effective
+    # tuple, fwd+rev each: PROBE_WINDOW slots × 4 key words × 4B) +
+    # 1 LB window (2 key words) + LPM 8B ×2 + batch IO
+    from cilium_tpu.engine.hashtable import PROBE_WINDOW
+
+    gather_bytes_per_tuple = (
+        12 + 4 * (PROBE_WINDOW * 4 * 4) + PROBE_WINDOW * 2 * 4 + 16 + 30
+    )
     emit(
         "verdicts_per_sec_per_chip",
         round(vps),
@@ -637,6 +661,10 @@ def run_config5(args) -> None:
         tuples=total,
         batch=args.batch,
         p50_batch_ms=round(p50_ms, 1),
+        counter_hits=counter_total,
+        gathered_gb_per_sec=round(
+            vps * gather_bytes_per_tuple / 1e9, 1
+        ),
         pipeline="fused: prefilter+LB/DNAT+CT+LPM+lattice+counters",
     )
 
@@ -926,7 +954,7 @@ def config4(args) -> None:
                 parsed=True,
             )
         )
-    packed = pad_kafka_requests(tables, templates)[:-1]
+    packed = pad_kafka_requests(tables, templates)
     n = args.l7_requests
     pick = rng.integers(0, len(templates), size=n)
     ident = rng.integers(0, n_ident, size=n).astype(np.int32)
@@ -999,7 +1027,12 @@ def main() -> None:
         smoke()
         return
 
+    # Config 5 (the headline) runs FIRST so a budget kill of the
+    # whole bench can never lose it; the driver's tail-parse reads
+    # the last line, so the headline JSON line is re-emitted at exit.
     configs = {c.strip() for c in args.configs.split(",")}
+    if "5" in configs:
+        run_config5(args)
     if "1" in configs:
         config1()
     if "2" in configs:
@@ -1008,8 +1041,8 @@ def main() -> None:
         config3(args)
     if "4" in configs:
         config4(args)
-    if "5" in configs:
-        run_config5(args)  # headline, prints LAST
+    if "5" in configs and _HEADLINE:
+        print(json.dumps(_HEADLINE), flush=True)  # re-emit for tail-parse
 
 
 if __name__ == "__main__":

@@ -87,6 +87,12 @@ _register_pytree()
 
 
 def compile_ct(ct: CTMap) -> CTSnapshot:
+    """Snapshot the host CT into device tables.  Capacity is pinned to
+    the map's max-entries envelope (pow2 ≥ LOAD_FACTOR_INV×max —
+    pkg/maps/ctmap/ctmap.go:71's 64k default ⇒ 256k slots), so the
+    snapshot SHAPES are identical across churn rebuilds and the fused
+    step never re-jits mid-replay; window-placement leftovers land in
+    the table's fixed stash rather than forcing a capacity change."""
     entries = list(ct.entries.items())
     if entries:
         keys = np.array(
@@ -94,16 +100,24 @@ def compile_ct(ct: CTMap) -> CTSnapshot:
         )
     else:
         keys = np.zeros((0, 4), dtype=np.uint32)
-    table = build_hash_table(keys)
-    rev_nat = np.array(
-        [e.rev_nat_index for _, e in entries] or [0], dtype=np.uint16
-    )
-    slave = np.array([e.slave for _, e in entries] or [0], dtype=np.uint16)
-    related = np.array(
-        [1 if (k.flags & TUPLE_F_RELATED) else 0 for k, _ in entries]
-        or [0],
-        dtype=np.uint8,
-    )
+    from cilium_tpu.engine.hashtable import LOAD_FACTOR_INV
+
+    min_capacity = 16
+    while min_capacity < LOAD_FACTOR_INV * max(ct.max_entries, 1):
+        min_capacity *= 2
+    table = build_hash_table(keys, min_capacity=min_capacity)
+    # value rows padded to the fixed envelope as well — every array
+    # shape in the snapshot must be churn-invariant (see above)
+    n_rows = max(ct.max_entries, len(entries), 1)
+    rev_nat = np.zeros(n_rows, dtype=np.uint16)
+    slave = np.zeros(n_rows, dtype=np.uint16)
+    related = np.zeros(n_rows, dtype=np.uint8)
+    if entries:
+        rev_nat[: len(entries)] = [e.rev_nat_index for _, e in entries]
+        slave[: len(entries)] = [e.slave for _, e in entries]
+        related[: len(entries)] = [
+            1 if (k.flags & TUPLE_F_RELATED) else 0 for k, _ in entries
+        ]
     return CTSnapshot(
         table=table, rev_nat_index=rev_nat, slave=slave, related=related
     )

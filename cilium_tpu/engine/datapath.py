@@ -60,8 +60,12 @@ from cilium_tpu.ct.table import (
 )
 from cilium_tpu.engine.verdict import (
     TupleBatch,
+    _accumulate_counters,
+    _combine,
+    _probes,
     _verdict_kernel,
     _verdict_kernel_with_counters,
+    make_counter_buffers,
 )
 from cilium_tpu.identity import RESERVED_WORLD
 from cilium_tpu.ipcache.lpm import LPMTables, _lookup_kernel
@@ -202,7 +206,10 @@ class DatapathVerdicts:
 
 
 def _datapath_core(
-    tables: DatapathTables, flows: FlowBatch, with_counters: bool
+    tables: DatapathTables,
+    flows: FlowBatch,
+    with_counters: bool,
+    acc=None,
 ):
     ingress = flows.direction == INGRESS
 
@@ -267,8 +274,17 @@ def _datapath_core(
         is_fragment=flows.is_fragment,
     )
     if with_counters:
-        v, l4_counts, l3_counts = _verdict_kernel_with_counters(
+        probe1, probe2, probe3, proxy, j, idx = _probes(
             tables.policy, resolved
+        )
+        v = _combine(
+            probe1, probe2, probe3, proxy, resolved.is_fragment
+        )
+        l4_acc, l3_acc = (
+            acc if acc is not None else make_counter_buffers(tables.policy)
+        )
+        l4_counts, l3_counts = _accumulate_counters(
+            v, resolved, j, idx, l4_acc, l3_acc
         )
     else:
         v = _verdict_kernel(tables.policy, resolved)
@@ -324,8 +340,22 @@ def _datapath_kernel_with_counters(
     return _datapath_core(tables, flows, with_counters=True)
 
 
+def _datapath_kernel_accum(
+    tables: DatapathTables, flows: FlowBatch, l4_acc, l3_acc
+):
+    """Streaming fused step: counters scatter into CARRIED buffers the
+    caller threads (and jit donates) across batches — no per-batch
+    [E, 2, N] materialization.  This is the headline-path kernel; the
+    agent folds the buffers back into realized map states once per
+    replay (the async kernel-map read of pkg/maps/policymap)."""
+    return _datapath_core(
+        tables, flows, with_counters=True, acc=(l4_acc, l3_acc)
+    )
+
+
 datapath_step = jax.jit(_datapath_kernel)
 datapath_step_with_counters = jax.jit(_datapath_kernel_with_counters)
+datapath_step_accum = jax.jit(_datapath_kernel_accum, donate_argnums=(2, 3))
 
 
 def _unique_rows(cols: list, sel: np.ndarray) -> np.ndarray:

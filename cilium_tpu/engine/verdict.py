@@ -225,23 +225,42 @@ def _verdict_kernel(tables: PolicyTables, batch: TupleBatch) -> Verdicts:
     return _combine(probe1, probe2, probe3, proxy, batch.is_fragment)
 
 
-def _verdict_kernel_with_counters(tables: PolicyTables, batch: TupleBatch):
-    """Full datapath step: verdicts + per-entry packet counters (the
-    policy_entry packets field, policy.h:66-68), accumulated with
-    scatter-adds — the realized-state metrics the agent syncs back
-    from the datapath (pkg/maps/policymap PolicyEntry.Packets)."""
-    probe1, probe2, probe3, proxy, j, idx = _probes(tables, batch)
-    v = _combine(probe1, probe2, probe3, proxy, batch.is_fragment)
+def _accumulate_counters(v, batch, j, idx, l4_acc, l3_acc):
+    """Scatter the batch's lattice hits into the carried counter
+    buffers (policy_entry packets, policy.h:66-68).  Callers donate
+    the buffers across batches (XLA updates in place) instead of
+    materializing fresh [E, 2, N] tensors per batch."""
+    hit_l4 = (v.match_kind == MATCH_L4) | (v.match_kind == MATCH_L4_WILD)
+    l4_acc = l4_acc.at[batch.ep_index, batch.direction, j].add(
+        hit_l4.astype(jnp.uint32)
+    )
+    l3_acc = l3_acc.at[batch.ep_index, batch.direction, idx].add(
+        (v.match_kind == MATCH_L3).astype(jnp.uint32)
+    )
+    return l4_acc, l3_acc
 
+
+def make_counter_buffers(tables: PolicyTables):
+    """Zeroed device counter buffers matching `tables`' shapes:
+    (l4 [E, 2, Kg], l3 [E, 2, N]) u32."""
     e_count, _, k = tables.l4_meta.shape
     n = tables.id_table.shape[0]
-    hit_l4 = (v.match_kind == MATCH_L4) | (v.match_kind == MATCH_L4_WILD)
-    l4_counts = jnp.zeros((e_count, 2, k), jnp.uint32).at[
-        batch.ep_index, batch.direction, j
-    ].add(hit_l4.astype(jnp.uint32))
-    l3_counts = jnp.zeros((e_count, 2, n), jnp.uint32).at[
-        batch.ep_index, batch.direction, idx
-    ].add((v.match_kind == MATCH_L3).astype(jnp.uint32))
+    return (
+        jnp.zeros((e_count, 2, k), jnp.uint32),
+        jnp.zeros((e_count, 2, n), jnp.uint32),
+    )
+
+
+def _verdict_kernel_with_counters(tables: PolicyTables, batch: TupleBatch):
+    """Verdicts + fresh per-batch counters (allocates; for one-shot
+    callers and tests — streaming paths use the donated-accumulator
+    variants)."""
+    probe1, probe2, probe3, proxy, j, idx = _probes(tables, batch)
+    v = _combine(probe1, probe2, probe3, proxy, batch.is_fragment)
+    l4_acc, l3_acc = make_counter_buffers(tables)
+    l4_counts, l3_counts = _accumulate_counters(
+        v, batch, j, idx, l4_acc, l3_acc
+    )
     return v, l4_counts, l3_counts
 
 

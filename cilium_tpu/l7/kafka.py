@@ -246,7 +246,7 @@ def evaluate_with_host_fallback(
     packed = pad_kafka_requests(tables, requests)
     overflow = packed[-1]
     allowed = np.asarray(
-        evaluate_kafka_batch(tables, *packed[:-1], ident_idx, known)
+        evaluate_kafka_batch(tables, *packed, ident_idx, known)
     ).copy()
     ident_idx = np.asarray(ident_idx)
     known = np.asarray(known)
@@ -266,10 +266,17 @@ def evaluate_kafka_batch(
     topic_count,
     parsed,
     checks_client,
+    overflow,
     ident_idx,
     known,
 ):
-    """Returns allowed bool [B].  Pure integer [B,R]/[B,T,R] compares."""
+    """Returns allowed bool [B].  Pure integer [B,R]/[B,T,R] compares.
+
+    Rows flagged `overflow` (topic list truncated by
+    pad_kafka_requests) are force-DENIED — only
+    evaluate_with_host_fallback may re-run them with the full topic
+    list; a direct caller dropping the flag must never see a
+    truncated row allowed."""
     import jax.numpy as jnp
 
     keys_lo = jnp.asarray(tables.rule_keys_lo)
@@ -285,12 +292,17 @@ def evaluate_kafka_batch(
     parsed_b = jnp.asarray(parsed)[:, None]
     checks_client_b = jnp.asarray(checks_client)[:, None]
 
-    # api-key membership (CheckAPIKeyRole, kafka.go:247)
+    # api-key membership (CheckAPIKeyRole, kafka.go:247); negative
+    # keys (structurally invalid, rejected at the wire parser) must
+    # not alias into the clipped shift range — gate them out here too
     in_lo = (keys_lo[None, :] >> jnp.clip(kind, 0, 31).astype(jnp.uint32)) & 1
     in_hi = (keys_hi[None, :] >> jnp.clip(kind - 32, 0, 31).astype(jnp.uint32)) & 1
-    key_ok = keys_any[None, :] | jnp.where(
-        kind < 32, in_lo, jnp.where(kind < 64, in_hi, 0)
-    ).astype(bool)
+    key_ok = (kind >= 0) & (
+        keys_any[None, :]
+        | jnp.where(
+            kind < 32, in_lo, jnp.where(kind < 64, in_hi, 0)
+        ).astype(bool)
+    )
 
     ver_ok = (rule_version[None, :] < 0) | (rule_version[None, :] == version)
 
@@ -341,7 +353,7 @@ def evaluate_kafka_batch(
     all_covered = (jnp.asarray(topic_count) > 0) & jnp.all(
         covered | ~slot_active, axis=1
     )
-    return allow_all | all_covered
+    return (allow_all | all_covered) & ~jnp.asarray(overflow)
 
 
 # ---------------------------------------------------------------------------

@@ -35,7 +35,13 @@ MAX_FRAME = 64 * 1024 * 1024  # sarama MaxRequestSize analog
 
 
 class KafkaParseError(ValueError):
-    pass
+    """Structurally malformed frame — connection-fatal in the
+    reference proxy (an unparseable header cannot be re-framed)."""
+
+
+class KafkaIncompleteFrame(KafkaParseError):
+    """Not enough bytes for a complete frame — the caller should keep
+    the remainder buffered and retry when more data arrives."""
 
 
 class _Reader:
@@ -169,16 +175,24 @@ def decode_request(buf: bytes, off: int = 0) -> Tuple[KafkaRequest, int, int]:
     header with an unparseable payload degrades to parsed=False.
     """
     if off + 4 > len(buf):
-        raise KafkaParseError("short frame header")
+        raise KafkaIncompleteFrame("short frame header")
     size = struct.unpack(">i", buf[off : off + 4])[0]
-    if size < 8 or size > MAX_FRAME or off + 4 + size > len(buf):
+    if size < 8 or size > MAX_FRAME:
         raise KafkaParseError(f"bad frame size {size}")
+    if off + 4 + size > len(buf):
+        raise KafkaIncompleteFrame("partial frame body")
     end = off + 4 + size
     r = _Reader(buf, off + 4, end)
     api_key = r.i16()
     api_version = r.i16()
     correlation_id = r.i32()
     client_id = r.string() or ""
+    if api_key < 0:
+        # int16 api keys are non-negative on the wire; a negative key
+        # would alias into the device matcher's clipped kind range
+        # (kafka.py evaluate_kafka_batch) and false-allow — treat as a
+        # malformed header, like the reference's sarama decoder
+        raise KafkaParseError(f"negative api_key {api_key}")
 
     parsed = False
     topics: Sequence[str] = ()
@@ -206,13 +220,15 @@ def decode_request(buf: bytes, off: int = 0) -> Tuple[KafkaRequest, int, int]:
 def decode_stream(buf: bytes) -> List[Tuple[KafkaRequest, int]]:
     """All complete frames in a connection buffer → [(request, correlation_id)].
     Trailing partial frames are ignored (a real proxy would keep them
-    buffered until more bytes arrive)."""
+    buffered until more bytes arrive); a structurally malformed frame
+    propagates KafkaParseError — connection-fatal, never silently
+    skipped."""
     out = []
     off = 0
     while off + 4 <= len(buf):
         try:
             req, cid, off = decode_request(buf, off)
-        except KafkaParseError:
+        except KafkaIncompleteFrame:
             break
         out.append((req, cid))
     return out
@@ -294,6 +310,14 @@ class CorrelationCache:
     def record(self, correlation_id: int, request: KafkaRequest) -> None:
         if len(self._pending) >= self._max:
             raise KafkaParseError("too many outstanding requests")
+        if correlation_id in self._pending:
+            # the reference sidesteps duplicates by rewriting IDs to
+            # unique sequence numbers (correlation_cache.go
+            # HandleRequest); we keep client IDs on the wire, so a
+            # duplicate would mis-pair a response — reject it
+            raise KafkaParseError(
+                f"duplicate correlation_id {correlation_id}"
+            )
         self._pending[correlation_id] = request
 
     def match(self, correlation_id: int) -> Optional[KafkaRequest]:

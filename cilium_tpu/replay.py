@@ -30,6 +30,11 @@ from cilium_tpu.engine.verdict import (
 from cilium_tpu.maps.policymap import PolicyKey
 from cilium_tpu.native import decode_flow_records
 
+# fold the carried u32 counter buffers into host u64 sums before any
+# cell could have gained 2^31 increments (each batch adds ≤ batch_size
+# to a cell), leaving 2× headroom below the u32 wrap
+_COUNTER_FOLD_MAX_INCR = 1 << 31
+
 
 @dataclass
 class ReplayStats:
@@ -139,8 +144,8 @@ def replay(
     ct_map=None,
 ) -> tuple:
     """Run all records through the FULL fused datapath step
-    (engine/datapath.datapath_step_with_counters) with pipelined
-    dispatch.
+    (engine/datapath.datapath_step_accum — counters scatter into
+    carried, donated device buffers) with pipelined dispatch.
 
     `tables` is a DatapathTables (prefilter/ipcache/CT/LB/policy).
     With `ct_map` (the authoritative host CTMap) replay runs in
@@ -158,12 +163,16 @@ def replay(
     """
     import time
 
+    import jax
+
     from cilium_tpu.ct.device import compile_ct
     from cilium_tpu.engine.datapath import (
         DatapathTables,
         apply_ct_writeback,
-        datapath_step_with_counters,
+        datapath_step,
+        datapath_step_accum,
     )
+    from cilium_tpu.engine.verdict import make_counter_buffers
 
     if manager is not None:
         # stale-table guard at the layer that actually reads the
@@ -173,18 +182,48 @@ def replay(
         manager.check_tables_current(tables.policy)
 
     stats = ReplayStats()
-    acc = _CounterAccumulator() if accumulate_counters else None
+    # counters scatter into carried u32 device buffers, donated
+    # across batches — one D2H fold per _COUNTER_FOLD_BATCHES into
+    # host u64 sums (a cell can gain ≤ batch_size per batch, so u32
+    # can't wrap within a fold interval), instead of [E, 2, N]
+    # tensors per batch
+    l4_acc = l3_acc = None
+    l4_total = l3_total = None
+    batches_since_fold = 0
+    fold_every = max(1, _COUNTER_FOLD_MAX_INCR // max(batch_size, 1))
+    if accumulate_counters:
+        l4_acc, l3_acc = jax.device_put(
+            make_counter_buffers(tables.policy)
+        )
+
+    def _fold_counters():
+        nonlocal l4_acc, l3_acc, l4_total, l3_total, batches_since_fold
+        l4_host = np.asarray(l4_acc).astype(np.uint64)
+        l3_host = np.asarray(l3_acc).astype(np.uint64)
+        l4_total = l4_host if l4_total is None else l4_total + l4_host
+        l3_total = l3_host if l3_total is None else l3_total + l3_host
+        l4_acc, l3_acc = jax.device_put(
+            make_counter_buffers(tables.policy)
+        )
+        batches_since_fold = 0
 
     pending = []  # pipelined dispatch, bounded depth
     t0 = time.perf_counter()
     for flows, valid in read_flow_batches(buf, batch_size, ep_map):
-        out = datapath_step_with_counters(tables, flows)
+        if accumulate_counters:
+            out, l4_acc, l3_acc = datapath_step_accum(
+                tables, flows, l4_acc, l3_acc
+            )
+            batches_since_fold += 1
+            if batches_since_fold >= fold_every:
+                _fold_counters()
+        else:
+            out = datapath_step(tables, flows)
         if ct_map is not None:
             # sustained churn: drain in order, fold intents back, and
             # refresh the snapshot the next batch probes
-            _drain_fused((out, valid), stats, acc)
-            verdicts = out[0]
-            created, deleted = apply_ct_writeback(ct_map, verdicts, flows)
+            _drain_fused((out, valid), stats)
+            created, deleted = apply_ct_writeback(ct_map, out, flows)
             stats.ct_created += created
             stats.ct_deleted += deleted
             stats.batches += 1
@@ -200,14 +239,15 @@ def replay(
         pending.append((out, valid))
         stats.batches += 1
         if len(pending) >= 4:
-            _drain_fused(pending.pop(0), stats, acc)
+            _drain_fused(pending.pop(0), stats)
     while pending:
-        _drain_fused(pending.pop(0), stats, acc)
+        _drain_fused(pending.pop(0), stats)
     stats.seconds = time.perf_counter() - t0
 
-    if acc is None:
+    if not accumulate_counters:
         return stats, None, None
-    return stats, acc.l4, acc.l3
+    _fold_counters()
+    return stats, l4_total, l3_total
 
 
 def replay_lattice(
@@ -274,7 +314,11 @@ def _drain(item, stats: ReplayStats, acc: Optional[_CounterAccumulator]) -> None
         acc.add(l4_counts, l3_counts)
 
 
-_drain_fused = _drain  # fused output tuples share the drain shape
+def _drain_fused(item, stats: ReplayStats) -> None:
+    """Fused-path drain: counters live in the carried device
+    accumulators, so the item is just (verdicts, valid)."""
+    verdicts, valid = item
+    _tally(verdicts, valid, stats)
 
 
 _REPLAY_STEP = None

@@ -293,3 +293,70 @@ def test_ct_device_related_icmp_matches_host():
     assert int(np.asarray(result)[0]) == CT_RELATED
     want = ct.lookup(icmp, 1, now=1, related_icmp=True)
     assert want == CT_RELATED
+
+
+def test_hashtable_stash_holds_window_overflow():
+    """Keys engineered to share one hash all compete for the same
+    8-slot window; the ones that don't fit must land in the stash and
+    still be found (hashtable.py stash design)."""
+    from cilium_tpu.engine.hashtable import (
+        PROBE_WINDOW,
+        STASH_SIZE,
+        _fnv1a_host,
+        build_hash_table,
+    )
+
+    rng = np.random.default_rng(7)
+    cands = rng.integers(0, 1 << 32, size=(200_000, 4),
+                         dtype=np.uint64).astype(np.uint32)
+    cands = np.unique(cands, axis=0)
+    h = _fnv1a_host(cands) & 1023  # bucket by low bits ≈ slot index
+    vals, counts = np.unique(h, return_counts=True)
+    # gather > PROBE_WINDOW keys whose home slots collide
+    target = vals[np.argmax(counts)]
+    cluster = cands[h == target][: PROBE_WINDOW + 4]
+    assert len(cluster) > PROBE_WINDOW // 2
+    table = build_hash_table(cluster, min_capacity=1024)
+    found, idx = lookup_batch(table, jnp.asarray(cluster))
+    assert bool(np.asarray(found).all())
+    np.testing.assert_array_equal(np.asarray(idx), np.arange(len(cluster)))
+
+
+def test_hashtable_adversarial_collisions_fail_loudly():
+    """A hash-collision cluster larger than window+stash can never
+    place at any capacity — the build must raise, not double until
+    OOM.  (Identical keys are the cheapest way to force identical
+    hashes; a real FNV-1a multicollision behaves the same.)"""
+    import pytest
+
+    from cilium_tpu.engine.hashtable import (
+        PROBE_WINDOW,
+        STASH_SIZE,
+        build_hash_table,
+    )
+
+    n_needed = PROBE_WINDOW + STASH_SIZE + 1
+    dup = np.tile(
+        np.array([[1, 2, 3, 4]], dtype=np.uint32), (n_needed, 1)
+    )
+    with pytest.raises(ValueError):
+        build_hash_table(dup, min_capacity=64)
+
+
+def test_ct_snapshot_shapes_churn_invariant():
+    """compile_ct must produce identical array shapes regardless of
+    how many entries the map holds (no mid-replay re-jit)."""
+    from cilium_tpu.ct.device import compile_ct
+
+    ct1 = CTMap()
+    ct2 = CTMap()
+    for i in range(100):
+        ct2.create(
+            CTTuple(0x0A000001 + i, 0x0A000002, 80, 4000 + i, 6),
+            CT_INGRESS,
+        )
+    s1, s2 = compile_ct(ct1), compile_ct(ct2)
+    assert s1.table.keys.shape == s2.table.keys.shape
+    assert s1.table.value_index.shape == s2.table.value_index.shape
+    assert s1.rev_nat_index.shape == s2.rev_nat_index.shape
+    assert s1.table.max_probes == s2.table.max_probes

@@ -387,3 +387,86 @@ def test_proxied_flow_produces_verdict_and_log():
     ]
     assert all(r.l7_proto == "http" for r in logs)
     assert logs[0].endpoint_id == 5
+
+
+def test_kafka_wire_negative_api_key_fatal():
+    """A negative api_key would alias into the device matcher's
+    clipped key range (api key 0 = Produce) and false-allow; the wire
+    parser must treat it as a malformed header (ADVICE r3)."""
+    import struct
+
+    from cilium_tpu.l7.kafka_wire import KafkaParseError
+
+    body = struct.pack(">hhi", -1, 0, 99) + struct.pack(">h", -1)
+    frame = struct.pack(">i", len(body)) + body
+    with pytest.raises(KafkaParseError):
+        decode_request(frame)
+
+
+def test_kafka_stream_partial_vs_malformed():
+    """Trailing partial frame → keep what parsed; structurally
+    malformed frame → connection-fatal KafkaParseError, not a silent
+    skip (request.go: unparseable header kills the connection)."""
+    import struct
+
+    from cilium_tpu.l7.kafka_wire import KafkaParseError, decode_stream
+
+    good = encode_request(
+        KafkaRequest(kind=3, version=0, client_id="c", topics=("t",),
+                     parsed=True),
+        correlation_id=1,
+    )
+    # partial: first 6 bytes of a second frame
+    out = decode_stream(good + good[:6])
+    assert len(out) == 1 and out[0][1] == 1
+
+    # malformed: negative frame size
+    bad = struct.pack(">i", -5)
+    with pytest.raises(KafkaParseError):
+        decode_stream(good + bad)
+
+
+def test_kafka_correlation_duplicate_rejected():
+    from cilium_tpu.l7.kafka_wire import CorrelationCache, KafkaParseError
+
+    cache = CorrelationCache()
+    req = KafkaRequest(kind=0, version=0, client_id="c", topics=("t",),
+                       parsed=True)
+    cache.record(5, req)
+    with pytest.raises(KafkaParseError):
+        cache.record(5, req)
+    assert cache.match(5) is req
+    assert cache.match(5) is None
+
+
+def test_kafka_overflow_rows_force_denied_on_device():
+    """pad_kafka_requests truncates >MAX_TOPICS rows; the device
+    matcher must deny them outright so only the host-fallback path
+    (which re-runs the full topic list) can allow them."""
+    import numpy as np
+
+    from cilium_tpu.l7.kafka import (
+        MAX_TOPICS,
+        KafkaRuleSpec,
+        compile_kafka_rules,
+        evaluate_kafka_batch,
+        evaluate_with_host_fallback,
+        pad_kafka_requests,
+    )
+
+    # rule allows ALL topics for identity 0 → host verdict is allow
+    specs = [KafkaRuleSpec(identity_indices=[0], api_keys=(0,), topic="")]
+    tables = compile_kafka_rules(specs, 4)
+    big = KafkaRequest(
+        kind=0, version=0, client_id="c",
+        topics=tuple(f"t{i}" for i in range(MAX_TOPICS + 2)),
+        parsed=True,
+    )
+    packed = pad_kafka_requests(tables, [big])
+    assert bool(packed[-1][0])  # overflow flagged
+    ident = np.zeros(1, np.int32)
+    known = np.ones(1, bool)
+    dev = np.asarray(evaluate_kafka_batch(tables, *packed, ident, known))
+    assert not bool(dev[0])  # device alone: deny
+    full = evaluate_with_host_fallback(tables, [big], ident, known)
+    assert bool(full[0])  # host fallback restores the true allow

@@ -18,7 +18,7 @@ from cilium_tpu.l7.kafka import (
 
 
 def run_device(tables, requests, ident_idx):
-    arrays = pad_kafka_requests(tables, requests)[:-1]
+    arrays = pad_kafka_requests(tables, requests)
     allowed = evaluate_kafka_batch(
         tables,
         *arrays,
