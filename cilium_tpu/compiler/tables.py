@@ -116,6 +116,13 @@ class PolicyTables:
     # survives device_put/flatten round trips without becoming a jit
     # cache key; 0 = unstamped (hand-built tables)
     generation: np.ndarray = np.uint64(0)
+    # fused exact+meta probe table, u32 [E, 2, Kg, 2W]: high half =
+    # allow bits for 16 identities (word16 = idx >> 4), low half =
+    # l4_meta (proxy << 1 | wild, requiring proxy < 2^15) — ONE gather
+    # answers the exact probe AND the slot metadata (random gathers
+    # are the datapath's unit of cost on TPU).  None when some proxy
+    # port exceeds 15 bits; the kernel then falls back to two gathers.
+    l4_combined: "np.ndarray | None" = None
 
     @property
     def num_endpoints(self) -> int:
@@ -140,6 +147,7 @@ class PolicyTables:
                 self.l4_allow_bits,
                 self.l3_allow_bits,
                 self.generation,
+                self.l4_combined,
             ),
             None,
         )
@@ -203,6 +211,26 @@ def _build_direct_index(id_table: np.ndarray) -> Tuple[np.ndarray, int]:
     id_direct[lo_ids] = lo_idx
     id_direct[lo_len + local_ids] = local_idx
     return id_direct, lo_len
+
+
+def build_l4_combined(
+    l4_allow_bits: np.ndarray, l4_meta: np.ndarray
+) -> "np.ndarray | None":
+    """Derive the fused exact+meta probe table: u32 [E, 2, Kg, 2W]
+    where entry [..., j, 2w + h] = (allow bits for identities
+    [32w + 16h, 32w + 16h + 16) << 16) | l4_meta[..., j].  Returns
+    None (kernel falls back to two gathers) if any proxy port needs
+    more than 15 bits."""
+    if (l4_meta >> 16).any():
+        return None
+    lo = (l4_allow_bits & np.uint32(0xFFFF)).astype(np.uint32)
+    hi = (l4_allow_bits >> np.uint32(16)).astype(np.uint32)
+    e, d, kg, w = l4_allow_bits.shape
+    combined = np.empty((e, d, kg, 2 * w), dtype=np.uint32)
+    combined[..., 0::2] = lo << np.uint32(16)
+    combined[..., 1::2] = hi << np.uint32(16)
+    combined |= l4_meta[..., None].astype(np.uint32)
+    return combined
 
 
 def lower_map_state(
@@ -298,6 +326,7 @@ def lower_map_state(
         l4_meta=l4_meta,
         l4_allow_bits=l4_allow_bits,
         l3_allow_bits=l3_allow_bits,
+        l4_combined=build_l4_combined(l4_allow_bits, l4_meta),
     )
 
 
@@ -738,6 +767,7 @@ class FleetCompiler:
             l4_meta=l4_meta,
             l4_allow_bits=l4_bits,
             l3_allow_bits=l3_bits,
+            l4_combined=build_l4_combined(l4_bits, l4_meta),
         )
         self._generation += 1
         tables.generation = np.uint64(

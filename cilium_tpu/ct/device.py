@@ -1,19 +1,46 @@
 """Device conntrack lookup over compiled snapshots.
 
 The host CTMap stays authoritative (it mutates); batches evaluate
-against a compiled snapshot in a fixed number of gathers, and the
-results (new flows, counters) are applied back on host — the same
-split as the reference, where the BPF map is written by the kernel and
-read/GC'd from userspace asynchronously.
+against a compiled snapshot, and the results (new flows, counters) are
+applied back on host — the same split as the reference, where the BPF
+map is written by the kernel and read/GC'd from userspace
+asynchronously (pkg/maps/ctmap, bpf/lib/conntrack.h).
 
-Lookup reproduces ct_lookup4's probe order under the batch: reverse
-tuple first (REPLY/RELATED precedence), then forward, else NEW.
+TPU-first layout: random element gathers cost ~7 ns/query on v5e but a
+128-lane ROW gather costs about the same — so the table is BUCKETIZED:
+
+  * buckets are [Cb, 128] u32 rows; each row holds up to 25 packed
+    entries (stride 5);
+  * the bucket hash is computed over the DIRECTION-NORMALIZED tuple
+    (sorted (addr, port) pairs), so a flow's forward key, reverse key,
+    and RELATED variants all land in the SAME bucket — one row gather
+    answers ct_lookup4's reverse-then-forward probe order
+    (bpf/lib/conntrack.h:349) that previously took four windowed
+    probes;
+  * entries that overflow their bucket go to a fixed-size stash that
+    is broadcast-compared against every query (bounded, shape-stable);
+  * every shape is pinned by the map's max-entries envelope, so churn
+    rebuilds never change the jit cache key, and `apply_bucket_delta`
+    updates individual bucket rows in place on device (donated) —
+    sustained churn does not re-upload or re-jit anything.
+
+Entry packing (5 × u32), PLANAR within the row — lanes [25k, 25k+25)
+hold word k of entries 0..24, so the kernel extracts each word as a
+contiguous [B, 25] slice of the fetched row (an interleaved layout
+would force a [B, 25, 5] reshape that XLA materializes with 4×
+tile padding — 16 GB at an 8M batch):
+  w0  normalized lo address
+  w1  normalized hi address
+  w2  lo port << 16 | hi port
+  w3  proto << 8 | swapped << 7 | key flags   (swapped: the original
+      key's (daddr, dport) sorted above (saddr, sport))
+  w4  rev_nat_index << 16 | slave
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -31,43 +58,83 @@ from cilium_tpu.ct.table import (
     TUPLE_F_RELATED,
     TUPLE_F_SERVICE,
 )
-from cilium_tpu.engine.hashtable import (
-    HashTable,
-    build_hash_table,
-    lookup_batch,
-)
+from cilium_tpu.engine.hashtable import _fnv1a_host, fnv1a_device
+
+ENTRY_WORDS = 5
+BUCKET_LANES = 128
+ENTRIES_PER_BUCKET = BUCKET_LANES // ENTRY_WORDS  # 25
+STASH_ENTRIES = 128
+# average entries per bucket at the max-entries envelope; 4 of 25
+# keeps the Poisson tail of bucket overflow far below the stash size
+BUCKET_LOAD = 4
+_SWAPPED_BIT = 1 << 7
+# an address word no real key produces (packed lo addr of an empty
+# lane); lanes are zero-filled and flags=0 entries can't exist (every
+# key carries at least one TUPLE_F bit or proto != 0 — but be exact:
+# an all-zero w3 with zero addresses IS producible in theory, so empty
+# lanes get an explicit invalid marker in w3 instead)
+_EMPTY_W3 = np.uint32(0xFFFFFFFF)
 
 
-def _pack_key(t: CTTuple) -> Tuple[int, int, int, int]:
-    """CTTuple → 4 u32 words (daddr, saddr, dport<<16|sport,
-    nexthdr<<8|flags) — the struct layout of common.h:359 collapsed."""
+def _normalize_host(
+    daddr: int, saddr: int, dport: int, sport: int
+) -> Tuple[int, int, int, int, int]:
+    """(lo_addr, hi_addr, lo_port, hi_port, swapped) — swapped means
+    (daddr, dport) sorts strictly above (saddr, sport)."""
+    if (daddr, dport) > (saddr, sport):
+        return saddr, daddr, sport, dport, 1
+    return daddr, saddr, dport, sport, 0
+
+
+def _bucket_hash_words(
+    lo_addr, hi_addr, lo_port, hi_port, proto
+) -> np.ndarray:
+    return np.stack(
+        [
+            np.asarray(lo_addr, np.uint32),
+            np.asarray(hi_addr, np.uint32),
+            (np.asarray(lo_port, np.uint32) << 16)
+            | np.asarray(hi_port, np.uint32),
+            np.asarray(proto, np.uint32),
+        ],
+        axis=-1,
+    )
+
+
+def _pack_entry(key: CTTuple, entry) -> Tuple[int, int, int, int, int]:
+    lo_a, hi_a, lo_p, hi_p, swapped = _normalize_host(
+        key.daddr, key.saddr, key.dport, key.sport
+    )
+    w3 = (
+        ((key.nexthdr & 0xFF) << 8)
+        | (swapped * _SWAPPED_BIT)
+        | (key.flags & 0x7F)
+    )
+    w4 = ((entry.rev_nat_index & 0xFFFF) << 16) | (entry.slave & 0xFFFF)
     return (
-        t.daddr & 0xFFFFFFFF,
-        t.saddr & 0xFFFFFFFF,
-        ((t.dport & 0xFFFF) << 16) | (t.sport & 0xFFFF),
-        ((t.nexthdr & 0xFF) << 8) | (t.flags & 0xFF),
+        lo_a & 0xFFFFFFFF,
+        hi_a & 0xFFFFFFFF,
+        ((lo_p & 0xFFFF) << 16) | (hi_p & 0xFFFF),
+        w3,
+        w4,
     )
 
 
 @dataclass
 class CTSnapshot:
-    """Compiled CT table: hash table over packed tuple words +
-    per-entry state needed by the datapath."""
+    """Compiled CT: bucket rows + overflow stash (pytree; n_buckets is
+    static aux so churn rebuilds share one jit cache entry)."""
 
-    table: HashTable
-    rev_nat_index: np.ndarray  # u16 [N]
-    slave: np.ndarray  # u16 [N]
-    related: np.ndarray  # u8 [N] entry carries TUPLE_F_RELATED
+    buckets: "np.ndarray"  # u32 [Cb, 128]
+    stash: "np.ndarray"  # u32 [STASH_ENTRIES, ENTRY_WORDS]
+    n_buckets: int
 
     def tree_flatten(self):
-        return (
-            (self.table, self.rev_nat_index, self.slave, self.related),
-            None,
-        )
+        return ((self.buckets, self.stash), self.n_buckets)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(children[0], children[1], aux)
 
 
 def _register_pytree() -> None:
@@ -86,52 +153,171 @@ def _register_pytree() -> None:
 _register_pytree()
 
 
-def compile_ct(ct: CTMap) -> CTSnapshot:
-    """Snapshot the host CT into device tables.  Capacity is pinned to
-    the map's max-entries envelope (pow2 ≥ LOAD_FACTOR_INV×max —
-    pkg/maps/ctmap/ctmap.go:71's 64k default ⇒ 256k slots), so the
-    snapshot SHAPES are identical across churn rebuilds and the fused
-    step never re-jits mid-replay; window-placement leftovers land in
-    the table's fixed stash rather than forcing a capacity change."""
-    entries = list(ct.entries.items())
-    if entries:
-        keys = np.array(
-            [_pack_key(k) for k, _ in entries], dtype=np.uint32
-        )
-    else:
-        keys = np.zeros((0, 4), dtype=np.uint32)
-    from cilium_tpu.engine.hashtable import LOAD_FACTOR_INV
+def _envelope_buckets(max_entries: int) -> int:
+    nb = 16
+    while nb * BUCKET_LOAD < max(max_entries, 1):
+        nb *= 2
+    return nb
 
-    min_capacity = 16
-    while min_capacity < LOAD_FACTOR_INV * max(ct.max_entries, 1):
-        min_capacity *= 2
-    table = build_hash_table(keys, min_capacity=min_capacity)
-    # value rows padded to the fixed envelope as well — every array
-    # shape in the snapshot must be churn-invariant (see above)
-    n_rows = max(ct.max_entries, len(entries), 1)
-    rev_nat = np.zeros(n_rows, dtype=np.uint16)
-    slave = np.zeros(n_rows, dtype=np.uint16)
-    related = np.zeros(n_rows, dtype=np.uint8)
-    if entries:
-        rev_nat[: len(entries)] = [e.rev_nat_index for _, e in entries]
-        slave[: len(entries)] = [e.slave for _, e in entries]
-        related[: len(entries)] = [
-            1 if (k.flags & TUPLE_F_RELATED) else 0 for k, _ in entries
+
+class CTBucketIndex:
+    """Host mirror of the device bucket layout, for incremental churn
+    updates: tracks which bucket each key lives in and rebuilds only
+    the rows that changed (the agent-side analog of the kernel
+    updating one hash bucket per CT event)."""
+
+    def __init__(self, ct: CTMap) -> None:
+        self.n_buckets = _envelope_buckets(ct.max_entries)
+        self.bucket_keys: List[List[CTTuple]] = [
+            [] for _ in range(self.n_buckets)
         ]
-    return CTSnapshot(
-        table=table, rev_nat_index=rev_nat, slave=slave, related=related
-    )
+        self.stash_keys: List[CTTuple] = []
+        self.key_home: Dict[CTTuple, int] = {}  # -1 = stash
+        for key in ct.entries:
+            self._place(key)
+        self.ct = ct
+
+    def _bucket_of(self, key: CTTuple) -> int:
+        lo_a, hi_a, lo_p, hi_p, _ = _normalize_host(
+            key.daddr, key.saddr, key.dport, key.sport
+        )
+        words = _bucket_hash_words(lo_a, hi_a, lo_p, hi_p, key.nexthdr)
+        return int(_fnv1a_host(words[None, :])[0]) & (self.n_buckets - 1)
+
+    def _place(self, key: CTTuple) -> int:
+        b = self._bucket_of(key)
+        if len(self.bucket_keys[b]) < ENTRIES_PER_BUCKET:
+            self.bucket_keys[b].append(key)
+            self.key_home[key] = b
+            return b
+        if len(self.stash_keys) >= STASH_ENTRIES:
+            raise ValueError(
+                "CT bucket and stash overflow — raise max_entries "
+                "(bucket envelope) or stash size"
+            )
+        self.stash_keys.append(key)
+        self.key_home[key] = -1
+        return -1
+
+    def _row(self, b: int) -> np.ndarray:
+        row = np.zeros(BUCKET_LANES, dtype=np.uint32)
+        # planar layout: word k of entry i sits at lane k*E + i
+        row[3 * ENTRIES_PER_BUCKET : 4 * ENTRIES_PER_BUCKET] = _EMPTY_W3
+        for i, key in enumerate(self.bucket_keys[b]):
+            packed = _pack_entry(key, self.ct.entries[key])
+            for k in range(ENTRY_WORDS):
+                row[k * ENTRIES_PER_BUCKET + i] = packed[k]
+        return row
+
+    def _stash_rows(self) -> np.ndarray:
+        stash = np.zeros((STASH_ENTRIES, ENTRY_WORDS), dtype=np.uint32)
+        stash[:, 3] = _EMPTY_W3
+        for i, key in enumerate(self.stash_keys):
+            stash[i] = _pack_entry(key, self.ct.entries[key])
+        return stash
+
+    def full_snapshot(self) -> CTSnapshot:
+        buckets = np.zeros((self.n_buckets, BUCKET_LANES), dtype=np.uint32)
+        buckets[
+            :, 3 * ENTRIES_PER_BUCKET : 4 * ENTRIES_PER_BUCKET
+        ] = _EMPTY_W3
+        for b in range(self.n_buckets):
+            if self.bucket_keys[b]:
+                buckets[b] = self._row(b)
+        return CTSnapshot(
+            buckets=buckets,
+            stash=self._stash_rows(),
+            n_buckets=self.n_buckets,
+        )
+
+    def apply(
+        self, created: List[CTTuple], deleted: List[CTTuple]
+    ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        """Fold create/delete key sets into the mirror; returns
+        (changed_bucket_indices i32 [M], changed_rows u32 [M, 128],
+        new_stash or None) for `apply_bucket_delta`."""
+        dirty = set()
+        stash_dirty = False
+        for key in deleted:
+            home = self.key_home.pop(key, None)
+            if home is None:
+                continue
+            if home < 0:
+                self.stash_keys.remove(key)
+                stash_dirty = True
+            else:
+                self.bucket_keys[home].remove(key)
+                dirty.add(home)
+        for key in created:
+            if key in self.key_home:
+                dirty_home = self.key_home[key]
+                if dirty_home >= 0:
+                    dirty.add(dirty_home)  # value may have changed
+                else:
+                    stash_dirty = True
+                continue
+            home = self._place(key)
+            if home < 0:
+                stash_dirty = True
+            else:
+                dirty.add(home)
+        idx = np.array(sorted(dirty), dtype=np.int32)
+        rows = (
+            np.stack([self._row(b) for b in idx])
+            if len(idx)
+            else np.zeros((0, BUCKET_LANES), dtype=np.uint32)
+        )
+        if len(idx):
+            # pad the delta to a pow2 length by repeating the first
+            # changed bucket (idempotent writes) so apply_bucket_delta
+            # compiles once per size bucket instead of per batch
+            m = 8
+            while m < len(idx):
+                m *= 2
+            pad = m - len(idx)
+            if pad:
+                idx = np.concatenate(
+                    [idx, np.full(pad, idx[0], np.int32)]
+                )
+                rows = np.concatenate(
+                    [rows, np.repeat(rows[:1], pad, axis=0)]
+                )
+        return idx, rows, self._stash_rows() if stash_dirty else None
 
 
-def _pack_batch(daddr, saddr, dport, sport, proto, flags):
+def compile_ct(ct: CTMap) -> CTSnapshot:
+    """Snapshot the host CT into device bucket tables.  Shapes are
+    pinned by ct.max_entries (pkg/maps/ctmap/ctmap.go:71's envelope),
+    identical across churn rebuilds."""
+    return CTBucketIndex(ct).full_snapshot()
+
+
+def apply_bucket_delta(snapshot, idx, rows, stash=None):
+    """Scatter changed bucket rows (and optionally a new stash) into a
+    device-resident snapshot.  Callers jit this with the snapshot
+    donated so churn updates are in-place row writes, not re-uploads."""
     import jax.numpy as jnp
 
-    w2 = (dport.astype(jnp.uint32) << 16) | sport.astype(jnp.uint32)
-    w3 = (proto.astype(jnp.uint32) << 8) | flags.astype(jnp.uint32)
-    return jnp.stack(
-        [daddr.astype(jnp.uint32), saddr.astype(jnp.uint32), w2, w3],
-        axis=1,
+    buckets = snapshot.buckets.at[idx].set(rows)
+    new_stash = snapshot.stash if stash is None else jnp.asarray(stash)
+    return CTSnapshot(
+        buckets=buckets, stash=new_stash, n_buckets=snapshot.n_buckets
     )
+
+
+def _normalize_device(daddr, saddr, dport, sport):
+    import jax.numpy as jnp
+
+    daddr = daddr.astype(jnp.uint32)
+    saddr = saddr.astype(jnp.uint32)
+    dport = dport.astype(jnp.uint32) & 0xFFFF
+    sport = sport.astype(jnp.uint32) & 0xFFFF
+    swapped = (daddr > saddr) | ((daddr == saddr) & (dport > sport))
+    lo_a = jnp.where(swapped, saddr, daddr)
+    hi_a = jnp.where(swapped, daddr, saddr)
+    lo_p = jnp.where(swapped, sport, dport)
+    hi_p = jnp.where(swapped, dport, sport)
+    return lo_a, hi_a, lo_p, hi_p, swapped
 
 
 def ct_lookup_batch(
@@ -145,7 +331,11 @@ def ct_lookup_batch(
     related_icmp=None,  # bool [B]: ICMP-error tuples (conntrack.h:349)
 ):
     """Returns (result u8 [B]: CT_NEW/ESTABLISHED/REPLY/RELATED,
-    rev_nat u16-as-i32 [B], slave i32 [B])."""
+    rev_nat u16-as-i32 [B], slave i32 [B]).
+
+    ONE bucket row gather: the normalized hash puts the forward and
+    reverse keys in the same bucket, and both direction probes are
+    lane compares against the fetched row."""
     import jax.numpy as jnp
 
     base_flags = jnp.where(
@@ -159,36 +349,92 @@ def ct_lookup_batch(
         base_flags = base_flags | jnp.where(
             jnp.asarray(related_icmp), jnp.uint32(TUPLE_F_RELATED), 0
         ).astype(jnp.uint32)
-
-    # reverse probe: swapped addrs/ports, IN flag flipped
     rev_flags = base_flags ^ jnp.uint32(TUPLE_F_IN)
-    rev_q = _pack_batch(saddr, daddr, sport, dport, proto, rev_flags)
-    fwd_q = _pack_batch(daddr, saddr, dport, sport, proto, base_flags)
 
-    rev_found, rev_idx = lookup_batch(snapshot.table, rev_q)
-    fwd_found, fwd_idx = lookup_batch(snapshot.table, fwd_q)
+    lo_a, hi_a, lo_p, hi_p, swapped = _normalize_device(
+        daddr, saddr, dport, sport
+    )
+    proto_u = proto.astype(jnp.uint32) & 0xFF
+    h = fnv1a_device(
+        jnp.stack([lo_a, hi_a, (lo_p << 16) | hi_p, proto_u], axis=1)
+    )
+    bucket = (h & jnp.uint32(snapshot.n_buckets - 1)).astype(jnp.int32)
 
-    related = jnp.asarray(snapshot.related)
-    rev_related = related[rev_idx].astype(bool) & rev_found
-    fwd_related = related[fwd_idx].astype(bool) & fwd_found
+    rows = jnp.asarray(snapshot.buckets)[bucket]  # [B, 128] — 1 gather
+    n_e = ENTRIES_PER_BUCKET
+    # planar extraction: word k of all entries = one contiguous slice
+    ew = [rows[:, k * n_e : (k + 1) * n_e] for k in range(ENTRY_WORDS)]
+
+    # probe w3 values: the forward key's swapped bit is the flow's
+    # own orientation; the reverse key's is the opposite (unless the
+    # address/port pairs are identical, where both normalize the same)
+    pairs_equal = (daddr.astype(jnp.uint32) == saddr.astype(jnp.uint32)) & (
+        (dport.astype(jnp.uint32) & 0xFFFF)
+        == (sport.astype(jnp.uint32) & 0xFFFF)
+    )
+    fwd_sw = swapped & ~pairs_equal
+    rev_sw = ~swapped & ~pairs_equal
+    w3_fwd = (
+        (proto_u << 8)
+        | (fwd_sw.astype(jnp.uint32) * _SWAPPED_BIT)
+        | base_flags
+    )
+    w3_rev = (
+        (proto_u << 8)
+        | (rev_sw.astype(jnp.uint32) * _SWAPPED_BIT)
+        | rev_flags
+    )
+
+    key_eq = (
+        (ew[0] == lo_a[:, None])
+        & (ew[1] == hi_a[:, None])
+        & (ew[2] == ((lo_p << 16) | hi_p)[:, None])
+    )
+    fwd_hit = key_eq & (ew[3] == w3_fwd[:, None])  # [B, E]
+    rev_hit = key_eq & (ew[3] == w3_rev[:, None])
+
+    # stash: broadcast compare (shape-stable, no gather)
+    stash = jnp.asarray(snapshot.stash)  # [S, 5]
+    s_key_eq = (
+        (stash[None, :, 0] == lo_a[:, None])
+        & (stash[None, :, 1] == hi_a[:, None])
+        & (stash[None, :, 2] == ((lo_p << 16) | hi_p)[:, None])
+    )
+    s_fwd = s_key_eq & (stash[None, :, 3] == w3_fwd[:, None])
+    s_rev = s_key_eq & (stash[None, :, 3] == w3_rev[:, None])
+
+    def _pick_val(hits, s_hits):
+        v = jnp.sum(
+            jnp.where(hits, ew[4], 0), axis=1, dtype=jnp.uint32
+        ) + jnp.sum(
+            jnp.where(s_hits, stash[None, :, 4], 0),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+        return v
+
+    fwd_found = jnp.any(fwd_hit, axis=1) | jnp.any(s_fwd, axis=1)
+    rev_found = jnp.any(rev_hit, axis=1) | jnp.any(s_rev, axis=1)
+    fwd_val = _pick_val(fwd_hit, s_fwd)
+    rev_val = _pick_val(rev_hit, s_rev)
+
+    # the probe itself carried the RELATED bit (exact key equality),
+    # so a hit on a RELATED probe IS a RELATED entry
+    probed_related = (base_flags & jnp.uint32(TUPLE_F_RELATED)) != 0
     result = jnp.where(
         rev_found,
-        jnp.where(rev_related, CT_RELATED, CT_REPLY),
+        jnp.where(probed_related, CT_RELATED, CT_REPLY),
         jnp.where(
             fwd_found,
-            jnp.where(fwd_related, CT_RELATED, CT_ESTABLISHED),
+            jnp.where(probed_related, CT_RELATED, CT_ESTABLISHED),
             CT_NEW,
         ),
     ).astype(jnp.uint8)
 
-    idx = jnp.where(rev_found, rev_idx, fwd_idx)
+    val = jnp.where(rev_found, rev_val, fwd_val)
     hit = rev_found | fwd_found
-    rev_nat = jnp.where(
-        hit, jnp.asarray(snapshot.rev_nat_index)[idx], 0
-    ).astype(jnp.int32)
-    slave = jnp.where(hit, jnp.asarray(snapshot.slave)[idx], 0).astype(
-        jnp.int32
-    )
+    rev_nat = jnp.where(hit, val >> 16, 0).astype(jnp.int32)
+    slave = jnp.where(hit, val & 0xFFFF, 0).astype(jnp.int32)
     return result, rev_nat, slave
 
 

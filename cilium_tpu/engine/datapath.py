@@ -63,14 +63,14 @@ from cilium_tpu.engine.verdict import (
     _accumulate_counters,
     _combine,
     _probes,
-    _verdict_kernel,
     _verdict_kernel_with_counters,
     make_counter_buffers,
+    split_counters,
 )
 from cilium_tpu.identity import RESERVED_WORLD
 from cilium_tpu.ipcache.lpm import LPMTables, _lookup_kernel
 from cilium_tpu.lb.device import LBTables, lb_select_batch
-from cilium_tpu.maps.policymap import INGRESS
+from cilium_tpu.maps.policymap import EGRESS, INGRESS
 
 
 def _register(cls):
@@ -91,7 +91,7 @@ class DatapathTables:
     """Everything the fused step consumes, as one pytree — the set of
     pinned maps a bpf_lxc program sees (lib/maps.h)."""
 
-    prefilter: LPMTables
+    prefilter: object  # PrefilterRanges (broadcast) or LPMTables
     ipcache: LPMTables
     ct: CTSnapshot
     lb: LBTables
@@ -210,39 +210,69 @@ def _datapath_core(
     flows: FlowBatch,
     with_counters: bool,
     acc=None,
+    emit_sec_id: bool = True,
+    static_direction=None,
 ):
-    ingress = flows.direction == INGRESS
+    """The fused per-packet pipeline.  With an idx-form ipcache
+    (specialize_ipcache_to_idx) the identity lookup yields the dense
+    lattice index directly and the id_direct gather disappears; with
+    `emit_sec_id=False` (the streaming accum path) the sec output is
+    that raw index — consumers translate through id_table host-side —
+    saving the id_table gather too.
 
-    # -- 1. XDP prefilter (deny-by-CIDR before everything) ------------------
-    pre_drop = _lookup_kernel(tables.prefilter, flows.saddr) != 0
+    `static_direction` compiles a direction-specialized program, the
+    analog of bpf_lxc's separate from-container/to-container sections:
+    the INGRESS program has no LB/service-CT stages at all (3 fewer
+    gathers), exactly as ingress packets never traverse lb4_local."""
+    if static_direction is None:
+        ingress = flows.direction == INGRESS
+    else:
+        ingress = jnp.full(
+            flows.direction.shape, static_direction == INGRESS
+        )
+
+    # -- 1. XDP prefilter (deny-by-CIDR before everything): small
+    # deny lists are a broadcast compare — zero gathers ------------------
+    from cilium_tpu.prefilter import prefilter_drop
+
+    pre_drop = prefilter_drop(tables.prefilter, flows.saddr)
 
     # -- 2. LB service DNAT (egress; lb4_local, bpf_lxc.c:486) --------------
     # Backend stickiness comes from the CT service-scope entry the
     # reference keeps per (vip, sport) — probe it, then select.
-    svc_dir = jnp.full_like(flows.direction, CT_SERVICE)
-    _, _, svc_slave = ct_lookup_batch(
-        tables.ct,
-        flows.daddr,
-        flows.saddr,
-        flows.dport,
-        flows.sport,
-        flows.proto,
-        svc_dir,
-    )
-    svc_found, slave, lb_daddr, lb_dport, lb_rev = lb_select_batch(
-        tables.lb,
-        flows.saddr,
-        flows.daddr,
-        flows.sport,
-        flows.dport,
-        flows.proto,
-        ct_slave=svc_slave,
-    )
-    do_lb = (~ingress) & svc_found
-    eff_daddr = jnp.where(do_lb, lb_daddr, flows.daddr.astype(jnp.uint32))
-    eff_dport = jnp.where(do_lb, lb_dport, flows.dport)
-    rev_nat = jnp.where(do_lb, lb_rev, 0)
-    lb_slave = jnp.where(do_lb, slave, 0)
+    if static_direction == INGRESS:
+        zero = jnp.zeros(flows.dport.shape, jnp.int32)
+        eff_daddr = flows.daddr.astype(jnp.uint32)
+        eff_dport = flows.dport
+        rev_nat = zero
+        lb_slave = zero
+    else:
+        svc_dir = jnp.full_like(flows.direction, CT_SERVICE)
+        _, _, svc_slave = ct_lookup_batch(
+            tables.ct,
+            flows.daddr,
+            flows.saddr,
+            flows.dport,
+            flows.sport,
+            flows.proto,
+            svc_dir,
+        )
+        svc_found, slave, lb_daddr, lb_dport, lb_rev = lb_select_batch(
+            tables.lb,
+            flows.saddr,
+            flows.daddr,
+            flows.sport,
+            flows.dport,
+            flows.proto,
+            ct_slave=svc_slave,
+        )
+        do_lb = (~ingress) & svc_found
+        eff_daddr = jnp.where(
+            do_lb, lb_daddr, flows.daddr.astype(jnp.uint32)
+        )
+        eff_dport = jnp.where(do_lb, lb_dport, flows.dport)
+        rev_nat = jnp.where(do_lb, lb_rev, 0)
+        lb_slave = jnp.where(do_lb, slave, 0)
 
     # -- 3. conntrack on the effective tuple (ct_lookup4) -------------------
     ct_res, ct_rev, _ = ct_lookup_batch(
@@ -256,38 +286,82 @@ def _datapath_core(
     )
 
     # -- 4. identity derivation (ipcache LPM; WORLD fallback) ---------------
+    from cilium_tpu.ipcache.lpm import (
+        UNKNOWN_IDX,
+        IPCacheDevice,
+        ipcache_lookup_fused,
+    )
+
     sec_ip = jnp.where(
         ingress, flows.saddr.astype(jnp.uint32), eff_daddr
     )
-    looked = _lookup_kernel(tables.ipcache, sec_ip)
-    sec_id = jnp.where(
-        looked == 0, jnp.uint32(RESERVED_WORLD), looked
-    ).astype(jnp.uint32)
+    idx_known = None
+    if (
+        isinstance(tables.ipcache, IPCacheDevice)
+        and tables.ipcache.values_are_idx
+    ):
+        looked, l3_word = ipcache_lookup_fused(
+            tables.ipcache, sec_ip, ingress=ingress
+        )
+        n = tables.policy.id_table.shape[0]
+        miss = looked == 0
+        # UNKNOWN_IDX = ipcache entry whose identity is outside the
+        # policy universe: present (no WORLD fallback) but not-known
+        vp = jnp.where(
+            miss, jnp.uint32(tables.ipcache.world_plus1), looked
+        )
+        known = (vp != 0) & (vp != jnp.uint32(UNKNOWN_IDX))
+        idx = jnp.where(known, vp - 1, jnp.uint32(n - 1)).astype(
+            jnp.int32
+        )
+        if l3_word is not None:
+            # miss → WORLD's l3 bits, selected by direction
+            l3_word = jnp.where(
+                miss,
+                jnp.where(
+                    ingress,
+                    jnp.uint32(tables.ipcache.world_l3_in),
+                    jnp.uint32(tables.ipcache.world_l3_out),
+                ),
+                l3_word,
+            )
+            l3_bit = (
+                (l3_word >> flows.ep_index.astype(jnp.uint32)) & 1
+            ).astype(bool)
+            idx_known = (idx, known, l3_bit)
+        else:
+            idx_known = (idx, known)
+        if emit_sec_id:
+            sec_id = tables.policy.id_table[idx]
+        else:
+            sec_id = idx.astype(jnp.uint32)  # sec_idx form
+        lattice_identity = jnp.zeros_like(looked)  # unused
+    else:
+        looked = _lookup_kernel(tables.ipcache, sec_ip)
+        sec_id = jnp.where(
+            looked == 0, jnp.uint32(RESERVED_WORLD), looked
+        ).astype(jnp.uint32)
+        lattice_identity = sec_id
 
     # -- 5. policy lattice (always evaluated, bpf_lxc.c:959) ----------------
     resolved = TupleBatch(
         ep_index=flows.ep_index,
-        identity=sec_id,
+        identity=lattice_identity,
         dport=eff_dport,
         proto=flows.proto,
         direction=flows.direction,
         is_fragment=flows.is_fragment,
     )
+    probe1, probe2, probe3, proxy, j, idx = _probes(
+        tables.policy, resolved, idx_known=idx_known
+    )
+    v = _combine(probe1, probe2, probe3, proxy, resolved.is_fragment)
     if with_counters:
-        probe1, probe2, probe3, proxy, j, idx = _probes(
-            tables.policy, resolved
+        if acc is None:
+            acc = make_counter_buffers(tables.policy)
+        acc = _accumulate_counters(
+            v, resolved, j, idx, acc, tables.policy.l4_meta.shape[2]
         )
-        v = _combine(
-            probe1, probe2, probe3, proxy, resolved.is_fragment
-        )
-        l4_acc, l3_acc = (
-            acc if acc is not None else make_counter_buffers(tables.policy)
-        )
-        l4_counts, l3_counts = _accumulate_counters(
-            v, resolved, j, idx, l4_acc, l3_acc
-        )
-    else:
-        v = _verdict_kernel(tables.policy, resolved)
 
     # -- 6. combine (bpf_lxc.c:962-985) -------------------------------------
     pol_allow = v.allowed.astype(bool)
@@ -320,7 +394,7 @@ def _datapath_core(
         ct_delete=ct_delete,
     )
     if with_counters:
-        return out, l4_counts, l3_counts
+        return out, acc
     return out
 
 
@@ -336,26 +410,56 @@ def _datapath_kernel_with_counters(
     """Fused step + per-entry packet counters (policy.h:66-68), same
     counter semantics as the lattice-only counters kernel: a counter
     bump per lattice hit, indexed in the published tables' slot and
-    identity axes."""
-    return _datapath_core(tables, flows, with_counters=True)
+    identity axes.  Returns (out, l4_counts, l3_counts)."""
+    out, acc = _datapath_core(tables, flows, with_counters=True)
+    l4_counts, l3_counts = split_counters(acc, tables.policy)
+    return out, l4_counts, l3_counts
 
 
 def _datapath_kernel_accum(
-    tables: DatapathTables, flows: FlowBatch, l4_acc, l3_acc
+    tables: DatapathTables, flows: FlowBatch, acc
 ):
-    """Streaming fused step: counters scatter into CARRIED buffers the
-    caller threads (and jit donates) across batches — no per-batch
-    [E, 2, N] materialization.  This is the headline-path kernel; the
-    agent folds the buffers back into realized map states once per
-    replay (the async kernel-map read of pkg/maps/policymap)."""
+    """Streaming fused step: counters scatter into the CARRIED flat
+    buffer the caller threads (and jit donates) across batches — no
+    per-batch [E, 2, N] materialization and ONE scatter.  This is the
+    headline-path kernel; the agent folds the buffer back into
+    realized map states once per replay (the async kernel-map read of
+    pkg/maps/policymap).  With an idx-form ipcache the sec output is
+    the dense identity INDEX (translate via tables.policy.id_table
+    host-side, as the monitor fold does)."""
     return _datapath_core(
-        tables, flows, with_counters=True, acc=(l4_acc, l3_acc)
+        tables, flows, with_counters=True, acc=acc, emit_sec_id=False
     )
 
 
 datapath_step = jax.jit(_datapath_kernel)
 datapath_step_with_counters = jax.jit(_datapath_kernel_with_counters)
-datapath_step_accum = jax.jit(_datapath_kernel_accum, donate_argnums=(2, 3))
+datapath_step_accum = jax.jit(_datapath_kernel_accum, donate_argnums=(2,))
+
+
+def _accum_dir_kernel(direction):
+    def kernel(tables, flows, acc):
+        return _datapath_core(
+            tables,
+            flows,
+            with_counters=True,
+            acc=acc,
+            emit_sec_id=False,
+            static_direction=direction,
+        )
+
+    return kernel
+
+
+# direction-specialized streaming programs (bpf_lxc's separate
+# ingress/egress sections): callers that split their flow stream per
+# direction — as the kernel datapath inherently does — dispatch these
+datapath_step_accum_ingress = jax.jit(
+    _accum_dir_kernel(INGRESS), donate_argnums=(2,)
+)
+datapath_step_accum_egress = jax.jit(
+    _accum_dir_kernel(EGRESS), donate_argnums=(2,)
+)
 
 
 def _unique_rows(cols: list, sel: np.ndarray) -> np.ndarray:
@@ -370,30 +474,33 @@ def _unique_rows(cols: list, sel: np.ndarray) -> np.ndarray:
     return np.unique(rows, axis=0)
 
 
-def apply_ct_writeback(
-    ct: CTMap, out: DatapathVerdicts, flows: FlowBatch, now: int = 0
+def apply_ct_writeback_host(
+    ct: CTMap,
+    create,
+    delete,
+    daddr,
+    dport,
+    saddr,
+    sport,
+    proto,
+    direction,
+    rev_nat,
+    slave,
+    now: int = 0,
 ) -> tuple:
-    """Host-side CT mutation after a batch: create entries for
-    NEW+allowed flows (ct_create4, bpf_lxc.c:978) and delete
-    ESTABLISHED-but-now-denied entries (ct_delete4, bpf_lxc.c:968).
-    Returns (created, deleted).
+    """Host-side CT mutation after a batch (all inputs host arrays):
+    create entries for NEW+allowed flows (ct_create4, bpf_lxc.c:978)
+    and delete ESTABLISHED-but-now-denied entries (ct_delete4,
+    bpf_lxc.c:968).  Returns (created_keys, deleted_keys) — the key
+    lists feed the incremental device-snapshot delta
+    (ct.device.CTBucketIndex.apply).
 
     Vectorized: flagged rows are deduplicated with one np.unique over
     packed tuple columns, so host dict work is O(unique flows), not
     O(batch) — a 1M-tuple batch over a 64k-flow universe touches the
     dict at most 64k times regardless of batch size."""
-    create = np.asarray(out.ct_create)
-    delete = np.asarray(out.ct_delete)
-    daddr = np.asarray(out.final_daddr)
-    dport = np.asarray(out.final_dport)
-    saddr = np.asarray(flows.saddr)
-    sport = np.asarray(flows.sport)
-    proto = np.asarray(flows.proto)
-    direction = np.asarray(flows.direction)
-    rev_nat = np.asarray(out.rev_nat)
-    slave = np.asarray(out.lb_slave)
-
-    created = deleted = 0
+    created_keys = []
+    deleted_keys = []
     create_cols = [
         daddr, saddr, dport, sport, proto, direction, rev_nat, slave
     ]
@@ -408,7 +515,7 @@ def apply_ct_writeback(
             CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto),
             c_dir, now=now, rev_nat_index=c_rev, slave=c_slave,
         )
-        created += 1
+        created_keys.append(key)
     delete_cols = [daddr, saddr, dport, sport, proto, direction]
     for row in _unique_rows(delete_cols, delete):
         c_daddr, c_saddr, c_dport, c_sport, c_proto, c_dir = (
@@ -417,5 +524,27 @@ def apply_ct_writeback(
         flags = TUPLE_F_OUT if c_dir == CT_INGRESS else TUPLE_F_IN
         key = CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto, flags)
         if ct.entries.pop(key, None) is not None:
-            deleted += 1
-    return created, deleted
+            deleted_keys.append(key)
+    return created_keys, deleted_keys
+
+
+def apply_ct_writeback(
+    ct: CTMap, out: DatapathVerdicts, flows: FlowBatch, now: int = 0
+) -> tuple:
+    """Device-output convenience wrapper over apply_ct_writeback_host;
+    returns (created, deleted) counts."""
+    created_keys, deleted_keys = apply_ct_writeback_host(
+        ct,
+        np.asarray(out.ct_create),
+        np.asarray(out.ct_delete),
+        np.asarray(out.final_daddr),
+        np.asarray(out.final_dport),
+        np.asarray(flows.saddr),
+        np.asarray(flows.sport),
+        np.asarray(flows.proto),
+        np.asarray(flows.direction),
+        np.asarray(out.rev_nat),
+        np.asarray(out.lb_slave),
+        now=now,
+    )
+    return len(created_keys), len(deleted_keys)

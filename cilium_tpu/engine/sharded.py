@@ -53,6 +53,7 @@ def table_specs(batch_axis: str, table_axis: str) -> PolicyTables:
         l4_allow_bits=P(None, None, None, table_axis),
         l3_allow_bits=P(None, None, table_axis),
         generation=P(),
+        l4_combined=P(None, None, None, table_axis),
     )
 
 
@@ -89,9 +90,12 @@ def make_mesh_evaluator(
     )
     def step(tables_l: PolicyTables, batch_l: TupleBatch):
         # Index resolution uses only replicated tables → global values.
-        idx, word, bit, known, j, has_port, proxy, wild = _index(
-            tables_l, batch_l
-        )
+        idx, word, bit, known, j, has_port = _index(tables_l, batch_l)
+        # slot metadata from the replicated l4_meta (the fused
+        # single-chip path reads it from l4_combined instead)
+        meta = tables_l.l4_meta[batch_l.ep_index, batch_l.direction, j]
+        proxy = (meta >> 1).astype(jnp.int32)
+        wild = (meta & 1).astype(bool)
 
         # This shard owns bit-words [off, off + w_local).
         w_local = tables_l.l3_allow_bits.shape[-1]

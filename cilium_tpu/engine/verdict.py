@@ -159,29 +159,65 @@ def _index(tables: PolicyTables, batch: TupleBatch):
     slot16 = tables.port_slot[proto, dport]
     has_port = slot16 != jnp.uint16(NO_SLOT)
     j = jnp.where(has_port, slot16, 0).astype(jnp.int32)
+    return idx, word, bit, known, j, has_port
 
-    # -- slot metadata: proxy_port << 1 | wildcard (1 gather) ---------------
-    meta = tables.l4_meta[batch.ep_index, batch.direction, j]
+
+def _probes(tables: PolicyTables, batch: TupleBatch, idx_known=None):
+    """The three map probes of policy.h:46, vectorized.  Returns
+    (probe1, probe2, probe3, proxy, j, idx).
+
+    With `l4_combined` present (half-word layout: allow bits for 16
+    identities in the high half, slot meta in the low half), the exact
+    probe and the slot metadata are ONE gather; otherwise they are the
+    classic two.  `idx_known=(idx, known[, l3_bit])` supplies a
+    pre-resolved identity index (e.g. from an idx-form ipcache) and
+    skips the id_direct gather; with `l3_bit` (the identity's
+    per-endpoint L3-allow bit, from an l3-plane ipcache) the L3 probe
+    gather disappears too."""
+    l3_bit = None
+    if idx_known is not None:
+        idx, known = idx_known[0], idx_known[1]
+        if len(idx_known) > 2:
+            l3_bit = idx_known[2]
+        word = idx >> 5
+        bit = (idx & 31).astype(jnp.uint32)
+        proto = jnp.clip(batch.proto, 0, 255).astype(jnp.int32)
+        dport = jnp.clip(batch.dport, 0, 65535).astype(jnp.int32)
+        from cilium_tpu.compiler.tables import NO_SLOT
+
+        slot16 = tables.port_slot[proto, dport]
+        has_port = slot16 != jnp.uint16(NO_SLOT)
+        j = jnp.where(has_port, slot16, 0).astype(jnp.int32)
+    else:
+        idx, word, bit, known, j, has_port = _index(tables, batch)
+
+    if tables.l4_combined is not None:
+        # -- probes 1+meta fused: one u32 gather ----------------------------
+        word16 = idx >> 4
+        bit16 = (idx & 15).astype(jnp.uint32)
+        cm = tables.l4_combined[
+            batch.ep_index, batch.direction, j, word16
+        ]
+        exact_bit = ((cm >> (jnp.uint32(16) + bit16)) & 1).astype(bool)
+        meta = cm & jnp.uint32(0xFFFF)
+    else:
+        exact_words = tables.l4_allow_bits[
+            batch.ep_index, batch.direction, j, word
+        ]
+        exact_bit = ((exact_words >> bit) & 1).astype(bool)
+        meta = tables.l4_meta[batch.ep_index, batch.direction, j]
     proxy = (meta >> 1).astype(jnp.int32)
     wild = (meta & 1).astype(bool)
-    return idx, word, bit, known, j, has_port, proxy, wild
-
-
-def _probes(tables: PolicyTables, batch: TupleBatch):
-    """The three map probes of policy.h:46, vectorized.  Returns
-    (probe1, probe2, probe3, proxy, j, idx)."""
-    idx, word, bit, known, j, has_port, proxy, wild = _index(tables, batch)
-
-    # -- probe 1: exact (identity, dport, proto) ----------------------------
-    exact_words = tables.l4_allow_bits[
-        batch.ep_index, batch.direction, j, word
-    ]
-    exact_bit = ((exact_words >> bit) & 1).astype(bool)
     probe1 = known & has_port & exact_bit
 
     # -- probe 2: L3-only (identity, 0, 0) ----------------------------------
-    l3_words = tables.l3_allow_bits[batch.ep_index, batch.direction, word]
-    probe2 = known & ((l3_words >> bit) & 1).astype(bool)
+    if l3_bit is not None:
+        probe2 = known & l3_bit
+    else:
+        l3_words = tables.l3_allow_bits[
+            batch.ep_index, batch.direction, word
+        ]
+        probe2 = known & ((l3_words >> bit) & 1).astype(bool)
 
     # -- probe 3: wildcard (0, dport, proto) --------------------------------
     probe3 = has_port & wild
@@ -225,30 +261,35 @@ def _verdict_kernel(tables: PolicyTables, batch: TupleBatch) -> Verdicts:
     return _combine(probe1, probe2, probe3, proxy, batch.is_fragment)
 
 
-def _accumulate_counters(v, batch, j, idx, l4_acc, l3_acc):
+def _accumulate_counters(v, batch, j, idx, acc, kg: int):
     """Scatter the batch's lattice hits into the carried counter
-    buffers (policy_entry packets, policy.h:66-68).  Callers donate
-    the buffers across batches (XLA updates in place) instead of
+    buffer (policy_entry packets, policy.h:66-68) — ONE scatter: the
+    L4 slot axis and the L3 identity axis share a flat column space
+    ([0, Kg) = L4 slots, [Kg, Kg+N) = L3 identities; a tuple matches
+    at most one entry, policy.h's single matched policy_entry).
+    `kg` is the static slot count (tables.l4_meta.shape[2]).  Callers
+    donate the buffer across batches (XLA updates in place) instead of
     materializing fresh [E, 2, N] tensors per batch."""
     hit_l4 = (v.match_kind == MATCH_L4) | (v.match_kind == MATCH_L4_WILD)
-    l4_acc = l4_acc.at[batch.ep_index, batch.direction, j].add(
-        hit_l4.astype(jnp.uint32)
-    )
-    l3_acc = l3_acc.at[batch.ep_index, batch.direction, idx].add(
-        (v.match_kind == MATCH_L3).astype(jnp.uint32)
-    )
-    return l4_acc, l3_acc
+    hit_l3 = v.match_kind == MATCH_L3
+    col = jnp.where(hit_l4, j, kg + idx)
+    weight = (hit_l4 | hit_l3).astype(jnp.uint32)
+    return acc.at[batch.ep_index, batch.direction, col].add(weight)
 
 
 def make_counter_buffers(tables: PolicyTables):
-    """Zeroed device counter buffers matching `tables`' shapes:
-    (l4 [E, 2, Kg], l3 [E, 2, N]) u32."""
+    """Zeroed device counter buffer [E, 2, Kg + N] u32 — L4 slot
+    columns first, then L3 identity columns (split with
+    split_counters)."""
     e_count, _, k = tables.l4_meta.shape
     n = tables.id_table.shape[0]
-    return (
-        jnp.zeros((e_count, 2, k), jnp.uint32),
-        jnp.zeros((e_count, 2, n), jnp.uint32),
-    )
+    return jnp.zeros((e_count, 2, k + n), jnp.uint32)
+
+
+def split_counters(acc, tables: PolicyTables):
+    """Flat accumulator → (l4 [E, 2, Kg], l3 [E, 2, N]) views."""
+    k = tables.l4_meta.shape[2]
+    return acc[:, :, :k], acc[:, :, k:]
 
 
 def _verdict_kernel_with_counters(tables: PolicyTables, batch: TupleBatch):
@@ -257,10 +298,11 @@ def _verdict_kernel_with_counters(tables: PolicyTables, batch: TupleBatch):
     variants)."""
     probe1, probe2, probe3, proxy, j, idx = _probes(tables, batch)
     v = _combine(probe1, probe2, probe3, proxy, batch.is_fragment)
-    l4_acc, l3_acc = make_counter_buffers(tables)
-    l4_counts, l3_counts = _accumulate_counters(
-        v, batch, j, idx, l4_acc, l3_acc
+    acc = make_counter_buffers(tables)
+    acc = _accumulate_counters(
+        v, batch, j, idx, acc, tables.l4_meta.shape[2]
     )
+    l4_counts, l3_counts = split_counters(acc, tables)
     return v, l4_counts, l3_counts
 
 
@@ -317,6 +359,7 @@ def make_sharded_evaluator(mesh: Optional[jax.sharding.Mesh] = None,
         l4_allow_bits=replicated,
         l3_allow_bits=replicated,
         generation=replicated,
+        l4_combined=replicated,
     )
     batch_shardings = TupleBatch(
         ep_index=batch_sharded,

@@ -127,9 +127,461 @@ def build_lpm(prefix_to_id: Dict[str, int]) -> LPMTables:
     return LPMTables(l1=l1, l2=l2)
 
 
-def _lookup_kernel(tables: LPMTables, ips):
+@dataclass
+class IPCacheDevice:
+    """Bucketized ipcache: the /32 population (endpoints — the bulk of
+    a real ipcache) lives in hash-bucket rows resolved by ONE row
+    gather, and the (few hundred at most) wider prefixes are
+    (base, mask, plen, value) arrays resolved by a broadcast
+    longest-prefix compare with no gathers at all.  This replaces the
+    DIR-24-8 double gather on the fused path; DIR-24-8 remains the
+    fallback for range-heavy tables (build_ipcache chooses).
+
+    Bucket row layout (planar, 64 entries × 2 words): lanes [0, 64)
+    hold entry ips, lanes [64, 128) hold entry values.  Empty lanes
+    hold IP 0xFFFFFFFF (255.255.255.255/32 can't be cached — the
+    reference ipcache never maps the broadcast address)."""
+
+    buckets: np.ndarray  # u32 [Cb, 128]
+    stash: np.ndarray  # u32 [S, 2 or 4] (ip, value[, l3_in, l3_out])
+    range_base: np.ndarray  # u32 [P]
+    range_mask: np.ndarray  # u32 [P]
+    range_plen: np.ndarray  # u32 [P]
+    range_value: np.ndarray  # u32 [P]
+    n_buckets: int
+    # values_are_idx: entry values are (dense policy identity index
+    # + 1) instead of raw identities (specialize_ipcache_to_idx) —
+    # the fused kernel then skips the id_direct gather; world_plus1
+    # is the miss fallback in the same encoding (0 = unknown).
+    values_are_idx: bool = False
+    world_plus1: int = 0
+    # l3_planes: entries also carry per-endpoint L3-only allow
+    # bitmasks (bit e = endpoint e allows this identity at L3, one
+    # u32 per direction; requires E ≤ 32) — the fused kernel then
+    # skips the l3_allow_bits gather entirely.  Bucket layout becomes
+    # 32 entries × 4 planar words: ips [0,32), values [32,64),
+    # l3-ingress [64,96), l3-egress [96,128).
+    l3_planes: bool = False
+    world_l3_in: int = 0
+    world_l3_out: int = 0
+    range_l3_in: "np.ndarray | None" = None
+    range_l3_out: "np.ndarray | None" = None
+
+    def tree_flatten(self):
+        return (
+            (
+                self.buckets,
+                self.stash,
+                self.range_base,
+                self.range_mask,
+                self.range_plen,
+                self.range_value,
+                self.range_l3_in,
+                self.range_l3_out,
+            ),
+            (
+                self.n_buckets,
+                self.values_are_idx,
+                self.world_plus1,
+                self.l3_planes,
+                self.world_l3_in,
+                self.world_l3_out,
+            ),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(
+            *children[:6],
+            n_buckets=aux[0],
+            values_are_idx=aux[1],
+            world_plus1=aux[2],
+            l3_planes=aux[3],
+            world_l3_in=aux[4],
+            world_l3_out=aux[5],
+            range_l3_in=children[6],
+            range_l3_out=children[7],
+        )
+
+
+IP_ENTRIES_PER_BUCKET = 64
+IP_STASH = 128
+MAX_RANGES = 512
+_EMPTY_IP = np.uint32(0xFFFFFFFF)
+# idx-form sentinel: ipcache entry exists but its identity is not in
+# the policy universe — must NOT be treated as a miss (WORLD), the
+# lattice sees it as not-known (real indices are < 2^20, so the
+# sentinel can't collide with idx+1)
+UNKNOWN_IDX = np.uint32(0xFFFFFFFF)
+
+
+def _register_ipcache_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            IPCacheDevice,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: IPCacheDevice.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_ipcache_pytree()
+
+
+def build_ipcache(prefix_to_id: Dict[str, int]):
+    """Lower {ipv4 cidr → identity} to the bucketized device form, or
+    DIR-24-8 when the non-/32 range population exceeds MAX_RANGES."""
+    from cilium_tpu.engine.hashtable import _fnv1a_host
+
+    exact_map: Dict[int, int] = {}
+    range_map: Dict[Tuple[int, int], int] = {}
+    for cidr, num_id in prefix_to_id.items():
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 4:
+            continue  # v6 resolved host-side (module docstring)
+        if num_id >= 1 << 31:
+            raise ValueError(f"identity {num_id} exceeds 31-bit LPM range")
+        base_addr = int(net.network_address)
+        if net.prefixlen == 32:
+            # duplicate spellings of one prefix: build_lpm paints in
+            # (plen, base, id) sort order, so the max id wins — match
+            prev = exact_map.get(base_addr)
+            exact_map[base_addr] = (
+                num_id if prev is None else max(prev, num_id)
+            )
+        else:
+            key = (net.prefixlen, base_addr)
+            prev = range_map.get(key)
+            range_map[key] = (
+                num_id if prev is None else max(prev, num_id)
+            )
+    exact = sorted(exact_map.items())
+    ranges = [
+        (base_addr, int(0xFFFFFFFF << (32 - pl)) & 0xFFFFFFFF
+         if pl else 0, pl, num_id)
+        for (pl, base_addr), num_id in sorted(range_map.items())
+    ]
+    if len(ranges) > MAX_RANGES:
+        return build_lpm(prefix_to_id)
+
+    nb = 16
+    while nb * 16 < max(len(exact), 1):
+        nb *= 2
+    buckets = np.zeros((nb, 128), dtype=np.uint32)
+    buckets[:, :IP_ENTRIES_PER_BUCKET] = _EMPTY_IP
+    stash = np.zeros((IP_STASH, 2), dtype=np.uint32)
+    stash[:, 0] = _EMPTY_IP
+    fill = [0] * nb
+    stash_fill = 0
+    if exact:
+        ips = np.array([ip for ip, _ in exact], dtype=np.uint32)
+        hashes = _fnv1a_host(ips[:, None])
+        for (ip, num_id), h in zip(exact, hashes):
+            b = int(h) & (nb - 1)
+            if fill[b] < IP_ENTRIES_PER_BUCKET:
+                buckets[b, fill[b]] = ip
+                buckets[b, IP_ENTRIES_PER_BUCKET + fill[b]] = num_id
+                fill[b] += 1
+            elif stash_fill < IP_STASH:
+                stash[stash_fill] = (ip, num_id)
+                stash_fill += 1
+            else:
+                raise ValueError("ipcache bucket and stash overflow")
+
+    p = 8
+    while p < len(ranges):
+        p *= 2
+    base = np.ones(p, dtype=np.uint32)  # base 1 & mask 0: unmatchable
+    mask = np.zeros(p, dtype=np.uint32)
+    plen = np.zeros(p, dtype=np.uint32)
+    value = np.zeros(p, dtype=np.uint32)
+    for i, (b_, m_, l_, v_) in enumerate(ranges):
+        base[i], mask[i], plen[i], value[i] = b_, m_, l_ + 1, v_
+    return IPCacheDevice(
+        buckets=buckets,
+        stash=stash,
+        range_base=base,
+        range_mask=mask,
+        range_plen=plen,
+        range_value=value,
+        n_buckets=nb,
+    )
+
+
+def specialize_ipcache_to_idx(
+    dev: IPCacheDevice, policy_tables
+) -> IPCacheDevice:
+    """Map every stored identity value through the policy tables'
+    direct index, producing an idx-form ipcache: the fused datapath
+    then derives the lattice index straight from the IP lookup and
+    skips the id_direct gather (one fewer random gather per tuple).
+    With ≤ 32 endpoints the entries additionally carry per-endpoint
+    L3-only allow bitmasks (one u32 per direction), eliminating the
+    l3_allow_bits gather as well.
+
+    Host-side, vectorized, applied whenever DatapathTables are
+    assembled — so it re-specializes naturally when either table
+    changes.  Identities absent from the universe map to the
+    UNKNOWN_IDX sentinel: the lattice treats them as not-known (NOT
+    as an ipcache miss, which would wrongly promote them to WORLD);
+    the raw-id passthrough the generic form would report for them is
+    dropped (their sec output is the parking index).  A non-device
+    input (the DIR-24-8 fallback for range-heavy tables) is returned
+    unchanged."""
+    if not isinstance(dev, IPCacheDevice):
+        return dev
+    from cilium_tpu.compiler.tables import (
+        LOCAL_ID_BASE,
+        NO_INDEX,
+    )
+    from cilium_tpu.identity import RESERVED_WORLD
+
+    id_direct = np.asarray(policy_tables.id_direct)
+    lo_len = int(policy_tables.id_lo_len)
+    l3_bits = np.asarray(policy_tables.l3_allow_bits)  # [E, 2, W]
+    e_count = l3_bits.shape[0]
+    with_l3 = e_count <= 32
+
+    def to_idx_plus1(vals: np.ndarray) -> np.ndarray:
+        """identity → idx+1; 0 stays 0 (no entry); identities not in
+        the universe become UNKNOWN_IDX (present but unresolvable —
+        distinct from a miss, which falls back to WORLD)."""
+        v = vals.astype(np.int64)
+        pos = np.where(
+            v >= LOCAL_ID_BASE, lo_len + v - LOCAL_ID_BASE, v
+        )
+        ok = (pos >= 0) & (pos < len(id_direct)) & (v > 0)
+        idx = np.full(vals.shape, UNKNOWN_IDX, dtype=np.uint32)
+        idx[v == 0] = 0
+        got = id_direct[np.clip(pos, 0, len(id_direct) - 1)]
+        ok &= got != NO_INDEX
+        idx[ok] = got[ok] + 1
+        return idx
+
+    def l3_words(idx_plus1: np.ndarray):
+        """(l3_in u32, l3_out u32) per entry: bit e set iff endpoint
+        e's L3-only table allows this identity in that direction.
+        Sentinel (unknown) and zero entries get no bits."""
+        idx_plus1 = np.where(
+            idx_plus1 == UNKNOWN_IDX, 0, idx_plus1
+        ).astype(np.uint32)
+        idx = np.maximum(idx_plus1.astype(np.int64), 1) - 1
+        word = idx >> 5
+        bit = (idx & 31).astype(np.uint32)
+        # [E, 2, n] bit per endpoint/direction
+        bits = (l3_bits[:, :, word] >> bit) & 1
+        weights = (np.uint32(1) << np.arange(e_count, dtype=np.uint32))[
+            :, None, None
+        ]
+        packed = (bits.astype(np.uint32) * weights).sum(
+            axis=0, dtype=np.uint32
+        )  # [2, n]
+        known = idx_plus1 > 0
+        return (
+            np.where(known, packed[0], 0).astype(np.uint32),
+            np.where(known, packed[1], 0).astype(np.uint32),
+        )
+
+    # extract live entries from the generic form
+    e = IP_ENTRIES_PER_BUCKET
+    ips = np.concatenate(
+        [dev.buckets[:, :e].reshape(-1), dev.stash[:, 0]]
+    )
+    vals = np.concatenate(
+        [dev.buckets[:, e : 2 * e].reshape(-1), dev.stash[:, 1]]
+    )
+    live = ips != _EMPTY_IP
+    ips, vals = ips[live], to_idx_plus1(vals[live])
+
+    world = int(to_idx_plus1(np.array([RESERVED_WORLD], np.uint32))[0])
+    if world == int(UNKNOWN_IDX):
+        world = 0  # WORLD not in universe: misses resolve to unknown
+    range_value = to_idx_plus1(dev.range_value)
+
+    if not with_l3:
+        # idx-form only, 64 entries × 2 planar words per bucket
+        buckets = np.zeros_like(dev.buckets)
+        buckets[:, :e] = _EMPTY_IP
+        stash = np.zeros_like(dev.stash)
+        stash[:, 0] = _EMPTY_IP
+        nb = dev.n_buckets
+        fill = [0] * nb
+        sfill = 0
+        from cilium_tpu.engine.hashtable import _fnv1a_host
+
+        hs = _fnv1a_host(ips[:, None].astype(np.uint32))
+        for ip, v, h in zip(ips, vals, hs):
+            b = int(h) & (nb - 1)
+            if fill[b] < e:
+                buckets[b, fill[b]] = ip
+                buckets[b, e + fill[b]] = v
+                fill[b] += 1
+            else:
+                stash[sfill] = (ip, v)
+                sfill += 1
+        return IPCacheDevice(
+            buckets=buckets,
+            stash=stash,
+            range_base=dev.range_base,
+            range_mask=dev.range_mask,
+            range_plen=dev.range_plen,
+            range_value=range_value,
+            n_buckets=nb,
+            values_are_idx=True,
+            world_plus1=world,
+        )
+
+    # idx + l3-plane form: 32 entries × 4 planar words per bucket
+    l3i, l3o = l3_words(vals)
+    per = 32
+    nb = 16
+    while nb * 8 < max(len(ips), 1):
+        nb *= 2
+    buckets = np.zeros((nb, 128), dtype=np.uint32)
+    buckets[:, :per] = _EMPTY_IP
+    stash = np.zeros((IP_STASH, 4), dtype=np.uint32)
+    stash[:, 0] = _EMPTY_IP
+    fill = [0] * nb
+    sfill = 0
+    from cilium_tpu.engine.hashtable import _fnv1a_host
+
+    hs = _fnv1a_host(ips[:, None].astype(np.uint32))
+    for ip, v, li, lo, h in zip(ips, vals, l3i, l3o, hs):
+        b = int(h) & (nb - 1)
+        if fill[b] < per:
+            i = fill[b]
+            buckets[b, i] = ip
+            buckets[b, per + i] = v
+            buckets[b, 2 * per + i] = li
+            buckets[b, 3 * per + i] = lo
+            fill[b] += 1
+        elif sfill < IP_STASH:
+            stash[sfill] = (ip, v, li, lo)
+            sfill += 1
+        else:
+            raise ValueError("ipcache bucket and stash overflow")
+    r_l3i, r_l3o = l3_words(range_value)
+    w_l3i, w_l3o = l3_words(np.array([world], np.uint32))
+    return IPCacheDevice(
+        buckets=buckets,
+        stash=stash,
+        range_base=dev.range_base,
+        range_mask=dev.range_mask,
+        range_plen=dev.range_plen,
+        range_value=range_value,
+        n_buckets=nb,
+        values_are_idx=True,
+        world_plus1=world,
+        l3_planes=True,
+        world_l3_in=int(w_l3i[0]),
+        world_l3_out=int(w_l3o[0]),
+        range_l3_in=r_l3i,
+        range_l3_out=r_l3o,
+    )
+
+
+def ipcache_lookup_fused(dev: IPCacheDevice, ips, ingress=None):
+    """Batched ipcache lookup: one bucket row gather + stash/range
+    broadcasts.  Returns (value u32 [B]; 0 = miss, l3_word u32 [B] or
+    None) — l3_word is the per-endpoint L3-allow bitmask selected by
+    direction when the table carries l3 planes (`ingress` required
+    then)."""
     import jax.numpy as jnp
 
+    from cilium_tpu.engine.hashtable import fnv1a_device
+
+    ips = ips.astype(jnp.uint32)
+    h = fnv1a_device(ips[:, None])
+    bucket = (h & jnp.uint32(dev.n_buckets - 1)).astype(jnp.int32)
+    rows = jnp.asarray(dev.buckets)[bucket]  # [B, 128] — 1 gather
+    per = 32 if dev.l3_planes else IP_ENTRIES_PER_BUCKET
+    hit = rows[:, :per] == ips[:, None]  # [B, per]
+    exact_found = jnp.any(hit, axis=1)
+
+    def msum(plane):  # masked extraction of a planar word
+        return jnp.sum(
+            jnp.where(hit, plane, 0), axis=1, dtype=jnp.uint32
+        )
+
+    exact_val = msum(rows[:, per : 2 * per])
+    stash = jnp.asarray(dev.stash)
+    s_hit = stash[None, :, 0] == ips[:, None]
+    exact_found = exact_found | jnp.any(s_hit, axis=1)
+
+    def ssum(col):
+        return jnp.sum(
+            jnp.where(s_hit, stash[None, :, col], 0),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+
+    exact_val = exact_val + ssum(1)
+
+    # ranges: longest matching prefix wins (plen stored +1 so zero
+    # padding never wins); same-length ranges can't overlap, so the
+    # masked value sum at the winning length is exact
+    match = (ips[:, None] & jnp.asarray(dev.range_mask)[None, :]) == (
+        jnp.asarray(dev.range_base)[None, :]
+    )
+    plen = jnp.asarray(dev.range_plen)
+    best = jnp.max(jnp.where(match, plen[None, :], 0), axis=1)  # [B]
+    range_sel = match & (plen[None, :] == best[:, None])
+
+    def rsum(arr):
+        return jnp.sum(
+            jnp.where(range_sel, jnp.asarray(arr)[None, :], 0),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+
+    range_found = best > 0
+    value = jnp.where(
+        exact_found,
+        exact_val,
+        jnp.where(range_found, rsum(dev.range_value), 0),
+    )
+    if not dev.l3_planes:
+        return value, None
+
+    l3_plane = jnp.where(
+        jnp.asarray(ingress)[:, None],
+        rows[:, 2 * per : 3 * per],
+        rows[:, 3 * per : 4 * per],
+    )
+    l3_exact = msum(l3_plane) + jnp.where(
+        jnp.asarray(ingress), ssum(2), ssum(3)
+    )
+    l3_range = jnp.where(
+        jnp.asarray(ingress),
+        rsum(dev.range_l3_in),
+        rsum(dev.range_l3_out),
+    )
+    l3 = jnp.where(
+        exact_found, l3_exact, jnp.where(range_found, l3_range, 0)
+    )
+    return value, l3
+
+
+def _ipcache_device_kernel(dev: IPCacheDevice, ips):
+    import jax.numpy as jnp
+
+    if dev.l3_planes:
+        value, _ = ipcache_lookup_fused(
+            dev, ips, ingress=jnp.ones(ips.shape[0], bool)
+        )
+        return value
+    value, _ = ipcache_lookup_fused(dev, ips)
+    return value
+
+
+def _lookup_kernel(tables, ips):
+    import jax.numpy as jnp
+
+    if isinstance(tables, IPCacheDevice):
+        return _ipcache_device_kernel(tables, ips)
     v1 = tables.l1[(ips >> 8).astype(jnp.int32)]
     is_block = (v1 & BLOCK_FLAG) != 0
     block = jnp.where(is_block, v1 & ~BLOCK_FLAG, 0).astype(jnp.int32)
@@ -183,8 +635,8 @@ class LPMBuilder:
             self.mappings.pop(cidr, None)
         self._dirty = True
 
-    def tables(self) -> LPMTables:
+    def tables(self):
         if self._dirty or self._tables is None:
-            self._tables = build_lpm(self.mappings)
+            self._tables = build_ipcache(self.mappings)
             self._dirty = False
         return self._tables

@@ -1,8 +1,7 @@
 """Device LB: batched service lookup + backend selection + DNAT.
 
 Reproduces the datapath semantics of bpf/lib/lb.h:
-  - lb4_lookup_service (lb.h:604): exact (vip, dport, proto) match —
-    here a device hash-table probe;
+  - lb4_lookup_service (lb.h:604): exact (vip, dport, proto) match;
   - lb4_select_slave (lb.h:158): `slave = (hash % count) + 1` on the
     flow hash (lb.h:185).  The kernel uses skb->hash (kernel jhash);
     we use the same FNV-1a flow hash as the CT table — the invariant
@@ -12,6 +11,23 @@ Reproduces the datapath semantics of bpf/lib/lb.h:
     (lb.h lb4_local path) — pass `ct_slave` from the CT lookup;
   - DNAT: daddr/dport rewritten to the chosen backend; rev_nat_index
     returned for the CT entry.
+
+TPU-first layout (same reasoning as ct/device.py): the service map is
+BUCKETIZED [Cs, 128] u32 rows — one row gather resolves the service —
+and each service's backends live in ONE [128]-lane row of a backend
+row table (a second row gather), with the chosen backend extracted by
+a masked lane sum instead of a per-backend gather.
+
+Service entry packing (4 × u32, 32 entries per bucket), PLANAR within
+the row — lanes [32k, 32k+32) hold word k of entries 0..31, so the
+kernel extracts each word as a contiguous [B, 32] slice (interleaved
+layouts force padded reshapes; see ct/device.py):
+  w0  vip
+  w1  dport << 16 | proto
+  w2  rev_nat << 16 | backend count
+  w3  backend row index
+Backend row (128 × u32): lanes [0, 64) backend ips; lanes [64, 96)
+backend ports packed two per lane (low half = even backend).
 """
 
 from __future__ import annotations
@@ -21,42 +37,35 @@ from typing import Tuple
 
 import numpy as np
 
-from cilium_tpu.engine.hashtable import (
-    HashTable,
-    build_hash_table,
-    fnv1a_device,
-    lookup_batch,
-)
+from cilium_tpu.engine.hashtable import _fnv1a_host, fnv1a_device
 from cilium_tpu.lb.service import ServiceManager
 
 MAX_BACKENDS = 64
+SVC_ENTRY_WORDS = 4
+BUCKET_LANES = 128
+SVC_PER_BUCKET = BUCKET_LANES // SVC_ENTRY_WORDS  # 32
+SVC_STASH = 64
+_EMPTY_W1 = np.uint32(0xFFFFFFFF)  # dport<<16|proto can't be all-ones
 
 
 @dataclass
 class LBTables:
-    """svc hash table over (vip, port<<8|proto) + backend matrix."""
+    """svc bucket rows + stash + backend row table (pytree)."""
 
-    table: HashTable
-    svc_rev_nat: np.ndarray  # u16 [S]
-    svc_count: np.ndarray  # i32 [S] backend count
-    backend_ip: np.ndarray  # u32 [S, MAX_BACKENDS]
-    backend_port: np.ndarray  # u16 [S, MAX_BACKENDS]
+    buckets: np.ndarray  # u32 [Cs, 128]
+    stash: np.ndarray  # u32 [SVC_STASH, 4]
+    backend_rows: np.ndarray  # u32 [S, 128]
+    n_buckets: int
 
     def tree_flatten(self):
         return (
-            (
-                self.table,
-                self.svc_rev_nat,
-                self.svc_count,
-                self.backend_ip,
-                self.backend_port,
-            ),
-            None,
+            (self.buckets, self.stash, self.backend_rows),
+            self.n_buckets,
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(children[0], children[1], children[2], aux)
 
 
 def _register_pytree() -> None:
@@ -77,32 +86,57 @@ _register_pytree()
 
 def compile_lb(mgr: ServiceManager) -> LBTables:
     services = sorted(mgr.by_frontend.values(), key=lambda s: s.id)
-    s = max(len(services), 1)
-    keys = np.zeros((len(services), 2), dtype=np.uint32)
-    rev_nat = np.zeros(s, dtype=np.uint16)
-    count = np.zeros(s, dtype=np.int32)
-    backend_ip = np.zeros((s, MAX_BACKENDS), dtype=np.uint32)
-    backend_port = np.zeros((s, MAX_BACKENDS), dtype=np.uint16)
-    for i, svc in enumerate(services):
+    nb = 16
+    while nb * 8 < max(len(services), 1):
+        nb *= 2
+    buckets = np.zeros((nb, BUCKET_LANES), dtype=np.uint32)
+    buckets[:, SVC_PER_BUCKET : 2 * SVC_PER_BUCKET] = _EMPTY_W1
+    stash = np.zeros((SVC_STASH, SVC_ENTRY_WORDS), dtype=np.uint32)
+    stash[:, 1] = _EMPTY_W1
+    fill = [0] * nb
+    stash_fill = 0
+    backend_rows = np.zeros(
+        (max(len(services), 1), BUCKET_LANES), dtype=np.uint32
+    )
+    for row_idx, svc in enumerate(services):
         if len(svc.backends) > MAX_BACKENDS:
             raise ValueError(
                 f"service {svc.frontend} has more than {MAX_BACKENDS} "
                 f"backends"
             )
-        keys[i, 0] = svc.frontend.ip_u32()
-        keys[i, 1] = (svc.frontend.port << 8) | svc.frontend.protocol
-        rev_nat[i] = svc.id
-        count[i] = len(svc.backends)
+        vip = svc.frontend.ip_u32()
+        w1 = ((svc.frontend.port & 0xFFFF) << 16) | (
+            svc.frontend.protocol & 0xFF
+        )
         for j, backend in enumerate(svc.backends):
-            backend_ip[i, j] = backend.addr.ip_u32()
-            backend_port[i, j] = backend.addr.port
-    table = build_hash_table(keys)
+            backend_rows[row_idx, j] = backend.addr.ip_u32()
+            half = 16 * (j & 1)
+            backend_rows[row_idx, 64 + (j >> 1)] |= np.uint32(
+                (backend.addr.port & 0xFFFF) << half
+            )
+        entry = (
+            vip,
+            w1,
+            ((svc.id & 0xFFFF) << 16) | (len(svc.backends) & 0xFFFF),
+            row_idx,
+        )
+        words = np.array([[vip, w1]], dtype=np.uint32)
+        b = int(_fnv1a_host(words)[0]) & (nb - 1)
+        if fill[b] < SVC_PER_BUCKET:
+            i = fill[b]
+            for k in range(SVC_ENTRY_WORDS):
+                buckets[b, k * SVC_PER_BUCKET + i] = entry[k]
+            fill[b] += 1
+        elif stash_fill < SVC_STASH:
+            stash[stash_fill] = entry
+            stash_fill += 1
+        else:
+            raise ValueError("LB service bucket and stash overflow")
     return LBTables(
-        table=table,
-        svc_rev_nat=rev_nat,
-        svc_count=count,
-        backend_ip=backend_ip,
-        backend_port=backend_port,
+        buckets=buckets,
+        stash=stash,
+        backend_rows=backend_rows,
+        n_buckets=nb,
     )
 
 
@@ -133,22 +167,47 @@ def lb_select_batch(
 ):
     """Returns (is_service bool [B], slave i32 [B], new_daddr u32 [B],
     new_dport i32 [B], rev_nat i32 [B]).  Non-service flows pass
-    through with their original daddr/dport and rev_nat 0."""
+    through with their original daddr/dport and rev_nat 0.
+
+    One bucket row gather resolves the service; one backend row gather
+    plus a masked lane sum picks the chosen backend."""
     import jax.numpy as jnp
 
-    query = jnp.stack(
-        [
-            daddr.astype(jnp.uint32),
-            (dport.astype(jnp.uint32) << 8) | proto.astype(jnp.uint32),
-        ],
-        axis=1,
+    vip = daddr.astype(jnp.uint32)
+    w1 = ((dport.astype(jnp.uint32) & 0xFFFF) << 16) | (
+        proto.astype(jnp.uint32) & 0xFF
     )
-    found, svc_idx = lookup_batch(tables.table, query)
-    count = jnp.asarray(tables.svc_count)[svc_idx]
+    h = fnv1a_device(jnp.stack([vip, w1], axis=1))
+    bucket = (h & jnp.uint32(tables.n_buckets - 1)).astype(jnp.int32)
+    rows = jnp.asarray(tables.buckets)[bucket]  # [B, 128] — 1 gather
+    p = SVC_PER_BUCKET
+    # planar extraction: word k of all entries = one contiguous slice
+    ent = [rows[:, k * p : (k + 1) * p] for k in range(SVC_ENTRY_WORDS)]
+    hit = (ent[0] == vip[:, None]) & (ent[1] == w1[:, None])
+
+    stash = jnp.asarray(tables.stash)
+    s_hit = (stash[None, :, 0] == vip[:, None]) & (
+        stash[None, :, 1] == w1[:, None]
+    )
+
+    def _pick(col):
+        return jnp.sum(
+            jnp.where(hit, ent[col], 0), axis=1, dtype=jnp.uint32
+        ) + jnp.sum(
+            jnp.where(s_hit, stash[None, :, col], 0),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+
+    found = jnp.any(hit, axis=1) | jnp.any(s_hit, axis=1)
+    meta = _pick(2)
+    base = _pick(3).astype(jnp.int32)
+    count = (meta & 0xFFFF).astype(jnp.int32)
+    rev_nat = (meta >> 16).astype(jnp.int32)
     found = found & (count > 0)
 
-    h = flow_hash(saddr, daddr, sport, dport, proto)
-    slave = (h % jnp.maximum(count, 1).astype(jnp.uint32)).astype(
+    fh = flow_hash(saddr, daddr, sport, dport, proto)
+    slave = (fh % jnp.maximum(count, 1).astype(jnp.uint32)).astype(
         jnp.int32
     ) + 1
     if ct_slave is not None:
@@ -156,12 +215,30 @@ def lb_select_batch(
         reuse = (ct_slave > 0) & (ct_slave <= count)
         slave = jnp.where(reuse, ct_slave, slave)
 
-    backend = jnp.clip(slave - 1, 0, MAX_BACKENDS - 1)
-    new_daddr = jnp.asarray(tables.backend_ip)[svc_idx, backend]
-    new_dport = jnp.asarray(tables.backend_port)[svc_idx, backend].astype(
-        jnp.int32
+    row_idx = jnp.clip(base, 0, tables.backend_rows.shape[0] - 1)
+    brow = jnp.asarray(tables.backend_rows)[row_idx]  # [B,128] — 1 gather
+    k = (slave - 1).astype(jnp.int32)
+    lane = jnp.arange(MAX_BACKENDS, dtype=jnp.int32)
+    ip_mask = lane[None, :] == k[:, None]
+    new_daddr = jnp.sum(
+        jnp.where(ip_mask, brow[:, :MAX_BACKENDS], 0),
+        axis=1,
+        dtype=jnp.uint32,
     )
-    rev_nat = jnp.asarray(tables.svc_rev_nat)[svc_idx].astype(jnp.int32)
+    plane = jnp.arange(MAX_BACKENDS // 2, dtype=jnp.int32)
+    port_mask = plane[None, :] == (k >> 1)[:, None]
+    port_pair = jnp.sum(
+        jnp.where(
+            port_mask,
+            brow[:, MAX_BACKENDS : MAX_BACKENDS + MAX_BACKENDS // 2],
+            0,
+        ),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    new_dport = (
+        (port_pair >> (16 * (k & 1)).astype(jnp.uint32)) & 0xFFFF
+    ).astype(jnp.int32)
 
     new_daddr = jnp.where(found, new_daddr, daddr.astype(jnp.uint32))
     new_dport = jnp.where(found, new_dport, dport.astype(jnp.int32))

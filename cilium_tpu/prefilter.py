@@ -3,20 +3,110 @@
 Port of /root/reference/pkg/policy/prefilter.go (+ daemon/prefilter.go,
 bpf/bpf_xdp.c): a deny-by-CIDR stage that drops flows BEFORE the
 policy engine runs — the reference compiles CIDR4_*_MAPs consulted by
-XDP; here the prefix set lowers onto the same DIR-24-8 structure and
-the engine applies the drop mask ahead of the verdict lattice.
-"""
+XDP.
+
+TPU-first lowering: deny lists are usually SMALL, and a random gather
+costs ~7 ns/query on v5e while an [B, P] broadcast compare is nearly
+free — so up to MAX_BROADCAST prefixes compile to (base, mask) range
+arrays checked with one vectorized compare (zero gathers in the fused
+step).  Larger sets fall back to the DIR-24-8 structure shared with
+the ipcache (two gathers)."""
 
 from __future__ import annotations
 
+import ipaddress
 import threading
-from typing import List, Set, Tuple
+from dataclasses import dataclass
+from typing import List, Set, Tuple, Union
+
+import numpy as np
 
 from cilium_tpu.ipcache.lpm import LPMTables, build_lpm
 
 # marker identity for "listed in the prefilter" (any nonzero works:
 # lpm misses resolve to 0)
 _LISTED = 1
+
+MAX_BROADCAST = 128
+
+
+@dataclass
+class PrefilterRanges:
+    """Broadcast-compare prefilter: drop iff any (ip & mask) == base.
+    Arrays padded to a pow2 ≤ MAX_BROADCAST (padding rows have
+    mask == 0, base == 1 — unmatchable)."""
+
+    base: np.ndarray  # u32 [P]
+    mask: np.ndarray  # u32 [P]
+
+    def tree_flatten(self):
+        return ((self.base, self.mask), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            PrefilterRanges,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: PrefilterRanges.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+def build_prefilter(
+    cidrs,
+) -> "Union[PrefilterRanges, LPMTables]":
+    """Lower a prefilter CIDR set (iterable of v4 cidr strings, or a
+    {cidr: marker} dict) to the broadcast form when small, DIR-24-8
+    otherwise."""
+    cidr_list = sorted(cidrs)
+    v4 = []
+    for c in cidr_list:
+        net = ipaddress.ip_network(c, strict=False)
+        if net.version != 4:
+            continue
+        v4.append(
+            (int(net.network_address), int(net.netmask))
+        )
+    if len(v4) > MAX_BROADCAST:
+        return build_lpm({c: _LISTED for c in cidr_list})
+    p = 8
+    while p < len(v4):
+        p *= 2
+    base = np.ones(p, dtype=np.uint32)  # base 1 & mask 0 never matches
+    mask = np.zeros(p, dtype=np.uint32)
+    for i, (b, m) in enumerate(v4):
+        base[i] = b
+        mask[i] = m
+    return PrefilterRanges(base=base, mask=mask)
+
+
+def prefilter_drop(tables, src_ips):
+    """bool [B]: True = drop before policy (XDP_DROP).  Dispatches on
+    the compiled form (the form is static pytree structure, so each
+    jit cache entry sees exactly one branch)."""
+    import jax.numpy as jnp
+
+    if isinstance(tables, PrefilterRanges):
+        ips = src_ips.astype(jnp.uint32)
+        return jnp.any(
+            (ips[:, None] & jnp.asarray(tables.mask)[None, :])
+            == jnp.asarray(tables.base)[None, :],
+            axis=1,
+        )
+    from cilium_tpu.ipcache.lpm import _lookup_kernel
+
+    return _lookup_kernel(tables, src_ips) != 0
 
 
 class PreFilter:
@@ -27,7 +117,7 @@ class PreFilter:
         self._lock = threading.Lock()
         self._cidrs: Set[str] = set()
         self._revision = 0
-        self._tables: Tuple[int, LPMTables] = (0, build_lpm({}))
+        self._tables = (0, build_prefilter(set()))
 
     def insert(self, cidrs: List[str]) -> int:
         with self._lock:
@@ -46,17 +136,15 @@ class PreFilter:
         with self._lock:
             return sorted(self._cidrs)
 
-    def tables(self) -> LPMTables:
+    def tables(self):
         with self._lock:
             version, tables = self._tables
             if version != self._revision:
-                tables = build_lpm({c: _LISTED for c in self._cidrs})
+                tables = build_prefilter(self._cidrs)
                 self._tables = (self._revision, tables)
             return tables
 
 
-def prefilter_batch(tables: LPMTables, src_ips):
+def prefilter_batch(tables, src_ips):
     """bool [B]: True = drop before policy (XDP_DROP)."""
-    from cilium_tpu.ipcache.lpm import _lookup_kernel
-
-    return _lookup_kernel(tables, src_ips) != 0
+    return prefilter_drop(tables, src_ips)

@@ -35,6 +35,14 @@ from cilium_tpu.native import decode_flow_records
 # to a cell), leaving 2× headroom below the u32 wrap
 _COUNTER_FOLD_MAX_INCR = 1 << 31
 
+# churn-mode intent compaction capacity: create/delete intents per
+# batch round that travel device→host (the transport is
+# latency/bandwidth constrained, so only deduped flagged rows move)
+_CT_INTENT_CAP = 1 << 16
+# claim-table slots for the on-device intent dedup (scatter-min);
+# larger = fewer convergence re-runs from slot collisions
+_CT_CLAIM_SLOTS = 1 << 19
+
 
 @dataclass
 class ReplayStats:
@@ -164,15 +172,22 @@ def replay(
     import time
 
     import jax
+    import jax.numpy as jnp
 
-    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.ct.device import (
+        CTBucketIndex,
+        apply_bucket_delta,
+    )
     from cilium_tpu.engine.datapath import (
         DatapathTables,
-        apply_ct_writeback,
+        apply_ct_writeback_host,
         datapath_step,
         datapath_step_accum,
     )
-    from cilium_tpu.engine.verdict import make_counter_buffers
+    from cilium_tpu.engine.verdict import (
+        make_counter_buffers,
+        split_counters,
+    )
 
     if manager is not None:
         # stale-table guard at the layer that actually reads the
@@ -182,59 +197,226 @@ def replay(
         manager.check_tables_current(tables.policy)
 
     stats = ReplayStats()
-    # counters scatter into carried u32 device buffers, donated
+    # pin every table on device once — jitted steps re-upload host
+    # numpy leaves on EVERY call otherwise (268 MB of policy tables
+    # per batch at config5 scale)
+    tables = jax.device_put(tables)
+    # counters scatter into a carried u32 device buffer, donated
     # across batches — one D2H fold per _COUNTER_FOLD_BATCHES into
     # host u64 sums (a cell can gain ≤ batch_size per batch, so u32
     # can't wrap within a fold interval), instead of [E, 2, N]
     # tensors per batch
-    l4_acc = l3_acc = None
-    l4_total = l3_total = None
+    acc = None
+    acc_total = None
     batches_since_fold = 0
     fold_every = max(1, _COUNTER_FOLD_MAX_INCR // max(batch_size, 1))
     if accumulate_counters:
-        l4_acc, l3_acc = jax.device_put(
-            make_counter_buffers(tables.policy)
-        )
+        acc = jax.device_put(make_counter_buffers(tables.policy))
 
     def _fold_counters():
-        nonlocal l4_acc, l3_acc, l4_total, l3_total, batches_since_fold
-        l4_host = np.asarray(l4_acc).astype(np.uint64)
-        l3_host = np.asarray(l3_acc).astype(np.uint64)
-        l4_total = l4_host if l4_total is None else l4_total + l4_host
-        l3_total = l3_host if l3_total is None else l3_total + l3_host
-        l4_acc, l3_acc = jax.device_put(
-            make_counter_buffers(tables.policy)
-        )
+        nonlocal acc, acc_total, batches_since_fold
+        host = np.asarray(acc).astype(np.uint64)
+        acc_total = host if acc_total is None else acc_total + host
+        acc = jax.device_put(make_counter_buffers(tables.policy))
         batches_since_fold = 0
+
+    ct_index = None
+    if ct_map is not None:
+        # incremental churn machinery: a host mirror of the device
+        # bucket layout (built once), a donated device snapshot, and
+        # one packed D2H per batch.  The kernel owns the map, the
+        # agent folds writes back — with per-bucket row updates
+        # instead of full-snapshot rebuilds (bpf/lib/conntrack.h's
+        # map writes are per-bucket too).
+        ct_index = CTBucketIndex(ct_map)
+        dev_snap = jax.device_put(ct_index.full_snapshot())
+        tables = DatapathTables(
+            prefilter=tables.prefilter,
+            ipcache=tables.ipcache,
+            ct=dev_snap,
+            lb=tables.lb,
+            policy=tables.policy,
+        )
+        _delta_jit = jax.jit(apply_bucket_delta, donate_argnums=(0,))
+        # device-side intent compaction: host↔device transfers through
+        # the runtime cost ~100 ms latency + low bandwidth, so only
+        # the create/delete-flagged rows travel (fixed capacity; the
+        # overflow count rides along in the header row).  Layout:
+        # [11, cap] u32, transferred flat — rows 0-9 intent columns,
+        # row 10 header (count, allowed, redirected, remaining at
+        # cols 0-3)
+        cap = _CT_INTENT_CAP
+        claim_m = _CT_CLAIM_SLOTS
+
+        def _compact(out, flows, valid):
+            """Dedup + compact the batch's create/delete intents on
+            device: a scatter-min claim table keeps the FIRST flagged
+            row per flow-hash slot (distinct flows sharing a slot lose
+            the round and surface in the header's `remaining`, which
+            drives a convergence re-run), so the D2H transfer is
+            O(unique intents), never O(batch)."""
+            from cilium_tpu.engine.hashtable import fnv1a_device
+
+            b = out.ct_create.shape[0]
+            flag = (
+                out.ct_create.astype(bool) | out.ct_delete.astype(bool)
+            )
+            in_valid = jnp.arange(b, dtype=jnp.int32) < valid
+            flag = flag & in_valid
+
+            h = fnv1a_device(
+                jnp.stack(
+                    [
+                        out.final_daddr.astype(jnp.uint32),
+                        flows.saddr.astype(jnp.uint32),
+                        (
+                            out.final_dport.astype(jnp.uint32) << 16
+                        )
+                        | (flows.sport.astype(jnp.uint32) & 0xFFFF),
+                        (flows.proto.astype(jnp.uint32) << 8)
+                        | flows.direction.astype(jnp.uint32),
+                    ],
+                    axis=1,
+                )
+            )
+            slot = (h & jnp.uint32(claim_m - 1)).astype(jnp.int32)
+            row_id = jnp.arange(b, dtype=jnp.int32)
+            claim = jnp.full(claim_m, b, jnp.int32).at[slot].min(
+                jnp.where(flag, row_id, b)
+            )
+            winner_row = claim[slot]
+            winner = flag & (winner_row == row_id)
+            # losers whose full hash equals their slot winner's are
+            # (almost surely) later packets of the SAME flow — the
+            # winner's create covers them, no convergence re-run
+            # needed.  A 32-bit-hash collision between distinct flows
+            # defers that flow's create to its next appearance in the
+            # stream, the same race the per-packet kernel datapath
+            # has (conntrack.h ct_create4 is best-effort too).
+            wr = jnp.clip(winner_row, 0, b - 1)
+            true_loser = flag & ~winner & (h[wr] != h)
+
+            # compaction via argsort, NOT scatter: a scatter routing
+            # millions of non-winner rows at one trash index is
+            # pathologically slow on TPU (duplicate-index collision
+            # handling); sorting 'winner-first' and slicing the head
+            # is a single O(B log B) sort plus tiny gathers
+            take = min(cap, b)
+            order = jnp.argsort(
+                jnp.where(winner, row_id, jnp.int32(b))
+            )[:take]
+            keep = winner[order]  # mask off the tail when < cap win
+            cols = jnp.stack(
+                [
+                    out.ct_create.astype(jnp.uint32),
+                    out.ct_delete.astype(jnp.uint32),
+                    out.final_daddr.astype(jnp.uint32),
+                    out.final_dport.astype(jnp.uint32),
+                    flows.saddr.astype(jnp.uint32),
+                    flows.sport.astype(jnp.uint32),
+                    flows.proto.astype(jnp.uint32),
+                    flows.direction.astype(jnp.uint32),
+                    out.rev_nat.astype(jnp.uint32),
+                    out.lb_slave.astype(jnp.uint32),
+                ]
+            )  # [10, B]
+            buf = jnp.zeros((11, cap), jnp.uint32)
+            buf = buf.at[:10, :take].set(
+                jnp.where(keep[None, :], cols[:, order], 0)
+            )
+            n_tx = jnp.minimum(
+                winner.sum(dtype=jnp.uint32), jnp.uint32(take)
+            )
+            allowed = jnp.sum(
+                out.allowed.astype(jnp.uint32) * in_valid,
+                dtype=jnp.uint32,
+            )
+            redirected = jnp.sum(
+                (out.proxy_port > 0) & in_valid, dtype=jnp.uint32
+            )
+            overflow = winner.sum(dtype=jnp.uint32) - n_tx
+            remaining = true_loser.sum(dtype=jnp.uint32) + overflow
+            buf = buf.at[10, :4].set(
+                jnp.stack([n_tx, allowed, redirected, remaining])
+            )
+            return buf.reshape(-1)  # flat: fastest D2H layout
+
+        _compact_jit = jax.jit(_compact)
 
     pending = []  # pipelined dispatch, bounded depth
     t0 = time.perf_counter()
     for flows, valid in read_flow_batches(buf, batch_size, ep_map):
-        if accumulate_counters:
-            out, l4_acc, l3_acc = datapath_step_accum(
-                tables, flows, l4_acc, l3_acc
+        if ct_map is not None:
+            tables = DatapathTables(
+                prefilter=tables.prefilter,
+                ipcache=tables.ipcache,
+                ct=dev_snap,
+                lb=tables.lb,
+                policy=tables.policy,
             )
+        if accumulate_counters:
+            out, acc = datapath_step_accum(tables, flows, acc)
             batches_since_fold += 1
             if batches_since_fold >= fold_every:
                 _fold_counters()
         else:
             out = datapath_step(tables, flows)
         if ct_map is not None:
-            # sustained churn: drain in order, fold intents back, and
-            # refresh the snapshot the next batch probes
-            _drain_fused((out, valid), stats)
-            created, deleted = apply_ct_writeback(ct_map, out, flows)
-            stats.ct_created += created
-            stats.ct_deleted += deleted
-            stats.batches += 1
-            if created or deleted:
+            # sustained churn: drain in order via ONE compacted,
+            # deduped D2H; fold intents back on host; scatter the
+            # changed bucket rows into the donated device snapshot.
+            # Claim-table losers (distinct flows sharing a dedup
+            # slot, or >cap unique intents) drive convergence
+            # re-runs of the same batch against the updated
+            # snapshot, so the next batch sees every flow this one
+            # created (up to the documented 32-bit-hash-collision
+            # deferral in _compact).
+            first_pass = True
+            while True:
+                packed = np.asarray(
+                    _compact_jit(out, flows, valid)
+                ).reshape(11, cap)
+                if first_pass:
+                    stats.total += int(valid)
+                    allowed = int(packed[10, 1])
+                    stats.allowed += allowed
+                    stats.denied += int(valid) - allowed
+                    stats.redirected += int(packed[10, 2])
+                    stats.batches += 1
+                    first_pass = False
+                k = int(packed[10, 0])
+                remaining = int(packed[10, 3])
+                created_keys, deleted_keys = apply_ct_writeback_host(
+                    ct_map,
+                    packed[0, :k].astype(bool),
+                    packed[1, :k].astype(bool),
+                    *(packed[j, :k] for j in range(2, 10)),
+                )
+                stats.ct_created += len(created_keys)
+                stats.ct_deleted += len(deleted_keys)
+                if created_keys or deleted_keys:
+                    idx, rows, new_stash = ct_index.apply(
+                        created_keys, deleted_keys
+                    )
+                    if len(idx) or new_stash is not None:
+                        dev_snap = _delta_jit(
+                            dev_snap,
+                            idx,
+                            rows,
+                            new_stash,
+                        )
+                if remaining == 0:
+                    break
+                # convergence pass: re-evaluate against the updated
+                # snapshot (no counter re-accumulation)
                 tables = DatapathTables(
                     prefilter=tables.prefilter,
                     ipcache=tables.ipcache,
-                    ct=compile_ct(ct_map),
+                    ct=dev_snap,
                     lb=tables.lb,
                     policy=tables.policy,
                 )
+                out = datapath_step(tables, flows)
             continue
         pending.append((out, valid))
         stats.batches += 1
@@ -247,7 +429,8 @@ def replay(
     if not accumulate_counters:
         return stats, None, None
     _fold_counters()
-    return stats, l4_total, l3_total
+    kg = tables.policy.l4_meta.shape[2]
+    return stats, acc_total[:, :, :kg], acc_total[:, :, kg:]
 
 
 def replay_lattice(

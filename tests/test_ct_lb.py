@@ -356,7 +356,6 @@ def test_ct_snapshot_shapes_churn_invariant():
             CT_INGRESS,
         )
     s1, s2 = compile_ct(ct1), compile_ct(ct2)
-    assert s1.table.keys.shape == s2.table.keys.shape
-    assert s1.table.value_index.shape == s2.table.value_index.shape
-    assert s1.rev_nat_index.shape == s2.rev_nat_index.shape
-    assert s1.table.max_probes == s2.table.max_probes
+    assert s1.buckets.shape == s2.buckets.shape
+    assert s1.stash.shape == s2.stash.shape
+    assert s1.n_buckets == s2.n_buckets

@@ -35,7 +35,8 @@ from cilium_tpu.engine.datapath import (
 from cilium_tpu.engine.hashtable import _fnv1a_host
 from cilium_tpu.engine.oracle import evaluate_batch_oracle
 from cilium_tpu.identity import RESERVED_WORLD
-from cilium_tpu.ipcache.lpm import build_lpm, lookup_host
+from cilium_tpu.ipcache.lpm import build_ipcache, build_lpm, lookup_host
+from cilium_tpu.prefilter import build_prefilter
 from cilium_tpu.lb.device import compile_lb
 from cilium_tpu.lb.service import L3n4Addr, ServiceManager
 from cilium_tpu.maps.policymap import EGRESS, INGRESS
@@ -192,8 +193,8 @@ def _build_world(seed):
     )
 
     tables = DatapathTables(
-        prefilter=build_lpm(prefilter_map),
-        ipcache=build_lpm(ipcache_map),
+        prefilter=build_prefilter(prefilter_map),
+        ipcache=build_ipcache(ipcache_map),
         ct=compile_ct(ct),
         lb=compile_lb(mgr),
         policy=policy,
@@ -316,3 +317,83 @@ def test_prefilter_blocks_before_everything():
     assert not bool(np.asarray(out.allowed)[0])
     assert bool(np.asarray(out.pre_dropped)[0])
     assert not bool(np.asarray(out.ct_create)[0])
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_idx_form_ipcache_matches_generic(seed):
+    """specialize_ipcache_to_idx must leave every datapath output
+    bit-identical (all _build_world ipcache identities are in the
+    universe, so sec_id round-trips through id_table)."""
+    from cilium_tpu.ipcache.lpm import specialize_ipcache_to_idx
+
+    (rng, pf, ipc, ct, mgr, states, tables, n_eps) = _build_world(seed)
+    spec = DatapathTables(
+        prefilter=tables.prefilter,
+        ipcache=specialize_ipcache_to_idx(tables.ipcache, tables.policy),
+        ct=tables.ct,
+        lb=tables.lb,
+        policy=tables.policy,
+    )
+    f = _random_flows(rng, 512, n_eps)
+    flows = FlowBatch.from_numpy(**f)
+    a = datapath_step(tables, flows)
+    b = datapath_step(spec, flows)
+    for field in (
+        "allowed", "proxy_port", "match_kind", "ct_result",
+        "pre_dropped", "sec_id", "final_daddr", "final_dport",
+        "rev_nat", "lb_slave", "ct_create", "ct_delete",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)),
+            np.asarray(getattr(b, field)),
+            err_msg=field,
+        )
+
+
+@pytest.mark.parametrize("direction", [0, 1])
+def test_direction_specialized_kernels_match_generic(direction):
+    """The per-direction streaming programs (bpf_lxc's separate
+    ingress/egress sections) must agree with the generic kernel on
+    single-direction batches, counters included."""
+    import jax
+
+    from cilium_tpu.engine.datapath import (
+        datapath_step_accum,
+        datapath_step_accum_egress,
+        datapath_step_accum_ingress,
+    )
+    from cilium_tpu.engine.verdict import make_counter_buffers
+    from cilium_tpu.ipcache.lpm import specialize_ipcache_to_idx
+
+    (rng, pf, ipc, ct, mgr, states, tables, n_eps) = _build_world(4)
+    tables = DatapathTables(
+        prefilter=tables.prefilter,
+        ipcache=specialize_ipcache_to_idx(tables.ipcache, tables.policy),
+        ct=tables.ct,
+        lb=tables.lb,
+        policy=tables.policy,
+    )
+    f = _random_flows(rng, 512, n_eps)
+    f["direction"] = np.full(512, direction)
+    flows = FlowBatch.from_numpy(**f)
+
+    acc_a = jax.device_put(make_counter_buffers(tables.policy))
+    a, acc_a = datapath_step_accum(tables, flows, acc_a)
+    acc_b = jax.device_put(make_counter_buffers(tables.policy))
+    fn = (
+        datapath_step_accum_ingress
+        if direction == 0
+        else datapath_step_accum_egress
+    )
+    b, acc_b = fn(tables, flows, acc_b)
+    for field in (
+        "allowed", "proxy_port", "match_kind", "ct_result",
+        "pre_dropped", "sec_id", "final_daddr", "final_dport",
+        "rev_nat", "lb_slave", "ct_create", "ct_delete",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)),
+            np.asarray(getattr(b, field)),
+            err_msg=field,
+        )
+    np.testing.assert_array_equal(np.asarray(acc_a), np.asarray(acc_b))
