@@ -531,9 +531,20 @@ def run_config5(args) -> None:
     seed_batch = min(args.batch, 1 << 20)
     picks = rng.integers(0, args.pool, size=2 * seed_batch)
     seed_buf = encode_pool_sample(pool, picks)
-    t0 = time.perf_counter()
     seed_stats, _, _ = replay(
         tables, seed_buf, batch_size=seed_batch, ct_map=ct,
+        accumulate_counters=False,
+    )
+    # sustained-churn metric: a SECOND pass at the same batch shape —
+    # the seed pass paid the jit compiles and created most of the
+    # pool's flows, so this measures the steady-state loop (dispatch
+    # + compacted intent D2H + per-bucket delta) the way a running
+    # agent experiences it
+    picks = rng.integers(0, args.pool, size=4 * seed_batch)
+    churn_buf = encode_pool_sample(pool, picks)
+    t0 = time.perf_counter()
+    churn_stats, _, _ = replay(
+        tables, churn_buf, batch_size=seed_batch, ct_map=ct,
         accumulate_counters=False,
     )
     churn_s = time.perf_counter() - t0
@@ -546,12 +557,12 @@ def run_config5(args) -> None:
     )
     emit(
         "ct_churn_tuples_per_sec",
-        round(seed_stats.total / churn_s),
+        round(churn_stats.total / churn_s),
         "tuples/s",
-        ct_created=seed_stats.ct_created,
+        ct_created=seed_stats.ct_created + churn_stats.ct_created,
         note=(
-            "fused replay, incremental device CT: compacted intent "
-            "D2H + per-bucket row deltas"
+            "sustained fused replay, incremental device CT: "
+            "compacted intent D2H + per-bucket row deltas"
         ),
     )
 

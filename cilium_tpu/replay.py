@@ -37,7 +37,8 @@ _COUNTER_FOLD_MAX_INCR = 1 << 31
 
 # churn-mode intent compaction capacity: create/delete intents per
 # batch round that travel device→host (the transport is
-# latency/bandwidth constrained, so only deduped flagged rows move)
+# latency/bandwidth constrained, so only deduped flagged rows move;
+# burstier rounds spill into extra convergence passes)
 _CT_INTENT_CAP = 1 << 16
 # claim-table slots for the on-device intent dedup (scatter-min);
 # larger = fewer convergence re-runs from slot collisions
