@@ -121,10 +121,43 @@ class Daemon:
 
         self.state_dir = state_dir
         if state_dir:
+            from cilium_tpu.ipcache.ipcache import (
+                FROM_AGENT_LOCAL,
+                IPIdentity,
+            )
+            from cilium_tpu.kvstore.ipsync import upsert_ip_mapping
+
             for endpoint in restore_endpoints(
                 state_dir, self.identity_allocator
             ):
                 self.endpoint_manager.insert(endpoint)
+                # republish the endpoint's IP mapping — the reference
+                # restores the ipcache from the pinned BPF map on
+                # restart (daemon restoreOldEndpoints + ipcache
+                # restore); without this, restored endpoints' traffic
+                # would resolve to WORLD
+                if (
+                    endpoint.ipv4
+                    and endpoint.security_identity is not None
+                ):
+                    self.ipcache.upsert(
+                        endpoint.ipv4,
+                        IPIdentity(
+                            endpoint.security_identity.id,
+                            FROM_AGENT_LOCAL,
+                        ),
+                    )
+                    # and to the cluster: the old daemon's
+                    # lease-scoped kvstore key died with its session,
+                    # so remote nodes would otherwise resolve this
+                    # endpoint to WORLD after our restart
+                    if self.kvstore is not None:
+                        upsert_ip_mapping(
+                            self.kvstore,
+                            endpoint.ipv4,
+                            endpoint.security_identity.id,
+                            node=self.node_name,
+                        )
             if self.endpoint_manager.endpoints():
                 self.trigger_policy_updates("restore", full=True)
 

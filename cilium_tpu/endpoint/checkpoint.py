@@ -118,6 +118,12 @@ def restore_endpoints(
                 name=doc.get("name", ""),
             )
             endpoint.set_state(STATE_RESTORING, "restoring")
+            # policy_revision round-trips for observability only; the
+            # regeneration gate reads next_policy_revision, which is
+            # deliberately NOT restored — a fresh daemon regenerates
+            # restored endpoints unconditionally (daemon/state.go
+            # regenerateRestoredEndpoints), since the checkpointed
+            # revision belongs to the old daemon's repo numbering
             endpoint.policy_revision = doc.get("policy_revision", 0)
             endpoint.realized_map_state = _map_state_from_json(
                 doc.get("realized_map_state", [])
