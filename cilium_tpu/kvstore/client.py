@@ -1,0 +1,353 @@
+"""Socket transport client: BackendOperations over the wire.
+
+The process-local face of the shared store (the analog of
+/root/reference/pkg/kvstore/etcd.go's etcdClient): implements the
+same method surface as the in-process KVStore, so the Daemon, the
+identity Allocator, ipcache sync, node discovery, and clustermesh run
+unchanged against a REMOTE store — multiple agent processes converge
+the way cilium agents converge through one etcd.
+
+Reconnect semantics (etcd.go's session/watcher re-establishment):
+on connection loss a background thread redials with backoff, then
+  * re-registers every live watch — the server replays the prefix as
+    `create` events (ListAndWatch), which downstream consumers treat
+    idempotently, exactly like an etcd watch restarted from a
+    compacted revision;
+  * re-publishes this client's lease-scoped keys — the old session
+    died with the old connection (lease expiry), and re-creating them
+    is the keepalive re-acquisition of pkg/kvstore/keepalive.go.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from cilium_tpu.kvstore.store import (
+    KVEvent,
+    Watcher,
+    wire_decode as _dec,
+    wire_encode as _enc,
+)
+
+
+class RemoteLock:
+    """Distributed lock: lease-scoped CAS key on the server (mutual
+    exclusion across processes; liveness by lease expiry on client
+    death).  Context-manager like the in-process RLock."""
+
+    def __init__(self, backend: "RemoteBackend", path: str) -> None:
+        self._backend = backend
+        self._path = path
+
+    def __enter__(self) -> "RemoteLock":
+        backoff = 0.005
+        while not self._backend._call("lock_acquire", key=self._path):
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.25)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._backend._call("lock_release", key=self._path)
+
+    # RLock-compat aliases
+    acquire = __enter__
+
+    def release(self) -> None:
+        self.__exit__()
+
+
+class RemoteBackend:
+    """KVStore-compatible client for a KVStoreServer."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        reconnect: bool = True,
+        dial_timeout: float = 5.0,
+    ) -> None:
+        self._host = host
+        self._port = port
+        self._reconnect = reconnect
+        self._dial_timeout = dial_timeout
+        self._io_lock = threading.Lock()
+        self._pending: Dict[int, "queue.Queue"] = {}
+        self._next_id = 0
+        self._next_wid = 0
+        self._watches: Dict[int, Tuple[str, Watcher]] = {}
+        self._lease_keys: Dict[str, bytes] = {}
+        self._closed = False
+        self._sock = None
+        self._connected = threading.Event()
+        # watch callbacks run on a dedicated dispatcher thread, NOT
+        # the reader: a callback that itself issues kvstore calls
+        # would otherwise deadlock waiting for the reader it blocks
+        import queue as _queue
+
+        self._event_q: "_queue.Queue" = _queue.Queue()
+        self._dial()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True
+        )
+        self._dispatcher.start()
+
+    # -- wire ----------------------------------------------------------------
+
+    def _dial(self) -> None:
+        deadline = time.monotonic() + self._dial_timeout
+        backoff = 0.02
+        while True:
+            try:
+                sock = socket.create_connection(
+                    (self._host, self._port), timeout=5.0
+                )
+                sock.settimeout(None)
+                self._sock = sock
+                self._rfile = sock.makefile("rb")
+                self._connected.set()
+                return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+
+    def _send(self, frame: dict) -> None:
+        data = (json.dumps(frame) + "\n").encode()
+        self._sock.sendall(data)
+
+    def _call(self, op: str, **kw):
+        import queue
+
+        if self._closed:
+            raise ConnectionError("backend closed")
+        self._connected.wait(self._dial_timeout)
+        q: "queue.Queue" = queue.Queue(maxsize=1)
+        with self._io_lock:
+            self._next_id += 1
+            rid = self._next_id
+            self._pending[rid] = q
+            try:
+                self._send({"id": rid, "op": op, **kw})
+            except OSError:
+                self._pending.pop(rid, None)
+                raise ConnectionError("kvstore connection lost")
+        import queue as _queue
+
+        try:
+            got = q.get(timeout=30.0)
+        except _queue.Empty:
+            raise ConnectionError(
+                f"kvstore call {op!r} timed out"
+            ) from None
+        finally:
+            self._pending.pop(rid, None)
+        if "error" in got:
+            raise RuntimeError(got["error"])
+        return got.get("result")
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            try:
+                line = self._rfile.readline()
+            except OSError:
+                line = b""
+            if not line:
+                self._connected.clear()
+                # fail outstanding requests
+                with self._io_lock:
+                    pending, self._pending = self._pending, {}
+                for q in pending.values():
+                    q.put({"error": "kvstore connection lost"})
+                if self._closed or not self._reconnect:
+                    return
+                try:
+                    self._dial()
+                except OSError:
+                    return
+                # re-establishment issues normal calls, whose
+                # responses THIS thread must keep reading — run it on
+                # its own thread
+                threading.Thread(
+                    target=self._reestablish, daemon=True
+                ).start()
+                continue
+            try:
+                frame = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "watch" in frame:
+                ev = frame["event"]
+                self._event_q.put(
+                    (
+                        "ev",
+                        frame["watch"],
+                        KVEvent(
+                            ev["kind"],
+                            ev["key"],
+                            _dec(ev["value"]) or b"",
+                            ev["revision"],
+                        ),
+                    )
+                )
+                continue
+            q = self._pending.pop(frame.get("id"), None)
+            if q is not None:
+                q.put(frame)
+
+    def _dispatch_loop(self) -> None:
+        while not self._closed:
+            item = self._event_q.get()
+            if item is None:
+                return
+            if item[0] == "sync":
+                item[1].set()
+                continue
+            _, wid, event = item
+            entry = self._watches.get(wid)
+            if entry is not None:
+                try:
+                    entry[1](event)
+                except Exception:
+                    pass  # a broken watcher must not kill dispatch
+
+    def _reestablish(self) -> None:
+        """Post-reconnect: re-publish lease keys (the old lease died
+        with the old connection) and re-register watches (the server
+        replays the prefix — idempotent for consumers)."""
+        for key, (value, session) in list(self._lease_keys.items()):
+            try:
+                self._call(
+                    "set", key=key, value=_enc(value), session=session
+                )
+            except (ConnectionError, RuntimeError):
+                return
+        for wid, (prefix, _) in list(self._watches.items()):
+            try:
+                self._call("watch", key=prefix, wid=wid)
+            except (ConnectionError, RuntimeError):
+                return
+
+    # -- BackendOperations surface -------------------------------------------
+
+    def get(self, key: str) -> Optional[bytes]:
+        return _dec(self._call("get", key=key))
+
+    def get_prefix(self, prefix: str):
+        got = self._call("get_prefix", key=prefix)
+        return None if got is None else (got[0], _dec(got[1]))
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        return {
+            k: _dec(v)
+            for k, v in self._call("list_prefix", key=prefix).items()
+        }
+
+    def set(
+        self, key: str, value: bytes, session: Optional[str] = None
+    ) -> int:
+        if session is not None:
+            self._lease_keys[key] = (value, session)
+        else:
+            # an unleased overwrite detaches any lease this client
+            # tracked (mirrors KVStore._attach_session)
+            self._lease_keys.pop(key, None)
+        return self._call(
+            "set", key=key, value=_enc(value), session=session
+        )
+
+    def create_only(
+        self, key: str, value: bytes, session: Optional[str] = None
+    ) -> bool:
+        ok = self._call(
+            "create_only", key=key, value=_enc(value), session=session
+        )
+        if ok and session is not None:
+            self._lease_keys[key] = (value, session)
+        return ok
+
+    def create_if_exists(
+        self,
+        cond_key: str,
+        key: str,
+        value: bytes,
+        session: Optional[str] = None,
+    ) -> bool:
+        ok = self._call(
+            "create_if_exists",
+            cond_key=cond_key,
+            key=key,
+            value=_enc(value),
+            session=session,
+        )
+        if ok and session is not None:
+            self._lease_keys[key] = (value, session)
+        return ok
+
+    def delete(self, key: str) -> bool:
+        self._lease_keys.pop(key, None)
+        return self._call("delete", key=key)
+
+    def delete_prefix(self, prefix: str) -> int:
+        for k in list(self._lease_keys):
+            if k.startswith(prefix):
+                del self._lease_keys[k]
+        return self._call("delete_prefix", key=prefix)
+
+    def lock_path(self, path: str) -> RemoteLock:
+        return RemoteLock(self, path)
+
+    def expire_session(self, session: str) -> int:
+        return self._call("expire_session", session=session)
+
+    def watch_prefix(
+        self, prefix: str, watcher: Watcher
+    ) -> Callable[[], None]:
+        with self._io_lock:
+            self._next_wid += 1
+            wid = self._next_wid
+        self._watches[wid] = (prefix, watcher)
+        self._call("watch", key=prefix, wid=wid)
+        # the server pushed the ListAndWatch replay BEFORE the watch
+        # response; drain the dispatcher up to here so callers see the
+        # in-process contract ("current contents replayed on return")
+        marker = threading.Event()
+        self._event_q.put(("sync", marker))
+        marker.wait(timeout=10.0)
+
+        def unsubscribe() -> None:
+            self._watches.pop(wid, None)
+            try:
+                self._call("unwatch", wid=wid)
+            except (ConnectionError, RuntimeError):
+                pass  # a dead connection has no watcher to remove
+
+        return unsubscribe
+
+    @property
+    def revision(self) -> int:
+        return self._call("revision")
+
+    def close(self) -> None:
+        self._closed = True
+        self._event_q.put(None)
+        # shutdown + close BOTH handles: the makefile() reader holds
+        # its own reference to the fd, so sock.close() alone never
+        # sends FIN and the server would keep the lease session alive
+        try:
+            if self._sock is not None:
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self._sock.close()
+            if getattr(self, "_rfile", None) is not None:
+                self._rfile.close()
+        except OSError:
+            pass

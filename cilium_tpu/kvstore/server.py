@@ -1,0 +1,292 @@
+"""Socket transport server for the kvstore: the etcd-stand-in.
+
+The reference's consensus layer is a network client against etcd
+(/root/reference/pkg/kvstore/etcd.go); this is the matching server
+side for this framework's BackendOperations (store.py), so multiple
+agent PROCESSES share one store the way cilium agents share one etcd:
+
+  * newline-delimited JSON frames over TCP (localhost); requests carry
+    an `id` and are answered in order;
+  * lease sessions are NAMED by the client (the node name, as in the
+    in-process store) and die with the connection that owns them
+    (etcd lease expiry ≙ dead-agent state cleanup,
+    pkg/kvstore/keepalive.go);
+  * watches are server-side subscriptions; events are pushed as
+    un-id'd frames tagged with the client's watch id, following the
+    ListAndWatch contract (replay-then-stream); `unwatch` removes the
+    server-side watcher;
+  * distributed locks are lease-scoped CAS keys under `lock/`
+    (etcd.go LockPath's concurrency.Mutex reduced to its observable
+    contract: mutual exclusion with liveness under client death);
+  * an optional snapshot file (debounced, plus on connection close
+    and SIGTERM) makes restarts durable for the reconnect story —
+    etcd's raft log reduced to a JSON dump; the semantics under test
+    are CLIENT re-list/re-watch, not server replication.
+
+Run standalone:  python -m cilium_tpu.kvstore.server --port 4321
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socketserver
+import threading
+from typing import Optional
+
+from cilium_tpu.kvstore.store import (
+    KVEvent,
+    KVStore,
+    wire_decode as _dec,
+    wire_encode as _enc,
+)
+
+_SNAPSHOT_DEBOUNCE_S = 0.2
+
+
+class _Conn(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server: KVStoreServer = self.server.kv_server  # type: ignore
+        conn_session = f"conn-{id(self)}"
+        send_lock = threading.Lock()
+        unsubscribes = {}
+        owned_sessions = set()
+
+        def push(frame: dict) -> None:
+            data = (json.dumps(frame) + "\n").encode()
+            with send_lock:
+                try:
+                    self.wfile.write(data)
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
+        try:
+            for line in self.rfile:
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                op = req.get("op")
+                rid = req.get("id")
+                try:
+                    result = self._dispatch(
+                        server,
+                        conn_session,
+                        owned_sessions,
+                        op,
+                        req,
+                        push,
+                        unsubscribes,
+                    )
+                except Exception as exc:  # surfaced to the client
+                    push({"id": rid, "error": str(exc)})
+                    continue
+                push({"id": rid, "result": result})
+        finally:
+            for unsub in unsubscribes.values():
+                unsub()
+            # connection death = lease expiry for every session this
+            # connection wrote through (named by the client, so
+            # expire_session-by-name keeps working remotely)
+            for session in owned_sessions | {conn_session}:
+                server.store.expire_session(session)
+            server.mark_dirty()
+            server.save_snapshot()
+
+    def _dispatch(
+        self,
+        server,
+        conn_session,
+        owned_sessions,
+        op,
+        req,
+        push,
+        unsubscribes,
+    ):
+        store = server.store
+        key = req.get("key", "")
+        value = _dec(req.get("value"))
+        session = req.get("session")
+        if session is not None:
+            owned_sessions.add(session)
+        mutated = False
+        try:
+            if op == "get":
+                return _enc(store.get(key))
+            if op == "get_prefix":
+                got = store.get_prefix(key)
+                return None if got is None else [got[0], _enc(got[1])]
+            if op == "list_prefix":
+                return {
+                    k: _enc(v)
+                    for k, v in store.list_prefix(key).items()
+                }
+            if op == "set":
+                mutated = True
+                return store.set(key, value, session=session)
+            if op == "create_only":
+                mutated = True
+                return store.create_only(key, value, session=session)
+            if op == "create_if_exists":
+                mutated = True
+                return store.create_if_exists(
+                    req["cond_key"], key, value, session=session
+                )
+            if op == "delete":
+                mutated = True
+                return store.delete(key)
+            if op == "delete_prefix":
+                mutated = True
+                return store.delete_prefix(key)
+            if op == "lock_acquire":
+                # lease-scoped CAS key: mutual exclusion with
+                # liveness under client death
+                return store.create_only(
+                    f"lock/{key}",
+                    conn_session.encode(),
+                    session=conn_session,
+                )
+            if op == "lock_release":
+                holder = store.get(f"lock/{key}")
+                if holder == conn_session.encode():
+                    store.delete(f"lock/{key}")
+                    return True
+                return False
+            if op == "watch":
+                wid = req["wid"]
+
+                def watcher(event: KVEvent) -> None:
+                    push(
+                        {
+                            "watch": wid,
+                            "event": {
+                                "kind": event.kind,
+                                "key": event.key,
+                                "value": _enc(event.value),
+                                "revision": event.revision,
+                            },
+                        }
+                    )
+
+                unsubscribes[wid] = store.watch_prefix(key, watcher)
+                return True
+            if op == "unwatch":
+                unsub = unsubscribes.pop(req["wid"], None)
+                if unsub is not None:
+                    unsub()
+                return True
+            if op == "revision":
+                return store.revision
+            if op == "expire_session":
+                mutated = True
+                return store.expire_session(req["session"])
+            if op == "ping":
+                return "pong"
+            raise ValueError(f"unknown op {op!r}")
+        finally:
+            if mutated:
+                server.mark_dirty()
+
+
+class _ThreadedTCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class KVStoreServer:
+    """Wraps a KVStore in the socket protocol; one thread per client;
+    debounced snapshotting to an optional state file."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        state_file: Optional[str] = None,
+    ) -> None:
+        self.store = KVStore()
+        self.state_file = state_file
+        self._snap_lock = threading.Lock()
+        self._dirty = threading.Event()
+        self._stopping = threading.Event()
+        if state_file and os.path.exists(state_file):
+            with open(state_file) as f:
+                for k, v in json.load(f).items():
+                    self.store.set(k, _dec(v))
+        self._tcp = _ThreadedTCP((host, port), _Conn)
+        self._tcp.kv_server = self  # type: ignore
+        self.port = self._tcp.server_address[1]
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True
+        )
+        self._snapshotter = threading.Thread(
+            target=self._snapshot_loop, daemon=True
+        )
+
+    def mark_dirty(self) -> None:
+        self._dirty.set()
+
+    def _snapshot_loop(self) -> None:
+        while not self._stopping.is_set():
+            if self._dirty.wait(timeout=0.5):
+                self._stopping.wait(_SNAPSHOT_DEBOUNCE_S)
+                self._dirty.clear()
+                self.save_snapshot()
+
+    def save_snapshot(self) -> None:
+        if not self.state_file:
+            return
+        with self._snap_lock:
+            # durable_items captures the lease exclusion atomically
+            # under the store lock — a key expiring concurrently can
+            # never be persisted
+            data = {
+                k: _enc(v) for k, v in self.store.durable_items().items()
+            }
+            tmp = self.state_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self.state_file)
+
+    def start(self) -> "KVStoreServer":
+        self._thread.start()
+        self._snapshotter.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        self.save_snapshot()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--state-file", default=None)
+    args = ap.parse_args()
+    server = KVStoreServer(args.host, args.port, args.state_file)
+    server.start()
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        server.stop()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    print(
+        f"kvstore-server listening on {args.host}:{server.port}",
+        flush=True,
+    )
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -176,3 +176,31 @@ class KVStore:
     def revision(self) -> int:
         with self._lock:
             return self._revision
+
+    def durable_items(self) -> Dict[str, bytes]:
+        """Non-lease-scoped contents, captured atomically — what a
+        snapshot may persist (lease keys die with their session and
+        must not resurrect across a restart)."""
+        with self._lock:
+            return {
+                k: v
+                for k, v in self._data.items()
+                if k not in self._key_session
+            }
+
+
+def wire_encode(value: Optional[bytes]) -> Optional[str]:
+    """Shared wire codec for the socket transport (server + client)."""
+    import base64
+
+    if value is None:
+        return None
+    return base64.b64encode(value).decode()
+
+
+def wire_decode(value: Optional[str]) -> Optional[bytes]:
+    import base64
+
+    if value is None:
+        return None
+    return base64.b64decode(value)

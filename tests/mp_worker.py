@@ -1,0 +1,122 @@
+"""Worker for the multi-process mesh test (SURVEY §4 tier-3).
+
+Launched N times by tests/test_multiprocess_mesh.py; each process
+contributes 4 virtual CPU devices to one global 8-device mesh via
+jax.distributed — the single-host analog of the reference running one
+agent per node with NCCL/MPI underneath, here XLA's distributed
+runtime.  Each process evaluates ITS addressable shard of a
+batch-sharded lattice evaluation and checks it against the host
+oracle; any divergence exits nonzero.
+"""
+
+import os
+import sys
+
+# the CI interpreter pre-imports jax with the hardware platform
+# selected, so env vars are too late — force CPU through the config
+# API before any backend initializes (same dance as conftest.py)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    coordinator = sys.argv[1]
+    process_id = int(sys.argv[2])
+    num_processes = int(sys.argv[3])
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.engine.oracle import evaluate_batch_oracle
+    from cilium_tpu.engine.verdict import TupleBatch, _verdict_kernel
+    from tests.test_verdict_engine import random_map_state
+
+    devices = np.array(jax.devices()).reshape(-1)
+    assert len(devices) == 4 * num_processes, len(devices)
+    mesh = Mesh(devices, ("batch",))
+
+    identity_ids = [1, 2, 3, 4, 5, 256, 257, 300, 1000]
+    rng = np.random.default_rng(0)  # same seed everywhere
+    states = [
+        random_map_state(rng, identity_ids, n_l4=12, n_l3=8)
+        for _ in range(3)
+    ]
+    tables = compile_map_states(states, identity_ids, 32, 16)
+
+    b_global = 1024
+    cols = dict(
+        ep_index=rng.integers(0, 3, size=b_global),
+        identity=rng.choice(identity_ids, size=b_global).astype(
+            np.uint32
+        ),
+        dport=rng.integers(1, 9000, size=b_global),
+        proto=rng.choice([6, 17], size=b_global),
+        direction=rng.integers(0, 2, size=b_global),
+        is_fragment=rng.random(size=b_global) < 0.1,
+    )
+
+    batch_sharding = NamedSharding(mesh, P("batch"))
+    replicated = NamedSharding(mesh, P())
+
+    def shard_col(a):
+        return jax.make_array_from_process_local_data(
+            batch_sharding,
+            np.asarray(a)[
+                process_id
+                * (b_global // num_processes) : (process_id + 1)
+                * (b_global // num_processes)
+            ],
+            (b_global,),
+        )
+
+    batch = TupleBatch.from_numpy(**cols)
+    batch = jax.tree.map(shard_col, batch)
+    tables_g = jax.device_put(tables, replicated)
+
+    step = jax.jit(
+        _verdict_kernel,
+        in_shardings=(replicated, batch_sharding),
+        out_shardings=batch_sharding,
+    )
+    out = step(tables_g, batch)
+
+    # every process checks ITS addressable rows against the oracle
+    want_allow, want_proxy, want_kind = evaluate_batch_oracle(
+        states, **{k: np.asarray(v) for k, v in cols.items()}
+    )
+    ok = True
+    for shard in out.allowed.addressable_shards:
+        lo = shard.index[0].start or 0
+        got = np.asarray(shard.data)
+        if not (got == want_allow[lo : lo + len(got)].astype(np.uint8)).all():
+            ok = False
+    for shard in out.proxy_port.addressable_shards:
+        lo = shard.index[0].start or 0
+        got = np.asarray(shard.data)
+        if not (got == want_proxy[lo : lo + len(got)]).all():
+            ok = False
+    print(
+        f"process {process_id}: devices={len(devices)} "
+        f"shard-check={'OK' if ok else 'DIVERGED'}",
+        flush=True,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

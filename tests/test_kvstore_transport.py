@@ -1,0 +1,246 @@
+"""Cross-process kvstore transport: two daemons, one store server.
+
+The reference's entire distributed layer is a network client against
+etcd (/root/reference/pkg/kvstore/etcd.go, allocator semantics
+/root/reference/pkg/kvstore/allocator/allocator.go:423).  These tests
+run a KVStoreServer in a SEPARATE PROCESS and drive two full Daemon
+instances against it through the socket RemoteBackend:
+
+  * identity allocated on daemon A resolves to the SAME numeric id on
+    daemon B (CAS master-key consensus through the store);
+  * an ipcache upsert on A propagates through the store watch into
+    B's ipcache AND B's device LPM tables;
+  * the client survives a server restart (watch re-establishment +
+    lease-key republication), like an etcd client outliving a leader
+    restart.
+"""
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.kvstore.client import RemoteBackend
+
+from tests.test_daemon import k8s_labels
+
+
+@pytest.fixture
+def server_proc(tmp_path):
+    state = str(tmp_path / "kv_state.json")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "cilium_tpu.kvstore.server",
+            "--port",
+            "0",
+            "--state-file",
+            state,
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    if not line or ":" not in line:
+        out = proc.stdout.read() if proc.poll() is not None else ""
+        raise RuntimeError(
+            f"kvstore server failed to start (rc={proc.poll()}): "
+            f"{line!r} {out!r}"
+        )
+    port = int(line.rsplit(":", 1)[1])
+    yield proc, port, state
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def _wait_for(cond, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_two_daemons_identity_and_ipcache_converge(server_proc):
+    proc, port, _ = server_proc
+    kv_a = RemoteBackend(port=port)
+    kv_b = RemoteBackend(port=port)
+    try:
+        da = Daemon(kvstore=kv_a, node_name="node-a")
+        db = Daemon(kvstore=kv_b, node_name="node-b")
+
+        # identity consensus: same labels → same numeric id via the
+        # store's CAS master key, whichever node allocates first
+        ep_a = da.create_endpoint(
+            1, k8s_labels(app="web"), ipv4="10.1.0.1"
+        )
+        ident_b, _ = db.identity_allocator.allocate(k8s_labels(app="web"))
+        assert ep_a.security_identity.id == ident_b.id
+
+        # ipcache convergence: A's endpoint IP appears in B's ipcache
+        # and B's device LPM via the store watch
+        _wait_for(
+            lambda: db.ipcache.lookup_by_ip("10.1.0.1")[0] is not None,
+            what="B's ipcache to see A's endpoint IP",
+        )
+        got, _ = db.ipcache.lookup_by_ip("10.1.0.1")
+        assert got.id == ep_a.security_identity.id
+
+        tables = db.lpm_builder.tables()
+        from cilium_tpu.ipcache.lpm import _lookup_kernel
+        import jax.numpy as jnp
+
+        val = int(
+            np.asarray(
+                _lookup_kernel(
+                    tables,
+                    jnp.asarray([int(0x0A010001)], dtype=jnp.uint32),
+                )
+            )[0]
+        )
+        assert val == ep_a.security_identity.id
+
+        # symmetric direction: B's endpoint appears on A
+        db.create_endpoint(2, k8s_labels(app="db"), ipv4="10.1.0.2")
+        _wait_for(
+            lambda: da.ipcache.lookup_by_ip("10.1.0.2")[0] is not None,
+            what="A's ipcache to see B's endpoint IP",
+        )
+    finally:
+        kv_a.close()
+        kv_b.close()
+
+
+def test_client_survives_server_restart(server_proc, tmp_path):
+    proc, port, state = server_proc
+    kv = RemoteBackend(port=port)
+    try:
+        kv.set("durable/key", b"v1")
+        kv.set("leased/key", b"mine", session="me")
+        seen = []
+        kv.watch_prefix("durable/", lambda ev: seen.append(ev))
+        assert [e.kind for e in seen] == ["create"]
+
+        # kill the server; restart on the SAME port from the snapshot
+        proc.terminate()
+        proc.wait(timeout=5)
+        proc2 = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "cilium_tpu.kvstore.server",
+                "--port",
+                str(port),
+                "--state-file",
+                state,
+            ],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            proc2.stdout.readline()
+            # the client redials, re-registers the watch (the server
+            # replays the prefix), and re-publishes its lease key
+            _wait_for(
+                lambda: len(seen) >= 2,
+                timeout=10.0,
+                what="watch replay after reconnect",
+            )
+            assert kv.get("durable/key") == b"v1"
+            _wait_for(
+                lambda: kv.get("leased/key") == b"mine",
+                timeout=10.0,
+                what="lease key republication",
+            )
+            # and new writes still flow
+            kv.set("durable/key2", b"v2")
+            _wait_for(
+                lambda: any(e.key == "durable/key2" for e in seen),
+                what="post-reconnect watch event",
+            )
+        finally:
+            proc2.terminate()
+            proc2.wait(timeout=5)
+    finally:
+        kv.close()
+
+
+def test_allocator_cas_uniqueness_across_processes(server_proc):
+    """Two backends racing allocations of many distinct label keys
+    never mint the same id for different keys (allocator.go's CAS
+    master-key invariant, exercised over the wire)."""
+    proc, port, _ = server_proc
+    kv_a = RemoteBackend(port=port)
+    kv_b = RemoteBackend(port=port)
+    try:
+        from cilium_tpu.kvstore import IDENTITIES_PATH
+        from cilium_tpu.kvstore.allocator import Allocator
+
+        alloc_a = Allocator(kv_a, IDENTITIES_PATH, node="a")
+        alloc_b = Allocator(kv_b, IDENTITIES_PATH, node="b")
+        ids = {}
+        import threading
+
+        def work(alloc, keys, out):
+            for k in keys:
+                out[k] = alloc.allocate(k)
+
+        out_a, out_b = {}, {}
+        keys = [f"labels;k8s:app={i};" for i in range(24)]
+        ta = threading.Thread(target=work, args=(alloc_a, keys, out_a))
+        tb = threading.Thread(
+            target=work, args=(alloc_b, list(reversed(keys)), out_b)
+        )
+        ta.start(); tb.start(); ta.join(10); tb.join(10)
+        # both processes agree on every key's id
+        assert out_a == out_b
+        # distinct keys never share an id
+        assert len(set(out_a.values())) == len(keys)
+    finally:
+        kv_a.close()
+        kv_b.close()
+
+
+def test_named_session_expiry_over_the_wire(server_proc):
+    """expire_session by NAME must work remotely (dead-node cleanup:
+    node.py/ipsync.py write with session=node and other agents expire
+    it) — the server attaches keys to the client-provided session,
+    not just the connection lease."""
+    proc, port, _ = server_proc
+    kv_a = RemoteBackend(port=port)
+    kv_b = RemoteBackend(port=port)
+    try:
+        kv_a.set("nodes/a", b"meta", session="node-a")
+        assert kv_b.get("nodes/a") == b"meta"
+        # another agent declares node-a dead
+        assert kv_b.expire_session("node-a") == 1
+        _wait_for(
+            lambda: kv_b.get("nodes/a") is None,
+            what="named session expiry",
+        )
+    finally:
+        kv_a.close()
+        kv_b.close()
+
+
+def test_connection_death_expires_leases(server_proc):
+    proc, port, _ = server_proc
+    kv_a = RemoteBackend(port=port)
+    kv_b = RemoteBackend(port=port)
+    try:
+        kv_a.set("nodes/a", b"meta", session="node-a")
+        kv_a.set("plain", b"stays")
+        assert kv_b.get("nodes/a") == b"meta"
+        kv_a.close()  # agent dies; its lease-scoped keys must vanish
+        _wait_for(
+            lambda: kv_b.get("nodes/a") is None,
+            what="lease expiry on connection death",
+        )
+        assert kv_b.get("plain") == b"stays"
+    finally:
+        kv_b.close()

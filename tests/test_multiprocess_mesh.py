@@ -1,0 +1,56 @@
+"""Multi-process JAX distribution (SURVEY §4 tier-3): two OS
+processes form one global device mesh via jax.distributed — the
+framework's DCN story exercised for real, not simulated on one
+process's virtual devices.  Each worker evaluates its addressable
+shard of a batch-sharded lattice evaluation against the host oracle
+(tests/mp_worker.py)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_global_mesh():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    workers = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    for pid in range(2):
+        workers.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.join(
+                        os.path.dirname(__file__), "mp_worker.py"
+                    ),
+                    coordinator,
+                    str(pid),
+                    "2",
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outputs = []
+    for w in workers:
+        out, _ = w.communicate(timeout=150)
+        outputs.append(out)
+    for pid, (w, out) in enumerate(zip(workers, outputs)):
+        assert w.returncode == 0, (
+            f"worker {pid} failed (rc {w.returncode}):\n{out}"
+        )
+        assert "shard-check=OK" in out, out
