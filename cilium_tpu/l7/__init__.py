@@ -11,4 +11,10 @@ the device engine over padded request byte tensors (`http`); Kafka
 rules compile to field-equality tables (`kafka`).  Pathological
 regexes and header constraints fall back to host evaluation, like the
 reference keeps Envoy host-side.
+
+Generic parsers (`proxylib`) register themselves by name at import —
+importing this package loads the bundled ones, as the reference's
+proxylib init() hooks do.
 """
+
+from cilium_tpu.l7 import memcached as _memcached  # noqa: F401

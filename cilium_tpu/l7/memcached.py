@@ -1,0 +1,317 @@
+"""Binary-memcached parser for the generic L7 framework.
+
+Behavioral port of the reference proxylib parser
+(/root/reference/proxylib/memcached/binary/parser.go): 24-byte binary
+header (magic 0x80 request / 0x81 response), opcode at byte 1, key of
+keyLength bytes after the extras; rules name an opcode or opcode
+group plus at most one of keyExact / keyPrefix / keyRegex; denied
+requests are answered with the 'access denied' response frame
+(DeniedMsgBase, parser.go:293).
+
+TPU-first matching (the l7/kafka.py design): opcodes become a 256-bit
+rule mask (8 u32 words), exact keys intern to dense u32 ids, and the
+batch evaluates as pure integer [B, R] compares on device; rules with
+keyPrefix/keyRegex are host-only — the device result flags any row
+whose identity owns such a rule for host fallback, so the fast path
+never false-denies (nor false-allows: flagged rows are re-run, not
+trusted).
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.l7.kafka import Interner
+from cilium_tpu.l7.proxylib import (
+    L7Request,
+    ParserEntry,
+    register_parser,
+)
+
+PARSER_NAME = "binarymemcache"
+HEADER_SIZE = 24
+REQUEST_MAGIC = 0x80
+RESPONSE_MAGIC = 0x81
+
+# parser.go:306 MemcacheOpCodeMap — names and groups to opcodes
+OPCODE_MAP: Dict[str, Tuple[int, ...]] = {
+    "get": (0,), "set": (1,), "add": (2,), "replace": (3,),
+    "delete": (4,), "increment": (5,), "decrement": (6,), "quit": (7,),
+    "flush": (8,), "getq": (9,), "noop": (10,), "version": (11,),
+    "getk": (12,), "getkq": (13,), "append": (14,), "prepend": (15,),
+    "stat": (16,), "setq": (17,), "addq": (18,), "replaceq": (19,),
+    "deleteq": (20,), "incrementq": (21,), "decrementq": (22,),
+    "quitq": (23,), "flushq": (24,), "appendq": (25,), "prependq": (26,),
+    "verbosity": (27,), "touch": (28,), "gat": (29,), "gatq": (30,),
+    "sasl-list-mechs": (32,), "sasl-auth": (33,), "sasl-step": (34,),
+    "rget": (48,), "rset": (49,), "rsetq": (50,), "rappend": (51,),
+    "rappendq": (52,), "rprepend": (53,), "rprependq": (54,),
+    "rdelete": (55,), "rdeleteq": (56,), "rincr": (57,), "rincrq": (58,),
+    "rdecr": (59,), "rdecrq": (60,), "set-vbucket": (61,),
+    "get-vbucket": (62,), "del-vbucket": (63,), "tap-connect": (64,),
+    "tap-mutation": (65,), "tap-delete": (66,), "tap-flush": (67,),
+    "tap-opaque": (68,), "tap-vbucket-set": (69,),
+    "tap-checkpoint-start": (70,), "tap-checkpoint-end": (71,),
+    "readGroup": (0, 9, 12, 13),
+    "writeGroup": (
+        1, 2, 3, 4, 5, 6, 14, 15, 17, 18, 19, 20, 21, 22, 25, 26,
+        28, 29, 30,
+    ),
+}
+
+# parser.go:293 DeniedMsgBase: status 0x0008, body 'access denied'
+DENIED_MSG = bytes(
+    [0x81, 0, 0, 0, 0, 0, 0, 8, 0, 0, 0, 0x0D, 0, 0, 0, 0,
+     0, 0, 0, 0, 0, 0, 0, 0]
+) + b"access denied"
+
+
+class MemcacheParseError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class MemcacheRuleSpec:
+    """One compiled rule (BinaryMemcacheRule, parser.go:32)."""
+
+    identity_indices: frozenset
+    op_codes: Tuple[int, ...]
+    key_exact: str = ""
+    key_prefix: str = ""
+    key_regex: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "identity_indices", frozenset(self.identity_indices)
+        )
+
+    @property
+    def device_expressible(self) -> bool:
+        return self.key_prefix == "" and self.key_regex == ""
+
+
+def compile_rules(
+    dicts: Sequence[dict], identity_indices: Sequence[int]
+) -> List[MemcacheRuleSpec]:
+    """L7BinaryMemcacheRuleParser (parser.go:89): each dict carries
+    `opCode` (required) and at most one key matcher.  An EMPTY dict
+    list is the wildcard allow-all for the selector."""
+    if not dicts:
+        return [
+            MemcacheRuleSpec(
+                identity_indices=identity_indices,
+                op_codes=tuple(range(256)),
+            )
+        ]
+    specs = []
+    for d in dicts:
+        op_name = d.get("opCode", "")
+        if op_name not in OPCODE_MAP:
+            raise ValueError(
+                f"unsupported or missing opCode {op_name!r}"
+            )
+        unknown = set(d) - {"opCode", "keyExact", "keyPrefix", "keyRegex"}
+        if unknown:
+            raise ValueError(f"unsupported keys: {sorted(unknown)}")
+        specs.append(
+            MemcacheRuleSpec(
+                identity_indices=identity_indices,
+                op_codes=OPCODE_MAP[op_name],
+                key_exact=d.get("keyExact", ""),
+                key_prefix=d.get("keyPrefix", ""),
+                key_regex=d.get("keyRegex", ""),
+            )
+        )
+    return specs
+
+
+def rule_matches(request: L7Request, spec: MemcacheRuleSpec) -> bool:
+    """BinaryMemcacheRule.Matches (parser.go:52)."""
+    opcode = int(request.get("opcode", "-1"))
+    if opcode not in spec.op_codes:
+        return False
+    key = request.get("key")
+    if spec.key_exact != "":
+        return spec.key_exact == key
+    if spec.key_prefix != "":
+        return key.startswith(spec.key_prefix)
+    if spec.key_regex != "":
+        return re.search(spec.key_regex, key) is not None
+    return True  # no key rule: match by opcode
+
+
+def decode_stream(buf: bytes) -> Tuple[List[L7Request], int]:
+    """Parse complete request frames; returns (requests, consumed).
+    Trailing partial frames stay unconsumed (proxylib.MORE); a
+    response-magic frame in the request direction is connection-fatal
+    (parser.go getOpcodeAndKey ERROR_INVALID_FRAME_TYPE)."""
+    requests = []
+    off = 0
+    while off + HEADER_SIZE <= len(buf):
+        magic = buf[off]
+        if magic & REQUEST_MAGIC != REQUEST_MAGIC:
+            raise MemcacheParseError(
+                f"invalid request magic 0x{magic:02x}"
+            )
+        opcode = buf[off + 1]
+        key_len = struct.unpack_from(">H", buf, off + 2)[0]
+        extras_len = buf[off + 4]
+        body_len = struct.unpack_from(">I", buf, off + 8)[0]
+        total = HEADER_SIZE + body_len
+        if off + total > len(buf):
+            break  # MORE
+        key = b""
+        if key_len:
+            ks = off + HEADER_SIZE + extras_len
+            key = buf[ks : ks + key_len]
+        requests.append(
+            L7Request(
+                proto=PARSER_NAME,
+                fields=(
+                    ("opcode", str(opcode)),
+                    ("key", key.decode("utf-8", "replace")),
+                ),
+            )
+        )
+        off += total
+    return requests, off
+
+
+def encode_request(
+    opcode: int, key: str = "", extras: bytes = b"", value: bytes = b""
+) -> bytes:
+    """Wire synthesis for tests/bench (the reverse of decode)."""
+    kb = key.encode()
+    body = extras + kb + value
+    return (
+        struct.pack(
+            ">BBHBBHIIQ",
+            REQUEST_MAGIC,
+            opcode & 0xFF,
+            len(kb),
+            len(extras),
+            0,
+            0,
+            len(body),
+            0,
+            0,
+        )
+        + body
+    )
+
+
+def deny_response(request: L7Request) -> bytes:
+    return DENIED_MSG
+
+
+@dataclass
+class MemcacheDeviceTables:
+    """Integer-tensor form: [R] rules with 256-bit opcode masks and
+    interned exact keys; [W]-word identity membership bitmasks."""
+
+    opcode_mask: np.ndarray  # u32 [R, 8]
+    key_id: np.ndarray  # u32 [R] (0 = no exact-key constraint)
+    device_ok: np.ndarray  # bool [R] (False: prefix/regex, host only)
+    ident_rules: np.ndarray  # u32 [N, W] rule-membership bits
+    interner: Interner
+    specs: List[MemcacheRuleSpec]
+
+    def evaluate(self, requests, ident_idx, known):
+        """(allowed [B], needs_host [B]): pure integer compares on
+        device; needs_host marks rows whose identity owns a
+        host-only rule AND the device path denied (a prefix/regex
+        rule might still allow them)."""
+        import jax.numpy as jnp
+
+        b = len(requests)
+        opcode = np.zeros(b, np.int32)
+        key_id = np.zeros(b, np.uint32)
+        for i, request in enumerate(requests):
+            opcode[i] = int(request.get("opcode", "-1"))
+            key_id[i] = self.interner.lookup(request.get("key"))
+
+        r = len(self.specs)
+        if r == 0:
+            return np.zeros(b, bool), np.zeros(b, bool)
+        op = jnp.clip(jnp.asarray(opcode), 0, 255)
+        op_word = (op >> 5).astype(jnp.int32)
+        op_bit = (op & 31).astype(jnp.uint32)
+        mask = jnp.asarray(self.opcode_mask)  # [R, 8]
+        op_ok = (
+            (mask[None, :, :] >> op_bit[:, None, None])
+            & 1
+        ).astype(bool)  # [B, R, 8] via broadcast, select word below
+        op_ok = jnp.take_along_axis(
+            op_ok,
+            op_word[:, None, None].astype(jnp.int32).repeat(r, axis=1),
+            axis=2,
+        )[:, :, 0]
+        op_ok = op_ok & (jnp.asarray(opcode)[:, None] >= 0)
+
+        rk = jnp.asarray(self.key_id)[None, :]
+        key_ok = (rk == 0) | (
+            rk == jnp.asarray(key_id)[:, None]
+        )
+
+        word = jnp.arange(r) // 32
+        bit = (jnp.arange(r) % 32).astype(jnp.uint32)
+        ident_bits = jnp.asarray(self.ident_rules)[
+            jnp.clip(
+                jnp.asarray(ident_idx), 0, self.ident_rules.shape[0] - 1
+            )
+        ]  # [B, W]
+        rule_bit = (
+            (ident_bits[:, word] >> bit[None, :]) & 1
+        ).astype(bool)
+        base = rule_bit & jnp.asarray(known)[:, None]
+
+        dev_ok = jnp.asarray(self.device_ok)[None, :]
+        allowed = jnp.any(base & dev_ok & op_ok & key_ok, axis=1)
+        has_host_rule = jnp.any(base & ~dev_ok, axis=1)
+        needs_host = has_host_rule & ~allowed
+        return np.asarray(allowed), np.asarray(needs_host)
+
+
+def compile_device(
+    specs: Sequence[MemcacheRuleSpec], n_identities: int
+) -> MemcacheDeviceTables:
+    r = len(specs)
+    opcode_mask = np.zeros((max(r, 1), 8), np.uint32)
+    key_id = np.zeros(max(r, 1), np.uint32)
+    device_ok = np.zeros(max(r, 1), bool)
+    w = max((r + 31) // 32, 1)
+    ident_rules = np.zeros((max(n_identities, 1), w), np.uint32)
+    interner = Interner()
+    for j, spec in enumerate(specs):
+        for oc in spec.op_codes:
+            opcode_mask[j, oc >> 5] |= np.uint32(1 << (oc & 31))
+        key_id[j] = interner.intern(spec.key_exact)
+        device_ok[j] = spec.device_expressible
+        for idx in spec.identity_indices:
+            if 0 <= idx < n_identities:
+                ident_rules[idx, j >> 5] |= np.uint32(1 << (j & 31))
+    return MemcacheDeviceTables(
+        opcode_mask=opcode_mask,
+        key_id=key_id,
+        device_ok=device_ok,
+        ident_rules=ident_rules,
+        interner=interner,
+        specs=list(specs),
+    )
+
+
+register_parser(
+    ParserEntry(
+        name=PARSER_NAME,
+        decode_stream=decode_stream,
+        compile_rules=compile_rules,
+        rule_matches=rule_matches,
+        compile_device=compile_device,
+        deny_response=deny_response,
+    )
+)

@@ -41,6 +41,7 @@ from cilium_tpu.l7.kafka import (
     compile_kafka_rules,
     rule_spec_from_port_rule,
 )
+from cilium_tpu.l7.proxylib import GenericL7Tables
 from cilium_tpu.monitor.bus import MonitorBus
 from cilium_tpu.monitor.events import LogRecordNotify
 from cilium_tpu.policy.l4 import L4Filter, proxy_id
@@ -63,6 +64,7 @@ class Redirect:
     ingress: bool
     http_policy: Optional[HTTPPolicy] = None
     kafka_tables: Optional[KafkaTables] = None
+    generic_tables: Optional[GenericL7Tables] = None
 
 
 class Proxy:
@@ -119,33 +121,65 @@ class Proxy:
                 endpoint_id=endpoint_id,
                 ingress=l4.ingress,
             )
-            if redirect.parser == PARSER_KAFKA:
-                specs = []
-                for selector, l7 in l4.l7_rules_per_ep.items():
-                    indices = resolve_selector_indices(
-                        selector, identity_cache, id_index, selector_cache
-                    )
-                    if not (l7.kafka or []):
-                        # empty rules = L7 allow-all: wildcard spec
-                        from cilium_tpu.l7.kafka import KafkaRuleSpec
+            try:
+                if redirect.parser == PARSER_KAFKA:
+                    specs = []
+                    for selector, l7 in l4.l7_rules_per_ep.items():
+                        indices = resolve_selector_indices(
+                            selector,
+                            identity_cache,
+                            id_index,
+                            selector_cache,
+                        )
+                        if not (l7.kafka or []):
+                            # empty rules = L7 allow-all: wildcard spec
+                            from cilium_tpu.l7.kafka import KafkaRuleSpec
 
-                        specs.append(
-                            KafkaRuleSpec(identity_indices=indices)
+                            specs.append(
+                                KafkaRuleSpec(identity_indices=indices)
+                            )
+                        for rule in l7.kafka or []:
+                            specs.append(
+                                rule_spec_from_port_rule(rule, indices)
+                            )
+                    redirect.kafka_tables = compile_kafka_rules(
+                        specs, n_identities
+                    )
+                elif redirect.parser not in (PARSER_HTTP, ""):
+                    # generic proxylib parser, dispatched by l7proto
+                    # name (proxy.go:217 createOrUpdateRedirect →
+                    # proxylib); bundled parsers register at
+                    # cilium_tpu.l7 import time
+                    from cilium_tpu.l7.proxylib import (
+                        compile_generic_rules,
+                    )
+
+                    per_selector = []
+                    for selector, l7 in l4.l7_rules_per_ep.items():
+                        indices = resolve_selector_indices(
+                            selector,
+                            identity_cache,
+                            id_index,
+                            selector_cache,
                         )
-                    for rule in l7.kafka or []:
-                        specs.append(
-                            rule_spec_from_port_rule(rule, indices)
-                        )
-                redirect.kafka_tables = compile_kafka_rules(
-                    specs, n_identities
-                )
-            else:
-                specs = specs_from_filter(
-                    l4, identity_cache, id_index, selector_cache
-                )
-                redirect.http_policy = compile_http_rules(
-                    specs, n_identities
-                )
+                        per_selector.append((indices, list(l7.l7 or [])))
+                    redirect.generic_tables = compile_generic_rules(
+                        redirect.parser, per_selector, n_identities
+                    )
+                else:
+                    specs = specs_from_filter(
+                        l4, identity_cache, id_index, selector_cache
+                    )
+                    redirect.http_policy = compile_http_rules(
+                        specs, n_identities
+                    )
+            except Exception:
+                # a failed compile must not leak the allocated port:
+                # update_endpoint_redirects retries on every policy
+                # recompute and would drain the pool
+                if existing is None:
+                    self._ports_in_use.discard(port)
+                raise
             self.redirects[pid] = redirect
             return redirect
 
@@ -176,6 +210,39 @@ class Proxy:
 
     # -- request verdicts (the L7 hot path) ----------------------------------
 
+    def _verdict_batch(
+        self,
+        redirect: Redirect,
+        tables,
+        evaluate,
+        requests,
+        ident_idx,
+        known,
+        log: bool,
+        parser_label: str,
+        info_fn,
+    ):
+        """Shared skeleton of the per-parser verdict methods: guard,
+        known default, batched evaluate, per-request access log."""
+        import numpy as np
+
+        if tables is None:
+            raise ValueError(
+                f"redirect {redirect.id} has no {parser_label} tables"
+            )
+        if known is None:
+            known = np.ones(len(requests), dtype=bool)
+        allowed = evaluate(tables, requests, ident_idx, known)
+        if log and self.monitor is not None:
+            for i, request in enumerate(requests):
+                self.log_record(
+                    redirect.endpoint_id,
+                    parser_label,
+                    "Forwarded" if allowed[i] else "Denied",
+                    info=info_fn(request),
+                )
+        return allowed
+
     def verdict_http(
         self,
         redirect: Redirect,
@@ -190,28 +257,23 @@ class Proxy:
         and over-length fields).  Returns allowed bool [B]; emits one
         access-log record per request (verdict Forwarded/Denied, like
         cilium_l7policy.cc's 403 + accesslog)."""
-        import numpy as np
-
         from cilium_tpu.l7.http import evaluate_with_host_fallback
 
-        if redirect.http_policy is None:
-            raise ValueError(f"redirect {redirect.id} is not HTTP")
-        if known is None:
-            known = np.ones(len(requests), dtype=bool)
-        allowed = evaluate_with_host_fallback(
-            redirect.http_policy, requests, ident_idx, known, headers
+        return self._verdict_batch(
+            redirect,
+            redirect.http_policy,
+            lambda t, r, i, k: evaluate_with_host_fallback(
+                t, r, i, k, headers
+            ),
+            requests,
+            ident_idx,
+            known,
+            log,
+            PARSER_HTTP,
+            lambda req: b" ".join([req[0], req[1]]).decode(
+                "latin-1", "replace"
+            ),
         )
-        if log and self.monitor is not None:
-            for i, (method, path, _host) in enumerate(requests):
-                self.log_record(
-                    redirect.endpoint_id,
-                    PARSER_HTTP,
-                    "Forwarded" if allowed[i] else "Denied",
-                    info=b" ".join([method, path]).decode(
-                        "latin-1", "replace"
-                    ),
-                )
-        return allowed
 
     def verdict_kafka(
         self,
@@ -223,26 +285,45 @@ class Proxy:
     ):
         """Batched Kafka request verdicts (pkg/proxy/kafka.go:116
         canAccess).  Returns allowed bool [B]."""
-        import numpy as np
-
         from cilium_tpu.l7.kafka import evaluate_with_host_fallback
 
-        if redirect.kafka_tables is None:
-            raise ValueError(f"redirect {redirect.id} is not Kafka")
-        if known is None:
-            known = np.ones(len(requests), dtype=bool)
-        allowed = evaluate_with_host_fallback(
-            redirect.kafka_tables, requests, ident_idx, known
+        return self._verdict_batch(
+            redirect,
+            redirect.kafka_tables,
+            evaluate_with_host_fallback,
+            requests,
+            ident_idx,
+            known,
+            log,
+            PARSER_KAFKA,
+            lambda req: f"key={req.kind} topics={list(req.topics)}",
         )
-        if log and self.monitor is not None:
-            for i, request in enumerate(requests):
-                self.log_record(
-                    redirect.endpoint_id,
-                    PARSER_KAFKA,
-                    "Forwarded" if allowed[i] else "Denied",
-                    info=f"key={request.kind} topics={list(request.topics)}",
-                )
-        return allowed
+
+    def verdict_generic(
+        self,
+        redirect: Redirect,
+        requests,  # [l7.proxylib.L7Request]
+        ident_idx,
+        known=None,
+        log: bool = True,
+    ):
+        """Batched verdicts through a generic proxylib parser's
+        compiled rules (proxylib policymap matching,
+        /root/reference/proxylib/proxylib/policymap.go:150).  Returns
+        allowed bool [B]."""
+        from cilium_tpu.l7.proxylib import evaluate_requests
+
+        return self._verdict_batch(
+            redirect,
+            redirect.generic_tables,
+            evaluate_requests,
+            requests,
+            ident_idx,
+            known,
+            log,
+            redirect.parser,
+            lambda req: " ".join(f"{k}={v}" for k, v in req.fields),
+        )
 
     # -- endpoint integration (pkg/endpoint/bpf.go:488) ---------------------
 

@@ -1,0 +1,202 @@
+"""Generic L7 framework (proxylib analog) + memcached binary parser.
+
+Mirrors the reference's proxylib test surface
+(/root/reference/proxylib/memcached/binary/parser.go): wire parsing,
+rule matching by opcode group and key, the access-denied response,
+and — the repo's own bar — a device-vs-host differential and a full
+daemon e2e where an `l7proto` rule becomes a redirect whose parser
+produces per-request verdicts from real frames.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import cilium_tpu.l7.memcached as mc
+from cilium_tpu.l7.proxylib import (
+    GenericL7Tables,
+    L7Request,
+    compile_generic_rules,
+    evaluate_requests,
+    get_parser,
+    matches_rules_host,
+)
+
+
+def _req(opcode, key=""):
+    return L7Request(
+        proto=mc.PARSER_NAME,
+        fields=(("opcode", str(opcode)), ("key", key)),
+    )
+
+
+def test_wire_roundtrip_and_partials():
+    buf = (
+        mc.encode_request(0, "alpha")
+        + mc.encode_request(1, "beta", value=b"v")
+        + mc.encode_request(12, "")
+    )
+    requests, consumed = mc.decode_stream(buf + buf[:10])
+    assert consumed == len(buf)  # trailing partial left for MORE
+    assert [(r.get("opcode"), r.get("key")) for r in requests] == [
+        ("0", "alpha"), ("1", "beta"), ("12", ""),
+    ]
+
+
+def test_wire_rejects_response_magic():
+    with pytest.raises(mc.MemcacheParseError):
+        mc.decode_stream(bytes([0x01]) + b"\x00" * 23)
+
+
+def test_rule_matching_host():
+    tables = compile_generic_rules(
+        mc.PARSER_NAME,
+        [
+            ([0], [{"opCode": "readGroup", "keyExact": "users"}]),
+            ([1], [{"opCode": "writeGroup", "keyPrefix": "tmp/"}]),
+            ([2], []),  # wildcard allow-all
+        ],
+        4,
+    )
+    # identity 0: reads of 'users' only
+    assert matches_rules_host(tables, _req(0, "users"), 0)
+    assert matches_rules_host(tables, _req(12, "users"), 0)  # getk
+    assert not matches_rules_host(tables, _req(1, "users"), 0)  # set
+    assert not matches_rules_host(tables, _req(0, "other"), 0)
+    # identity 1: writes under tmp/
+    assert matches_rules_host(tables, _req(1, "tmp/x"), 1)
+    assert not matches_rules_host(tables, _req(1, "prod/x"), 1)
+    assert not matches_rules_host(tables, _req(0, "tmp/x"), 1)
+    # identity 2: wildcard
+    assert matches_rules_host(tables, _req(55, "anything"), 2)
+    # identity 3: no rules
+    assert not matches_rules_host(tables, _req(0, "users"), 3)
+
+
+def test_device_matches_host_differential():
+    rng = np.random.default_rng(3)
+    tables = compile_generic_rules(
+        mc.PARSER_NAME,
+        [
+            ([0, 2], [{"opCode": "get", "keyExact": "a"},
+                      {"opCode": "writeGroup"}]),
+            ([1], [{"opCode": "readGroup", "keyPrefix": "p/"}]),
+            ([3], []),
+        ],
+        8,
+    )
+    keys = ["a", "b", "p/x", "p/y", "zzz", ""]
+    requests = [
+        _req(int(rng.integers(0, 64)), keys[int(rng.integers(0, 6))])
+        for _ in range(512)
+    ]
+    ident = rng.integers(0, 8, size=512).astype(np.int32)
+    known = rng.random(512) > 0.05
+    got = evaluate_requests(tables, requests, ident, known)
+    want = np.array(
+        [
+            bool(known[i])
+            and matches_rules_host(tables, requests[i], int(ident[i]))
+            for i in range(512)
+        ]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_unknown_l7proto_raises():
+    with pytest.raises(KeyError):
+        compile_generic_rules("no-such-proto", [], 1)
+
+
+def test_deny_response_shape():
+    deny = get_parser(mc.PARSER_NAME).deny_response(_req(1, "k"))
+    assert deny[0] == 0x81
+    assert deny.endswith(b"access denied")
+
+
+def test_daemon_e2e_l7proto_redirect():
+    """An l7proto rule flows policy_add → L4 merge → redirect with a
+    generic parser → wire frames to per-request verdicts (the
+    proxylib e2e: CreateOrUpdateRedirect + OnData + policymap
+    matching)."""
+    from cilium_tpu.daemon import Daemon
+    from tests.test_daemon import es_k8s, k8s_labels, wait_trigger
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.policy.api import (
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.rule import L7Rules, PortRuleL7
+
+    d = Daemon()
+    cache = d.create_endpoint(
+        1, k8s_labels(app="cache"), ipv4="10.3.0.1"
+    )
+    client = d.create_endpoint(
+        2, k8s_labels(app="worker"), ipv4="10.3.0.2"
+    )
+    d.policy_add(
+        [
+            Rule(
+                endpoint_selector=es_k8s(app="cache"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[es_k8s(app="worker")],
+                        to_ports=[
+                            PortRule(
+                                ports=[
+                                    PortProtocol(
+                                        port="11211", protocol="TCP"
+                                    )
+                                ],
+                                rules=L7Rules(
+                                    l7proto=mc.PARSER_NAME,
+                                    l7=[
+                                        PortRuleL7(
+                                            opCode="readGroup",
+                                            keyExact="sessions",
+                                        )
+                                    ],
+                                ),
+                            )
+                        ],
+                    )
+                ],
+                labels=LabelArray.parse("mc-rule"),
+            )
+        ]
+    )
+    wait_trigger(d)
+
+    redirect = d.proxy.redirect_for(cache.id, True, "TCP", 11211)
+    assert redirect is not None
+    assert redirect.parser == mc.PARSER_NAME
+    assert redirect.generic_tables is not None
+
+    # the datapath would steer port-11211 flows to this proxy port;
+    # feed it real wire bytes as the in-proc proxy
+    buf = (
+        mc.encode_request(0, "sessions")  # get sessions → allow
+        + mc.encode_request(12, "sessions")  # getk → allow (readGroup)
+        + mc.encode_request(1, "sessions")  # set → deny
+        + mc.encode_request(0, "secrets")  # wrong key → deny
+    )
+    requests, consumed = mc.decode_stream(buf)
+    assert consumed == len(buf)
+
+    # resolve the worker's identity index in the redirect's universe
+    version, tables, index = d.endpoint_manager.published()
+    from cilium_tpu.compiler.tables import PAD_ID
+
+    id_list = [
+        int(v) for v in np.asarray(tables.id_table) if v != int(PAD_ID)
+    ]
+    worker_idx = id_list.index(client.security_identity.id)
+    ident = np.full(len(requests), worker_idx, np.int32)
+    allowed = d.proxy.verdict_generic(
+        redirect, requests, ident, log=True
+    )
+    assert list(allowed) == [True, True, False, False]
