@@ -1,0 +1,304 @@
+"""k8s watch loop: informer stores + serialized per-resource queues.
+
+The machinery of /root/reference/daemon/k8s_watcher.go:453-671 —
+controllers subscribing to NetworkPolicy / CiliumNetworkPolicy /
+Service / Endpoints streams, with each resource kind draining its
+events IN ORDER through its own serialized queue
+(k8sUtils.ResourceEventHandlerFactory's funcSerializer) and an
+initial-sync gate (blockWaitGroupToSyncResources) before the daemon
+is considered ready.
+
+There is no kube-apiserver in this environment; `FakeAPIServer` is
+the in-proc stand-in implementing the list+watch contract the
+reference's informers consume (replay current objects as ADDED, then
+stream).  The event handlers are the real daemon paths:
+
+  * (C)NP add/update → parse → Daemon.policy_add with the policy's
+    derived labels (replacing the prior revision of the same policy);
+    delete → Daemon.policy_delete by labels;
+  * Service/Endpoints → ServiceManager upsert (the LB frontend) AND
+    live ToServices→ToCIDRSet retranslation via RuleTranslator
+    (k8s_watcher.go updateK8sServiceV1 →
+    pkg/k8s/rule_translate.go:44), followed by a policy trigger so
+    endpoints regenerate against the rewritten rules.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cilium_tpu.k8s.network_policy import (
+    get_policy_labels,
+    parse_cilium_network_policy,
+    parse_network_policy,
+)
+from cilium_tpu.k8s.rule_translate import K8sServiceInfo, RuleTranslator
+from cilium_tpu.lb.service import L3n4Addr
+
+
+@dataclass(frozen=True)
+class K8sEvent:
+    kind: str  # resource kind, e.g. "Service"
+    action: str  # added | modified | deleted
+    obj: dict
+    old: Optional[dict] = None
+
+
+class FakeAPIServer:
+    """List+watch over {kind → (namespace, name) → object}."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._objects: Dict[str, Dict[Tuple[str, str], dict]] = {}
+        self._watchers: List[Tuple[str, Callable[[K8sEvent], None]]] = []
+
+    @staticmethod
+    def _key(obj: dict) -> Tuple[str, str]:
+        meta = obj.get("metadata", {})
+        return meta.get("namespace", "default"), meta.get("name", "")
+
+    def upsert(self, kind: str, obj: dict) -> None:
+        with self._lock:
+            store = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            old = store.get(key)
+            store[key] = obj
+            action = "modified" if old is not None else "added"
+            watchers = [w for k, w in self._watchers if k == kind]
+        for w in watchers:
+            w(K8sEvent(kind, action, obj, old))
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            store = self._objects.setdefault(kind, {})
+            obj = store.pop((namespace, name), None)
+            watchers = [w for k, w in self._watchers if k == kind]
+        if obj is not None:
+            for w in watchers:
+                w(K8sEvent(kind, "deleted", obj))
+
+    def watch(
+        self, kind: str, handler: Callable[[K8sEvent], None]
+    ) -> None:
+        """Replay current objects as `added`, then stream (the
+        informer ListAndWatch contract)."""
+        with self._lock:
+            current = list(self._objects.get(kind, {}).values())
+            self._watchers.append((kind, handler))
+        for obj in current:
+            handler(K8sEvent(kind, "added", obj))
+
+
+class _SerializedQueue:
+    """Per-resource ordered event execution (the reference's
+    funcSerializer: handlers for one resource kind never run
+    concurrently or out of order)."""
+
+    def __init__(self, name: str) -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name=f"k8s-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:
+                pass  # the reference logs and keeps the loop alive
+
+    def enqueue(self, fn: Callable[[], None]) -> None:
+        self._q.put(fn)
+
+    def drain(self) -> None:
+        """Block until everything enqueued so far has executed."""
+        done = threading.Event()
+        self._q.put(done.set)
+        done.wait(timeout=10.0)
+
+    def close(self) -> None:
+        self._q.put(None)
+
+
+class K8sWatcher:
+    """EnableK8sWatcher (k8s_watcher.go:453): wires the resource
+    streams into the daemon with per-kind serialized queues."""
+
+    KINDS = (
+        "NetworkPolicy",
+        "CiliumNetworkPolicy",
+        "Service",
+        "Endpoints",
+    )
+
+    def __init__(self, daemon, apiserver: FakeAPIServer, services=None):
+        self.daemon = daemon
+        self.apiserver = apiserver
+        self.services = services  # lb.ServiceManager (optional)
+        self._svc_info: Dict[Tuple[str, str], K8sServiceInfo] = {}
+        self._svc_frontends: Dict[Tuple[str, str], L3n4Addr] = {}
+        self._queues = {k: _SerializedQueue(k) for k in self.KINDS}
+        self._synced = {k: threading.Event() for k in self.KINDS}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        handlers = {
+            "NetworkPolicy": self._on_np,
+            "CiliumNetworkPolicy": self._on_cnp,
+            "Service": self._on_service,
+            "Endpoints": self._on_endpoints,
+        }
+        for kind in self.KINDS:
+            self.apiserver.watch(
+                kind,
+                lambda ev, k=kind: self._queues[k].enqueue(
+                    lambda: handlers[ev.kind](ev)
+                ),
+            )
+            # blockWaitGroupToSyncResources: the replayed backlog is
+            # queued; the sync gate trips once it has drained
+            self._queues[kind].enqueue(self._synced[kind].set)
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return all(e.wait(timeout) for e in self._synced.values())
+
+    def drain(self) -> None:
+        for q in self._queues.values():
+            q.drain()
+
+    def close(self) -> None:
+        for q in self._queues.values():
+            q.close()
+
+    # -- policy resources ----------------------------------------------------
+
+    def _policy_upsert(self, rules, labels) -> None:
+        if not rules:
+            return
+        # replaceWithLabels: a re-add of the same policy replaces its
+        # previous revision (daemon PolicyAdd ReplaceWithLabels)
+        self.daemon.policy_delete(labels)
+        self.daemon.policy_add(rules)
+
+    def _on_np(self, ev: K8sEvent) -> None:
+        meta = ev.obj.get("metadata", {})
+        labels = get_policy_labels(
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+            "NetworkPolicy",
+        )
+        if ev.action == "deleted":
+            self.daemon.policy_delete(labels)
+            return
+        self._policy_upsert(parse_network_policy(ev.obj), labels)
+
+    def _on_cnp(self, ev: K8sEvent) -> None:
+        meta = ev.obj.get("metadata", {})
+        labels = get_policy_labels(
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+            "CiliumNetworkPolicy",
+        )
+        if ev.action == "deleted":
+            self.daemon.policy_delete(labels)
+            return
+        self._policy_upsert(parse_cilium_network_policy(ev.obj), labels)
+
+    # -- service resources ---------------------------------------------------
+
+    def _info_for(self, namespace: str, name: str) -> K8sServiceInfo:
+        key = (namespace, name)
+        if key not in self._svc_info:
+            self._svc_info[key] = K8sServiceInfo(
+                name=name, namespace=namespace
+            )
+        return self._svc_info[key]
+
+    def _on_service(self, ev: K8sEvent) -> None:
+        meta = ev.obj.get("metadata", {})
+        namespace = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        key = (namespace, name)
+        if ev.action == "deleted":
+            frontend = self._svc_frontends.pop(key, None)
+            if frontend is not None and self.services is not None:
+                self.services.delete(frontend)
+            info = self._svc_info.pop(key, None)
+            if info is not None:
+                self._retranslate(info, revert=True)
+            return
+        spec = ev.obj.get("spec", {})
+        info = self._info_for(namespace, name)
+        info.labels = dict(spec.get("selector") or {})
+        cluster_ip = spec.get("clusterIP")
+        ports = spec.get("ports") or []
+        if cluster_ip and ports and self.services is not None:
+            port = int(ports[0].get("port", 0))
+            proto = 6 if ports[0].get("protocol", "TCP") == "TCP" else 17
+            frontend = L3n4Addr(cluster_ip, port, proto)
+            self._svc_frontends[key] = frontend
+            self._sync_lb(key)
+
+    def _on_endpoints(self, ev: K8sEvent) -> None:
+        meta = ev.obj.get("metadata", {})
+        namespace = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        info = self._info_for(namespace, name)
+        old_ips = set(info.backend_ips)
+        if ev.action == "deleted":
+            info.backend_ips = set()
+        else:
+            ips = set()
+            for subset in ev.obj.get("subsets") or []:
+                for addr in subset.get("addresses") or []:
+                    if addr.get("ip"):
+                        ips.add(addr["ip"])
+            info.backend_ips = ips
+        self._sync_lb((namespace, name))
+        # live ToServices → ToCIDRSet retranslation + regeneration
+        # (k8s_watcher.go updateK8sEndpointV1 → TranslateRules):
+        # depopulate against the OLD endpoint set, populate the new —
+        # the reference translator carries both (rule_translate.go
+        # RuleTranslator{OldEndpoint, NewEndpoint})
+        stale = old_ips - info.backend_ips
+        if stale:
+            self._retranslate(
+                K8sServiceInfo(
+                    name=name,
+                    namespace=namespace,
+                    backend_ips=stale,
+                    labels=dict(info.labels),
+                ),
+                revert=True,
+            )
+        self._retranslate(info, revert=False)
+
+    def _sync_lb(self, key: Tuple[str, str]) -> None:
+        if self.services is None:
+            return
+        frontend = self._svc_frontends.get(key)
+        info = self._svc_info.get(key)
+        if frontend is None or info is None:
+            return
+        backends = [
+            L3n4Addr(ip, frontend.port, frontend.protocol)
+            for ip in sorted(info.backend_ips)
+        ]
+        self.services.upsert(frontend, backends)
+
+    def _retranslate(self, info: K8sServiceInfo, revert: bool) -> None:
+        with self.daemon.lock:
+            self.daemon.repo.translate_rules(
+                RuleTranslator(info, revert=revert)
+            )
+        self.daemon.trigger_policy_updates(
+            f"service {info.namespace}/{info.name} endpoints", full=True
+        )

@@ -118,6 +118,15 @@ def compile_rules(
         unknown = set(d) - {"opCode", "keyExact", "keyPrefix", "keyRegex"}
         if unknown:
             raise ValueError(f"unsupported keys: {sorted(unknown)}")
+        matchers = [
+            k
+            for k in ("keyExact", "keyPrefix", "keyRegex")
+            if d.get(k, "")
+        ]
+        if len(matchers) > 1:
+            raise ValueError(
+                f"at most one key matcher allowed, got {matchers}"
+            )
         specs.append(
             MemcacheRuleSpec(
                 identity_indices=identity_indices,
@@ -154,7 +163,10 @@ def decode_stream(buf: bytes) -> Tuple[List[L7Request], int]:
     off = 0
     while off + HEADER_SIZE <= len(buf):
         magic = buf[off]
-        if magic & REQUEST_MAGIC != REQUEST_MAGIC:
+        if magic != REQUEST_MAGIC:
+            # includes response magic 0x81 in the request direction:
+            # connection-fatal, as the reference's
+            # ERROR_INVALID_FRAME_TYPE
             raise MemcacheParseError(
                 f"invalid request magic 0x{magic:02x}"
             )
@@ -162,6 +174,11 @@ def decode_stream(buf: bytes) -> Tuple[List[L7Request], int]:
         key_len = struct.unpack_from(">H", buf, off + 2)[0]
         extras_len = buf[off + 4]
         body_len = struct.unpack_from(">I", buf, off + 8)[0]
+        if extras_len + key_len > body_len:
+            raise MemcacheParseError(
+                f"frame claims extras {extras_len} + key {key_len} "
+                f"beyond body length {body_len}"
+            )
         total = HEADER_SIZE + body_len
         if off + total > len(buf):
             break  # MORE
@@ -242,15 +259,10 @@ class MemcacheDeviceTables:
         op_word = (op >> 5).astype(jnp.int32)
         op_bit = (op & 31).astype(jnp.uint32)
         mask = jnp.asarray(self.opcode_mask)  # [R, 8]
-        op_ok = (
-            (mask[None, :, :] >> op_bit[:, None, None])
-            & 1
-        ).astype(bool)  # [B, R, 8] via broadcast, select word below
-        op_ok = jnp.take_along_axis(
-            op_ok,
-            op_word[:, None, None].astype(jnp.int32).repeat(r, axis=1),
-            axis=2,
-        )[:, :, 0]
+        # select each request's mask word first ([B, R]), then test
+        # the bit — no [B, R, 8] intermediate
+        words = mask.T[op_word]  # [B, R]
+        op_ok = ((words >> op_bit[:, None]) & 1).astype(bool)
         op_ok = op_ok & (jnp.asarray(opcode)[:, None] >= 0)
 
         rk = jnp.asarray(self.key_id)[None, :]

@@ -52,7 +52,7 @@ class ParserEntry:
     RegisterL7RuleParser collapsed into one registration)."""
 
     name: str
-    # bytes → ([parsed requests], consumed, deny_frames_fn)
+    # bytes → ([parsed requests], consumed bytes)
     decode_stream: Callable[[bytes], Tuple[List[L7Request], int]]
     # rule dicts + identity indices → list of compiled rule specs
     compile_rules: Callable[[Sequence[dict], Sequence[int]], list]

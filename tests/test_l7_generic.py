@@ -45,8 +45,25 @@ def test_wire_roundtrip_and_partials():
 
 
 def test_wire_rejects_response_magic():
+    for magic in (0x01, 0x81, 0xFF):
+        with pytest.raises(mc.MemcacheParseError):
+            mc.decode_stream(bytes([magic]) + b"\x00" * 23)
+
+
+def test_wire_rejects_key_beyond_body():
+    import struct
+
+    frame = bytearray(mc.encode_request(0, ""))
+    struct.pack_into(">H", frame, 2, 5)  # key_len 5, body_len 0
     with pytest.raises(mc.MemcacheParseError):
-        mc.decode_stream(bytes([0x01]) + b"\x00" * 23)
+        mc.decode_stream(bytes(frame) + mc.encode_request(1, "x"))
+
+
+def test_rule_rejects_multiple_key_matchers():
+    with pytest.raises(ValueError):
+        mc.compile_rules(
+            [{"opCode": "get", "keyExact": "a", "keyPrefix": "b/"}], [0]
+        )
 
 
 def test_rule_matching_host():
