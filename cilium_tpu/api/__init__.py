@@ -1,0 +1,1 @@
+"""REST API server + client (the api/v1 unix-socket seam)."""

@@ -1,0 +1,90 @@
+"""REST API client over the agent's unix socket.
+
+The analog of /root/reference/pkg/client: every CLI command and
+external tool drives a RUNNING daemon through this, instead of
+constructing a private in-memory one."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, socket_path: str, timeout: float = 30.0):
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._socket_path)
+        self.sock = sock
+
+
+class APIClient:
+    """Methods mirror api.server.DaemonAPI — the shared contract."""
+
+    def __init__(self, socket_path: str) -> None:
+        self.socket_path = socket_path
+
+    def _request(self, method: str, path: str, body=None):
+        conn = _UnixHTTPConnection(self.socket_path)
+        try:
+            payload = None
+            headers = {}
+            if body is not None:
+                payload = (
+                    body if isinstance(body, str) else json.dumps(body)
+                )
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = json.loads(resp.read().decode() or "null")
+            if resp.status >= 400:
+                raise RuntimeError(
+                    data.get("error", f"HTTP {resp.status}")
+                    if isinstance(data, dict)
+                    else f"HTTP {resp.status}"
+                )
+            return data
+        finally:
+            conn.close()
+
+    def healthz(self):
+        return self._request("GET", "/healthz")
+
+    def status(self):
+        return self._request("GET", "/status")
+
+    def config_get(self):
+        return self._request("GET", "/config")
+
+    def policy_get(self):
+        return self._request("GET", "/policy")
+
+    def policy_add(self, rules_json: str, replace: bool = False):
+        path = "/policy?replace=1" if replace else "/policy"
+        return self._request("POST", path, body=rules_json)
+
+    def policy_delete(self, labels):
+        return self._request("DELETE", "/policy", body=list(labels))
+
+    def policy_resolve(self, body: dict):
+        return self._request("POST", "/policy/resolve", body=body)
+
+    def endpoint_list(self):
+        return self._request("GET", "/endpoint")
+
+    def endpoint_get(self, endpoint_id: int):
+        return self._request("GET", f"/endpoint/{endpoint_id}")
+
+    def identity_list(self):
+        return self._request("GET", "/identity")
+
+    def ipcache_dump(self):
+        return self._request("GET", "/ipcache")
+
+    def metrics_dump(self):
+        return self._request("GET", "/metrics")

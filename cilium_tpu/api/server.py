@@ -1,0 +1,270 @@
+"""REST API over a unix socket — the api/v1 surface.
+
+The reference agent is driven entirely over a swagger REST API on a
+unix socket (/root/reference/api/v1/openapi.yaml, served by
+daemon/server; consumed by /root/reference/pkg/client and the cilium
+CLI).  This is the matching seam for this framework: a thread-per-
+connection HTTP server on a unix socket exposing the daemon's
+control surface as JSON, so out-of-process clients (cilium_tpu.cli,
+tooling, tests) operate a RUNNING agent instead of a private
+in-memory one.
+
+Routes (the api/v1 subset this framework's daemon implements):
+  GET    /healthz            agent liveness + datapath health probe
+  GET    /status             full agent status (daemon.status())
+  GET    /config             daemon option set
+  GET    /policy             policy repository (revision, rules)
+  POST   /policy             add rules (JSON list; ?replace=1)
+  DELETE /policy             delete by labels (JSON list of labels)
+  POST   /policy/resolve     policy trace (the explain mode)
+  GET    /endpoint           endpoint list
+  GET    /endpoint/{id}      one endpoint
+  GET    /identity           identity cache
+  GET    /ipcache            ipcache dump
+  GET    /metrics            metrics registry dump
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+
+from cilium_tpu.labels import LabelArray
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.policy.api import rules_from_json
+from cilium_tpu.policy.search import Port, SearchContext
+
+
+class DaemonAPI:
+    """The operations behind the routes — shared by the HTTP server
+    and the CLI's in-process fallback, so both speak the same
+    contract (pkg/client's methods mirror this)."""
+
+    def __init__(self, daemon) -> None:
+        self.daemon = daemon
+
+    def healthz(self) -> dict:
+        from cilium_tpu.health import probe_endpoints
+
+        try:
+            probes = probe_endpoints(self.daemon.endpoint_manager)
+            reachable = sum(1 for p in probes if p.reachable)
+            return {
+                "status": "ok",
+                "endpoints": len(probes),
+                "reachable": reachable,
+            }
+        except Exception as exc:
+            return {"status": "degraded", "detail": str(exc)}
+
+    def status(self) -> dict:
+        return self.daemon.status()
+
+    def config_get(self) -> dict:
+        from cilium_tpu import option
+
+        cfg = option.Config
+        return {
+            "policy_enforcement": cfg.policy_enforcement,
+            "options": dict(getattr(cfg, "opts", {}) or {}),
+        }
+
+    def policy_get(self) -> dict:
+        repo = self.daemon.repo
+        return {
+            "revision": repo.get_revision(),
+            "count": repo.num_rules(),
+            "rules": [str(rule) for rule in repo.rules],
+        }
+
+    def policy_add(self, rules_json: str, replace: bool) -> dict:
+        rules = rules_from_json(rules_json)
+        revision = self.daemon.policy_add(rules, replace=replace)
+        return {"revision": revision, "count": len(rules)}
+
+    def policy_delete(self, labels: list) -> dict:
+        revision, deleted = self.daemon.policy_delete(
+            LabelArray.parse(*labels)
+        )
+        return {"revision": revision, "deleted": deleted}
+
+    def policy_resolve(self, body: dict) -> dict:
+        ctx = SearchContext(
+            from_labels=LabelArray.parse_select(
+                *body.get("from", [])
+            ),
+            to_labels=LabelArray.parse_select(*body.get("to", [])),
+            dports=[
+                Port(int(p["port"]), p.get("protocol", "TCP"))
+                for p in body.get("dports", [])
+            ],
+        )
+        verdict, log = self.daemon.policy_resolve(ctx)
+        return {"verdict": str(verdict), "trace": log}
+
+    def endpoint_list(self) -> list:
+        return [
+            {
+                "id": ep.id,
+                "name": ep.name,
+                "ipv4": ep.ipv4,
+                "state": ep.state,
+                "identity": (
+                    ep.security_identity.id
+                    if ep.security_identity
+                    else None
+                ),
+                "policy_revision": ep.policy_revision,
+            }
+            for ep in self.daemon.endpoint_manager.endpoints()
+        ]
+
+    def endpoint_get(self, endpoint_id: int) -> Optional[dict]:
+        for entry in self.endpoint_list():
+            if entry["id"] == endpoint_id:
+                return entry
+        return None
+
+    def identity_list(self) -> dict:
+        return {
+            str(num_id): [str(label) for label in labels]
+            for num_id, labels in self.daemon.identity_cache().items()
+        }
+
+    def ipcache_dump(self) -> dict:
+        return dict(self.daemon.lpm_builder.mappings)
+
+    def metrics_dump(self) -> dict:
+        return {"text": metrics.expose()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # quiet the default stderr access log
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _reply(self, code: int, body) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n).decode() if n else ""
+
+    def do_GET(self) -> None:  # noqa: N802
+        api: DaemonAPI = self.server.api  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/healthz":
+                return self._reply(200, api.healthz())
+            if path == "/status":
+                return self._reply(200, api.status())
+            if path == "/config":
+                return self._reply(200, api.config_get())
+            if path == "/policy":
+                return self._reply(200, api.policy_get())
+            if path == "/endpoint":
+                return self._reply(200, api.endpoint_list())
+            if path.startswith("/endpoint/"):
+                raw = path.rsplit("/", 1)[1]
+                if not raw.isdigit():
+                    return self._reply(404, {"error": "not found"})
+                got = api.endpoint_get(int(raw))
+                if got is None:
+                    return self._reply(404, {"error": "not found"})
+                return self._reply(200, got)
+            if path == "/identity":
+                return self._reply(200, api.identity_list())
+            if path == "/ipcache":
+                return self._reply(200, api.ipcache_dump())
+            if path == "/metrics":
+                return self._reply(200, api.metrics_dump())
+            return self._reply(404, {"error": f"no route {path}"})
+        except Exception as exc:
+            return self._reply(500, {"error": str(exc)})
+
+    def do_POST(self) -> None:  # noqa: N802
+        api: DaemonAPI = self.server.api  # type: ignore
+        path, _, query = self.path.partition("?")
+        try:
+            if path == "/policy":
+                replace = "replace=1" in query
+                return self._reply(
+                    200, api.policy_add(self._body(), replace)
+                )
+            if path == "/policy/resolve":
+                return self._reply(
+                    200, api.policy_resolve(json.loads(self._body()))
+                )
+            return self._reply(404, {"error": f"no route {path}"})
+        except (json.JSONDecodeError, KeyError, ValueError) as exc:
+            return self._reply(400, {"error": f"bad request: {exc}"})
+        except Exception as exc:
+            return self._reply(500, {"error": str(exc)})
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        api: DaemonAPI = self.server.api  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/policy":
+                labels = json.loads(self._body())
+                return self._reply(200, api.policy_delete(labels))
+            return self._reply(404, {"error": f"no route {path}"})
+        except (json.JSONDecodeError, ValueError) as exc:
+            return self._reply(400, {"error": f"bad request: {exc}"})
+        except Exception as exc:
+            return self._reply(500, {"error": str(exc)})
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class APIServer:
+    """Serve a Daemon's API on a unix socket (the cilium.sock)."""
+
+    def __init__(self, daemon, socket_path: str) -> None:
+        if os.path.exists(socket_path):
+            # refuse to hijack a LIVE agent's socket; only reclaim a
+            # stale one (the previous agent died without cleanup)
+            import socket as _socket
+
+            probe = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            try:
+                probe.settimeout(0.5)
+                probe.connect(socket_path)
+                probe.close()
+                raise RuntimeError(
+                    f"another agent is serving on {socket_path}"
+                )
+            except (ConnectionRefusedError, FileNotFoundError, OSError):
+                pass
+            finally:
+                probe.close()
+            os.unlink(socket_path)
+        self.socket_path = socket_path
+        self.api = DaemonAPI(daemon)
+        self._httpd = _UnixHTTPServer(socket_path, _Handler)
+        self._httpd.api = self.api  # type: ignore
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> "APIServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)

@@ -1,111 +1,127 @@
 """cilium-tpu CLI.
 
 Re-design of /root/reference/cilium/cmd (cobra commands over the REST
-API): the same command surface driven in-process against a Daemon —
-policy import/get/delete/trace, endpoint list/get/regenerate,
-identity list, ipcache dump (bpf ipcache analog), service list,
-metrics, status.  `python -m cilium_tpu.cli --help` for usage.
+API): policy import/get/delete/trace, endpoint list/get, identity
+list, ipcache dump, metrics, status — driven through the api/v1
+contract (api.server.DaemonAPI).
+
+Like the reference CLI, commands talk to a RUNNING agent through its
+unix socket (``--socket`` or $CILIUM_TPU_SOCK — run one with
+``python -m cilium_tpu.agent``); without a socket they fall back to a
+self-contained in-process daemon (useful for one-shot policy
+evaluation, the DryMode analog).  Both paths go through the same
+DaemonAPI operations, so output is identical either way.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Optional
 
-from cilium_tpu.daemon import Daemon
-from cilium_tpu.labels import LabelArray
-from cilium_tpu.metrics import registry as metrics
-from cilium_tpu.policy.api import rules_from_json
-from cilium_tpu.policy.search import Port, SearchContext
+SOCK_ENV = "CILIUM_TPU_SOCK"
 
 
-def _daemon() -> Daemon:
-    # CLI sessions are self-contained (the reference talks to the
-    # agent's unix socket; an RPC transport can replace this factory).
-    return Daemon()
+def _api(args):
+    """APIClient against a live agent socket, or DaemonAPI over a
+    fresh in-process daemon (the factory the RPC transport replaces,
+    now actually replaced)."""
+    socket_path = getattr(args, "socket", None) or os.environ.get(
+        SOCK_ENV
+    )
+    if socket_path:
+        from cilium_tpu.api.client import APIClient
+
+        return APIClient(socket_path)
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.daemon import Daemon
+
+    return DaemonAPI(Daemon())
 
 
-def cmd_policy_import(daemon: Daemon, args) -> int:
+def cmd_policy_import(api, args) -> int:
     with open(args.file) as f:
-        rules = rules_from_json(f.read())
-    revision = daemon.policy_add(rules, replace=args.replace)
-    print(f"Revision: {revision}")
+        got = api.policy_add(f.read(), args.replace)
+    print(f"Revision: {got['revision']}")
     return 0
 
 
-def cmd_policy_get(daemon: Daemon, args) -> int:
+def cmd_policy_get(api, args) -> int:
+    got = api.policy_get()
     print(
         json.dumps(
-            {
-                "revision": daemon.repo.get_revision(),
-                "count": daemon.repo.num_rules(),
-            }
+            {"revision": got["revision"], "count": got["count"]}
         )
     )
+    if args.verbose:
+        for rule in got.get("rules", []):
+            print(rule)
     return 0
 
 
-def cmd_policy_delete(daemon: Daemon, args) -> int:
-    labels = LabelArray.parse(*args.labels)
-    revision, deleted = daemon.policy_delete(labels)
-    print(f"Revision: {revision}, deleted: {deleted}")
+def cmd_policy_delete(api, args) -> int:
+    got = api.policy_delete(args.labels)
+    print(f"Revision: {got['revision']}, deleted: {got['deleted']}")
     return 0
 
 
-def cmd_policy_trace(daemon: Daemon, args) -> int:
-    ctx = SearchContext(
-        from_labels=LabelArray.parse_select(*args.src.split(",")),
-        to_labels=LabelArray.parse_select(*args.dst.split(",")),
-        dports=[Port(int(p), "TCP") for p in (args.dport or [])],
+def cmd_policy_trace(api, args) -> int:
+    got = api.policy_resolve(
+        {
+            "from": args.src.split(","),
+            "to": args.dst.split(","),
+            "dports": [
+                {"port": int(p), "protocol": "TCP"}
+                for p in (args.dport or [])
+            ],
+        }
     )
-    verdict, trace = daemon.policy_resolve(ctx)
-    print(trace, end="")
-    print(f"Final verdict: {str(verdict).upper()}")
-    return 0 if str(verdict) == "allowed" else 1
+    print(got["trace"], end="")
+    print(f"Final verdict: {got['verdict'].upper()}")
+    return 0 if got["verdict"] == "allowed" else 1
 
 
-def cmd_endpoint_list(daemon: Daemon, args) -> int:
-    for endpoint in sorted(
-        daemon.endpoint_manager.endpoints(), key=lambda e: e.id
-    ):
-        ident = (
-            endpoint.security_identity.id
-            if endpoint.security_identity
-            else "-"
-        )
+def cmd_endpoint_list(api, args) -> int:
+    for ep in sorted(api.endpoint_list(), key=lambda e: e["id"]):
         print(
-            f"{endpoint.id}\t{endpoint.state}\t{ident}\t"
-            f"{endpoint.ipv4 or '-'}\t{endpoint.name}"
+            f"{ep['id']}\t{ep['state']}\t{ep['identity'] or '-'}\t"
+            f"{ep['ipv4'] or '-'}\t{ep['name']}"
         )
     return 0
 
 
-def cmd_identity_list(daemon: Daemon, args) -> int:
-    for num_id, labels in sorted(daemon.identity_cache().items()):
-        print(f"{num_id}\t{','.join(str(l) for l in labels)}")
+def cmd_identity_list(api, args) -> int:
+    for num_id, labels in sorted(
+        api.identity_list().items(), key=lambda kv: int(kv[0])
+    ):
+        print(f"{num_id}\t{','.join(labels)}")
     return 0
 
 
-def cmd_ipcache_dump(daemon: Daemon, args) -> int:
-    for ip, ident in sorted(daemon.ipcache.ip_to_identity.items()):
-        print(f"{ip}\t{ident.id}\t{ident.source}")
+def cmd_ipcache_dump(api, args) -> int:
+    for cidr, ident in sorted(api.ipcache_dump().items()):
+        print(f"{cidr}\t{ident}")
     return 0
 
 
-def cmd_status(daemon: Daemon, args) -> int:
-    print(json.dumps(daemon.status(), indent=2))
+def cmd_status(api, args) -> int:
+    print(json.dumps(api.status(), indent=2))
     return 0
 
 
-def cmd_metrics(daemon: Daemon, args) -> int:
-    print(metrics.expose(), end="")
+def cmd_metrics(api, args) -> int:
+    print(api.metrics_dump()["text"], end="")
     return 0
 
 
 def make_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="cilium-tpu")
+    parser.add_argument(
+        "--socket",
+        default=None,
+        help=f"agent unix socket (default: ${SOCK_ENV})",
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("policy")
@@ -115,6 +131,7 @@ def make_parser() -> argparse.ArgumentParser:
     imp.add_argument("--replace", action="store_true")
     imp.set_defaults(func=cmd_policy_import)
     get = psub.add_parser("get")
+    get.add_argument("--verbose", action="store_true")
     get.set_defaults(func=cmd_policy_get)
     dele = psub.add_parser("delete")
     dele.add_argument("labels", nargs="+")
@@ -147,9 +164,9 @@ def make_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def main(argv=None, daemon: Optional[Daemon] = None) -> int:
+def main(argv=None, api=None) -> int:
     args = make_parser().parse_args(argv)
-    return args.func(daemon or _daemon(), args)
+    return args.func(api or _api(args), args)
 
 
 if __name__ == "__main__":

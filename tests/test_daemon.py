@@ -368,20 +368,23 @@ def test_cli_flow(tmp_path, capsys):
     f = tmp_path / "policy.json"
     f.write_text(rules_json)
 
-    assert cli.main(["policy", "import", str(f)], daemon=d) == 0
+    from cilium_tpu.api.server import DaemonAPI
+
+    api = DaemonAPI(d)
+    assert cli.main(["policy", "import", str(f)], api=api) == 0
     wait_trigger(d)
     assert d.repo.num_rules() == 1
 
     rc = cli.main(
         ["policy", "trace", "--src", "app=client", "--dst", "app=server"],
-        daemon=d,
+        api=api,
     )
     out = capsys.readouterr().out
     assert rc == 0 and "Final verdict: ALLOWED" in out
 
-    assert cli.main(["endpoint", "list"], daemon=d) == 0
-    assert cli.main(["status"], daemon=d) == 0
-    assert cli.main(["ipcache", "dump"], daemon=d) == 0
+    assert cli.main(["endpoint", "list"], api=api) == 0
+    assert cli.main(["status"], api=api) == 0
+    assert cli.main(["ipcache", "dump"], api=api) == 0
     out = capsys.readouterr().out
     assert "10.0.0.1" in out
 
