@@ -70,6 +70,7 @@ class Daemon:
         kvstore: Optional[KVStore] = None,
         state_dir: Optional[str] = None,
         num_workers: int = 4,
+        dns_resolver=None,
     ) -> None:
         self.node_name = node_name
         self.lock = threading.RLock()
@@ -108,13 +109,16 @@ class Daemon:
         self.policy_trigger = Trigger(
             self._regenerate_for_reasons, name="policy_update"
         )
-        # ToFQDNs poller (daemon.go NewDaemon: d.dnsPoller); resolver
-        # injectable — None disables generation (DryMode-ish default)
-        from cilium_tpu.fqdn import DNSPoller
+        # ToFQDNs poller (daemon.go NewDaemon: d.dnsPoller).  The
+        # resolver defaults to the REAL host stack
+        # (fqdn.system_resolver ≙ dnspoller.go LookupIPs); tests
+        # inject deterministic resolvers.  Polling starts only when
+        # ToFQDNs rules are marked, so hermetic runs never touch DNS.
+        from cilium_tpu.fqdn import DNSPoller, system_resolver
 
         self.dns_poller = DNSPoller(
             policy_add=lambda rules: self.policy_add(rules, replace=True),
-            resolver=lambda name: [],
+            resolver=dns_resolver or system_resolver,
         )
         # CIDR prefix-length refcounts (daemon.go createPrefixLengthCounter)
         self.prefix_lengths: _Counter = _Counter()
@@ -177,8 +181,12 @@ class Daemon:
             except Exception:
                 metrics.policy_import_errors.inc()
                 raise
-            # MarkToFQDNRules (daemon/policy.go:172)
+            # MarkToFQDNRules (daemon/policy.go:172); the poll loop
+            # spins up lazily on the first ToFQDNs rule, so hermetic
+            # runs without such rules never touch DNS
             self.dns_poller.mark_to_fqdn_rules(rules)
+            if self.dns_poller.managed and not self.dns_poller.running:
+                self.dns_poller.start()
             prefixes = get_cidr_prefixes(rules)
             import ipaddress
 
@@ -293,7 +301,11 @@ class Daemon:
         # the fleet compiler's index space (the published tables'
         # id_direct), not a sorted rebuild.
         id_index, n_identities = self.endpoint_manager.identity_index()
+        from cilium_tpu.utils.completion import WaitGroup
+
+        wait_group = WaitGroup()
         dirty = False
+        attempted = []  # (endpoint, realized map before this attempt)
         for endpoint in self.endpoint_manager.endpoints():
             l4 = endpoint.desired_l4_policy
             if l4 is None or not l4.has_redirect():
@@ -306,11 +318,28 @@ class Daemon:
             before = dict(endpoint.realized_redirects)
             realized = self.proxy.update_endpoint_redirects(
                 endpoint, cache, id_index, n_identities,
-                self.selector_cache,
+                self.selector_cache, wait_group=wait_group,
             )
+            attempted.append((endpoint, before))
             if realized != before:
                 endpoint.force_policy_compute = True
                 dirty = True
+        # ACK gate (pkg/completion + pkg/envoy/xds/ack.go): the table
+        # flip below happens only once EVERY submitted matcher
+        # compile — port change or not — has ACKed its version; on
+        # timeout or NACK the regeneration FAILS: realized redirect
+        # state rolls back so old redirects and old published tables
+        # keep serving, and the retry flag makes the next trigger
+        # re-attempt (pkg/endpoint/bpf.go:442, policy.go:770-775)
+        if wait_group.pending and not wait_group.wait(
+            timeout=option.Config.redirect_ack_timeout
+        ):
+            metrics.endpoint_regenerations.inc("fail")
+            for endpoint, before in attempted:
+                endpoint.realized_redirects = before
+                endpoint.force_policy_compute = True
+            stats.span("total").end()
+            return n
         if dirty:
             self.endpoint_manager.regenerate_all(
                 self.repo,

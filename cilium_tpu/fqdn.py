@@ -35,6 +35,26 @@ def has_to_fqdns(rule: Rule) -> bool:
     return any(e.to_fqdns for e in rule.egress)
 
 
+def system_resolver(name: str) -> List[str]:
+    """Resolve via the host stack (the reference's DNSPoller uses the
+    Go resolver the same way, pkg/fqdn/dnspoller.go LookupIPs).
+    Returns [] on failure — an unresolvable name simply generates no
+    toCIDRSet entries this poll, like a DNS timeout in the
+    reference."""
+    import socket
+
+    try:
+        infos = socket.getaddrinfo(name, None, proto=socket.IPPROTO_TCP)
+    except (socket.gaierror, OSError):
+        return []
+    out = []
+    for _family, _type, _proto, _canon, addr in infos:
+        ip = addr[0]
+        if ip not in out:
+            out.append(ip)
+    return out
+
+
 class DNSPoller:
     def __init__(
         self,
@@ -61,6 +81,15 @@ class DNSPoller:
                 if has_to_fqdns(rule):
                     key = ",".join(str(l) for l in rule.labels)
                     self._rules[key] = copy.deepcopy(rule)
+
+    @property
+    def managed(self) -> bool:
+        with self._lock:
+            return bool(self._rules)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
 
     def stop_managing(self, label_key: str) -> None:
         with self._lock:

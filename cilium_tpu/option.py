@@ -73,6 +73,10 @@ class DaemonConfig:
     # (config.go:183).
     host_allows_world: bool = False
     dry_mode: bool = False
+    # EndpointGenerationTimeout (pkg/endpoint/bpf.go:442): how long a
+    # regeneration waits for proxy redirect ACKs before failing and
+    # keeping old state
+    redirect_ack_timeout: float = 30.0
     opts: OptionMap = field(default_factory=OptionMap)
 
     # TPU-side knobs (compiler cache key components).

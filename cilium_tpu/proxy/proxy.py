@@ -54,6 +54,15 @@ PARSER_KAFKA = "kafka"
 
 
 @dataclass
+class _PidState:
+    """Per-proxy-id bookkeeping (see Proxy._pids)."""
+
+    port: int
+    endpoint_id: int
+    gen: int = 0
+
+
+@dataclass
 class Redirect:
     """proxy.go Redirect."""
 
@@ -81,6 +90,19 @@ class Proxy:
         self._port_max = port_max
         self._next_port = port_min
         self._ports_in_use: set = set()
+        # pid → (stable port, compile generation, endpoint) — a pid
+        # owns its port from first allocation to remove_redirect,
+        # even while a compile is pending, and only the NEWEST
+        # generation's result may be installed
+        self._pids: Dict[str, _PidState] = {}
+        # matcher compiles ACK asynchronously (the NPDS push → Envoy
+        # ACK shape, pkg/envoy/xds/ack.go): one worker keeps update
+        # order per the reference's serialized xDS stream
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._compiler = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="proxy-compile"
+        )
 
     # -- port allocation (proxy.go allocatePort) ----------------------------
 
@@ -106,90 +128,142 @@ class Proxy:
         id_index: Dict[int, int],
         n_identities: int,
         selector_cache=None,
+        wait_group=None,
     ) -> Redirect:
         """proxy.go:153: compile (or recompile) the L7 matcher for one
-        redirect; the proxy port is stable across updates."""
+        redirect; the proxy port is stable across updates (including
+        pending ones — the pid owns its port until remove_redirect).
+
+        Rule/selector resolution happens SYNCHRONOUSLY on the caller
+        (no shared control-plane state crosses threads); with
+        `wait_group` (a utils.completion.WaitGroup) the tensor compile
+        runs ASYNC and the new redirect is swapped in — and its
+        completion ACKed — only when the compile finishes AND this
+        call has not been superseded or removed: the xDS version-ACK
+        contract (pkg/envoy/xds/ack.go).  A failed compile NACKs, so
+        the waiter fails fast; the OLD redirect keeps serving either
+        way — a timed-out regeneration keeps old state
+        (pkg/endpoint/bpf.go:442)."""
         with self._lock:
-            existing = self.redirects.get(pid)
-            port = (
-                existing.proxy_port if existing else self._allocate_port()
-            )
-            redirect = Redirect(
-                id=pid,
-                proxy_port=port,
-                parser=l4.l7_parser or PARSER_HTTP,
-                endpoint_id=endpoint_id,
-                ingress=l4.ingress,
-            )
-            try:
-                if redirect.parser == PARSER_KAFKA:
-                    specs = []
-                    for selector, l7 in l4.l7_rules_per_ep.items():
-                        indices = resolve_selector_indices(
-                            selector,
-                            identity_cache,
-                            id_index,
-                            selector_cache,
-                        )
-                        if not (l7.kafka or []):
-                            # empty rules = L7 allow-all: wildcard spec
-                            from cilium_tpu.l7.kafka import KafkaRuleSpec
-
-                            specs.append(
-                                KafkaRuleSpec(identity_indices=indices)
-                            )
-                        for rule in l7.kafka or []:
-                            specs.append(
-                                rule_spec_from_port_rule(rule, indices)
-                            )
-                    redirect.kafka_tables = compile_kafka_rules(
-                        specs, n_identities
-                    )
-                elif redirect.parser not in (PARSER_HTTP, ""):
-                    # generic proxylib parser, dispatched by l7proto
-                    # name (proxy.go:217 createOrUpdateRedirect →
-                    # proxylib); bundled parsers register at
-                    # cilium_tpu.l7 import time
-                    from cilium_tpu.l7.proxylib import (
-                        compile_generic_rules,
-                    )
-
-                    per_selector = []
-                    for selector, l7 in l4.l7_rules_per_ep.items():
-                        indices = resolve_selector_indices(
-                            selector,
-                            identity_cache,
-                            id_index,
-                            selector_cache,
-                        )
-                        per_selector.append((indices, list(l7.l7 or [])))
-                    redirect.generic_tables = compile_generic_rules(
-                        redirect.parser, per_selector, n_identities
-                    )
-                else:
-                    specs = specs_from_filter(
-                        l4, identity_cache, id_index, selector_cache
-                    )
-                    redirect.http_policy = compile_http_rules(
-                        specs, n_identities
-                    )
-            except Exception:
-                # a failed compile must not leak the allocated port:
-                # update_endpoint_redirects retries on every policy
-                # recompute and would drain the pool
-                if existing is None:
-                    self._ports_in_use.discard(port)
-                raise
-            self.redirects[pid] = redirect
+            state = self._pids.get(pid)
+            if state is None:
+                state = _PidState(
+                    port=self._allocate_port(),
+                    endpoint_id=endpoint_id,
+                )
+                self._pids[pid] = state
+            state.gen += 1
+            gen = state.gen
+            port = state.port
+        redirect = Redirect(
+            id=pid,
+            proxy_port=port,
+            parser=l4.l7_parser or PARSER_HTTP,
+            endpoint_id=endpoint_id,
+            ingress=l4.ingress,
+        )
+        # resolve the rules here, on the regeneration thread — the
+        # async job must not read live selector/identity caches
+        resolved = self._resolve_matcher_inputs(
+            redirect, l4, identity_cache, id_index, selector_cache
+        )
+        if wait_group is None:
+            self._compile_tables(redirect, resolved, n_identities)
+            with self._lock:
+                if self._pids.get(pid) is state and state.gen == gen:
+                    self.redirects[pid] = redirect
             return redirect
 
+        completion = wait_group.add_completion()
+
+        def job() -> None:
+            try:
+                self._compile_tables(redirect, resolved, n_identities)
+            except Exception:
+                completion.fail()  # NACK: the waiter fails fast
+                return
+            with self._lock:
+                # superseded by a newer compile, or removed: do not
+                # resurrect — the newest generation wins
+                if self._pids.get(pid) is state and state.gen == gen:
+                    self.redirects[pid] = redirect
+            completion.complete()
+
+        self._compiler.submit(job)
+        return redirect
+
+    def _resolve_matcher_inputs(
+        self,
+        redirect: Redirect,
+        l4: L4Filter,
+        identity_cache: IdentityCache,
+        id_index: Dict[int, int],
+        selector_cache=None,
+    ):
+        """Selector → identity-index resolution (control-plane state;
+        must run on the regeneration thread)."""
+        if redirect.parser == PARSER_KAFKA:
+            specs = []
+            for selector, l7 in l4.l7_rules_per_ep.items():
+                indices = resolve_selector_indices(
+                    selector, identity_cache, id_index, selector_cache
+                )
+                if not (l7.kafka or []):
+                    # empty rules = L7 allow-all: wildcard spec
+                    from cilium_tpu.l7.kafka import KafkaRuleSpec
+
+                    specs.append(
+                        KafkaRuleSpec(identity_indices=indices)
+                    )
+                for rule in l7.kafka or []:
+                    specs.append(
+                        rule_spec_from_port_rule(rule, indices)
+                    )
+            return specs
+        if redirect.parser not in (PARSER_HTTP, ""):
+            # generic proxylib parser, dispatched by l7proto name
+            # (proxy.go:217 createOrUpdateRedirect → proxylib);
+            # bundled parsers register at cilium_tpu.l7 import time
+            per_selector = []
+            for selector, l7 in l4.l7_rules_per_ep.items():
+                indices = resolve_selector_indices(
+                    selector, identity_cache, id_index, selector_cache
+                )
+                per_selector.append((indices, list(l7.l7 or [])))
+            return per_selector
+        return specs_from_filter(
+            l4, identity_cache, id_index, selector_cache
+        )
+
+    def _compile_tables(
+        self, redirect: Redirect, resolved, n_identities: int
+    ) -> None:
+        """Tensor compile from pre-resolved inputs (pure; safe off
+        the control-plane thread)."""
+        if redirect.parser == PARSER_KAFKA:
+            redirect.kafka_tables = compile_kafka_rules(
+                resolved, n_identities
+            )
+        elif redirect.parser not in (PARSER_HTTP, ""):
+            from cilium_tpu.l7.proxylib import compile_generic_rules
+
+            redirect.generic_tables = compile_generic_rules(
+                redirect.parser, resolved, n_identities
+            )
+        else:
+            redirect.http_policy = compile_http_rules(
+                resolved, n_identities
+            )
+
     def remove_redirect(self, pid: str) -> bool:
-        """proxy.go RemoveRedirect."""
+        """proxy.go RemoveRedirect: releases the pid's port and
+        invalidates any in-flight compile for it."""
         with self._lock:
-            redirect = self.redirects.pop(pid, None)
-            if redirect is None:
+            state = self._pids.pop(pid, None)
+            self.redirects.pop(pid, None)
+            if state is None:
                 return False
-            self._ports_in_use.discard(redirect.proxy_port)
+            self._ports_in_use.discard(state.port)
             return True
 
     def redirect_for(
@@ -334,6 +408,7 @@ class Proxy:
         id_index: Dict[int, int],
         n_identities: int,
         selector_cache=None,
+        wait_group=None,
     ) -> Dict[str, int]:
         """addNewRedirects/removeOldRedirects for one endpoint; returns
         the realized proxy-id → port map to feed back into the next
@@ -352,14 +427,17 @@ class Proxy:
                     redirect = self.create_or_update_redirect(
                         f, pid, endpoint.id, identity_cache, id_index,
                         n_identities, selector_cache,
+                        wait_group=wait_group,
                     )
                     realized[pid] = redirect.proxy_port
                     wanted.add(pid)
-        for pid in [
-            p
-            for p, r in self.redirects.items()
-            if r.endpoint_id == endpoint.id and p not in wanted
-        ]:
+        with self._lock:
+            stale = [
+                p
+                for p, st in self._pids.items()
+                if st.endpoint_id == endpoint.id and p not in wanted
+            ]
+        for pid in stale:
             self.remove_redirect(pid)
         endpoint.realized_redirects = realized
         return realized

@@ -15,13 +15,24 @@ from typing import List, Optional
 class Completion:
     def __init__(self) -> None:
         self._event = threading.Event()
+        self._failed = False
 
     def complete(self) -> None:
         self._event.set()
 
+    def fail(self) -> None:
+        """NACK (xds/ack.go's NACK path): the waiter returns False
+        immediately instead of blocking out the timeout."""
+        self._failed = True
+        self._event.set()
+
     @property
     def completed(self) -> bool:
-        return self._event.is_set()
+        return self._event.is_set() and not self._failed
+
+    @property
+    def failed(self) -> bool:
+        return self._failed
 
 
 class WaitGroup:
@@ -50,4 +61,11 @@ class WaitGroup:
             )
             if not c._event.wait(timeout=remaining):
                 return False
+            if c.failed:
+                return False
         return True
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._completions)

@@ -470,3 +470,102 @@ def test_kafka_overflow_rows_force_denied_on_device():
     assert not bool(dev[0])  # device alone: deny
     full = evaluate_with_host_fallback(tables, [big], ident, known)
     assert bool(full[0])  # host fallback restores the true allow
+
+
+def test_ack_gated_publish_timeout_keeps_old_state(monkeypatch):
+    """pkg/completion + pkg/envoy/xds/ack.go wiring: a redirect
+    matcher compile that never ACKs fails the regeneration within
+    EndpointGenerationTimeout — realized redirect state rolls back,
+    the OLD redirect tables keep serving, the fail metric increments
+    — and unblocking lets the next trigger succeed with the new
+    tables."""
+    import threading
+    import time
+
+    from cilium_tpu import option
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.metrics import registry as metrics
+    from cilium_tpu.proxy.proxy import Proxy
+
+    from tests.test_daemon import es_k8s, k8s_labels, wait_trigger
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.policy.api import (
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.rule import L7Rules, PortRuleHTTP
+
+    monkeypatch.setattr(option.Config, "redirect_ack_timeout", 0.3)
+
+    d = Daemon()
+    d.create_endpoint(1, k8s_labels(app="api"), ipv4="10.5.0.1")
+    d.create_endpoint(2, k8s_labels(app="ui"), ipv4="10.5.0.2")
+
+    def http_rule(path):
+        return Rule(
+            endpoint_selector=es_k8s(app="api"),
+            ingress=[
+                IngressRule(
+                    from_endpoints=[es_k8s(app="ui")],
+                    to_ports=[
+                        PortRule(
+                            ports=[
+                                PortProtocol(port="80", protocol="TCP")
+                            ],
+                            rules=L7Rules(
+                                http=[PortRuleHTTP(path=path)]
+                            ),
+                        )
+                    ],
+                )
+            ],
+            labels=LabelArray.parse("ack-rule"),
+        )
+
+    # first revision compiles and ACKs normally
+    d.policy_add([http_rule("/v1/.*")], replace=True)
+    wait_trigger(d)
+    redirect = d.proxy.redirect_for(1, True, "TCP", 80)
+    assert redirect is not None
+    old_policy = redirect.http_policy
+    before_realized = dict(
+        d.endpoint_manager.lookup(1).realized_redirects
+    )
+    assert before_realized  # the port map is realized
+
+    # block the NEXT tensor compile: the ACK never arrives
+    gate = threading.Event()
+    orig = Proxy._compile_tables
+
+    def blocking(self, *a, **kw):
+        gate.wait()
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(Proxy, "_compile_tables", blocking)
+    fails_before = metrics.endpoint_regenerations.get("fail")
+    d.policy_add([http_rule("/v2/.*")], replace=True)
+    t0 = time.monotonic()
+    d.regenerate_all("ack test")
+    elapsed = time.monotonic() - t0
+    # the gate actually fired: we waited out the (shortened) timeout
+    assert elapsed >= 0.3
+    assert metrics.endpoint_regenerations.get("fail") == fails_before + 1
+    # old state keeps serving: same redirect tables, rolled-back map
+    stuck = d.proxy.redirect_for(1, True, "TCP", 80)
+    assert stuck is not None
+    assert stuck.http_policy is old_policy
+    assert (
+        d.endpoint_manager.lookup(1).realized_redirects
+        == before_realized
+    )
+
+    # unblock; the retry succeeds and swaps the new tables in
+    monkeypatch.setattr(Proxy, "_compile_tables", orig)
+    gate.set()
+    d.regenerate_all("retry")
+    # drain the async compiler queue (the blocked job + the retry)
+    d.proxy._compiler.submit(lambda: None).result(timeout=5)
+    fresh = d.proxy.redirect_for(1, True, "TCP", 80)
+    assert fresh.http_policy is not old_policy
