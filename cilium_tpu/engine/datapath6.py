@@ -1,0 +1,483 @@
+"""The fused IPv6 datapath program.
+
+The reference datapath is dual-stack with SEPARATE per-family
+programs (bpf_lxc.c:754 ipv6_policy beside ipv4_policy; eps.h:70
+ipcache_lookup6; conntrack.h ct_lookup6) — this module is the v6
+sibling of engine/datapath.py, sharing the policy lattice and the
+bucket-row design:
+
+  * prefilter6: broadcast limb-masked range compare (zero gathers);
+  * CT6: direction-normalized bucket rows — entries carry 4-limb
+    address pairs (11 × u32 stride, 11 entries per 128-lane row),
+    one row gather answers forward+reverse probes;
+  * ipcache6: ipcache/lpm6.IPCache6Device (bucketized /128s +
+    broadcast ranges);
+  * the SAME policy lattice tables as v4 (identities are
+    family-agnostic, as in the reference's shared policymap).
+
+Service LB for v6 (lb6_local) is not yet lowered to the device; v6
+service flows should stay on the host path until it is (tracked as
+follow-up work — the v4 LB design generalizes limb-for-limb).
+
+Mixed v4/v6 batches run each family through its own program, exactly
+as packets hit one of the reference's two program sections.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cilium_tpu.ct.table import (
+    CT_EGRESS,
+    CT_ESTABLISHED,
+    CT_INGRESS,
+    CT_NEW,
+    CT_RELATED,
+    CT_REPLY,
+    CTMap,
+    CTTuple,
+    TUPLE_F_IN,
+    TUPLE_F_OUT,
+    TUPLE_F_RELATED,
+)
+from cilium_tpu.engine.hashtable import _fnv1a_host, fnv1a_device
+from cilium_tpu.engine.verdict import TupleBatch, _combine, _probes
+from cilium_tpu.identity import RESERVED_WORLD
+from cilium_tpu.ipcache.lpm6 import (
+    IPCache6Device,
+    build_limb_ranges,
+    ipcache6_lookup,
+    limbs_of_int,
+    match_limb_ranges,
+)
+from cilium_tpu.maps.policymap import INGRESS
+
+CT6_ENTRY_WORDS = 11
+CT6_PER_BUCKET = 128 // CT6_ENTRY_WORDS  # 11
+CT6_BUCKET_LOAD = 2
+CT6_STASH = 128
+_SWAPPED_BIT = 1 << 7
+_EMPTY_W = np.uint32(0xFFFFFFFF)  # marker in the proto|flags plane
+
+
+_limbs = limbs_of_int
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class FlowBatch6:
+    """Raw v6 5-tuples: addresses as u32 [B, 4] limb arrays."""
+
+    ep_index: jax.Array  # i32 [B]
+    saddr: jax.Array  # u32 [B, 4]
+    daddr: jax.Array  # u32 [B, 4]
+    sport: jax.Array  # i32 [B]
+    dport: jax.Array  # i32 [B]
+    proto: jax.Array  # i32 [B]
+    direction: jax.Array  # i32 [B]
+    is_fragment: jax.Array  # bool [B]
+
+    def tree_flatten(self):
+        return (
+            (
+                self.ep_index,
+                self.saddr,
+                self.daddr,
+                self.sport,
+                self.dport,
+                self.proto,
+                self.direction,
+                self.is_fragment,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def from_numpy(
+        ep_index, saddr, daddr, sport, dport, proto, direction,
+        is_fragment=None,
+    ) -> "FlowBatch6":
+        b = len(ep_index)
+        if is_fragment is None:
+            is_fragment = np.zeros(b, dtype=bool)
+        return FlowBatch6(
+            ep_index=jnp.asarray(ep_index, dtype=jnp.int32),
+            saddr=jnp.asarray(saddr, dtype=jnp.uint32),
+            daddr=jnp.asarray(daddr, dtype=jnp.uint32),
+            sport=jnp.asarray(sport, dtype=jnp.int32),
+            dport=jnp.asarray(dport, dtype=jnp.int32),
+            proto=jnp.asarray(proto, dtype=jnp.int32),
+            direction=jnp.asarray(direction, dtype=jnp.int32),
+            is_fragment=jnp.asarray(is_fragment, dtype=bool),
+        )
+
+
+@dataclass
+class Prefilter6:
+    """Broadcast limb ranges (the v6 face of prefilter.py)."""
+
+    base: np.ndarray  # u32 [P, 4]
+    mask: np.ndarray  # u32 [P, 4]
+
+    def tree_flatten(self):
+        return ((self.base, self.mask), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@dataclass
+class CT6Snapshot:
+    """v6 conntrack bucket rows (pytree; planar 11-entry stride)."""
+
+    buckets: np.ndarray  # u32 [Cb, 128]
+    stash: np.ndarray  # u32 [S, CT6_ENTRY_WORDS]
+    n_buckets: int
+
+    def tree_flatten(self):
+        return ((self.buckets, self.stash), self.n_buckets)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+jax.tree_util.register_pytree_node(
+    Prefilter6,
+    lambda t: t.tree_flatten(),
+    lambda aux, ch: Prefilter6.tree_unflatten(aux, ch),
+)
+jax.tree_util.register_pytree_node(
+    CT6Snapshot,
+    lambda t: t.tree_flatten(),
+    lambda aux, ch: CT6Snapshot.tree_unflatten(aux, ch),
+)
+
+
+def build_prefilter6(cidrs) -> Prefilter6:
+    import ipaddress
+
+    from cilium_tpu.ipcache.lpm6 import _mask_limbs, ip6_limbs
+
+    nets = []
+    for c in sorted(cidrs):
+        net = ipaddress.ip_network(c, strict=False)
+        if net.version != 6:
+            continue
+        nets.append(
+            (
+                ip6_limbs(str(net.network_address)),
+                _mask_limbs(net.prefixlen),
+            )
+        )
+    base, mask = build_limb_ranges(nets)
+    return Prefilter6(base=base, mask=mask)
+
+
+def prefilter6_drop(pf: Prefilter6, limbs) -> "jax.Array":
+    return jnp.any(match_limb_ranges(pf.base, pf.mask, limbs), axis=1)
+
+
+# -- CT6 ---------------------------------------------------------------------
+
+
+def _normalize_host6(daddr: int, saddr: int, dport: int, sport: int):
+    if (daddr, dport) > (saddr, sport):
+        return saddr, daddr, sport, dport, 1
+    return daddr, saddr, dport, sport, 0
+
+
+def compile_ct6(ct: CTMap) -> CT6Snapshot:
+    """Host CT (CTTuple addresses as 128-bit ints) → v6 bucket rows.
+    Shapes pinned by ct.max_entries like the v4 compile."""
+    per = CT6_PER_BUCKET
+    # load 2 of 11 lanes ≈ the v4 envelope's 4-of-25 fill ratio, so
+    # the Poisson spill into the fixed stash stays negligible at the
+    # full max_entries envelope
+    nb = 16
+    while nb * CT6_BUCKET_LOAD < max(ct.max_entries, 1):
+        nb *= 2
+    buckets = np.zeros((nb, 128), dtype=np.uint32)
+    buckets[:, 9 * per : 10 * per] = _EMPTY_W  # proto|flags plane
+    stash = np.zeros((CT6_STASH, CT6_ENTRY_WORDS), dtype=np.uint32)
+    stash[:, 9] = _EMPTY_W
+    fill = [0] * nb
+    sfill = 0
+    for key, entry in ct.entries.items():
+        lo_a, hi_a, lo_p, hi_p, swapped = _normalize_host6(
+            key.daddr, key.saddr, key.dport, key.sport
+        )
+        lo = _limbs(lo_a)
+        hi = _limbs(hi_a)
+        words = np.array(
+            [[*lo, *hi, ((lo_p & 0xFFFF) << 16) | (hi_p & 0xFFFF),
+              key.nexthdr & 0xFF]],
+            dtype=np.uint32,
+        )
+        h = int(_fnv1a_host(words)[0])
+        packed = (
+            *lo,
+            *hi,
+            ((lo_p & 0xFFFF) << 16) | (hi_p & 0xFFFF),
+            ((key.nexthdr & 0xFF) << 8)
+            | (swapped * _SWAPPED_BIT)
+            | (key.flags & 0x7F),
+            ((entry.rev_nat_index & 0xFFFF) << 16)
+            | (entry.slave & 0xFFFF),
+        )
+        b = h & (nb - 1)
+        if fill[b] < per:
+            i = fill[b]
+            for k in range(CT6_ENTRY_WORDS):
+                buckets[b, k * per + i] = packed[k]
+            fill[b] += 1
+        elif sfill < CT6_STASH:
+            stash[sfill] = packed
+            sfill += 1
+        else:
+            raise ValueError("CT6 bucket and stash overflow")
+    return CT6Snapshot(buckets=buckets, stash=stash, n_buckets=nb)
+
+
+def ct6_lookup_batch(
+    snapshot: CT6Snapshot,
+    daddr,  # u32 [B, 4]
+    saddr,
+    dport,
+    sport,
+    proto,
+    direction,
+    related_icmp=None,
+):
+    """ct_lookup6: one bucket row gather, forward+reverse lane
+    compares (the v4 kernel generalized limb-for-limb)."""
+    base_flags = jnp.where(
+        direction == CT_INGRESS, TUPLE_F_OUT, TUPLE_F_IN
+    ).astype(jnp.uint32)
+    if related_icmp is not None:
+        base_flags = base_flags | jnp.where(
+            jnp.asarray(related_icmp), jnp.uint32(TUPLE_F_RELATED), 0
+        ).astype(jnp.uint32)
+    rev_flags = base_flags ^ jnp.uint32(TUPLE_F_IN)
+
+    daddr = daddr.astype(jnp.uint32)
+    saddr = saddr.astype(jnp.uint32)
+    dport_u = dport.astype(jnp.uint32) & 0xFFFF
+    sport_u = sport.astype(jnp.uint32) & 0xFFFF
+
+    # lexicographic address-pair normalization over limbs, then port
+    d_gt = jnp.zeros(daddr.shape[0], bool)
+    d_eq = jnp.ones(daddr.shape[0], bool)
+    for k in range(4):
+        d_gt = d_gt | (d_eq & (daddr[:, k] > saddr[:, k]))
+        d_eq = d_eq & (daddr[:, k] == saddr[:, k])
+    swapped = d_gt | (d_eq & (dport_u > sport_u))
+    pairs_equal = d_eq & (dport_u == sport_u)
+
+    lo = jnp.where(swapped[:, None], saddr, daddr)
+    hi = jnp.where(swapped[:, None], daddr, saddr)
+    lo_p = jnp.where(swapped, sport_u, dport_u)
+    hi_p = jnp.where(swapped, dport_u, sport_u)
+    proto_u = proto.astype(jnp.uint32) & 0xFF
+
+    h = fnv1a_device(
+        jnp.concatenate(
+            [lo, hi, ((lo_p << 16) | hi_p)[:, None], proto_u[:, None]],
+            axis=1,
+        )
+    )
+    bucket = (h & jnp.uint32(snapshot.n_buckets - 1)).astype(jnp.int32)
+    rows = jnp.asarray(snapshot.buckets)[bucket]  # [B, 128]
+    per = CT6_PER_BUCKET
+
+    def plane(k):
+        return rows[:, k * per : (k + 1) * per]
+
+    key_eq = jnp.ones((daddr.shape[0], per), bool)
+    for k in range(4):
+        key_eq = key_eq & (plane(k) == lo[:, k : k + 1])
+        key_eq = key_eq & (plane(4 + k) == hi[:, k : k + 1])
+    key_eq = key_eq & (plane(8) == ((lo_p << 16) | hi_p)[:, None])
+
+    fwd_sw = swapped & ~pairs_equal
+    rev_sw = ~swapped & ~pairs_equal
+    w9_fwd = (
+        (proto_u << 8)
+        | (fwd_sw.astype(jnp.uint32) * _SWAPPED_BIT)
+        | base_flags
+    )
+    w9_rev = (
+        (proto_u << 8)
+        | (rev_sw.astype(jnp.uint32) * _SWAPPED_BIT)
+        | rev_flags
+    )
+    fwd_hit = key_eq & (plane(9) == w9_fwd[:, None])
+    rev_hit = key_eq & (plane(9) == w9_rev[:, None])
+
+    stash = jnp.asarray(snapshot.stash)
+    s_key = jnp.ones((daddr.shape[0], stash.shape[0]), bool)
+    for k in range(4):
+        s_key = s_key & (stash[None, :, k] == lo[:, k : k + 1])
+        s_key = s_key & (stash[None, :, 4 + k] == hi[:, k : k + 1])
+    s_key = s_key & (stash[None, :, 8] == ((lo_p << 16) | hi_p)[:, None])
+    s_fwd = s_key & (stash[None, :, 9] == w9_fwd[:, None])
+    s_rev = s_key & (stash[None, :, 9] == w9_rev[:, None])
+
+    def pick(hits, s_hits):
+        return jnp.sum(
+            jnp.where(hits, plane(10), 0), axis=1, dtype=jnp.uint32
+        ) + jnp.sum(
+            jnp.where(s_hits, stash[None, :, 10], 0),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+
+    fwd_found = jnp.any(fwd_hit, axis=1) | jnp.any(s_fwd, axis=1)
+    rev_found = jnp.any(rev_hit, axis=1) | jnp.any(s_rev, axis=1)
+    probed_related = (base_flags & jnp.uint32(TUPLE_F_RELATED)) != 0
+    result = jnp.where(
+        rev_found,
+        jnp.where(probed_related, CT_RELATED, CT_REPLY),
+        jnp.where(
+            fwd_found,
+            jnp.where(probed_related, CT_RELATED, CT_ESTABLISHED),
+            CT_NEW,
+        ),
+    ).astype(jnp.uint8)
+    val = jnp.where(rev_found, pick(rev_hit, s_rev), pick(fwd_hit, s_fwd))
+    hit = rev_found | fwd_found
+    rev_nat = jnp.where(hit, val >> 16, 0).astype(jnp.int32)
+    slave = jnp.where(hit, val & 0xFFFF, 0).astype(jnp.int32)
+    return result, rev_nat, slave
+
+
+# -- the fused v6 program ----------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Datapath6Tables:
+    prefilter: Prefilter6
+    ipcache: IPCache6Device
+    ct: CT6Snapshot
+    policy: object  # compiler.tables.PolicyTables (shared with v4)
+
+    def tree_flatten(self):
+        return (
+            (self.prefilter, self.ipcache, self.ct, self.policy),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Datapath6Verdicts:
+    allowed: jax.Array  # u8 [B]
+    proxy_port: jax.Array  # i32 [B]
+    match_kind: jax.Array  # u8 [B]
+    ct_result: jax.Array  # u8 [B]
+    pre_dropped: jax.Array  # bool [B]
+    sec_id: jax.Array  # u32 [B]
+    ct_create: jax.Array  # bool [B]
+    ct_delete: jax.Array  # bool [B]
+
+    def tree_flatten(self):
+        return (
+            (
+                self.allowed,
+                self.proxy_port,
+                self.match_kind,
+                self.ct_result,
+                self.pre_dropped,
+                self.sec_id,
+                self.ct_create,
+                self.ct_delete,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _datapath6_kernel(
+    tables: Datapath6Tables, flows: FlowBatch6
+) -> Datapath6Verdicts:
+    """ipv6_policy (bpf_lxc.c:754): prefilter → CT6 → ipcache6 →
+    shared policy lattice → combine.  (lb6_local not yet lowered —
+    module docstring.)"""
+    ingress = flows.direction == INGRESS
+
+    pre_drop = prefilter6_drop(tables.prefilter, flows.saddr)
+
+    ct_res, _ct_rev, _ = ct6_lookup_batch(
+        tables.ct,
+        flows.daddr,
+        flows.saddr,
+        flows.dport,
+        flows.sport,
+        flows.proto,
+        flows.direction,
+    )
+
+    sec_limbs = jnp.where(
+        ingress[:, None], flows.saddr, flows.daddr
+    )
+    looked = ipcache6_lookup(tables.ipcache, sec_limbs)
+    sec_id = jnp.where(
+        looked == 0, jnp.uint32(RESERVED_WORLD), looked
+    ).astype(jnp.uint32)
+
+    resolved = TupleBatch(
+        ep_index=flows.ep_index,
+        identity=sec_id,
+        dport=flows.dport,
+        proto=flows.proto,
+        direction=flows.direction,
+        is_fragment=flows.is_fragment,
+    )
+    p1, p2, p3, proxy, _j, _idx = _probes(tables.policy, resolved)
+    v = _combine(p1, p2, p3, proxy, resolved.is_fragment)
+
+    pol_allow = v.allowed.astype(bool)
+    pass_ct = (ct_res == CT_REPLY) | (ct_res == CT_RELATED)
+    allowed = (~pre_drop) & (pass_ct | pol_allow)
+    ct_delete = (
+        (ct_res == CT_ESTABLISHED) & ~pol_allow & ~pass_ct & ~pre_drop
+    )
+    ct_create = (ct_res == CT_NEW) & allowed
+    proxy_out = jnp.where(
+        pol_allow
+        & ((ct_res == CT_NEW) | (ct_res == CT_ESTABLISHED))
+        & allowed,
+        v.proxy_port,
+        0,
+    )
+    return Datapath6Verdicts(
+        allowed=allowed.astype(jnp.uint8),
+        proxy_port=proxy_out,
+        match_kind=v.match_kind,
+        ct_result=ct_res,
+        pre_dropped=pre_drop,
+        sec_id=sec_id,
+        ct_create=ct_create,
+        ct_delete=ct_delete,
+    )
+
+
+datapath6_step = jax.jit(_datapath6_kernel)

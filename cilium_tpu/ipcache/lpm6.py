@@ -1,0 +1,278 @@
+"""IPv6 device LPM: limb-masked longest-prefix match.
+
+The reference's ipcache is dual-stack (bpf/lib/eps.h:70
+ipcache_lookup6, with the per-prefix-length unrolled fallback at
+eps.h:86); rule_validation.go:29 bounds distinct prefix lengths at
+40.  That bound is what makes the TPU form cheap: v6 prefixes become
+(base limbs, mask limbs, plen, value) arrays compared by broadcast —
+4×u32 limb compares per range, no gathers — and the /128 population
+(endpoints) lives in bucketized rows fetched by ONE row gather, the
+same design as the v4 IPCacheDevice (ipcache/lpm.py).
+
+Bucket row layout (planar, 25 entries × 5 words): lanes [25k, 25k+25)
+hold word k — limbs 0..3 of each entry's address, then the value.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import numpy as np
+
+from cilium_tpu.engine.hashtable import _fnv1a_host, fnv1a_device
+
+V6_ENTRIES_PER_BUCKET = 25
+V6_STASH = 128
+MAX_RANGES6 = 512
+_EMPTY_LIMB = np.uint32(0xFFFFFFFF)
+
+
+def limbs_of_int(raw: int) -> Tuple[int, int, int, int]:
+    """128-bit int → 4 big-endian u32 limbs (shared by every v6
+    table builder)."""
+    return (
+        (raw >> 96) & 0xFFFFFFFF,
+        (raw >> 64) & 0xFFFFFFFF,
+        (raw >> 32) & 0xFFFFFFFF,
+        raw & 0xFFFFFFFF,
+    )
+
+
+def ip6_limbs(ip: str) -> Tuple[int, int, int, int]:
+    """IPv6 address → 4 big-endian u32 limbs."""
+    return limbs_of_int(int(ipaddress.IPv6Address(ip)))
+
+
+def build_limb_ranges(nets):
+    """[(base limbs, mask limbs)] → pow2-padded (base, mask) u32
+    [P, 4] arrays; padding rows (base limb0 = 1, mask 0) are
+    unmatchable.  Shared by the ipcache range path and prefilter6."""
+    p = 8
+    while p < len(nets):
+        p *= 2
+    base = np.zeros((p, 4), dtype=np.uint32)
+    base[:, 0] = 1
+    mask = np.zeros((p, 4), dtype=np.uint32)
+    for i, (b, m) in enumerate(nets):
+        base[i] = b
+        mask[i] = m
+    return base, mask
+
+
+def match_limb_ranges(base, mask, limbs):
+    """bool [B, P]: per-range limb-masked prefix match."""
+    import jax.numpy as jnp
+
+    match = jnp.ones((limbs.shape[0], base.shape[0]), bool)
+    rb = jnp.asarray(base)
+    rm = jnp.asarray(mask)
+    for k in range(4):
+        match = match & (
+            (limbs[:, k : k + 1].astype(jnp.uint32) & rm[None, :, k])
+            == rb[None, :, k]
+        )
+    return match
+
+
+def _mask_limbs(plen: int) -> Tuple[int, int, int, int]:
+    m = ((1 << plen) - 1) << (128 - plen) if plen else 0
+    return (
+        (m >> 96) & 0xFFFFFFFF,
+        (m >> 64) & 0xFFFFFFFF,
+        (m >> 32) & 0xFFFFFFFF,
+        m & 0xFFFFFFFF,
+    )
+
+
+@dataclass
+class IPCache6Device:
+    """Bucketized /128 rows + broadcast ranges (pytree)."""
+
+    buckets: np.ndarray  # u32 [Cb, 128]
+    stash: np.ndarray  # u32 [S, 5] (limbs 0-3, value)
+    range_base: np.ndarray  # u32 [P, 4]
+    range_mask: np.ndarray  # u32 [P, 4]
+    range_plen: np.ndarray  # u32 [P] (stored +1; 0 = padding)
+    range_value: np.ndarray  # u32 [P]
+    n_buckets: int
+
+    def tree_flatten(self):
+        return (
+            (
+                self.buckets,
+                self.stash,
+                self.range_base,
+                self.range_mask,
+                self.range_plen,
+                self.range_value,
+            ),
+            self.n_buckets,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            IPCache6Device,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: IPCache6Device.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+def build_ipcache6(prefix_to_id: Dict[str, int]) -> IPCache6Device:
+    """Lower {ipv6 cidr → identity}.  /128s bucket by address hash;
+    shorter prefixes become broadcast ranges (longest wins; same-plen
+    overlap is impossible)."""
+    exact: Dict[Tuple[int, int, int, int], int] = {}
+    range_map: Dict[Tuple[int, Tuple[int, int, int, int]], int] = {}
+    for cidr, num_id in prefix_to_id.items():
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 6:
+            continue
+        if num_id >= 1 << 31:
+            raise ValueError(f"identity {num_id} exceeds 31-bit range")
+        limbs = ip6_limbs(str(net.network_address))
+        if limbs == (_EMPTY_LIMB,) * 4:
+            # all-ones /128 is the empty-lane marker; the reference
+            # ipcache never maps it either
+            raise ValueError("ff..ff/128 cannot be cached")
+        if net.prefixlen == 128:
+            prev = exact.get(limbs)
+            exact[limbs] = num_id if prev is None else max(prev, num_id)
+        else:
+            key = (net.prefixlen, limbs)
+            prev = range_map.get(key)
+            range_map[key] = (
+                num_id if prev is None else max(prev, num_id)
+            )
+    if len(range_map) > MAX_RANGES6:
+        raise ValueError(
+            f"{len(range_map)} v6 ranges exceed MAX_RANGES6 "
+            f"({MAX_RANGES6}); the reference bounds distinct prefix "
+            f"lengths at 40 (rule_validation.go:29)"
+        )
+
+    nb = 16
+    while nb * 8 < max(len(exact), 1):
+        nb *= 2
+    per = V6_ENTRIES_PER_BUCKET
+    buckets = np.zeros((nb, 128), dtype=np.uint32)
+    # empties marked in ALL limb planes: only the (excluded) all-ones
+    # /128 could ever equal them, so no probe false-hits an empty lane
+    buckets[:, : 4 * per] = _EMPTY_LIMB
+    stash = np.zeros((V6_STASH, 5), dtype=np.uint32)
+    stash[:, :4] = _EMPTY_LIMB
+    fill = [0] * nb
+    sfill = 0
+    for limbs, num_id in sorted(exact.items()):
+        words = np.array([limbs], dtype=np.uint32)
+        b = int(_fnv1a_host(words)[0]) & (nb - 1)
+        if fill[b] < per:
+            i = fill[b]
+            for k in range(4):
+                buckets[b, k * per + i] = limbs[k]
+            buckets[b, 4 * per + i] = num_id
+            fill[b] += 1
+        elif sfill < V6_STASH:
+            stash[sfill] = (*limbs, num_id)
+            sfill += 1
+        else:
+            raise ValueError("v6 ipcache bucket and stash overflow")
+
+    nets = [
+        (limbs, _mask_limbs(pl))
+        for (pl, limbs) in sorted(range_map)
+    ]
+    base, mask = build_limb_ranges(nets)
+    plen = np.zeros(base.shape[0], dtype=np.uint32)
+    value = np.zeros(base.shape[0], dtype=np.uint32)
+    for i, ((pl, limbs), num_id) in enumerate(sorted(range_map.items())):
+        plen[i] = pl + 1
+        value[i] = num_id
+    return IPCache6Device(
+        buckets=buckets,
+        stash=stash,
+        range_base=base,
+        range_mask=mask,
+        range_plen=plen,
+        range_value=value,
+        n_buckets=nb,
+    )
+
+
+def ipcache6_lookup(dev: IPCache6Device, limbs) -> "jax.Array":
+    """Batched v6 → identity (u32; 0 = miss).  `limbs` is u32 [B, 4].
+    One bucket row gather + broadcast range compares."""
+    import jax.numpy as jnp
+
+    limbs = limbs.astype(jnp.uint32)
+    h = fnv1a_device(limbs)
+    bucket = (h & jnp.uint32(dev.n_buckets - 1)).astype(jnp.int32)
+    rows = jnp.asarray(dev.buckets)[bucket]  # [B, 128] — 1 gather
+    per = V6_ENTRIES_PER_BUCKET
+    hit = jnp.ones((limbs.shape[0], per), bool)
+    for k in range(4):
+        hit = hit & (
+            rows[:, k * per : (k + 1) * per] == limbs[:, k : k + 1]
+        )
+    exact_found = jnp.any(hit, axis=1)
+    exact_val = jnp.sum(
+        jnp.where(hit, rows[:, 4 * per : 5 * per], 0),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    stash = jnp.asarray(dev.stash)
+    s_hit = jnp.ones((limbs.shape[0], stash.shape[0]), bool)
+    for k in range(4):
+        s_hit = s_hit & (stash[None, :, k] == limbs[:, k : k + 1])
+    exact_found = exact_found | jnp.any(s_hit, axis=1)
+    exact_val = exact_val + jnp.sum(
+        jnp.where(s_hit, stash[None, :, 4], 0), axis=1, dtype=jnp.uint32
+    )
+
+    match = match_limb_ranges(dev.range_base, dev.range_mask, limbs)
+    plen = jnp.asarray(dev.range_plen)
+    best = jnp.max(jnp.where(match, plen[None, :], 0), axis=1)
+    range_val = jnp.sum(
+        jnp.where(
+            match & (plen[None, :] == best[:, None]),
+            jnp.asarray(dev.range_value)[None, :],
+            0,
+        ),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    return jnp.where(
+        exact_found,
+        exact_val,
+        jnp.where(best > 0, range_val, 0),
+    )
+
+
+def lookup_host6(prefix_to_id: Dict[str, int], ip: str) -> int:
+    """Host reference LPM for v6 (the oracle)."""
+    addr = ipaddress.ip_address(ip)
+    best_len, best_id = -1, 0
+    for cidr, num_id in prefix_to_id.items():
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 6:
+            continue
+        if addr in net and (
+            net.prefixlen > best_len
+            or (net.prefixlen == best_len and num_id > best_id)
+        ):
+            best_len, best_id = net.prefixlen, num_id
+    return best_id

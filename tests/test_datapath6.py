@@ -1,0 +1,263 @@
+"""IPv6 fused datapath vs composed host oracles.
+
+The v6 sibling of test_datapath.py: the fused v6 program
+(engine/datapath6.py — prefilter6 → CT6 → ipcache6 → shared lattice)
+must agree flow-by-flow with the host reference components, the way
+bpf_lxc.c's ipv6_policy mirrors ipv4_policy over shared policy maps.
+Also covers mixed v4/v6 batches: each family through its own program,
+one shared policy table set."""
+
+import ipaddress
+
+import numpy as np
+import pytest
+
+from cilium_tpu.compiler.tables import compile_map_states
+from cilium_tpu.ct.table import (
+    CT_EGRESS,
+    CT_INGRESS,
+    CT_NEW,
+    CT_RELATED,
+    CT_REPLY,
+    CTMap,
+    CTTuple,
+)
+from cilium_tpu.engine.datapath6 import (
+    Datapath6Tables,
+    FlowBatch6,
+    build_prefilter6,
+    compile_ct6,
+    datapath6_step,
+)
+from cilium_tpu.engine.oracle import evaluate_batch_oracle
+from cilium_tpu.identity import RESERVED_WORLD
+from cilium_tpu.ipcache.lpm6 import (
+    build_ipcache6,
+    ip6_limbs,
+    ipcache6_lookup,
+    lookup_host6,
+)
+from cilium_tpu.maps.policymap import EGRESS, INGRESS
+
+from tests.test_verdict_engine import random_map_state
+
+IDENTITY_IDS = [1, 2, 3, 4, 5, 256, 257, 300, 1000]
+
+V6_POOL = [
+    "2001:db8::1",
+    "2001:db8::2",
+    "2001:db8:1::10",
+    "2001:db8:1:2::3",
+    "fd00::1",
+    "fd00:aaaa::7",
+    "2600:1::9",
+]
+
+IPCACHE6 = {
+    "2001:db8::/32": 256,
+    "2001:db8:1::/48": 257,
+    "2001:db8:1:2::/64": 300,
+    "2001:db8:1:2::3/128": 1000,
+    "fd00::/8": 5,
+}
+
+PREFILTER6 = ["2600:1::/32"]
+
+
+def _addr_int(ip: str) -> int:
+    return int(ipaddress.IPv6Address(ip))
+
+
+def test_ipcache6_matches_host_oracle():
+    dev = build_ipcache6(IPCACHE6)
+    import jax.numpy as jnp
+
+    probes = V6_POOL + ["2001:db8:1:2::4", "::1", "2600:1:2::5"]
+    limbs = np.array([ip6_limbs(p) for p in probes], np.uint32)
+    got = np.asarray(ipcache6_lookup(dev, jnp.asarray(limbs)))
+    for i, p in enumerate(probes):
+        assert got[i] == lookup_host6(IPCACHE6, p), p
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_v6_matches_composed_oracle(seed):
+    rng = np.random.default_rng(seed)
+    n_eps = 3
+    states = [
+        random_map_state(rng, IDENTITY_IDS, n_l4=10, n_l3=10)
+        for _ in range(n_eps)
+    ]
+    policy = compile_map_states(states, IDENTITY_IDS, 32, 16)
+
+    ct = CTMap()
+    established = [
+        ("2001:db8::1", "2001:db8:1::10", 4001, 80, 6, CT_INGRESS),
+        ("fd00::1", "2001:db8:1:2::3", 4002, 443, 6, CT_EGRESS),
+    ]
+    for saddr, daddr, sport, dport, proto, d in established:
+        ct.create(
+            CTTuple(
+                _addr_int(daddr), _addr_int(saddr), dport, sport, proto
+            ),
+            d,
+        )
+
+    tables = Datapath6Tables(
+        prefilter=build_prefilter6(PREFILTER6),
+        ipcache=build_ipcache6(IPCACHE6),
+        ct=compile_ct6(ct),
+        policy=policy,
+    )
+
+    n = 256
+    saddr_s = [str(rng.choice(V6_POOL)) for _ in range(n)]
+    daddr_s = [str(rng.choice(V6_POOL)) for _ in range(n)]
+    f = dict(
+        ep_index=rng.integers(0, n_eps, size=n),
+        saddr=np.array([ip6_limbs(s) for s in saddr_s], np.uint32),
+        daddr=np.array([ip6_limbs(s) for s in daddr_s], np.uint32),
+        sport=rng.choice([4001, 4002, 5000], size=n),
+        dport=rng.choice([53, 80, 443, 8080], size=n),
+        proto=rng.choice([6, 17], size=n),
+        direction=rng.integers(0, 2, size=n),
+        is_fragment=rng.random(size=n) < 0.05,
+    )
+    flows = FlowBatch6.from_numpy(**f)
+    out = datapath6_step(tables, flows)
+
+    got_allowed = np.asarray(out.allowed)
+    got_ct = np.asarray(out.ct_result)
+    got_sec = np.asarray(out.sec_id)
+    got_create = np.asarray(out.ct_create)
+
+    import copy
+
+    for i in range(n):
+        s_ip, d_ip = saddr_s[i], daddr_s[i]
+        direction = int(f["direction"][i])
+        # prefilter
+        pre = any(
+            ipaddress.IPv6Address(s_ip)
+            in ipaddress.ip_network(c)
+            for c in PREFILTER6
+        )
+        # CT on the (un-NAT'd) tuple
+        ct_res = ct.lookup(
+            CTTuple(
+                _addr_int(d_ip),
+                _addr_int(s_ip),
+                int(f["dport"][i]),
+                int(f["sport"][i]),
+                int(f["proto"][i]),
+            ),
+            CT_INGRESS if direction == INGRESS else CT_EGRESS,
+        )
+        # identity
+        sec_ip = s_ip if direction == INGRESS else d_ip
+        sec = lookup_host6(IPCACHE6, sec_ip) or RESERVED_WORLD
+        # lattice
+        allow, proxy, kind = evaluate_batch_oracle(
+            copy.deepcopy(states),
+            ep_index=np.array([int(f["ep_index"][i])]),
+            identity=np.array([sec], np.uint32),
+            dport=np.array([int(f["dport"][i])]),
+            proto=np.array([int(f["proto"][i])]),
+            direction=np.array([direction]),
+            is_fragment=np.array([bool(f["is_fragment"][i])]),
+        )
+        pol = bool(allow[0])
+        pass_ct = ct_res in (CT_REPLY, CT_RELATED)
+        want_allowed = (not pre) and (pass_ct or pol)
+        ctx = f"v6 flow {i}: {s_ip}->{d_ip} dir={direction}"
+        assert bool(got_allowed[i]) == want_allowed, ctx
+        assert int(got_ct[i]) == int(ct_res), ctx
+        assert int(got_sec[i]) == int(sec), ctx
+        assert bool(got_create[i]) == (
+            ct_res == CT_NEW and want_allowed
+        ), ctx
+
+
+def test_mixed_family_batch_shared_policy():
+    """Mixed v4/v6 traffic: each family through its own program, ONE
+    shared policy table set — the verdict for the same (identity,
+    port, proto, direction) tuple is family-invariant."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.ct.device import compile_ct as compile_ct4
+    from cilium_tpu.engine.datapath import (
+        DatapathTables,
+        FlowBatch,
+        datapath_step,
+    )
+    from cilium_tpu.ipcache.lpm import build_ipcache
+    from cilium_tpu.lb.device import compile_lb
+    from cilium_tpu.lb.service import ServiceManager
+    from cilium_tpu.prefilter import build_prefilter
+
+    rng = np.random.default_rng(7)
+    states = [random_map_state(rng, IDENTITY_IDS, n_l4=8, n_l3=6)]
+    policy = compile_map_states(states, IDENTITY_IDS, 32, 16)
+
+    t4 = DatapathTables(
+        prefilter=build_prefilter({}),
+        ipcache=build_ipcache({"10.0.0.1/32": 257}),
+        ct=compile_ct4(CTMap()),
+        lb=compile_lb(ServiceManager()),
+        policy=policy,
+    )
+    t6 = Datapath6Tables(
+        prefilter=build_prefilter6([]),
+        ipcache=build_ipcache6({"2001:db8::99/128": 257}),
+        ct=compile_ct6(CTMap()),
+        policy=policy,
+    )
+    n = 64
+    dports = rng.choice([53, 80, 443], size=n)
+    protos = rng.choice([6, 17], size=n)
+    f4 = FlowBatch.from_numpy(
+        ep_index=np.zeros(n, np.int32),
+        saddr=np.full(n, int(ipaddress.IPv4Address("10.0.0.1")), np.uint32),
+        daddr=np.full(n, int(ipaddress.IPv4Address("10.9.9.9")), np.uint32),
+        sport=np.full(n, 5555),
+        dport=dports,
+        proto=protos,
+        direction=np.zeros(n, np.int32),
+    )
+    f6 = FlowBatch6.from_numpy(
+        ep_index=np.zeros(n, np.int32),
+        saddr=np.tile(
+            np.array(ip6_limbs("2001:db8::99"), np.uint32), (n, 1)
+        ),
+        daddr=np.tile(
+            np.array(ip6_limbs("2001:db8::1"), np.uint32), (n, 1)
+        ),
+        sport=np.full(n, 5555),
+        dport=dports,
+        proto=protos,
+        direction=np.zeros(n, np.int32),
+    )
+    out4 = datapath_step(t4, f4)
+    out6 = datapath6_step(t6, f6)
+    # same identity (257), same ports/protos → identical verdicts
+    np.testing.assert_array_equal(
+        np.asarray(out4.allowed), np.asarray(out6.allowed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out4.match_kind), np.asarray(out6.match_kind)
+    )
+
+
+def test_ipcache6_high_address_not_false_hit(tmp_path):
+    """Regression: probes near the all-ones marker must not
+    exact-hit empty lanes and shadow their covering range."""
+    import jax.numpy as jnp
+
+    dev = build_ipcache6({"ffff::/16": 500})
+    probes = ["ffff:ffff::", "ffff::1", "::"]
+    limbs = np.array([ip6_limbs(p) for p in probes], np.uint32)
+    got = np.asarray(ipcache6_lookup(dev, jnp.asarray(limbs)))
+    assert list(got) == [500, 500, 0]
+    with pytest.raises(ValueError):
+        build_ipcache6(
+            {"ffff:ffff:ffff:ffff:ffff:ffff:ffff:ffff/128": 7}
+        )
