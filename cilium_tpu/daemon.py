@@ -391,6 +391,44 @@ class Daemon:
         )
         return endpoint
 
+    def update_endpoint_labels(self, endpoint_id: int, labels) -> bool:
+        """EndpointUpdateLabels (pkg/endpoint + workloads docker.go:479):
+        re-allocate the identity from the new label set, republish the
+        IP mapping, release the old identity, regenerate."""
+        from cilium_tpu.ipcache.ipcache import FROM_AGENT_LOCAL, IPIdentity
+        from cilium_tpu.kvstore.ipsync import upsert_ip_mapping
+
+        endpoint = self.endpoint_manager.lookup(endpoint_id)
+        if endpoint is None:
+            return False
+        old = endpoint.security_identity
+        ident, _ = self.identity_allocator.allocate(labels)
+        if old is not None and ident.id == old.id:
+            # same identity: drop the reference allocate() just took
+            # (repeated runtime START events must not leak refs)
+            self.identity_allocator.release(ident)
+            return True
+        endpoint.set_identity(ident)
+        # the identity universe may be unchanged (another endpoint
+        # already holds both identities), so the revision gate would
+        # skip this endpoint — force its recompute
+        endpoint.force_policy_compute = True
+        if endpoint.ipv4:
+            self.ipcache.upsert(
+                endpoint.ipv4, IPIdentity(ident.id, FROM_AGENT_LOCAL)
+            )
+            if self.kvstore is not None:
+                upsert_ip_mapping(
+                    self.kvstore, endpoint.ipv4, ident.id,
+                    node=self.node_name,
+                )
+        if old is not None:
+            self.identity_allocator.release(old)
+        self.trigger_policy_updates(
+            f"endpoint {endpoint_id} relabeled", full=True
+        )
+        return True
+
     def delete_endpoint(self, endpoint_id: int) -> bool:
         from cilium_tpu.endpoint.endpoint import (
             STATE_DISCONNECTED,

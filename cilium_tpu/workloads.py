@@ -1,0 +1,208 @@
+"""Container-runtime integration: workload events → endpoint labels.
+
+The behavioral port of /root/reference/pkg/workloads/docker.go: the
+runtime's event stream (start/die) drives per-container serialized
+queues (enqueueByContainerID); a start event fetches the container's
+labels, filters them into identity-relevant vs informational sets
+(retrieveDockerLabels → filterLabels), and calls the endpoint's
+UpdateLabels path — identity re-allocation plus policy regeneration
+(handleCreateWorkload, docker.go:391-479); a delete tears the
+endpoint down.
+
+There is no container runtime in this environment; `FakeRuntime` is
+the in-proc stand-in implementing the inspect+events contract the
+docker client consumes.  The daemon paths the handlers drive are
+real: identity allocation, ipcache publication, regeneration.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cilium_tpu.labels import Label, Labels
+
+EVENT_START = "start"
+EVENT_DELETE = "delete"
+
+# label keys the reference strips from the identity-relevant set
+# (filterLabels: io.kubernetes.* bookkeeping labels are
+# informational, not security-relevant)
+_INFO_PREFIXES = ("io.kubernetes.",)
+
+
+@dataclass
+class Workload:
+    """One container: id, labels, network address."""
+
+    container_id: str
+    labels: Dict[str, str]
+    ipv4: Optional[str] = None
+    endpoint_id: Optional[int] = None
+    running: bool = True
+
+
+@dataclass(frozen=True)
+class WorkloadEvent:
+    container_id: str
+    event_type: str  # EVENT_START | EVENT_DELETE
+
+
+class FakeRuntime:
+    """The inspect+events surface of the docker client."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._containers: Dict[str, Workload] = {}
+        self._listeners: List[Callable[[WorkloadEvent], None]] = []
+
+    def start_container(self, workload: Workload) -> None:
+        with self._lock:
+            self._containers[workload.container_id] = workload
+            listeners = list(self._listeners)
+        for listener in listeners:
+            listener(
+                WorkloadEvent(workload.container_id, EVENT_START)
+            )
+
+    def stop_container(self, container_id: str) -> None:
+        with self._lock:
+            workload = self._containers.pop(container_id, None)
+            listeners = list(self._listeners)
+        if workload is not None:
+            for listener in listeners:
+                listener(WorkloadEvent(container_id, EVENT_DELETE))
+
+    def inspect(self, container_id: str) -> Optional[Workload]:
+        with self._lock:
+            return self._containers.get(container_id)
+
+    def enable_event_listener(
+        self, listener: Callable[[WorkloadEvent], None]
+    ) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+
+def filter_labels(
+    raw: Dict[str, str]
+) -> Tuple[Labels, Dict[str, str]]:
+    """retrieveDockerLabels' split: identity-relevant container labels
+    (source `container`, like the reference's docker label source)
+    vs informational ones."""
+    identity = {}
+    info = {}
+    for k, v in raw.items():
+        if k.startswith(_INFO_PREFIXES):
+            info[k] = v
+        else:
+            identity[k] = v
+    return (
+        Labels({k: Label(k, v, "container") for k, v in identity.items()}),
+        info,
+    )
+
+
+class WorkloadWatcher:
+    """EnableEventListener + processEvents (docker.go:264,330): one
+    serialized queue per container id, start → create/update the
+    endpoint from the container's labels, delete → tear it down."""
+
+    def __init__(self, daemon, runtime: FakeRuntime) -> None:
+        self.daemon = daemon
+        self.runtime = runtime
+        self._queues: Dict[str, "queue.Queue"] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._by_container: Dict[str, int] = {}
+        self._next_ep_id = 10_000
+
+    def start(self) -> None:
+        self.runtime.enable_event_listener(self._enqueue)
+
+    # -- per-container serialized queues (enqueueByContainerID) -----------
+
+    def _enqueue(self, event: WorkloadEvent) -> None:
+        with self._lock:
+            q = self._queues.get(event.container_id)
+            if q is None:
+                q = queue.Queue()
+                self._queues[event.container_id] = q
+                thread = threading.Thread(
+                    target=self._drain_loop,
+                    args=(q,),
+                    name=f"workload-{event.container_id[:8]}",
+                    daemon=True,
+                )
+                self._threads[event.container_id] = thread
+                thread.start()
+        q.put(event)
+
+    def _drain_loop(self, q: "queue.Queue") -> None:
+        while True:
+            event = q.get()
+            if event is None:
+                return
+            try:
+                self._process(event)
+            except Exception:
+                pass  # docker.go logs and keeps the listener alive
+
+    def drain(self) -> None:
+        done = []
+        with self._lock:
+            queues = list(self._queues.values())
+        for q in queues:
+            marker = threading.Event()
+            q.put(marker)
+            done.append(marker)
+        for marker in done:
+            marker.wait(timeout=10.0)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _process(self, event) -> None:
+        if isinstance(event, threading.Event):  # drain marker
+            event.set()
+            return
+        if event.event_type == EVENT_START:
+            self._handle_start(event.container_id)
+        elif event.event_type == EVENT_DELETE:
+            self._handle_delete(event.container_id)
+
+    def _handle_start(self, container_id: str) -> None:
+        """handleCreateWorkload (docker.go:391): inspect, filter
+        labels, create or relabel the endpoint."""
+        workload = self.runtime.inspect(container_id)
+        if workload is None or not workload.running:
+            return  # IgnoreRunningWorkloads / raced a stop
+        identity_labels, _info = filter_labels(workload.labels)
+        ep_id = self._by_container.get(container_id)
+        if ep_id is None:
+            with self._lock:
+                ep_id = (
+                    workload.endpoint_id
+                    if workload.endpoint_id is not None
+                    else self._next_ep_id
+                )
+                self._next_ep_id = max(
+                    self._next_ep_id + 1, ep_id + 1
+                )
+            self.daemon.create_endpoint(
+                ep_id,
+                identity_labels,
+                ipv4=workload.ipv4,
+                name=container_id,
+            )
+            self._by_container[container_id] = ep_id
+        else:
+            # UpdateLabels (docker.go:479): re-allocate the identity
+            # from the new label set and regenerate
+            self.daemon.update_endpoint_labels(ep_id, identity_labels)
+
+    def _handle_delete(self, container_id: str) -> None:
+        ep_id = self._by_container.pop(container_id, None)
+        if ep_id is not None:
+            self.daemon.delete_endpoint(ep_id)

@@ -1,0 +1,69 @@
+"""Workload (container-runtime) integration: runtime events →
+endpoints, the pkg/workloads/docker.go flow against a fake runtime."""
+
+import numpy as np
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.workloads import (
+    FakeRuntime,
+    Workload,
+    WorkloadWatcher,
+    filter_labels,
+)
+
+
+def test_filter_labels_split():
+    identity, info = filter_labels(
+        {
+            "app": "web",
+            "tier": "front",
+            "io.kubernetes.pod.name": "web-0",
+        }
+    )
+    assert set(identity) == {"app", "tier"}
+    assert identity["app"].source == "container"
+    assert info == {"io.kubernetes.pod.name": "web-0"}
+
+
+def test_container_lifecycle_drives_endpoints():
+    d = Daemon()
+    runtime = FakeRuntime()
+    watcher = WorkloadWatcher(d, runtime)
+    watcher.start()
+
+    runtime.start_container(
+        Workload(
+            container_id="c-web-1",
+            labels={"app": "web", "io.kubernetes.pod.name": "web-0"},
+            ipv4="10.20.0.1",
+        )
+    )
+    watcher.drain()
+    eps = {ep.name: ep for ep in d.endpoint_manager.endpoints()}
+    assert "c-web-1" in eps
+    ep = eps["c-web-1"]
+    assert ep.ipv4 == "10.20.0.1"
+    ident1 = ep.security_identity.id
+    got, _ = d.ipcache.lookup_by_ip("10.20.0.1")
+    assert got.id == ident1
+
+    # relabel: the container restarts with different labels → the
+    # endpoint's identity changes and the ipcache follows
+    runtime.start_container(
+        Workload(
+            container_id="c-web-1",
+            labels={"app": "web", "tier": "canary"},
+            ipv4="10.20.0.1",
+        )
+    )
+    watcher.drain()
+    ep = d.endpoint_manager.lookup(ep.id)
+    ident2 = ep.security_identity.id
+    assert ident2 != ident1
+    got, _ = d.ipcache.lookup_by_ip("10.20.0.1")
+    assert got.id == ident2
+
+    # container dies → endpoint gone
+    runtime.stop_container("c-web-1")
+    watcher.drain()
+    assert d.endpoint_manager.lookup(ep.id) is None
