@@ -79,6 +79,10 @@ class HTTPTables:
     ident_rules: np.ndarray  # u32 [N, W] per-identity rule bits
     n_rules: int
     n_words: int
+    # strided forms (None = fall back to the byte-at-a-time scan)
+    method_sdfa: "Optional[StridedDFA]" = None
+    path_sdfa: "Optional[StridedDFA]" = None
+    host_sdfa: "Optional[StridedDFA]" = None
 
 
 @dataclass
@@ -226,6 +230,9 @@ def compile_http_rules(
             ident_rules[idx, i // 32] |= np.uint32(1 << (i % 32))
 
     tables = HTTPTables(
+        method_sdfa=build_strided(method_dfa),
+        path_sdfa=build_strided(path_dfa),
+        host_sdfa=build_strided(host_dfa),
         method_dfa=method_dfa,
         path_dfa=path_dfa,
         host_dfa=host_dfa,
@@ -244,6 +251,154 @@ def compile_http_rules(
 # ---------------------------------------------------------------------------
 # device kernel
 # ---------------------------------------------------------------------------
+
+
+@dataclass
+class StridedDFA:
+    """A DFA squared k times: one scan step consumes 2^k bytes.
+
+    The sequential byte-at-a-time scan is the HTTP path's cost center
+    (a ~12 ms [B]-gather PER BYTE POSITION on v5e); squaring the
+    transition table — with column deduplication between rounds and an
+    artificial identity class so padding can never move the state —
+    divides the step count by the stride.  The union DFAs here are
+    tiny (tens of states), so even stride 16 tables stay kilobytes.
+
+    Level map l takes a pair of level-(l-1) classes to a level-l
+    class; the per-request class sequence is folded level by level
+    with elementwise small-table gathers BEFORE the scan."""
+
+    classes: np.ndarray  # byte → level-0 class (identity class added)
+    id_class0: int
+    # byte-PAIR bootstrap (always present: build_strided returns None
+    # instead of a LUT-less strided form): (b1, b2) → level-1 class in one gather,
+    # with pseudo-byte 256 as padding — fuses the per-byte class
+    # lookup and the first fold, halving the dominant element count
+    pair_lut: np.ndarray  # [(257)*(257)] → level-1 class
+    level_maps: List[np.ndarray]  # [nc_prev * nc_prev] → class id
+    level_ncs: List[int]  # nc INPUT of each level
+    level_ids: List[int]  # identity class id at each level OUTPUT
+    trans: np.ndarray  # [S, nc_final]
+    start: int
+    accept: np.ndarray
+
+
+def build_strided(
+    dfa: DFA, rounds: int = 4, max_table_bytes: int = 1 << 22
+) -> "Optional[StridedDFA]":
+    """Square the transition table `rounds` times (stride 2^rounds),
+    deduping equivalent columns between rounds and carrying an
+    identity class for padding."""
+    trans = dfa.trans.astype(np.int64)
+    s_count, nc = trans.shape
+    # identity column: padding bytes leave the state unchanged, so a
+    # stride group that crosses the end of the string is exact
+    trans = np.concatenate(
+        [trans, np.arange(s_count, dtype=np.int64)[:, None]], axis=1
+    )
+    id_class = nc
+    nc += 1
+
+    level_maps: List[np.ndarray] = []
+    level_ncs: List[int] = []
+    level_ids: List[int] = []
+    cur_id = id_class
+    for _ in range(rounds):
+        if s_count * nc * nc * 8 > max_table_bytes:
+            break
+        # T2[s, c1, c2] = trans[trans[s, c1], c2]
+        t2 = trans[trans, :]  # t2[s, c1, c2] = trans[trans[s, c1], c2]
+        flat = t2.reshape(s_count, nc * nc)
+        cols, inverse = np.unique(flat.T, axis=0, return_inverse=True)
+        level_maps.append(inverse.astype(np.int32))
+        level_ncs.append(nc)
+        trans = cols.T.astype(np.int64)  # [S, n_unique]
+        cur_id = int(inverse[cur_id * nc + cur_id])
+        level_ids.append(cur_id)
+        nc = trans.shape[1]
+
+    if not level_maps:
+        # squaring never fit the budget: no strided form — callers
+        # use the byte-at-a-time scan
+        return None
+    # classes extended with the pad pseudo-byte 256 → id class
+    classes_e = np.concatenate(
+        [dfa.classes.astype(np.int64), [id_class]]
+    )
+    nc0 = level_ncs[0]
+    b1 = np.repeat(classes_e, 257)
+    b2 = np.tile(classes_e, 257)
+    pair_lut = level_maps[0][b1 * nc0 + b2].astype(np.int32)
+
+    return StridedDFA(
+        classes=dfa.classes.astype(np.int32),
+        id_class0=id_class,
+        pair_lut=pair_lut,
+        level_maps=level_maps,
+        level_ncs=level_ncs,
+        level_ids=level_ids,
+        trans=trans.astype(np.int32),
+        start=dfa.start,
+        accept=dfa.accept,
+    )
+
+
+def _dfa_scan_strided(sdfa: StridedDFA, data, lengths):
+    """[B, L] u8 → accept bitmask, consuming 2^rounds bytes per scan
+    step.  Positions past the string length become the identity class
+    before the level folding, so padding is state-neutral by
+    construction."""
+    import jax
+    import jax.numpy as jnp
+
+    b, l = data.shape
+    pos = jnp.arange(l, dtype=jnp.int32)
+
+    # byte-pair bootstrap: one gather per TWO bytes
+    if l % 2:
+        data = jnp.concatenate(
+            [data, jnp.zeros((b, 1), data.dtype)], axis=1
+        )
+        l += 1
+        pos = jnp.arange(l, dtype=jnp.int32)
+    p = jnp.where(
+        pos[None, :] < lengths[:, None],
+        data.astype(jnp.int32),
+        jnp.int32(256),  # pad pseudo-byte
+    )
+    c = jnp.asarray(sdfa.pair_lut)[
+        p[:, 0::2] * 257 + p[:, 1::2]
+    ]  # [B, L/2] of level-1 classes
+    remaining = list(
+        zip(
+            sdfa.level_maps[1:],
+            sdfa.level_ncs[1:],
+            sdfa.level_ids[1:],
+        )
+    )
+    pad_id = sdfa.level_ids[0]
+
+    for pair_map, nc_in, out_id in remaining:
+        if c.shape[1] % 2:
+            c = jnp.concatenate(
+                [c, jnp.full((b, 1), pad_id, jnp.int32)], axis=1
+            )
+        c = jnp.asarray(pair_map)[
+            c[:, 0::2] * nc_in + c[:, 1::2]
+        ]  # [B, L/2]
+        pad_id = out_id
+
+    trans = jnp.asarray(sdfa.trans)
+    nc_final = trans.shape[1]
+    flat = trans.reshape(-1)
+    state0 = jnp.full((b,), sdfa.start, dtype=jnp.int32)
+
+    def step(state, col):
+        return flat[state * nc_final + col], None
+
+    cols = jnp.moveaxis(c, 1, 0)  # [L', B]
+    state, _ = jax.lax.scan(step, state0, cols)
+    return jnp.asarray(sdfa.accept)[state]
 
 
 def _dfa_scan(dfa: DFA, data, lengths):
@@ -290,9 +445,16 @@ def evaluate_http_batch(
     """Returns (allowed bool [B], matched_rules u32 [B, W])."""
     import jax.numpy as jnp
 
-    acc_m = _dfa_scan(tables.method_dfa, method, method_len)  # [B, W]
-    acc_p = _dfa_scan(tables.path_dfa, path, path_len)
-    acc_h = _dfa_scan(tables.host_dfa, host, host_len)
+    def scan(dfa, sdfa, data, lens):
+        if sdfa is not None:
+            return _dfa_scan_strided(sdfa, data, lens)
+        return _dfa_scan(dfa, data, lens)
+
+    acc_m = scan(
+        tables.method_dfa, tables.method_sdfa, method, method_len
+    )  # [B, W]
+    acc_p = scan(tables.path_dfa, tables.path_sdfa, path, path_len)
+    acc_h = scan(tables.host_dfa, tables.host_sdfa, host, host_len)
 
     matched = (
         (acc_m | jnp.asarray(tables.absent_method)[None, :])
