@@ -1,0 +1,147 @@
+"""Tunnel/overlay model: the encap forwarding decision.
+
+The reference keeps a prefix → tunnel-endpoint map
+(/root/reference/pkg/maps/tunnel/tunnel.go:84 SetTunnelEndpoint, fed
+from node discovery) that bpf_overlay.c / lib/encap.h consult: a
+packet whose destination falls in a remote node's pod CIDR is
+VXLAN/Geneve-encapsulated to that node's IP with the source security
+identity carried in the tunnel metadata
+(encap_and_redirect_with_nodeid, encap.h:26); local destinations and
+unknown destinations go direct.
+
+Here the map lowers onto the same broadcast-range form as the
+prefilter (remote pod CIDRs are few — one or two per node), and the
+forwarding decision is a zero-gather device kernel returning, per
+flow, the tunnel endpoint (0 = no encap) — the identity to carry is
+the fused step's sec output, exactly as the reference stuffs seclabel
+into the tunnel key.  `TunnelMap` subscribes to node discovery so
+remote nodes' pod CIDRs appear and vanish with node lifecycle.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclass
+class TunnelTables:
+    """Broadcast (base, mask) ranges → tunnel endpoint u32 (pytree)."""
+
+    base: np.ndarray  # u32 [P]
+    mask: np.ndarray  # u32 [P]
+    endpoint: np.ndarray  # u32 [P] node IP (0 = padding)
+
+    def tree_flatten(self):
+        return ((self.base, self.mask, self.endpoint), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_pytree() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            TunnelTables,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: TunnelTables.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+class TunnelMap:
+    """prefix → tunnel endpoint (tunnel.go TunnelMap), fed by node
+    discovery: each remote node's pod CIDRs map to its node IP."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._prefixes: Dict[str, int] = {}
+        self._dirty = True
+        self._tables: Optional[TunnelTables] = None
+
+    def set_tunnel_endpoint(self, prefix: str, endpoint_ip: str) -> None:
+        """SetTunnelEndpoint (tunnel.go:84)."""
+        with self._lock:
+            self._prefixes[prefix] = int(
+                ipaddress.IPv4Address(endpoint_ip)
+            )
+            self._dirty = True
+
+    def delete_tunnel_endpoint(self, prefix: str) -> None:
+        with self._lock:
+            self._prefixes.pop(prefix, None)
+            self._dirty = True
+
+    # -- node discovery feed (pkg/datapath's node handler) ----------------
+
+    def on_node(self, kind: str, node) -> None:
+        """Wire as a kvstore NodeWatcher on_change callback: a remote
+        node's pod CIDR tunnels to its internal IP; node deletion
+        removes the mapping (linuxNodeHandler NodeAdd/NodeDelete →
+        tunnel map updates)."""
+        cidr = getattr(node, "ipv4_alloc_cidr", None)
+        ip = getattr(node, "internal_ip", None)
+        if not cidr:
+            return
+        if kind == "delete":
+            self.delete_tunnel_endpoint(cidr)
+        elif ip:
+            self.set_tunnel_endpoint(cidr, ip)
+
+    def tables(self) -> TunnelTables:
+        with self._lock:
+            if not self._dirty and self._tables is not None:
+                return self._tables
+            nets = []
+            for cidr, ep in sorted(self._prefixes.items()):
+                net = ipaddress.ip_network(cidr, strict=False)
+                if net.version != 4:
+                    continue
+                nets.append(
+                    (int(net.network_address), int(net.netmask), ep)
+                )
+            p = 8
+            while p < len(nets):
+                p *= 2
+            base = np.ones(p, dtype=np.uint32)  # base 1 & mask 0: never
+            mask = np.zeros(p, dtype=np.uint32)
+            endpoint = np.zeros(p, dtype=np.uint32)
+            for i, (b, m, e) in enumerate(nets):
+                base[i] = b
+                mask[i] = m
+                endpoint[i] = e
+            self._tables = TunnelTables(
+                base=base, mask=mask, endpoint=endpoint
+            )
+            self._dirty = False
+            return self._tables
+
+
+def tunnel_select(tables: TunnelTables, daddr, local_node_ip: int = 0):
+    """Per-flow forwarding decision (encap.h:26): returns the tunnel
+    endpoint u32 [B] (0 = direct / local).  Longest-prefix is
+    irrelevant here — the reference tunnel map holds disjoint pod
+    CIDRs — so any match wins; a flow towards the local node's own
+    prefix (endpoint == local_node_ip) stays direct."""
+    import jax.numpy as jnp
+
+    ips = daddr.astype(jnp.uint32)
+    match = (ips[:, None] & jnp.asarray(tables.mask)[None, :]) == (
+        jnp.asarray(tables.base)[None, :]
+    )
+    ep = jnp.max(
+        jnp.where(match, jnp.asarray(tables.endpoint)[None, :], 0),
+        axis=1,
+    )
+    return jnp.where(ep == jnp.uint32(local_node_ip), 0, ep)

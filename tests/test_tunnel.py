@@ -1,0 +1,64 @@
+"""Tunnel/overlay model: node discovery → tunnel map → encap decision."""
+
+import ipaddress
+
+import numpy as np
+import jax.numpy as jnp
+
+from cilium_tpu.kvstore.node import Node, NodeWatcher, register_node, unregister_node
+from cilium_tpu.kvstore.store import KVStore
+from cilium_tpu.tunnel import TunnelMap, tunnel_select
+
+
+def _u32(ip):
+    return int(ipaddress.IPv4Address(ip))
+
+
+def test_encap_decision_matches_semantics():
+    tm = TunnelMap()
+    tm.set_tunnel_endpoint("10.1.0.0/24", "192.168.0.2")
+    tm.set_tunnel_endpoint("10.2.0.0/24", "192.168.0.3")
+    tm.set_tunnel_endpoint("10.0.0.0/24", "192.168.0.1")  # local node
+
+    daddr = np.array(
+        [_u32("10.1.0.7"), _u32("10.2.0.9"), _u32("10.0.0.5"),
+         _u32("8.8.8.8")],
+        np.uint32,
+    )
+    got = np.asarray(
+        tunnel_select(
+            tm.tables(), jnp.asarray(daddr),
+            local_node_ip=_u32("192.168.0.1"),
+        )
+    )
+    # remote pod CIDRs encap to their node; the local prefix and
+    # unknown destinations go direct
+    assert list(got) == [
+        _u32("192.168.0.2"), _u32("192.168.0.3"), 0, 0,
+    ]
+
+
+def test_node_discovery_feeds_tunnel_map():
+    store = KVStore()
+    tm = TunnelMap()
+    NodeWatcher(store, on_change=tm.on_node)
+    n2 = Node(name="n2", internal_ip="192.168.0.2",
+              ipv4_alloc_cidr="10.1.0.0/24")
+    register_node(store, n2)
+
+    got = np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(np.array([_u32("10.1.0.7")], np.uint32)),
+        )
+    )
+    assert got[0] == _u32("192.168.0.2")
+
+    unregister_node(store, n2)
+    got = np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(np.array([_u32("10.1.0.7")], np.uint32)),
+        )
+    )
+    assert got[0] == 0
