@@ -64,18 +64,32 @@ class TunnelMap:
     """prefix → tunnel endpoint (tunnel.go TunnelMap), fed by node
     discovery: each remote node's pod CIDRs map to its node IP."""
 
+    MAX_PREFIXES = 512  # broadcast form; a DIR-24-8 fallback (as the
+    # prefilter has) is the escape hatch if clusters outgrow this
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._prefixes: Dict[str, int] = {}
+        self._node_cidr: Dict[str, str] = {}
         self._dirty = True
         self._tables: Optional[TunnelTables] = None
 
     def set_tunnel_endpoint(self, prefix: str, endpoint_ip: str) -> None:
-        """SetTunnelEndpoint (tunnel.go:84)."""
+        """SetTunnelEndpoint (tunnel.go:84).  v6 mappings are skipped
+        until the v6 overlay lands (engine/datapath6.py docstring)."""
+        try:
+            ep = int(ipaddress.IPv4Address(endpoint_ip))
+        except (ipaddress.AddressValueError, ValueError):
+            return
         with self._lock:
-            self._prefixes[prefix] = int(
-                ipaddress.IPv4Address(endpoint_ip)
-            )
+            if (
+                prefix not in self._prefixes
+                and len(self._prefixes) >= self.MAX_PREFIXES
+            ):
+                raise ValueError(
+                    f"tunnel map exceeds {self.MAX_PREFIXES} prefixes"
+                )
+            self._prefixes[prefix] = ep
             self._dirty = True
 
     def delete_tunnel_endpoint(self, prefix: str) -> None:
@@ -87,17 +101,26 @@ class TunnelMap:
 
     def on_node(self, kind: str, node) -> None:
         """Wire as a kvstore NodeWatcher on_change callback: a remote
-        node's pod CIDR tunnels to its internal IP; node deletion
-        removes the mapping (linuxNodeHandler NodeAdd/NodeDelete →
-        tunnel map updates)."""
+        node's pod CIDR tunnels to its internal IP; node deletion —
+        or a node re-publishing with a DIFFERENT pod CIDR — removes
+        the old mapping first (linuxNodeHandler NodeUpdate deletes
+        the previous CIDR's tunnel entry before inserting the new)."""
         cidr = getattr(node, "ipv4_alloc_cidr", None)
         ip = getattr(node, "internal_ip", None)
-        if not cidr:
-            return
+        name = getattr(node, "name", "")
+        old = self._node_cidr.get(name)
         if kind == "delete":
-            self.delete_tunnel_endpoint(cidr)
-        elif ip:
+            if old:
+                self.delete_tunnel_endpoint(old)
+                self._node_cidr.pop(name, None)
+            return
+        if old and old != cidr:
+            self.delete_tunnel_endpoint(old)
+            self._node_cidr.pop(name, None)
+        if cidr and ip:
             self.set_tunnel_endpoint(cidr, ip)
+            if cidr in self._prefixes:  # v4 mapping actually stored
+                self._node_cidr[name] = cidr
 
     def tables(self) -> TunnelTables:
         with self._lock:

@@ -62,3 +62,48 @@ def test_node_discovery_feeds_tunnel_map():
         )
     )
     assert got[0] == 0
+
+
+def test_node_cidr_update_removes_stale_mapping():
+    store = KVStore()
+    tm = TunnelMap()
+    NodeWatcher(store, on_change=tm.on_node)
+    register_node(
+        store,
+        Node(name="n2", internal_ip="192.168.0.2",
+             ipv4_alloc_cidr="10.1.0.0/24"),
+    )
+    # the node re-publishes with a different pod CIDR
+    register_node(
+        store,
+        Node(name="n2", internal_ip="192.168.0.2",
+             ipv4_alloc_cidr="10.3.0.0/24"),
+    )
+    got = np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(
+                np.array(
+                    [_u32("10.1.0.7"), _u32("10.3.0.7")], np.uint32
+                )
+            ),
+        )
+    )
+    assert list(got) == [0, _u32("192.168.0.2")]
+
+
+def test_v6_nodes_skipped_not_fatal():
+    tm = TunnelMap()
+    tm.on_node(
+        "create",
+        Node(name="n6", internal_ip="fd00::2",
+             ipv4_alloc_cidr="10.9.0.0/24"),
+    )
+    # v6 endpoint: skipped without raising, no mapping stored
+    got = np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(np.array([_u32("10.9.0.1")], np.uint32)),
+        )
+    )
+    assert got[0] == 0
