@@ -118,6 +118,14 @@ class CTMap:
     def __init__(self, max_entries: int = MAX_ENTRIES_LOCAL) -> None:
         self.entries: Dict[CTTuple, CTEntry] = {}
         self.max_entries = max_entries
+        # bumped on every mutation THROUGH this map (create, probe
+        # side effects, gc) — replay's device-snapshot cache gates on
+        # it plus the key set, so host-side lookups between replays
+        # (which mutate lifetime/closing flags in place) invalidate
+        # the cached snapshot.  Direct writes to `entries` values
+        # bypass it; such callers must invalidate the cache
+        # themselves (replay._ChurnDriver docstring).
+        self.mutations = 0
 
     # -- timeout logic (conntrack.h:190-207) --------------------------------
 
@@ -150,6 +158,8 @@ class CTMap:
         entry = self.entries.get(tup)
         if entry is None:
             return CT_NEW
+        self.mutations += 1  # probe hits mutate in place (timeout,
+        # counters, closing flags) — see __init__
         if entry.alive():
             self._update_timeout(entry, is_tcp, dir, syn, now)
         if ct_state is not None:
@@ -266,6 +276,7 @@ class CTMap:
         is_tcp = tup.nexthdr == IPPROTO_TCP
         self._update_timeout(entry, is_tcp, dir, tcp_syn, now)
         self.entries[key] = entry
+        self.mutations += 1
         return entry
 
     # -- GC (pkg/maps/ctmap conntrack GC) -----------------------------------
@@ -274,4 +285,6 @@ class CTMap:
         dead = [k for k, v in self.entries.items() if v.lifetime < now]
         for k in dead:
             del self.entries[k]
+        if dead:
+            self.mutations += 1
         return len(dead)

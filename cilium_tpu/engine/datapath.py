@@ -147,19 +147,57 @@ class FlowBatch:
         ep_index, saddr, daddr, sport, dport, proto, direction,
         is_fragment=None,
     ) -> "FlowBatch":
+        """Pack all eight columns into ONE [8, B] u32 host array and
+        upload it as a single transfer — per-array device_put pays the
+        transport's ~100 ms fixed round-trip latency EIGHT times per
+        batch, which dominated the sustained-churn loop.  A tiny
+        jitted splitter restores the typed columns on device."""
         b = len(ep_index)
         if is_fragment is None:
             is_fragment = np.zeros(b, dtype=bool)
-        return FlowBatch(
-            ep_index=jnp.asarray(ep_index, jnp.int32),
-            saddr=jnp.asarray(np.asarray(saddr, np.uint32)),
-            daddr=jnp.asarray(np.asarray(daddr, np.uint32)),
-            sport=jnp.asarray(sport, jnp.int32),
-            dport=jnp.asarray(dport, jnp.int32),
-            proto=jnp.asarray(proto, jnp.int32),
-            direction=jnp.asarray(direction, jnp.int32),
-            is_fragment=jnp.asarray(is_fragment, bool),
+        cols = dict(
+            ep_index=ep_index, saddr=saddr, daddr=daddr, sport=sport,
+            dport=dport, proto=proto, direction=direction,
+            is_fragment=is_fragment,
         )
+        packed = np.empty((len(FLOW_COLUMNS), b), dtype=np.uint32)
+        for j, name in enumerate(FLOW_COLUMNS):
+            packed[j] = np.asarray(cols[name]).astype(
+                np.uint32, copy=False
+            )
+        return _unpack_flow_batch(jnp.asarray(packed))
+
+
+# THE column-order contract for packed flow transfers: row j of a
+# [8, B] u32 pack is FLOW_COLUMNS[j].  from_numpy's pack,
+# flow_batch_from_packed, and replay.pack_flow_pool all derive from
+# this one tuple — reorder here and nowhere else.
+FLOW_COLUMNS = (
+    "ep_index", "saddr", "daddr", "sport", "dport", "proto",
+    "direction", "is_fragment",
+)
+
+
+def flow_batch_from_packed(packed) -> "FlowBatch":
+    """[8, B] u32 rows (FLOW_COLUMNS order) → typed FlowBatch columns.
+    Traced helper: call from inside a jit (device-side half of the
+    single-transfer pack; also the pool-mode gather's splitter)."""
+    cols = dict(zip(FLOW_COLUMNS, packed))
+    return FlowBatch(
+        ep_index=cols["ep_index"].astype(jnp.int32),
+        saddr=cols["saddr"],
+        daddr=cols["daddr"],
+        sport=cols["sport"].astype(jnp.int32),
+        dport=cols["dport"].astype(jnp.int32),
+        proto=cols["proto"].astype(jnp.int32),
+        direction=cols["direction"].astype(jnp.int32),
+        is_fragment=cols["is_fragment"].astype(bool),
+    )
+
+
+# jitted splitter (jax.jit is lazy — no trace until first call): the
+# device-side half of FlowBatch.from_numpy's single-transfer pack
+_unpack_flow_batch = jax.jit(flow_batch_from_packed)
 
 
 @_register

@@ -82,17 +82,37 @@ class TupleBatch:
         direction,
         is_fragment=None,
     ) -> "TupleBatch":
+        """Single-transfer upload: one [6, B] u32 pack instead of six
+        device_puts (each pays the transport's ~100 ms round trip —
+        see FlowBatch.from_numpy)."""
         b = len(ep_index)
         if is_fragment is None:
             is_fragment = np.zeros(b, dtype=bool)
-        return TupleBatch(
-            ep_index=jnp.asarray(ep_index, dtype=jnp.int32),
-            identity=jnp.asarray(identity, dtype=jnp.uint32),
-            dport=jnp.asarray(dport, dtype=jnp.int32),
-            proto=jnp.asarray(proto, dtype=jnp.int32),
-            direction=jnp.asarray(direction, dtype=jnp.int32),
-            is_fragment=jnp.asarray(is_fragment, dtype=bool),
+        packed = np.empty((6, b), dtype=np.uint32)
+        packed[0] = np.asarray(ep_index).astype(np.uint32, copy=False)
+        packed[1] = np.asarray(identity, np.uint32)
+        packed[2] = np.asarray(dport).astype(np.uint32, copy=False)
+        packed[3] = np.asarray(proto).astype(np.uint32, copy=False)
+        packed[4] = np.asarray(direction).astype(
+            np.uint32, copy=False
         )
+        packed[5] = np.asarray(is_fragment).astype(np.uint32)
+        return _unpack_tuple_batch(jnp.asarray(packed))
+
+
+def _tuple_batch_from_packed(packed) -> "TupleBatch":
+    return TupleBatch(
+        ep_index=packed[0].astype(jnp.int32),
+        identity=packed[1],
+        dport=packed[2].astype(jnp.int32),
+        proto=packed[3].astype(jnp.int32),
+        direction=packed[4].astype(jnp.int32),
+        is_fragment=packed[5].astype(bool),
+    )
+
+
+# jitted splitter for TupleBatch.from_numpy's single-transfer pack
+_unpack_tuple_batch = jax.jit(_tuple_batch_from_packed)
 
 
 @jax.tree_util.register_pytree_node_class

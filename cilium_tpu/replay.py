@@ -43,6 +43,300 @@ _CT_INTENT_CAP = 1 << 16
 # claim-table slots for the on-device intent dedup (scatter-min);
 # larger = fewer convergence re-runs from slot collisions
 _CT_CLAIM_SLOTS = 1 << 19
+# intent-fetch slice buckets: the D2H transport costs ~100 ms of fixed
+# latency plus ~17 MB/s, so the fetch moves the smallest power-of-two
+# column slice covering the round's intent count instead of the full
+# [10, cap] buffer (2.6 MB).  Static sizes keep the slice kernels in
+# the jit cache.
+_CT_FETCH_BUCKETS = (1 << 10, 1 << 13, _CT_INTENT_CAP)
+
+
+def _churn_compact(out, flows, valid):
+    """Dedup + compact a batch's create/delete intents on device: a
+    scatter-min claim table keeps the FIRST flagged row per flow-hash
+    slot (distinct flows sharing a slot lose the round and surface in
+    the header's `remaining`, which drives a convergence re-run), so
+    the D2H transfer is O(unique intents), never O(batch).
+
+    Returns (header u32 [4] = count/allowed/redirected/remaining,
+    intents u32 [10, cap]) as SEPARATE outputs so the caller can pull
+    the 16-byte header alone on quiet rounds — the transport costs
+    ~100 ms of fixed latency per fetch, so the intent buffer only
+    moves when the header says something is in it."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.engine.hashtable import fnv1a_device
+
+    cap = _CT_INTENT_CAP
+    claim_m = _CT_CLAIM_SLOTS
+    b = out.ct_create.shape[0]
+    flag = out.ct_create.astype(bool) | out.ct_delete.astype(bool)
+    in_valid = jnp.arange(b, dtype=jnp.int32) < valid
+    flag = flag & in_valid
+
+    h = fnv1a_device(
+        jnp.stack(
+            [
+                out.final_daddr.astype(jnp.uint32),
+                flows.saddr.astype(jnp.uint32),
+                (out.final_dport.astype(jnp.uint32) << 16)
+                | (flows.sport.astype(jnp.uint32) & 0xFFFF),
+                (flows.proto.astype(jnp.uint32) << 8)
+                | flows.direction.astype(jnp.uint32),
+            ],
+            axis=1,
+        )
+    )
+    slot = (h & jnp.uint32(claim_m - 1)).astype(jnp.int32)
+    row_id = jnp.arange(b, dtype=jnp.int32)
+    claim = jnp.full(claim_m, b, jnp.int32).at[slot].min(
+        jnp.where(flag, row_id, b)
+    )
+    winner_row = claim[slot]
+    winner = flag & (winner_row == row_id)
+    # losers whose full hash equals their slot winner's are (almost
+    # surely) later packets of the SAME flow — the winner's create
+    # covers them, no convergence re-run needed.  A 32-bit-hash
+    # collision between distinct flows defers that flow's create to
+    # its next appearance in the stream, the same race the per-packet
+    # kernel datapath has (conntrack.h ct_create4 is best-effort too).
+    wr = jnp.clip(winner_row, 0, b - 1)
+    true_loser = flag & ~winner & (h[wr] != h)
+
+    # compaction via argsort, NOT scatter: a scatter routing millions
+    # of non-winner rows at one trash index is pathologically slow on
+    # TPU (duplicate-index collision handling); sorting 'winner-first'
+    # and slicing the head is a single O(B log B) sort + tiny gathers
+    take = min(cap, b)
+    order = jnp.argsort(jnp.where(winner, row_id, jnp.int32(b)))[:take]
+    keep = winner[order]  # mask off the tail when < cap win
+    cols = jnp.stack(
+        [
+            out.ct_create.astype(jnp.uint32),
+            out.ct_delete.astype(jnp.uint32),
+            out.final_daddr.astype(jnp.uint32),
+            out.final_dport.astype(jnp.uint32),
+            flows.saddr.astype(jnp.uint32),
+            flows.sport.astype(jnp.uint32),
+            flows.proto.astype(jnp.uint32),
+            flows.direction.astype(jnp.uint32),
+            out.rev_nat.astype(jnp.uint32),
+            out.lb_slave.astype(jnp.uint32),
+        ]
+    )  # [10, B]
+    intents = jnp.zeros((10, cap), jnp.uint32)
+    intents = intents.at[:, :take].set(
+        jnp.where(keep[None, :], cols[:, order], 0)
+    )
+    n_tx = jnp.minimum(winner.sum(dtype=jnp.uint32), jnp.uint32(take))
+    allowed = jnp.sum(
+        out.allowed.astype(jnp.uint32) * in_valid, dtype=jnp.uint32
+    )
+    redirected = jnp.sum(
+        (out.proxy_port > 0) & in_valid, dtype=jnp.uint32
+    )
+    overflow = winner.sum(dtype=jnp.uint32) - n_tx
+    remaining = true_loser.sum(dtype=jnp.uint32) + overflow
+    header = jnp.stack([n_tx, allowed, redirected, remaining])
+    return header, intents
+
+
+_CHURN_FNS = None
+
+
+def _flows_from_pool(pool_packed, picks):
+    """Device-side flow materialization: gather pool rows by pick
+    index inside the fused program, split via the shared
+    FLOW_COLUMNS contract.  The pool-mode data loader exists because
+    the operator host has ONE core shared with the transport relay —
+    every host-touched byte (decode, pack, upload serialization)
+    competes with the tunnel for that core, so the loader moves
+    4 bytes/tuple (the pick) instead of ~88 (decode read + pack write
+    + record upload)."""
+    from cilium_tpu.engine.datapath import flow_batch_from_packed
+
+    return flow_batch_from_packed(pool_packed[:, picks])
+
+
+def pack_flow_pool(pool: Dict[str, np.ndarray]) -> np.ndarray:
+    """Flow-universe dict → [8, P] u32 pack (one upload, device
+    gathers per batch).  Row order is datapath.FLOW_COLUMNS — the
+    same contract FlowBatch.from_numpy packs with."""
+    from cilium_tpu.engine.datapath import FLOW_COLUMNS
+
+    p = len(pool["saddr"])
+    packed = np.empty((len(FLOW_COLUMNS), p), dtype=np.uint32)
+    for j, k in enumerate(FLOW_COLUMNS):
+        packed[j] = np.asarray(pool[k]).astype(np.uint32, copy=False)
+    return packed
+
+
+def _churn_fns():
+    """Jitted fused churn programs: datapath step + intent compaction
+    in ONE dispatch (the churn loop's critical path is serial —
+    step → header D2H → CT fold → snapshot delta — so every extra
+    dispatch adds a full transport round trip).  Returns
+    (step, step_accum, step_pool, step_pool_accum); the *_pool forms
+    additionally fuse the pool-row gather (see _flows_from_pool)."""
+    global _CHURN_FNS
+    if _CHURN_FNS is None:
+        import jax
+
+        from cilium_tpu.engine.datapath import (
+            _datapath_kernel,
+            _datapath_kernel_accum,
+        )
+
+        def step(tables, flows, valid):
+            out = _datapath_kernel(tables, flows)
+            return _churn_compact(out, flows, valid)
+
+        def step_accum(tables, flows, valid, acc):
+            out, acc = _datapath_kernel_accum(tables, flows, acc)
+            header, intents = _churn_compact(out, flows, valid)
+            return header, intents, acc
+
+        def step_pool(tables, pool_packed, picks, valid):
+            flows = _flows_from_pool(pool_packed, picks)
+            out = _datapath_kernel(tables, flows)
+            return _churn_compact(out, flows, valid)
+
+        def step_pool_accum(tables, pool_packed, picks, valid, acc):
+            flows = _flows_from_pool(pool_packed, picks)
+            out, acc = _datapath_kernel_accum(tables, flows, acc)
+            header, intents = _churn_compact(out, flows, valid)
+            return header, intents, acc
+
+        _CHURN_FNS = (
+            jax.jit(step),
+            jax.jit(step_accum, donate_argnums=(3,)),
+            jax.jit(step_pool),
+            jax.jit(step_pool_accum, donate_argnums=(4,)),
+        )
+    return _CHURN_FNS
+
+
+_FETCH_SLICE = {}
+
+
+def _fetch_intents(intents_dev, k: int) -> np.ndarray:
+    """Pull the first k intent columns via the smallest static slice
+    bucket (each bucket is one tiny cached jit program; the transport
+    charges ~100 ms latency + ~17 MB/s bandwidth per fetch, so a
+    quiet round moves kilobytes, not the full 2.6 MB buffer)."""
+    import jax
+
+    bucket = next(
+        (b for b in _CT_FETCH_BUCKETS if k <= b), _CT_INTENT_CAP
+    )
+    fn = _FETCH_SLICE.get(bucket)
+    if fn is None:
+        fn = jax.jit(lambda x, n=bucket: x[:, :n])
+        _FETCH_SLICE[bucket] = fn
+    return np.asarray(fn(intents_dev))[:, :k]
+
+
+class _ChurnDriver:
+    """Shared churn-mode machinery for replay()/replay_pool(): the
+    bucket-index + device-snapshot cache, and the per-round drain
+    (header parse → bucketed intent fetch → host CT fold → per-bucket
+    device delta).
+
+    The bucket index (O(entries) host hash placement) and the
+    full-snapshot upload are the churn path's fixed setup cost — both
+    cache on the CTMap across calls.  Validity gate: the CTMap
+    mutation counter (bumped by create/probe/gc — catches host-side
+    lookups between replays that mutate lifetime/closing flags in
+    place) plus the exact key set (catches direct `entries` dict
+    manipulation).  The only remaining bypass is mutating a CTEntry
+    object's fields directly without touching the map; such callers
+    must `del ct_map._device_churn_cache`.  Within the loop every
+    mutation flows through ct_index.apply, keeping all three (map,
+    index, device snapshot) in lockstep.
+    """
+
+    def __init__(self, ct_map) -> None:
+        import jax
+
+        from cilium_tpu.ct.device import CTBucketIndex
+
+        self.ct_map = ct_map
+        self._delta_jit = _delta_fn()
+        cached = getattr(ct_map, "_device_churn_cache", None)
+        if (
+            cached is not None
+            and cached[2] == getattr(ct_map, "mutations", -1)
+            and cached[0].key_home.keys() == ct_map.entries.keys()
+        ):
+            self.ct_index, self.dev_snap = cached[:2]
+        else:
+            self.ct_index = CTBucketIndex(ct_map)
+            self.dev_snap = jax.device_put(
+                self.ct_index.full_snapshot()
+            )
+
+    def drain(
+        self, header_d, intents_d, stats: "ReplayStats",
+        valid: int, first_pass: bool,
+    ) -> int:
+        """One convergence round: fold the round's intents into the
+        host CT + device snapshot, update stats on the first pass.
+        Returns the header's `remaining` count (>0 ⇒ the caller must
+        re-run the batch against the updated snapshot)."""
+        from cilium_tpu.engine.datapath import apply_ct_writeback_host
+
+        header = np.asarray(header_d)
+        k = int(header[0])
+        remaining = int(header[3])
+        if first_pass:
+            stats.total += valid
+            allowed = int(header[1])
+            stats.allowed += allowed
+            stats.denied += valid - allowed
+            stats.redirected += int(header[2])
+            stats.batches += 1
+        if k:
+            packed = _fetch_intents(intents_d, k)
+            created_keys, deleted_keys = apply_ct_writeback_host(
+                self.ct_map,
+                packed[0].astype(bool),
+                packed[1].astype(bool),
+                *(packed[j] for j in range(2, 10)),
+            )
+            stats.ct_created += len(created_keys)
+            stats.ct_deleted += len(deleted_keys)
+            if created_keys or deleted_keys:
+                idx, rows, new_stash = self.ct_index.apply(
+                    created_keys, deleted_keys
+                )
+                if len(idx) or new_stash is not None:
+                    self.dev_snap = self._delta_jit(
+                        self.dev_snap, idx, rows, new_stash
+                    )
+        return remaining
+
+    def stash(self) -> None:
+        self.ct_map._device_churn_cache = (
+            self.ct_index,
+            self.dev_snap,
+            self.ct_map.mutations,
+        )
+
+
+_DELTA_FN = None
+
+
+def _delta_fn():
+    """Module-level cached jit of apply_bucket_delta (donated
+    snapshot) — per-driver jits would re-trace on every replay call."""
+    global _DELTA_FN
+    if _DELTA_FN is None:
+        import jax
+
+        from cilium_tpu.ct.device import apply_bucket_delta
+
+        _DELTA_FN = jax.jit(apply_bucket_delta, donate_argnums=(0,))
+    return _DELTA_FN
 
 
 @dataclass
@@ -173,22 +467,13 @@ def replay(
     import time
 
     import jax
-    import jax.numpy as jnp
 
-    from cilium_tpu.ct.device import (
-        CTBucketIndex,
-        apply_bucket_delta,
-    )
     from cilium_tpu.engine.datapath import (
         DatapathTables,
-        apply_ct_writeback_host,
         datapath_step,
         datapath_step_accum,
     )
-    from cilium_tpu.engine.verdict import (
-        make_counter_buffers,
-        split_counters,
-    )
+    from cilium_tpu.engine.verdict import make_counter_buffers
 
     if manager is not None:
         # stale-table guard at the layer that actually reads the
@@ -221,140 +506,68 @@ def replay(
         acc = jax.device_put(make_counter_buffers(tables.policy))
         batches_since_fold = 0
 
-    ct_index = None
+    churn = None
     if ct_map is not None:
-        # incremental churn machinery: a host mirror of the device
-        # bucket layout (built once), a donated device snapshot, and
-        # one packed D2H per batch.  The kernel owns the map, the
-        # agent folds writes back — with per-bucket row updates
-        # instead of full-snapshot rebuilds (bpf/lib/conntrack.h's
-        # map writes are per-bucket too).
-        ct_index = CTBucketIndex(ct_map)
-        dev_snap = jax.device_put(ct_index.full_snapshot())
+        # incremental churn machinery (_ChurnDriver): a host mirror
+        # of the device bucket layout, a donated device snapshot, and
+        # a two-phase D2H per batch (16-byte header always; intent
+        # columns only on rounds that flagged any).  The kernel owns
+        # the map, the agent folds writes back — with per-bucket row
+        # updates instead of full-snapshot rebuilds
+        # (bpf/lib/conntrack.h's map writes are per-bucket too).
+        churn = _ChurnDriver(ct_map)
         tables = DatapathTables(
             prefilter=tables.prefilter,
             ipcache=tables.ipcache,
-            ct=dev_snap,
+            ct=churn.dev_snap,
             lb=tables.lb,
             policy=tables.policy,
         )
-        _delta_jit = jax.jit(apply_bucket_delta, donate_argnums=(0,))
-        # device-side intent compaction: host↔device transfers through
-        # the runtime cost ~100 ms latency + low bandwidth, so only
-        # the create/delete-flagged rows travel (fixed capacity; the
-        # overflow count rides along in the header row).  Layout:
-        # [11, cap] u32, transferred flat — rows 0-9 intent columns,
-        # row 10 header (count, allowed, redirected, remaining at
-        # cols 0-3)
-        cap = _CT_INTENT_CAP
-        claim_m = _CT_CLAIM_SLOTS
-
-        def _compact(out, flows, valid):
-            """Dedup + compact the batch's create/delete intents on
-            device: a scatter-min claim table keeps the FIRST flagged
-            row per flow-hash slot (distinct flows sharing a slot lose
-            the round and surface in the header's `remaining`, which
-            drives a convergence re-run), so the D2H transfer is
-            O(unique intents), never O(batch)."""
-            from cilium_tpu.engine.hashtable import fnv1a_device
-
-            b = out.ct_create.shape[0]
-            flag = (
-                out.ct_create.astype(bool) | out.ct_delete.astype(bool)
-            )
-            in_valid = jnp.arange(b, dtype=jnp.int32) < valid
-            flag = flag & in_valid
-
-            h = fnv1a_device(
-                jnp.stack(
-                    [
-                        out.final_daddr.astype(jnp.uint32),
-                        flows.saddr.astype(jnp.uint32),
-                        (
-                            out.final_dport.astype(jnp.uint32) << 16
-                        )
-                        | (flows.sport.astype(jnp.uint32) & 0xFFFF),
-                        (flows.proto.astype(jnp.uint32) << 8)
-                        | flows.direction.astype(jnp.uint32),
-                    ],
-                    axis=1,
-                )
-            )
-            slot = (h & jnp.uint32(claim_m - 1)).astype(jnp.int32)
-            row_id = jnp.arange(b, dtype=jnp.int32)
-            claim = jnp.full(claim_m, b, jnp.int32).at[slot].min(
-                jnp.where(flag, row_id, b)
-            )
-            winner_row = claim[slot]
-            winner = flag & (winner_row == row_id)
-            # losers whose full hash equals their slot winner's are
-            # (almost surely) later packets of the SAME flow — the
-            # winner's create covers them, no convergence re-run
-            # needed.  A 32-bit-hash collision between distinct flows
-            # defers that flow's create to its next appearance in the
-            # stream, the same race the per-packet kernel datapath
-            # has (conntrack.h ct_create4 is best-effort too).
-            wr = jnp.clip(winner_row, 0, b - 1)
-            true_loser = flag & ~winner & (h[wr] != h)
-
-            # compaction via argsort, NOT scatter: a scatter routing
-            # millions of non-winner rows at one trash index is
-            # pathologically slow on TPU (duplicate-index collision
-            # handling); sorting 'winner-first' and slicing the head
-            # is a single O(B log B) sort plus tiny gathers
-            take = min(cap, b)
-            order = jnp.argsort(
-                jnp.where(winner, row_id, jnp.int32(b))
-            )[:take]
-            keep = winner[order]  # mask off the tail when < cap win
-            cols = jnp.stack(
-                [
-                    out.ct_create.astype(jnp.uint32),
-                    out.ct_delete.astype(jnp.uint32),
-                    out.final_daddr.astype(jnp.uint32),
-                    out.final_dport.astype(jnp.uint32),
-                    flows.saddr.astype(jnp.uint32),
-                    flows.sport.astype(jnp.uint32),
-                    flows.proto.astype(jnp.uint32),
-                    flows.direction.astype(jnp.uint32),
-                    out.rev_nat.astype(jnp.uint32),
-                    out.lb_slave.astype(jnp.uint32),
-                ]
-            )  # [10, B]
-            buf = jnp.zeros((11, cap), jnp.uint32)
-            buf = buf.at[:10, :take].set(
-                jnp.where(keep[None, :], cols[:, order], 0)
-            )
-            n_tx = jnp.minimum(
-                winner.sum(dtype=jnp.uint32), jnp.uint32(take)
-            )
-            allowed = jnp.sum(
-                out.allowed.astype(jnp.uint32) * in_valid,
-                dtype=jnp.uint32,
-            )
-            redirected = jnp.sum(
-                (out.proxy_port > 0) & in_valid, dtype=jnp.uint32
-            )
-            overflow = winner.sum(dtype=jnp.uint32) - n_tx
-            remaining = true_loser.sum(dtype=jnp.uint32) + overflow
-            buf = buf.at[10, :4].set(
-                jnp.stack([n_tx, allowed, redirected, remaining])
-            )
-            return buf.reshape(-1)  # flat: fastest D2H layout
-
-        _compact_jit = jax.jit(_compact)
+        churn_step, churn_step_accum = _churn_fns()[:2]
 
     pending = []  # pipelined dispatch, bounded depth
     t0 = time.perf_counter()
     for flows, valid in read_flow_batches(buf, batch_size, ep_map):
         if ct_map is not None:
-            tables = DatapathTables(
-                prefilter=tables.prefilter,
-                ipcache=tables.ipcache,
-                ct=dev_snap,
-                lb=tables.lb,
-                policy=tables.policy,
-            )
+            # sustained churn: the compaction runs FUSED with the
+            # datapath step (one dispatch per round), the 16-byte
+            # header is the only unconditional D2H, and intent
+            # columns travel in the smallest slice bucket covering
+            # the round's count.  Claim-table losers (distinct flows
+            # sharing a dedup slot, or >cap unique intents) drive
+            # convergence re-runs of the same batch against the
+            # updated snapshot, so the next batch sees every flow
+            # this one created (up to the documented
+            # 32-bit-hash-collision deferral in _churn_compact).
+            first_pass = True
+            while True:
+                tables = DatapathTables(
+                    prefilter=tables.prefilter,
+                    ipcache=tables.ipcache,
+                    ct=churn.dev_snap,
+                    lb=tables.lb,
+                    policy=tables.policy,
+                )
+                if first_pass and accumulate_counters:
+                    header_d, intents_d, acc = churn_step_accum(
+                        tables, flows, valid, acc
+                    )
+                    batches_since_fold += 1
+                    if batches_since_fold >= fold_every:
+                        _fold_counters()
+                else:
+                    # convergence passes skip counter accumulation —
+                    # the first pass already counted this batch
+                    header_d, intents_d = churn_step(
+                        tables, flows, valid
+                    )
+                remaining = churn.drain(
+                    header_d, intents_d, stats, int(valid), first_pass
+                )
+                first_pass = False
+                if remaining == 0:
+                    break
+            continue
         if accumulate_counters:
             out, acc = datapath_step_accum(tables, flows, acc)
             batches_since_fold += 1
@@ -362,69 +575,14 @@ def replay(
                 _fold_counters()
         else:
             out = datapath_step(tables, flows)
-        if ct_map is not None:
-            # sustained churn: drain in order via ONE compacted,
-            # deduped D2H; fold intents back on host; scatter the
-            # changed bucket rows into the donated device snapshot.
-            # Claim-table losers (distinct flows sharing a dedup
-            # slot, or >cap unique intents) drive convergence
-            # re-runs of the same batch against the updated
-            # snapshot, so the next batch sees every flow this one
-            # created (up to the documented 32-bit-hash-collision
-            # deferral in _compact).
-            first_pass = True
-            while True:
-                packed = np.asarray(
-                    _compact_jit(out, flows, valid)
-                ).reshape(11, cap)
-                if first_pass:
-                    stats.total += int(valid)
-                    allowed = int(packed[10, 1])
-                    stats.allowed += allowed
-                    stats.denied += int(valid) - allowed
-                    stats.redirected += int(packed[10, 2])
-                    stats.batches += 1
-                    first_pass = False
-                k = int(packed[10, 0])
-                remaining = int(packed[10, 3])
-                created_keys, deleted_keys = apply_ct_writeback_host(
-                    ct_map,
-                    packed[0, :k].astype(bool),
-                    packed[1, :k].astype(bool),
-                    *(packed[j, :k] for j in range(2, 10)),
-                )
-                stats.ct_created += len(created_keys)
-                stats.ct_deleted += len(deleted_keys)
-                if created_keys or deleted_keys:
-                    idx, rows, new_stash = ct_index.apply(
-                        created_keys, deleted_keys
-                    )
-                    if len(idx) or new_stash is not None:
-                        dev_snap = _delta_jit(
-                            dev_snap,
-                            idx,
-                            rows,
-                            new_stash,
-                        )
-                if remaining == 0:
-                    break
-                # convergence pass: re-evaluate against the updated
-                # snapshot (no counter re-accumulation)
-                tables = DatapathTables(
-                    prefilter=tables.prefilter,
-                    ipcache=tables.ipcache,
-                    ct=dev_snap,
-                    lb=tables.lb,
-                    policy=tables.policy,
-                )
-                out = datapath_step(tables, flows)
-            continue
         pending.append((out, valid))
         stats.batches += 1
         if len(pending) >= 4:
             _drain_fused(pending.pop(0), stats)
     while pending:
         _drain_fused(pending.pop(0), stats)
+    if churn is not None:
+        churn.stash()
     stats.seconds = time.perf_counter() - t0
 
     if not accumulate_counters:
@@ -432,6 +590,76 @@ def replay(
     _fold_counters()
     kg = tables.policy.l4_meta.shape[2]
     return stats, acc_total[:, :, :kg], acc_total[:, :, kg:]
+
+
+def replay_pool(
+    tables,
+    pool: Dict[str, np.ndarray],
+    picks: np.ndarray,
+    batch_size: int = 1 << 21,
+    *,
+    ct_map,
+) -> ReplayStats:
+    """Sustained-churn replay over a FLOW-UNIVERSE loader: the pool
+    (unique flows, as real traffic repeats flows) uploads once and
+    each batch moves only its u32 pick indices; the fused program
+    gathers the flow columns on device (_flows_from_pool) before the
+    datapath step + intent compaction.
+
+    Identical verdict/CT semantics to replay() with a record buffer of
+    pool[picks] — only the transport changes: 4 bytes/tuple instead of
+    decoding+packing+uploading 24-byte records through the single host
+    core the transport relay shares.  `ct_map` is required: pool mode
+    IS the churn loader (for churn-free pool replay, pre-stage device
+    batches as bench.run_config5's headline loop does).  Counter
+    accumulation is not offered here for the same reason.
+    """
+    import time
+
+    import jax
+
+    from cilium_tpu.engine.datapath import DatapathTables
+
+    stats = ReplayStats()
+    tables = jax.device_put(tables)
+    pool_dev = jax.device_put(pack_flow_pool(pool))
+    churn_pool = _churn_fns()[2]
+    churn = _ChurnDriver(ct_map)
+
+    picks = np.asarray(picks).astype(np.uint32, copy=False)
+    t0 = time.perf_counter()
+    for start in range(0, len(picks), batch_size):
+        chunk = picks[start : start + batch_size]
+        valid = len(chunk)
+        if valid < batch_size:
+            chunk = np.concatenate(
+                [
+                    chunk,
+                    np.zeros(batch_size - valid, dtype=np.uint32),
+                ]
+            )
+        picks_dev = jax.device_put(chunk)
+        first_pass = True
+        while True:
+            t = DatapathTables(
+                prefilter=tables.prefilter,
+                ipcache=tables.ipcache,
+                ct=churn.dev_snap,
+                lb=tables.lb,
+                policy=tables.policy,
+            )
+            header_d, intents_d = churn_pool(
+                t, pool_dev, picks_dev, valid
+            )
+            remaining = churn.drain(
+                header_d, intents_d, stats, valid, first_pass
+            )
+            first_pass = False
+            if remaining == 0:
+                break
+    churn.stash()
+    stats.seconds = time.perf_counter() - t0
+    return stats
 
 
 def replay_lattice(

@@ -21,6 +21,7 @@ remote nodes' pod CIDRs appear and vanish with node lifecycle.
 from __future__ import annotations
 
 import ipaddress
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
@@ -70,17 +71,31 @@ class TunnelMap:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._prefixes: Dict[str, int] = {}
-        self._node_cidr: Dict[str, str] = {}
+        # node name → (cidr, endpoint u32) this node's insert stored;
+        # the endpoint is re-checked before ownership-based deletes so
+        # a prefix reassigned to another node is never torn down by
+        # the old owner's late delete event.  Guarded by _node_lock
+        # (on_node's read-modify-write spans several _lock sections).
+        self._node_cidr: Dict[str, tuple] = {}
+        self._node_lock = threading.Lock()
         self._dirty = True
         self._tables: Optional[TunnelTables] = None
 
-    def set_tunnel_endpoint(self, prefix: str, endpoint_ip: str) -> None:
-        """SetTunnelEndpoint (tunnel.go:84).  v6 mappings are skipped
-        until the v6 overlay lands (engine/datapath6.py docstring)."""
+    def set_tunnel_endpoint(
+        self, prefix: str, endpoint_ip: str
+    ) -> Optional[int]:
+        """SetTunnelEndpoint (tunnel.go:84).  Returns the stored
+        endpoint u32, or None when skipped: v6 endpoints are skipped
+        until the v6 overlay lands (engine/datapath6.py docstring).
+        Raises when the map is full — direct callers should see the
+        failure, but event-driven feeds (on_node) must contain it.
+        Returning the parsed value (not a bool) lets on_node record
+        ownership with the EXACT endpoint the map stored, which
+        _release_owned later compares against."""
         try:
             ep = int(ipaddress.IPv4Address(endpoint_ip))
         except (ipaddress.AddressValueError, ValueError):
-            return
+            return None
         with self._lock:
             if (
                 prefix not in self._prefixes
@@ -91,6 +106,7 @@ class TunnelMap:
                 )
             self._prefixes[prefix] = ep
             self._dirty = True
+            return ep
 
     def delete_tunnel_endpoint(self, prefix: str) -> None:
         with self._lock:
@@ -108,19 +124,50 @@ class TunnelMap:
         cidr = getattr(node, "ipv4_alloc_cidr", None)
         ip = getattr(node, "internal_ip", None)
         name = getattr(node, "name", "")
+        with self._node_lock:
+            self._on_node_locked(kind, name, cidr, ip)
+
+    def _release_owned(self, name: str) -> None:
+        """Drop this node's recorded mapping, but only if the live
+        prefix entry still carries THIS node's endpoint — a prefix
+        reassigned to another node (its create processed before our
+        delete) must survive the old owner's teardown."""
+        owned = self._node_cidr.pop(name, None)
+        if owned is None:
+            return
+        cidr, ep = owned
+        with self._lock:
+            if self._prefixes.get(cidr) == ep:
+                self._prefixes.pop(cidr, None)
+                self._dirty = True
+
+    def _on_node_locked(self, kind, name, cidr, ip) -> None:
         old = self._node_cidr.get(name)
         if kind == "delete":
-            if old:
-                self.delete_tunnel_endpoint(old)
-                self._node_cidr.pop(name, None)
+            self._release_owned(name)
             return
-        if old and old != cidr:
-            self.delete_tunnel_endpoint(old)
-            self._node_cidr.pop(name, None)
+        if old and old[0] != cidr:
+            self._release_owned(name)
         if cidr and ip:
-            self.set_tunnel_endpoint(cidr, ip)
-            if cidr in self._prefixes:  # v4 mapping actually stored
-                self._node_cidr[name] = cidr
+            # contain the map-full error: this runs inside the
+            # kvstore watcher fan-out, and an escaping exception
+            # would starve every watcher registered after this one
+            # (KVStore._emit delivers synchronously); a node beyond
+            # the cap just stays un-encapsulated, like a failed
+            # tunnel-map update in the reference agent
+            try:
+                stored_ep = self.set_tunnel_endpoint(cidr, ip)
+            except ValueError:
+                logging.getLogger("tunnel").warning(
+                    "tunnel map full; node %s (%s) not mapped",
+                    name, cidr,
+                )
+                stored_ep = None
+            # ownership is recorded only when THIS node's insert took
+            # effect — a skipped v6 insert must not claim (and later
+            # delete) a mapping another node owns
+            if stored_ep is not None:
+                self._node_cidr[name] = (cidr, stored_ep)
 
     def tables(self) -> TunnelTables:
         with self._lock:

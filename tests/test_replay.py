@@ -220,6 +220,40 @@ def test_fused_replay_sustained_churn():
     assert not np.any(np.asarray(out2.ct_result)[was_created] == CT_NEW)
 
 
+def test_replay_pool_matches_record_replay():
+    """The pool-mode loader (flow universe + pick indices, device-side
+    gather) must produce the same stats and final CT state as replay()
+    over the equivalent record buffer pool[picks]."""
+    import copy
+
+    from cilium_tpu.replay import replay_pool
+    from tests.test_datapath import _random_flows
+
+    (rng, _, _, ct, _, states, tables, n_eps) = _fused_world()
+    p = 64
+    pool = _random_flows(rng, p, n_eps)
+    picks = rng.integers(0, p, size=256).astype(np.uint32)
+
+    sampled = {k: v[picks] for k, v in pool.items()}
+    buf = _encode_flows(sampled)
+    ct_rec = copy.deepcopy(ct)
+    stats_rec, _, _ = replay(
+        tables, buf, batch_size=128, ct_map=ct_rec,
+        accumulate_counters=False,
+    )
+    ct_pool = copy.deepcopy(ct)
+    stats_pool = replay_pool(
+        tables, pool, picks, batch_size=128, ct_map=ct_pool
+    )
+    assert stats_pool.total == stats_rec.total
+    assert stats_pool.allowed == stats_rec.allowed
+    assert stats_pool.denied == stats_rec.denied
+    assert stats_pool.redirected == stats_rec.redirected
+    assert stats_pool.ct_created == stats_rec.ct_created
+    assert stats_pool.ct_deleted == stats_rec.ct_deleted
+    assert set(ct_pool.entries) == set(ct_rec.entries)
+
+
 def test_counters_sync_l3_and_l4():
     """Both L4 (port 80 from client) and L3 (any port from peer) hits
     land in realized map-state packet counters."""
@@ -258,3 +292,32 @@ def test_counters_sync_l3_and_l4():
         and k.traffic_direction == INGRESS
     )
     assert l4_total == n_l4
+
+
+def test_churn_snapshot_cache_invalidated_by_host_probe():
+    """A host-side CT lookup between replays mutates entry values in
+    place (lifetime/closing flags); the cached device snapshot must be
+    rebuilt, not reused (gated on CTMap.mutations)."""
+    from cilium_tpu.ct.table import CT_EGRESS, CTTuple
+    from cilium_tpu.replay import replay_pool
+    from tests.test_datapath import _random_flows
+
+    (rng, _, _, ct, _, states, tables, n_eps) = _fused_world()
+    p = 64
+    pool = _random_flows(rng, p, n_eps)
+    picks = rng.integers(0, p, size=128).astype(np.uint32)
+    replay_pool(tables, pool, picks, batch_size=128, ct_map=ct)
+    cached = ct._device_churn_cache
+    assert cached[2] == ct.mutations
+    if not ct.entries:
+        return  # nothing created — nothing to probe
+    key = next(iter(ct.entries))
+    # host probe through the map: bumps the mutation counter
+    ct.lookup(
+        CTTuple(key.saddr, key.daddr, key.sport, key.dport,
+                key.nexthdr),
+        CT_EGRESS, now=5,
+    )
+    assert ct.mutations != cached[2]
+    replay_pool(tables, pool, picks, batch_size=128, ct_map=ct)
+    assert ct._device_churn_cache[0] is not cached[0]  # rebuilt

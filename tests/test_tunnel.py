@@ -107,3 +107,72 @@ def test_v6_nodes_skipped_not_fatal():
         )
     )
     assert got[0] == 0
+
+
+def test_v6_node_never_claims_v4_nodes_mapping():
+    """A node whose own insert was skipped (v6 endpoint) must not
+    claim — and later delete — a mapping another node owns for the
+    same CIDR."""
+    tm = TunnelMap()
+    tm.on_node(
+        "create",
+        Node(name="a", internal_ip="192.168.0.1",
+             ipv4_alloc_cidr="10.9.0.0/24"),
+    )
+    tm.on_node(
+        "create",
+        Node(name="b", internal_ip="fd00::2",
+             ipv4_alloc_cidr="10.9.0.0/24"),
+    )
+    tm.on_node("delete", Node(name="b", internal_ip="fd00::2"))
+    got = np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(np.array([_u32("10.9.0.5")], np.uint32)),
+        )
+    )
+    assert got[0] == _u32("192.168.0.1")  # node a's mapping survives
+
+
+def test_tunnel_map_full_contained_in_watcher_feed():
+    """Beyond-cap nodes are skipped with a warning, not raised through
+    the watcher fan-out (KVStore._emit delivers synchronously)."""
+    tm = TunnelMap()
+    for i in range(TunnelMap.MAX_PREFIXES):
+        tm.set_tunnel_endpoint(f"10.{i // 256}.{i % 256}.0/24",
+                               "192.168.0.1")
+    # watcher-feed path: must not raise
+    tm.on_node(
+        "create",
+        Node(name="over", internal_ip="192.168.0.9",
+             ipv4_alloc_cidr="172.16.0.0/24"),
+    )
+    assert "over" not in tm._node_cidr
+    import pytest
+    with pytest.raises(ValueError):
+        tm.set_tunnel_endpoint("172.16.1.0/24", "192.168.0.9")
+
+
+def test_late_delete_from_old_owner_spares_reassigned_prefix():
+    """CIDR reassigned a→b with b's create processed before a's
+    delete: a's late delete must not tear down b's live mapping
+    (ownership is endpoint-checked, not name-checked)."""
+    tm = TunnelMap()
+    tm.on_node(
+        "create",
+        Node(name="a", internal_ip="192.168.0.1",
+             ipv4_alloc_cidr="10.9.0.0/24"),
+    )
+    tm.on_node(
+        "create",
+        Node(name="b", internal_ip="192.168.0.2",
+             ipv4_alloc_cidr="10.9.0.0/24"),
+    )
+    tm.on_node("delete", Node(name="a", internal_ip="192.168.0.1"))
+    got = np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(np.array([_u32("10.9.0.5")], np.uint32)),
+        )
+    )
+    assert got[0] == _u32("192.168.0.2")  # b's mapping survives
