@@ -917,6 +917,13 @@ def config3(args) -> None:
             (method.encode(), path.encode(), host.encode())
         )
     tm, tml, tp, tpl, th, thl, _ = pad_requests(templates)
+    # trim each field to its occupied pow2 width — the scans cost per
+    # processed byte, and real requests rarely fill the field budgets
+    from cilium_tpu.l7.http import trim_packed
+
+    tm = trim_packed(tm, tml)
+    tp = trim_packed(tp, tpl)
+    th = trim_packed(th, thl)
     n = args.l7_requests
     pick = rng.integers(0, len(templates), size=n)
     ident = rng.integers(0, n_ident, size=n).astype(np.int32)

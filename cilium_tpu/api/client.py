@@ -11,6 +11,15 @@ import json
 import socket
 
 
+class APIError(RuntimeError):
+    """Non-2xx agent response, with the HTTP status for callers that
+    branch on conflict (409) vs server condition (5xx)."""
+
+    def __init__(self, status: int, msg: str) -> None:
+        super().__init__(msg)
+        self.status = status
+
+
 class _UnixHTTPConnection(http.client.HTTPConnection):
     def __init__(self, socket_path: str, timeout: float = 30.0):
         super().__init__("localhost", timeout=timeout)
@@ -43,10 +52,11 @@ class APIClient:
             resp = conn.getresponse()
             data = json.loads(resp.read().decode() or "null")
             if resp.status >= 400:
-                raise RuntimeError(
+                raise APIError(
+                    resp.status,
                     data.get("error", f"HTTP {resp.status}")
                     if isinstance(data, dict)
-                    else f"HTTP {resp.status}"
+                    else f"HTTP {resp.status}",
                 )
             return data
         finally:
@@ -76,6 +86,19 @@ class APIClient:
 
     def endpoint_list(self):
         return self._request("GET", "/endpoint")
+
+    def endpoint_create(self, endpoint_id: int, body: dict):
+        return self._request(
+            "PUT", f"/endpoint/{endpoint_id}", body=body
+        )
+
+    def endpoint_delete(self, endpoint_id: int, name=None):
+        path = f"/endpoint/{endpoint_id}"
+        if name:
+            from urllib.parse import quote
+
+            path += f"?name={quote(name)}"
+        return self._request("DELETE", path)
 
     def endpoint_get(self, endpoint_id: int):
         return self._request("GET", f"/endpoint/{endpoint_id}")

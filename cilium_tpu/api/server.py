@@ -19,6 +19,8 @@ Routes (the api/v1 subset this framework's daemon implements):
   POST   /policy/resolve     policy trace (the explain mode)
   GET    /endpoint           endpoint list
   GET    /endpoint/{id}      one endpoint
+  PUT    /endpoint/{id}      create endpoint (labels[, ipv4, name]; CNI ADD)
+  DELETE /endpoint/{id}      delete endpoint (CNI DEL)
   GET    /identity           identity cache
   GET    /ipcache            ipcache dump
   GET    /metrics            metrics registry dump
@@ -123,6 +125,36 @@ class DaemonAPI:
             for ep in self.daemon.endpoint_manager.endpoints()
         ]
 
+    def endpoint_create(self, endpoint_id: int, body: dict) -> dict:
+        from cilium_tpu.labels import labels_from_json
+
+        labels = labels_from_json(body.get("labels", []))
+        endpoint = self.daemon.create_endpoint(
+            endpoint_id,
+            labels,
+            ipv4=body.get("ipv4"),
+            name=body.get("name", ""),
+        )
+        return {
+            "id": endpoint.id,
+            "ipv4": endpoint.ipv4,
+            "identity": (
+                endpoint.security_identity.id
+                if endpoint.security_identity
+                else None
+            ),
+            "state": endpoint.state,
+        }
+
+    def endpoint_delete(
+        self, endpoint_id: int, expected_name: Optional[str] = None
+    ) -> dict:
+        return {
+            "deleted": self.daemon.delete_endpoint(
+                endpoint_id, expected_name
+            )
+        }
+
     def endpoint_get(self, endpoint_id: int) -> Optional[dict]:
         for entry in self.endpoint_list():
             if entry["id"] == endpoint_id:
@@ -210,6 +242,52 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:
             return self._reply(500, {"error": str(exc)})
 
+    def do_PUT(self) -> None:  # noqa: N802
+        from cilium_tpu.daemon import EndpointConflict
+
+        api: DaemonAPI = self.server.api  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path.startswith("/endpoint/"):
+                raw = path.rsplit("/", 1)[1]
+                if not raw.isdigit():
+                    return self._reply(404, {"error": "not found"})
+                # parse errors alone are the client's fault — deeper
+                # ValueErrors (IPAM exhaustion is one) are SERVER
+                # conditions and must not masquerade as 400s
+                try:
+                    body = json.loads(self._body() or "{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be an object")
+                    labels = body.get("labels", [])
+                    if not isinstance(labels, list) or any(
+                        not isinstance(item, dict)
+                        or "key" not in item
+                        for item in labels
+                    ):
+                        raise ValueError("malformed labels")
+                    if body.get("ipv4") is not None:
+                        import ipaddress as _ipaddress
+
+                        _ipaddress.IPv4Address(body["ipv4"])
+                except (
+                    json.JSONDecodeError,
+                    ValueError,
+                    TypeError,
+                    AttributeError,
+                ) as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                return self._reply(
+                    201, api.endpoint_create(int(raw), body)
+                )
+            return self._reply(404, {"error": f"no route {path}"})
+        except EndpointConflict as exc:
+            return self._reply(409, {"error": str(exc)})
+        except Exception as exc:
+            return self._reply(500, {"error": str(exc)})
+
     def do_DELETE(self) -> None:  # noqa: N802
         api: DaemonAPI = self.server.api  # type: ignore
         path = self.path.split("?", 1)[0]
@@ -217,6 +295,26 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/policy":
                 labels = json.loads(self._body())
                 return self._reply(200, api.policy_delete(labels))
+            if path.startswith("/endpoint/"):
+                raw = path.rsplit("/", 1)[1]
+                if not raw.isdigit():
+                    return self._reply(404, {"error": "not found"})
+                name = None
+                if "name=" in (self.path.partition("?")[2] or ""):
+                    from urllib.parse import parse_qs
+
+                    name = parse_qs(
+                        self.path.partition("?")[2]
+                    ).get("name", [None])[0]
+                from cilium_tpu.daemon import EndpointConflict
+
+                try:
+                    return self._reply(
+                        200,
+                        api.endpoint_delete(int(raw), name),
+                    )
+                except EndpointConflict as exc:
+                    return self._reply(409, {"error": str(exc)})
             return self._reply(404, {"error": f"no route {path}"})
         except (json.JSONDecodeError, ValueError) as exc:
             return self._reply(400, {"error": f"bad request: {exc}"})

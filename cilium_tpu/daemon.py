@@ -28,6 +28,11 @@ import threading
 from collections import Counter as _Counter
 from typing import Dict, List, Optional, Tuple
 
+from cilium_tpu import logging as logfields
+from cilium_tpu.logging import get_logger
+
+log = get_logger("daemon")
+
 from cilium_tpu import option
 from cilium_tpu.endpoint import Endpoint, EndpointManager
 from cilium_tpu.endpoint.checkpoint import restore_endpoints, save_endpoint
@@ -48,6 +53,10 @@ from cilium_tpu.proxy import Proxy
 from cilium_tpu.spanstat import SpanStats
 from cilium_tpu.utils.controller import ControllerManager
 from cilium_tpu.utils.trigger import Trigger
+
+
+class EndpointConflict(ValueError):
+    """Endpoint id already in use by a different workload."""
 
 
 def get_cidr_prefixes(rules) -> List[str]:
@@ -71,9 +80,16 @@ class Daemon:
         state_dir: Optional[str] = None,
         num_workers: int = 4,
         dns_resolver=None,
+        ipam_cidr: str = "10.200.0.0/16",
     ) -> None:
         self.node_name = node_name
         self.lock = threading.RLock()
+        # host-scope endpoint IP allocation (pkg/ipam; daemon.go
+        # ipam.Init) — create_endpoint without an explicit address
+        # draws from this pool, the CNI ADD path
+        from cilium_tpu.ipam import IPAM
+
+        self.ipam = IPAM(ipam_cidr)
 
         # policy.NewPolicyRepository (daemon.go:1100)
         self.repo = Repository()
@@ -131,10 +147,39 @@ class Daemon:
             )
             from cilium_tpu.kvstore.ipsync import upsert_ip_mapping
 
+            # schema migration FIRST (the init.sh cilium-map-migrate
+            # moment): old-version checkpoints rewrite in place, then
+            # restore parses only current-version docs
+            from cilium_tpu.endpoint.checkpoint import migrate_state_dir
+
+            migrated = migrate_state_dir(state_dir)
+            if migrated:
+                log.info(
+                    "migrated endpoint checkpoints",
+                    extra={"fields": {"count": migrated}},
+                )
+            import ipaddress as _ipaddress
+
             for endpoint in restore_endpoints(
                 state_dir, self.identity_allocator
             ):
                 self.endpoint_manager.insert(endpoint)
+                # re-reserve the restored IP — a fresh pool would
+                # hand the same address to the next CNI ADD
+                if endpoint.ipv4 and (
+                    _ipaddress.ip_address(endpoint.ipv4)
+                    in self.ipam.cidr
+                ):
+                    try:
+                        self.ipam.allocate(endpoint.ipv4)
+                    except Exception:
+                        log.warning(
+                            "restored endpoint IP already reserved",
+                            extra={"fields": {
+                                logfields.ENDPOINT_ID: endpoint.id,
+                                logfields.IP_ADDR: endpoint.ipv4,
+                            }},
+                        )
                 # republish the endpoint's IP mapping — the reference
                 # restores the ipcache from the pinned BPF map on
                 # restart (daemon restoreOldEndpoints + ipcache
@@ -208,6 +253,13 @@ class Daemon:
             revision = self.repo.add_list(list(rules))
             metrics.policy_count.set(self.repo.num_rules())
             metrics.policy_revision.set(revision)
+            log.info(
+                "policy rules imported",
+                extra={"fields": {
+                    logfields.POLICY_REVISION: revision,
+                    "count": len(rules),
+                }},
+            )
         self.trigger_policy_updates("policy rules added")
         return revision
 
@@ -364,7 +416,15 @@ class Daemon:
         name: str = "",
     ) -> Endpoint:
         """PUT /endpoint/{id} (daemon/endpoint.go:138): allocate the
-        identity from labels, publish the IP, regenerate."""
+        identity from labels, publish the IP, regenerate.
+
+        Idempotent for runtime retries: re-creating an id with the
+        SAME name returns the existing endpoint untouched (CNI ADD is
+        retried by runtimes); the same id under a DIFFERENT name is a
+        conflict — silently replacing would leak the old endpoint's
+        IP and leave its ipcache entry pointing at a dead identity."""
+        import ipaddress as _ipaddress
+
         from cilium_tpu.endpoint.endpoint import (
             STATE_READY,
             STATE_WAITING_FOR_IDENTITY,
@@ -372,20 +432,58 @@ class Daemon:
         from cilium_tpu.ipcache.ipcache import FROM_AGENT_LOCAL, IPIdentity
         from cilium_tpu.kvstore.ipsync import upsert_ip_mapping
 
-        endpoint = Endpoint(endpoint_id, ipv4=ipv4, name=name)
-        endpoint.set_state(STATE_WAITING_FOR_IDENTITY, "creating")
-        ident, _ = self.identity_allocator.allocate(labels)
-        endpoint.set_identity(ident)
-        endpoint.set_state(STATE_READY, "identity resolved")
-        self.endpoint_manager.insert(endpoint)
-        if ipv4:
-            self.ipcache.upsert(
-                ipv4, IPIdentity(ident.id, FROM_AGENT_LOCAL)
-            )
-            if self.kvstore is not None:
-                upsert_ip_mapping(
-                    self.kvstore, ipv4, ident.id, node=self.node_name
+        with self.lock:
+            # check-then-act under the daemon lock: the API server is
+            # thread-per-connection, and two concurrent ADD retries
+            # racing past the existence guard would double-allocate
+            # the IP and leak the losing endpoint's resources
+            existing = self.endpoint_manager.lookup(endpoint_id)
+            if existing is not None:
+                # idempotent ONLY for a matching non-empty name (the
+                # runtime-retry case); unnamed re-creates have no
+                # identity to match on and must surface as conflicts
+                # rather than silently discarding the new labels/IP
+                if name and existing.name == name:
+                    return existing
+                raise EndpointConflict(
+                    f"endpoint id {endpoint_id} in use by "
+                    f"{existing.name!r}"
                 )
+            allocated_ip = None
+            if ipv4 is None:
+                ipv4 = allocated_ip = self.ipam.allocate()
+            elif _ipaddress.ip_address(ipv4) in self.ipam.cidr:
+                # in-pool explicit address: a duplicate must FAIL
+                # (the except-everything that was here swallowed the
+                # conflict and brought two endpoints up on one IP);
+                # out-of-pool addresses are the caller's own numbering
+                self.ipam.allocate(ipv4)
+                allocated_ip = ipv4
+            try:
+                endpoint = Endpoint(endpoint_id, ipv4=ipv4, name=name)
+                endpoint.set_state(
+                    STATE_WAITING_FOR_IDENTITY, "creating"
+                )
+                ident, _ = self.identity_allocator.allocate(labels)
+                endpoint.set_identity(ident)
+                endpoint.set_state(STATE_READY, "identity resolved")
+                self.endpoint_manager.insert(endpoint)
+            except BaseException:
+                # a failed create must hand its address back — the
+                # runtime retries, and each leaked IP would drain the
+                # pool without ever serving an endpoint
+                if allocated_ip is not None:
+                    self.ipam.release(allocated_ip)
+                raise
+            if ipv4:
+                self.ipcache.upsert(
+                    ipv4, IPIdentity(ident.id, FROM_AGENT_LOCAL)
+                )
+                if self.kvstore is not None:
+                    upsert_ip_mapping(
+                        self.kvstore, ipv4, ident.id,
+                        node=self.node_name,
+                    )
         self.trigger_policy_updates(
             f"endpoint {endpoint_id} created", full=True
         )
@@ -429,26 +527,44 @@ class Daemon:
         )
         return True
 
-    def delete_endpoint(self, endpoint_id: int) -> bool:
+    def delete_endpoint(
+        self, endpoint_id: int, expected_name: Optional[str] = None
+    ) -> bool:
+        """`expected_name` guards hash-derived callers (the CNI shim
+        maps container ids onto endpoint ids): a DEL whose id collided
+        with a DIFFERENT workload's endpoint must not tear that
+        endpoint down."""
         from cilium_tpu.endpoint.endpoint import (
             STATE_DISCONNECTED,
             STATE_DISCONNECTING,
         )
         from cilium_tpu.kvstore.ipsync import delete_ip_mapping
 
-        endpoint = self.endpoint_manager.lookup(endpoint_id)
-        if endpoint is None:
-            return False
-        endpoint.set_state(STATE_DISCONNECTING, "delete")
-        if endpoint.ipv4:
-            self.ipcache.delete(endpoint.ipv4)
-            if self.kvstore is not None:
-                delete_ip_mapping(self.kvstore, endpoint.ipv4)
-        if endpoint.security_identity is not None:
-            self.identity_allocator.release(endpoint.security_identity)
-        self.endpoint_manager.remove(endpoint)
-        endpoint.set_state(STATE_DISCONNECTED, "deleted")
-        return True
+        with self.lock:
+            endpoint = self.endpoint_manager.lookup(endpoint_id)
+            if endpoint is None:
+                return False
+            if (
+                expected_name is not None
+                and endpoint.name != expected_name
+            ):
+                raise EndpointConflict(
+                    f"endpoint id {endpoint_id} belongs to "
+                    f"{endpoint.name!r}, not {expected_name!r}"
+                )
+            endpoint.set_state(STATE_DISCONNECTING, "delete")
+            if endpoint.ipv4:
+                self.ipcache.delete(endpoint.ipv4)
+                self.ipam.release(endpoint.ipv4)
+                if self.kvstore is not None:
+                    delete_ip_mapping(self.kvstore, endpoint.ipv4)
+            if endpoint.security_identity is not None:
+                self.identity_allocator.release(
+                    endpoint.security_identity
+                )
+            self.endpoint_manager.remove(endpoint)
+            endpoint.set_state(STATE_DISCONNECTED, "deleted")
+            return True
 
     # -- persistence ---------------------------------------------------------
 

@@ -21,7 +21,7 @@ from cilium_tpu.endpoint.endpoint import (
     Endpoint,
 )
 from cilium_tpu.identity import IdentityAllocator
-from cilium_tpu.labels import Label, Labels
+from cilium_tpu.labels import labels_from_json
 from cilium_tpu.maps.policymap import (
     PolicyKey,
     PolicyMapState,
@@ -29,6 +29,104 @@ from cilium_tpu.maps.policymap import (
 )
 
 STATE_FILE = "ep_state.json"
+
+# Checkpoint schema version — the analog of the reference's pinned-map
+# schema that bpf/cilium-map-migrate.c migrates on upgrade (init.sh
+# runs it before the agent attaches).  History:
+#   0: round-1 shape — no version stamp, no realized_redirects, map
+#      entries without packets/bytes counters;
+#   1: adds the explicit version stamp, realized_redirects, and
+#      per-entry packets/bytes.
+# A checkpoint newer than SCHEMA_VERSION is NOT restored (a downgraded
+# agent must not guess at fields it does not know), mirroring
+# map-migrate refusing unknown map properties.
+SCHEMA_VERSION = 1
+
+# version k → pure doc→doc migration producing version k+1
+_MIGRATIONS = {}
+
+
+def _migration(frm: int):
+    def register(fn):
+        _MIGRATIONS[frm] = fn
+        return fn
+
+    return register
+
+
+@_migration(0)
+def _v0_to_v1(doc: dict) -> dict:
+    """Round-1 checkpoints: stamp the version, default the fields
+    later rounds added (redirects; per-entry counters)."""
+    doc = dict(doc)
+    doc["version"] = 1
+    doc.setdefault("realized_redirects", {})
+    doc["realized_map_state"] = [
+        {**{"packets": 0, "bytes": 0}, **item}
+        for item in doc.get("realized_map_state", [])
+    ]
+    return doc
+
+
+class CheckpointTooNew(ValueError):
+    """Checkpoint written by a NEWER framework version."""
+
+
+def migrate_doc(doc: dict) -> dict:
+    """Apply registered migrations until the doc reaches
+    SCHEMA_VERSION (missing stamp ⇒ version 0)."""
+    version = int(doc.get("version", 0))
+    if version > SCHEMA_VERSION:
+        raise CheckpointTooNew(
+            f"checkpoint version {version} > supported "
+            f"{SCHEMA_VERSION}"
+        )
+    while version < SCHEMA_VERSION:
+        fn = _MIGRATIONS.get(version)
+        if fn is None:
+            raise ValueError(
+                f"no migration registered from version {version}"
+            )
+        doc = fn(doc)
+        version = int(doc["version"])
+    return doc
+
+
+def migrate_state_dir(state_dir: str) -> int:
+    """Rewrite old-version checkpoints in place (the init.sh
+    map-migrate moment: run once at boot, BEFORE restore).  Returns
+    the number migrated; too-new or unparseable files are left
+    untouched for the operator."""
+    migrated = 0
+    if not os.path.isdir(state_dir):
+        return 0
+    for entry in sorted(os.listdir(state_dir)):
+        path = os.path.join(state_dir, entry, STATE_FILE)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (ValueError, json.JSONDecodeError):
+            continue
+        if int(doc.get("version", 0)) == SCHEMA_VERSION:
+            continue
+        try:
+            doc = migrate_doc(doc)
+        except (CheckpointTooNew, ValueError):
+            continue
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), prefix=".tmp_migrate"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+            migrated += 1
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return migrated
 
 
 def _map_state_to_json(state: PolicyMapState) -> list:
@@ -66,6 +164,7 @@ def save_endpoint(endpoint: Endpoint, state_dir: str) -> str:
     ep_dir = os.path.join(state_dir, str(endpoint.id))
     os.makedirs(ep_dir, exist_ok=True)
     doc = {
+        "version": SCHEMA_VERSION,
         "id": endpoint.id,
         "name": endpoint.name,
         "ipv4": endpoint.ipv4,
@@ -112,6 +211,7 @@ def restore_endpoints(
         try:
             with open(path) as f:
                 doc = json.load(f)
+            doc = migrate_doc(doc)
             endpoint = Endpoint(
                 endpoint_id=int(doc["id"]),
                 ipv4=doc.get("ipv4"),
@@ -132,22 +232,16 @@ def restore_endpoints(
                 doc.get("realized_redirects", {})
             )
             if allocator is not None and doc.get("labels"):
-                labels = Labels(
-                    {
-                        item["key"]: Label(
-                            key=item["key"],
-                            value=item.get("value", ""),
-                            source=item.get("source", "unspec"),
-                        )
-                        for item in doc["labels"]
-                    }
+                ident, _ = allocator.allocate(
+                    labels_from_json(doc["labels"])
                 )
-                ident, _ = allocator.allocate(labels)
                 endpoint.set_identity(ident)
             endpoint.set_state(
                 STATE_WAITING_TO_REGENERATE, "restored"
             )
             endpoints.append(endpoint)
         except (ValueError, KeyError, json.JSONDecodeError):
+            # includes CheckpointTooNew (a ValueError): a downgraded
+            # agent must not guess at unknown fields
             continue
     return endpoints

@@ -26,6 +26,10 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from cilium_tpu.logging import get_logger
+
+log = get_logger("kvstore")
+
 from cilium_tpu.kvstore.store import (
     KVEvent,
     Watcher,
@@ -174,6 +178,14 @@ class RemoteBackend:
                 # re-establishment issues normal calls, whose
                 # responses THIS thread must keep reading — run it on
                 # its own thread
+                log.info(
+                    "kvstore connection lost; redialed, "
+                    "re-establishing watches and leases",
+                    extra={"fields": {
+                        "watches": len(self._watches),
+                        "leaseKeys": len(self._lease_keys),
+                    }},
+                )
                 threading.Thread(
                     target=self._reestablish, daemon=True
                 ).start()

@@ -346,3 +346,20 @@ def get_cidr_labels(network: ipaddress._BaseNetwork) -> LabelArray:
     for plen in range(0, network.prefixlen + 1):
         out.append(parse_label(masked_ip_net_to_label_string(network, plen)))
     return out
+
+
+def labels_from_json(items: list) -> "Labels":
+    """Wire/checkpoint label decoding: [{key, value?, source?}] →
+    Labels.  One definition for every JSON surface (REST endpoint
+    create, endpoint checkpoints) — raises ValueError on an item
+    without a key, so transports can classify it as a client fault."""
+    out = {}
+    for item in items:
+        if "key" not in item:
+            raise ValueError(f"label item without key: {item!r}")
+        out[item["key"]] = Label(
+            key=item["key"],
+            value=item.get("value", ""),
+            source=item.get("source", "unspec"),
+        )
+    return Labels(out)

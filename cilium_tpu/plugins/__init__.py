@@ -1,0 +1,1 @@
+"""Orchestrator plugins: the CNI shim (plugins/cilium-cni analog)."""

@@ -1,0 +1,178 @@
+"""CNI plugin shim: CNI ADD/DEL → the agent's REST API.
+
+Behavioral analog of /root/reference/plugins/cilium-cni: the runtime
+invokes the plugin with the CNI contract (CNI_COMMAND/CNI_CONTAINERID
+env + network config JSON on stdin); the reference plugin creates the
+veth pair and PUTs /endpoint to the agent.  This framework has no
+kernel datapath to plumb a veth into, so the shim performs the
+CONTROL-PLANE half — register/deregister the workload as an endpoint
+over the unix-socket REST API (IP from the agent's IPAM) — and
+returns a spec-shaped CNI result; interface plumbing belongs to the
+host networking layer that embeds the framework.
+
+Endpoint numbering: the container id hashes into the endpoint-id
+space deterministically, so ADD and DEL agree without plugin-side
+state (the reference derives the endpoint from the container's
+attachment the same way).
+
+Usage (CNI conformance): `python -m cilium_tpu.plugins.cni` with the
+standard env + stdin; VERSION/ADD/DEL supported, errors returned as
+CNI error JSON on stdout with a non-zero exit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+CNI_VERSIONS = ["0.3.0", "0.3.1", "0.4.0"]
+DEFAULT_SOCKET = "/var/run/cilium_tpu.sock"
+
+# endpoint ids live in u16 space above the reserved low ids
+_EP_ID_BASE = 256
+_EP_ID_SPACE = 65536 - _EP_ID_BASE
+
+
+def endpoint_id_for(container_id: str) -> int:
+    digest = hashlib.sha256(container_id.encode()).digest()
+    return _EP_ID_BASE + int.from_bytes(digest[:4], "big") % _EP_ID_SPACE
+
+
+def _labels_from_args(cni_args: str) -> list:
+    """CNI_ARGS K8S_POD_NAMESPACE/K8S_POD_NAME → k8s labels (the
+    reference resolves pod labels via the apiserver; the shim carries
+    the identifying pair so the k8s watcher can refine later)."""
+    kv: Dict[str, str] = {}
+    for part in (cni_args or "").split(";"):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            kv[k] = v
+    labels = []
+    if kv.get("K8S_POD_NAMESPACE"):
+        labels.append(
+            {
+                "key": "io.kubernetes.pod.namespace",
+                "value": kv["K8S_POD_NAMESPACE"],
+                "source": "k8s",
+            }
+        )
+    if kv.get("K8S_POD_NAME"):
+        labels.append(
+            {
+                "key": "io.kubernetes.pod.name",
+                "value": kv["K8S_POD_NAME"],
+                "source": "k8s",
+            }
+        )
+    if not labels:
+        labels.append(
+            {"key": "unmanaged", "value": "", "source": "container"}
+        )
+    return labels
+
+
+def run(
+    env: Optional[Dict[str, str]] = None,
+    stdin: Optional[str] = None,
+    client=None,
+) -> tuple:
+    """Execute one CNI invocation; returns (exit_code, result_dict).
+    `client` injects an APIClient (tests); default connects to the
+    socket named in the network config ("socket_path") or
+    DEFAULT_SOCKET."""
+    env = dict(os.environ if env is None else env)
+    command = env.get("CNI_COMMAND", "")
+    if command == "VERSION":
+        return 0, {
+            "cniVersion": CNI_VERSIONS[-1],
+            "supportedVersions": CNI_VERSIONS,
+        }
+
+    try:
+        conf = json.loads(stdin or "{}")
+    except json.JSONDecodeError as exc:
+        return 1, _error(2, f"bad network config: {exc}")
+    container_id = env.get("CNI_CONTAINERID", "")
+    if not container_id:
+        return 1, _error(2, "CNI_CONTAINERID missing")
+    if client is None:
+        from cilium_tpu.api.client import APIClient
+
+        client = APIClient(
+            conf.get("socket_path", DEFAULT_SOCKET)
+        )
+    ep_id = endpoint_id_for(container_id)
+
+    if command == "ADD":
+        try:
+            created = client.endpoint_create(
+                ep_id,
+                {
+                    "labels": _labels_from_args(
+                        env.get("CNI_ARGS", "")
+                    ),
+                    "name": container_id[:12],
+                },
+            )
+        except Exception as exc:
+            status = getattr(exc, "status", None)
+            if status == 409:
+                # permanent: the hash-derived id belongs to another
+                # live workload — retrying cannot help
+                return 1, _error(7, f"endpoint id conflict: {exc}")
+            if status is not None:
+                return 1, _error(11, f"agent error {status}: {exc}")
+            return 1, _error(11, f"agent unreachable: {exc}")
+        ipv4 = created.get("ipv4")
+        return 0, {
+            "cniVersion": conf.get("cniVersion", CNI_VERSIONS[-1]),
+            "interfaces": [
+                {"name": env.get("CNI_IFNAME", "eth0")}
+            ],
+            "ips": (
+                [
+                    {
+                        "version": "4",
+                        "address": f"{ipv4}/32",
+                        "interface": 0,
+                    }
+                ]
+                if ipv4
+                else []
+            ),
+        }
+
+    if command == "DEL":
+        # CNI DEL must be idempotent and succeed for unknown
+        # containers (the runtime retries DELs).  The name guard
+        # keeps a hash-collided id from tearing down ANOTHER
+        # workload's endpoint (the agent answers 409, swallowed here
+        # as "not ours").
+        try:
+            client.endpoint_delete(ep_id, name=container_id[:12])
+        except Exception:
+            pass
+        return 0, {}
+
+    return 1, _error(4, f"unsupported CNI_COMMAND {command!r}")
+
+
+def _error(code: int, msg: str) -> dict:
+    return {
+        "cniVersion": CNI_VERSIONS[-1],
+        "code": code,
+        "msg": msg,
+    }
+
+
+def main() -> int:
+    rc, result = run(stdin=sys.stdin.read())
+    print(json.dumps(result))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,6 @@ import threading
 from typing import List, Optional, Tuple
 
 from cilium_tpu.labels import LabelArray
-import logging
 
 from cilium_tpu.policy.api.rule import (
     PROTO_TCP,
@@ -34,7 +33,9 @@ from cilium_tpu.policy.l4 import (
 from cilium_tpu.policy.rule_resolve import L4MergeError, PolicyRule, TraceState
 from cilium_tpu.policy.search import Decision, SearchContext
 
-log = logging.getLogger(__name__)
+from cilium_tpu.logging import get_logger
+
+log = get_logger("policy")
 
 
 class Repository:

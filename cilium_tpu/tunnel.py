@@ -21,12 +21,13 @@ remote nodes' pod CIDRs appear and vanish with node lifecycle.
 from __future__ import annotations
 
 import ipaddress
-import logging
 import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
 import numpy as np
+
+from cilium_tpu.logging import get_logger
 
 
 @dataclass
@@ -158,7 +159,7 @@ class TunnelMap:
             try:
                 stored_ep = self.set_tunnel_endpoint(cidr, ip)
             except ValueError:
-                logging.getLogger("tunnel").warning(
+                get_logger("tunnel").warning(
                     "tunnel map full; node %s (%s) not mapped",
                     name, cidr,
                 )

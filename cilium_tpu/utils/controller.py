@@ -10,6 +10,10 @@ from __future__ import annotations
 
 import threading
 import time
+
+from cilium_tpu.logging import get_logger
+
+log = get_logger("controller")
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
@@ -59,6 +63,15 @@ class Controller:
                 self.status.consecutive_failures += 1
                 self.status.last_error = str(exc)
                 self.status.last_failure = time.time()
+                log.warning(
+                    "controller run failed, retrying with backoff",
+                    extra={"fields": {
+                        "name": self.name,
+                        "consecutiveFailures":
+                            self.status.consecutive_failures,
+                        "error": str(exc),
+                    }},
+                )
                 delay = min(
                     self.error_retry_base
                     * (2 ** (self.status.consecutive_failures - 1)),

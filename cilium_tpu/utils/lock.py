@@ -1,0 +1,245 @@
+"""Lock wrappers with opt-in deadlock detection.
+
+Behavioral analog of /root/reference/pkg/lock: thin wrappers over the
+platform mutexes that the whole agent uses, with a debug build-tag
+variant (pkg/lock/lock_debug.go) that detects deadlocks.  Here the
+debug variant is runtime-switchable (`enable_lock_debug()`), and
+detects the two bug classes the reference's deadlock-detecting
+mutexes catch:
+
+  * **lock-order inversion**: acquiring B while holding A records the
+    edge A→B in a global order graph; a later acquisition that would
+    close a cycle (any path B⤳A already recorded) raises
+    `LockOrderViolation` at acquire time — the deadlock is reported
+    deterministically on the FIRST inverted acquisition, not only on
+    the unlucky interleaving that actually wedges;
+  * **long-held locks**: a lock held longer than `hold_warning_s`
+    logs the holder's acquisition stack (go-deadlock's
+    DeadlockTimeout analog), through the `lock` subsys logger.
+
+`Mutex` and `RWLock` (sync.Mutex / sync.RWMutex) are context
+managers; RWLock exposes `.read()` / `.write()` scopes.  With debug
+off they add one attribute read over the raw primitives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+from cilium_tpu.logging import get_logger
+
+log = get_logger("lock")
+
+_DEBUG = False
+_HOLD_WARNING_S = 10.0
+
+# global lock-order graph: edge a → b means "b acquired while a held"
+_order_lock = threading.Lock()
+_order_edges: Dict[int, Set[int]] = {}
+_names: Dict[int, str] = {}
+
+_tls = threading.local()
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquire would close a cycle in the global lock-order graph."""
+
+
+def enable_lock_debug(hold_warning_s: float = 10.0) -> None:
+    """Turn on detection (the reference's `lockdebug` build tag)."""
+    global _DEBUG, _HOLD_WARNING_S
+    _DEBUG = True
+    _HOLD_WARNING_S = hold_warning_s
+
+
+def disable_lock_debug() -> None:
+    global _DEBUG
+    _DEBUG = False
+    with _order_lock:
+        _order_edges.clear()
+        _names.clear()
+
+
+def _held_stack() -> List[Tuple[int, float]]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _reaches(src: int, dst: int) -> bool:
+    """Path src ⤳ dst in the order graph (held under _order_lock)."""
+    seen = set()
+    frontier = [src]
+    while frontier:
+        node = frontier.pop()
+        if node == dst:
+            return True
+        if node in seen:
+            continue
+        seen.add(node)
+        frontier.extend(_order_edges.get(node, ()))
+    return False
+
+
+def _debug_acquired(lock_id: int, name: str) -> None:
+    held = _held_stack()
+    with _order_lock:
+        _names[lock_id] = name
+        for prior_id, *_ in held:
+            if prior_id == lock_id:
+                continue
+            # would edge prior→lock_id close a cycle?
+            if _reaches(lock_id, prior_id):
+                prior = _names.get(prior_id, hex(prior_id))
+                raise LockOrderViolation(
+                    f"lock-order inversion: acquiring {name!r} while "
+                    f"holding {prior!r}, but {name!r} ⤳ {prior!r} "
+                    "was recorded on another path"
+                )
+            _order_edges.setdefault(prior_id, set()).add(lock_id)
+    held.append(
+        (
+            lock_id,
+            time.monotonic(),
+            # the holder's stack, captured AT ACQUIRE — the long-hold
+            # warning must point at where the lock was taken, not the
+            # release-site frame
+            "".join(traceback.format_stack(limit=8)[:-2]),
+        )
+    )
+
+
+def _debug_released(lock_id: int) -> None:
+    """ALWAYS runs on release (not only when debug is on): a lock
+    acquired while debug was enabled must leave the per-thread held
+    stack even if debug was toggled off in between — a stale entry
+    would fabricate order edges and spurious violations after a
+    re-enable."""
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == lock_id:
+            _, t0, acquire_stack = held.pop(i)
+            dur = time.monotonic() - t0
+            if _DEBUG and dur > _HOLD_WARNING_S:
+                log.warning(
+                    "lock held past the warning threshold",
+                    extra={"fields": {
+                        "lock": _names.get(lock_id, hex(lock_id)),
+                        "heldSeconds": round(dur, 3),
+                        "stack": acquire_stack,
+                    }},
+                )
+            return
+
+
+class Mutex:
+    """sync.Mutex analog (context manager)."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self._lock = threading.Lock()
+        self.name = name or f"mutex@{id(self):x}"
+
+    def acquire(self) -> None:
+        self._lock.acquire()
+        if _DEBUG:
+            try:
+                _debug_acquired(id(self), self.name)
+            except LockOrderViolation:
+                self._lock.release()
+                raise
+
+    def release(self) -> None:
+        _debug_released(id(self))
+        self._lock.release()
+
+    def __enter__(self) -> "Mutex":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class RWLock:
+    """sync.RWMutex analog: many readers or one writer.
+
+    Writer-preferring: a waiting writer blocks NEW readers, so a
+    steady reader stream cannot starve regeneration (the reference
+    relies on Go's sync.RWMutex doing the same)."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name or f"rwlock@{id(self):x}"
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    # -- write side --------------------------------------------------------
+
+    def acquire_write(self) -> None:
+        with self._cond:
+            self._writers_waiting += 1
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writers_waiting -= 1
+            self._writer = True
+        if _DEBUG:
+            try:
+                _debug_acquired(id(self), self.name)
+            except LockOrderViolation:
+                with self._cond:
+                    self._writer = False
+                    self._cond.notify_all()
+                raise
+
+    def release_write(self) -> None:
+        _debug_released(id(self))
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    # -- read side ---------------------------------------------------------
+
+    def acquire_read(self) -> None:
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        if _DEBUG:
+            try:
+                _debug_acquired(id(self), self.name)
+            except LockOrderViolation:
+                with self._cond:
+                    self._readers -= 1
+                    self._cond.notify_all()
+                raise
+
+    def release_read(self) -> None:
+        _debug_released(id(self))
+        with self._cond:
+            self._readers -= 1
+            if not self._readers:
+                self._cond.notify_all()
+
+    class _Scope:
+        def __init__(self, enter, leave) -> None:
+            self._enter, self._leave = enter, leave
+
+        def __enter__(self):
+            self._enter()
+            return self
+
+        def __exit__(self, *exc):
+            self._leave()
+
+    def read(self) -> "_Scope":
+        return self._Scope(self.acquire_read, self.release_read)
+
+    def write(self) -> "_Scope":
+        return self._Scope(self.acquire_write, self.release_write)

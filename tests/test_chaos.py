@@ -185,3 +185,73 @@ def test_kill_mid_replay_restore_verdict_identity(tmp_path):
             np.asarray(getattr(want, field)),
             err_msg=f"post-restore divergence in {field}",
         )
+
+
+def test_checkpoint_schema_migration_v0(tmp_path):
+    """A round-1 (version-0) checkpoint — no version stamp, no
+    realized_redirects, counterless map entries — restores through the
+    migration chain (the cilium-map-migrate moment of init.sh)."""
+    import json
+    import os
+
+    from cilium_tpu.endpoint.checkpoint import (
+        SCHEMA_VERSION,
+        migrate_state_dir,
+        restore_endpoints,
+    )
+
+    state_dir = str(tmp_path / "state_v0")
+    ep_dir = os.path.join(state_dir, "7")
+    os.makedirs(ep_dir)
+    v0 = {
+        "id": 7,
+        "name": "old-ep",
+        "ipv4": "10.0.0.7",
+        "labels": [
+            {"key": "app", "value": "legacy", "source": "k8s"}
+        ],
+        "policy_revision": 3,
+        "realized_map_state": [
+            {"identity": 1234, "dest_port": 80, "nexthdr": 6,
+             "dir": 0, "proxy_port": 0}
+        ],
+    }
+    with open(os.path.join(ep_dir, "ep_state.json"), "w") as f:
+        json.dump(v0, f)
+
+    assert migrate_state_dir(state_dir) == 1
+    with open(os.path.join(ep_dir, "ep_state.json")) as f:
+        doc = json.load(f)
+    assert doc["version"] == SCHEMA_VERSION
+    assert doc["realized_redirects"] == {}
+    assert doc["realized_map_state"][0]["packets"] == 0
+
+    eps = restore_endpoints(state_dir)
+    assert len(eps) == 1 and eps[0].id == 7
+    key = next(iter(eps[0].realized_map_state))
+    assert key.identity == 1234 and key.dest_port == 80
+    # idempotent second run
+    assert migrate_state_dir(state_dir) == 0
+
+
+def test_checkpoint_too_new_skipped(tmp_path):
+    """A checkpoint from a NEWER framework version is left on disk and
+    not restored (a downgraded agent must not guess)."""
+    import json
+    import os
+
+    from cilium_tpu.endpoint.checkpoint import (
+        migrate_state_dir,
+        restore_endpoints,
+    )
+
+    state_dir = str(tmp_path / "state_future")
+    ep_dir = os.path.join(state_dir, "9")
+    os.makedirs(ep_dir)
+    future = {"version": 99, "id": 9, "realized_map_state": []}
+    with open(os.path.join(ep_dir, "ep_state.json"), "w") as f:
+        json.dump(future, f)
+    assert migrate_state_dir(state_dir) == 0
+    assert restore_endpoints(state_dir) == []
+    with open(os.path.join(ep_dir, "ep_state.json")) as f:
+        assert json.load(f)["version"] == 99  # untouched

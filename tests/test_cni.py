@@ -1,0 +1,90 @@
+"""CNI plugin shim: ADD/DEL/VERSION against a live agent REST API
+(plugins/cilium-cni analog — control-plane half: endpoint
+registration + IPAM address in a spec-shaped CNI result)."""
+
+import json
+
+import pytest
+
+from cilium_tpu.api.server import APIServer
+from cilium_tpu.api.client import APIClient
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.plugins.cni import endpoint_id_for, run
+
+
+@pytest.fixture
+def agent(tmp_path):
+    d = Daemon()
+    sock = str(tmp_path / "agent.sock")
+    server = APIServer(d, sock)
+    server.start()
+    yield d, sock
+    server.stop()
+
+
+def _env(command, container="cafe" * 16, args=""):
+    return {
+        "CNI_COMMAND": command,
+        "CNI_CONTAINERID": container,
+        "CNI_IFNAME": "eth0",
+        "CNI_ARGS": args,
+    }
+
+
+def _conf(sock):
+    return json.dumps(
+        {"cniVersion": "0.4.0", "name": "cilium-tpu",
+         "socket_path": sock}
+    )
+
+
+def test_version():
+    rc, out = run(env=_env("VERSION"), stdin="{}")
+    assert rc == 0
+    assert "0.4.0" in out["supportedVersions"]
+
+
+def test_add_registers_endpoint_with_ipam_address(agent):
+    d, sock = agent
+    rc, out = run(
+        env=_env(
+            "ADD",
+            args="K8S_POD_NAMESPACE=prod;K8S_POD_NAME=web-0",
+        ),
+        stdin=_conf(sock),
+    )
+    assert rc == 0, out
+    assert out["ips"] and out["ips"][0]["address"].endswith("/32")
+    ip = out["ips"][0]["address"].split("/")[0]
+
+    ep_id = endpoint_id_for("cafe" * 16)
+    ep = d.endpoint_manager.lookup(ep_id)
+    assert ep is not None and ep.ipv4 == ip
+    labels = ep.security_identity.labels
+    assert labels["io.kubernetes.pod.namespace"].value == "prod"
+    # the IP resolves in the agent's ipcache
+    ident, ok = d.ipcache.lookup_by_ip(ip)
+    assert ok and ident.id == ep.security_identity.id
+
+
+def test_del_is_idempotent(agent):
+    d, sock = agent
+    run(env=_env("ADD"), stdin=_conf(sock))
+    ep_id = endpoint_id_for("cafe" * 16)
+    assert d.endpoint_manager.lookup(ep_id) is not None
+    rc, _ = run(env=_env("DEL"), stdin=_conf(sock))
+    assert rc == 0
+    assert d.endpoint_manager.lookup(ep_id) is None
+    # second DEL (runtime retry) still succeeds
+    rc, _ = run(env=_env("DEL"), stdin=_conf(sock))
+    assert rc == 0
+
+
+def test_bad_command_and_missing_container():
+    rc, out = run(env=_env("WEIRD"), stdin="{}")
+    assert rc == 1 and out["code"] == 4
+    rc, out = run(
+        env={"CNI_COMMAND": "ADD", "CNI_CONTAINERID": ""},
+        stdin="{}",
+    )
+    assert rc == 1 and out["code"] == 2

@@ -404,3 +404,47 @@ def test_daemon_multinode_via_kvstore():
     # d2 sees d1's endpoint IP via the kvstore watcher
     ident, ok = d2.ipcache.lookup_by_ip("10.1.0.1")
     assert ok and ident.id == e1.security_identity.id
+
+
+def test_ipam_restored_ips_re_reserved(tmp_path):
+    """After a restart, the IPAM pool must not re-hand addresses that
+    restored endpoints still own."""
+    state = str(tmp_path / "state")
+    d1 = Daemon(state_dir=state)
+    ep = d1.create_endpoint(40, k8s_labels(app="a"))
+    first_ip = ep.ipv4
+    d1.checkpoint()
+
+    d2 = Daemon(state_dir=state)
+    assert d2.endpoint_manager.lookup(40).ipv4 == first_ip
+    ep2 = d2.create_endpoint(41, k8s_labels(app="b"))
+    assert ep2.ipv4 != first_ip
+
+
+def test_create_endpoint_idempotent_and_conflicting():
+    """Same id + same name = runtime retry (same endpoint back, no IP
+    leak); same id + different name = conflict, not silent replace."""
+    import pytest
+
+    from cilium_tpu.daemon import EndpointConflict
+
+    d = Daemon()
+    a = d.create_endpoint(50, k8s_labels(app="a"), name="pod-a")
+    in_use = d.ipam.in_use()
+    again = d.create_endpoint(50, k8s_labels(app="a"), name="pod-a")
+    assert again is a and d.ipam.in_use() == in_use
+    with pytest.raises(EndpointConflict):
+        d.create_endpoint(50, k8s_labels(app="b"), name="pod-b")
+
+
+def test_explicit_in_pool_duplicate_ip_rejected():
+    import pytest
+
+    from cilium_tpu.ipam import IPAMError
+
+    d = Daemon()
+    d.create_endpoint(60, k8s_labels(app="a"), ipv4="10.200.0.50",
+                      name="x")
+    with pytest.raises(IPAMError):
+        d.create_endpoint(61, k8s_labels(app="b"),
+                          ipv4="10.200.0.50", name="y")

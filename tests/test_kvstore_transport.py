@@ -244,3 +244,68 @@ def test_connection_death_expires_leases(server_proc):
         assert kv_b.get("plain") == b"stays"
     finally:
         kv_b.close()
+
+
+def test_clustermesh_over_socket_transport(server_proc):
+    """ClusterMesh against a REMOTE cluster's store over the wire:
+    the reference connects to remote etcds
+    (pkg/clustermesh/remote_cluster.go); here the remote cluster is a
+    KVStoreServer process and both the publishing 'remote agent' and
+    the local mesh ride RemoteBackend sockets."""
+    from cilium_tpu.ipcache import IPCache
+    from cilium_tpu.kvstore import Allocator, upsert_ip_mapping
+    from cilium_tpu.kvstore.clustermesh import (
+        ClusterMesh,
+        cluster_id_of,
+    )
+    from cilium_tpu.kvstore.paths import IDENTITIES_PATH
+
+    proc, port, _ = server_proc
+    remote_agent = RemoteBackend(port=port)
+    mesh_conn = RemoteBackend(port=port)
+    try:
+        # remote agent publishes an identity + ip mapping into ITS
+        # cluster's store (cluster_id=2 partitioning)
+        alloc = Allocator(
+            remote_agent, IDENTITIES_PATH, node="r1", cluster_id=2
+        )
+        remote_id = alloc.allocate("labels;app=remote;")
+        upsert_ip_mapping(
+            remote_agent, "172.16.0.9", remote_id, node="r1"
+        )
+
+        local_ipcache = IPCache()
+        mesh = ClusterMesh(local_ipcache)
+        seen = []
+        remote = mesh.add_cluster(
+            "cluster-2", mesh_conn,
+            on_identity=lambda *a: seen.append(a),
+        )
+        _wait_for(
+            lambda: remote.remote_identities().get(remote_id)
+            == "labels;app=remote;",
+            what="remote identity fan-in over the wire",
+        )
+        assert cluster_id_of(remote_id) == 2
+        _wait_for(
+            lambda: local_ipcache.lookup_by_ip("172.16.0.9")[0]
+            is not None,
+            what="remote ipcache fan-in over the wire",
+        )
+        ident, ok = local_ipcache.lookup_by_ip("172.16.0.9")
+        assert ok and ident.id == remote_id
+
+        # live update after connect: a second mapping arrives
+        upsert_ip_mapping(
+            remote_agent, "172.16.0.10", remote_id, node="r1"
+        )
+        _wait_for(
+            lambda: local_ipcache.lookup_by_ip("172.16.0.10")[0]
+            is not None,
+            what="live remote upsert over the wire",
+        )
+        mesh.remove_cluster("cluster-2")
+        assert mesh.num_connected() == 0
+    finally:
+        remote_agent.close()
+        mesh_conn.close()

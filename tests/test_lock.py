@@ -1,0 +1,162 @@
+"""Deadlock-detecting locks (pkg/lock lockdebug analog)."""
+
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.utils.lock import (
+    LockOrderViolation,
+    Mutex,
+    RWLock,
+    disable_lock_debug,
+    enable_lock_debug,
+)
+
+
+@pytest.fixture(autouse=True)
+def _debug():
+    enable_lock_debug(hold_warning_s=10.0)
+    yield
+    disable_lock_debug()
+
+
+def test_lock_order_inversion_detected_deterministically():
+    """A→B on one path, then B→A on another thread raises at acquire
+    time — no actual wedge needed (the reference's deadlock-detecting
+    mutex reports the same way)."""
+    a, b = Mutex("a"), Mutex("b")
+    with a:
+        with b:
+            pass
+    err = []
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except LockOrderViolation as e:
+            err.append(e)
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(timeout=5)
+    assert err, "inverted order must raise"
+    assert "a" in str(err[0]) and "b" in str(err[0])
+
+
+def test_same_lock_reacquire_pattern_not_flagged_across_threads():
+    """A consistent global order (a then b everywhere) never trips."""
+    a, b = Mutex("a2"), Mutex("b2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+
+
+def test_rwlock_readers_share_writer_excludes():
+    rw = RWLock("state")
+    state = {"readers": 0, "max_readers": 0}
+    cond = threading.Barrier(2)
+
+    def reader():
+        with rw.read():
+            state["readers"] += 1
+            state["max_readers"] = max(
+                state["max_readers"], state["readers"]
+            )
+            cond.wait(timeout=5)  # both readers inside together
+            state["readers"] -= 1
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert state["max_readers"] == 2
+
+    # writer exclusion: with a writer inside, a reader must wait
+    entered = threading.Event()
+    release = threading.Event()
+
+    def writer():
+        with rw.write():
+            entered.set()
+            release.wait(timeout=5)
+
+    w = threading.Thread(target=writer)
+    w.start()
+    entered.wait(timeout=5)
+    got_read = threading.Event()
+
+    def late_reader():
+        with rw.read():
+            got_read.set()
+
+    r = threading.Thread(target=late_reader)
+    r.start()
+    time.sleep(0.05)
+    assert not got_read.is_set()  # blocked behind the writer
+    release.set()
+    w.join(timeout=5)
+    r.join(timeout=5)
+    assert got_read.is_set()
+
+
+def test_long_hold_logs_warning():
+    import io
+    import logging as pylog
+
+    from cilium_tpu import logging as fl
+
+    stream = io.StringIO()
+    fl.setup(level=pylog.DEBUG, fmt="text", stream=stream)
+    enable_lock_debug(hold_warning_s=0.01)
+    m = Mutex("slowpoke")
+    with m:
+        time.sleep(0.05)
+    out = stream.getvalue()
+    assert "slowpoke" in out and "heldSeconds" in out
+
+
+def test_disabled_mode_is_inert():
+    disable_lock_debug()
+    a, b = Mutex("x"), Mutex("y")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # inverted, but detection is off
+            pass
+
+
+def test_toggle_off_while_held_leaves_no_stale_entries():
+    """Disabling debug between acquire and release must still pop the
+    held stack — a stale entry would fabricate order edges (and
+    violations) after a re-enable."""
+    a, b = Mutex("t1"), Mutex("t2")
+    a.acquire()
+    disable_lock_debug()
+    a.release()
+    enable_lock_debug()
+    # a is NOT held anymore: b-then-a on this thread records b→a
+    with b:
+        with a:
+            pass
+    # and a-then-b elsewhere now trips (proving the graph is live,
+    # built from real holds, not stale ones)
+    err = []
+
+    def inverted():
+        try:
+            with a:
+                with b:
+                    pass
+        except LockOrderViolation as e:
+            err.append(e)
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(timeout=5)
+    assert err

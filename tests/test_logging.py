@@ -1,0 +1,82 @@
+"""Structured subsys logging (pkg/logging analog).
+
+The reference gives every package a logrus logger with a `subsys`
+field and standard structured field names (pkg/logging,
+pkg/logging/logfields); these tests pin the same surface: subsys
+stamping, WithFields nesting, text and JSON sink formats, runtime
+level changes scoped per subsystem, and that the framework root does
+not leak into the host application's root logger.
+"""
+
+import io
+import json
+import logging as pylog
+
+from cilium_tpu import logging as fl
+
+
+def _capture(fmt: str):
+    stream = io.StringIO()
+    fl.setup(level=pylog.DEBUG, fmt=fmt, stream=stream)
+    return stream
+
+
+def test_subsys_field_and_text_format():
+    stream = _capture("text")
+    log = fl.get_logger("policy")
+    log.info("rules imported", extra={"fields": {"count": 3}})
+    line = stream.getvalue().strip()
+    assert 'msg="rules imported"' in line
+    assert "subsys=policy" in line
+    assert "count=3" in line
+
+
+def test_json_format_is_parseable():
+    stream = _capture("json")
+    log = fl.get_logger("endpoint")
+    fl.with_fields(log, **{fl.ENDPOINT_ID: 42}).warning("regen failed")
+    rec = json.loads(stream.getvalue().strip())
+    assert rec["level"] == "warning"
+    assert rec["msg"] == "regen failed"
+    assert rec[fl.SUBSYS] == "endpoint"
+    assert rec[fl.ENDPOINT_ID] == 42
+    assert isinstance(rec["ts"], float)
+
+
+def test_with_fields_nests_without_mutating_parent():
+    stream = _capture("json")
+    base = fl.get_logger("proxy")
+    bound = fl.with_fields(base, port=8080)
+    bound2 = fl.with_fields(bound, **{fl.IDENTITY: 9})
+    bound2.info("redirect")
+    rec = json.loads(stream.getvalue().strip())
+    assert rec["port"] == 8080 and rec[fl.IDENTITY] == 9
+    # parent unaffected
+    stream.truncate(0)
+    stream.seek(0)
+    base.info("plain")
+    rec = json.loads(stream.getvalue().strip())
+    assert "port" not in rec
+
+
+def test_per_subsys_level():
+    stream = _capture("text")
+    fl.set_level(pylog.ERROR, subsys="kvstore")
+    fl.get_logger("kvstore").info("suppressed")
+    fl.get_logger("daemon").info("visible")
+    out = stream.getvalue()
+    assert "suppressed" not in out and "visible" in out
+    fl.set_level(pylog.DEBUG, subsys="kvstore")  # restore
+
+
+def test_setup_idempotent_and_scoped():
+    s1 = _capture("text")
+    s2 = _capture("text")  # replaces the handler, not stacks it
+    fl.get_logger("x").info("once")
+    assert s1.getvalue() == ""
+    assert s2.getvalue().count("once") == 1
+    # the process root logger is untouched
+    assert not any(
+        getattr(h, "_cilium_tpu_handler", False)
+        for h in pylog.getLogger().handlers
+    )
