@@ -109,5 +109,13 @@ class APIClient:
     def ipcache_dump(self):
         return self._request("GET", "/ipcache")
 
+    def ipam_allocate(self, ip=None):
+        return self._request(
+            "POST", "/ipam", body={} if ip is None else {"ip": ip}
+        )
+
+    def ipam_release(self, ip: str):
+        return self._request("DELETE", f"/ipam/{ip}")
+
     def metrics_dump(self):
         return self._request("GET", "/metrics")

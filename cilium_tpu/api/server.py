@@ -24,6 +24,8 @@ Routes (the api/v1 subset this framework's daemon implements):
   GET    /identity           identity cache
   GET    /ipcache            ipcache dump
   GET    /metrics            metrics registry dump
+  POST   /ipam               allocate an address ({ip} to pin one)
+  DELETE /ipam/{ip}          release an address
 """
 
 from __future__ import annotations
@@ -73,6 +75,7 @@ class DaemonAPI:
         return {
             "policy_enforcement": cfg.policy_enforcement,
             "options": dict(getattr(cfg, "opts", {}) or {}),
+            "ipam_cidr": str(self.daemon.ipam.cidr),
         }
 
     def policy_get(self) -> dict:
@@ -134,6 +137,7 @@ class DaemonAPI:
             labels,
             ipv4=body.get("ipv4"),
             name=body.get("name", ""),
+            ip_reserved=bool(body.get("ip_reserved")),
         )
         return {
             "id": endpoint.id,
@@ -160,6 +164,13 @@ class DaemonAPI:
             if entry["id"] == endpoint_id:
                 return entry
         return None
+
+    def ipam_allocate(self, ip: Optional[str] = None) -> dict:
+        got = self.daemon.ipam.allocate(ip)
+        return {"ip": got}
+
+    def ipam_release(self, ip: str) -> dict:
+        return {"released": self.daemon.ipam.release(ip)}
 
     def identity_list(self) -> dict:
         return {
@@ -236,6 +247,23 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(
                     200, api.policy_resolve(json.loads(self._body()))
                 )
+            if path == "/ipam":
+                # parse faults are 400; allocation failures (pool
+                # exhausted, duplicate pin — IPAMError is a
+                # ValueError) are SERVER conditions and must not ride
+                # the blanket bad-request catch below
+                try:
+                    body = json.loads(self._body() or "{}")
+                except json.JSONDecodeError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                try:
+                    return self._reply(
+                        201, api.ipam_allocate(body.get("ip"))
+                    )
+                except Exception as exc:
+                    return self._reply(503, {"error": str(exc)})
             return self._reply(404, {"error": f"no route {path}"})
         except (json.JSONDecodeError, KeyError, ValueError) as exc:
             return self._reply(400, {"error": f"bad request: {exc}"})
@@ -295,6 +323,9 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/policy":
                 labels = json.loads(self._body())
                 return self._reply(200, api.policy_delete(labels))
+            if path.startswith("/ipam/"):
+                ip = path.split("/ipam/", 1)[1]
+                return self._reply(200, api.ipam_release(ip))
             if path.startswith("/endpoint/"):
                 raw = path.rsplit("/", 1)[1]
                 if not raw.isdigit():

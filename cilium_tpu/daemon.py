@@ -413,7 +413,7 @@ class Daemon:
 
     def create_endpoint(
         self, endpoint_id: int, labels, ipv4: Optional[str] = None,
-        name: str = "",
+        name: str = "", ip_reserved: bool = False,
     ) -> Endpoint:
         """PUT /endpoint/{id} (daemon/endpoint.go:138): allocate the
         identity from labels, publish the IP, regenerate.
@@ -452,6 +452,11 @@ class Daemon:
             allocated_ip = None
             if ipv4 is None:
                 ipv4 = allocated_ip = self.ipam.allocate()
+            elif ip_reserved:
+                # the caller already holds this address from the
+                # agent's own IPAM (POST /ipam — the docker IpamDriver
+                # flow); re-reserving would false-conflict
+                pass
             elif _ipaddress.ip_address(ipv4) in self.ipam.cidr:
                 # in-pool explicit address: a duplicate must FAIL
                 # (the except-everything that was here swallowed the
@@ -461,6 +466,12 @@ class Daemon:
                 allocated_ip = ipv4
             try:
                 endpoint = Endpoint(endpoint_id, ipv4=ipv4, name=name)
+                # externally-reserved addresses (POST /ipam → docker
+                # IpamDriver) are NOT returned to the pool on delete;
+                # their ReleaseAddress call does that.  In-memory
+                # only: a restart converts ownership to the agent
+                # (restore re-reserves the address itself).
+                endpoint.ip_externally_owned = ip_reserved
                 endpoint.set_state(
                     STATE_WAITING_FOR_IDENTITY, "creating"
                 )
@@ -479,11 +490,13 @@ class Daemon:
                 self.ipcache.upsert(
                     ipv4, IPIdentity(ident.id, FROM_AGENT_LOCAL)
                 )
-                if self.kvstore is not None:
-                    upsert_ip_mapping(
-                        self.kvstore, ipv4, ident.id,
-                        node=self.node_name,
-                    )
+        # the kvstore publish is network I/O — outside the daemon
+        # lock, or one wedged store round trip stalls every
+        # concurrent endpoint operation
+        if ipv4 and self.kvstore is not None:
+            upsert_ip_mapping(
+                self.kvstore, ipv4, ident.id, node=self.node_name
+            )
         self.trigger_policy_updates(
             f"endpoint {endpoint_id} created", full=True
         )
@@ -555,16 +568,20 @@ class Daemon:
             endpoint.set_state(STATE_DISCONNECTING, "delete")
             if endpoint.ipv4:
                 self.ipcache.delete(endpoint.ipv4)
-                self.ipam.release(endpoint.ipv4)
-                if self.kvstore is not None:
-                    delete_ip_mapping(self.kvstore, endpoint.ipv4)
+                if not getattr(
+                    endpoint, "ip_externally_owned", False
+                ):
+                    self.ipam.release(endpoint.ipv4)
             if endpoint.security_identity is not None:
                 self.identity_allocator.release(
                     endpoint.security_identity
                 )
             self.endpoint_manager.remove(endpoint)
             endpoint.set_state(STATE_DISCONNECTED, "deleted")
-            return True
+        # network I/O outside the lock (see create_endpoint)
+        if endpoint.ipv4 and self.kvstore is not None:
+            delete_ip_mapping(self.kvstore, endpoint.ipv4)
+        return True
 
     # -- persistence ---------------------------------------------------------
 

@@ -1,0 +1,228 @@
+"""Docker libnetwork remote driver shim.
+
+Behavioral analog of /root/reference/plugins/cilium-docker: a unix-
+socket HTTP server speaking the libnetwork remote-driver protocol
+(driver.go:173-181 route set — Plugin.Activate handshake,
+NetworkDriver.* lifecycle — plus the IpamDriver.* surface of ipam.go),
+delegating to a RUNNING agent over its REST API the way the reference
+driver calls the agent through pkg/client.  The veth/route plumbing of
+the reference's Join belongs to the host networking layer; the shim
+answers the protocol with the interface naming contract and keeps the
+CONTROL-PLANE state (endpoint registration, IPAM) authoritative in
+the agent.
+
+libnetwork contract notes:
+  * every call is POST with a JSON body; errors are {"Err": "..."};
+  * CreateEndpoint receives Interface.Address when docker's IPAM (us,
+    via IpamDriver) already assigned one — the driver must then NOT
+    return an address (EndpointInterface conflict, driver.go
+    createEndpoint);
+  * DeleteEndpoint/Leave must be idempotent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, Optional
+
+from cilium_tpu.logging import get_logger
+from cilium_tpu.plugins.cni import endpoint_id_for
+
+log = get_logger("docker-plugin")
+
+CONTAINER_IF_PREFIX = "cilium"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _reply(self, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self) -> None:  # noqa: N802
+        plugin: "DockerPlugin" = self.server.plugin  # type: ignore
+        n = int(self.headers.get("Content-Length") or 0)
+        try:
+            body = json.loads(self.rfile.read(n) or b"{}")
+        except json.JSONDecodeError:
+            body = {}
+        try:
+            handler = plugin.routes.get(self.path)
+            if handler is None:
+                return self._reply(
+                    {"Err": f"unknown method {self.path}"}
+                )
+            return self._reply(handler(body))
+        except Exception as exc:
+            return self._reply({"Err": str(exc)})
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class DockerPlugin:
+    """Serve the libnetwork remote-driver protocol on a unix socket,
+    delegating to the agent REST API (`client` = api.client.APIClient
+    or compatible)."""
+
+    def __init__(self, client, socket_path: str) -> None:
+        self.client = client
+        self.socket_path = socket_path
+        # libnetwork endpoint id → (agent endpoint id, allocated ip)
+        self._endpoints: Dict[str, tuple] = {}
+        self._server: Optional[_UnixHTTPServer] = None
+        self.routes = {
+            "/Plugin.Activate": self._activate,
+            "/NetworkDriver.GetCapabilities": self._capabilities,
+            "/NetworkDriver.CreateNetwork": self._ok,
+            "/NetworkDriver.DeleteNetwork": self._ok,
+            "/NetworkDriver.CreateEndpoint": self._create_endpoint,
+            "/NetworkDriver.DeleteEndpoint": self._delete_endpoint,
+            "/NetworkDriver.EndpointOperInfo": self._oper_info,
+            "/NetworkDriver.Join": self._join,
+            "/NetworkDriver.Leave": self._ok,
+            "/IpamDriver.GetCapabilities": self._ok,
+            "/IpamDriver.GetDefaultAddressSpaces": self._address_spaces,
+            "/IpamDriver.RequestPool": self._request_pool,
+            "/IpamDriver.ReleasePool": self._ok,
+            "/IpamDriver.RequestAddress": self._request_address,
+            "/IpamDriver.ReleaseAddress": self._release_address,
+        }
+
+    # -- handshake ---------------------------------------------------------
+
+    def _activate(self, body: dict) -> dict:
+        return {"Implements": ["NetworkDriver", "IpamDriver"]}
+
+    def _capabilities(self, body: dict) -> dict:
+        return {"Scope": "local"}
+
+    def _ok(self, body: dict) -> dict:
+        return {}
+
+    # -- NetworkDriver -----------------------------------------------------
+
+    def _create_endpoint(self, body: dict) -> dict:
+        eid = body.get("EndpointID", "")
+        if not eid:
+            return {"Err": "EndpointID missing"}
+        iface = body.get("Interface") or {}
+        given = (iface.get("Address") or "").split("/")[0] or None
+        ep_id = endpoint_id_for(eid)
+        created = self.client.endpoint_create(
+            ep_id,
+            {
+                "labels": [
+                    {
+                        "key": "container",
+                        "value": eid[:12],
+                        "source": "container",
+                    }
+                ],
+                "name": eid[:12],
+                # an Interface.Address came from docker, which got it
+                # from OUR IpamDriver — it is already reserved in the
+                # agent pool
+                **(
+                    {"ipv4": given, "ip_reserved": True}
+                    if given
+                    else {}
+                ),
+            },
+        )
+        self._endpoints[eid] = (ep_id, created.get("ipv4"))
+        if given:
+            # docker already assigned the address through our
+            # IpamDriver — returning one again is a protocol error
+            return {"Interface": {}}
+        return {
+            "Interface": {"Address": f"{created.get('ipv4')}/32"}
+        }
+
+    def _delete_endpoint(self, body: dict) -> dict:
+        eid = body.get("EndpointID", "")
+        entry = self._endpoints.pop(eid, None)
+        ep_id = entry[0] if entry else endpoint_id_for(eid)
+        try:
+            self.client.endpoint_delete(ep_id, name=eid[:12])
+        except Exception:
+            pass  # idempotent per the protocol
+        return {}
+
+    def _oper_info(self, body: dict) -> dict:
+        eid = body.get("EndpointID", "")
+        entry = self._endpoints.get(eid)
+        return {
+            "Value": {"ip": entry[1] if entry else None}
+        }
+
+    def _join(self, body: dict) -> dict:
+        eid = body.get("EndpointID", "")
+        return {
+            "InterfaceName": {
+                "SrcName": f"{CONTAINER_IF_PREFIX}{eid[:5]}",
+                "DstPrefix": CONTAINER_IF_PREFIX,
+            },
+            # gateway handling mirrors the reference: traffic routes
+            # through the host; no per-endpoint gateway address
+            "Gateway": "",
+        }
+
+    # -- IpamDriver --------------------------------------------------------
+
+    def _address_spaces(self, body: dict) -> dict:
+        return {
+            "LocalDefaultAddressSpace": "CiliumLocal",
+            "GlobalDefaultAddressSpace": "CiliumGlobal",
+        }
+
+    def _request_pool(self, body: dict) -> dict:
+        # the agent owns the pool; docker gets an opaque pool id and
+        # the agent's CIDR via the config surface
+        cidr = self.client.config_get().get(
+            "ipam_cidr", "10.200.0.0/16"
+        )
+        return {"PoolID": "cilium-tpu-pool", "Pool": cidr}
+
+    def _request_address(self, body: dict) -> dict:
+        preferred = (body.get("Address") or "") or None
+        got = self.client.ipam_allocate(preferred)
+        return {"Address": f"{got['ip']}/32"}
+
+    def _release_address(self, body: dict) -> dict:
+        addr = (body.get("Address") or "").split("/")[0]
+        if addr:
+            self.client.ipam_release(addr)
+        return {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "DockerPlugin":
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = _UnixHTTPServer(self.socket_path, _Handler)
+        self._server.plugin = self  # type: ignore
+        import threading
+
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)

@@ -210,7 +210,6 @@ def _churn_fns():
 
 
 _FETCH_SLICE = {}
-_POOL_CACHE = {}
 
 
 def _fetch_intents(intents_dev, k: int) -> np.ndarray:
@@ -616,16 +615,15 @@ def replay_pool(
 
     stats = ReplayStats()
     tables = jax.device_put(tables)
-    # pool upload caches by object identity across calls (seed +
-    # timed churn reuse one universe); the pool arrays are treated as
-    # immutable once replayed — callers that mutate them must pass a
-    # fresh dict
-    cached = _POOL_CACHE.get(id(pool))
-    if cached is None:
-        cached = jax.device_put(pack_flow_pool(pool))
-        _POOL_CACHE.clear()  # one live pool at a time; no leak
-        _POOL_CACHE[id(pool)] = cached
-    pool_dev = cached
+    # the packed device copy caches ON the pool dict itself (seed +
+    # timed churn reuse one universe; a dict-id-keyed cache would go
+    # stale when CPython recycles a freed dict's id).  The pool
+    # arrays are treated as immutable once replayed — callers that
+    # mutate them must drop "_device_pack" or pass a fresh dict.
+    pool_dev = pool.get("_device_pack")
+    if pool_dev is None:
+        pool_dev = jax.device_put(pack_flow_pool(pool))
+        pool["_device_pack"] = pool_dev
     churn_pool = _churn_fns()[2]
     churn = _ChurnDriver(ct_map)
 

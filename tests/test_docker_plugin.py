@@ -1,0 +1,133 @@
+"""Docker libnetwork remote driver shim (plugins/cilium-docker
+analog): protocol handshake + endpoint/IPAM lifecycle against a live
+agent REST API."""
+
+import json
+import http.client
+import socket
+
+import pytest
+
+from cilium_tpu.api.client import APIClient
+from cilium_tpu.api.server import APIServer
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.plugins.docker import DockerPlugin, endpoint_id_for
+
+
+class _UnixConn(http.client.HTTPConnection):
+    def __init__(self, path):
+        super().__init__("localhost", timeout=10)
+        self._path = path
+
+    def connect(self):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(10)
+        s.connect(self._path)
+        self.sock = s
+
+
+def _call(sock_path, method, body=None):
+    conn = _UnixConn(sock_path)
+    try:
+        payload = json.dumps(body or {})
+        conn.request(
+            "POST", method, body=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        return json.loads(conn.getresponse().read().decode())
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def stack(tmp_path):
+    d = Daemon()
+    agent_sock = str(tmp_path / "agent.sock")
+    plugin_sock = str(tmp_path / "docker.sock")
+    api = APIServer(d, agent_sock).start()
+    plugin = DockerPlugin(APIClient(agent_sock), plugin_sock).start()
+    yield d, plugin_sock
+    plugin.stop()
+    api.stop()
+
+
+def test_handshake_and_capabilities(stack):
+    _, sock = stack
+    out = _call(sock, "/Plugin.Activate")
+    assert "NetworkDriver" in out["Implements"]
+    assert "IpamDriver" in out["Implements"]
+    assert _call(sock, "/NetworkDriver.GetCapabilities")["Scope"] == "local"
+
+
+def test_endpoint_lifecycle_driver_assigned_address(stack):
+    d, sock = stack
+    eid = "aa" * 20
+    out = _call(sock, "/NetworkDriver.CreateEndpoint",
+                {"EndpointID": eid, "Interface": {}})
+    addr = out["Interface"]["Address"]
+    assert addr.endswith("/32")
+    ep = d.endpoint_manager.lookup(endpoint_id_for(eid))
+    assert ep is not None and ep.ipv4 == addr.split("/")[0]
+
+    info = _call(sock, "/NetworkDriver.EndpointOperInfo",
+                 {"EndpointID": eid})
+    assert info["Value"]["ip"] == ep.ipv4
+
+    join = _call(sock, "/NetworkDriver.Join", {"EndpointID": eid})
+    assert join["InterfaceName"]["DstPrefix"] == "cilium"
+
+    _call(sock, "/NetworkDriver.DeleteEndpoint", {"EndpointID": eid})
+    assert d.endpoint_manager.lookup(endpoint_id_for(eid)) is None
+    # idempotent retry
+    out = _call(sock, "/NetworkDriver.DeleteEndpoint",
+                {"EndpointID": eid})
+    assert out == {}
+
+
+def test_ipam_flow_then_endpoint_with_assigned_address(stack):
+    d, sock = stack
+    spaces = _call(sock, "/IpamDriver.GetDefaultAddressSpaces")
+    assert spaces["LocalDefaultAddressSpace"]
+    pool = _call(sock, "/IpamDriver.RequestPool", {})
+    assert pool["Pool"] == str(d.ipam.cidr)
+    got = _call(sock, "/IpamDriver.RequestAddress", {"PoolID": pool["PoolID"]})
+    ip = got["Address"].split("/")[0]
+    assert d.ipam.in_use() >= 1
+
+    # docker hands the assigned address back at CreateEndpoint: the
+    # driver must NOT return an address again
+    eid = "bb" * 20
+    out = _call(sock, "/NetworkDriver.CreateEndpoint",
+                {"EndpointID": eid,
+                 "Interface": {"Address": got["Address"]}})
+    assert out["Interface"] == {}
+    ep = d.endpoint_manager.lookup(endpoint_id_for(eid))
+    assert ep.ipv4 == ip
+
+    _call(sock, "/NetworkDriver.DeleteEndpoint", {"EndpointID": eid})
+    _call(sock, "/IpamDriver.ReleaseAddress", {"Address": got["Address"]})
+
+
+def test_unknown_method_returns_err(stack):
+    _, sock = stack
+    out = _call(sock, "/NetworkDriver.Nope")
+    assert "Err" in out
+
+
+def test_externally_reserved_ip_not_double_released(stack):
+    """An address obtained through the IpamDriver stays reserved after
+    NetworkDriver.DeleteEndpoint; only ReleaseAddress frees it — an
+    agent-side release would let a concurrent RequestAddress hand the
+    SAME ip to another container before docker's release arrives."""
+    d, sock = stack
+    got = _call(sock, "/IpamDriver.RequestAddress", {})
+    ip = got["Address"].split("/")[0]
+    eid = "cc" * 20
+    _call(sock, "/NetworkDriver.CreateEndpoint",
+          {"EndpointID": eid, "Interface": {"Address": got["Address"]}})
+    in_use = d.ipam.in_use()
+    _call(sock, "/NetworkDriver.DeleteEndpoint", {"EndpointID": eid})
+    # still reserved: DeleteEndpoint must not return it to the pool
+    assert d.ipam.in_use() == in_use
+    _call(sock, "/IpamDriver.ReleaseAddress", {"Address": got["Address"]})
+    assert d.ipam.in_use() == in_use - 1
