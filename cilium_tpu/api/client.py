@@ -68,6 +68,9 @@ class APIClient:
     def status(self):
         return self._request("GET", "/status")
 
+    def config_patch(self, changes: dict):
+        return self._request("PATCH", "/config", body=changes)
+
     def config_get(self):
         return self._request("GET", "/config")
 

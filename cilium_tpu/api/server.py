@@ -13,6 +13,7 @@ Routes (the api/v1 subset this framework's daemon implements):
   GET    /healthz            agent liveness + datapath health probe
   GET    /status             full agent status (daemon.status())
   GET    /config             daemon option set
+  PATCH  /config             mutate runtime options / enforcement mode
   GET    /policy             policy repository (revision, rules)
   POST   /policy             add rules (JSON list; ?replace=1)
   DELETE /policy             delete by labels (JSON list of labels)
@@ -67,6 +68,9 @@ class DaemonAPI:
 
     def status(self) -> dict:
         return self.daemon.status()
+
+    def config_patch(self, changes: dict) -> dict:
+        return self.daemon.config_patch(changes)
 
     def config_get(self) -> dict:
         from cilium_tpu import option
@@ -313,6 +317,33 @@ class _Handler(BaseHTTPRequestHandler):
             return self._reply(404, {"error": f"no route {path}"})
         except EndpointConflict as exc:
             return self._reply(409, {"error": str(exc)})
+        except Exception as exc:
+            return self._reply(500, {"error": str(exc)})
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        api: DaemonAPI = self.server.api  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/config":
+                try:
+                    body = json.loads(self._body() or "{}")
+                    if not isinstance(body, dict) or not isinstance(
+                        body.get("options", {}), dict
+                    ):
+                        raise ValueError("body must be an object")
+                except (json.JSONDecodeError, ValueError) as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                try:
+                    return self._reply(200, api.config_patch(body))
+                except ValueError as exc:
+                    # unknown option / enforcement mode is the
+                    # client's fault
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+            return self._reply(404, {"error": f"no route {path}"})
         except Exception as exc:
             return self._reply(500, {"error": str(exc)})
 

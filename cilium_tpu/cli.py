@@ -105,6 +105,47 @@ def cmd_ipcache_dump(api, args) -> int:
     return 0
 
 
+def cmd_config_get(api, args) -> int:
+    print(json.dumps(api.config_get(), indent=2))
+    return 0
+
+
+_TRUE = ("1", "true", "on", "enabled")
+_FALSE = ("0", "false", "off", "disabled")
+
+
+def cmd_config_set(api, args) -> int:
+    changes = {}
+    opts = {}
+    for kv in args.set:
+        key, sep, value = kv.partition("=")
+        if not sep:
+            print(
+                f"error: {kv!r} is not Key=value", file=sys.stderr
+            )
+            return 1
+        if key == "policy-enforcement":
+            changes["policy_enforcement"] = value
+            continue
+        low = value.lower()
+        if low in _TRUE:
+            opts[key] = True
+        elif low in _FALSE:
+            opts[key] = False
+        else:
+            # a typo ('ture') must not silently DISABLE the option
+            print(
+                f"error: {key}={value!r} is not a boolean "
+                f"({'/'.join(_TRUE)} or {'/'.join(_FALSE)})",
+                file=sys.stderr,
+            )
+            return 1
+    if opts:
+        changes["options"] = opts
+    print(json.dumps(api.config_patch(changes), indent=2))
+    return 0
+
+
 def cmd_status(api, args) -> int:
     print(json.dumps(api.status(), indent=2))
     return 0
@@ -156,6 +197,17 @@ def make_parser() -> argparse.ArgumentParser:
     ipsub = ipc.add_subparsers(dest="subcmd", required=True)
     dump = ipsub.add_parser("dump")
     dump.set_defaults(func=cmd_ipcache_dump)
+
+    config = sub.add_parser("config")
+    csub = config.add_subparsers(dest="config_cmd", required=True)
+    cget = csub.add_parser("get")
+    cget.set_defaults(func=cmd_config_get)
+    cset = csub.add_parser("set")
+    cset.add_argument(
+        "set", nargs="+",
+        help="Option=true|false pairs (or policy-enforcement=MODE)",
+    )
+    cset.set_defaults(func=cmd_config_set)
 
     status = sub.add_parser("status")
     status.set_defaults(func=cmd_status)

@@ -596,6 +596,58 @@ class Daemon:
 
     # -- status (daemon/status.go) ------------------------------------------
 
+    def config_patch(self, changes: Dict) -> Dict:
+        """PATCH /config (daemon config handler + pkg/option runtime
+        options): apply named boolean option changes and the mutable
+        enforcement mode; verdict-affecting changes trigger a full
+        regeneration, exactly as the reference recompiles on config
+        change (config IS part of the compiled program — the options
+        feed the compiler cache key)."""
+        applied = 0
+        verdict_affecting = False
+        with self.lock:
+            # validate EVERYTHING before mutating anything: a partial
+            # apply followed by a 400 would silently diverge daemon
+            # state from what the client believes
+            raw_opts = changes.get("options") or {}
+            for k, v in raw_opts.items():
+                if k not in option.KNOWN_OPTIONS:
+                    raise ValueError(f"unknown option {k}")
+                if not isinstance(v, bool):
+                    # bool("false") is True — stringified booleans
+                    # must be rejected, not inverted
+                    raise ValueError(
+                        f"option {k} requires a JSON boolean, "
+                        f"got {v!r}"
+                    )
+            enforcement = changes.get("policy_enforcement")
+            if enforcement is not None and enforcement not in (
+                option.DEFAULT_ENFORCEMENT,
+                option.ALWAYS_ENFORCE,
+                option.NEVER_ENFORCE,
+            ):
+                raise ValueError(
+                    f"unknown enforcement mode {enforcement!r}"
+                )
+            if raw_opts:
+                applied += option.Config.opts.apply(dict(raw_opts))
+            if enforcement is not None:
+                if option.Config.policy_enforcement != enforcement:
+                    option.Config.policy_enforcement = enforcement
+                    applied += 1
+                    verdict_affecting = True
+        if applied:
+            # enforcement changes alter verdicts → full sweep; pure
+            # observability toggles (tracing, notifications) do not
+            self.trigger_policy_updates(
+                "configuration changed", full=verdict_affecting
+            )
+        return {
+            "applied": applied,
+            "policy_enforcement": option.Config.policy_enforcement,
+            "options": dict(option.Config.opts),
+        }
+
     def status(self) -> Dict:
         version, tables, index = self.endpoint_manager.published()
         return {

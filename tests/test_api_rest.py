@@ -129,3 +129,85 @@ def test_client_surface(agent):
     assert got["verdict"] == "allowed"
     with pytest.raises(RuntimeError):
         client._request("GET", "/endpoint/999")
+
+
+def test_config_patch_runtime_options(tmp_path):
+    """PATCH /config mutates runtime options and enforcement mode
+    (pkg/option runtime-mutable options + daemon config handler);
+    enforcement changes alter verdicts, so they trigger regeneration."""
+    from cilium_tpu import option
+    from cilium_tpu.api.client import APIClient
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    sock = str(tmp_path / "cfg.sock")
+    server = APIServer(d, sock).start()
+    client = APIClient(sock)
+    before = option.Config.policy_enforcement
+    try:
+        out = client.config_patch(
+            {"options": {"PolicyTracing": True}}
+        )
+        assert out["applied"] == 1
+        assert out["options"]["PolicyTracing"] is True
+        assert client.config_get()["options"]["PolicyTracing"] is True
+
+        out = client.config_patch({"policy_enforcement": "never"})
+        assert out["policy_enforcement"] == "never"
+
+        # unknown option / bad mode are client faults (400)
+        from cilium_tpu.api.client import APIError
+
+        try:
+            client.config_patch({"options": {"NotAThing": True}})
+            assert False, "unknown option must 400"
+        except APIError as exc:
+            assert exc.status == 400
+        try:
+            client.config_patch({"policy_enforcement": "sometimes"})
+            assert False, "bad mode must 400"
+        except APIError as exc:
+            assert exc.status == 400
+    finally:
+        server.stop()
+        option.Config.policy_enforcement = before
+        option.Config.opts.pop("PolicyTracing", None)
+
+
+def test_config_patch_is_atomic(tmp_path):
+    """A request mixing a valid option with an invalid one (or a bad
+    enforcement mode) must apply NOTHING — partial application with a
+    400 reply would silently diverge daemon state."""
+    from cilium_tpu import option
+    from cilium_tpu.api.client import APIClient, APIError
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    sock = str(tmp_path / "cfg2.sock")
+    server = APIServer(d, sock).start()
+    client = APIClient(sock)
+    try:
+        for bad in (
+            {"options": {"PolicyTracing": True, "NotAThing": True}},
+            {"options": {"PolicyTracing": True},
+             "policy_enforcement": "bogus"},
+            {"options": {"PolicyTracing": "false"}},  # stringified
+        ):
+            try:
+                client.config_patch(bad)
+                assert False, f"{bad} must 400"
+            except APIError as exc:
+                assert exc.status == 400
+            assert not option.Config.opts.is_enabled("PolicyTracing")
+        # malformed shapes are 400s too, not 500s
+        for shape in ([1], {"options": "x"}):
+            try:
+                client.config_patch(shape)
+                assert False
+            except APIError as exc:
+                assert exc.status == 400
+    finally:
+        server.stop()
+        option.Config.opts.pop("PolicyTracing", None)
