@@ -38,8 +38,9 @@ class APIClient:
     def __init__(self, socket_path: str) -> None:
         self.socket_path = socket_path
 
-    def _request(self, method: str, path: str, body=None):
-        conn = _UnixHTTPConnection(self.socket_path)
+    def _request(self, method: str, path: str, body=None,
+                 timeout: float = 30.0):
+        conn = _UnixHTTPConnection(self.socket_path, timeout=timeout)
         try:
             payload = None
             headers = {}
@@ -70,6 +71,11 @@ class APIClient:
 
     def config_patch(self, changes: dict):
         return self._request("PATCH", "/config", body=changes)
+
+    def endpoint_config_patch(self, endpoint_id: int, changes: dict):
+        return self._request(
+            "PATCH", f"/endpoint/{endpoint_id}/config", body=changes
+        )
 
     def config_get(self):
         return self._request("GET", "/config")
@@ -119,6 +125,23 @@ class APIClient:
 
     def ipam_release(self, ip: str):
         return self._request("DELETE", f"/ipam/{ip}")
+
+    def monitor_open(self):
+        return self._request("POST", "/monitor")
+
+    def monitor_poll(self, sid: str, timeout: float = 5.0,
+                     max_events: int = 1024):
+        # the HTTP socket budget must outlive the server's long-poll
+        # window (clamped to 30 s server-side) or a reply carrying
+        # already-dequeued events times out client-side and loses them
+        return self._request(
+            "GET",
+            f"/monitor/{sid}?timeout={timeout}&max={max_events}",
+            timeout=min(timeout, 30.0) + 15.0,
+        )
+
+    def monitor_close(self, sid: str):
+        return self._request("DELETE", f"/monitor/{sid}")
 
     def metrics_dump(self):
         return self._request("GET", "/metrics")

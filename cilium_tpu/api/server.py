@@ -14,6 +14,7 @@ Routes (the api/v1 subset this framework's daemon implements):
   GET    /status             full agent status (daemon.status())
   GET    /config             daemon option set
   PATCH  /config             mutate runtime options / enforcement mode
+  PATCH  /endpoint/{id}/config  per-endpoint options (regen that endpoint)
   GET    /policy             policy repository (revision, rules)
   POST   /policy             add rules (JSON list; ?replace=1)
   DELETE /policy             delete by labels (JSON list of labels)
@@ -27,6 +28,9 @@ Routes (the api/v1 subset this framework's daemon implements):
   GET    /metrics            metrics registry dump
   POST   /ipam               allocate an address ({ip} to pin one)
   DELETE /ipam/{ip}          release an address
+  POST   /monitor            open a monitor session (persistent queue)
+  GET    /monitor/{sid}      long-poll events (?timeout=s&max=n)
+  DELETE /monitor/{sid}      close the session
 """
 
 from __future__ import annotations
@@ -50,6 +54,12 @@ class DaemonAPI:
     contract (pkg/client's methods mirror this)."""
 
     def __init__(self, daemon) -> None:
+        import threading as _threading
+
+        self._monitor_sessions = {}
+        # the API server is thread-per-connection: open/poll/close/
+        # expire race without this
+        self._monitor_lock = _threading.Lock()
         self.daemon = daemon
 
     def healthz(self) -> dict:
@@ -71,6 +81,13 @@ class DaemonAPI:
 
     def config_patch(self, changes: dict) -> dict:
         return self.daemon.config_patch(changes)
+
+    def endpoint_config_patch(
+        self, endpoint_id: int, changes: dict
+    ) -> dict:
+        return self.daemon.endpoint_config_patch(
+            endpoint_id, changes
+        )
 
     def config_get(self) -> dict:
         from cilium_tpu import option
@@ -185,6 +202,92 @@ class DaemonAPI:
     def ipcache_dump(self) -> dict:
         return dict(self.daemon.lpm_builder.mappings)
 
+    # -- monitor sessions (the `cilium monitor` stream, re-shaped for
+    # HTTP: the reference's monitor unix socket pushes; REST clients
+    # long-poll a per-session persistent queue so no events are lost
+    # between polls; idle sessions expire) ------------------------------
+
+    MONITOR_SESSION_IDLE_S = 60.0
+
+    def monitor_open(self) -> dict:
+        import time as _time
+        import uuid
+
+        # expire on OPEN too: sessions abandoned before their first
+        # poll must not leak bus subscribers forever
+        self._expire_monitor_sessions()
+        sid = uuid.uuid4().hex[:12]
+        q = self.daemon.monitor.subscribe_queue()
+        with self._monitor_lock:
+            self._monitor_sessions[sid] = (q, [_time.monotonic()])
+        return {"session": sid}
+
+    def monitor_poll(
+        self, sid: str, timeout: float = 5.0, max_events: int = 1024
+    ) -> Optional[dict]:
+        import dataclasses
+        import time as _time
+
+        self._expire_monitor_sessions()
+        with self._monitor_lock:
+            entry = self._monitor_sessions.get(sid)
+            if entry is None:
+                return None
+            q, last = entry
+            last[0] = _time.monotonic()
+        deadline = _time.monotonic() + min(timeout, 30.0)
+        max_events = max(1, max_events)
+        events = []
+        while not events:
+            # blocking wakeup from MonitorBus.publish — no spin
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                break
+            if not self.daemon.monitor.wait_for_events(
+                q, remaining
+            ):
+                break
+            with self._monitor_lock:
+                # concurrent polls on one sid: drain under the lock
+                # so both cannot popleft the same event
+                while q and len(events) < max_events:
+                    ev = q.popleft()
+                    events.append(
+                        {
+                            "event": type(ev).__name__,
+                            **dataclasses.asdict(ev),
+                        }
+                    )
+        return {
+            "events": events,
+            # THIS session's overflow drops, not the bus-global count
+            # (one abandoned subscriber must not inflate everyone's
+            # loss report)
+            "lost": self.daemon.monitor.queue_drops(q),
+        }
+
+    def monitor_close(self, sid: str) -> dict:
+        with self._monitor_lock:
+            entry = self._monitor_sessions.pop(sid, None)
+        if entry is not None:
+            self.daemon.monitor.unsubscribe_queue(entry[0])
+        return {"closed": entry is not None}
+
+    def _expire_monitor_sessions(self) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        with self._monitor_lock:
+            expired = [
+                (sid, entry[0])
+                for sid, entry in self._monitor_sessions.items()
+                if now - entry[1][0] > self.MONITOR_SESSION_IDLE_S
+            ]
+            for sid, _ in expired:
+                self._monitor_sessions.pop(sid, None)
+        for _, q in expired:
+            self.daemon.monitor.unsubscribe_queue(q)
+
     def metrics_dump(self) -> dict:
         return {"text": metrics.expose()}
 
@@ -234,6 +337,26 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(200, api.ipcache_dump())
             if path == "/metrics":
                 return self._reply(200, api.metrics_dump())
+            if path.startswith("/monitor/"):
+                from urllib.parse import parse_qs
+
+                qs = parse_qs(self.path.partition("?")[2])
+                sid = path.split("/monitor/", 1)[1]
+                try:
+                    timeout = float(qs.get("timeout", ["5"])[0])
+                    max_events = int(qs.get("max", ["1024"])[0])
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                got = api.monitor_poll(
+                    sid, timeout=timeout, max_events=max_events
+                )
+                if got is None:
+                    return self._reply(
+                        404, {"error": "unknown monitor session"}
+                    )
+                return self._reply(200, got)
             return self._reply(404, {"error": f"no route {path}"})
         except Exception as exc:
             return self._reply(500, {"error": str(exc)})
@@ -251,6 +374,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(
                     200, api.policy_resolve(json.loads(self._body()))
                 )
+            if path == "/monitor":
+                return self._reply(201, api.monitor_open())
             if path == "/ipam":
                 # parse faults are 400; allocation failures (pool
                 # exhausted, duplicate pin — IPAMError is a
@@ -320,26 +445,55 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:
             return self._reply(500, {"error": str(exc)})
 
+    def _patch_body(self):
+        """Shared config-patch body parsing: JSON object with an
+        optional `options` object.  Returns (body, None) or
+        (None, error_reply_sent)."""
+        try:
+            body = json.loads(self._body() or "{}")
+            if not isinstance(body, dict) or not isinstance(
+                body.get("options", {}), dict
+            ):
+                raise ValueError("body must be an object")
+            return body, False
+        except (json.JSONDecodeError, ValueError) as exc:
+            self._reply(400, {"error": f"bad request: {exc}"})
+            return None, True
+
     def do_PATCH(self) -> None:  # noqa: N802
         api: DaemonAPI = self.server.api  # type: ignore
         path = self.path.split("?", 1)[0]
         try:
             if path == "/config":
-                try:
-                    body = json.loads(self._body() or "{}")
-                    if not isinstance(body, dict) or not isinstance(
-                        body.get("options", {}), dict
-                    ):
-                        raise ValueError("body must be an object")
-                except (json.JSONDecodeError, ValueError) as exc:
-                    return self._reply(
-                        400, {"error": f"bad request: {exc}"}
-                    )
+                body, sent = self._patch_body()
+                if sent:
+                    return
                 try:
                     return self._reply(200, api.config_patch(body))
                 except ValueError as exc:
                     # unknown option / enforcement mode is the
                     # client's fault
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+            if (
+                path.startswith("/endpoint/")
+                and path.endswith("/config")
+            ):
+                raw = path.split("/")[2]
+                if not raw.isdigit():
+                    return self._reply(404, {"error": "not found"})
+                body, sent = self._patch_body()
+                if sent:
+                    return
+                try:
+                    return self._reply(
+                        200,
+                        api.endpoint_config_patch(int(raw), body),
+                    )
+                except KeyError as exc:
+                    return self._reply(404, {"error": str(exc)})
+                except ValueError as exc:
                     return self._reply(
                         400, {"error": f"bad request: {exc}"}
                     )
@@ -354,6 +508,9 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/policy":
                 labels = json.loads(self._body())
                 return self._reply(200, api.policy_delete(labels))
+            if path.startswith("/monitor/"):
+                sid = path.split("/monitor/", 1)[1]
+                return self._reply(200, api.monitor_close(sid))
             if path.startswith("/ipam/"):
                 ip = path.split("/ipam/", 1)[1]
                 return self._reply(200, api.ipam_release(ip))

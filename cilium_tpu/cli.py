@@ -146,6 +146,36 @@ def cmd_config_set(api, args) -> int:
     return 0
 
 
+def cmd_monitor(api, args) -> int:
+    """`cilium monitor` follow mode over the REST stream."""
+    sid = api.monitor_open()["session"]
+    printed = 0
+    try:
+        while args.count == 0 or printed < args.count:
+            # cap the poll at the remaining budget: events the server
+            # dequeues for this reply but the CLI would not print
+            # could never be retrieved again
+            remaining = (
+                args.count - printed if args.count else 1024
+            )
+            got = api.monitor_poll(
+                sid, timeout=args.timeout, max_events=remaining
+            )
+            for ev in got["events"]:
+                print(json.dumps(ev))
+                printed += 1
+            if args.once and not got["events"]:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        try:
+            api.monitor_close(sid)
+        except Exception:
+            pass
+    return 0
+
+
 def cmd_status(api, args) -> int:
     print(json.dumps(api.status(), indent=2))
     return 0
@@ -197,6 +227,14 @@ def make_parser() -> argparse.ArgumentParser:
     ipsub = ipc.add_subparsers(dest="subcmd", required=True)
     dump = ipsub.add_parser("dump")
     dump.set_defaults(func=cmd_ipcache_dump)
+
+    mon = sub.add_parser("monitor")
+    mon.add_argument("--count", type=int, default=0,
+                     help="stop after N events (0 = follow)")
+    mon.add_argument("--timeout", type=float, default=5.0)
+    mon.add_argument("--once", action="store_true",
+                     help="exit after one empty poll")
+    mon.set_defaults(func=cmd_monitor)
 
     config = sub.add_parser("config")
     csub = config.add_subparsers(dest="config_cmd", required=True)

@@ -648,6 +648,120 @@ class Daemon:
             "options": dict(option.Config.opts),
         }
 
+    def endpoint_config_patch(
+        self, endpoint_id: int, changes: Dict
+    ) -> Dict:
+        """`cilium endpoint config` (pkg/endpoint applyOptsLocked):
+        apply per-endpoint option changes and queue THAT endpoint's
+        regeneration — per-endpoint config is compiled state in the
+        reference (it lands in the generated header)."""
+        opts = changes.get("options") or {}
+        for k, v in opts.items():
+            if k not in option.KNOWN_OPTIONS:
+                raise ValueError(f"unknown option {k}")
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"option {k} requires a JSON boolean, got {v!r}"
+                )
+        with self.lock:
+            endpoint = self.endpoint_manager.lookup(endpoint_id)
+            if endpoint is None:
+                raise KeyError(f"no endpoint {endpoint_id}")
+            with endpoint.lock:
+                applied = endpoint.opts.apply(dict(opts))
+            if applied:
+                # force THIS endpoint's recompute through the delta
+                # sweep (the revision gate would skip it otherwise) —
+                # a per-endpoint toggle must not recompile the fleet
+                endpoint.force_policy_compute = True
+        if applied:
+            self.trigger_policy_updates(
+                f"endpoint {endpoint_id} config changed"
+            )
+        return {
+            "applied": applied,
+            "options": dict(endpoint.opts),
+        }
+
+    def verdict_notification_endpoints(self) -> set:
+        """Endpoint ids with per-endpoint PolicyVerdictNotification on
+        (plus all when the global option is set): the monitor fold's
+        allowed-verdict scope."""
+        from cilium_tpu.option import POLICY_VERDICT_NOTIFICATION
+
+        eps = self.endpoint_manager.endpoints()
+        if option.Config.opts.is_enabled(POLICY_VERDICT_NOTIFICATION):
+            return {ep.id for ep in eps}
+        return {
+            ep.id
+            for ep in eps
+            if ep.opts.is_enabled(POLICY_VERDICT_NOTIFICATION)
+        }
+
+    def process_flows(
+        self, buf: bytes, batch_size: int = 1 << 20
+    ) -> "object":
+        """Datapath execution under the agent with monitor folding —
+        the production path behind `cilium monitor`: replay the
+        record stream through the PUBLISHED lattice tables and fold
+        every batch's verdicts into the monitor bus (drops always;
+        allowed-verdict events for endpoints with
+        PolicyVerdictNotification on, per-endpoint or global).
+
+        This is the Hubble-style audit form (identity pre-resolved in
+        the record); it reads verdict bits back per batch, which is
+        the monitoring cost the reference pays through its perf ring.
+        Returns ReplayStats."""
+        import numpy as np
+
+        from cilium_tpu.engine.verdict import evaluate_batch
+        from cilium_tpu.monitor import verdicts_to_events
+        from cilium_tpu.replay import ReplayStats, read_batches
+
+        version, tables, index = self.endpoint_manager.published()
+        if tables is None:
+            raise RuntimeError("no published tables")
+        rev_index = {v: k for k, v in index.items()}
+        ep_map = dict(index)
+        verdict_eps = self.verdict_notification_endpoints()
+        stats = ReplayStats()
+        import time as _time
+
+        t0 = _time.perf_counter()
+        for batch, valid in read_batches(buf, batch_size, ep_map):
+            out = evaluate_batch(tables, batch)
+            allowed = np.asarray(out.allowed)[:valid]
+            proxy = np.asarray(out.proxy_port)[:valid]
+            stats.total += int(valid)
+            stats.allowed += int(allowed.sum())
+            stats.denied += int(valid - allowed.sum())
+            stats.redirected += int((proxy > 0).sum())
+            stats.batches += 1
+            ep_idx = np.asarray(batch.ep_index)[:valid]
+            ep_ids = np.asarray(
+                [rev_index.get(int(e), int(e)) for e in ep_idx]
+            )
+
+            class _V:  # the verdict fields the fold consumes
+                pass
+
+            v = _V()
+            v.allowed = allowed
+            v.match_kind = np.asarray(out.match_kind)[:valid]
+            v.proxy_port = proxy
+            verdicts_to_events(
+                self.monitor,
+                v,
+                ep_ids=ep_ids,
+                identities=np.asarray(batch.identity)[:valid],
+                dports=np.asarray(batch.dport)[:valid],
+                protos=np.asarray(batch.proto)[:valid],
+                directions=np.asarray(batch.direction)[:valid],
+                verdict_eps=verdict_eps,
+            )
+        stats.seconds = _time.perf_counter() - t0
+        return stats
+
     def status(self) -> Dict:
         version, tables, index = self.endpoint_manager.published()
         return {

@@ -131,6 +131,14 @@ class Endpoint:
         self.instance_nonce = next(_ENDPOINT_NONCE)
         self.realized_redirects: Dict[str, int] = {}
 
+        # per-endpoint runtime options (pkg/endpoint applyOptsLocked;
+        # `cilium endpoint config`): overlay on the global option set.
+        # Consulted by the monitor fold (per-endpoint
+        # PolicyVerdictNotification) and any per-endpoint toggles.
+        from cilium_tpu.option import OptionMap
+
+        self.opts = OptionMap()
+
         self.lock = threading.RLock()
         self.build_lock = threading.Lock()
 

@@ -33,10 +33,12 @@ DROP_FRAG_CODE = 157  # magnitude of DROP_FRAG_NOSUPPORT (common.h:264)
 class MonitorBus:
     def __init__(self, queue_size: int = 65536) -> None:
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._subscribers: List[Deque] = []
         self._callbacks: List[Callable] = []
         self.queue_size = queue_size
-        self.lost_events = 0
+        self.lost_events = 0  # bus-global (all subscribers)
+        self._drops: dict = {}  # id(queue) → that subscriber's drops
 
     def subscribe_queue(self) -> Deque:
         """Bounded queue subscriber; overflow counts lost events."""
@@ -49,13 +51,47 @@ class MonitorBus:
         with self._lock:
             self._callbacks.append(fn)
 
+    def unsubscribe_queue(self, q: Deque) -> bool:
+        """Detach a queue subscriber (monitor listener hang-up,
+        monitor.go listener cleanup)."""
+        with self._lock:
+            self._drops.pop(id(q), None)
+            try:
+                self._subscribers.remove(q)
+                return True
+            except ValueError:
+                return False
+
+    def wait_for_events(self, q: Deque, timeout: float) -> bool:
+        """Block until `q` has events or the timeout lapses — the
+        long-poll wakeup (no 50 ms spin; publish() notifies)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            while not q:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            return True
+
+    def queue_drops(self, q: Deque) -> int:
+        """Overflow drops charged to ONE subscriber's queue."""
+        with self._lock:
+            return self._drops.get(id(q), 0)
+
     def publish(self, event) -> None:
         with self._lock:
             for q in self._subscribers:
                 if len(q) == q.maxlen:
                     self.lost_events += 1
+                    self._drops[id(q)] = (
+                        self._drops.get(id(q), 0) + 1
+                    )
                 q.append(event)
             callbacks = list(self._callbacks)
+            self._cond.notify_all()
         for fn in callbacks:
             fn(event)
 
@@ -69,19 +105,26 @@ def verdicts_to_events(
     protos: np.ndarray,
     directions: np.ndarray,
     emit_allowed: bool = False,
+    verdict_eps: "Optional[set]" = None,
 ) -> int:
     """Fold a batch: denied tuples → DropNotify (+ verdict events when
-    PolicyVerdictNotification is on / emit_allowed).  Returns the
-    number of events published."""
+    PolicyVerdictNotification is on / emit_allowed).  `verdict_eps`
+    scopes allowed-verdict emission to specific endpoint ids — the
+    per-endpoint PolicyVerdictNotification option (`cilium endpoint
+    config`), which the reference compiles into that endpoint's
+    datapath alone.  Returns the number of events published."""
     allowed = np.asarray(verdicts.allowed)
     kind = np.asarray(verdicts.match_kind)
     proxy = np.asarray(verdicts.proxy_port)
     n = 0
-    idx = (
-        np.arange(len(allowed))
-        if emit_allowed
-        else np.nonzero(allowed == 0)[0]
-    )
+    if emit_allowed:
+        idx = np.arange(len(allowed))
+    elif verdict_eps:
+        ep_arr = np.asarray(ep_ids)
+        per_ep = np.isin(ep_arr, np.asarray(sorted(verdict_eps)))
+        idx = np.nonzero((allowed == 0) | per_ep)[0]
+    else:
+        idx = np.nonzero(allowed == 0)[0]
     for i in idx:
         if allowed[i]:
             bus.publish(

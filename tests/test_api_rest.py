@@ -211,3 +211,95 @@ def test_config_patch_is_atomic(tmp_path):
     finally:
         server.stop()
         option.Config.opts.pop("PolicyTracing", None)
+
+
+def test_monitor_stream_over_rest(tmp_path):
+    """Monitor session: events published after the session opens are
+    delivered across polls (persistent per-session queue — no loss
+    between long-polls), and closing detaches the subscriber."""
+    from cilium_tpu.api.client import APIClient
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.monitor.events import DropNotify
+
+    d = Daemon()
+    sock = str(tmp_path / "mon.sock")
+    server = APIServer(d, sock).start()
+    client = APIClient(sock)
+    try:
+        sid = client.monitor_open()["session"]
+        d.monitor.publish(DropNotify(source=7, reason=133))
+        got = client.monitor_poll(sid, timeout=2)
+        assert len(got["events"]) == 1
+        ev = got["events"][0]
+        assert ev["event"] == "DropNotify" and ev["source"] == 7
+
+        # events between polls are buffered, not lost
+        d.monitor.publish(DropNotify(source=8, reason=133))
+        d.monitor.publish(DropNotify(source=9, reason=133))
+        got = client.monitor_poll(sid, timeout=2)
+        assert [e["source"] for e in got["events"]] == [8, 9]
+
+        assert client.monitor_close(sid)["closed"] is True
+        from cilium_tpu.api.client import APIError
+
+        try:
+            client.monitor_poll(sid, timeout=0.1)
+            assert False, "closed session must 404"
+        except APIError as exc:
+            assert exc.status == 404
+    finally:
+        server.stop()
+
+
+def test_per_endpoint_config_gates_verdict_events(tmp_path):
+    """PATCH /endpoint/{id}/config turns on per-endpoint
+    PolicyVerdictNotification: the monitor fold then emits allowed-
+    verdict events for THAT endpoint only (the reference compiles the
+    option into that endpoint's datapath alone)."""
+    import numpy as np
+
+    from cilium_tpu.api.client import APIClient, APIError
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.monitor import verdicts_to_events
+    from tests.test_daemon import k8s_labels
+
+    d = Daemon()
+    sock = str(tmp_path / "epcfg.sock")
+    server = APIServer(d, sock).start()
+    client = APIClient(sock)
+    try:
+        d.create_endpoint(70, k8s_labels(app="a"), name="a")
+        d.create_endpoint(71, k8s_labels(app="b"), name="b")
+        out = client.endpoint_config_patch(
+            70, {"options": {"PolicyVerdictNotification": True}}
+        )
+        assert out["applied"] == 1
+        assert d.verdict_notification_endpoints() == {70}
+
+        class V:  # minimal verdicts carrier
+            allowed = np.array([1, 1], np.uint8)
+            match_kind = np.array([1, 1], np.uint8)
+            proxy_port = np.array([0, 0], np.int32)
+
+        q = d.monitor.subscribe_queue()
+        n = verdicts_to_events(
+            d.monitor, V(),
+            ep_ids=np.array([70, 71]),
+            identities=np.array([100, 100]),
+            dports=np.array([80, 80]),
+            protos=np.array([6, 6]),
+            directions=np.array([0, 0]),
+            verdict_eps=d.verdict_notification_endpoints(),
+        )
+        assert n == 1
+        assert [e.source for e in q] == [70]
+
+        try:
+            client.endpoint_config_patch(999, {"options": {}})
+            assert False, "unknown endpoint must 404"
+        except APIError as exc:
+            assert exc.status == 404
+    finally:
+        server.stop()

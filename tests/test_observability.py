@@ -142,3 +142,49 @@ def test_trace_policy_and_explain():
     assert not allowed and "DROP_POLICY" in why
     allowed, why = explain_tuple(state, 256, 80, 6, INGRESS, is_fragment=True)
     assert not allowed and "fragment" in why.lower()
+
+
+def test_process_flows_feeds_monitor():
+    """Daemon.process_flows: the production datapath→monitor path —
+    replay through published tables folds drops into the bus, and
+    allowed-verdict events appear for endpoints opted into
+    PolicyVerdictNotification (per-endpoint or global)."""
+    import numpy as np
+
+    from cilium_tpu import option
+    from cilium_tpu.monitor.events import DropNotify, PolicyVerdictNotify
+    from tests.test_replay import _daemon_with_policy, _make_buf
+
+    d, server, client = _daemon_with_policy()
+    q = d.monitor.subscribe_queue()
+    rng = np.random.default_rng(3)
+    cid = client.security_identity.id
+    buf = _make_buf(rng, 64, [10], [cid, 999999])
+
+    stats = d.process_flows(buf, batch_size=32)
+    assert stats.total == 64
+    drops = [e for e in q if isinstance(e, DropNotify)]
+    assert len(drops) == stats.denied and stats.denied > 0
+    assert not any(isinstance(e, PolicyVerdictNotify) for e in q)
+
+    # opt the server endpoint into verdict notifications
+    d.endpoint_config_patch(
+        10, {"options": {"PolicyVerdictNotification": True}}
+    )
+    q.clear()
+    d.process_flows(buf, batch_size=32)
+    verdicts = [e for e in q if isinstance(e, PolicyVerdictNotify)]
+    assert len(verdicts) == stats.allowed and stats.allowed > 0
+    assert all(e.source == 10 for e in verdicts)
+
+    # the GLOBAL option covers every endpoint
+    d.endpoint_config_patch(
+        10, {"options": {"PolicyVerdictNotification": False}}
+    )
+    option.Config.opts["PolicyVerdictNotification"] = True
+    try:
+        assert d.verdict_notification_endpoints() == {
+            ep.id for ep in d.endpoint_manager.endpoints()
+        }
+    finally:
+        option.Config.opts.pop("PolicyVerdictNotification", None)
