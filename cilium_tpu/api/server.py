@@ -260,10 +260,11 @@ class DaemonAPI:
                     )
         return {
             "events": events,
-            # THIS session's overflow drops, not the bus-global count
-            # (one abandoned subscriber must not inflate everyone's
-            # loss report)
-            "lost": self.daemon.monitor.queue_drops(q),
+            # THIS session's drops since the LAST poll, not the
+            # bus-global count (one abandoned subscriber must not
+            # inflate everyone's loss report, and a one-time overflow
+            # must not read as ongoing loss forever)
+            "lost": self.daemon.monitor.queue_drops(q, reset=True),
         }
 
     def monitor_close(self, sid: str) -> dict:

@@ -110,6 +110,27 @@ class Daemon:
         if kvstore is not None:
             self._ip_watcher = IPIdentityWatcher(kvstore, self.ipcache)
         self.clustermesh = ClusterMesh(self.ipcache)
+        # tunnel/overlay map fed by node discovery (pkg/maps/tunnel ←
+        # linuxNodeHandler NodeUpdate): remote nodes' pod CIDRs map to
+        # their node IP; consumers assemble DatapathTables with
+        # tunnel=self.tunnel_map.tables() to compile the overlay form
+        from cilium_tpu.tunnel import TunnelMap
+
+        self.tunnel_map = TunnelMap()
+        if kvstore is not None:
+            from cilium_tpu.kvstore.node import NodeWatcher
+
+            def _tunnel_feed(kind, node):
+                # the agent's OWN published Node comes back through
+                # the watch; the local pod CIDR must stay direct
+                # (linuxNodeHandler skips the local node)
+                if getattr(node, "name", "") == self.node_name:
+                    return
+                self.tunnel_map.on_node(kind, node)
+
+            self._node_watcher = NodeWatcher(
+                kvstore, on_change=_tunnel_feed
+            )
         # indexed selector -> identity-set resolution for the compiler
         from cilium_tpu.compiler.selectorcache import RuleIndex, SelectorCache
 
@@ -712,47 +733,58 @@ class Daemon:
         the record); it reads verdict bits back per batch, which is
         the monitoring cost the reference pays through its perf ring.
         Returns ReplayStats."""
+        import time as _time
+        from types import SimpleNamespace
+
         import numpy as np
 
         from cilium_tpu.engine.verdict import evaluate_batch
         from cilium_tpu.monitor import verdicts_to_events
-        from cilium_tpu.replay import ReplayStats, read_batches
+        from cilium_tpu.native import decode_flow_records, encode_flow_records
+        from cilium_tpu.replay import (
+            ReplayStats,
+            _tally,
+            read_batches,
+        )
 
         version, tables, index = self.endpoint_manager.published()
         if tables is None:
             raise RuntimeError("no published tables")
-        rev_index = {v: k for k, v in index.items()}
-        ep_map = dict(index)
+        # records for endpoints this node doesn't own are dropped up
+        # front (read_batches maps unknown ids to axis 0, which would
+        # evaluate them under — and attribute their events to — the
+        # endpoint that happens to sit there)
+        rec = decode_flow_records(buf)
+        known = np.isin(
+            rec["ep_id"], np.fromiter(index, dtype=np.int64)
+        )
+        if not known.all():
+            rec = {k: v[known] for k, v in rec.items()}
+            buf = encode_flow_records(**rec)
+        # vectorized index→endpoint-id translation (inverse of
+        # replay._ep_index_of's LUT)
+        rev_lut = np.zeros(
+            max(index.values(), default=0) + 1, dtype=np.int64
+        )
+        for ep_id, idx in index.items():
+            rev_lut[idx] = ep_id
         verdict_eps = self.verdict_notification_endpoints()
         stats = ReplayStats()
-        import time as _time
-
         t0 = _time.perf_counter()
-        for batch, valid in read_batches(buf, batch_size, ep_map):
+        for batch, valid in read_batches(buf, batch_size, dict(index)):
             out = evaluate_batch(tables, batch)
-            allowed = np.asarray(out.allowed)[:valid]
-            proxy = np.asarray(out.proxy_port)[:valid]
-            stats.total += int(valid)
-            stats.allowed += int(allowed.sum())
-            stats.denied += int(valid - allowed.sum())
-            stats.redirected += int((proxy > 0).sum())
+            _tally(out, valid, stats)
             stats.batches += 1
             ep_idx = np.asarray(batch.ep_index)[:valid]
-            ep_ids = np.asarray(
-                [rev_index.get(int(e), int(e)) for e in ep_idx]
+            v = SimpleNamespace(
+                allowed=np.asarray(out.allowed)[:valid],
+                match_kind=np.asarray(out.match_kind)[:valid],
+                proxy_port=np.asarray(out.proxy_port)[:valid],
             )
-
-            class _V:  # the verdict fields the fold consumes
-                pass
-
-            v = _V()
-            v.allowed = allowed
-            v.match_kind = np.asarray(out.match_kind)[:valid]
-            v.proxy_port = proxy
             verdicts_to_events(
                 self.monitor,
                 v,
-                ep_ids=ep_ids,
+                ep_ids=rev_lut[ep_idx],
                 identities=np.asarray(batch.identity)[:valid],
                 dports=np.asarray(batch.dport)[:valid],
                 protos=np.asarray(batch.proto)[:valid],

@@ -36,11 +36,14 @@ STATE_FILE = "ep_state.json"
 #   0: round-1 shape — no version stamp, no realized_redirects, map
 #      entries without packets/bytes counters;
 #   1: adds the explicit version stamp, realized_redirects, and
-#      per-entry packets/bytes.
+#      per-entry packets/bytes;
+#   2: adds per-endpoint runtime options ("opts" — `cilium endpoint
+#      config` state, which is compiled datapath state in the
+#      reference and must survive restarts).
 # A checkpoint newer than SCHEMA_VERSION is NOT restored (a downgraded
 # agent must not guess at fields it does not know), mirroring
 # map-migrate refusing unknown map properties.
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # version k → pure doc→doc migration producing version k+1
 _MIGRATIONS = {}
@@ -65,6 +68,14 @@ def _v0_to_v1(doc: dict) -> dict:
         {**{"packets": 0, "bytes": 0}, **item}
         for item in doc.get("realized_map_state", [])
     ]
+    return doc
+
+
+@_migration(1)
+def _v1_to_v2(doc: dict) -> dict:
+    doc = dict(doc)
+    doc["version"] = 2
+    doc.setdefault("opts", {})
     return doc
 
 
@@ -181,6 +192,7 @@ def save_endpoint(endpoint: Endpoint, state_dir: str) -> str:
             endpoint.realized_map_state
         ),
         "realized_redirects": endpoint.realized_redirects,
+        "opts": dict(endpoint.opts),
     }
     fd, tmp = tempfile.mkstemp(dir=ep_dir, prefix=".tmp_state")
     try:
@@ -230,6 +242,12 @@ def restore_endpoints(
             )
             endpoint.realized_redirects = dict(
                 doc.get("realized_redirects", {})
+            )
+            endpoint.opts.update(
+                {
+                    k: bool(v)
+                    for k, v in doc.get("opts", {}).items()
+                }
             )
             if allocator is not None and doc.get("labels"):
                 ident, _ = allocator.allocate(

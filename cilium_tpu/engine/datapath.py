@@ -89,17 +89,23 @@ def _register(cls):
 @dataclass
 class DatapathTables:
     """Everything the fused step consumes, as one pytree — the set of
-    pinned maps a bpf_lxc program sees (lib/maps.h)."""
+    pinned maps a bpf_lxc program sees (lib/maps.h).  `tunnel` is the
+    node-discovery-fed prefix→node-IP map (pkg/maps/tunnel); None
+    compiles the no-overlay program (native routing mode)."""
 
     prefilter: object  # PrefilterRanges (broadcast) or LPMTables
     ipcache: LPMTables
     ct: CTSnapshot
     lb: LBTables
     policy: PolicyTables
+    tunnel: object = None  # TunnelTables or None
 
     def tree_flatten(self):
         return (
-            (self.prefilter, self.ipcache, self.ct, self.lb, self.policy),
+            (
+                self.prefilter, self.ipcache, self.ct, self.lb,
+                self.policy, self.tunnel,
+            ),
             None,
         )
 
@@ -218,6 +224,9 @@ class DatapathVerdicts:
     lb_slave: jax.Array  # i32 [B] chosen backend (0 = not a service)
     ct_create: jax.Array  # bool [B] NEW + allowed → host CT create
     ct_delete: jax.Array  # bool [B] ESTABLISHED + denied → host delete
+    # u32 [B] remote node IP to encapsulate to (0 = direct/local) —
+    # bpf_overlay's encap decision; all-zero without a tunnel map
+    tunnel_endpoint: jax.Array = None
 
     def tree_flatten(self):
         return (
@@ -234,6 +243,7 @@ class DatapathVerdicts:
                 self.lb_slave,
                 self.ct_create,
                 self.ct_delete,
+                self.tunnel_endpoint,
             ),
             None,
         )
@@ -417,6 +427,23 @@ def _datapath_core(
         0,
     )
 
+    # -- 7. overlay forwarding decision (encap_and_redirect,
+    # bpf/lib/encap.h:26 via bpf_lxc's ipv4 tail): an ALLOWED egress
+    # flow whose destination falls in a remote node's pod CIDR gets
+    # the tunnel endpoint (the identity to carry rides in sec_id,
+    # exactly as the reference stuffs seclabel into the tunnel key);
+    # 0 = direct route / local delivery ---------------------------------
+    if tables.tunnel is not None and static_direction != INGRESS:
+        from cilium_tpu.tunnel import tunnel_select
+
+        tunnel_ep = jnp.where(
+            allowed & ~ingress,
+            tunnel_select(tables.tunnel, eff_daddr),
+            jnp.uint32(0),
+        )
+    else:
+        tunnel_ep = jnp.zeros(eff_daddr.shape, jnp.uint32)
+
     out = DatapathVerdicts(
         allowed=allowed.astype(jnp.uint8),
         proxy_port=proxy,
@@ -430,6 +457,7 @@ def _datapath_core(
         lb_slave=lb_slave,
         ct_create=ct_create,
         ct_delete=ct_delete,
+        tunnel_endpoint=tunnel_ep,
     )
     if with_counters:
         return out, acc

@@ -76,9 +76,13 @@ class MonitorBus:
                 self._cond.wait(timeout=remaining)
             return True
 
-    def queue_drops(self, q: Deque) -> int:
-        """Overflow drops charged to ONE subscriber's queue."""
+    def queue_drops(self, q: Deque, reset: bool = False) -> int:
+        """Overflow drops charged to ONE subscriber's queue.  With
+        `reset` the counter reads as a delta (long-poll replies report
+        drops SINCE the last poll, not a forever-cumulative number)."""
         with self._lock:
+            if reset:
+                return self._drops.pop(id(q), 0)
             return self._drops.get(id(q), 0)
 
     def publish(self, event) -> None:

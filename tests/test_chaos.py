@@ -255,3 +255,22 @@ def test_checkpoint_too_new_skipped(tmp_path):
     assert restore_endpoints(state_dir) == []
     with open(os.path.join(ep_dir, "ep_state.json")) as f:
         assert json.load(f)["version"] == 99  # untouched
+
+
+def test_per_endpoint_opts_survive_restart(tmp_path):
+    """Schema v2: per-endpoint runtime options checkpoint and restore
+    (the reference compiles them into the endpoint's datapath — they
+    are durable state, not session state)."""
+    from cilium_tpu.daemon import Daemon
+    from tests.test_daemon import k8s_labels
+
+    state = str(tmp_path / "state_opts")
+    d1 = Daemon(state_dir=state)
+    d1.create_endpoint(30, k8s_labels(app="m"), name="m")
+    d1.endpoint_config_patch(
+        30, {"options": {"PolicyVerdictNotification": True}}
+    )
+    d1.checkpoint()
+
+    d2 = Daemon(state_dir=state)
+    assert d2.verdict_notification_endpoints() == {30}

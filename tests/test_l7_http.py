@@ -314,3 +314,23 @@ def test_http_device_vs_host_oracle_fuzz(seed):
             for r in rules
         )
         assert bool(got[i]) == want, (i, reqs[i], idents[i])
+
+
+def test_mxu_lookup_matches_numpy_gather():
+    """_mxu_lookup (one-hot × table matmul) must be EXACT for integer
+    tables — both the single-dot path (values ≤ 256) and the lo/hi
+    byte-plane split (values > 256, where bf16 would round)."""
+    import numpy as np
+    import jax
+
+    from cilium_tpu.l7.http import _mxu_lookup
+
+    rng = np.random.default_rng(5)
+    for k, hi in ((257, 256), (900, 255), (513, 4095), (2048, 60000)):
+        table = rng.integers(0, hi + 1, size=k).astype(np.int64)
+        table[0] = hi  # pin the extreme value
+        idx = rng.integers(0, k, size=(512, 7)).astype(np.int32)
+        got = np.asarray(jax.jit(
+            lambda i, t=table: _mxu_lookup(i, t)
+        )(idx))
+        np.testing.assert_array_equal(got, table[idx])
