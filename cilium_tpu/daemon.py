@@ -740,27 +740,29 @@ class Daemon:
 
         from cilium_tpu.engine.verdict import evaluate_batch
         from cilium_tpu.monitor import verdicts_to_events
-        from cilium_tpu.native import decode_flow_records, encode_flow_records
+        from cilium_tpu.native import decode_flow_records
         from cilium_tpu.replay import (
             ReplayStats,
             _tally,
-            read_batches,
+            read_batches_from_rec,
         )
 
         version, tables, index = self.endpoint_manager.published()
         if tables is None:
             raise RuntimeError("no published tables")
         # records for endpoints this node doesn't own are dropped up
-        # front (read_batches maps unknown ids to axis 0, which would
-        # evaluate them under — and attribute their events to — the
-        # endpoint that happens to sit there)
+        # front (the index→axis mapping sends unknown ids to axis 0,
+        # which would evaluate them under — and attribute their
+        # events to — the endpoint that happens to sit there).  ONE
+        # decode pass: the filtered SoA feeds batching directly, and
+        # the drop count is surfaced in stats.
         rec = decode_flow_records(buf)
         known = np.isin(
             rec["ep_id"], np.fromiter(index, dtype=np.int64)
         )
-        if not known.all():
+        n_dropped = int((~known).sum())
+        if n_dropped:
             rec = {k: v[known] for k, v in rec.items()}
-            buf = encode_flow_records(**rec)
         # vectorized index→endpoint-id translation (inverse of
         # replay._ep_index_of's LUT)
         rev_lut = np.zeros(
@@ -770,8 +772,11 @@ class Daemon:
             rev_lut[idx] = ep_id
         verdict_eps = self.verdict_notification_endpoints()
         stats = ReplayStats()
+        stats.dropped = n_dropped
         t0 = _time.perf_counter()
-        for batch, valid in read_batches(buf, batch_size, dict(index)):
+        for batch, valid in read_batches_from_rec(
+            rec, batch_size, dict(index)
+        ):
             out = evaluate_batch(tables, batch)
             _tally(out, valid, stats)
             stats.batches += 1

@@ -371,10 +371,14 @@ class Datapath6Tables:
     ipcache: IPCache6Device
     ct: CT6Snapshot
     policy: object  # compiler.tables.PolicyTables (shared with v4)
+    tunnel: object = None  # tunnel.TunnelTables6 or None
 
     def tree_flatten(self):
         return (
-            (self.prefilter, self.ipcache, self.ct, self.policy),
+            (
+                self.prefilter, self.ipcache, self.ct, self.policy,
+                self.tunnel,
+            ),
             None,
         )
 
@@ -394,6 +398,9 @@ class Datapath6Verdicts:
     sec_id: jax.Array  # u32 [B]
     ct_create: jax.Array  # bool [B]
     ct_delete: jax.Array  # bool [B]
+    # u32 [B] remote node IP (v4 underlay) to encapsulate to; 0 =
+    # direct/local — all-zero without a tunnel table
+    tunnel_endpoint: jax.Array = None
 
     def tree_flatten(self):
         return (
@@ -406,6 +413,7 @@ class Datapath6Verdicts:
                 self.sec_id,
                 self.ct_create,
                 self.ct_delete,
+                self.tunnel_endpoint,
             ),
             None,
         )
@@ -468,6 +476,20 @@ def _datapath6_kernel(
         v.proxy_port,
         0,
     )
+    # overlay decision (the v4 program's stage 7, limb-masked): an
+    # allowed egress flow into a remote node's v6 pod CIDR carries
+    # that node's (v4 underlay) IP
+    if tables.tunnel is not None:
+        from cilium_tpu.tunnel import tunnel_select6
+
+        tunnel_ep = jnp.where(
+            allowed & ~ingress,
+            tunnel_select6(tables.tunnel, flows.daddr),
+            jnp.uint32(0),
+        )
+    else:
+        tunnel_ep = jnp.zeros(allowed.shape, jnp.uint32)
+
     return Datapath6Verdicts(
         allowed=allowed.astype(jnp.uint8),
         proxy_port=proxy_out,
@@ -477,6 +499,7 @@ def _datapath6_kernel(
         sec_id=sec_id,
         ct_create=ct_create,
         ct_delete=ct_delete,
+        tunnel_endpoint=tunnel_ep,
     )
 
 

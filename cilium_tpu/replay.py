@@ -342,6 +342,10 @@ class ReplayStats:
     seconds: float = 0.0
     ct_created: int = 0
     ct_deleted: int = 0
+    # records discarded BEFORE evaluation (e.g. unknown endpoint ids
+    # filtered by Daemon.process_flows) — totals must account for
+    # every input record
+    dropped: int = 0
 
     @property
     def verdicts_per_sec(self) -> float:
@@ -384,7 +388,19 @@ def read_batches(
     pre-resolved from the record).  `ep_map` translates record
     endpoint ids to table endpoint-axis indices (unknown endpoints map
     to 0 — callers should pre-filter)."""
-    rec = decode_flow_records(buf)
+    return read_batches_from_rec(
+        decode_flow_records(buf), batch_size, ep_map
+    )
+
+
+def read_batches_from_rec(
+    rec: Dict[str, np.ndarray],
+    batch_size: int,
+    ep_map: Optional[Dict[int, int]] = None,
+) -> Iterator[Tuple[TupleBatch, int]]:
+    """read_batches over an ALREADY-decoded record SoA — callers that
+    pre-filter records (Daemon.process_flows) avoid a second decode
+    pass over the buffer."""
     n = len(rec["ep_id"])
     ep_index = _ep_index_of(rec, ep_map)
     for start, end in _batch_slices(n, batch_size):
@@ -515,6 +531,7 @@ def replay(
             ct=churn.dev_snap,
             lb=tables.lb,
             policy=tables.policy,
+            tunnel=tables.tunnel,
         )
         churn_step, churn_step_accum = _churn_fns()[:2]
 
@@ -540,6 +557,7 @@ def replay(
                     ct=churn.dev_snap,
                     lb=tables.lb,
                     policy=tables.policy,
+                    tunnel=tables.tunnel,
                 )
                 if first_pass and accumulate_counters:
                     header_d, intents_d, acc = churn_step_accum(
@@ -648,6 +666,7 @@ def replay_pool(
                 ct=churn.dev_snap,
                 lb=tables.lb,
                 policy=tables.policy,
+                tunnel=tables.tunnel,
             )
             header_d, intents_d = churn_pool(
                 t, pool_dev, picks_dev, valid

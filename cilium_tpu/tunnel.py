@@ -46,6 +46,26 @@ class TunnelTables:
         return cls(*children)
 
 
+@dataclass
+class TunnelTables6:
+    """v6 pod CIDRs → tunnel endpoint: limb-masked ranges (the
+    lpm6.build_limb_ranges form) with a v4 underlay node IP per range
+    — dual-stack pods commonly overlay v6 pod networks on a v4 node
+    fabric, exactly the shape tunnel.go stores (tunnel keys carry the
+    prefix family, values the node IP)."""
+
+    base: np.ndarray  # u32 [P, 4] limb base
+    mask: np.ndarray  # u32 [P, 4] limb mask
+    endpoint: np.ndarray  # u32 [P] node IP (0 = padding)
+
+    def tree_flatten(self):
+        return ((self.base, self.mask, self.endpoint), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
 def _register_pytree() -> None:
     try:
         import jax
@@ -54,6 +74,11 @@ def _register_pytree() -> None:
             TunnelTables,
             lambda t: t.tree_flatten(),
             lambda aux, ch: TunnelTables.tree_unflatten(aux, ch),
+        )
+        jax.tree_util.register_pytree_node(
+            TunnelTables6,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: TunnelTables6.tree_unflatten(aux, ch),
         )
     except Exception:  # pragma: no cover
         pass
@@ -81,6 +106,7 @@ class TunnelMap:
         self._node_lock = threading.Lock()
         self._dirty = True
         self._tables: Optional[TunnelTables] = None
+        self._tables6: Optional[TunnelTables6] = None
 
     def set_tunnel_endpoint(
         self, prefix: str, endpoint_ip: str
@@ -118,15 +144,24 @@ class TunnelMap:
 
     def on_node(self, kind: str, node) -> None:
         """Wire as a kvstore NodeWatcher on_change callback: a remote
-        node's pod CIDR tunnels to its internal IP; node deletion —
-        or a node re-publishing with a DIFFERENT pod CIDR — removes
-        the old mapping first (linuxNodeHandler NodeUpdate deletes
-        the previous CIDR's tunnel entry before inserting the new)."""
-        cidr = getattr(node, "ipv4_alloc_cidr", None)
+        node's pod CIDRs (v4 AND v6) tunnel to its internal IP; node
+        deletion — or a node re-publishing with a DIFFERENT pod CIDR
+        — removes the old mapping first (linuxNodeHandler NodeUpdate
+        deletes the previous CIDR's tunnel entry before inserting the
+        new).  Both families key one map, as tunnel.go does (the
+        prefix carries its family); tables()/tables6() split them at
+        lowering."""
         ip = getattr(node, "internal_ip", None)
         name = getattr(node, "name", "")
-        with self._node_lock:
-            self._on_node_locked(kind, name, cidr, ip)
+        for attr, suffix in (
+            ("ipv4_alloc_cidr", ""),
+            ("ipv6_alloc_cidr", "#6"),
+        ):
+            cidr = getattr(node, attr, None)
+            with self._node_lock:
+                self._on_node_locked(
+                    kind, name + suffix, cidr, ip
+                )
 
     def _release_owned(self, name: str) -> None:
         """Drop this node's recorded mapping, but only if the live
@@ -170,9 +205,18 @@ class TunnelMap:
             if stored_ep is not None:
                 self._node_cidr[name] = (cidr, stored_ep)
 
+    def _refresh_locked(self) -> None:
+        """Invalidate both lowered forms once per mutation epoch
+        (held under self._lock): each then rebuilds lazily."""
+        if self._dirty:
+            self._tables = None
+            self._tables6 = None
+            self._dirty = False
+
     def tables(self) -> TunnelTables:
         with self._lock:
-            if not self._dirty and self._tables is not None:
+            self._refresh_locked()
+            if self._tables is not None:
                 return self._tables
             nets = []
             for cidr, ep in sorted(self._prefixes.items()):
@@ -195,8 +239,41 @@ class TunnelMap:
             self._tables = TunnelTables(
                 base=base, mask=mask, endpoint=endpoint
             )
-            self._dirty = False
             return self._tables
+
+    def tables6(self) -> TunnelTables6:
+        """The v6 half of the map: limb-masked ranges over the same
+        prefix set (both forms invalidate on any mutation)."""
+        from cilium_tpu.ipcache.lpm6 import (
+            _mask_limbs,
+            build_limb_ranges,
+            limbs_of_int,
+        )
+
+        with self._lock:
+            self._refresh_locked()
+            if self._tables6 is not None:
+                return self._tables6
+            nets = []
+            eps = []
+            for cidr, ep in sorted(self._prefixes.items()):
+                net = ipaddress.ip_network(cidr, strict=False)
+                if net.version != 6:
+                    continue
+                nets.append(
+                    (
+                        limbs_of_int(int(net.network_address)),
+                        _mask_limbs(net.prefixlen),
+                    )
+                )
+                eps.append(ep)
+            base, mask = build_limb_ranges(nets)
+            endpoint = np.zeros(base.shape[0], dtype=np.uint32)
+            endpoint[: len(eps)] = eps
+            self._tables6 = TunnelTables6(
+                base=base, mask=mask, endpoint=endpoint
+            )
+            return self._tables6
 
 
 def tunnel_select(tables: TunnelTables, daddr, local_node_ip: int = 0):
@@ -211,6 +288,24 @@ def tunnel_select(tables: TunnelTables, daddr, local_node_ip: int = 0):
     match = (ips[:, None] & jnp.asarray(tables.mask)[None, :]) == (
         jnp.asarray(tables.base)[None, :]
     )
+    ep = jnp.max(
+        jnp.where(match, jnp.asarray(tables.endpoint)[None, :], 0),
+        axis=1,
+    )
+    return jnp.where(ep == jnp.uint32(local_node_ip), 0, ep)
+
+
+def tunnel_select6(
+    tables: "TunnelTables6", daddr_limbs, local_node_ip: int = 0
+):
+    """v6 forwarding decision: daddr u32 [B, 4] limbs → tunnel
+    endpoint u32 [B] (0 = direct/local), the limb-masked analog of
+    tunnel_select (disjoint pod CIDRs ⇒ any match wins)."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.ipcache.lpm6 import match_limb_ranges
+
+    match = match_limb_ranges(tables.base, tables.mask, daddr_limbs)
     ep = jnp.max(
         jnp.where(match, jnp.asarray(tables.endpoint)[None, :], 0),
         axis=1,

@@ -258,3 +258,116 @@ def test_daemon_node_discovery_feeds_tunnel_map():
         )
     )
     assert got[0] == 0
+
+
+def test_v6_pod_cidr_over_v4_underlay():
+    """v6 pod CIDRs lower into limb-masked tunnel ranges with a v4
+    underlay node IP; tunnel_select6 resolves them and the v6 fused
+    program carries the encap decision."""
+    from cilium_tpu.ipcache.lpm6 import ip6_limbs
+    from cilium_tpu.tunnel import tunnel_select6
+
+    tm = TunnelMap()
+    tm.on_node(
+        "create",
+        Node(name="r6", internal_ip="192.168.3.3",
+             ipv4_alloc_cidr="10.66.0.0/24",
+             ipv6_alloc_cidr="fd10:6::/64"),
+    )
+    t6 = tm.tables6()
+    limbs = np.array(
+        [ip6_limbs("fd10:6::42"), ip6_limbs("fd10:7::42")],
+        np.uint32,
+    )
+    got = np.asarray(tunnel_select6(t6, jnp.asarray(limbs)))
+    assert got[0] == _u32("192.168.3.3") and got[1] == 0
+    # the v4 half still lowers alongside
+    got4 = np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(np.array([_u32("10.66.0.9")], np.uint32)),
+        )
+    )
+    assert got4[0] == _u32("192.168.3.3")
+    # deletion removes BOTH families' mappings
+    tm.on_node("delete", Node(name="r6", internal_ip="192.168.3.3"))
+    assert np.asarray(
+        tunnel_select6(tm.tables6(), jnp.asarray(limbs))
+    )[0] == 0
+    assert np.asarray(
+        tunnel_select(
+            tm.tables(),
+            jnp.asarray(np.array([_u32("10.66.0.9")], np.uint32)),
+        )
+    )[0] == 0
+
+
+def test_fused_v6_step_encap_decision():
+    """Datapath6Tables with a tunnel: allowed egress flows into the
+    remote v6 pod CIDR carry the node IP in tunnel_endpoint."""
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.ct.table import CTMap
+    from cilium_tpu.engine.datapath6 import (
+        Datapath6Tables,
+        FlowBatch6,
+        build_prefilter6,
+        compile_ct6,
+        datapath6_step,
+    )
+    from cilium_tpu.ipcache.lpm6 import build_ipcache6, ip6_limbs
+    from tests.test_datapath6 import (
+        IDENTITY_IDS,
+        IPCACHE6,
+        random_map_state,
+    )
+
+    rng = np.random.default_rng(9)
+    n_eps = 3
+    states = [
+        random_map_state(rng, IDENTITY_IDS, n_l4=10, n_l3=10)
+        for _ in range(n_eps)
+    ]
+    policy = compile_map_states(states, IDENTITY_IDS, 32, 16)
+    tm = TunnelMap()
+    tm.on_node(
+        "create",
+        Node(name="r6", internal_ip="192.168.4.4",
+             ipv6_alloc_cidr="fd10:9::/64"),
+    )
+    tables = Datapath6Tables(
+        prefilter=build_prefilter6([]),
+        ipcache=build_ipcache6(IPCACHE6),
+        ct=compile_ct6(CTMap()),
+        policy=policy,
+        tunnel=tm.tables6(),
+    )
+    n = 128
+    ips = ["2001:db8::1", "fd10:9::7"]
+    daddr_s = [ips[int(x)] for x in rng.integers(0, 2, size=n)]
+    f = dict(
+        ep_index=rng.integers(0, n_eps, size=n),
+        saddr=np.array(
+            [ip6_limbs("2001:db8:1::10")] * n, np.uint32
+        ),
+        daddr=np.array(
+            [ip6_limbs(d) for d in daddr_s], np.uint32
+        ),
+        sport=rng.integers(1024, 60000, size=n),
+        dport=rng.choice([53, 80, 443], size=n),
+        proto=rng.choice([6, 17], size=n),
+        direction=rng.integers(0, 2, size=n),
+        is_fragment=np.zeros(n, bool),
+    )
+    flows = FlowBatch6.from_numpy(**f)
+    out = datapath6_step(tables, flows)
+    te = np.asarray(out.tunnel_endpoint)
+    allowed = np.asarray(out.allowed).astype(bool)
+    in_cidr = np.array(
+        [d == "fd10:9::7" for d in daddr_s]
+    )
+    want = np.where(
+        allowed & (f["direction"] == 1) & in_cidr,
+        _u32("192.168.4.4"),
+        0,
+    )
+    np.testing.assert_array_equal(te, want)
