@@ -118,6 +118,18 @@ class APIClient:
     def ipcache_dump(self):
         return self._request("GET", "/ipcache")
 
+    def service_list(self):
+        return self._request("GET", "/service")
+
+    def service_upsert(self, body: dict):
+        return self._request("POST", "/service", body=body)
+
+    def service_delete(self, body: dict):
+        return self._request("DELETE", "/service", body=body)
+
+    def ct_list(self):
+        return self._request("GET", "/ct")
+
     def ipam_allocate(self, ip=None):
         return self._request(
             "POST", "/ipam", body={} if ip is None else {"ip": ip}

@@ -26,6 +26,8 @@ Routes (the api/v1 subset this framework's daemon implements):
   GET    /identity           identity cache
   GET    /ipcache            ipcache dump
   GET    /metrics            metrics registry dump
+  GET    /service            service list; POST upserts; DELETE removes
+  GET    /ct                 conntrack dump (bpf_ct_list analog)
   POST   /ipam               allocate an address ({ip} to pin one)
   DELETE /ipam/{ip}          release an address
   POST   /monitor            open a monitor session (persistent queue)
@@ -186,6 +188,97 @@ class DaemonAPI:
                 return entry
         return None
 
+    def service_list(self) -> list:
+        # snapshot under the daemon lock: the server is thread-per-
+        # connection and POST/DELETE mutate these dicts concurrently
+        with self.daemon.lock:
+            services = [
+                (svc, list(svc.backends))
+                for svc in self.daemon.services.by_id.values()
+            ]
+        return [
+            {
+                "id": svc.id,
+                "frontend": {
+                    "ip": svc.frontend.ip,
+                    "port": svc.frontend.port,
+                    "protocol": svc.frontend.protocol,
+                },
+                "backends": [
+                    {
+                        "ip": b.addr.ip,
+                        "port": b.addr.port,
+                        "protocol": b.addr.protocol,
+                    }
+                    for b in _backends
+                ],
+            }
+            for svc, _backends in services
+        ]
+
+    def service_upsert(self, body: dict) -> dict:
+        from cilium_tpu.lb.service import L3n4Addr
+
+        fe = body["frontend"]
+        frontend = L3n4Addr(
+            fe["ip"], int(fe["port"]), int(fe.get("protocol", 6))
+        )
+        backends = [
+            L3n4Addr(
+                b["ip"], int(b["port"]), int(b.get("protocol", 6))
+            )
+            for b in body.get("backends", [])
+        ]
+        svc = self.daemon.service_upsert(frontend, backends)
+        return {"id": svc.id}
+
+    def service_delete(self, body: dict) -> dict:
+        from cilium_tpu.lb.service import L3n4Addr
+
+        fe = body["frontend"]
+        frontend = L3n4Addr(
+            fe["ip"], int(fe["port"]), int(fe.get("protocol", 6))
+        )
+        return {"deleted": self.daemon.service_delete(frontend)}
+
+    def ct_list(self, limit: int = 4096) -> dict:
+        import ipaddress as _ipaddress
+
+        def _fmt(addr: int) -> str:
+            # v4 keys store u32, v6 keys 128-bit ints —
+            # ip_address(int) picks the family by magnitude, matching
+            # how CTTuple stores both
+            try:
+                return str(_ipaddress.ip_address(addr))
+            except ValueError:
+                return str(addr)
+
+        entries = []
+        # snapshot: the ct-gc controller thread deletes from this
+        # dict concurrently
+        snapshot = list(self.daemon.ct.entries.items())
+        for key, entry in snapshot:
+            if len(entries) >= limit:
+                break
+            entries.append(
+                {
+                    "daddr": _fmt(key.daddr),
+                    "saddr": _fmt(key.saddr),
+                    "dport": key.dport,
+                    "sport": key.sport,
+                    "proto": key.nexthdr,
+                    "flags": key.flags,
+                    "lifetime": entry.lifetime,
+                    "rx_packets": entry.rx_packets,
+                    "tx_packets": entry.tx_packets,
+                    "rev_nat": entry.rev_nat_index,
+                }
+            )
+        return {
+            "count": len(snapshot),
+            "entries": entries,
+        }
+
     def ipam_allocate(self, ip: Optional[str] = None) -> dict:
         got = self.daemon.ipam.allocate(ip)
         return {"ip": got}
@@ -338,6 +431,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(200, api.ipcache_dump())
             if path == "/metrics":
                 return self._reply(200, api.metrics_dump())
+            if path == "/service":
+                return self._reply(200, api.service_list())
+            if path == "/ct":
+                return self._reply(200, api.ct_list())
             if path.startswith("/monitor/"):
                 from urllib.parse import parse_qs
 
@@ -377,6 +474,16 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if path == "/monitor":
                 return self._reply(201, api.monitor_open())
+            if path == "/service":
+                try:
+                    body = json.loads(self._body() or "{}")
+                    if not isinstance(body, dict) or "frontend" not in body:
+                        raise ValueError("frontend required")
+                except (json.JSONDecodeError, ValueError) as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                return self._reply(200, api.service_upsert(body))
             if path == "/ipam":
                 # parse faults are 400; allocation failures (pool
                 # exhausted, duplicate pin — IPAMError is a
@@ -509,6 +616,16 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/policy":
                 labels = json.loads(self._body())
                 return self._reply(200, api.policy_delete(labels))
+            if path == "/service":
+                try:
+                    body = json.loads(self._body() or "{}")
+                    if not isinstance(body, dict) or "frontend" not in body:
+                        raise ValueError("frontend required")
+                except (json.JSONDecodeError, ValueError) as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                return self._reply(200, api.service_delete(body))
             if path.startswith("/monitor/"):
                 sid = path.split("/monitor/", 1)[1]
                 return self._reply(200, api.monitor_close(sid))

@@ -146,6 +146,16 @@ def cmd_config_set(api, args) -> int:
     return 0
 
 
+def cmd_service_list(api, args) -> int:
+    print(json.dumps(api.service_list(), indent=2))
+    return 0
+
+
+def cmd_ct_list(api, args) -> int:
+    print(json.dumps(api.ct_list(), indent=2))
+    return 0
+
+
 def cmd_monitor(api, args) -> int:
     """`cilium monitor` follow mode over the REST stream."""
     sid = api.monitor_open()["session"]
@@ -227,6 +237,16 @@ def make_parser() -> argparse.ArgumentParser:
     ipsub = ipc.add_subparsers(dest="subcmd", required=True)
     dump = ipsub.add_parser("dump")
     dump.set_defaults(func=cmd_ipcache_dump)
+
+    svc = sub.add_parser("service")
+    svcsub = svc.add_subparsers(dest="service_cmd", required=True)
+    slist = svcsub.add_parser("list")
+    slist.set_defaults(func=cmd_service_list)
+
+    ctp = sub.add_parser("ct")
+    ctsub = ctp.add_subparsers(dest="ct_cmd", required=True)
+    clist = ctsub.add_parser("list")
+    clist.set_defaults(func=cmd_ct_list)
 
     mon = sub.add_parser("monitor")
     mon.add_argument("--count", type=int, default=0,

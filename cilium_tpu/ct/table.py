@@ -116,8 +116,16 @@ class CTState:
 
 class CTMap:
     def __init__(self, max_entries: int = MAX_ENTRIES_LOCAL) -> None:
+        import time as _time
+
         self.entries: Dict[CTTuple, CTEntry] = {}
         self.max_entries = max_entries
+        # the map's time base: callers pass `now` in seconds on
+        # whatever monotonic scale they choose (tests use 0..N); the
+        # daemon's GC uses now() — seconds since THIS map was created
+        # — so wall-clock epochs can never mass-expire entries that
+        # were stamped on a relative scale
+        self._epoch = _time.monotonic()
         # bumped on every mutation THROUGH this map (create, probe
         # side effects, gc) — replay's device-snapshot cache gates on
         # it plus the key set, so host-side lookups between replays
@@ -126,6 +134,12 @@ class CTMap:
         # bypass it; such callers must invalidate the cache
         # themselves (replay._ChurnDriver docstring).
         self.mutations = 0
+
+    def now(self) -> int:
+        """Seconds since this map's creation (the GC clock)."""
+        import time as _time
+
+        return int(_time.monotonic() - self._epoch)
 
     # -- timeout logic (conntrack.h:190-207) --------------------------------
 

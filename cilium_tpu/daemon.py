@@ -110,6 +110,16 @@ class Daemon:
         if kvstore is not None:
             self._ip_watcher = IPIdentityWatcher(kvstore, self.ipcache)
         self.clustermesh = ClusterMesh(self.ipcache)
+        # service model + conntrack, daemon-owned like the reference
+        # (daemon/loadbalancer.go service BPF sync; endpointmanager
+        # conntrack.go periodic GC).  Consumers assemble
+        # DatapathTables with lb=compile_lb(self.services) /
+        # ct=compile_ct(self.ct).
+        from cilium_tpu.ct.table import CTMap
+        from cilium_tpu.lb.service import ServiceManager
+
+        self.services = ServiceManager()
+        self.ct = CTMap()
         # tunnel/overlay map fed by node discovery (pkg/maps/tunnel ←
         # linuxNodeHandler NodeUpdate): remote nodes' pod CIDRs map to
         # their node IP; consumers assemble DatapathTables with
@@ -142,6 +152,17 @@ class Daemon:
         self.monitor = MonitorBus()
         self.proxy = Proxy(monitor=self.monitor)
         self.controllers = ControllerManager()
+        # periodic CT GC (pkg/maps/ctmap GC; endpointmanager
+        # conntrack.go loop)
+        from cilium_tpu.utils.controller import Controller
+
+        self.controllers.update_controller(
+            Controller(
+                name="ct-gc",
+                do_func=self._ct_gc,
+                run_interval=30.0,
+            )
+        )
         # TriggerPolicyUpdates debouncing (daemon/policy.go:47)
         self.policy_trigger = Trigger(
             self._regenerate_for_reasons, name="policy_update"
@@ -616,6 +637,26 @@ class Daemon:
         return n
 
     # -- status (daemon/status.go) ------------------------------------------
+
+    def _ct_gc(self) -> None:
+        """Periodic CT garbage collection (pkg/maps/ctmap GC loop):
+        expired entries leave the host map; gc() bumps the map's
+        mutation counter, so the churn snapshot cache self-invalidates
+        at its next use (replay._ChurnDriver gate) and the device CT
+        resyncs."""
+        self.ct.gc(now=self.ct.now())
+
+    def service_upsert(
+        self, frontend, backends
+    ):
+        """PUT /service (daemon/loadbalancer.go SVCAdd)."""
+        with self.lock:
+            svc = self.services.upsert(frontend, backends)
+        return svc
+
+    def service_delete(self, frontend) -> bool:
+        with self.lock:
+            return self.services.delete(frontend)
 
     def config_patch(self, changes: Dict) -> Dict:
         """PATCH /config (daemon config handler + pkg/option runtime

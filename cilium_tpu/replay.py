@@ -295,6 +295,11 @@ class _ChurnDriver:
                 packed[0].astype(bool),
                 packed[1].astype(bool),
                 *(packed[j] for j in range(2, 10)),
+                # stamp lifetimes on the MAP's clock: the daemon's GC
+                # runs on ct.now() (map age), and a now=0 stamp would
+                # read as already-expired once uptime passes the
+                # timeout
+                now=self.ct_map.now(),
             )
             stats.ct_created += len(created_keys)
             stats.ct_deleted += len(deleted_keys)

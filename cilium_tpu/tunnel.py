@@ -112,13 +112,15 @@ class TunnelMap:
         self, prefix: str, endpoint_ip: str
     ) -> Optional[int]:
         """SetTunnelEndpoint (tunnel.go:84).  Returns the stored
-        endpoint u32, or None when skipped: v6 endpoints are skipped
-        until the v6 overlay lands (engine/datapath6.py docstring).
-        Raises when the map is full — direct callers should see the
-        failure, but event-driven feeds (on_node) must contain it.
-        Returning the parsed value (not a bool) lets on_node record
-        ownership with the EXACT endpoint the map stored, which
-        _release_owned later compares against."""
+        endpoint u32, or None when skipped: the underlay is v4 BY
+        DESIGN (TunnelTables/TunnelTables6 store u32 node IPs — v6
+        pod CIDRs overlay a v4 node fabric), so a v6 endpoint IP is
+        skipped, not an unfinished case.  Raises when the map is full
+        — direct callers should see the failure, but event-driven
+        feeds (on_node) must contain it.  Returning the parsed value
+        (not a bool) lets on_node record ownership with the EXACT
+        endpoint the map stored, which _release_owned later compares
+        against."""
         try:
             ep = int(ipaddress.IPv4Address(endpoint_ip))
         except (ipaddress.AddressValueError, ValueError):

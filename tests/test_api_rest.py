@@ -303,3 +303,70 @@ def test_per_endpoint_config_gates_verdict_events(tmp_path):
             assert exc.status == 404
     finally:
         server.stop()
+
+
+def test_service_and_ct_surfaces(tmp_path):
+    """`cilium service list` / `cilium ct list` analogs: the daemon
+    owns the service model and conntrack; REST exposes both."""
+    from cilium_tpu.api.client import APIClient
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.ct.table import CT_EGRESS, CTTuple
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    sock = str(tmp_path / "svc.sock")
+    server = APIServer(d, sock).start()
+    client = APIClient(sock)
+    try:
+        out = client.service_upsert(
+            {
+                "frontend": {"ip": "10.250.1.1", "port": 80},
+                "backends": [
+                    {"ip": "10.0.0.1", "port": 8080},
+                    {"ip": "10.0.0.2", "port": 8080},
+                ],
+            }
+        )
+        assert out["id"] >= 1
+        services = client.service_list()
+        assert len(services) == 1
+        assert services[0]["frontend"]["ip"] == "10.250.1.1"
+        assert len(services[0]["backends"]) == 2
+        # rev-NAT id is the service id (CT stickiness contract)
+        assert services[0]["id"] == out["id"]
+
+        d.ct.create(
+            CTTuple(0x0A000001, 0x0A000002, 80, 4000, 6), CT_EGRESS,
+            now=10, rev_nat_index=out["id"],
+        )
+        ct = client.ct_list()
+        assert ct["count"] == 1
+        assert ct["entries"][0]["daddr"] == "10.0.0.1"
+        assert ct["entries"][0]["rev_nat"] == out["id"]
+
+        assert client.service_delete(
+            {"frontend": {"ip": "10.250.1.1", "port": 80}}
+        )["deleted"] is True
+        assert client.service_list() == []
+    finally:
+        server.stop()
+
+
+def test_ct_gc_controller_runs():
+    """The daemon's ct-gc controller expires dead entries on the
+    map-age clock; the removal bumps the mutation counter, which is
+    exactly what the churn snapshot cache gates on."""
+    from cilium_tpu.ct.table import CT_EGRESS, CTTuple
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    # an entry whose lifetime is long past
+    d.ct.create(
+        CTTuple(0x0A000001, 0x0A000002, 80, 4000, 6), CT_EGRESS, now=0
+    )
+    for entry in d.ct.entries.values():
+        entry.lifetime = -1  # strictly before any map-relative now
+    before = d.ct.mutations
+    d._ct_gc()
+    assert len(d.ct.entries) == 0
+    assert d.ct.mutations > before  # invalidates the churn cache
