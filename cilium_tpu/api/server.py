@@ -393,11 +393,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, code: int, body) -> None:
         data = json.dumps(body).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client hung up (or the server is stopping while a
+            # long-poll handler is mid-reply): there is nobody to
+            # answer, and an exception escaping a handler thread is
+            # just teardown noise
+            pass
 
     def _body(self):
         n = int(self.headers.get("Content-Length") or 0)

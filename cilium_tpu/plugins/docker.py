@@ -42,11 +42,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, body: dict) -> None:
         data = json.dumps(body).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client hung up / server stopping mid-reply
 
     def do_POST(self) -> None:  # noqa: N802
         plugin: "DockerPlugin" = self.server.plugin  # type: ignore
