@@ -14,7 +14,8 @@ Configs 1-4 (one JSON line each):
   1. L3/L4 identity-pair allowlist from real rules, 1k tuples — the
      minimum end-to-end slice, oracle-gated.
   2. CIDR ruleset: DIR-24-8 ipcache LPM identity derivation feeding
-     the lattice, 100k-unique-tuple replay.
+     the lattice, 100k-unique-tuple replay (plus a supplementary
+     1M-batch line showing the dispatch-amortized device rate).
   3. HTTP L7: regex→DFA device matching, 1M requests, host re.fullmatch
      oracle subsample.
   4. Kafka L7: field-equality tensors, 1M requests, MatchesRule host
