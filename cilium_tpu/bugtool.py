@@ -68,6 +68,44 @@ def collect(daemon, out_dir: str) -> str:
         },
     )
     write("prefix_lengths.json", dict(daemon.prefix_lengths))
+    # daemon-owned service/CT/tunnel/controller state (the reference
+    # bugtool dumps `cilium service list`, `cilium bpf ct list`,
+    # `cilium bpf tunnel list`, and controller statuses the same way)
+    write(
+        "services.json",
+        [
+            {
+                "id": svc.id,
+                "frontend": f"{svc.frontend.ip}:{svc.frontend.port}",
+                "backends": [
+                    f"{b.addr.ip}:{b.addr.port}"
+                    for b in svc.backends
+                ],
+            }
+            for svc in daemon.services.by_id.values()
+        ],
+    )
+    write(
+        "conntrack.json",
+        {
+            "count": len(daemon.ct.entries),
+            "mutations": daemon.ct.mutations,
+            "clock": daemon.ct.now(),
+        },
+    )
+    write("tunnel.json", daemon.tunnel_map.snapshot())
+    write(
+        "controllers.json",
+        {
+            name: {
+                "success_count": st.success_count,
+                "failure_count": st.failure_count,
+                "consecutive_failures": st.consecutive_failures,
+                "last_error": st.last_error,
+            }
+            for name, st in daemon.controllers.statuses().items()
+        },
+    )
     with open(os.path.join(root, "metrics.prom"), "w") as f:
         f.write(metrics.expose())
 

@@ -137,6 +137,16 @@ class TunnelMap:
             self._dirty = True
             return ep
 
+    def snapshot(self) -> Dict[str, str]:
+        """prefix → dotted node IP, the `cilium bpf tunnel list`
+        shape (public, lock-taking — dump tooling must not reach into
+        the guarded internals)."""
+        with self._lock:
+            return {
+                prefix: str(ipaddress.ip_address(ep))
+                for prefix, ep in self._prefixes.items()
+            }
+
     def delete_tunnel_endpoint(self, prefix: str) -> None:
         with self._lock:
             self._prefixes.pop(prefix, None)

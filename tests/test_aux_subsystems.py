@@ -1,6 +1,7 @@
 """fqdn poller, ipam, completion, prefilter, health, bugtool."""
 
 import ipaddress
+import json
 import os
 
 import numpy as np
@@ -145,12 +146,28 @@ def test_bugtool_collect(tmp_path):
 
     from cilium_tpu import bugtool
 
+    from cilium_tpu.lb.service import L3n4Addr
+
     d = Daemon()
     d.create_endpoint(1, k8s_labels(app="x"), ipv4="10.0.0.1")
+    d.service_upsert(
+        L3n4Addr("10.250.2.2", 80), [L3n4Addr("10.0.0.1", 8080)]
+    )
     archive = bugtool.collect(d, str(tmp_path))
     assert os.path.exists(archive)
     with tarfile.open(archive) as tar:
         names = tar.getnames()
-    assert any("status.json" in n for n in names)
-    assert any("endpoints.json" in n for n in names)
-    assert any("metrics.prom" in n for n in names)
+        assert any("status.json" in n for n in names)
+        assert any("endpoints.json" in n for n in names)
+        assert any("metrics.prom" in n for n in names)
+        for extra in (
+            "services.json", "conntrack.json", "tunnel.json",
+            "controllers.json",
+        ):
+            assert any(n.endswith(extra) for n in names), extra
+        svc = json.load(
+            tar.extractfile(
+                next(n for n in names if n.endswith("services.json"))
+            )
+        )
+    assert svc and svc[0]["frontend"] == "10.250.2.2:80"
