@@ -146,6 +146,15 @@ class Daemon:
 
         self.selector_cache = SelectorCache()
         self.rule_index = RuleIndex()
+        # Serializes whole regeneration sweeps.  The selector cache and
+        # rule index are shared, version-keyed caches: a second sweep
+        # starting mid-flight would re-sync them to a NEWER identity
+        # universe than the first sweep's snapshot, so in-flight builds
+        # could resolve selectors against identities absent from the
+        # universe their tables are lowered onto (universe/table skew —
+        # the reference serializes the equivalent via the trigger +
+        # per-endpoint regeneration.Lock, policy.go:540-552).
+        self._regen_lock = threading.Lock()
         # endpoint selectors of rules changed since the last sweep;
         # None = a non-policy reason forced a full sweep
         self._pending_rule_selectors: Optional[list] = []
@@ -354,6 +363,10 @@ class Daemon:
         self.regenerate_all(", ".join(reasons) or "trigger")
 
     def regenerate_all(self, reason: str = "") -> int:
+        with self._regen_lock:
+            return self._regenerate_all_locked(reason)
+
+    def _regenerate_all_locked(self, reason: str = "") -> int:
         stats = SpanStats()
         stats.span("total").start()
         cache = self.identity_cache()
@@ -842,6 +855,9 @@ class Daemon:
 
     def status(self) -> Dict:
         version, tables, index = self.endpoint_manager.published()
+        build_fail_count, build_fail_last = (
+            self.endpoint_manager.build_failure_snapshot()
+        )
         return {
             "node": self.node_name,
             "policy_revision": self.repo.get_revision(),
@@ -853,6 +869,11 @@ class Daemon:
             "table_endpoints": len(index),
             "kvstore": "connected" if self.kvstore else "disabled",
             "clustermesh_clusters": self.clustermesh.num_connected(),
+            "build_failures": build_fail_count,
+            "last_build_failures": [
+                {"endpoint": e, "reason": r, "error": err}
+                for e, r, err in build_fail_last
+            ],
             "controllers": {
                 name: {
                     "success": s.success_count,

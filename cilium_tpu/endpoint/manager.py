@@ -20,7 +20,10 @@ import threading
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Callable, Dict, List, Optional, Tuple
 
+from cilium_tpu import logging as logfields
 from cilium_tpu.compiler.tables import FleetCompiler, PolicyTables
+from cilium_tpu.logging import get_logger
+from cilium_tpu.metrics import registry as metrics
 from cilium_tpu.endpoint.endpoint import (
     STATE_READY,
     STATE_REGENERATING,
@@ -28,6 +31,8 @@ from cilium_tpu.endpoint.endpoint import (
     Endpoint,
 )
 from cilium_tpu.identity import IdentityCache
+
+log = get_logger("endpoint-manager")
 
 
 class EndpointManager:
@@ -48,6 +53,11 @@ class EndpointManager:
         # incremental lowering: caches identity/slot tables and
         # per-endpoint rows across publishes (delta compilation)
         self._fleet_compiler = FleetCompiler()
+        # builder failure bookkeeping (endpoint.go's bpf.go:442 retry
+        # counter analog): (endpoint_id, reason, repr(exc)) of the
+        # most recent failed builds, surfaced via daemon status
+        self.build_failures = 0
+        self.last_build_failures: List[Tuple[int, str, str]] = []
 
     # -- registry (manager.go Insert/Lookup*) --------------------------------
 
@@ -152,7 +162,33 @@ class EndpointManager:
             for endpoint in eps
         ]
         wait(futures)
-        n = sum(1 for f in futures if not f.exception() and f.result())
+        n = 0
+        failures = []
+        for endpoint, f in zip(eps, futures):
+            exc = f.exception()
+            if exc is None:
+                n += 1 if f.result() else 0
+            else:
+                failures.append((endpoint.id, reason, repr(exc)))
+        metrics.endpoint_regenerations.inc("success", value=n)
+        if failures:
+            # a failed build must be LOUD, not a swallowed pool
+            # exception: count it, keep the last batch for status,
+            # and log — the endpoint itself already fell back to
+            # waiting-to-regenerate inside regenerate_endpoint
+            for ep_id, rsn, err in failures:
+                metrics.endpoint_regenerations.inc("fail")
+                log.error(
+                    "endpoint build failed",
+                    extra={"fields": {
+                        logfields.ENDPOINT_ID: ep_id,
+                        "reason": rsn,
+                        "error": err,
+                    }},
+                )
+            with self._lock:
+                self.build_failures += len(failures)
+                self.last_build_failures = failures
         self.publish_tables(identity_cache)
         return n
 
@@ -198,6 +234,12 @@ class EndpointManager:
     def published(self) -> Tuple[int, Optional[PolicyTables], Dict[int, int]]:
         with self._lock:
             return self._published
+
+    def build_failure_snapshot(self) -> Tuple[int, List[Tuple[int, str, str]]]:
+        """(total count, last batch) read atomically — the two fields
+        are updated together under the manager lock."""
+        with self._lock:
+            return self.build_failures, list(self.last_build_failures)
 
     def check_tables_current(self, tables) -> None:
         """See FleetCompiler.check_tables_current: raises if `tables`

@@ -1,0 +1,113 @@
+"""Regression: concurrent regeneration sweeps vs identity churn must
+never produce universe/table skew (a build resolving selectors against
+identities absent from the universe its tables are lowered onto).
+
+Round-4 symptom: `ValueError: identity N in map state but not in the
+identity universe` escaping the builder pool as an unhandled thread
+exception during tests/test_workloads.py.  Root cause: the shared
+selector cache / rule index are version-keyed; a second sweep starting
+mid-flight re-synced them to a newer identity universe than the first
+sweep's snapshot.  Daemon._regen_lock now serializes whole sweeps, and
+builder failures are surfaced in metrics + status instead of being
+swallowed by the pool.
+"""
+
+import threading
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.labels import Label, Labels
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+
+
+def _rule(app: str, team: str, port: int) -> Rule:
+    return Rule(
+        endpoint_selector=EndpointSelector(
+            match_labels={"k8s.app": app}
+        ),
+        ingress=[
+            IngressRule(
+                from_endpoints=[
+                    EndpointSelector(match_labels={"k8s.team": team})
+                ],
+                to_ports=[
+                    PortRule(
+                        ports=[
+                            PortProtocol(port=str(port), protocol="TCP")
+                        ]
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def test_concurrent_sweeps_and_identity_churn_no_skew():
+    d = Daemon(num_workers=4)
+    d.policy_trigger.close(wait=True)  # drive sweeps explicitly
+    for i in range(4):
+        d.create_endpoint(
+            100 + i,
+            Labels({"app": Label("app", f"app{i}", "k8s")}),
+            ipv4=f"10.9.0.{i + 1}",
+            name=f"ep{i}",
+        )
+    d.policy_add([_rule(f"app{i}", f"t{i % 3}", 4000 + i)
+                  for i in range(4)])
+    d.regenerate_all("seed")
+
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            labels = Labels(
+                {"team": Label("team", f"t{i % 7}", "k8s"),
+                 "n": Label("n", str(i), "k8s")}
+            )
+            try:
+                ident, _ = d.identity_allocator.allocate(labels)
+                d.policy_add([_rule(f"app{i % 4}", f"t{i % 7}",
+                                    5000 + (i % 100))])
+                d.regenerate_all(f"churn-{i}")
+                if i % 3 == 0:
+                    d.identity_allocator.release(ident)
+            except Exception as e:  # pragma: no cover - the bug
+                errors.append(e)
+                return
+            i += 1
+
+    def sweeper():
+        while not stop.is_set():
+            try:
+                d.regenerate_all("sweep")
+            except Exception as e:  # pragma: no cover - the bug
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=churn),
+        threading.Thread(target=sweeper),
+        threading.Thread(target=sweeper),
+    ]
+    for t in threads:
+        t.start()
+    # let the race window spin; pre-fix this reproduced the skew raise
+    # in a few hundred milliseconds
+    import time
+
+    time.sleep(3.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, f"sweep raised: {errors[:3]}"
+    # builds that DID fail must be loud, not swallowed
+    assert d.endpoint_manager.build_failures == 0, (
+        d.endpoint_manager.last_build_failures
+    )
