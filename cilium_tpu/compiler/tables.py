@@ -65,6 +65,137 @@ _COMPILER_NONCE = _itertools.count(1)
 
 NUM_DIRECTIONS = 2  # INGRESS, EGRESS
 
+# -- hashed L4 entry table ---------------------------------------------------
+# The exact and wildcard L4 probes gather from ONE bucketized entry
+# table instead of the dense [E, 2, Kg, W] bitmap: measured on v5e,
+# element gathers from >=128 MB tables run ~17 ns/flow while 128-lane
+# ROW gathers run ~7 ns regardless of row width, and the entry table
+# is proportional to the REALIZED map entries (the reference's
+# per-endpoint BPF hash maps are entry-proportional too,
+# pkg/maps/policymap) rather than E×Kg×identities.
+#
+# Row = one bucket of 42 planar 3-word entries:
+#   lanes [0, 42)   key0 = idx | dir << 22 | (ep & 0x1FF) << 23
+#   lanes [42, 84)  key1 = dport << 16 | proto << 8 | ep >> 9
+#   lanes [84, 126) value = j << 16 | proxy_port
+# Wildcard (identity 0) entries store idx = L4H_WILD_IDX.  Empty lanes
+# hold key1 = 0xFFFFFFFF, unreachable because ep >> 9 < 128 for any
+# endpoint index < 2^16 (the reference's endpoint-id cap).
+L4H_ENTRIES = 42
+L4H_WILD_IDX = np.uint32((1 << 22) - 1)
+L4H_STASH = 64
+# average entries per 42-capacity bucket row at build time; the
+# Poisson tail beyond 42 at lambda=16 is ~1e-8 per bucket, so the
+# stash is headroom, not a working set
+L4H_LOAD = 16
+
+
+def l4h_key0(idx, d, ep):
+    """Key word 0 of the hashed L4 probe.  Dtype-generic (np or jnp
+    arrays): build side and device probe MUST share this packing."""
+    return (
+        idx.astype(np.uint32)
+        | (d.astype(np.uint32) << np.uint32(22))
+        | ((ep.astype(np.uint32) & np.uint32(0x1FF)) << np.uint32(23))
+    )
+
+
+def l4h_key1(dport, proto, ep):
+    """Key word 1 (see l4h_key0)."""
+    return (
+        (dport.astype(np.uint32) << np.uint32(16))
+        | (proto.astype(np.uint32) << np.uint32(8))
+        | (ep.astype(np.uint32) >> np.uint32(9))
+    )
+
+
+def build_l4_hash(
+    ep: np.ndarray,
+    d: np.ndarray,
+    idx: np.ndarray,
+    dport: np.ndarray,
+    proto: np.ndarray,
+    value: np.ndarray,
+    min_rows: int = 64,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized bucket placement of T entries → (rows u32 [R, 128],
+    stash u32 [L4H_STASH, 3]).  R is a power of two sized for ~16
+    entries per 42-capacity row; rows double until the overflow fits
+    the stash (never in practice — the tail is Poisson)."""
+    t = len(ep)
+    if np.any((idx >= L4H_WILD_IDX) & (idx != L4H_WILD_IDX)):
+        raise ValueError("identity index exceeds 22-bit hash key space")
+    if t and int(ep.max()) >= 65536:
+        # the empty-lane marker relies on ep >> 9 < 128; the reference
+        # caps endpoint ids at 65535 too (pkg/endpoint/endpoint.go)
+        raise ValueError("endpoint axis exceeds the 16-bit key space")
+    w0 = l4h_key0(idx, d, ep)
+    w1 = l4h_key1(dport, proto, ep)
+    h = _fnv1a_host_2(w0, w1)
+    n_rows = _pow2_at_least(max(t // L4H_LOAD, 1), min_rows)
+    while True:
+        b = (h & np.uint32(n_rows - 1)).astype(np.int64)
+        order = np.argsort(b, kind="stable")
+        sb = b[order]
+        first = np.searchsorted(sb, sb)
+        rank = np.arange(t, dtype=np.int64) - first
+        main = rank < L4H_ENTRIES
+        if int((~main).sum()) <= L4H_STASH:
+            break
+        n_rows <<= 1
+    rows = np.zeros((n_rows, 128), dtype=np.uint32)
+    rows[:, L4H_ENTRIES : 2 * L4H_ENTRIES] = np.uint32(0xFFFFFFFF)
+    flat = rows.reshape(-1)
+    # `main`/`rank` index SORTED positions; `order` maps them back
+    mo = order[main]
+    base = sb[main] * 128 + rank[main]
+    flat[base] = w0[mo]
+    flat[base + L4H_ENTRIES] = w1[mo]
+    flat[base + 2 * L4H_ENTRIES] = value[mo]
+    stash = np.zeros((L4H_STASH, 3), dtype=np.uint32)
+    stash[:, 1] = np.uint32(0xFFFFFFFF)
+    so = order[~main]
+    stash[: len(so), 0] = w0[so]
+    stash[: len(so), 1] = w1[so]
+    stash[: len(so), 2] = value[so]
+    return rows, stash
+
+
+def build_l4_hash_pair(
+    ep: np.ndarray,
+    d: np.ndarray,
+    idx: np.ndarray,
+    dport: np.ndarray,
+    proto: np.ndarray,
+    value: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Partition entries into the main (exact) and wildcard tables:
+    (rows, stash, wild_rows, wild_stash)."""
+    wild = idx == L4H_WILD_IDX
+    keep = ~wild
+    rows, stash = build_l4_hash(
+        ep[keep], d[keep], idx[keep], dport[keep], proto[keep],
+        value[keep],
+    )
+    wrows, wstash = build_l4_hash(
+        ep[wild], d[wild], idx[wild], dport[wild], proto[wild],
+        value[wild], min_rows=16,
+    )
+    return rows, stash, wrows, wstash
+
+
+def _fnv1a_host_2(w0: np.ndarray, w1: np.ndarray) -> np.ndarray:
+    """FNV-1a over two u32 word columns (avoids the [T, 2] stack)."""
+    from cilium_tpu.engine.hashtable import FNV_OFFSET, FNV_PRIME
+
+    h = np.full(len(w0), FNV_OFFSET, dtype=np.uint64)
+    prime = np.uint64(int(FNV_PRIME))
+    for col in (w0, w1):
+        c = col.astype(np.uint64)
+        for shift in (0, 8, 16, 24):
+            h = ((h ^ ((c >> shift) & 0xFF)) * prime) & 0xFFFFFFFF
+    return h.astype(np.uint32)
+
 
 def _round_up(n: int, mult: int) -> int:
     return max(mult, ((n + mult - 1) // mult) * mult)
@@ -116,13 +247,21 @@ class PolicyTables:
     # survives device_put/flatten round trips without becoming a jit
     # cache key; 0 = unstamped (hand-built tables)
     generation: np.ndarray = np.uint64(0)
-    # fused exact+meta probe table, u32 [E, 2, Kg, 2W]: high half =
-    # allow bits for 16 identities (word16 = idx >> 4), low half =
-    # l4_meta (proxy << 1 | wild, requiring proxy < 2^15) — ONE gather
-    # answers the exact probe AND the slot metadata (random gathers
-    # are the datapath's unit of cost on TPU).  None when some proxy
-    # port exceeds 15 bits; the kernel then falls back to two gathers.
-    l4_combined: "np.ndarray | None" = None
+    # hashed L4 entry tables (see build_l4_hash): the exact and
+    # wildcard probes are each ONE 128-lane row gather from an
+    # entry-proportional table instead of element gathers from the
+    # dense bitmap — on v5e row gathers run ~2x faster than big-table
+    # element gathers.  Wildcard (identity 0) entries live in their
+    # own SMALL table: they are per-(ep, dir, port, proto), so the
+    # table stays a few KB and the second gather per flow hits a hot
+    # region instead of paying the big-table random-access cost
+    # again.  None → the kernel falls back to the dense
+    # l4_allow_bits/l4_meta path (the layout the table-axis-sharded
+    # mesh evaluator uses).
+    l4_hash_rows: "np.ndarray | None" = None
+    l4_hash_stash: "np.ndarray | None" = None
+    l4_wild_rows: "np.ndarray | None" = None
+    l4_wild_stash: "np.ndarray | None" = None
 
     @property
     def num_endpoints(self) -> int:
@@ -147,7 +286,10 @@ class PolicyTables:
                 self.l4_allow_bits,
                 self.l3_allow_bits,
                 self.generation,
-                self.l4_combined,
+                self.l4_hash_rows,
+                self.l4_hash_stash,
+                self.l4_wild_rows,
+                self.l4_wild_stash,
             ),
             None,
         )
@@ -213,26 +355,6 @@ def _build_direct_index(id_table: np.ndarray) -> Tuple[np.ndarray, int]:
     return id_direct, lo_len
 
 
-def build_l4_combined(
-    l4_allow_bits: np.ndarray, l4_meta: np.ndarray
-) -> "np.ndarray | None":
-    """Derive the fused exact+meta probe table: u32 [E, 2, Kg, 2W]
-    where entry [..., j, 2w + h] = (allow bits for identities
-    [32w + 16h, 32w + 16h + 16) << 16) | l4_meta[..., j].  Returns
-    None (kernel falls back to two gathers) if any proxy port needs
-    more than 15 bits."""
-    if (l4_meta >> 16).any():
-        return None
-    lo = (l4_allow_bits & np.uint32(0xFFFF)).astype(np.uint32)
-    hi = (l4_allow_bits >> np.uint32(16)).astype(np.uint32)
-    e, d, kg, w = l4_allow_bits.shape
-    combined = np.empty((e, d, kg, 2 * w), dtype=np.uint32)
-    combined[..., 0::2] = lo << np.uint32(16)
-    combined[..., 1::2] = hi << np.uint32(16)
-    combined |= l4_meta[..., None].astype(np.uint32)
-    return combined
-
-
 def lower_map_state(
     states: Sequence[PolicyMapState],
     id_table: np.ndarray,
@@ -247,6 +369,11 @@ def lower_map_state(
     equivalent of pkg/alignchecker.
     """
     n = id_table.shape[0]
+    if n >= int(L4H_WILD_IDX):
+        raise ValueError(
+            "identity axis too large for the hashed L4 probe "
+            f"(n={n}, cap={int(L4H_WILD_IDX)})"
+        )
     w = n // 32
     id_index: Dict[int, int] = {}
     for i, v in enumerate(id_table.tolist()):
@@ -294,6 +421,14 @@ def lower_map_state(
     # diverging from the per-entry oracle.
     proxy_seen: Dict[Tuple[int, int, int], int] = {}
 
+    # hashed entry-table columns (one row per non-L3 map entry)
+    h_ep: List[int] = []
+    h_d: List[int] = []
+    h_idx: List[int] = []
+    h_dport: List[int] = []
+    h_proto: List[int] = []
+    h_val: List[int] = []
+
     for e, state in enumerate(states):
         for key, entry in state.items():
             d = key.traffic_direction
@@ -312,12 +447,27 @@ def lower_map_state(
             l4_meta[e, d, j] |= np.uint32(entry.proxy_port << 1)
             if key.identity == 0:
                 l4_meta[e, d, j] |= np.uint32(1)
+                idx = int(L4H_WILD_IDX)
             else:
                 idx = _id_idx(key.identity)
                 l4_allow_bits[e, d, j, idx >> 5] |= np.uint32(
                     1 << (idx & 31)
                 )
+            h_ep.append(e)
+            h_d.append(d)
+            h_idx.append(idx)
+            h_dport.append(key.dest_port)
+            h_proto.append(key.nexthdr)
+            h_val.append((j << 16) | entry.proxy_port)
 
+    rows, stash, wrows, wstash = build_l4_hash_pair(
+        np.asarray(h_ep, np.uint32),
+        np.asarray(h_d, np.uint32),
+        np.asarray(h_idx, np.uint32),
+        np.asarray(h_dport, np.uint32),
+        np.asarray(h_proto, np.uint32),
+        np.asarray(h_val, np.uint32),
+    )
     return PolicyTables(
         id_table=id_table,
         id_direct=id_direct,
@@ -326,7 +476,10 @@ def lower_map_state(
         l4_meta=l4_meta,
         l4_allow_bits=l4_allow_bits,
         l3_allow_bits=l3_allow_bits,
-        l4_combined=build_l4_combined(l4_allow_bits, l4_meta),
+        l4_hash_rows=rows,
+        l4_hash_stash=stash,
+        l4_wild_rows=wrows,
+        l4_wild_stash=wstash,
     )
 
 
@@ -457,6 +610,11 @@ class FleetCompiler:
         if not self._id_tables_dirty and self._id_table is not None:
             return
         n = self._padded_n()
+        if n >= int(L4H_WILD_IDX):
+            raise ValueError(
+                "identity axis too large for the hashed L4 probe "
+                f"(n={n}, cap={int(L4H_WILD_IDX)})"
+            )
         table = np.full((n,), PAD_ID, dtype=np.uint32)
         table[: len(self._id_list)] = np.asarray(
             self._id_list, dtype=np.uint32
@@ -562,8 +720,18 @@ class FleetCompiler:
         l4 = np.zeros((2, kg, w), dtype=np.uint32)
         l3 = np.zeros((2, w), dtype=np.uint32)
         m = len(state.keys_packed)
+        empty_ent = {
+            "d": np.zeros(0, np.uint32),
+            "idx": np.zeros(0, np.uint32),
+            "dport": np.zeros(0, np.uint32),
+            "proto": np.zeros(0, np.uint32),
+            "val": np.zeros(0, np.uint32),
+        }
         if m == 0:
-            return {"kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3}
+            return {
+                "kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3,
+                "ent": empty_ent,
+            }
 
         ident, dport, proto, d = unpack_keys(state.keys_packed)
         l3_mask = (dport == 0) & (proto == 0)
@@ -602,6 +770,7 @@ class FleetCompiler:
 
         # -- L4 slots -----------------------------------------------------
         sel4 = ~l3_mask
+        ent = empty_ent
         if sel4.any():
             sorted_pairs, order = self._slot_pair_lut()
             pair = (dport[sel4].astype(np.int64) << 8) | proto[sel4]
@@ -641,7 +810,24 @@ class FleetCompiler:
                     (dj_all[setbits]) * w + word[setbits],
                     bit[setbits],
                 )
-        return {"kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3}
+            # hashed-probe entry columns (ep bits added at stack time
+            # — the endpoint's stack position is not known here)
+            ent_idx = idx[sel4].copy()
+            ent_idx[wild_mask[sel4]] = L4H_WILD_IDX
+            ent = {
+                "d": d[sel4].astype(np.uint32),
+                "idx": ent_idx.astype(np.uint32),
+                "dport": dport[sel4].astype(np.uint32),
+                "proto": proto[sel4].astype(np.uint32),
+                "val": (
+                    (j.astype(np.uint32) << np.uint32(16))
+                    | proxy4.astype(np.uint32)
+                ),
+            }
+        return {
+            "kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3,
+            "ent": ent,
+        }
 
     def _lower_rows(self, state: PolicyMapState) -> dict:
         if isinstance(state, MapStateArrays):
@@ -653,6 +839,11 @@ class FleetCompiler:
         l4 = np.zeros((2, kg, w), dtype=np.uint32)
         l3 = np.zeros((2, w), dtype=np.uint32)
         proxy_seen: Dict[Tuple[int, int], int] = {}
+        h_d: List[int] = []
+        h_idx: List[int] = []
+        h_dport: List[int] = []
+        h_proto: List[int] = []
+        h_val: List[int] = []
         for key, entry in state.items():
             d = key.traffic_direction
             if key.is_l3_only():
@@ -675,6 +866,7 @@ class FleetCompiler:
             meta[d, j] |= np.uint32(entry.proxy_port << 1)
             if key.identity == 0:
                 meta[d, j] |= np.uint32(1)
+                idx = int(L4H_WILD_IDX)
             else:
                 idx = self._id_index.get(key.identity)
                 if idx is None:
@@ -683,7 +875,22 @@ class FleetCompiler:
                         f"in the identity universe (universe/table skew)"
                     )
                 l4[d, j, idx >> 5] |= np.uint32(1 << (idx & 31))
-        return {"kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3}
+            h_d.append(d)
+            h_idx.append(idx)
+            h_dport.append(key.dest_port)
+            h_proto.append(key.nexthdr)
+            h_val.append((j << 16) | entry.proxy_port)
+        ent = {
+            "d": np.asarray(h_d, np.uint32),
+            "idx": np.asarray(h_idx, np.uint32),
+            "dport": np.asarray(h_dport, np.uint32),
+            "proto": np.asarray(h_proto, np.uint32),
+            "val": np.asarray(h_val, np.uint32),
+        }
+        return {
+            "kg": kg, "w": w, "meta": meta, "l4": l4, "l3": l3,
+            "ent": ent,
+        }
 
     @staticmethod
     def _pad_rows(rows: dict, kg: int, w: int) -> dict:
@@ -759,6 +966,9 @@ class FleetCompiler:
             l4_bits = np.zeros((1, 2, kg, w), dtype=np.uint32)
             l3_bits = np.zeros((1, 2, w), dtype=np.uint32)
 
+        hash_rows, hash_stash, wild_rows, wild_stash = (
+            self._build_hash(order)
+        )
         tables = PolicyTables(
             id_table=self._id_table,
             id_direct=self._id_direct,
@@ -767,7 +977,10 @@ class FleetCompiler:
             l4_meta=l4_meta,
             l4_allow_bits=l4_bits,
             l3_allow_bits=l3_bits,
-            l4_combined=build_l4_combined(l4_bits, l4_meta),
+            l4_hash_rows=hash_rows,
+            l4_hash_stash=hash_stash,
+            l4_wild_rows=wild_rows,
+            l4_wild_stash=wild_stash,
         )
         self._generation += 1
         tables.generation = np.uint64(
@@ -801,6 +1014,29 @@ class FleetCompiler:
                 f"{self._generation - gen} publishes old (max 1 — "
                 f"double-buffered rows have been overwritten)"
             )
+
+    def _build_hash(self, order: List[int]):
+        """Concatenate every endpoint's cached entry columns (adding
+        the stack-position ep bits, which are only known here) and
+        place them into the hashed probe table.  O(total entries) with
+        vectorized hashing/placement — ~0.5 s for 4M entries."""
+        ents = [self._rows[ep_id]["ent"] for ep_id in order]
+        if not ents:
+            return build_l4_hash_pair(*([np.zeros(0, np.uint32)] * 6))
+        ep = np.concatenate(
+            [
+                np.full(len(e["d"]), i, np.uint32)
+                for i, e in enumerate(ents)
+            ]
+        )
+        cat = {
+            k: np.concatenate([e[k] for e in ents])
+            for k in ("d", "idx", "dport", "proto", "val")
+        }
+        return build_l4_hash_pair(
+            ep, cat["d"], cat["idx"], cat["dport"], cat["proto"],
+            cat["val"],
+        )
 
     def _stacked(self, order: List[int], kg: int, w: int):
         """Write rows into the standby stacked buffer, copying only

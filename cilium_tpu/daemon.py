@@ -488,6 +488,18 @@ class Daemon:
         from cilium_tpu.kvstore.ipsync import upsert_ip_mapping
 
         with self.lock:
+            # id 0 = "agent allocates": pick a free id instead of the
+            # caller deriving one (a hash-derived id collides at
+            # birthday rates and surfaces as a permanent ADD failure;
+            # the reference's endpointmanager allocates too).
+            # Idempotency still holds: a named re-create finds the
+            # live endpoint by name before allocating.
+            if endpoint_id == 0:
+                if name:
+                    existing = self.endpoint_manager.lookup_name(name)
+                    if existing is not None:
+                        return existing
+                endpoint_id = self._allocate_endpoint_id()
             # check-then-act under the daemon lock: the API server is
             # thread-per-connection, and two concurrent ADD retries
             # racing past the existence guard would double-allocate
@@ -595,6 +607,25 @@ class Daemon:
         )
         return True
 
+    # next candidate for agent-allocated endpoint ids; ids live in
+    # u16 space above the reserved low range, like the reference's
+    # endpointmanager allocation (pkg/endpointmanager)
+    _EP_ID_BASE = 256
+    _next_ep_id = 256
+
+    def _allocate_endpoint_id(self) -> int:
+        """Pick a free endpoint id (caller holds self.lock)."""
+        span = 65536 - self._EP_ID_BASE
+        for _ in range(span):
+            candidate = self._next_ep_id
+            self._next_ep_id = (
+                self._EP_ID_BASE
+                + (candidate + 1 - self._EP_ID_BASE) % span
+            )
+            if self.endpoint_manager.lookup(candidate) is None:
+                return candidate
+        raise EndpointConflict("endpoint id space exhausted")
+
     def delete_endpoint(
         self, endpoint_id: int, expected_name: Optional[str] = None
     ) -> bool:
@@ -609,7 +640,13 @@ class Daemon:
         from cilium_tpu.kvstore.ipsync import delete_ip_mapping
 
         with self.lock:
-            endpoint = self.endpoint_manager.lookup(endpoint_id)
+            if endpoint_id == 0 and expected_name:
+                # agent-allocated ids: the caller only knows the name
+                endpoint = self.endpoint_manager.lookup_name(
+                    expected_name
+                )
+            else:
+                endpoint = self.endpoint_manager.lookup(endpoint_id)
             if endpoint is None:
                 return False
             if (

@@ -81,6 +81,10 @@ class EndpointManager:
         with self._lock:
             return self.by_id.get(endpoint_id)
 
+    def lookup_name(self, name: str) -> Optional[Endpoint]:
+        with self._lock:
+            return self.by_name.get(name)
+
     def endpoints(self) -> List[Endpoint]:
         with self._lock:
             return list(self.by_id.values())

@@ -53,7 +53,13 @@ def table_specs(batch_axis: str, table_axis: str) -> PolicyTables:
         l4_allow_bits=P(None, None, None, table_axis),
         l3_allow_bits=P(None, None, table_axis),
         generation=P(),
-        l4_combined=P(None, None, None, table_axis),
+        # the hashed entry table is a single-chip layout (row buckets
+        # mix all identities); the table-sharded evaluator replicates
+        # it untouched and probes the dense sharded bitmap instead
+        l4_hash_rows=P(),
+        l4_hash_stash=P(),
+        l4_wild_rows=P(),
+        l4_wild_stash=P(),
     )
 
 
@@ -92,7 +98,7 @@ def make_mesh_evaluator(
         # Index resolution uses only replicated tables → global values.
         idx, word, bit, known, j, has_port = _index(tables_l, batch_l)
         # slot metadata from the replicated l4_meta (the fused
-        # single-chip path reads it from l4_combined instead)
+        # single-chip path reads it from the hashed entry table)
         meta = tables_l.l4_meta[batch_l.ep_index, batch_l.direction, j]
         proxy = (meta >> 1).astype(jnp.int32)
         wild = (meta & 1).astype(bool)

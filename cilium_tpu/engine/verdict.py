@@ -132,26 +132,15 @@ class Verdicts:
         return cls(*children)
 
 
-def _index(tables: PolicyTables, batch: TupleBatch):
-    """Index resolution: O(1) direct-table gathers only.
-
-    Returns (idx, word, bit, known, j, has_port, proxy, wild) — the
-    global identity index / bit position and the global L4 slot of
-    each tuple, all derived from small replicated tables (no touch of
-    the big allow-bit tensors, so the identity-sharded path can reuse
-    this and offset `word` per shard).
-    """
-    from cilium_tpu.compiler.tables import (
-        LOCAL_ID_BASE,
-        NO_INDEX,
-        NO_SLOT,
-    )
+def _index_identity(tables: PolicyTables, batch: TupleBatch):
+    """Identity half of index resolution: raw u32 id → dense index
+    (1 gather from the small direct table).  Returns (idx, known)."""
+    from cilium_tpu.compiler.tables import LOCAL_ID_BASE, NO_INDEX
 
     n = tables.id_table.shape[0]
     direct_sz = tables.id_direct.shape[0]
     lo_len = tables.id_lo_len.astype(jnp.uint32)
 
-    # -- identity probe: raw u32 id → dense index (1 gather) ----------------
     # id_direct is two dense regions: [0, lo_len) for cluster-scope
     # ids, [lo_len, end) for local CIDR ids offset by LOCAL_ID_BASE.
     ident = batch.identity.astype(jnp.uint32)
@@ -167,13 +156,28 @@ def _index(tables: PolicyTables, batch: TupleBatch):
     v = tables.id_direct[pos]
     known = in_range & (v != jnp.uint32(NO_INDEX))
     idx = jnp.where(known, v, jnp.uint32(n - 1)).astype(jnp.int32)
+    return idx, known
+
+
+def _index(tables: PolicyTables, batch: TupleBatch):
+    """Index resolution: O(1) direct-table gathers only.
+
+    Returns (idx, word, bit, known, j, has_port) — the global identity
+    index / bit position and the global L4 slot of each tuple, all
+    derived from small replicated tables (no touch of the big
+    allow-bit tensors, so the identity-sharded path can reuse this and
+    offset `word` per shard)."""
+    from cilium_tpu.compiler.tables import NO_SLOT
+
+    idx, known = _index_identity(tables, batch)
     word = idx >> 5
     bit = (idx & 31).astype(jnp.uint32)
 
     # -- L4 key probe: (proto, dport) → global slot (1 gather) --------------
     # port_slot is indexed by the RAW proto byte (one 65536-entry row
-    # per proto, 32 MB): trading memory for one fewer gather per tuple
-    # (marginal gather ≈ 7 ms per 1M tuples on v5e).
+    # per proto, 32 MB); only the identity-sharded mesh evaluator
+    # still probes through it — the single-chip kernels resolve the
+    # slot from the hashed entry table's value word instead.
     proto = jnp.clip(batch.proto, 0, 255).astype(jnp.int32)
     dport = jnp.clip(batch.dport, 0, 65535).astype(jnp.int32)
     slot16 = tables.port_slot[proto, dport]
@@ -182,53 +186,112 @@ def _index(tables: PolicyTables, batch: TupleBatch):
     return idx, word, bit, known, j, has_port
 
 
+def _l4hash_probe(hash_rows, hash_stash, ep, dirn, idx, dport, proto):
+    """One probe of a hashed L4 entry table: a single 128-lane row
+    gather + lane compares (+ a small stash broadcast).  Returns
+    (hit bool [B], value u32 [B] = j << 16 | proxy_port)."""
+    from cilium_tpu.compiler.tables import (
+        L4H_ENTRIES,
+        l4h_key0,
+        l4h_key1,
+    )
+    from cilium_tpu.engine.hashtable import fnv1a_device
+
+    e = L4H_ENTRIES
+    # the key packing helpers are dtype-generic — build side and
+    # probe side MUST stay one implementation
+    w0 = l4h_key0(idx, dirn, ep)
+    w1 = l4h_key1(dport, proto, ep)
+    h = fnv1a_device(jnp.stack([w0, w1], axis=1))
+    n_rows = hash_rows.shape[0]
+    b = (h & jnp.uint32(n_rows - 1)).astype(jnp.int32)
+    rows = jnp.asarray(hash_rows)[b]  # [B, 128] — 1 gather
+    hit = (rows[:, :e] == w0[:, None]) & (
+        rows[:, e : 2 * e] == w1[:, None]
+    )
+    val = jnp.sum(
+        jnp.where(hit, rows[:, 2 * e : 3 * e], 0),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    stash = jnp.asarray(hash_stash)
+    s_hit = (stash[None, :, 0] == w0[:, None]) & (
+        stash[None, :, 1] == w1[:, None]
+    )
+    val = val + jnp.sum(
+        jnp.where(s_hit, stash[None, :, 2], 0), axis=1, dtype=jnp.uint32
+    )
+    found = jnp.any(hit, axis=1) | jnp.any(s_hit, axis=1)
+    return found, val
+
+
 def _probes(tables: PolicyTables, batch: TupleBatch, idx_known=None):
     """The three map probes of policy.h:46, vectorized.  Returns
     (probe1, probe2, probe3, proxy, j, idx).
 
-    With `l4_combined` present (half-word layout: allow bits for 16
-    identities in the high half, slot meta in the low half), the exact
-    probe and the slot metadata are ONE gather; otherwise they are the
-    classic two.  `idx_known=(idx, known[, l3_bit])` supplies a
-    pre-resolved identity index (e.g. from an idx-form ipcache) and
-    skips the id_direct gather; with `l3_bit` (the identity's
-    per-endpoint L3-allow bit, from an l3-plane ipcache) the L3 probe
-    gather disappears too."""
+    With the hashed entry table present (the FleetCompiler always
+    builds it), the exact probe and the wildcard probe are each ONE
+    row gather; the slot index for counters and the proxy port ride
+    in the matched entry's value word, so neither port_slot nor the
+    dense bitmap is touched.  `idx_known=(idx, known[, l3_bit])`
+    supplies a pre-resolved identity index (e.g. from an idx-form
+    ipcache) and skips the id_direct gather; with `l3_bit` (the
+    identity's per-endpoint L3-allow bit, from an l3-plane ipcache)
+    the L3 probe gather disappears too."""
+    from cilium_tpu.compiler.tables import L4H_WILD_IDX
+
     l3_bit = None
     if idx_known is not None:
         idx, known = idx_known[0], idx_known[1]
         if len(idx_known) > 2:
             l3_bit = idx_known[2]
-        word = idx >> 5
-        bit = (idx & 31).astype(jnp.uint32)
-        proto = jnp.clip(batch.proto, 0, 255).astype(jnp.int32)
-        dport = jnp.clip(batch.dport, 0, 65535).astype(jnp.int32)
+    else:
+        idx, known = _index_identity(tables, batch)
+    word = idx >> 5
+    bit = (idx & 31).astype(jnp.uint32)
+    proto = jnp.clip(batch.proto, 0, 255).astype(jnp.int32)
+    dport = jnp.clip(batch.dport, 0, 65535).astype(jnp.int32)
+
+    if tables.l4_hash_rows is not None:
+        # -- probes 1+3: two row gathers from the hashed entry table ----
+        # (an unknown identity resolves to the in-range fallback idx
+        # and probe1 is masked by `known`; a real idx never equals the
+        # wildcard sentinel — the compilers bound the identity axis
+        # below L4H_WILD_IDX)
+        hit1, val1 = _l4hash_probe(
+            tables.l4_hash_rows, tables.l4_hash_stash,
+            batch.ep_index, batch.direction,
+            idx.astype(jnp.uint32), dport, proto,
+        )
+        wild_idx = jnp.full(
+            idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
+        )
+        hit3, val3 = _l4hash_probe(
+            tables.l4_wild_rows, tables.l4_wild_stash,
+            batch.ep_index, batch.direction, wild_idx,
+            dport, proto,
+        )
+        probe1 = known & hit1
+        probe3 = hit3
+        val = jnp.where(probe1, val1, val3)
+        proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        j = (val >> jnp.uint32(16)).astype(jnp.int32)
+    else:
+        # dense fallback (hand-built tables without the hash)
         from cilium_tpu.compiler.tables import NO_SLOT
 
         slot16 = tables.port_slot[proto, dport]
         has_port = slot16 != jnp.uint16(NO_SLOT)
         j = jnp.where(has_port, slot16, 0).astype(jnp.int32)
-    else:
-        idx, word, bit, known, j, has_port = _index(tables, batch)
-
-    if tables.l4_combined is not None:
-        # -- probes 1+meta fused: one u32 gather ----------------------------
-        word16 = idx >> 4
-        bit16 = (idx & 15).astype(jnp.uint32)
-        cm = tables.l4_combined[
-            batch.ep_index, batch.direction, j, word16
-        ]
-        exact_bit = ((cm >> (jnp.uint32(16) + bit16)) & 1).astype(bool)
-        meta = cm & jnp.uint32(0xFFFF)
-    else:
         exact_words = tables.l4_allow_bits[
             batch.ep_index, batch.direction, j, word
         ]
         exact_bit = ((exact_words >> bit) & 1).astype(bool)
         meta = tables.l4_meta[batch.ep_index, batch.direction, j]
-    proxy = (meta >> 1).astype(jnp.int32)
-    wild = (meta & 1).astype(bool)
-    probe1 = known & has_port & exact_bit
+        proxy = (meta >> 1).astype(jnp.int32)
+        wild = (meta & 1).astype(bool)
+        probe1 = known & has_port & exact_bit
+        probe3 = has_port & wild
 
     # -- probe 2: L3-only (identity, 0, 0) ----------------------------------
     if l3_bit is not None:
@@ -238,9 +301,6 @@ def _probes(tables: PolicyTables, batch: TupleBatch, idx_known=None):
             batch.ep_index, batch.direction, word
         ]
         probe2 = known & ((l3_words >> bit) & 1).astype(bool)
-
-    # -- probe 3: wildcard (0, dport, proto) --------------------------------
-    probe3 = has_port & wild
 
     return probe1, probe2, probe3, proxy, j, idx
 
@@ -379,7 +439,10 @@ def make_sharded_evaluator(mesh: Optional[jax.sharding.Mesh] = None,
         l4_allow_bits=replicated,
         l3_allow_bits=replicated,
         generation=replicated,
-        l4_combined=replicated,
+        l4_hash_rows=replicated,
+        l4_hash_stash=replicated,
+        l4_wild_rows=replicated,
+        l4_wild_stash=replicated,
     )
     batch_shardings = TupleBatch(
         ep_index=batch_sharded,

@@ -47,6 +47,36 @@ SVC_PER_BUCKET = BUCKET_LANES // SVC_ENTRY_WORDS  # 32
 SVC_STASH = 64
 _EMPTY_W1 = np.uint32(0xFFFFFFFF)  # dport<<16|proto can't be all-ones
 
+# -- inline layout (the default): service + backends in ONE row -------------
+# Each 128-lane row holds two 64-lane service slots:
+#   lane 0 = vip, lane 1 = dport << 16 | proto,
+#   lane 2 = rev_nat << 16 | backend count, lane 3 = pad,
+#   lanes [4, 44)  = backend ips (40),
+#   lanes [44, 64) = backend ports, two per lane (low half = even).
+# One row gather resolves service AND backends; the separate backend-
+# row gather of the classic layout (~7 ns/flow on v5e) disappears.
+# Services with more than INLINE_MAX_BACKENDS fall back to the classic
+# two-gather LBTables at compile time.
+INLINE_MAX_BACKENDS = 40
+INLINE_SLOT = 64
+INLINE_STASH = 8
+
+
+@dataclass
+class LBInline:
+    """Inline service rows + small stash (pytree)."""
+
+    rows: np.ndarray  # u32 [R, 128] — two 64-lane service slots per row
+    stash: np.ndarray  # u32 [INLINE_STASH, 64]
+    n_buckets: int
+
+    def tree_flatten(self):
+        return ((self.rows, self.stash), self.n_buckets)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
 
 @dataclass
 class LBTables:
@@ -72,11 +102,12 @@ def _register_pytree() -> None:
     try:
         import jax
 
-        jax.tree_util.register_pytree_node(
-            LBTables,
-            lambda t: t.tree_flatten(),
-            lambda aux, ch: LBTables.tree_unflatten(aux, ch),
-        )
+        for cls in (LBTables, LBInline):
+            jax.tree_util.register_pytree_node(
+                cls,
+                lambda t: t.tree_flatten(),
+                lambda aux, ch, c=cls: c.tree_unflatten(aux, ch),
+            )
     except Exception:  # pragma: no cover
         pass
 
@@ -84,7 +115,81 @@ def _register_pytree() -> None:
 _register_pytree()
 
 
-def compile_lb(mgr: ServiceManager) -> LBTables:
+def _svc_slot(svc) -> np.ndarray:
+    """Pack one service into a 64-lane inline slot."""
+    slot = np.zeros(INLINE_SLOT, dtype=np.uint32)
+    slot[0] = svc.frontend.ip_u32()
+    slot[1] = ((svc.frontend.port & 0xFFFF) << 16) | (
+        svc.frontend.protocol & 0xFF
+    )
+    slot[2] = ((svc.id & 0xFFFF) << 16) | (len(svc.backends) & 0xFFFF)
+    for j, backend in enumerate(svc.backends):
+        slot[4 + j] = backend.addr.ip_u32()
+        slot[4 + INLINE_MAX_BACKENDS + (j >> 1)] |= np.uint32(
+            (backend.addr.port & 0xFFFF) << (16 * (j & 1))
+        )
+    return slot
+
+
+def compile_lb_inline(mgr: ServiceManager) -> "LBInline | None":
+    """Inline single-gather layout; None if any service exceeds the
+    inline backend budget (caller falls back to compile_lb)."""
+    services = sorted(mgr.by_frontend.values(), key=lambda s: s.id)
+    if any(len(s.backends) > INLINE_MAX_BACKENDS for s in services):
+        return None
+    nb = 16
+    while nb < len(services):
+        nb *= 2
+    # identical full-hash frontends never separate by doubling; cap
+    # the growth and fall back to the classic layout (32 per bucket +
+    # larger stash) instead of doubling unboundedly
+    nb_cap = max(nb * 64, 1 << 12)
+    while nb <= nb_cap:
+        rows = np.zeros((nb, BUCKET_LANES), dtype=np.uint32)
+        rows[:, 1] = _EMPTY_W1
+        rows[:, INLINE_SLOT + 1] = _EMPTY_W1
+        stash = np.zeros((INLINE_STASH, INLINE_SLOT), dtype=np.uint32)
+        stash[:, 1] = _EMPTY_W1
+        fill = [0] * nb
+        stash_fill = 0
+        ok = True
+        for svc in services:
+            vip = svc.frontend.ip_u32()
+            w1 = ((svc.frontend.port & 0xFFFF) << 16) | (
+                svc.frontend.protocol & 0xFF
+            )
+            words = np.array([[vip, w1]], dtype=np.uint32)
+            b = int(_fnv1a_host(words)[0]) & (nb - 1)
+            if fill[b] < 2:
+                rows[b, fill[b] * INLINE_SLOT : (fill[b] + 1) * INLINE_SLOT] = (
+                    _svc_slot(svc)
+                )
+                fill[b] += 1
+            elif stash_fill < INLINE_STASH:
+                stash[stash_fill] = _svc_slot(svc)
+                stash_fill += 1
+            else:
+                ok = False
+                break
+        if ok:
+            return LBInline(rows=rows, stash=stash, n_buckets=nb)
+        nb *= 2
+    return None  # pathological hash collisions: caller uses classic
+
+
+def compile_lb(mgr: ServiceManager):
+    """Compile the service map for the datapath: the inline
+    single-gather layout when every service fits the inline backend
+    budget (the overwhelmingly common case — the classic layout costs
+    a second dependent row gather per flow), else the classic
+    bucketized layout with separate backend rows."""
+    inline = compile_lb_inline(mgr)
+    if inline is not None:
+        return inline
+    return compile_lb_classic(mgr)
+
+
+def compile_lb_classic(mgr: ServiceManager) -> LBTables:
     services = sorted(mgr.by_frontend.values(), key=lambda s: s.id)
     nb = 16
     while nb * 8 < max(len(services), 1):
@@ -156,8 +261,91 @@ def flow_hash(saddr, daddr, sport, dport, proto):
     return fnv1a_device(words)
 
 
+def _lb_select_inline(
+    tables: "LBInline",
+    saddr,
+    daddr,
+    sport,
+    dport,
+    proto,
+    ct_slave=None,
+):
+    """Inline-layout select: ONE row gather resolves the service AND
+    its backends; the matching 64-lane slot is combined in-register."""
+    import jax.numpy as jnp
+
+    vip = daddr.astype(jnp.uint32)
+    w1 = ((dport.astype(jnp.uint32) & 0xFFFF) << 16) | (
+        proto.astype(jnp.uint32) & 0xFF
+    )
+    h = fnv1a_device(jnp.stack([vip, w1], axis=1))
+    bucket = (h & jnp.uint32(tables.n_buckets - 1)).astype(jnp.int32)
+    rows = jnp.asarray(tables.rows)[bucket]  # [B, 128] — THE gather
+    half = rows.reshape(-1, 2, INLINE_SLOT)  # [B, 2, 64]
+    hit2 = (half[:, :, 0] == vip[:, None]) & (
+        half[:, :, 1] == w1[:, None]
+    )  # [B, 2]
+    slot = jnp.sum(
+        jnp.where(hit2[:, :, None], half, 0), axis=1, dtype=jnp.uint32
+    )  # [B, 64]
+    stash = jnp.asarray(tables.stash)  # [S, 64]
+    s_hit = (stash[None, :, 0] == vip[:, None]) & (
+        stash[None, :, 1] == w1[:, None]
+    )  # [B, S]
+    slot = slot + jnp.sum(
+        jnp.where(s_hit[:, :, None], stash[None, :, :], 0),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    found = jnp.any(hit2, axis=1) | jnp.any(s_hit, axis=1)
+
+    meta = slot[:, 2]
+    count = (meta & 0xFFFF).astype(jnp.int32)
+    rev_nat = (meta >> 16).astype(jnp.int32)
+    found = found & (count > 0)
+
+    fh = flow_hash(saddr, daddr, sport, dport, proto)
+    slave = (fh % jnp.maximum(count, 1).astype(jnp.uint32)).astype(
+        jnp.int32
+    ) + 1
+    if ct_slave is not None:
+        # established flows stick to their backend (lb4_local)
+        reuse = (ct_slave > 0) & (ct_slave <= count)
+        slave = jnp.where(reuse, ct_slave, slave)
+
+    k = (slave - 1).astype(jnp.int32)
+    lane = jnp.arange(INLINE_MAX_BACKENDS, dtype=jnp.int32)
+    ip_mask = lane[None, :] == k[:, None]
+    new_daddr = jnp.sum(
+        jnp.where(ip_mask, slot[:, 4 : 4 + INLINE_MAX_BACKENDS], 0),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    plane = jnp.arange(INLINE_MAX_BACKENDS // 2, dtype=jnp.int32)
+    port_mask = plane[None, :] == (k >> 1)[:, None]
+    port_pair = jnp.sum(
+        jnp.where(
+            port_mask,
+            slot[:, 4 + INLINE_MAX_BACKENDS : 4 + INLINE_MAX_BACKENDS
+                 + INLINE_MAX_BACKENDS // 2],
+            0,
+        ),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    new_dport = (
+        (port_pair >> (16 * (k & 1)).astype(jnp.uint32)) & 0xFFFF
+    ).astype(jnp.int32)
+
+    new_daddr = jnp.where(found, new_daddr, daddr.astype(jnp.uint32))
+    new_dport = jnp.where(found, new_dport, dport.astype(jnp.int32))
+    rev_nat = jnp.where(found, rev_nat, 0)
+    slave = jnp.where(found, slave, 0)
+    return found, slave, new_daddr, new_dport, rev_nat
+
+
 def lb_select_batch(
-    tables: LBTables,
+    tables,
     saddr,
     daddr,
     sport,
@@ -169,9 +357,15 @@ def lb_select_batch(
     new_dport i32 [B], rev_nat i32 [B]).  Non-service flows pass
     through with their original daddr/dport and rev_nat 0.
 
-    One bucket row gather resolves the service; one backend row gather
-    plus a masked lane sum picks the chosen backend."""
+    Inline layout: one row gather resolves service and backends.
+    Classic layout: one bucket row gather resolves the service; one
+    backend row gather plus a masked lane sum picks the backend."""
     import jax.numpy as jnp
+
+    if isinstance(tables, LBInline):
+        return _lb_select_inline(
+            tables, saddr, daddr, sport, dport, proto, ct_slave
+        )
 
     vip = daddr.astype(jnp.uint32)
     w1 = ((dport.astype(jnp.uint32) & 0xFFFF) << 16) | (

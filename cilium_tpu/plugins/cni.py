@@ -10,10 +10,10 @@ over the unix-socket REST API (IP from the agent's IPAM) — and
 returns a spec-shaped CNI result; interface plumbing belongs to the
 host networking layer that embeds the framework.
 
-Endpoint numbering: the container id hashes into the endpoint-id
-space deterministically, so ADD and DEL agree without plugin-side
-state (the reference derives the endpoint from the container's
-attachment the same way).
+Endpoint numbering: the AGENT allocates the endpoint id (PUT
+/endpoint/0); DEL resolves the endpoint by its container-derived
+name, so ADD and DEL agree without plugin-side state and without
+hash collisions.
 
 Usage (CNI conformance): `python -m cilium_tpu.plugins.cni` with the
 standard env + stdin; VERSION/ADD/DEL supported, errors returned as
@@ -22,7 +22,6 @@ CNI error JSON on stdout with a non-zero exit.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import sys
@@ -31,14 +30,13 @@ from typing import Dict, Optional
 CNI_VERSIONS = ["0.3.0", "0.3.1", "0.4.0"]
 DEFAULT_SOCKET = "/var/run/cilium_tpu.sock"
 
-# endpoint ids live in u16 space above the reserved low ids
-_EP_ID_BASE = 256
-_EP_ID_SPACE = 65536 - _EP_ID_BASE
-
-
-def endpoint_id_for(container_id: str) -> int:
-    digest = hashlib.sha256(container_id.encode()).digest()
-    return _EP_ID_BASE + int.from_bytes(digest[:4], "big") % _EP_ID_SPACE
+# The shim does NOT derive endpoint ids from container ids: a
+# hash-derived id collides at birthday rates (~7% at 100 concurrent
+# workloads) and a collision is a permanent ADD failure.  Instead the
+# AGENT allocates the id (PUT /endpoint/0, like the reference's
+# endpointmanager); ADD reads the allocated id from the reply and DEL
+# resolves by the container-derived endpoint name.
+ALLOCATE_EP_ID = 0
 
 
 def _labels_from_args(cni_args: str) -> list:
@@ -104,12 +102,10 @@ def run(
         client = APIClient(
             conf.get("socket_path", DEFAULT_SOCKET)
         )
-    ep_id = endpoint_id_for(container_id)
-
     if command == "ADD":
         try:
             created = client.endpoint_create(
-                ep_id,
+                ALLOCATE_EP_ID,
                 {
                     "labels": _labels_from_args(
                         env.get("CNI_ARGS", "")
@@ -120,9 +116,7 @@ def run(
         except Exception as exc:
             status = getattr(exc, "status", None)
             if status == 409:
-                # permanent: the hash-derived id belongs to another
-                # live workload — retrying cannot help
-                return 1, _error(7, f"endpoint id conflict: {exc}")
+                return 1, _error(7, f"endpoint conflict: {exc}")
             if status is not None:
                 return 1, _error(11, f"agent error {status}: {exc}")
             return 1, _error(11, f"agent unreachable: {exc}")
@@ -147,12 +141,14 @@ def run(
 
     if command == "DEL":
         # CNI DEL must be idempotent and succeed for unknown
-        # containers (the runtime retries DELs).  The name guard
-        # keeps a hash-collided id from tearing down ANOTHER
-        # workload's endpoint (the agent answers 409, swallowed here
-        # as "not ours").
+        # containers (the runtime retries DELs).  id 0 + name =
+        # delete-by-name: the shim never learns the allocated id, and
+        # the name guard keeps a DEL from tearing down another
+        # workload's endpoint.
         try:
-            client.endpoint_delete(ep_id, name=container_id[:12])
+            client.endpoint_delete(
+                ALLOCATE_EP_ID, name=container_id[:12]
+            )
         except Exception:
             pass
         return 0, {}

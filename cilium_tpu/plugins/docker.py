@@ -29,7 +29,7 @@ from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional
 
 from cilium_tpu.logging import get_logger
-from cilium_tpu.plugins.cni import endpoint_id_for
+from cilium_tpu.plugins.cni import ALLOCATE_EP_ID
 
 log = get_logger("docker-plugin")
 
@@ -122,9 +122,9 @@ class DockerPlugin:
             return {"Err": "EndpointID missing"}
         iface = body.get("Interface") or {}
         given = (iface.get("Address") or "").split("/")[0] or None
-        ep_id = endpoint_id_for(eid)
+        # the agent allocates the endpoint id (see plugins/cni.py)
         created = self.client.endpoint_create(
-            ep_id,
+            ALLOCATE_EP_ID,
             {
                 "labels": [
                     {
@@ -144,7 +144,7 @@ class DockerPlugin:
                 ),
             },
         )
-        self._endpoints[eid] = (ep_id, created.get("ipv4"))
+        self._endpoints[eid] = (created.get("id"), created.get("ipv4"))
         if given:
             # docker already assigned the address through our
             # IpamDriver — returning one again is a protocol error
@@ -156,8 +156,9 @@ class DockerPlugin:
     def _delete_endpoint(self, body: dict) -> dict:
         eid = body.get("EndpointID", "")
         entry = self._endpoints.pop(eid, None)
-        ep_id = entry[0] if entry else endpoint_id_for(eid)
+        ep_id = entry[0] if entry else ALLOCATE_EP_ID
         try:
+            # id 0 + name resolves by the endpoint name (restart case)
             self.client.endpoint_delete(ep_id, name=eid[:12])
         except Exception:
             pass  # idempotent per the protocol

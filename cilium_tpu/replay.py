@@ -158,6 +158,9 @@ def _flows_from_pool(pool_packed, picks):
     return flow_batch_from_packed(pool_packed[:, picks])
 
 
+_POOL_PACK_KEY = "__device_pack__"
+
+
 def pack_flow_pool(pool: Dict[str, np.ndarray]) -> np.ndarray:
     """Flow-universe dict → [8, P] u32 pack (one upload, device
     gathers per batch).  Row order is datapath.FLOW_COLUMNS — the
@@ -201,10 +204,31 @@ def _churn_fns():
             out = _datapath_kernel(tables, flows)
             return _churn_compact(out, flows, valid)
 
+        def step_pool_rand(tables, pool_packed, key, batch_size, valid):
+            # device-side pick generation: the serial churn loop pays
+            # the transport's full H2D latency per upload, so moving
+            # an [B] index array per round dominates when the link is
+            # slow — an 8-byte PRNG key replaces it (uniform picks,
+            # same distribution the host sampler draws)
+            import jax.numpy as jnp
+            import jax.random as jrandom
+
+            picks = jrandom.randint(
+                key,
+                (batch_size,),
+                0,
+                pool_packed.shape[1],
+                dtype=jnp.uint32,
+            )
+            flows = _flows_from_pool(pool_packed, picks)
+            out = _datapath_kernel(tables, flows)
+            return _churn_compact(out, flows, valid)
+
         _CHURN_FNS = (
             jax.jit(step),
             jax.jit(step_accum, donate_argnums=(3,)),
             jax.jit(step_pool),
+            jax.jit(step_pool_rand, static_argnums=(3,)),
         )
     return _CHURN_FNS
 
@@ -611,7 +635,7 @@ def replay(
 def replay_pool(
     tables,
     pool: Dict[str, np.ndarray],
-    picks: np.ndarray,
+    picks: "np.ndarray | int",
     batch_size: int = 1 << 21,
     *,
     ct_map,
@@ -621,6 +645,12 @@ def replay_pool(
     each batch moves only its u32 pick indices; the fused program
     gathers the flow columns on device (_flows_from_pool) before the
     datapath step + intent compaction.
+
+    `picks` is either an explicit index array (caller-chosen flow
+    order, one [B] u32 upload per batch) or an INT — "this many
+    uniform picks, generated on device from an 8-byte PRNG key per
+    batch" — the mode for slow H2D links where per-batch index
+    uploads would dominate the serial churn loop.
 
     Identical verdict/CT semantics to replay() with a record buffer of
     pool[picks] — only the transport changes: 4 bytes/tuple instead of
@@ -640,15 +670,59 @@ def replay_pool(
     tables = jax.device_put(tables)
     # the packed device copy caches ON the pool dict itself (seed +
     # timed churn reuse one universe; a dict-id-keyed cache would go
-    # stale when CPython recycles a freed dict's id).  The pool
-    # arrays are treated as immutable once replayed — callers that
-    # mutate them must drop "_device_pack" or pass a fresh dict.
-    pool_dev = pool.get("_device_pack")
+    # stale when CPython recycles a freed dict's id).  The dunder key
+    # keeps consumers that iterate pool.items() for FLOW COLUMNS from
+    # picking up the [8, P] device array as a bogus column; helpers
+    # that take the pool dict should iterate FLOW_COLUMNS, not items.
+    # The pool arrays are treated as immutable once replayed —
+    # callers that mutate them must drop the cache key or pass a
+    # fresh dict.
+    pool_dev = pool.get(_POOL_PACK_KEY)
     if pool_dev is None:
         pool_dev = jax.device_put(pack_flow_pool(pool))
-        pool["_device_pack"] = pool_dev
+        pool[_POOL_PACK_KEY] = pool_dev
     churn_pool = _churn_fns()[2]
+    churn_pool_rand = _churn_fns()[3]
     churn = _ChurnDriver(ct_map)
+
+    # `picks` as an INT means "n uniform picks, generated on device":
+    # the serial churn loop pays the transport's full H2D latency for
+    # every upload, so shipping a [B] index array per round can
+    # dominate on a slow link — an 8-byte PRNG key per batch replaces
+    # it.  An explicit array keeps the caller-chosen flow order.
+    if isinstance(picks, (int, np.integer)):
+        import jax.random as jrandom
+
+        n = int(picks)
+        base_key = jrandom.PRNGKey(len(ct_map.entries) ^ n)
+        t0 = time.perf_counter()
+        batch_idx = 0
+        for start in range(0, n, batch_size):
+            valid = min(batch_size, n - start)
+            key = jrandom.fold_in(base_key, batch_idx)
+            batch_idx += 1
+            first_pass = True
+            while True:
+                t = DatapathTables(
+                    prefilter=tables.prefilter,
+                    ipcache=tables.ipcache,
+                    ct=churn.dev_snap,
+                    lb=tables.lb,
+                    policy=tables.policy,
+                    tunnel=tables.tunnel,
+                )
+                header_d, intents_d = churn_pool_rand(
+                    t, pool_dev, key, batch_size, valid
+                )
+                remaining = churn.drain(
+                    header_d, intents_d, stats, valid, first_pass
+                )
+                first_pass = False
+                if remaining == 0:
+                    break
+        churn.stash()
+        stats.seconds = time.perf_counter() - t0
+        return stats
 
     picks = np.asarray(picks).astype(np.uint32, copy=False)
     t0 = time.perf_counter()

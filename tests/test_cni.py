@@ -9,7 +9,7 @@ import pytest
 from cilium_tpu.api.server import APIServer
 from cilium_tpu.api.client import APIClient
 from cilium_tpu.daemon import Daemon
-from cilium_tpu.plugins.cni import endpoint_id_for, run
+from cilium_tpu.plugins.cni import run
 
 
 @pytest.fixture
@@ -57,8 +57,7 @@ def test_add_registers_endpoint_with_ipam_address(agent):
     assert out["ips"] and out["ips"][0]["address"].endswith("/32")
     ip = out["ips"][0]["address"].split("/")[0]
 
-    ep_id = endpoint_id_for("cafe" * 16)
-    ep = d.endpoint_manager.lookup(ep_id)
+    ep = d.endpoint_manager.lookup_name(("cafe" * 16)[:12])
     assert ep is not None and ep.ipv4 == ip
     labels = ep.security_identity.labels
     assert labels["io.kubernetes.pod.namespace"].value == "prod"
@@ -70,11 +69,11 @@ def test_add_registers_endpoint_with_ipam_address(agent):
 def test_del_is_idempotent(agent):
     d, sock = agent
     run(env=_env("ADD"), stdin=_conf(sock))
-    ep_id = endpoint_id_for("cafe" * 16)
-    assert d.endpoint_manager.lookup(ep_id) is not None
+    name = ("cafe" * 16)[:12]
+    assert d.endpoint_manager.lookup_name(name) is not None
     rc, _ = run(env=_env("DEL"), stdin=_conf(sock))
     assert rc == 0
-    assert d.endpoint_manager.lookup(ep_id) is None
+    assert d.endpoint_manager.lookup_name(name) is None
     # second DEL (runtime retry) still succeeds
     rc, _ = run(env=_env("DEL"), stdin=_conf(sock))
     assert rc == 0
@@ -88,3 +87,24 @@ def test_bad_command_and_missing_container():
         stdin="{}",
     )
     assert rc == 1 and out["code"] == 2
+
+
+def test_add_allocates_distinct_ids_and_is_idempotent(agent):
+    """The agent allocates endpoint ids (no hash collisions); a
+    retried ADD for the same container returns the same endpoint."""
+    d, sock = agent
+    ids = set()
+    for i in range(8):
+        rc, out = run(
+            env=_env("ADD", container=(f"c{i}" + "x" * 62)[:64]), stdin=_conf(sock)
+        )
+        assert rc == 0, out
+        ep = d.endpoint_manager.lookup_name((f"c{i}" + "x" * 62)[:64][:12])
+        assert ep is not None
+        ids.add(ep.id)
+    assert len(ids) == 8  # all distinct — allocation, not hashing
+    # runtime-retried ADD is idempotent
+    rc, out = run(env=_env("ADD", container=("c0" + "x" * 62)[:64]),
+                  stdin=_conf(sock))
+    assert rc == 0
+    assert len(d.endpoint_manager.endpoints()) == 8

@@ -359,3 +359,75 @@ def test_ct_snapshot_shapes_churn_invariant():
     assert s1.buckets.shape == s2.buckets.shape
     assert s1.stash.shape == s2.stash.shape
     assert s1.n_buckets == s2.n_buckets
+
+
+def test_lb_inline_matches_classic():
+    """The inline single-gather layout and the classic two-gather
+    layout must produce identical selections for every flow."""
+    from cilium_tpu.lb.device import (
+        LBInline,
+        compile_lb_classic,
+        compile_lb_inline,
+    )
+
+    mgr = ServiceManager()
+    rng = np.random.default_rng(7)
+    for i in range(37):  # enough services to force bucket collisions
+        backends = [
+            L3n4Addr(f"10.1.{i}.{b + 1}", 8000 + b)
+            for b in range(int(rng.integers(1, 12)))
+        ]
+        mgr.upsert(L3n4Addr(f"10.96.1.{i + 1}", 80 + (i % 3)), backends)
+    inline = compile_lb_inline(mgr)
+    classic = compile_lb_classic(mgr)
+    assert isinstance(inline, LBInline)
+
+    b = 2048
+    import ipaddress
+
+    vips = np.asarray(
+        [int(ipaddress.IPv4Address(f"10.96.1.{i + 1}")) for i in range(37)]
+        + [int(ipaddress.IPv4Address("8.8.8.8"))],
+        np.uint32,
+    )
+    daddr = vips[rng.integers(0, len(vips), size=b)]
+    saddr = rng.integers(1, 1 << 32, size=b).astype(np.uint32)
+    sport = rng.integers(1024, 65535, size=b).astype(np.int32)
+    dport = rng.integers(80, 84, size=b).astype(np.int32)
+    proto = np.full(b, 6, np.int32)
+    ct_slave = rng.integers(0, 4, size=b).astype(np.int32)
+
+    args = [jnp.asarray(x) for x in (saddr, daddr, sport, dport, proto)]
+    got = lb_select_batch(inline, *args, ct_slave=jnp.asarray(ct_slave))
+    want = lb_select_batch(classic, *args, ct_slave=jnp.asarray(ct_slave))
+    for g, w, name in zip(got, want,
+                          ("is_svc", "slave", "daddr", "dport", "rev")):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(w), err_msg=name
+        )
+
+
+def test_lb_inline_fallback_wide_service():
+    """A service wider than the inline budget falls back to the
+    classic layout through the public compile_lb."""
+    from cilium_tpu.lb.device import LBInline, LBTables
+
+    mgr = ServiceManager()
+    mgr.upsert(
+        L3n4Addr("10.96.2.1", 80),
+        [L3n4Addr(f"10.2.{b // 256}.{b % 256 + 1}", 9000) for b in range(60)],
+    )
+    tables = compile_lb(mgr)
+    assert isinstance(tables, LBTables) and not isinstance(tables, LBInline)
+    vip = np.asarray(
+        [int(__import__("ipaddress").IPv4Address("10.96.2.1"))], np.uint32
+    )
+    is_svc, slave, nd, npn, rv = lb_select_batch(
+        tables,
+        jnp.asarray(np.asarray([1], np.uint32)), jnp.asarray(vip),
+        jnp.asarray(np.asarray([1024], np.int32)),
+        jnp.asarray(np.asarray([80], np.int32)),
+        jnp.asarray(np.asarray([6], np.int32)),
+    )
+    assert bool(np.asarray(is_svc)[0])
+    assert 1 <= int(np.asarray(slave)[0]) <= 60

@@ -11,7 +11,7 @@ import pytest
 from cilium_tpu.api.client import APIClient
 from cilium_tpu.api.server import APIServer
 from cilium_tpu.daemon import Daemon
-from cilium_tpu.plugins.docker import DockerPlugin, endpoint_id_for
+from cilium_tpu.plugins.docker import DockerPlugin
 
 
 class _UnixConn(http.client.HTTPConnection):
@@ -66,7 +66,7 @@ def test_endpoint_lifecycle_driver_assigned_address(stack):
                 {"EndpointID": eid, "Interface": {}})
     addr = out["Interface"]["Address"]
     assert addr.endswith("/32")
-    ep = d.endpoint_manager.lookup(endpoint_id_for(eid))
+    ep = d.endpoint_manager.lookup_name(eid[:12])
     assert ep is not None and ep.ipv4 == addr.split("/")[0]
 
     info = _call(sock, "/NetworkDriver.EndpointOperInfo",
@@ -77,7 +77,7 @@ def test_endpoint_lifecycle_driver_assigned_address(stack):
     assert join["InterfaceName"]["DstPrefix"] == "cilium"
 
     _call(sock, "/NetworkDriver.DeleteEndpoint", {"EndpointID": eid})
-    assert d.endpoint_manager.lookup(endpoint_id_for(eid)) is None
+    assert d.endpoint_manager.lookup_name(eid[:12]) is None
     # idempotent retry
     out = _call(sock, "/NetworkDriver.DeleteEndpoint",
                 {"EndpointID": eid})
@@ -101,7 +101,7 @@ def test_ipam_flow_then_endpoint_with_assigned_address(stack):
                 {"EndpointID": eid,
                  "Interface": {"Address": got["Address"]}})
     assert out["Interface"] == {}
-    ep = d.endpoint_manager.lookup(endpoint_id_for(eid))
+    ep = d.endpoint_manager.lookup_name(eid[:12])
     assert ep.ipv4 == ip
 
     _call(sock, "/NetworkDriver.DeleteEndpoint", {"EndpointID": eid})

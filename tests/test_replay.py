@@ -321,3 +321,38 @@ def test_churn_snapshot_cache_invalidated_by_host_probe():
     assert ct.mutations != cached[2]
     replay_pool(tables, pool, picks, batch_size=128, ct_map=ct)
     assert ct._device_churn_cache[0] is not cached[0]  # rebuilt
+
+
+def test_replay_pool_device_generated_picks():
+    """The int-picks mode (device-side PRNG pick generation) replays
+    the same pool with consistent accounting: totals add up, created
+    CT entries are real pool flows, and a partial final batch is
+    counted correctly."""
+    import copy
+
+    from cilium_tpu.replay import replay_pool
+    from tests.test_datapath import _random_flows
+
+    (rng, _, _, ct, _, states, tables, n_eps) = _fused_world()
+    p = 64
+    pool = _random_flows(rng, p, n_eps)
+
+    ct_dev = copy.deepcopy(ct)
+    # 300 is not a multiple of 128: exercises the partial final batch
+    stats = replay_pool(tables, pool, 300, batch_size=128, ct_map=ct_dev)
+    assert stats.total == 300
+    assert stats.allowed + stats.denied == 300
+    # every created entry corresponds to a pool flow's effective tuple
+    pool_saddrs = set(int(s) for s in pool["saddr"])
+    for key in ct_dev.entries:
+        if key not in ct.entries:
+            assert (
+                key.saddr in pool_saddrs or key.daddr in pool_saddrs
+            )
+    # a second pass over the same (now-seeded) CT creates little new
+    before = len(ct_dev.entries)
+    stats2 = replay_pool(
+        tables, pool, 256, batch_size=128, ct_map=ct_dev
+    )
+    assert stats2.total == 256
+    assert len(ct_dev.entries) <= before + p
