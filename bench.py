@@ -58,39 +58,7 @@ def ip_u32(s: str) -> int:
     return int(ipaddress.ip_address(s))
 
 
-class HostLPM:
-    """Fast host-side LPM oracle: /32s in a dict, other prefixes
-    scanned longest-first (their count stays small in the bench
-    worlds, unlike the /32 population)."""
-
-    def __init__(self, mapping):
-        self.exact = {}
-        self.ranges = []
-        for cidr, num_id in mapping.items():
-            net = ipaddress.ip_network(cidr, strict=False)
-            if net.version != 4:
-                continue
-            if net.prefixlen == 32:
-                self.exact[int(net.network_address)] = num_id
-            else:
-                self.ranges.append(
-                    (
-                        net.prefixlen,
-                        int(net.network_address),
-                        int(net.netmask),
-                        num_id,
-                    )
-                )
-        self.ranges.sort(key=lambda r: -r[0])
-
-    def lookup(self, ip: int) -> int:
-        hit = self.exact.get(ip)
-        if hit is not None:
-            return hit
-        for _, base, mask, num_id in self.ranges:
-            if (ip & mask) == base:
-                return num_id
-        return 0
+from cilium_tpu.engine.hostpath import HostLPM, composed_oracle  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -127,9 +95,11 @@ def build_rules(rng, n_rules, n_endpoints, n_teams):
     kafka_ports = list(range(9090, 9098))
 
     rules = []
+    l7_pairs = []  # (endpoint_idx, dport, team_idx) of L7 rules
     for i in range(n_rules):
         app = f"app{i % n_endpoints}"
-        team = f"t{int(rng.integers(0, n_teams))}"
+        team_idx = int(rng.integers(0, n_teams))
+        team = f"t{team_idx}"
         kind = rng.random()
         sel = es("app", app)
         src = es("team", team)
@@ -153,6 +123,7 @@ def build_rules(rng, n_rules, n_endpoints, n_teams):
             )
         elif kind < 0.99:
             port = http_ports[int(rng.integers(0, len(http_ports)))]
+            l7_pairs.append((i % n_endpoints, port, team_idx))
             ingress = IngressRule(
                 from_endpoints=[src],
                 to_ports=[
@@ -173,6 +144,7 @@ def build_rules(rng, n_rules, n_endpoints, n_teams):
             )
         else:
             port = kafka_ports[int(rng.integers(0, len(kafka_ports)))]
+            l7_pairs.append((i % n_endpoints, port, team_idx))
             ingress = IngressRule(
                 from_endpoints=[src],
                 to_ports=[
@@ -201,7 +173,7 @@ def build_rules(rng, n_rules, n_endpoints, n_teams):
         + [(p, 6) for p in http_ports]
         + [(p, 6) for p in kafka_ports]
     )
-    return rules, all_ports
+    return rules, all_ports, l7_pairs
 
 
 def build_config5(args, rng):
@@ -258,7 +230,7 @@ def build_config5(args, rng):
 
     # policy: n_rules mixed rules through the real policy_add path
     t0 = time.perf_counter()
-    rules, all_ports = build_rules(
+    rules, all_ports, l7_pairs = build_rules(
         rng, args.rules, args.endpoints, n_teams
     )
     d.policy_add(rules)
@@ -314,14 +286,22 @@ def build_config5(args, rng):
     }
     pool = make_flow_pool(
         args, rng, ep_ip, np.asarray(id_ips, np.uint32), vips, all_ports,
-        index,
+        index, l7_pairs=l7_pairs, n_teams=n_teams,
     )
     return d, tables, index, pool, oracle_ctx, timings, ct, mgr
 
 
-def make_flow_pool(args, rng, ep_ip, id_ips, vips, all_ports, index):
+def make_flow_pool(args, rng, ep_ip, id_ips, vips, all_ports, index,
+                   l7_pairs=None, n_teams=1):
     """A pool of unique flows (CT-friendly: 10M replay tuples sample
-    from `pool_size` unique flows, like real traffic repeats flows)."""
+    from `pool_size` unique flows, like real traffic repeats flows).
+
+    2.5% of flows are PROXY-BOUND L7 traffic: real clients of the
+    policy's HTTP/Kafka rules (an allowed team member hitting the
+    rule's port at the rule's endpoint) — the mixed L3/L4/L7 traffic
+    shape BASELINE config 5 describes.  Uncorrelated random flows
+    virtually never redirect (team × port joint probability ~1e-5),
+    which would leave the proxy path unmeasured."""
     n = args.pool
     ep_ids = np.asarray(sorted(ep_ip), np.int64)
     ep_axis = np.asarray([index[int(e)] for e in ep_ids], np.int32)
@@ -367,8 +347,30 @@ def make_flow_pool(args, rng, ep_ip, id_ips, vips, all_ports, index):
     sport = rng.integers(1024, 65536, size=n).astype(np.uint16)
     frag = (rng.random(n) < 0.02).astype(np.uint8)
 
+    ep_index = ep_axis[pick_ep].astype(np.uint32)
+    if l7_pairs:
+        # overlay LAST so junk/VIP/prefilter mixing can't clobber the
+        # L7 flows' defining fields
+        l7 = np.nonzero(rng.random(n) < 0.025)[0]
+        pick_rule = rng.integers(0, len(l7_pairs), size=len(l7))
+        for row, r in zip(l7, pick_rule):
+            app_i, port, team_idx = l7_pairs[int(r)]
+            # an identity of that team: id_ips[i] belongs to team
+            # (i % n_teams)
+            member = int(rng.integers(0, len(id_ips) // n_teams))
+            i_id = member * n_teams + team_idx
+            if i_id >= len(id_ips):
+                i_id = team_idx
+            direction[row] = 0  # ingress at the serving endpoint
+            ep_index[row] = index[100 + app_i]
+            saddr[row] = id_ips[i_id]
+            daddr[row] = ep_ip[100 + app_i]
+            dport[row] = port
+            proto[row] = 6
+            frag[row] = 0
+
     return {
-        "ep_index": ep_axis[pick_ep].astype(np.uint32),
+        "ep_index": ep_index,
         "saddr": saddr.astype(np.uint32),
         "daddr": daddr.astype(np.uint32),
         "sport": sport,
@@ -394,107 +396,6 @@ def encode_pool_sample(pool, picks):
         direction=pool["direction"][picks],
         is_fragment=pool["is_fragment"][picks],
     )
-
-
-def composed_oracle(ctx, states, flows_dict, idx_list):
-    """The test-suite's composed host oracle (tests/test_datapath.py
-    _host_oracle), over the bench world's host components.  Returns
-    (allowed, proxy, sec_id) arrays for the sampled indices."""
-    from cilium_tpu.ct.table import (
-        CT_EGRESS,
-        CT_ESTABLISHED,
-        CT_INGRESS,
-        CT_NEW,
-        CT_RELATED,
-        CT_REPLY,
-        CT_SERVICE,
-        CTTuple,
-        TUPLE_F_SERVICE,
-    )
-    from cilium_tpu.engine.hashtable import _fnv1a_host
-    from cilium_tpu.engine.oracle import policy_can_access
-    from cilium_tpu.identity import RESERVED_WORLD
-    from cilium_tpu.lb.service import L3n4Addr
-    from cilium_tpu.maps.policymap import INGRESS
-
-    pre, ipc, ct, mgr = (
-        ctx["prefilter"], ctx["ipcache"], ctx["ct"], ctx["mgr"],
-    )
-    out_allow = np.zeros(len(idx_list), np.uint8)
-    out_proxy = np.zeros(len(idx_list), np.int32)
-    out_sec = np.zeros(len(idx_list), np.uint32)
-    f = flows_dict
-    for row, i in enumerate(idx_list):
-        ep = int(f["ep_index"][i])
-        saddr, daddr = int(f["saddr"][i]), int(f["daddr"][i])
-        sport, dport = int(f["sport"][i]), int(f["dport"][i])
-        proto = int(f["proto"][i])
-        direction = int(f["direction"][i])
-        frag = bool(f["is_fragment"][i])
-
-        pre_drop = pre.lookup(saddr) != 0
-
-        eff_daddr, eff_dport = daddr, dport
-        if direction != INGRESS:
-            svc = mgr.lookup(
-                L3n4Addr(str(ipaddress.ip_address(daddr)), dport, proto)
-            )
-            if svc is not None and svc.backends:
-                slave = 0
-                st_res = ct.lookup(
-                    CTTuple(daddr, saddr, dport, sport, proto), CT_SERVICE
-                )
-                if st_res in (CT_ESTABLISHED, CT_REPLY):
-                    for key in (
-                        CTTuple(saddr, daddr, sport, dport, proto,
-                                TUPLE_F_SERVICE | 1),
-                        CTTuple(daddr, saddr, dport, sport, proto,
-                                TUPLE_F_SERVICE),
-                        CTTuple(saddr, daddr, sport, dport, proto,
-                                TUPLE_F_SERVICE),
-                        CTTuple(daddr, saddr, dport, sport, proto,
-                                TUPLE_F_SERVICE | 1),
-                    ):
-                        e = ct.entries.get(key)
-                        if e is not None:
-                            slave = e.slave
-                            break
-                if not (0 < slave <= len(svc.backends)):
-                    words = np.array(
-                        [[saddr, daddr, (sport << 16) | dport, proto]],
-                        dtype=np.uint32,
-                    )
-                    slave = (
-                        int(_fnv1a_host(words)[0]) % len(svc.backends)
-                    ) + 1
-                b = svc.backends[slave - 1]
-                eff_daddr = b.addr.ip_u32()
-                eff_dport = b.addr.port
-
-        ct_res = ct.lookup(
-            CTTuple(eff_daddr, saddr, eff_dport, sport, proto),
-            CT_INGRESS if direction == INGRESS else CT_EGRESS,
-        )
-
-        sec_ip = saddr if direction == INGRESS else eff_daddr
-        sec_id = ipc.lookup(sec_ip)
-        if sec_id == 0:
-            sec_id = RESERVED_WORLD
-
-        v = policy_can_access(
-            states[ep], sec_id, eff_dport, proto, direction, frag
-        )
-        pass_ct = ct_res in (CT_REPLY, CT_RELATED)
-        allowed = (not pre_drop) and (pass_ct or v.allowed)
-        proxy = (
-            v.proxy_port
-            if v.allowed and ct_res in (CT_NEW, CT_ESTABLISHED) and allowed
-            else 0
-        )
-        out_allow[row] = 1 if allowed else 0
-        out_proxy[row] = proxy
-        out_sec[row] = sec_id
-    return out_allow, out_proxy, out_sec
 
 
 def run_config5(args) -> None:
@@ -696,6 +597,59 @@ def run_config5(args) -> None:
         vs_baseline=round(lat_vps / BASELINE_PER_CHIP, 3),
     )
 
+    # --- combined datapath + inline L7 (the full serving system) -----------
+    run_config5_combined(args, d, tables, pool, oracle_ctx, states)
+
+    # --- incremental update: one rule added to the 50k world ---------------
+    # The reference's regeneration is revision-gated per endpoint
+    # (pkg/endpoint/policy.go:540-552): adding one rule re-lowers only
+    # the endpoints it selects.  Measured: policy_add → delta-scoped
+    # regenerate → fresh published tables.
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.policy.api import (
+        EndpointSelector as _ES,
+        IngressRule as _IR,
+        PortProtocol as _PP,
+        PortRule as _PR,
+        Rule as _Rule,
+    )
+
+    ver_before = d.endpoint_manager.published()[0]
+    t0 = time.perf_counter()
+    d.policy_add(
+        [
+            _Rule(
+                endpoint_selector=_ES(
+                    match_labels={"k8s.app": "app0"}
+                ),
+                ingress=[
+                    _IR(
+                        from_endpoints=[
+                            _ES(match_labels={"k8s.team": "t0"})
+                        ],
+                        to_ports=[
+                            _PR(ports=[_PP(port="4242",
+                                           protocol="TCP")])
+                        ],
+                    )
+                ],
+                labels=LabelArray.parse("bench-incremental"),
+            )
+        ]
+    )
+    d.regenerate_all("incremental-update bench")
+    incr_ms = (time.perf_counter() - t0) * 1000
+    assert d.endpoint_manager.published()[0] > ver_before
+    emit(
+        "incremental_update_ms",
+        round(incr_ms, 1),
+        "ms",
+        note=(
+            "one rule added to the full world -> delta-scoped "
+            "regenerate -> new published tables"
+        ),
+    )
+
     p50_ms = dt / n_batches * 1000
     # achieved HBM gather traffic of the headline loop (roofline
     # context for regressions): bytes actually gathered per tuple —
@@ -723,6 +677,346 @@ def run_config5(args) -> None:
             "fused per-direction programs: prefilter+LB/DNAT+CT+"
             "ipcache+lattice+counters"
         ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# config 5 combined: fused datapath + inline L7 (the datapath+proxy
+# system, envoy/cilium_l7policy.cc:193 / pkg/proxy/kafka.go:116)
+# ---------------------------------------------------------------------------
+
+# redirected-flow compaction cap per batch: the L7 matchers run on a
+# fixed-size compacted slice (proxy-bound flows are a few percent of
+# traffic); overflow is counted in the header and asserted zero
+_L7_CAP = 1 << 17
+
+
+def build_l7_payloads(args, rng, pool, fleet):
+    """Per-pool-flow L7 request payloads: HTTP fields for flows aimed
+    at HTTP ports, Kafka fields for Kafka ports (the first request of
+    each replayed connection).  Returns device-resident padded
+    tensors aligned with the pool row index."""
+    from cilium_tpu.l7.http import pad_requests, trim_packed
+    from cilium_tpu.l7.kafka import KafkaRequest, pad_kafka_requests
+
+    n = len(pool["saddr"])
+    dport = pool["dport"]
+    reqs = []
+    for i in range(n):
+        p = int(dport[i])
+        if 8000 <= p < 8016:
+            k = int(rng.integers(0, 5))
+            path = (
+                f"/api/v{p % 4}/items",
+                f"/api/v{(p + 1) % 4}/items",  # version mismatch mix
+                "/api/v9/nope",
+                "/health",
+                f"/api/v{p % 4}/x{i % 97}",
+            )[k]
+            method = "GET" if k != 3 else "POST"
+            reqs.append((method.encode(), path.encode(), b""))
+        else:
+            reqs.append((b"", b"", b""))
+    m, ml, p_, pl, h, hl, overflow = pad_requests(reqs)
+    assert not overflow.any()
+    m, p_, h = trim_packed(m, ml), trim_packed(p_, pl), trim_packed(h, hl)
+
+    kreqs = []
+    for i in range(n):
+        pt = int(dport[i])
+        if 9090 <= pt < 9098:
+            kreqs.append(
+                KafkaRequest(
+                    kind=0,
+                    version=0,
+                    client_id=f"client{i % 4}",
+                    topics=(f"topic{int(rng.integers(0, 48))}",),
+                    parsed=True,
+                )
+            )
+        else:
+            kreqs.append(
+                KafkaRequest(kind=0, version=0, client_id="",
+                             topics=(), parsed=True)
+            )
+    kf = pad_kafka_requests(fleet.kafka, kreqs)
+    import jax
+
+    http_dev = tuple(
+        jax.device_put(x) for x in (m, ml, p_, pl, h, hl)
+    )
+    kafka_dev = tuple(jax.device_put(np.asarray(x)) for x in kf)
+    return reqs, kreqs, http_dev, kafka_dev
+
+
+def _combined_step_fn(fleet, pool_n):
+    """One jitted combined step per direction: device picks → fused
+    datapath → compact redirected rows → inline L7 verdicts →
+    combined counts.  Returns a function
+
+      (tables, pool_dev, http_pool, kafka_pool, key, acc) →
+        (header u32 [4] = allowed/redirected/l7_allowed/overflow, acc)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.engine.datapath import _datapath_core
+    from cilium_tpu.l7.fleet import evaluate_fleet_l7
+    from cilium_tpu.maps.policymap import INGRESS
+    from cilium_tpu.replay import _flows_from_pool
+
+    def step(tables, pool_dev, dir_idx, http_pool, kafka_pool, key,
+             acc, static_direction):
+        import jax.random as jrandom
+
+        # picks draw from THIS direction's pool subset (dir_idx): the
+        # direction-specialized programs mirror how packets arrive at
+        # the two hooks, as the headline loop does
+        r = jrandom.randint(
+            key, (_COMBINED_BATCH,), 0, dir_idx.shape[0],
+            dtype=jnp.uint32,
+        )
+        picks = dir_idx[r]
+        flows = _flows_from_pool(pool_dev, picks)
+        out, acc = _datapath_core(
+            tables, flows, with_counters=True, acc=acc,
+            emit_sec_id=False, static_direction=static_direction,
+        )
+        b = picks.shape[0]
+        redirected = (out.proxy_port > 0) & out.allowed.astype(bool)
+        row_id = jnp.arange(b, dtype=jnp.int32)
+        order = jnp.argsort(
+            jnp.where(redirected, row_id, jnp.int32(b))
+        )[:_L7_CAP]
+        valid = redirected[order]
+        rows_pool = picks[order]  # pool row of each compacted flow
+
+        http_fields = tuple(
+            jnp.asarray(a)[rows_pool] for a in http_pool
+        )
+        kafka_fields = tuple(
+            jnp.asarray(a)[rows_pool] for a in kafka_pool
+        )
+        l7_ok = evaluate_fleet_l7(
+            fleet,
+            flows.ep_index[order],
+            flows.direction[order],
+            out.l4_slot[order],
+            out.sec_id[order].astype(jnp.int32),  # idx-form sec
+            jnp.ones(order.shape, bool),
+            http_fields=http_fields,
+            kafka_fields=kafka_fields,
+        ) & valid
+
+        # combined allow: redirected flows need the L7 verdict too
+        n_redirected = redirected.sum(dtype=jnp.uint32)
+        overflow = n_redirected - valid.sum(dtype=jnp.uint32)
+        l7_allowed = l7_ok.sum(dtype=jnp.uint32)
+        combined = (
+            out.allowed.astype(jnp.uint32).sum(dtype=jnp.uint32)
+            - n_redirected
+            + l7_allowed
+        )
+        header = jnp.stack(
+            [combined, n_redirected, l7_allowed, overflow]
+        )
+        return header, acc
+
+    return (
+        jax.jit(
+            lambda t, pd, di, hp, kp, k, a: step(
+                t, pd, di, hp, kp, k, a, INGRESS
+            ),
+            donate_argnums=(6,),
+        ),
+        jax.jit(
+            lambda t, pd, di, hp, kp, k, a: step(
+                t, pd, di, hp, kp, k, a, 1
+            ),
+            donate_argnums=(6,),
+        ),
+    )
+
+
+_COMBINED_BATCH = 1 << 21
+
+
+def run_config5_combined(args, d, tables, pool, oracle_ctx, states):
+    """The end-to-end datapath+proxy number: fused verdicts with the
+    compiled fleet L7 matchers applied inline to redirected flows —
+    ONE measured pipeline, the analog of kernel datapath + Envoy
+    being the serving system."""
+    import jax
+    import jax.random as jrandom
+
+    from cilium_tpu.engine.verdict import make_counter_buffers
+    from cilium_tpu.l7.fleet import compile_fleet_l7
+    from cilium_tpu.replay import pack_flow_pool
+
+    rng = np.random.default_rng(23)
+    t0 = time.perf_counter()
+    fleet = compile_fleet_l7(d)
+    fleet_compile_s = time.perf_counter() - t0
+    reqs, kreqs, http_dev, kafka_dev = build_l7_payloads(
+        args, rng, pool, fleet
+    )
+    pool_dev = jax.device_put(pack_flow_pool(pool))
+    pool_n = len(pool["saddr"])
+    dir_in = jax.device_put(
+        np.nonzero(pool["direction"] == 0)[0].astype(np.uint32)
+    )
+    dir_eg = jax.device_put(
+        np.nonzero(pool["direction"] == 1)[0].astype(np.uint32)
+    )
+
+    step_in, step_eg = _combined_step_fn(fleet, pool_n)
+
+    # --- bit-identity gate: sampled picks through a full-output path ---
+    _gate_combined(
+        args, d, tables, pool, oracle_ctx, states, fleet, reqs, kreqs,
+        http_dev, kafka_dev, rng,
+    )
+
+    acc = jax.device_put(make_counter_buffers(tables.policy))
+    base = jrandom.PRNGKey(101)
+    # warmup both directions
+    h0, acc = step_in(tables, pool_dev, dir_in, http_dev, kafka_dev,
+                      jrandom.fold_in(base, 0), acc)
+    h1, acc = step_eg(tables, pool_dev, dir_eg, http_dev, kafka_dev,
+                      jrandom.fold_in(base, 1), acc)
+    jax.block_until_ready((h0, h1))
+    _ = np.asarray(h0)
+
+    import jax.numpy as jnp
+
+    n_batches = max(args.tuples // (2 * _COMBINED_BATCH), 1)
+    tot = jnp.zeros(4, jnp.uint32)
+    recent = []
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        hin, acc = step_in(
+            tables, pool_dev, dir_in, http_dev, kafka_dev,
+            jrandom.fold_in(base, 2 * i + 2), acc,
+        )
+        heg, acc = step_eg(
+            tables, pool_dev, dir_eg, http_dev, kafka_dev,
+            jrandom.fold_in(base, 2 * i + 3), acc,
+        )
+        tot = tot + hin + heg  # lazy on-device accumulation
+        recent.append((hin, heg))
+        if len(recent) > 4:
+            recent.pop(0)
+    totals = np.asarray(tot)  # one final D2H syncs the pipeline
+    dt = time.perf_counter() - t0
+    total = n_batches * 2 * _COMBINED_BATCH
+    assert int(totals[3]) == 0, "L7 compaction cap overflow"
+    emit(
+        "config5_combined_verdicts_per_sec",
+        round(total / dt),
+        "verdicts/s",
+        vs_baseline=round(total / dt / BASELINE_PER_CHIP, 3),
+        tuples=total,
+        allowed=int(totals[0]),
+        l7_redirected=int(totals[1]),
+        l7_allowed=int(totals[2]),
+        fleet_l7_compile_s=round(fleet_compile_s, 2),
+        note=(
+            "fused datapath + inline fleet L7 (HTTP DFA + Kafka "
+            "tensors) in one measured pipeline; mixed config-5 policy"
+        ),
+    )
+
+
+def _gate_combined(
+    args, d, tables, pool, oracle_ctx, states, fleet, reqs, kreqs,
+    http_dev, kafka_dev, rng,
+):
+    """Bit-identity of the combined path vs the composed host oracle
+    INCLUDING L7: fused verdict, then host-side HTTP/Kafka matching
+    for redirected samples."""
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.engine.datapath import datapath_step
+    from cilium_tpu.l7.fleet import (
+        PARSER_HTTP_ID,
+        PARSER_KAFKA_ID,
+        evaluate_fleet_l7,
+    )
+    from cilium_tpu.l7.http import http_rule_matches_host
+    from cilium_tpu.l7.kafka import matches_rules_host
+    from cilium_tpu.replay import read_flow_batches
+
+    sample = rng.integers(0, len(pool["saddr"]), size=512)
+    buf = encode_pool_sample(pool, sample)
+    flows = next(read_flow_batches(buf, len(sample)))[0]
+    out = datapath_step(tables, flows)
+
+    want_allow, want_proxy, want_sec = composed_oracle(
+        oracle_ctx, states, pool, list(sample)
+    )
+    assert (np.asarray(out.allowed) == want_allow).all()
+    assert (np.asarray(out.proxy_port) == want_proxy).all()
+    id_index, _ = d.endpoint_manager.identity_index()
+
+    # device combined L7 on exactly the sampled rows
+    rows_pool = jnp.asarray(sample.astype(np.uint32))
+    http_fields = tuple(jnp.asarray(a)[rows_pool] for a in http_dev)
+    kafka_fields = tuple(jnp.asarray(a)[rows_pool] for a in kafka_dev)
+    # translate sec ids to idx-form for the L7 ident gating
+    sec_idx = np.asarray(
+        [id_index.get(int(s), 0) for s in np.asarray(out.sec_id)],
+        np.int32,
+    )
+    got_l7 = np.asarray(
+        evaluate_fleet_l7(
+            fleet,
+            flows.ep_index,
+            flows.direction,
+            out.l4_slot,
+            jnp.asarray(sec_idx),
+            jnp.ones(len(sample), bool),
+            http_fields=http_fields,
+            kafka_fields=kafka_fields,
+        )
+    )
+
+    # host oracle: per-scope rule sets from the compiled fleet specs
+    http_by_scope = {}
+    for r, spec in enumerate(fleet.http.device_rules if fleet.http else []):
+        http_by_scope.setdefault(spec.scope_key, []).append(spec)
+    kafka_by_scope = {}
+    for r, spec in enumerate(fleet.kafka.specs if fleet.kafka else []):
+        kafka_by_scope.setdefault(spec.scope_key, []).append(spec)
+
+    allowed = np.asarray(out.allowed)
+    proxy = np.asarray(out.proxy_port)
+    slots = np.asarray(out.l4_slot)
+    eps = np.asarray(flows.ep_index)
+    dirs = np.asarray(flows.direction)
+    mismatches = 0
+    for row, i in enumerate(sample):
+        if not (allowed[row] and proxy[row] > 0):
+            continue
+        scope = (int(eps[row]), int(dirs[row]), int(slots[row]))
+        kind = fleet.parser_kind[scope]
+        sidx = int(sec_idx[row])
+        if kind == PARSER_HTTP_ID:
+            m, p, h = reqs[int(i)]
+            want = any(
+                sidx in spec.identity_indices
+                and http_rule_matches_host(spec, m, p, h)
+                for spec in http_by_scope.get(scope, [])
+            )
+        elif kind == PARSER_KAFKA_ID:
+            scoped = kafka_by_scope.get(scope, [])
+            want = matches_rules_host(kreqs[int(i)], scoped, sidx)
+        else:
+            want = False
+        if bool(got_l7[row]) != want:
+            mismatches += 1
+    assert mismatches == 0, (
+        f"combined L7 diverges from host oracle on {mismatches} samples"
     )
 
 

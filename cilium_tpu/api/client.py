@@ -142,13 +142,18 @@ class APIClient:
         return self._request("POST", "/monitor")
 
     def monitor_poll(self, sid: str, timeout: float = 5.0,
-                     max_events: int = 1024):
+                     max_events: int = 1024, ack=None):
         # the HTTP socket budget must outlive the server's long-poll
         # window (clamped to 30 s server-side) or a reply carrying
-        # already-dequeued events times out client-side and loses them
+        # already-dequeued events times out client-side and loses them.
+        # `ack` acknowledges the previous reply's seq — an unacked
+        # batch (reply lost to a hang-up) is re-delivered.
+        qs = f"timeout={timeout}&max={max_events}"
+        if ack is not None:
+            qs += f"&ack={ack}"
         return self._request(
             "GET",
-            f"/monitor/{sid}?timeout={timeout}&max={max_events}",
+            f"/monitor/{sid}?{qs}",
             timeout=min(timeout, 30.0) + 15.0,
         )
 

@@ -244,12 +244,14 @@ class DaemonAPI:
     def ct_list(self, limit: int = 4096) -> dict:
         import ipaddress as _ipaddress
 
+        # daemon.ct is the IPv4 conntrack map (a v6 map is a separate
+        # CTMap compiled by engine/datapath6); the family comes from
+        # WHICH map is dumped, never from address magnitude — a v6
+        # address numerically below 2^32 (e.g. ::1) must not render
+        # as a dotted quad
         def _fmt(addr: int) -> str:
-            # v4 keys store u32, v6 keys 128-bit ints —
-            # ip_address(int) picks the family by magnitude, matching
-            # how CTTuple stores both
             try:
-                return str(_ipaddress.ip_address(addr))
+                return str(_ipaddress.IPv4Address(addr))
             except ValueError:
                 return str(addr)
 
@@ -312,11 +314,19 @@ class DaemonAPI:
         sid = uuid.uuid4().hex[:12]
         q = self.daemon.monitor.subscribe_queue()
         with self._monitor_lock:
-            self._monitor_sessions[sid] = (q, [_time.monotonic()])
+            # [queue, [last-active], delivery state]: `seq` numbers
+            # each delivered batch; the batch stays in `pending`
+            # until the client's NEXT poll acknowledges it (ack=seq),
+            # so a reply lost to a client hang-up mid-write is
+            # re-delivered instead of silently dropped
+            self._monitor_sessions[sid] = (
+                q, [_time.monotonic()], {"seq": 0, "pending": None},
+            )
         return {"session": sid}
 
     def monitor_poll(
-        self, sid: str, timeout: float = 5.0, max_events: int = 1024
+        self, sid: str, timeout: float = 5.0, max_events: int = 1024,
+        ack: Optional[int] = None,
     ) -> Optional[dict]:
         import dataclasses
         import time as _time
@@ -326,8 +336,19 @@ class DaemonAPI:
             entry = self._monitor_sessions.get(sid)
             if entry is None:
                 return None
-            q, last = entry
+            q, last, state = entry
             last[0] = _time.monotonic()
+            if state["pending"] is not None:
+                if ack is None or ack == state["seq"]:
+                    # ack'd — or a legacy client that never acks
+                    # (implicit ack keeps old pollers moving; only
+                    # ack-aware clients get the re-delivery guarantee)
+                    state["pending"] = None
+                else:
+                    # the previous reply never reached the client
+                    # (hang-up mid-write): re-deliver the same batch
+                    # under the same seq
+                    return dict(state["pending"])
         deadline = _time.monotonic() + min(timeout, 30.0)
         max_events = max(1, max_events)
         events = []
@@ -351,7 +372,7 @@ class DaemonAPI:
                             **dataclasses.asdict(ev),
                         }
                     )
-        return {
+        reply = {
             "events": events,
             # THIS session's drops since the LAST poll, not the
             # bus-global count (one abandoned subscriber must not
@@ -359,6 +380,14 @@ class DaemonAPI:
             # must not read as ongoing loss forever)
             "lost": self.daemon.monitor.queue_drops(q, reset=True),
         }
+        with self._monitor_lock:
+            entry = self._monitor_sessions.get(sid)
+            if entry is not None and events:
+                state = entry[2]
+                state["seq"] += 1
+                reply["seq"] = state["seq"]
+                state["pending"] = dict(reply)
+        return reply
 
     def monitor_close(self, sid: str) -> dict:
         with self._monitor_lock:
@@ -450,12 +479,15 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     timeout = float(qs.get("timeout", ["5"])[0])
                     max_events = int(qs.get("max", ["1024"])[0])
+                    ack_raw = qs.get("ack", [None])[0]
+                    ack = None if ack_raw is None else int(ack_raw)
                 except ValueError as exc:
                     return self._reply(
                         400, {"error": f"bad request: {exc}"}
                     )
                 got = api.monitor_poll(
-                    sid, timeout=timeout, max_events=max_events
+                    sid, timeout=timeout, max_events=max_events,
+                    ack=ack,
                 )
                 if got is None:
                     return self._reply(

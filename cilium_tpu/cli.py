@@ -160,6 +160,7 @@ def cmd_monitor(api, args) -> int:
     """`cilium monitor` follow mode over the REST stream."""
     sid = api.monitor_open()["session"]
     printed = 0
+    ack = None
     try:
         while args.count == 0 or printed < args.count:
             # cap the poll at the remaining budget: events the server
@@ -169,9 +170,12 @@ def cmd_monitor(api, args) -> int:
                 args.count - printed if args.count else 1024
             )
             got = api.monitor_poll(
-                sid, timeout=args.timeout, max_events=remaining
+                sid, timeout=args.timeout, max_events=remaining,
+                ack=ack,
             )
-            for ev in got["events"]:
+            ack = got.get("seq", ack)
+            # a re-delivered batch may exceed this poll's budget
+            for ev in got["events"][:remaining]:
                 print(json.dumps(ev))
                 printed += 1
             if args.once and not got["events"]:

@@ -164,7 +164,15 @@ class CTBucketIndex:
     """Host mirror of the device bucket layout, for incremental churn
     updates: tracks which bucket each key lives in and rebuilds only
     the rows that changed (the agent-side analog of the kernel
-    updating one hash bucket per CT event)."""
+    updating one hash bucket per CT event).
+
+    DNATed flow entries are DUAL-HOMED: besides their natural bucket
+    (hash of the post-DNAT normalized tuple, where ingress replies
+    probe), a copy lives in the bucket of the flow's ORIGINAL
+    pre-DNAT tuple — the bucket the merged egress probe fetches ONCE
+    for both the service-scope lookup and the flow lookup
+    (`ct_probe_rows`), mirroring how bpf_lxc looks up both per packet
+    (bpf_lxc.c:486-509) without paying two row gathers here."""
 
     def __init__(self, ct: CTMap) -> None:
         self.n_buckets = _envelope_buckets(ct.max_entries)
@@ -172,32 +180,63 @@ class CTBucketIndex:
             [] for _ in range(self.n_buckets)
         ]
         self.stash_keys: List[CTTuple] = []
-        self.key_home: Dict[CTTuple, int] = {}  # -1 = stash
+        # key → list of homes (-1 = stash); DNATed entries have two
+        self.key_home: Dict[CTTuple, List[int]] = {}
+        self.ct = ct
         for key in ct.entries:
             self._place(key)
-        self.ct = ct
 
-    def _bucket_of(self, key: CTTuple) -> int:
+    def _bucket_of_tuple(
+        self, daddr: int, saddr: int, dport: int, sport: int,
+        proto: int,
+    ) -> int:
         lo_a, hi_a, lo_p, hi_p, _ = _normalize_host(
-            key.daddr, key.saddr, key.dport, key.sport
+            daddr, saddr, dport, sport
         )
-        words = _bucket_hash_words(lo_a, hi_a, lo_p, hi_p, key.nexthdr)
+        words = _bucket_hash_words(lo_a, hi_a, lo_p, hi_p, proto)
         return int(_fnv1a_host(words[None, :])[0]) & (self.n_buckets - 1)
 
-    def _place(self, key: CTTuple) -> int:
-        b = self._bucket_of(key)
-        if len(self.bucket_keys[b]) < ENTRIES_PER_BUCKET:
-            self.bucket_keys[b].append(key)
-            self.key_home[key] = b
-            return b
-        if len(self.stash_keys) >= STASH_ENTRIES:
-            raise ValueError(
-                "CT bucket and stash overflow — raise max_entries "
-                "(bucket envelope) or stash size"
+    def _bucket_of(self, key: CTTuple) -> int:
+        return self._bucket_of_tuple(
+            key.daddr, key.saddr, key.dport, key.sport, key.nexthdr
+        )
+
+    def _homes_of(self, key: CTTuple) -> List[int]:
+        homes = [self._bucket_of(key)]
+        entry = self.ct.entries.get(key)
+        orig_daddr = getattr(entry, "orig_daddr", 0) if entry else 0
+        if orig_daddr:
+            pre = self._bucket_of_tuple(
+                orig_daddr, key.saddr,
+                getattr(entry, "orig_dport", 0), key.sport,
+                key.nexthdr,
             )
-        self.stash_keys.append(key)
-        self.key_home[key] = -1
-        return -1
+            if pre != homes[0]:
+                homes.append(pre)
+        return homes
+
+    def _place(self, key: CTTuple) -> List[int]:
+        """A key lives EITHER in its home rows (one copy per distinct
+        bucket) OR exactly once in the stash — never both: the stash
+        is broadcast-compared by every probe, so a row copy plus a
+        stash copy would double-count in the masked value sum."""
+        want = self._homes_of(key)
+        if all(
+            len(self.bucket_keys[b]) < ENTRIES_PER_BUCKET for b in want
+        ):
+            for b in want:
+                self.bucket_keys[b].append(key)
+            homes = list(want)
+        else:
+            if len(self.stash_keys) >= STASH_ENTRIES:
+                raise ValueError(
+                    "CT bucket and stash overflow — raise max_entries "
+                    "(bucket envelope) or stash size"
+                )
+            self.stash_keys.append(key)
+            homes = [-1]
+        self.key_home[key] = homes
+        return homes
 
     def _row(self, b: int) -> np.ndarray:
         row = np.zeros(BUCKET_LANES, dtype=np.uint32)
@@ -239,28 +278,25 @@ class CTBucketIndex:
         dirty = set()
         stash_dirty = False
         for key in deleted:
-            home = self.key_home.pop(key, None)
-            if home is None:
+            homes = self.key_home.pop(key, None)
+            if homes is None:
                 continue
-            if home < 0:
-                self.stash_keys.remove(key)
-                stash_dirty = True
-            else:
-                self.bucket_keys[home].remove(key)
-                dirty.add(home)
-        for key in created:
-            if key in self.key_home:
-                dirty_home = self.key_home[key]
-                if dirty_home >= 0:
-                    dirty.add(dirty_home)  # value may have changed
-                else:
+            for home in homes:
+                if home < 0:
+                    self.stash_keys.remove(key)
                     stash_dirty = True
-                continue
-            home = self._place(key)
-            if home < 0:
-                stash_dirty = True
-            else:
-                dirty.add(home)
+                else:
+                    self.bucket_keys[home].remove(key)
+                    dirty.add(home)
+        for key in created:
+            homes = self.key_home.get(key)
+            if homes is None:
+                homes = self._place(key)
+            for home in homes:  # value may have changed: re-pack
+                if home < 0:
+                    stash_dirty = True
+                else:
+                    dirty.add(home)
         idx = np.array(sorted(dirty), dtype=np.int32)
         rows = (
             np.stack([self._row(b) for b in idx])
@@ -320,6 +356,26 @@ def _normalize_device(daddr, saddr, dport, sport):
     return lo_a, hi_a, lo_p, hi_p, swapped
 
 
+def ct_fetch_rows(snapshot: CTSnapshot, daddr, saddr, dport, sport, proto):
+    """THE bucket row gather: fetch each flow's CT bucket row by the
+    normalized-tuple hash.  Probes against the fetched rows are lane
+    compares (`ct_probe_rows`) — the merged egress path fetches by the
+    ORIGINAL tuple once and probes both the service-scope key and the
+    (possibly DNATed) flow key against the same rows, relying on the
+    dual-homed placement of CTBucketIndex."""
+    import jax.numpy as jnp
+
+    lo_a, hi_a, lo_p, hi_p, _ = _normalize_device(
+        daddr, saddr, dport, sport
+    )
+    proto_u = proto.astype(jnp.uint32) & 0xFF
+    h = fnv1a_device(
+        jnp.stack([lo_a, hi_a, (lo_p << 16) | hi_p, proto_u], axis=1)
+    )
+    bucket = (h & jnp.uint32(snapshot.n_buckets - 1)).astype(jnp.int32)
+    return jnp.asarray(snapshot.buckets)[bucket]  # [B, 128] — 1 gather
+
+
 def ct_lookup_batch(
     snapshot: CTSnapshot,
     daddr,
@@ -336,6 +392,28 @@ def ct_lookup_batch(
     ONE bucket row gather: the normalized hash puts the forward and
     reverse keys in the same bucket, and both direction probes are
     lane compares against the fetched row."""
+    rows = ct_fetch_rows(snapshot, daddr, saddr, dport, sport, proto)
+    return ct_probe_rows(
+        snapshot, rows, daddr, saddr, dport, sport, proto, direction,
+        related_icmp,
+    )
+
+
+def ct_probe_rows(
+    snapshot: CTSnapshot,
+    rows,  # u32 [B, 128] from ct_fetch_rows
+    daddr,
+    saddr,
+    dport,
+    sport,
+    proto,
+    direction,
+    related_icmp=None,
+):
+    """Probe pre-fetched bucket rows for the given tuple/direction —
+    see ct_lookup_batch.  The rows need not have been fetched with
+    THIS tuple's hash: the merged egress path probes the pre-DNAT
+    row for the post-DNAT key (dual-homed entries)."""
     import jax.numpy as jnp
 
     base_flags = jnp.where(
@@ -355,12 +433,6 @@ def ct_lookup_batch(
         daddr, saddr, dport, sport
     )
     proto_u = proto.astype(jnp.uint32) & 0xFF
-    h = fnv1a_device(
-        jnp.stack([lo_a, hi_a, (lo_p << 16) | hi_p, proto_u], axis=1)
-    )
-    bucket = (h & jnp.uint32(snapshot.n_buckets - 1)).astype(jnp.int32)
-
-    rows = jnp.asarray(snapshot.buckets)[bucket]  # [B, 128] — 1 gather
     n_e = ENTRIES_PER_BUCKET
     # planar extraction: word k of all entries = one contiguous slice
     ew = [rows[:, k * n_e : (k + 1) * n_e] for k in range(ENTRY_WORDS)]

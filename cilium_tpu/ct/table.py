@@ -99,6 +99,11 @@ class CTEntry:
     seen_non_syn: bool = False
     rx_closing: bool = False
     tx_closing: bool = False
+    # pre-DNAT frontend of a load-balanced flow (0 = not DNATed):
+    # the device bucket index dual-homes such entries so the merged
+    # egress probe finds them in the original tuple's bucket
+    orig_daddr: int = 0
+    orig_dport: int = 0
 
     def alive(self) -> bool:
         """ct_entry_alive: neither side closed."""
@@ -272,6 +277,8 @@ class CTMap:
         slave: int = 0,
         loopback: bool = False,
         tcp_syn: bool = False,
+        orig_daddr: int = 0,
+        orig_dport: int = 0,
     ) -> CTEntry:
         if dir == CT_INGRESS:
             flags = TUPLE_F_OUT
@@ -285,7 +292,8 @@ class CTMap:
         if len(self.entries) >= self.max_entries and key not in self.entries:
             raise OverflowError("CT map full")
         entry = CTEntry(
-            rev_nat_index=rev_nat_index, slave=slave, lb_loopback=loopback
+            rev_nat_index=rev_nat_index, slave=slave, lb_loopback=loopback,
+            orig_daddr=orig_daddr, orig_dport=orig_dport,
         )
         is_tcp = tup.nexthdr == IPPROTO_TCP
         self._update_timeout(entry, is_tcp, dir, tcp_syn, now)

@@ -85,6 +85,10 @@ class EndpointManager:
         with self._lock:
             return self.by_name.get(name)
 
+    def lookup_ip(self, ipv4: str) -> Optional[Endpoint]:
+        with self._lock:
+            return self.by_ip.get(ipv4)
+
     def endpoints(self) -> List[Endpoint]:
         with self._lock:
             return list(self.by_id.values())

@@ -44,7 +44,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from cilium_tpu.compiler.tables import PolicyTables
-from cilium_tpu.ct.device import CTSnapshot, ct_lookup_batch
+from cilium_tpu.ct.device import (
+    CTSnapshot,
+    ct_fetch_rows,
+    ct_lookup_batch,
+    ct_probe_rows,
+)
 from cilium_tpu.ct.table import (
     CT_EGRESS,
     CT_ESTABLISHED,
@@ -57,6 +62,7 @@ from cilium_tpu.ct.table import (
     CTTuple,
     TUPLE_F_IN,
     TUPLE_F_OUT,
+    TUPLE_F_SERVICE,
 )
 from cilium_tpu.engine.verdict import (
     TupleBatch,
@@ -227,6 +233,9 @@ class DatapathVerdicts:
     # u32 [B] remote node IP to encapsulate to (0 = direct/local) —
     # bpf_overlay's encap decision; all-zero without a tunnel map
     tunnel_endpoint: jax.Array = None
+    # i32 [B] global L4 slot of the matched entry (0 on L3/no match) —
+    # keys the fleet L7 scope tables (l7/fleet.py) for redirected flows
+    l4_slot: jax.Array = None
 
     def tree_flatten(self):
         return (
@@ -244,6 +253,7 @@ class DatapathVerdicts:
                 self.ct_create,
                 self.ct_delete,
                 self.tunnel_endpoint,
+                self.l4_slot,
             ),
             None,
         )
@@ -285,9 +295,18 @@ def _datapath_core(
 
     pre_drop = prefilter_drop(tables.prefilter, flows.saddr)
 
-    # -- 2. LB service DNAT (egress; lb4_local, bpf_lxc.c:486) --------------
-    # Backend stickiness comes from the CT service-scope entry the
-    # reference keeps per (vip, sport) — probe it, then select.
+    # -- 2+3. ONE CT row gather serves both probes: the bucket row is
+    # fetched by the ORIGINAL tuple's normalized hash; the
+    # service-scope stickiness probe (lb4_local's ct lookup,
+    # bpf_lxc.c:486) compares the original key, and after LB the flow
+    # probe (ct_lookup4, bpf_lxc.c:509) compares the post-DNAT key
+    # against the SAME row — DNATed entries are dual-homed there by
+    # CTBucketIndex, so the second row gather the reference pays in
+    # nanoseconds (and we'd pay ~7 ns/flow for) disappears.
+    ct_rows = ct_fetch_rows(
+        tables.ct, flows.daddr, flows.saddr, flows.dport, flows.sport,
+        flows.proto,
+    )
     if static_direction == INGRESS:
         zero = jnp.zeros(flows.dport.shape, jnp.int32)
         eff_daddr = flows.daddr.astype(jnp.uint32)
@@ -296,8 +315,9 @@ def _datapath_core(
         lb_slave = zero
     else:
         svc_dir = jnp.full_like(flows.direction, CT_SERVICE)
-        _, _, svc_slave = ct_lookup_batch(
+        _, _, svc_slave = ct_probe_rows(
             tables.ct,
+            ct_rows,
             flows.daddr,
             flows.saddr,
             flows.dport,
@@ -322,9 +342,9 @@ def _datapath_core(
         rev_nat = jnp.where(do_lb, lb_rev, 0)
         lb_slave = jnp.where(do_lb, slave, 0)
 
-    # -- 3. conntrack on the effective tuple (ct_lookup4) -------------------
-    ct_res, ct_rev, _ = ct_lookup_batch(
+    ct_res, ct_rev, _ = ct_probe_rows(
         tables.ct,
+        ct_rows,
         eff_daddr,
         flows.saddr,
         eff_dport,
@@ -458,6 +478,7 @@ def _datapath_core(
         ct_create=ct_create,
         ct_delete=ct_delete,
         tunnel_endpoint=tunnel_ep,
+        l4_slot=j,
     )
     if with_counters:
         return out, acc
@@ -553,13 +574,18 @@ def apply_ct_writeback_host(
     rev_nat,
     slave,
     now: int = 0,
+    orig_daddr=None,
+    orig_dport=None,
 ) -> tuple:
     """Host-side CT mutation after a batch (all inputs host arrays):
     create entries for NEW+allowed flows (ct_create4, bpf_lxc.c:978)
     and delete ESTABLISHED-but-now-denied entries (ct_delete4,
-    bpf_lxc.c:968).  Returns (created_keys, deleted_keys) — the key
-    lists feed the incremental device-snapshot delta
-    (ct.device.CTBucketIndex.apply).
+    bpf_lxc.c:968).  For load-balanced flows (rev_nat > 0 and the
+    pre-DNAT columns provided) the SERVICE-scope entry is created
+    alongside, carrying the selected backend for stickiness — exactly
+    lb4_local's ct_create4 on the service tuple (bpf/lib/lb.h).
+    Returns (created_keys, deleted_keys) — the key lists feed the
+    incremental device-snapshot delta (ct.device.CTBucketIndex.apply).
 
     Vectorized: flagged rows are deduplicated with one np.unique over
     packed tuple columns, so host dict work is O(unique flows), not
@@ -567,21 +593,44 @@ def apply_ct_writeback_host(
     dict at most 64k times regardless of batch size."""
     created_keys = []
     deleted_keys = []
+    if orig_daddr is None:
+        orig_daddr = daddr
+        orig_dport = dport
     create_cols = [
-        daddr, saddr, dport, sport, proto, direction, rev_nat, slave
+        daddr, saddr, dport, sport, proto, direction, rev_nat, slave,
+        orig_daddr, orig_dport,
     ]
     for row in _unique_rows(create_cols, create):
         (c_daddr, c_saddr, c_dport, c_sport, c_proto, c_dir,
-         c_rev, c_slave) = (int(v) for v in row)
+         c_rev, c_slave, c_odaddr, c_odport) = (int(v) for v in row)
         flags = TUPLE_F_OUT if c_dir == CT_INGRESS else TUPLE_F_IN
         key = CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto, flags)
-        if key in ct.entries:
-            continue  # duplicate within the batch
-        ct.create(
-            CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto),
-            c_dir, now=now, rev_nat_index=c_rev, slave=c_slave,
+        dnat = c_rev > 0 and (
+            c_odaddr != c_daddr or c_odport != c_dport
         )
-        created_keys.append(key)
+        if key not in ct.entries:
+            ct.create(
+                CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto),
+                c_dir, now=now, rev_nat_index=c_rev, slave=c_slave,
+                orig_daddr=c_odaddr if dnat else 0,
+                orig_dport=c_odport if dnat else 0,
+            )
+            created_keys.append(key)
+        if dnat:
+            # the service-scope stickiness entry (lb4_local)
+            svc_key = CTTuple(
+                c_odaddr, c_saddr, c_odport, c_sport, c_proto,
+                TUPLE_F_SERVICE,
+            )
+            if svc_key not in ct.entries:
+                ct.create(
+                    CTTuple(
+                        c_odaddr, c_saddr, c_odport, c_sport, c_proto
+                    ),
+                    CT_SERVICE, now=now, rev_nat_index=c_rev,
+                    slave=c_slave,
+                )
+                created_keys.append(svc_key)
     delete_cols = [daddr, saddr, dport, sport, proto, direction]
     for row in _unique_rows(delete_cols, delete):
         c_daddr, c_saddr, c_dport, c_sport, c_proto, c_dir = (
@@ -612,5 +661,7 @@ def apply_ct_writeback(
         np.asarray(out.rev_nat),
         np.asarray(out.lb_slave),
         now=now,
+        orig_daddr=np.asarray(flows.daddr),
+        orig_dport=np.asarray(flows.dport),
     )
     return len(created_keys), len(deleted_keys)

@@ -1,0 +1,157 @@
+"""Composed host-side datapath oracle: the differential-testing twin
+of the fused device step (engine/datapath.py).
+
+Every stage is the plain-Python reference implementation over the host
+data structures (HostLPM / ServiceManager / CTMap / policy map
+states), evaluated per tuple in the same order the fused program
+fuses: prefilter → LB/DNAT with service-scope stickiness → conntrack
+→ ipcache identity derivation → policy lattice → combine
+(bpf_lxc.c:440/899).  Device outputs must be BIT-IDENTICAL to this on
+any input — the bench's pre-timing gate, the multichip dryrun and the
+test suite all cross-check through it.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Dict
+
+import numpy as np
+
+
+class HostLPM:
+    """Fast host-side LPM oracle: /32s in a dict, other prefixes
+    scanned longest-first (their count stays small in the bench
+    worlds, unlike the /32 population)."""
+
+    def __init__(self, mapping: Dict[str, int]):
+        self.exact = {}
+        self.ranges = []
+        for cidr, num_id in mapping.items():
+            net = ipaddress.ip_network(cidr, strict=False)
+            if net.version != 4:
+                continue
+            if net.prefixlen == 32:
+                self.exact[int(net.network_address)] = num_id
+            else:
+                self.ranges.append(
+                    (
+                        net.prefixlen,
+                        int(net.network_address),
+                        int(net.netmask),
+                        num_id,
+                    )
+                )
+        self.ranges.sort(key=lambda r: -r[0])
+
+    def lookup(self, ip: int) -> int:
+        hit = self.exact.get(ip)
+        if hit is not None:
+            return hit
+        for _, base, mask, num_id in self.ranges:
+            if (ip & mask) == base:
+                return num_id
+        return 0
+
+
+def composed_oracle(ctx, states, flows_dict, idx_list):
+    """Per-tuple host evaluation of the FULL fused pipeline.  `ctx`
+    carries {"prefilter": HostLPM, "ipcache": HostLPM, "ct": CTMap,
+    "mgr": ServiceManager}; `states` is the per-endpoint realized map
+    state list in endpoint-axis order.  Returns (allowed, proxy,
+    sec_id) arrays for the sampled indices."""
+    from cilium_tpu.ct.table import (
+        CT_EGRESS,
+        CT_ESTABLISHED,
+        CT_INGRESS,
+        CT_NEW,
+        CT_RELATED,
+        CT_REPLY,
+        CT_SERVICE,
+        CTTuple,
+        TUPLE_F_SERVICE,
+    )
+    from cilium_tpu.engine.hashtable import _fnv1a_host
+    from cilium_tpu.engine.oracle import policy_can_access
+    from cilium_tpu.identity import RESERVED_WORLD
+    from cilium_tpu.lb.service import L3n4Addr
+    from cilium_tpu.maps.policymap import INGRESS
+
+    pre, ipc, ct, mgr = (
+        ctx["prefilter"], ctx["ipcache"], ctx["ct"], ctx["mgr"],
+    )
+    out_allow = np.zeros(len(idx_list), np.uint8)
+    out_proxy = np.zeros(len(idx_list), np.int32)
+    out_sec = np.zeros(len(idx_list), np.uint32)
+    f = flows_dict
+    for row, i in enumerate(idx_list):
+        ep = int(f["ep_index"][i])
+        saddr, daddr = int(f["saddr"][i]), int(f["daddr"][i])
+        sport, dport = int(f["sport"][i]), int(f["dport"][i])
+        proto = int(f["proto"][i])
+        direction = int(f["direction"][i])
+        frag = bool(f["is_fragment"][i])
+
+        pre_drop = pre.lookup(saddr) != 0
+
+        eff_daddr, eff_dport = daddr, dport
+        if direction != INGRESS:
+            svc = mgr.lookup(
+                L3n4Addr(str(ipaddress.ip_address(daddr)), dport, proto)
+            )
+            if svc is not None and svc.backends:
+                slave = 0
+                st_res = ct.lookup(
+                    CTTuple(daddr, saddr, dport, sport, proto), CT_SERVICE
+                )
+                if st_res in (CT_ESTABLISHED, CT_REPLY):
+                    for key in (
+                        CTTuple(saddr, daddr, sport, dport, proto,
+                                TUPLE_F_SERVICE | 1),
+                        CTTuple(daddr, saddr, dport, sport, proto,
+                                TUPLE_F_SERVICE),
+                        CTTuple(saddr, daddr, sport, dport, proto,
+                                TUPLE_F_SERVICE),
+                        CTTuple(daddr, saddr, dport, sport, proto,
+                                TUPLE_F_SERVICE | 1),
+                    ):
+                        e = ct.entries.get(key)
+                        if e is not None:
+                            slave = e.slave
+                            break
+                if not (0 < slave <= len(svc.backends)):
+                    words = np.array(
+                        [[saddr, daddr, (sport << 16) | dport, proto]],
+                        dtype=np.uint32,
+                    )
+                    slave = (
+                        int(_fnv1a_host(words)[0]) % len(svc.backends)
+                    ) + 1
+                b = svc.backends[slave - 1]
+                eff_daddr = b.addr.ip_u32()
+                eff_dport = b.addr.port
+
+        ct_res = ct.lookup(
+            CTTuple(eff_daddr, saddr, eff_dport, sport, proto),
+            CT_INGRESS if direction == INGRESS else CT_EGRESS,
+        )
+
+        sec_ip = saddr if direction == INGRESS else eff_daddr
+        sec_id = ipc.lookup(sec_ip)
+        if sec_id == 0:
+            sec_id = RESERVED_WORLD
+
+        v = policy_can_access(
+            states[ep], sec_id, eff_dport, proto, direction, frag
+        )
+        pass_ct = ct_res in (CT_REPLY, CT_RELATED)
+        allowed = (not pre_drop) and (pass_ct or v.allowed)
+        proxy = (
+            v.proxy_port
+            if v.allowed and ct_res in (CT_NEW, CT_ESTABLISHED) and allowed
+            else 0
+        )
+        out_allow[row] = 1 if allowed else 0
+        out_proxy[row] = proxy
+        out_sec[row] = sec_id
+    return out_allow, out_proxy, out_sec

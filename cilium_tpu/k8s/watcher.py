@@ -129,21 +129,52 @@ class _SerializedQueue:
 
 class K8sWatcher:
     """EnableK8sWatcher (k8s_watcher.go:453): wires the resource
-    streams into the daemon with per-kind serialized queues."""
+    streams into the daemon with per-kind serialized queues.
+
+    Resource kinds beyond policy/services (k8s_watcher.go:72-79):
+
+      * Pod — pod label changes reach the endpoint as an identity
+        re-allocation (EndpointUpdateLabels), including the
+        namespace's labels under the io.cilium.k8s.namespace.labels
+        key space;
+      * Namespace — namespace label changes re-derive EVERY tracked
+        pod's endpoint labels in that namespace;
+      * Node — remote nodes' pod CIDRs + internal IPs feed the
+        tunnel/overlay map (the k8s twin of the kvstore NodeWatcher);
+      * Ingress — single-service ingresses become an external LB
+        frontend on the host address at the backend service's port
+        (addIngressV1beta1's loadbalancer sync)."""
 
     KINDS = (
         "NetworkPolicy",
         "CiliumNetworkPolicy",
         "Service",
         "Endpoints",
+        "Pod",
+        "Namespace",
+        "Node",
+        "Ingress",
     )
 
-    def __init__(self, daemon, apiserver: FakeAPIServer, services=None):
+    def __init__(self, daemon, apiserver: FakeAPIServer, services=None,
+                 host_ip: str = "192.168.0.1"):
         self.daemon = daemon
         self.apiserver = apiserver
         self.services = services  # lb.ServiceManager (optional)
+        self.host_ip = host_ip  # ingress frontend host (HostV4Addr)
         self._svc_info: Dict[Tuple[str, str], K8sServiceInfo] = {}
         self._svc_frontends: Dict[Tuple[str, str], L3n4Addr] = {}
+        # pod bookkeeping for namespace-label rederivation
+        self._ns_labels: Dict[str, Dict[str, str]] = {}
+        self._pod_eps: Dict[Tuple[str, str], int] = {}
+        self._pod_labels: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._ingress_frontends: Dict[Tuple[str, str], L3n4Addr] = {}
+        # ingress key → (namespace, serviceName, raw servicePort)
+        # — the port may be a NAME (k8s IntOrString), resolved at
+        # sync time against the service's port list
+        self._ingress_spec: Dict[Tuple[str, str], tuple] = {}
+        # (namespace, service) → {port name → port number}
+        self._svc_ports: Dict[Tuple[str, str], Dict[str, int]] = {}
         self._queues = {k: _SerializedQueue(k) for k in self.KINDS}
         self._synced = {k: threading.Event() for k in self.KINDS}
 
@@ -155,6 +186,10 @@ class K8sWatcher:
             "CiliumNetworkPolicy": self._on_cnp,
             "Service": self._on_service,
             "Endpoints": self._on_endpoints,
+            "Pod": self._on_pod,
+            "Namespace": self._on_namespace,
+            "Node": self._on_node,
+            "Ingress": self._on_ingress,
         }
         for kind in self.KINDS:
             self.apiserver.watch(
@@ -232,6 +267,10 @@ class K8sWatcher:
             if frontend is not None and self.services is not None:
                 self.services.delete(frontend)
             info = self._svc_info.pop(key, None)
+            self._svc_ports.pop(key, None)
+            if self.services is not None:
+                # dependent ingress frontends drop to empty backends
+                self._sync_ingresses_for(namespace, name)
             if info is not None:
                 self._retranslate(info, revert=True)
             return
@@ -240,6 +279,13 @@ class K8sWatcher:
         info.labels = dict(spec.get("selector") or {})
         cluster_ip = spec.get("clusterIP")
         ports = spec.get("ports") or []
+        self._svc_ports[key] = {
+            str(p.get("name", "")): int(p.get("port", 0))
+            for p in ports
+            if p.get("name")
+        }
+        if self.services is not None:
+            self._sync_ingresses_for(namespace, name)
         if cluster_ip and ports and self.services is not None:
             port = int(ports[0].get("port", 0))
             proto = 6 if ports[0].get("protocol", "TCP") == "TCP" else 17
@@ -263,6 +309,7 @@ class K8sWatcher:
                         ips.add(addr["ip"])
             info.backend_ips = ips
         self._sync_lb((namespace, name))
+        self._sync_ingresses_for(namespace, name)
         # live ToServices → ToCIDRSet retranslation + regeneration
         # (k8s_watcher.go updateK8sEndpointV1 → TranslateRules):
         # depopulate against the OLD endpoint set, populate the new —
@@ -302,3 +349,158 @@ class K8sWatcher:
         self.daemon.trigger_policy_updates(
             f"service {info.namespace}/{info.name} endpoints", full=True
         )
+
+    # -- pods & namespaces ---------------------------------------------------
+
+    def _derived_pod_labels(self, namespace: str, pod_labels):
+        """Pod labels + namespace meta labels, the label view the
+        reference derives for an endpoint (k8s.go GetPodLabels +
+        network_policy.go:73-80's namespace key space)."""
+        from cilium_tpu.k8s.network_policy import (
+            POD_NAMESPACE_META_LABELS,
+        )
+        from cilium_tpu.labels import Label, Labels
+
+        out = {}
+        for k, v in (pod_labels or {}).items():
+            out[k] = Label(k, str(v), "k8s")
+        out["io.kubernetes.pod.namespace"] = Label(
+            "io.kubernetes.pod.namespace", namespace, "k8s"
+        )
+        for k, v in self._ns_labels.get(namespace, {}).items():
+            nk = f"{POD_NAMESPACE_META_LABELS}.{k}"
+            out[nk] = Label(nk, str(v), "k8s")
+        return Labels(out)
+
+    def _apply_pod_labels(self, key: Tuple[str, str]) -> None:
+        ep_id = self._pod_eps.get(key)
+        if ep_id is None:
+            return
+        labels = self._derived_pod_labels(
+            key[0], self._pod_labels.get(key, {})
+        )
+        # identity re-allocation + regeneration (EndpointUpdateLabels)
+        self.daemon.update_endpoint_labels(ep_id, labels)
+
+    def _on_pod(self, ev: K8sEvent) -> None:
+        meta = ev.obj.get("metadata", {})
+        namespace = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        key = (namespace, name)
+        if ev.action == "deleted":
+            self._pod_eps.pop(key, None)
+            self._pod_labels.pop(key, None)
+            return  # endpoint teardown is the CNI DEL's job
+        pod_ip = (ev.obj.get("status") or {}).get("podIP")
+        ep = None
+        if pod_ip:
+            ep = self.daemon.endpoint_manager.lookup_ip(pod_ip)
+        if ep is None:
+            ep = self.daemon.endpoint_manager.lookup_name(name)
+        if ep is None:
+            return  # pod not (yet) a local endpoint
+        self._pod_eps[key] = ep.id
+        new_labels = dict(meta.get("labels") or {})
+        if self._pod_labels.get(key) == new_labels:
+            return
+        self._pod_labels[key] = new_labels
+        self._apply_pod_labels(key)
+
+    def _on_namespace(self, ev: K8sEvent) -> None:
+        meta = ev.obj.get("metadata", {})
+        name = meta.get("name", "")
+        if ev.action == "deleted":
+            self._ns_labels.pop(name, None)
+        else:
+            new = dict(meta.get("labels") or {})
+            if self._ns_labels.get(name) == new:
+                return
+            self._ns_labels[name] = new
+        # re-derive every tracked pod endpoint in this namespace
+        for key in [k for k in self._pod_eps if k[0] == name]:
+            self._apply_pod_labels(key)
+
+    # -- nodes ---------------------------------------------------------------
+
+    def _on_node(self, ev: K8sEvent) -> None:
+        from cilium_tpu.kvstore.node import Node
+
+        meta = ev.obj.get("metadata", {})
+        name = meta.get("name", "")
+        if name == self.daemon.node_name:
+            return  # the local node's pod CIDR stays direct
+        internal_ip = None
+        for addr in (ev.obj.get("status") or {}).get("addresses") or []:
+            if addr.get("type") == "InternalIP":
+                internal_ip = addr.get("address")
+        spec = ev.obj.get("spec") or {}
+        node = Node(
+            name=name,
+            internal_ip=internal_ip,
+            ipv4_alloc_cidr=spec.get("podCIDR"),
+        )
+        kind = "delete" if ev.action == "deleted" else "upsert"
+        self.daemon.tunnel_map.on_node(kind, node)
+
+    # -- ingresses -----------------------------------------------------------
+
+    def _on_ingress(self, ev: K8sEvent) -> None:
+        """Single-service ingress → external LB frontend on the host
+        address at the backend service's port, backed by that
+        service's endpoints (addIngressV1beta1 → syncExternalLB)."""
+        if self.services is None:
+            return
+        meta = ev.obj.get("metadata", {})
+        namespace = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        key = (namespace, name)
+        if ev.action == "deleted":
+            frontend = self._ingress_frontends.pop(key, None)
+            self._ingress_spec.pop(key, None)
+            if frontend is not None:
+                self.services.delete(frontend)
+            return
+        backend_ref = (ev.obj.get("spec") or {}).get("backend")
+        if not backend_ref:
+            return  # only Single Service Ingress is supported
+        self._ingress_spec[key] = (
+            namespace,
+            backend_ref.get("serviceName", ""),
+            backend_ref.get("servicePort", 0),
+        )
+        self._sync_ingress(key)
+
+    def _sync_ingress(self, key: Tuple[str, str]) -> None:
+        """Refresh one ingress frontend from its backing service
+        (syncExternalLB): also called from the Service/Endpoints
+        streams, because the queues are independently serialized and
+        may arrive in either order — and a NAMED servicePort
+        (IntOrString) only resolves once the service is known."""
+        spec = self._ingress_spec.get(key)
+        if spec is None:
+            return
+        namespace, svc_name, raw_port = spec
+        try:
+            port = int(raw_port)
+        except (TypeError, ValueError):
+            port = self._svc_ports.get((namespace, svc_name), {}).get(
+                str(raw_port), 0
+            )
+        if not port:
+            return  # named port not resolvable (yet)
+        frontend = L3n4Addr(self.host_ip, port, 6)
+        old = self._ingress_frontends.get(key)
+        if old is not None and old != frontend:
+            self.services.delete(old)
+        self._ingress_frontends[key] = frontend
+        info = self._svc_info.get((namespace, svc_name))
+        backends = [
+            L3n4Addr(ip, port, 6)
+            for ip in sorted(info.backend_ips if info else [])
+        ]
+        self.services.upsert(frontend, backends)
+
+    def _sync_ingresses_for(self, namespace: str, name: str) -> None:
+        for key, spec in list(self._ingress_spec.items()):
+            if spec[:2] == (namespace, name):
+                self._sync_ingress(key)

@@ -63,6 +63,10 @@ class HTTPRuleSpec:
     method: str = ""
     host: str = ""
     headers: Tuple[str, ...] = ()
+    # fleet-scoped compiles (l7/fleet.py) key each rule to its
+    # (endpoint, direction, L4 slot); None = filter-local rule.
+    # Participates in dedupe: rules only merge within one scope.
+    scope_key: "object" = None
 
 
 @dataclass
@@ -156,10 +160,10 @@ def _dedupe_specs(rules: List[HTTPRuleSpec]) -> List[HTTPRuleSpec]:
     is semantics-preserving.  The dominant case is the allow-all
     pseudo-rules that every L3-only rule wildcards into each L7
     filter (repository.go:170): they all collapse to one."""
-    merged: Dict[Tuple[str, str, str], set] = {}
-    order: List[Tuple[str, str, str]] = []
+    merged: Dict[Tuple[str, str, str, object], set] = {}
+    order: List[Tuple[str, str, str, object]] = []
     for rule in rules:
-        key = (rule.method, rule.path, rule.host)
+        key = (rule.method, rule.path, rule.host, rule.scope_key)
         if key not in merged:
             merged[key] = set()
             order.append(key)
@@ -170,6 +174,7 @@ def _dedupe_specs(rules: List[HTTPRuleSpec]) -> List[HTTPRuleSpec]:
             method=key[0],
             path=key[1],
             host=key[2],
+            scope_key=key[3],
         )
         for key in order
     ]
@@ -479,6 +484,7 @@ def evaluate_http_batch(
     host_len: "np.ndarray",
     ident_idx: "np.ndarray",  # i32 [B] identity index (from engine._index)
     known: "np.ndarray",  # bool [B]
+    scope_bits=None,  # u32 [B, W] per-flow rule-scope mask (fleet mode)
 ):
     """Returns (allowed bool [B], matched_rules u32 [B, W])."""
     import jax.numpy as jnp
@@ -505,6 +511,8 @@ def evaluate_http_batch(
     matched = matched & ident_bits & jnp.where(
         known, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
     )[:, None]
+    if scope_bits is not None:
+        matched = matched & scope_bits
     return jnp.any(matched != 0, axis=1), matched
 
 

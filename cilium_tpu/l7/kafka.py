@@ -82,6 +82,9 @@ class KafkaRuleSpec:
     api_version: Optional[int] = None  # None = wildcard
     client_id: str = ""
     topic: str = ""
+    # fleet-scoped compiles (l7/fleet.py): rules merge only within
+    # one (endpoint, direction, L4 slot) scope
+    scope_key: "object" = None
 
 
 @dataclass
@@ -126,6 +129,7 @@ def _dedupe_specs(specs: Sequence[KafkaRuleSpec]) -> List[KafkaRuleSpec]:
             spec.api_version,
             spec.client_id,
             spec.topic,
+            spec.scope_key,
         )
         if key not in merged:
             merged[key] = set()
@@ -138,6 +142,7 @@ def _dedupe_specs(specs: Sequence[KafkaRuleSpec]) -> List[KafkaRuleSpec]:
             api_version=key[1],
             client_id=key[2],
             topic=key[3],
+            scope_key=key[4],
         )
         for key in order
     ]
@@ -269,6 +274,7 @@ def evaluate_kafka_batch(
     overflow,
     ident_idx,
     known,
+    scope_bits=None,  # u32 [B, W] per-flow rule-scope mask (fleet mode)
 ):
     """Returns allowed bool [B].  Pure integer [B,R]/[B,T,R] compares.
 
@@ -332,6 +338,11 @@ def evaluate_kafka_batch(
     bit_of_rule = (jnp.arange(r) % 32).astype(jnp.uint32)
     rule_bit = (ident_bits[:, word_of_rule] >> bit_of_rule[None, :]) & 1
     base = base & rule_bit.astype(bool) & jnp.asarray(known)[:, None]
+    if scope_bits is not None:
+        scope_bit = (
+            scope_bits[:, word_of_rule] >> bit_of_rule[None, :]
+        ) & 1
+        base = base & scope_bit.astype(bool)
 
     # MatchesRule: topic-less rule (or topic-less request) matching →
     # allow everything...

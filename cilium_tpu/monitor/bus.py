@@ -121,6 +121,7 @@ def verdicts_to_events(
     kind = np.asarray(verdicts.match_kind)
     proxy = np.asarray(verdicts.proxy_port)
     n = 0
+    per_ep = None
     if emit_allowed:
         idx = np.arange(len(allowed))
     elif verdict_eps:
@@ -129,22 +130,32 @@ def verdicts_to_events(
         idx = np.nonzero((allowed == 0) | per_ep)[0]
     else:
         idx = np.nonzero(allowed == 0)[0]
+
+    def _verdict_event(i, is_allowed: bool) -> PolicyVerdictNotify:
+        return PolicyVerdictNotify(
+            source=int(ep_ids[i]),
+            src_label=int(identities[i]),
+            dst_label=0,
+            dport=int(dports[i]),
+            proto=int(protos[i]),
+            ingress=int(directions[i]) == 0,
+            allowed=is_allowed,
+            proxy_port=int(proxy[i]),
+            match_kind=int(kind[i]),
+        )
+
     for i in idx:
         if allowed[i]:
-            bus.publish(
-                PolicyVerdictNotify(
-                    source=int(ep_ids[i]),
-                    src_label=int(identities[i]),
-                    dst_label=0,
-                    dport=int(dports[i]),
-                    proto=int(protos[i]),
-                    ingress=int(directions[i]) == 0,
-                    allowed=True,
-                    proxy_port=int(proxy[i]),
-                    match_kind=int(kind[i]),
-                )
-            )
+            bus.publish(_verdict_event(i, True))
         else:
+            if emit_allowed or (
+                per_ep is not None and per_ep[i]
+            ):
+                # PolicyVerdictNotification covers BOTH outcomes in
+                # the reference (monitor/datapath_policy.go): opted-in
+                # endpoints see the deny verdict alongside the drop
+                bus.publish(_verdict_event(i, False))
+                n += 1
             reason = (
                 DROP_FRAG_CODE
                 if kind[i] == MATCH_FRAG_DROP

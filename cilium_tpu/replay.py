@@ -46,7 +46,7 @@ _CT_CLAIM_SLOTS = 1 << 19
 # intent-fetch slice buckets: the D2H transport costs ~100 ms of fixed
 # latency plus ~17 MB/s, so the fetch moves the smallest power-of-two
 # column slice covering the round's intent count instead of the full
-# [10, cap] buffer (2.6 MB).  Static sizes keep the slice kernels in
+# [12, cap] buffer (3.1 MB).  Static sizes keep the slice kernels in
 # the jit cache.
 _CT_FETCH_BUCKETS = (1 << 10, 1 << 13, _CT_INTENT_CAP)
 
@@ -59,7 +59,7 @@ def _churn_compact(out, flows, valid):
     the D2H transfer is O(unique intents), never O(batch).
 
     Returns (header u32 [4] = count/allowed/redirected/remaining,
-    intents u32 [10, cap]) as SEPARATE outputs so the caller can pull
+    intents u32 [12, cap]) as SEPARATE outputs so the caller can pull
     the 16-byte header alone on quiet rounds — the transport costs
     ~100 ms of fixed latency per fetch, so the intent buffer only
     moves when the header says something is in it."""
@@ -122,9 +122,13 @@ def _churn_compact(out, flows, valid):
             flows.direction.astype(jnp.uint32),
             out.rev_nat.astype(jnp.uint32),
             out.lb_slave.astype(jnp.uint32),
+            # pre-DNAT frontend, for service-entry creation and
+            # dual-homed bucket placement (apply_ct_writeback_host)
+            flows.daddr.astype(jnp.uint32),
+            flows.dport.astype(jnp.uint32),
         ]
-    )  # [10, B]
-    intents = jnp.zeros((10, cap), jnp.uint32)
+    )  # [12, B]
+    intents = jnp.zeros((12, cap), jnp.uint32)
     intents = intents.at[:, :take].set(
         jnp.where(keep[None, :], cols[:, order], 0)
     )
@@ -319,6 +323,8 @@ class _ChurnDriver:
                 packed[0].astype(bool),
                 packed[1].astype(bool),
                 *(packed[j] for j in range(2, 10)),
+                orig_daddr=packed[10],
+                orig_dport=packed[11],
                 # stamp lifetimes on the MAP's clock: the daemon's GC
                 # runs on ct.now() (map age), and a now=0 stamp would
                 # read as already-expired once uptime passes the

@@ -370,3 +370,42 @@ def test_ct_gc_controller_runs():
     d._ct_gc()
     assert len(d.ct.entries) == 0
     assert d.ct.mutations > before  # invalidates the churn cache
+
+
+def test_monitor_poll_redelivers_unacked_batch(tmp_path):
+    """A reply lost to a client hang-up mid-write must not lose its
+    events: an ack-aware client that re-polls with a STALE ack gets
+    the same batch again (same seq); acking advances the stream."""
+    from cilium_tpu.api.client import APIClient
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.monitor.events import DropNotify
+
+    d = Daemon()
+    sock = str(tmp_path / "mon-ack.sock")
+    server = APIServer(d, sock).start()
+    client = APIClient(sock)
+    try:
+        sid = client.monitor_open()["session"]
+        d.monitor.publish(DropNotify(source=7, reason=133))
+        got1 = client.monitor_poll(sid, timeout=2, ack=0)
+        assert [e["source"] for e in got1["events"]] == [7]
+        seq1 = got1["seq"]
+
+        # simulate "reply never arrived": re-poll WITHOUT acking
+        d.monitor.publish(DropNotify(source=8, reason=133))
+        again = client.monitor_poll(sid, timeout=2, ack=0)
+        assert again["seq"] == seq1
+        assert [e["source"] for e in again["events"]] == [7]
+
+        # ack the batch: the next poll advances to the new event
+        got2 = client.monitor_poll(sid, timeout=2, ack=seq1)
+        assert [e["source"] for e in got2["events"]] == [8]
+        assert got2["seq"] == seq1 + 1
+
+        # legacy pollers (no ack) keep advancing (implicit ack)
+        d.monitor.publish(DropNotify(source=9, reason=133))
+        got3 = client.monitor_poll(sid, timeout=2)
+        assert [e["source"] for e in got3["events"]] == [9]
+    finally:
+        server.stop()

@@ -431,3 +431,96 @@ def test_lb_inline_fallback_wide_service():
     )
     assert bool(np.asarray(is_svc)[0])
     assert 1 <= int(np.asarray(slave)[0]) <= 60
+
+
+def test_merged_ct_probe_dnat_dual_home():
+    """The egress program fetches ONE CT row by the pre-DNAT tuple and
+    probes both the service-scope key and the post-DNAT flow key
+    against it.  A DNATed flow's entry is dual-homed, so the second
+    packet must see ESTABLISHED (and the reply direction REPLY) with
+    service-entry stickiness pinning the backend."""
+    import jax
+    from cilium_tpu.ct.device import compile_ct, ct_lookup_batch
+    from cilium_tpu.ct.table import (
+        CT_EGRESS,
+        CT_ESTABLISHED,
+        CT_NEW,
+        CT_REPLY,
+        CT_SERVICE,
+        CTMap,
+        TUPLE_F_SERVICE,
+    )
+    from cilium_tpu.engine.datapath import apply_ct_writeback_host
+    import ipaddress
+
+    vip = int(ipaddress.IPv4Address("10.96.9.1"))
+    backend = int(ipaddress.IPv4Address("10.3.0.7"))
+    client = int(ipaddress.IPv4Address("10.0.0.5"))
+
+    ct = CTMap()
+    # the writeback a NEW VIP flow produces: flow entry keyed
+    # post-DNAT, plus the service-scope stickiness entry
+    created, _ = apply_ct_writeback_host(
+        ct,
+        np.asarray([True]), np.asarray([False]),
+        np.asarray([backend]), np.asarray([8080]),
+        np.asarray([client]), np.asarray([4001]),
+        np.asarray([6]), np.asarray([1]),  # egress
+        np.asarray([3]), np.asarray([2]),  # rev_nat=3, slave=2
+        orig_daddr=np.asarray([vip]), orig_dport=np.asarray([80]),
+    )
+    assert len(created) == 2  # flow entry + service entry
+    svc_keys = [k for k in ct.entries if k.flags & TUPLE_F_SERVICE]
+    assert len(svc_keys) == 1 and ct.entries[svc_keys[0]].slave == 2
+
+    snap = jax.device_put(compile_ct(ct))
+
+    def probe(daddr, dport, direction, fetch_daddr, fetch_dport):
+        """Fetch by the pre-DNAT tuple, probe the given key (the
+        merged egress pattern)."""
+        from cilium_tpu.ct.device import ct_fetch_rows, ct_probe_rows
+        import jax.numpy as jnp
+
+        rows = ct_fetch_rows(
+            snap,
+            jnp.asarray(np.asarray([fetch_daddr], np.uint32)),
+            jnp.asarray(np.asarray([client], np.uint32)),
+            jnp.asarray(np.asarray([fetch_dport], np.int32)),
+            jnp.asarray(np.asarray([4001], np.int32)),
+            jnp.asarray(np.asarray([6], np.int32)),
+        )
+        res, rev, slave = ct_probe_rows(
+            snap, rows,
+            jnp.asarray(np.asarray([daddr], np.uint32)),
+            jnp.asarray(np.asarray([client], np.uint32)),
+            jnp.asarray(np.asarray([dport], np.int32)),
+            jnp.asarray(np.asarray([4001], np.int32)),
+            jnp.asarray(np.asarray([6], np.int32)),
+            jnp.asarray(np.asarray([direction], np.int32)),
+        )
+        return int(np.asarray(res)[0]), int(np.asarray(rev)[0]), int(
+            np.asarray(slave)[0]
+        )
+
+    # service probe in the pre-DNAT row: sticky slave
+    res, rev, slave = probe(vip, 80, CT_SERVICE, vip, 80)
+    assert res == CT_ESTABLISHED and slave == 2 and rev == 3
+    # flow probe of the POST-DNAT key against the PRE-DNAT row
+    # (dual-homed copy)
+    res, _, _ = probe(backend, 8080, CT_EGRESS, vip, 80)
+    assert res == CT_ESTABLISHED
+    # ingress reply probes its own (post-DNAT-normalized) bucket
+    res2, rev2, _ = ct_lookup_batch(
+        snap,
+        jnp.asarray(np.asarray([client], np.uint32)),
+        jnp.asarray(np.asarray([backend], np.uint32)),
+        jnp.asarray(np.asarray([4001], np.int32)),
+        jnp.asarray(np.asarray([8080], np.int32)),
+        jnp.asarray(np.asarray([6], np.int32)),
+        jnp.asarray(np.asarray([0], np.int32)),  # ingress
+    )
+    assert int(np.asarray(res2)[0]) == CT_REPLY
+    assert int(np.asarray(rev2)[0]) == 3  # rev-NAT index for un-DNAT
+    # an unrelated tuple stays NEW
+    res, _, _ = probe(backend, 9999, CT_EGRESS, backend, 9999)
+    assert res == CT_NEW

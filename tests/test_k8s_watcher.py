@@ -196,3 +196,178 @@ def test_service_endpoints_feed_lb_and_retranslation():
         for c in (egress.to_cidr_set or [])
     }
     assert cidrs == {"10.7.0.9/32"}
+
+
+# ---------------------------------------------------------------------------
+# informer breadth: Pod / Namespace / Node / Ingress
+# (daemon/k8s_watcher.go:72-79,453-671)
+# ---------------------------------------------------------------------------
+
+
+def _pod(name, ip, labels, namespace="default"):
+    return {
+        "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": namespace, "labels": labels,
+        },
+        "status": {"podIP": ip},
+    }
+
+
+def test_pod_label_update_reallocates_identity():
+    """Pod label change → endpoint UpdateLabels → new identity with
+    the pod's labels (+ the namespace key space)."""
+    d, api, services, watcher = _world()
+    d.policy_trigger.close(wait=True)
+    from cilium_tpu.labels import Label, Labels
+
+    ep = d.create_endpoint(
+        300, Labels({"app": Label("app", "web", "k8s")}),
+        ipv4="10.11.0.1", name="web-0",
+    )
+    watcher.start()
+    assert watcher.wait_for_sync()
+
+    api.upsert("Pod", _pod("web-0", "10.11.0.1", {"app": "web",
+                                                  "tier": "front"}))
+    watcher.drain()
+    ident = d.endpoint_manager.lookup(300).security_identity
+    assert ident.labels["tier"].value == "front"
+    assert (
+        ident.labels["io.kubernetes.pod.namespace"].value == "default"
+    )
+
+    # label UPDATE re-allocates
+    api.upsert("Pod", _pod("web-0", "10.11.0.1", {"app": "web",
+                                                  "tier": "back"}))
+    watcher.drain()
+    ident2 = d.endpoint_manager.lookup(300).security_identity
+    assert ident2.id != ident.id
+    assert ident2.labels["tier"].value == "back"
+    watcher.close()
+
+
+def test_namespace_labels_visible_to_endpoints():
+    """Namespace label change re-derives every tracked pod endpoint's
+    labels in that namespace (io.cilium.k8s.namespace.labels.*)."""
+    d, api, services, watcher = _world()
+    d.policy_trigger.close(wait=True)
+    from cilium_tpu.labels import Label, Labels
+
+    d.create_endpoint(
+        301, Labels({"app": Label("app", "api", "k8s")}),
+        ipv4="10.11.0.2", name="api-0",
+    )
+    watcher.start()
+    api.upsert("Pod", _pod("api-0", "10.11.0.2", {"app": "api"}))
+    watcher.drain()
+
+    api.upsert(
+        "Namespace",
+        {
+            "kind": "Namespace",
+            "metadata": {"name": "default",
+                         "labels": {"env": "prod"}},
+        },
+    )
+    watcher.drain()
+    ident = d.endpoint_manager.lookup(301).security_identity
+    key = "io.cilium.k8s.namespace.labels.env"
+    assert ident.labels[key].value == "prod"
+    watcher.close()
+
+
+def test_node_informer_feeds_tunnel_map():
+    """Remote node's pod CIDR + InternalIP → tunnel map entry; the
+    local node is skipped; delete removes it."""
+    import ipaddress
+
+    d, api, services, watcher = _world()
+    watcher.start()
+    api.upsert(
+        "Node",
+        {
+            "kind": "Node",
+            "metadata": {"name": "remote-1"},
+            "spec": {"podCIDR": "10.40.0.0/16"},
+            "status": {
+                "addresses": [
+                    {"type": "InternalIP", "address": "192.168.7.2"}
+                ]
+            },
+        },
+    )
+    # the daemon's OWN node must not get a tunnel entry
+    api.upsert(
+        "Node",
+        {
+            "kind": "Node",
+            "metadata": {"name": d.node_name},
+            "spec": {"podCIDR": "10.41.0.0/16"},
+            "status": {
+                "addresses": [
+                    {"type": "InternalIP", "address": "192.168.7.1"}
+                ]
+            },
+        },
+    )
+    watcher.drain()
+    prefixes = dict(d.tunnel_map._prefixes)
+    assert any(p.startswith("10.40.") for p in prefixes)
+    assert not any(p.startswith("10.41.") for p in prefixes)
+    api.delete("Node", "default", "remote-1")
+    watcher.drain()
+    assert not d.tunnel_map._prefixes
+    watcher.close()
+
+
+def test_ingress_creates_external_lb_service():
+    """Single-service ingress → frontend on the host IP at the
+    backend service's port, backed by the service's endpoints."""
+    d, api, services, watcher = _world()
+    watcher.start()
+    api.upsert(
+        "Service",
+        {
+            "kind": "Service",
+            "metadata": {"name": "shop", "namespace": "default"},
+            "spec": {
+                "selector": {"app": "shop"},
+                "clusterIP": "172.20.0.9",
+                "ports": [{"port": 80, "protocol": "TCP"}],
+            },
+        },
+    )
+    api.upsert(
+        "Endpoints",
+        {
+            "kind": "Endpoints",
+            "metadata": {"name": "shop", "namespace": "default"},
+            "subsets": [
+                {"addresses": [{"ip": "10.12.0.1"},
+                               {"ip": "10.12.0.2"}]}
+            ],
+        },
+    )
+    api.upsert(
+        "Ingress",
+        {
+            "kind": "Ingress",
+            "metadata": {"name": "shop-ing", "namespace": "default"},
+            "spec": {
+                "backend": {"serviceName": "shop", "servicePort": 80}
+            },
+        },
+    )
+    watcher.drain()
+    frontend = L3n4Addr(watcher.host_ip, 80, 6)
+    svc = services.lookup(frontend)
+    assert svc is not None
+    assert sorted(b.addr.ip for b in svc.backends) == [
+        "10.12.0.1", "10.12.0.2",
+    ]
+    # ingress deletion removes the external frontend
+    api.delete("Ingress", "default", "shop-ing")
+    watcher.drain()
+    assert services.lookup(frontend) is None
+    watcher.close()

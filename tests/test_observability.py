@@ -64,10 +64,14 @@ def test_verdicts_to_events():
         directions=np.array([0, 0]),
         emit_allowed=True,
     )
-    assert n == 2
+    # allow verdict + (deny verdict + drop) — the reference's
+    # PolicyVerdictNotification covers BOTH outcomes
+    assert n == 3
     assert isinstance(events[0], PolicyVerdictNotify) and events[0].allowed
-    assert isinstance(events[1], DropNotify)
-    assert events[1].reason == 133 and events[1].src_label == 256
+    assert isinstance(events[1], PolicyVerdictNotify)
+    assert not events[1].allowed
+    assert isinstance(events[2], DropNotify)
+    assert events[2].reason == 133 and events[2].src_label == 256
 
 
 def test_metrics_registry_exposition():
@@ -174,7 +178,12 @@ def test_process_flows_feeds_monitor():
     q.clear()
     d.process_flows(buf, batch_size=32)
     verdicts = [e for e in q if isinstance(e, PolicyVerdictNotify)]
-    assert len(verdicts) == stats.allowed and stats.allowed > 0
+    # opted-in endpoints see BOTH outcomes (the reference emits the
+    # deny verdict alongside the DropNotify)
+    allows = [e for e in verdicts if e.allowed]
+    denies = [e for e in verdicts if not e.allowed]
+    assert len(allows) == stats.allowed and stats.allowed > 0
+    assert len(denies) == stats.denied and stats.denied > 0
     assert all(e.source == 10 for e in verdicts)
 
     # the GLOBAL option covers every endpoint

@@ -135,3 +135,83 @@ def test_table_axis_one_degenerates():
     np.testing.assert_array_equal(
         np.asarray(got.proxy_port), np.asarray(ref.proxy_port)
     )
+
+
+def test_scaled_world_fused_mesh_vs_host_oracle():
+    """Config5-SHAPED world (thousands of identities through the real
+    control plane, mixed rules, CT/LB/prefilter populated): the FULL
+    fused datapath over a batch-sharded mesh must stay bit-identical
+    to the composed HOST oracle, and the bare lattice over the 2D
+    (batch x table) mesh must match single-device — with the table
+    axis holding MANY bit-words per shard (the >HBM sharding shape of
+    SURVEY §2.9)."""
+    import __graft_entry__ as ge
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from cilium_tpu.engine.datapath import (
+        FlowBatch,
+        _datapath_kernel_accum,
+    )
+    from cilium_tpu.engine.hostpath import composed_oracle
+    from cilium_tpu.engine.verdict import make_counter_buffers
+
+    tables, pool, oracle_ctx, states = ge._build_scaled_world(
+        n_identities=2048, n_rules=256, n_endpoints=4
+    )
+    stables = tables.policy
+    n_ids = stables.id_table.shape[0]
+    assert (n_ids // 32) % 2 == 0
+    assert n_ids // 32 // 2 >= 16  # many words per shard
+
+    devs = jax.devices("cpu")[:8]
+    mesh2d = Mesh(np.array(devs).reshape(4, 2), ("batch", "table"))
+    rng = np.random.default_rng(9)
+    real_ids = stables.id_table[
+        stables.id_table != np.uint32(0xFFFFFFFF)
+    ]
+    t = dict(
+        ep_index=rng.integers(0, stables.l4_meta.shape[0], size=512),
+        identity=rng.choice(real_ids, size=512),
+        dport=rng.integers(1, 30000, size=512),
+        proto=rng.choice([6, 17], size=512),
+        direction=rng.integers(0, 2, size=512),
+    )
+    batch = TupleBatch.from_numpy(**t)
+    got, l4c, l3c = make_mesh_evaluator(mesh2d)(stables, batch)
+    ref = evaluate_batch(stables, batch)
+    np.testing.assert_array_equal(
+        np.asarray(got.allowed), np.asarray(ref.allowed)
+    )
+
+    # full fused path, batch-sharded, vs the composed host oracle
+    mesh1d = Mesh(np.array(devs), ("batch",))
+    replicated = NamedSharding(mesh1d, P())
+    sharded = NamedSharding(mesh1d, P("batch"))
+    b = (len(pool["saddr"]) // 8) * 8
+    flows = FlowBatch.from_numpy(
+        **{k: pool[k][:b] for k in (
+            "ep_index", "saddr", "daddr", "sport", "dport", "proto",
+            "direction", "is_fragment",
+        )}
+    )
+    step = jax.jit(
+        _datapath_kernel_accum,
+        in_shardings=(replicated, sharded, replicated),
+        donate_argnums=(2,),
+    )
+    out, _ = step(
+        jax.device_put(tables, replicated),
+        jax.device_put(flows, sharded),
+        jax.device_put(make_counter_buffers(stables), replicated),
+    )
+    sample = rng.integers(0, b, size=256)
+    want_allow, want_proxy, _ = composed_oracle(
+        oracle_ctx, states, pool, list(sample)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.allowed)[sample], want_allow
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.proxy_port)[sample], want_proxy
+    )
