@@ -1021,6 +1021,157 @@ def _gate_combined(
 
 
 # ---------------------------------------------------------------------------
+# config 6: the fused IPv6 datapath (ipv6_policy + lb6_local)
+# ---------------------------------------------------------------------------
+
+
+def config6(args) -> None:
+    """v6 sibling of the fused replay: prefilter6 → lb6 DNAT with
+    service stickiness → CT6 → ipcache6 → shared lattice, timed at a
+    1M-flow batch with a composed-oracle subsample."""
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.ct.table import (
+        CT_EGRESS,
+        CT_INGRESS,
+        CT_RELATED,
+        CT_REPLY,
+        CTMap,
+        CTTuple,
+    )
+    from cilium_tpu.engine.datapath6 import (
+        Datapath6Tables,
+        FlowBatch6,
+        build_prefilter6,
+        compile_ct6,
+        datapath6_step,
+    )
+    from cilium_tpu.engine.oracle import policy_can_access
+    from cilium_tpu.identity import RESERVED_WORLD
+    from cilium_tpu.ipcache.lpm6 import (
+        build_ipcache6,
+        ip6_limbs,
+        lookup_host6,
+    )
+    from cilium_tpu.lb.device6 import (
+        compile_lb6,
+        lb6_lookup_host,
+        slave_for_host,
+    )
+    from cilium_tpu.lb.service import L3n4Addr, ServiceManager
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    rng = np.random.default_rng(29)
+    n_ident = 4096
+    base_id = 4096
+    ids = list(range(base_id, base_id + n_ident))
+    # /128 per identity under 2001:db8::/32 + some broader nets
+    ipcache6 = {}
+    addrs = []
+    for i, num_id in enumerate(ids):
+        a = f"2001:db8:{i >> 8:x}:{i & 0xFF:x}::{(i % 9) + 1:x}"
+        ipcache6[f"{a}/128"] = num_id
+        addrs.append(a)
+    ipcache6["fd00::/8"] = ids[0]
+
+    state = {}
+    ports = rng.choice(np.arange(1000, 30000), size=64, replace=False)
+    for num_id in ids[::2]:
+        p = int(ports[num_id % len(ports)])
+        state[PolicyKey(num_id, p, 6, INGRESS)] = PolicyMapStateEntry()
+    for num_id in ids[::5]:
+        state[PolicyKey(num_id, 0, 0, INGRESS)] = PolicyMapStateEntry()
+    for num_id in ids[::3]:
+        state[PolicyKey(num_id, 8443, 6, 1)] = PolicyMapStateEntry()
+    tables_pol = compile_map_states([state], ids, identity_pad=1024)
+
+    mgr = ServiceManager()
+    vip = "fd00:77::1"
+    backends = addrs[:4]
+    mgr.upsert(
+        L3n4Addr(vip, 443, 6),
+        [L3n4Addr(b, 8443, 6) for b in backends],
+    )
+    ct = CTMap()
+    world = Datapath6Tables(
+        prefilter=build_prefilter6(["2600:1::/32"]),
+        ipcache=build_ipcache6(ipcache6),
+        ct=compile_ct6(ct),
+        policy=tables_pol,
+        lb=compile_lb6(mgr),
+    )
+    world = jax.device_put(world)
+
+    n = 1 << 20
+    pick = rng.integers(0, len(addrs), size=n)
+    saddr = np.array([ip6_limbs(a) for a in addrs], np.uint32)[pick]
+    to_vip = rng.random(n) < 0.1
+    dpick = rng.integers(0, len(addrs), size=n)
+    daddr = np.array([ip6_limbs(a) for a in addrs], np.uint32)[dpick]
+    daddr[to_vip] = ip6_limbs(vip)
+    direction = (rng.random(n) < 0.5).astype(np.int64)
+    direction[to_vip] = 1
+    dport = rng.choice(ports, size=n).astype(np.int64)
+    dport[to_vip] = 443
+    flows = FlowBatch6.from_numpy(
+        ep_index=np.zeros(n, np.int32),
+        saddr=saddr,
+        daddr=daddr,
+        sport=rng.integers(1024, 60000, size=n),
+        dport=dport,
+        proto=np.full(n, 6),
+        direction=direction,
+    )
+    flows = jax.device_put(flows)
+    out = datapath6_step(world, flows)
+    jax.block_until_ready(out.allowed)
+
+    # composed oracle subsample (incl. lb6 DNAT)
+    allowed = np.asarray(out.allowed)
+    slave_arr = np.asarray(out.lb_slave)
+    sample = rng.integers(0, n, size=256)
+    for i in sample:
+        s = addrs[int(pick[i])]
+        d = vip if to_vip[i] else addrs[int(dpick[i])]
+        dirn = int(direction[i])
+        eff_d, eff_p = d, int(dport[i])
+        if dirn == 1:
+            svc = lb6_lookup_host(mgr, d, eff_p, 6)
+            if svc is not None and svc.backends:
+                sl = slave_for_host(
+                    svc, s, d, int(np.asarray(flows.sport)[i]),
+                    eff_p, 6,
+                )
+                assert int(slave_arr[i]) == sl, i
+                eff_d = svc.backends[sl - 1].addr.ip
+                eff_p = svc.backends[sl - 1].addr.port
+        sec_ip = s if dirn == INGRESS else eff_d
+        sec = lookup_host6(ipcache6, sec_ip) or RESERVED_WORLD
+        v = policy_can_access(state, sec, eff_p, 6, dirn)
+        assert bool(allowed[i]) == v.allowed, i
+
+    t0 = time.perf_counter()
+    outs = [datapath6_step(world, flows) for _ in range(8)]
+    jax.block_until_ready(outs)
+    vps = 8 * n / (time.perf_counter() - t0)
+    emit(
+        "config6_ipv6_fused_verdicts_per_sec",
+        round(vps),
+        "verdicts/s",
+        tuples=n,
+        identities=n_ident,
+        bit_identical=True,
+        note="fused v6: prefilter6+lb6/DNAT+CT6+ipcache6+lattice",
+    )
+
+
+# ---------------------------------------------------------------------------
 # config 1: minimum end-to-end slice
 # ---------------------------------------------------------------------------
 
@@ -1404,8 +1555,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument(
-        "--configs", default="1,2,3,4,5",
-        help="comma-separated subset of 1-5",
+        "--configs", default="1,2,3,4,5,6",
+        help="comma-separated subset of 1-6",
     )
     ap.add_argument("--rules", type=int, default=50_000)
     ap.add_argument("--endpoints", type=int, default=32)
@@ -1437,6 +1588,8 @@ def main() -> None:
         config3(args)
     if "4" in configs:
         config4(args)
+    if "6" in configs:
+        config6(args)
     if "5" in configs and _HEADLINE:
         print(json.dumps(_HEADLINE), flush=True)  # re-emit for tail-parse
 

@@ -44,6 +44,7 @@ import threading
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
+from cilium_tpu import option
 from cilium_tpu.labels import LabelArray
 from cilium_tpu.metrics import registry as metrics
 from cilium_tpu.policy.api import rules_from_json
@@ -98,6 +99,10 @@ class DaemonAPI:
         return {
             "policy_enforcement": cfg.policy_enforcement,
             "options": dict(getattr(cfg, "opts", {}) or {}),
+            # the option LIBRARY: define/description/requires per
+            # option (option.go's descriptor table, for `cilium
+            # config --list-options`)
+            "library": cfg.opts.describe(),
             "ipam_cidr": str(self.daemon.ipam.cidr),
         }
 

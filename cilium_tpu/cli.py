@@ -176,7 +176,17 @@ def cmd_monitor(api, args) -> int:
             ack = got.get("seq", ack)
             # a re-delivered batch may exceed this poll's budget
             for ev in got["events"][:remaining]:
-                print(json.dumps(ev))
+                if args.verbose:
+                    # `cilium monitor -v`: dissected one-line
+                    # rendering (pkg/monitor/dissect.go + the
+                    # per-event formatters)
+                    from cilium_tpu.monitor.dissect import (
+                        dissect_event,
+                    )
+
+                    print(dissect_event(ev))
+                else:
+                    print(json.dumps(ev))
                 printed += 1
             if args.once and not got["events"]:
                 break
@@ -258,6 +268,8 @@ def make_parser() -> argparse.ArgumentParser:
     mon.add_argument("--timeout", type=float, default=5.0)
     mon.add_argument("--once", action="store_true",
                      help="exit after one empty poll")
+    mon.add_argument("-v", "--verbose", action="store_true",
+                     help="dissected human-readable rendering")
     mon.set_defaults(func=cmd_monitor)
 
     config = sub.add_parser("config")

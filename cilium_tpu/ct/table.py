@@ -131,6 +131,9 @@ class CTMap:
         # — so wall-clock epochs can never mass-expire entries that
         # were stamped on a relative scale
         self._epoch = _time.monotonic()
+        # ConntrackAccounting: per-flow packet/byte counters on probe
+        # (flipped by the owning daemon's option hook)
+        self.accounting = True
         # bumped on every mutation THROUGH this map (create, probe
         # side effects, gc) — replay's device-snapshot cache gates on
         # it plus the key set, so host-side lookups between replays
@@ -185,12 +188,18 @@ class CTMap:
             ct_state.rev_nat_index = entry.rev_nat_index
             ct_state.loopback = entry.lb_loopback
             ct_state.slave = entry.slave
-        if dir == CT_INGRESS:
-            entry.rx_packets += 1
-            entry.rx_bytes += pkt_len
-        else:
-            entry.tx_packets += 1
-            entry.tx_bytes += pkt_len
+        if self.accounting:
+            # per-flow statistics are compiled out when the
+            # ConntrackAccounting option is off (the reference's
+            # CONNTRACK_ACCOUNTING #define gates the counter bumps);
+            # the owning daemon flips this flag on option change —
+            # standalone maps account unconditionally
+            if dir == CT_INGRESS:
+                entry.rx_packets += 1
+                entry.rx_bytes += pkt_len
+            else:
+                entry.tx_packets += 1
+                entry.tx_bytes += pkt_len
         if action == "create":
             if entry.rx_closing or entry.tx_closing:
                 # connection being reopened (conntrack.h:259-264)

@@ -693,7 +693,15 @@ class Daemon:
         expired entries leave the host map; gc() bumps the map's
         mutation counter, so the churn snapshot cache self-invalidates
         at its next use (replay._ChurnDriver gate) and the device CT
-        resyncs."""
+        resyncs.  Still sweeps a NON-EMPTY table while the Conntrack
+        option is off: disabling flushed it, but replay harnesses may
+        repopulate the daemon map afterwards — entries must never
+        accumulate unbounded just because GC went dormant."""
+        if (
+            not option.Config.opts.is_enabled(option.CONNTRACK)
+            and not self.ct.entries
+        ):
+            return
         self.ct.gc(now=self.ct.now())
 
     def service_upsert(
@@ -720,18 +728,13 @@ class Daemon:
         with self.lock:
             # validate EVERYTHING before mutating anything: a partial
             # apply followed by a 400 would silently diverge daemon
-            # state from what the client believes
+            # state from what the client believes.  Validation is the
+            # option LIBRARY's parse+verify (option.go ParseOption):
+            # booleans for most options, level names/ints for
+            # MonitorAggregationLevel, NAT46's unsupported-gate, etc.
             raw_opts = changes.get("options") or {}
             for k, v in raw_opts.items():
-                if k not in option.KNOWN_OPTIONS:
-                    raise ValueError(f"unknown option {k}")
-                if not isinstance(v, bool):
-                    # bool("false") is True — stringified booleans
-                    # must be rejected, not inverted
-                    raise ValueError(
-                        f"option {k} requires a JSON boolean, "
-                        f"got {v!r}"
-                    )
+                option.Config.opts.parse_validate(k, v)
             enforcement = changes.get("policy_enforcement")
             if enforcement is not None and enforcement not in (
                 option.DEFAULT_ENFORCEMENT,
@@ -742,7 +745,21 @@ class Daemon:
                     f"unknown enforcement mode {enforcement!r}"
                 )
             if raw_opts:
-                applied += option.Config.opts.apply(dict(raw_opts))
+                ct_before = option.Config.opts.is_enabled(
+                    option.CONNTRACK
+                )
+                applied += option.Config.opts.apply(
+                    dict(raw_opts), changed_hook=self._option_changed
+                )
+                # conntrack on/off changes verdict semantics
+                # (REPLY/RELATED bypass exists only with CT) — and it
+                # can flip via DEPENDENCY propagation (enabling
+                # ConntrackAccounting enables Conntrack), so compare
+                # states instead of checking the request keys
+                if option.Config.opts.is_enabled(
+                    option.CONNTRACK
+                ) != ct_before:
+                    verdict_affecting = True
             if enforcement is not None:
                 if option.Config.policy_enforcement != enforcement:
                     option.Config.policy_enforcement = enforcement
@@ -760,6 +777,31 @@ class Daemon:
             "options": dict(option.Config.opts),
         }
 
+    def _option_changed(self, name: str, value: int) -> None:
+        """Behavioral hooks behind runtime options (the analog of the
+        reference regenerating datapath programs whose #defines
+        changed): logging levels flip immediately; disabling
+        conntrack flushes the table the way the agent tears down CT
+        state when CONNTRACK is compiled out."""
+        import logging as _pylogging
+
+        from cilium_tpu import logging as tpulog
+
+        if name == option.DEBUG:
+            tpulog.set_level(
+                _pylogging.DEBUG if value else _pylogging.INFO
+            )
+        elif name == option.DEBUG_LB:
+            tpulog.set_level(
+                _pylogging.DEBUG if value else _pylogging.INFO,
+                subsys="lb",
+            )
+        elif name == option.CONNTRACK_ACCOUNTING:
+            self.ct.accounting = bool(value)
+        elif name == option.CONNTRACK and not value:
+            self.ct.entries.clear()
+            self.ct.mutations += 1
+
     def endpoint_config_patch(
         self, endpoint_id: int, changes: Dict
     ) -> Dict:
@@ -769,12 +811,7 @@ class Daemon:
         reference (it lands in the generated header)."""
         opts = changes.get("options") or {}
         for k, v in opts.items():
-            if k not in option.KNOWN_OPTIONS:
-                raise ValueError(f"unknown option {k}")
-            if not isinstance(v, bool):
-                raise ValueError(
-                    f"option {k} requires a JSON boolean, got {v!r}"
-                )
+            option.Config.opts.parse_validate(k, v)
         with self.lock:
             endpoint = self.endpoint_manager.lookup(endpoint_id)
             if endpoint is None:
@@ -877,6 +914,7 @@ class Daemon:
                 match_kind=np.asarray(out.match_kind)[:valid],
                 proxy_port=np.asarray(out.proxy_port)[:valid],
             )
+            opts = option.Config.opts
             verdicts_to_events(
                 self.monitor,
                 v,
@@ -886,6 +924,12 @@ class Daemon:
                 protos=np.asarray(batch.proto)[:valid],
                 directions=np.asarray(batch.direction)[:valid],
                 verdict_eps=verdict_eps,
+                emit_drops=opts.is_enabled(option.DROP_NOTIFICATION),
+                emit_trace=(
+                    opts.is_enabled(option.TRACE_NOTIFICATION)
+                    and opts.level(option.MONITOR_AGGREGATION)
+                    == option.MONITOR_AGG_NONE
+                ),
             )
         stats.seconds = _time.perf_counter() - t0
         return stats

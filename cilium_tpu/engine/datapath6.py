@@ -15,9 +15,10 @@ bucket-row design:
   * the SAME policy lattice tables as v4 (identities are
     family-agnostic, as in the reference's shared policymap).
 
-Service LB for v6 (lb6_local) is not yet lowered to the device; v6
-service flows should stay on the host path until it is (tracked as
-follow-up work — the v4 LB design generalizes limb-for-limb).
+Service LB for v6 (lb6_local, bpf/lib/lb.h lb6_*) IS lowered:
+lb/device6.py's inline single-gather layout resolves the v6 service
+and backend, with CT6 service-scope stickiness probed first exactly
+as the v4 program does.
 
 Mixed v4/v6 batches run each family through its own program, exactly
 as packets hit one of the reference's two program sections.
@@ -39,11 +40,13 @@ from cilium_tpu.ct.table import (
     CT_NEW,
     CT_RELATED,
     CT_REPLY,
+    CT_SERVICE,
     CTMap,
     CTTuple,
     TUPLE_F_IN,
     TUPLE_F_OUT,
     TUPLE_F_RELATED,
+    TUPLE_F_SERVICE,
 )
 from cilium_tpu.engine.hashtable import _fnv1a_host, fnv1a_device
 from cilium_tpu.engine.verdict import TupleBatch, _combine, _probes
@@ -262,7 +265,9 @@ def ct6_lookup_batch(
     """ct_lookup6: one bucket row gather, forward+reverse lane
     compares (the v4 kernel generalized limb-for-limb)."""
     base_flags = jnp.where(
-        direction == CT_INGRESS, TUPLE_F_OUT, TUPLE_F_IN
+        direction == CT_INGRESS,
+        TUPLE_F_OUT,
+        jnp.where(direction == CT_EGRESS, TUPLE_F_IN, TUPLE_F_SERVICE),
     ).astype(jnp.uint32)
     if related_icmp is not None:
         base_flags = base_flags | jnp.where(
@@ -372,12 +377,13 @@ class Datapath6Tables:
     ct: CT6Snapshot
     policy: object  # compiler.tables.PolicyTables (shared with v4)
     tunnel: object = None  # tunnel.TunnelTables6 or None
+    lb: object = None  # lb.device6.LB6Inline or None (no v6 services)
 
     def tree_flatten(self):
         return (
             (
                 self.prefilter, self.ipcache, self.ct, self.policy,
-                self.tunnel,
+                self.tunnel, self.lb,
             ),
             None,
         )
@@ -401,6 +407,12 @@ class Datapath6Verdicts:
     # u32 [B] remote node IP (v4 underlay) to encapsulate to; 0 =
     # direct/local — all-zero without a tunnel table
     tunnel_endpoint: jax.Array = None
+    # post-DNAT destination (lb6_local); equal to the input daddr /
+    # dport for non-service flows
+    final_daddr: jax.Array = None  # u32 [B, 4]
+    final_dport: jax.Array = None  # i32 [B]
+    rev_nat: jax.Array = None  # i32 [B]
+    lb_slave: jax.Array = None  # i32 [B]
 
     def tree_flatten(self):
         return (
@@ -414,6 +426,10 @@ class Datapath6Verdicts:
                 self.ct_create,
                 self.ct_delete,
                 self.tunnel_endpoint,
+                self.final_daddr,
+                self.final_dport,
+                self.rev_nat,
+                self.lb_slave,
             ),
             None,
         )
@@ -426,25 +442,64 @@ class Datapath6Verdicts:
 def _datapath6_kernel(
     tables: Datapath6Tables, flows: FlowBatch6
 ) -> Datapath6Verdicts:
-    """ipv6_policy (bpf_lxc.c:754): prefilter → CT6 → ipcache6 →
-    shared policy lattice → combine.  (lb6_local not yet lowered —
-    module docstring.)"""
+    """ipv6_policy (bpf_lxc.c:754): prefilter → lb6_local (service
+    DNAT with CT6 service-scope stickiness) → CT6 → ipcache6 →
+    shared policy lattice → combine."""
     ingress = flows.direction == INGRESS
 
     pre_drop = prefilter6_drop(tables.prefilter, flows.saddr)
 
+    # -- lb6_local: v6 service DNAT on egress flows ---------------------
+    if tables.lb is not None:
+        from cilium_tpu.lb.device6 import lb6_select_batch
+
+        svc_dir = jnp.full_like(flows.direction, CT_SERVICE)
+        _, _, svc_slave = ct6_lookup_batch(
+            tables.ct,
+            flows.daddr,
+            flows.saddr,
+            flows.dport,
+            flows.sport,
+            flows.proto,
+            svc_dir,
+        )
+        svc_found, slave, lb_daddr, lb_dport, lb_rev = (
+            lb6_select_batch(
+                tables.lb,
+                flows.saddr,
+                flows.daddr,
+                flows.sport,
+                flows.dport,
+                flows.proto,
+                ct_slave=svc_slave,
+            )
+        )
+        do_lb = (~ingress) & svc_found
+        eff_daddr = jnp.where(
+            do_lb[:, None], lb_daddr, flows.daddr.astype(jnp.uint32)
+        )
+        eff_dport = jnp.where(do_lb, lb_dport, flows.dport)
+        rev_nat = jnp.where(do_lb, lb_rev, 0)
+        lb_slave = jnp.where(do_lb, slave, 0)
+    else:
+        zero = jnp.zeros(flows.dport.shape, jnp.int32)
+        eff_daddr = flows.daddr.astype(jnp.uint32)
+        eff_dport = flows.dport
+        rev_nat = zero
+        lb_slave = zero
+
     ct_res, _ct_rev, _ = ct6_lookup_batch(
         tables.ct,
-        flows.daddr,
+        eff_daddr,
         flows.saddr,
-        flows.dport,
+        eff_dport,
         flows.sport,
         flows.proto,
         flows.direction,
     )
 
     sec_limbs = jnp.where(
-        ingress[:, None], flows.saddr, flows.daddr
+        ingress[:, None], flows.saddr, eff_daddr
     )
     looked = ipcache6_lookup(tables.ipcache, sec_limbs)
     sec_id = jnp.where(
@@ -454,7 +509,7 @@ def _datapath6_kernel(
     resolved = TupleBatch(
         ep_index=flows.ep_index,
         identity=sec_id,
-        dport=flows.dport,
+        dport=eff_dport,
         proto=flows.proto,
         direction=flows.direction,
         is_fragment=flows.is_fragment,
@@ -478,13 +533,13 @@ def _datapath6_kernel(
     )
     # overlay decision (the v4 program's stage 7, limb-masked): an
     # allowed egress flow into a remote node's v6 pod CIDR carries
-    # that node's (v4 underlay) IP
+    # that node's (v4 underlay) IP — on the POST-DNAT destination
     if tables.tunnel is not None:
         from cilium_tpu.tunnel import tunnel_select6
 
         tunnel_ep = jnp.where(
             allowed & ~ingress,
-            tunnel_select6(tables.tunnel, flows.daddr),
+            tunnel_select6(tables.tunnel, eff_daddr),
             jnp.uint32(0),
         )
     else:
@@ -500,7 +555,83 @@ def _datapath6_kernel(
         ct_create=ct_create,
         ct_delete=ct_delete,
         tunnel_endpoint=tunnel_ep,
+        final_daddr=eff_daddr,
+        final_dport=eff_dport,
+        rev_nat=rev_nat,
+        lb_slave=lb_slave,
     )
 
 
 datapath6_step = jax.jit(_datapath6_kernel)
+
+
+def _int_of_limbs(limbs) -> int:
+    v = 0
+    for k in range(4):
+        v = (v << 32) | int(limbs[k])
+    return v
+
+
+def apply_ct_writeback6(
+    ct: CTMap, out: Datapath6Verdicts, flows: FlowBatch6, now: int = 0
+) -> tuple:
+    """Host-side v6 CT mutation after a batch: NEW+allowed flows
+    create entries on the post-DNAT tuple (+ the SERVICE-scope
+    stickiness entry for load-balanced flows, lb6_local's ct_create6),
+    ESTABLISHED-but-denied flows delete.  Returns (created, deleted)
+    counts.  Addresses stay 128-bit ints in the host map, exactly as
+    compile_ct6 expects them."""
+    create = np.asarray(out.ct_create)
+    delete = np.asarray(out.ct_delete)
+    fdaddr = np.asarray(out.final_daddr)
+    fdport = np.asarray(out.final_dport)
+    saddr = np.asarray(flows.saddr)
+    odaddr = np.asarray(flows.daddr)
+    odport = np.asarray(flows.dport)
+    sport = np.asarray(flows.sport)
+    proto = np.asarray(flows.proto)
+    direction = np.asarray(flows.direction)
+    rev = np.asarray(out.rev_nat)
+    slave = np.asarray(out.lb_slave)
+    created = deleted = 0
+    for i in np.nonzero(create | delete)[0]:
+        d_int = _int_of_limbs(fdaddr[i])
+        s_int = _int_of_limbs(saddr[i])
+        dirv = int(direction[i])
+        flags = TUPLE_F_OUT if dirv == CT_INGRESS else TUPLE_F_IN
+        key = CTTuple(
+            d_int, s_int, int(fdport[i]), int(sport[i]),
+            int(proto[i]), flags,
+        )
+        if create[i]:
+            if key not in ct.entries:
+                ct.create(
+                    CTTuple(
+                        d_int, s_int, int(fdport[i]), int(sport[i]),
+                        int(proto[i]),
+                    ),
+                    dirv, now=now, rev_nat_index=int(rev[i]),
+                    slave=int(slave[i]),
+                )
+                created += 1
+            if int(rev[i]) > 0:
+                o_int = _int_of_limbs(odaddr[i])
+                svc_key = CTTuple(
+                    o_int, s_int, int(odport[i]), int(sport[i]),
+                    int(proto[i]), TUPLE_F_SERVICE,
+                )
+                if svc_key not in ct.entries:
+                    ct.create(
+                        CTTuple(
+                            o_int, s_int, int(odport[i]),
+                            int(sport[i]), int(proto[i]),
+                        ),
+                        CT_SERVICE, now=now,
+                        rev_nat_index=int(rev[i]),
+                        slave=int(slave[i]),
+                    )
+                    created += 1
+        elif delete[i]:
+            if ct.entries.pop(key, None) is not None:
+                deleted += 1
+    return created, deleted

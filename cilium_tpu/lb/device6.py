@@ -1,0 +1,278 @@
+"""Device LB for IPv6: batched lb6_lookup_service / lb6_local analog.
+
+The v4 inline layout (lb/device.py) generalized limb-for-limb, as
+bpf/lib/lb.h's lb6_* functions mirror lb4_*: one 128-lane row per
+bucket holds TWO 64-lane service slots, each carrying the service key
+AND its backends — a single row gather resolves the service and the
+chosen backend.
+
+Slot layout (64 lanes):
+  lanes [0, 4)    vip limbs (big-endian u32 limbs)
+  lane  4         dport << 16 | proto
+  lane  5         rev_nat << 16 | backend count
+  lanes [6, 8)    pad
+  lanes [8, 56)   backend address limbs, LIMB-PLANAR: lanes
+                  [8 + 12k, 8 + 12k + 12) hold limb k of backends
+                  0..11 (masked per-backend extraction stays a
+                  contiguous 12-lane slice per limb)
+  lanes [56, 62)  backend ports, two per lane (low half = even)
+Backends per service cap: 12 (INLINE6_MAX_BACKENDS); larger services
+raise — the reference's lb6 maps scale further, and growing this
+means a second row per service, a straightforward extension.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+
+import numpy as np
+
+from cilium_tpu.engine.hashtable import _fnv1a_host, fnv1a_device
+from cilium_tpu.ipcache.lpm6 import ip6_limbs
+from cilium_tpu.lb.service import ServiceManager
+
+INLINE6_MAX_BACKENDS = 12
+INLINE6_SLOT = 64
+INLINE6_STASH = 8
+_EMPTY_KEY = np.uint32(0xFFFFFFFF)  # dport<<16|proto plane marker
+
+
+@dataclass
+class LB6Inline:
+    """v6 inline service rows + small stash (pytree)."""
+
+    rows: np.ndarray  # u32 [R, 128]
+    stash: np.ndarray  # u32 [INLINE6_STASH, 64]
+    n_buckets: int
+
+    def tree_flatten(self):
+        return ((self.rows, self.stash), self.n_buckets)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+def _register() -> None:
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            LB6Inline,
+            lambda t: t.tree_flatten(),
+            lambda aux, ch: LB6Inline.tree_unflatten(aux, ch),
+        )
+    except Exception:  # pragma: no cover
+        pass
+
+
+_register()
+
+
+def _is_v6(ip: str) -> bool:
+    return ":" in ip
+
+
+def _svc_slot6(svc) -> np.ndarray:
+    slot = np.zeros(INLINE6_SLOT, dtype=np.uint32)
+    slot[0:4] = ip6_limbs(svc.frontend.ip)
+    slot[4] = ((svc.frontend.port & 0xFFFF) << 16) | (
+        svc.frontend.protocol & 0xFF
+    )
+    slot[5] = ((svc.id & 0xFFFF) << 16) | (len(svc.backends) & 0xFFFF)
+    for j, backend in enumerate(svc.backends):
+        limbs = ip6_limbs(backend.addr.ip)
+        for k in range(4):
+            slot[8 + 12 * k + j] = limbs[k]
+        slot[56 + (j >> 1)] |= np.uint32(
+            (backend.addr.port & 0xFFFF) << (16 * (j & 1))
+        )
+    return slot
+
+
+def compile_lb6(mgr: ServiceManager) -> LB6Inline:
+    """Compile the v6 services of the manager (v4 frontends are the
+    v4 compiler's job; the reference keeps lb4/lb6 maps separate)."""
+    services = sorted(
+        (
+            s
+            for s in mgr.by_frontend.values()
+            if _is_v6(s.frontend.ip)
+        ),
+        key=lambda s: s.id,
+    )
+    for svc in services:
+        if len(svc.backends) > INLINE6_MAX_BACKENDS:
+            raise ValueError(
+                f"v6 service {svc.frontend} exceeds "
+                f"{INLINE6_MAX_BACKENDS} backends"
+            )
+        if any(not _is_v6(b.addr.ip) for b in svc.backends):
+            raise ValueError("v6 service with v4 backend (NAT46 scope)")
+    nb = 16
+    while nb < len(services):
+        nb *= 2
+    nb_cap = max(nb * 64, 1 << 12)
+    while nb <= nb_cap:
+        rows = np.zeros((nb, 128), dtype=np.uint32)
+        rows[:, 4] = _EMPTY_KEY
+        rows[:, INLINE6_SLOT + 4] = _EMPTY_KEY
+        stash = np.zeros((INLINE6_STASH, INLINE6_SLOT), dtype=np.uint32)
+        stash[:, 4] = _EMPTY_KEY
+        fill = [0] * nb
+        sfill = 0
+        ok = True
+        for svc in services:
+            limbs = ip6_limbs(svc.frontend.ip)
+            w4 = ((svc.frontend.port & 0xFFFF) << 16) | (
+                svc.frontend.protocol & 0xFF
+            )
+            words = np.array([[*limbs, w4]], dtype=np.uint32)
+            b = int(_fnv1a_host(words)[0]) & (nb - 1)
+            if fill[b] < 2:
+                rows[
+                    b, fill[b] * INLINE6_SLOT : (fill[b] + 1) * INLINE6_SLOT
+                ] = _svc_slot6(svc)
+                fill[b] += 1
+            elif sfill < INLINE6_STASH:
+                stash[sfill] = _svc_slot6(svc)
+                sfill += 1
+            else:
+                ok = False
+                break
+        if ok:
+            return LB6Inline(rows=rows, stash=stash, n_buckets=nb)
+        nb *= 2
+    raise ValueError("LB6 bucket overflow (pathological collisions)")
+
+
+def flow_hash6(saddr, daddr, sport, dport, proto):
+    """v6 flow hash for slave selection (get_hash_recalc over the
+    limb tuple; same invariants as the v4 hash)."""
+    import jax.numpy as jnp
+
+    words = jnp.concatenate(
+        [
+            saddr.astype(jnp.uint32),
+            daddr.astype(jnp.uint32),
+            (
+                (sport.astype(jnp.uint32) << 16)
+                | dport.astype(jnp.uint32)
+            )[:, None],
+            proto.astype(jnp.uint32)[:, None],
+        ],
+        axis=1,
+    )
+    return fnv1a_device(words)
+
+
+def lb6_select_batch(
+    tables: LB6Inline,
+    saddr,  # u32 [B, 4]
+    daddr,  # u32 [B, 4]
+    sport,
+    dport,
+    proto,
+    ct_slave=None,
+):
+    """Returns (is_service bool [B], slave i32 [B],
+    new_daddr u32 [B, 4], new_dport i32 [B], rev_nat i32 [B])."""
+    import jax.numpy as jnp
+
+    vip = daddr.astype(jnp.uint32)
+    w4 = ((dport.astype(jnp.uint32) & 0xFFFF) << 16) | (
+        proto.astype(jnp.uint32) & 0xFF
+    )
+    h = fnv1a_device(jnp.concatenate([vip, w4[:, None]], axis=1))
+    bucket = (h & jnp.uint32(tables.n_buckets - 1)).astype(jnp.int32)
+    rows = jnp.asarray(tables.rows)[bucket]  # [B, 128] — THE gather
+    half = rows.reshape(-1, 2, INLINE6_SLOT)  # [B, 2, 64]
+    hit2 = jnp.ones(half.shape[:2], bool)
+    for k in range(4):
+        hit2 = hit2 & (half[:, :, k] == vip[:, k : k + 1])
+    hit2 = hit2 & (half[:, :, 4] == w4[:, None])
+    slot = jnp.sum(
+        jnp.where(hit2[:, :, None], half, 0), axis=1, dtype=jnp.uint32
+    )  # [B, 64]
+    stash = jnp.asarray(tables.stash)  # [S, 64]
+    s_hit = jnp.ones((vip.shape[0], stash.shape[0]), bool)
+    for k in range(4):
+        s_hit = s_hit & (stash[None, :, k] == vip[:, k : k + 1])
+    s_hit = s_hit & (stash[None, :, 4] == w4[:, None])
+    slot = slot + jnp.sum(
+        jnp.where(s_hit[:, :, None], stash[None, :, :], 0),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    found = jnp.any(hit2, axis=1) | jnp.any(s_hit, axis=1)
+
+    meta = slot[:, 5]
+    count = (meta & 0xFFFF).astype(jnp.int32)
+    rev_nat = (meta >> 16).astype(jnp.int32)
+    found = found & (count > 0)
+
+    fh = flow_hash6(saddr, daddr, sport, dport, proto)
+    slave = (fh % jnp.maximum(count, 1).astype(jnp.uint32)).astype(
+        jnp.int32
+    ) + 1
+    if ct_slave is not None:
+        reuse = (ct_slave > 0) & (ct_slave <= count)
+        slave = jnp.where(reuse, ct_slave, slave)
+
+    k_sel = (slave - 1).astype(jnp.int32)
+    lane = jnp.arange(INLINE6_MAX_BACKENDS, dtype=jnp.int32)
+    mask = lane[None, :] == k_sel[:, None]  # [B, 12]
+    limbs = []
+    for k in range(4):
+        limbs.append(
+            jnp.sum(
+                jnp.where(
+                    mask,
+                    slot[:, 8 + 12 * k : 8 + 12 * k + 12],
+                    0,
+                ),
+                axis=1,
+                dtype=jnp.uint32,
+            )
+        )
+    new_daddr = jnp.stack(limbs, axis=1)  # [B, 4]
+    plane = jnp.arange(INLINE6_MAX_BACKENDS // 2, dtype=jnp.int32)
+    port_mask = plane[None, :] == (k_sel >> 1)[:, None]
+    port_pair = jnp.sum(
+        jnp.where(port_mask, slot[:, 56:62], 0), axis=1, dtype=jnp.uint32
+    )
+    new_dport = (
+        (port_pair >> (16 * (k_sel & 1)).astype(jnp.uint32)) & 0xFFFF
+    ).astype(jnp.int32)
+
+    new_daddr = jnp.where(
+        found[:, None], new_daddr, daddr.astype(jnp.uint32)
+    )
+    new_dport = jnp.where(found, new_dport, dport.astype(jnp.int32))
+    rev_nat = jnp.where(found, rev_nat, 0)
+    slave = jnp.where(found, slave, 0)
+    return found, slave, new_daddr, new_dport, rev_nat
+
+
+def lb6_lookup_host(mgr: ServiceManager, daddr: str, dport: int,
+                    proto: int):
+    """Host-side lb6_lookup_service (oracle)."""
+    from cilium_tpu.lb.service import L3n4Addr
+
+    return mgr.lookup(L3n4Addr(daddr, dport, proto))
+
+
+def slave_for_host(svc, saddr: str, daddr: str, sport: int, dport: int,
+                   proto: int) -> int:
+    """Host-side hashed slave selection (matches flow_hash6)."""
+    words = np.array(
+        [[
+            *ip6_limbs(saddr),
+            *ip6_limbs(daddr),
+            ((sport & 0xFFFF) << 16) | (dport & 0xFFFF),
+            proto & 0xFF,
+        ]],
+        dtype=np.uint32,
+    )
+    return (int(_fnv1a_host(words)[0]) % len(svc.backends)) + 1

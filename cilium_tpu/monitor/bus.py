@@ -110,13 +110,20 @@ def verdicts_to_events(
     directions: np.ndarray,
     emit_allowed: bool = False,
     verdict_eps: "Optional[set]" = None,
+    emit_drops: bool = True,
+    emit_trace: bool = False,
 ) -> int:
     """Fold a batch: denied tuples → DropNotify (+ verdict events when
     PolicyVerdictNotification is on / emit_allowed).  `verdict_eps`
     scopes allowed-verdict emission to specific endpoint ids — the
     per-endpoint PolicyVerdictNotification option (`cilium endpoint
     config`), which the reference compiles into that endpoint's
-    datapath alone.  Returns the number of events published."""
+    datapath alone.  `emit_drops` is the DropNotification option
+    (DROP_NOTIFY #define); `emit_trace` emits a per-flow TraceNotify
+    for allowed tuples — the TraceNotification option at
+    MonitorAggregationLevel none (TRACE_NOTIFY; higher aggregation
+    levels suppress per-packet traces, monitor.go).  Returns the
+    number of events published."""
     allowed = np.asarray(verdicts.allowed)
     kind = np.asarray(verdicts.match_kind)
     proxy = np.asarray(verdicts.proxy_port)
@@ -144,6 +151,22 @@ def verdicts_to_events(
             match_kind=int(kind[i]),
         )
 
+    if emit_trace:
+        from cilium_tpu.monitor.events import TraceNotify
+
+        for i in np.nonzero(allowed)[0]:
+            # the local endpoint is the DESTINATION of an ingress
+            # flow and the SOURCE of an egress one (send_trace_notify
+            # carries distinct src/dst; 0 = remote/unknown)
+            ingress_i = int(directions[i]) == 0
+            bus.publish(
+                TraceNotify(
+                    source=0 if ingress_i else int(ep_ids[i]),
+                    src_label=int(identities[i]),
+                    dst_id=int(ep_ids[i]) if ingress_i else 0,
+                )
+            )
+            n += 1
     for i in idx:
         if allowed[i]:
             bus.publish(_verdict_event(i, True))
@@ -156,6 +179,8 @@ def verdicts_to_events(
                 # endpoints see the deny verdict alongside the drop
                 bus.publish(_verdict_event(i, False))
                 n += 1
+            if not emit_drops:
+                continue
             reason = (
                 DROP_FRAG_CODE
                 if kind[i] == MATCH_FRAG_DROP

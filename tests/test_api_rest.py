@@ -150,8 +150,8 @@ def test_config_patch_runtime_options(tmp_path):
             {"options": {"PolicyTracing": True}}
         )
         assert out["applied"] == 1
-        assert out["options"]["PolicyTracing"] is True
-        assert client.config_get()["options"]["PolicyTracing"] is True
+        assert bool(out["options"]["PolicyTracing"])  # OptionSetting int
+        assert bool(client.config_get()["options"]["PolicyTracing"])
 
         out = client.config_patch({"policy_enforcement": "never"})
         assert out["policy_enforcement"] == "never"
@@ -193,7 +193,7 @@ def test_config_patch_is_atomic(tmp_path):
             {"options": {"PolicyTracing": True, "NotAThing": True}},
             {"options": {"PolicyTracing": True},
              "policy_enforcement": "bogus"},
-            {"options": {"PolicyTracing": "false"}},  # stringified
+            {"options": {"PolicyTracing": "maybe"}},  # junk value
         ):
             try:
                 client.config_patch(bad)

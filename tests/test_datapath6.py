@@ -261,3 +261,169 @@ def test_ipcache6_high_address_not_false_hit(tmp_path):
         build_ipcache6(
             {"ffff:ffff:ffff:ffff:ffff:ffff:ffff:ffff/128": 7}
         )
+
+
+# ---------------------------------------------------------------------------
+# v6 service LB (lb6_local, bpf/lib/lb.h lb6_*)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_v6_lb_dnat_and_stickiness():
+    """Egress v6 flows to a service VIP DNAT to a hashed backend; the
+    CT6 service-scope entry pins the backend; writeback creates both
+    the flow entry and the service entry; a second pass sees
+    ESTABLISHED."""
+    from cilium_tpu.engine.datapath6 import apply_ct_writeback6
+    from cilium_tpu.lb.device6 import (
+        compile_lb6,
+        lb6_lookup_host,
+        slave_for_host,
+    )
+    from cilium_tpu.lb.service import L3n4Addr, ServiceManager
+    from cilium_tpu.maps.policymap import (
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    rng = np.random.default_rng(4)
+    # the endpoint must allow the BACKENDS' identities at the
+    # backend port (the lattice sees the post-DNAT destination):
+    # 2001:db8:1::10 -> /48 -> 257; 2001:db8:1:2::3 -> /128 -> 1000
+    state = {
+        PolicyKey(257, 8443, 6, EGRESS): PolicyMapStateEntry(),
+        PolicyKey(1000, 8443, 6, EGRESS): PolicyMapStateEntry(),
+    }
+    policy = compile_map_states([state], IDENTITY_IDS, 32, 16)
+
+    mgr = ServiceManager()
+    vip = "fd00:5::100"
+    backends = ["2001:db8:1::10", "2001:db8:1:2::3"]
+    mgr.upsert(
+        L3n4Addr(vip, 443, 6),
+        [L3n4Addr(b, 8443, 6) for b in backends],
+    )
+    ct = CTMap()
+    tables = Datapath6Tables(
+        prefilter=build_prefilter6(PREFILTER6),
+        ipcache=build_ipcache6(IPCACHE6),
+        ct=compile_ct6(ct),
+        policy=policy,
+        lb=compile_lb6(mgr),
+    )
+
+    n = 64
+    srcs = [str(rng.choice(V6_POOL[:4])) for _ in range(n)]
+    f = dict(
+        ep_index=np.zeros(n, np.int32),
+        saddr=np.array([ip6_limbs(s) for s in srcs], np.uint32),
+        daddr=np.array([ip6_limbs(vip)] * n, np.uint32),
+        sport=rng.integers(1024, 60000, size=n),
+        dport=np.full(n, 443),
+        proto=np.full(n, 6),
+        direction=np.ones(n, np.int64),  # egress
+    )
+    flows = FlowBatch6.from_numpy(**f)
+    out = datapath6_step(tables, flows)
+
+    got_daddr = np.asarray(out.final_daddr)
+    got_dport = np.asarray(out.final_dport)
+    got_slave = np.asarray(out.lb_slave)
+    svc = lb6_lookup_host(mgr, vip, 443, 6)
+    assert svc is not None
+    for i in range(n):
+        want_slave = slave_for_host(
+            svc, srcs[i], vip, int(f["sport"][i]), 443, 6
+        )
+        assert int(got_slave[i]) == want_slave, i
+        want_backend = ip6_limbs(backends[want_slave - 1])
+        np.testing.assert_array_equal(got_daddr[i], want_backend)
+        assert int(got_dport[i]) == 8443
+        # DNAT'd destination resolves through ipcache → identity →
+        # policy: backends are under 2001:db8:1::/48 or /64 nets
+        assert int(np.asarray(out.ct_result)[i]) == CT_NEW
+
+    created, _ = apply_ct_writeback6(ct, out, flows)
+    # one flow entry + one service entry per unique flow
+    assert created == 2 * n
+
+    # second pass: service-scope stickiness + ESTABLISHED flow
+    tables2 = Datapath6Tables(
+        prefilter=tables.prefilter,
+        ipcache=tables.ipcache,
+        ct=compile_ct6(ct),
+        policy=policy,
+        lb=tables.lb,
+    )
+    out2 = datapath6_step(tables2, flows)
+    from cilium_tpu.ct.table import CT_ESTABLISHED
+
+    assert (
+        np.asarray(out2.ct_result) == CT_ESTABLISHED
+    ).all()
+    np.testing.assert_array_equal(
+        np.asarray(out2.final_daddr), got_daddr
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out2.lb_slave), got_slave
+    )
+
+
+def test_lb6_inline_vs_host_lookup():
+    """Device lb6 selection equals the host lookup + hashed slave for
+    a mixed batch of service and non-service destinations."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.lb.device6 import (
+        compile_lb6,
+        lb6_select_batch,
+        slave_for_host,
+    )
+    from cilium_tpu.lb.service import L3n4Addr, ServiceManager
+
+    rng = np.random.default_rng(9)
+    mgr = ServiceManager()
+    vips = [f"fd00:9::{i + 1}" for i in range(19)]
+    for i, vip in enumerate(vips):
+        mgr.upsert(
+            L3n4Addr(vip, 80 + (i % 3), 6),
+            [
+                L3n4Addr(f"2001:db8:b::{j + 1}", 9000 + j, 6)
+                for j in range(1 + i % 5)
+            ],
+        )
+    tables = compile_lb6(mgr)
+
+    n = 256
+    dsts = [
+        str(rng.choice(vips + ["2001:db8::77"])) for _ in range(n)
+    ]
+    dports = rng.integers(80, 84, size=n)
+    srcs = [f"2001:db8:c::{int(rng.integers(1, 99))}" for _ in range(n)]
+    args = (
+        jnp.asarray(np.array([ip6_limbs(s) for s in srcs], np.uint32)),
+        jnp.asarray(np.array([ip6_limbs(d) for d in dsts], np.uint32)),
+        jnp.asarray(rng.integers(1024, 60000, size=n).astype(np.int32)),
+        jnp.asarray(dports.astype(np.int32)),
+        jnp.asarray(np.full(n, 6, np.int32)),
+    )
+    found, slave, nd, npt, rv = lb6_select_batch(tables, *args)
+    found = np.asarray(found)
+    slave = np.asarray(slave)
+    nd = np.asarray(nd)
+    from cilium_tpu.lb.service import L3n4Addr as A
+
+    for i in range(n):
+        svc = mgr.lookup(A(dsts[i], int(dports[i]), 6))
+        if svc is None or not svc.backends:
+            assert not found[i], i
+            np.testing.assert_array_equal(nd[i], ip6_limbs(dsts[i]))
+            continue
+        assert found[i], i
+        want = slave_for_host(
+            svc, srcs[i], dsts[i],
+            int(np.asarray(args[2])[i]), int(dports[i]), 6,
+        )
+        assert int(slave[i]) == want, i
+        np.testing.assert_array_equal(
+            nd[i], ip6_limbs(svc.backends[want - 1].addr.ip)
+        )

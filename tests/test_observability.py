@@ -197,3 +197,86 @@ def test_process_flows_feeds_monitor():
         }
     finally:
         option.Config.opts.pop("PolicyVerdictNotification", None)
+
+
+def test_monitor_dissector_formats():
+    """pkg/monitor/dissect.go analog: native flow-record payloads
+    decode into connection summaries, and each monitor event kind
+    renders as the reference's one-line format."""
+    import numpy as np
+
+    from cilium_tpu.monitor.dissect import (
+        connection_summary,
+        dissect_event,
+        dissect_flow_buffer,
+    )
+    from cilium_tpu.native import encode_flow_records
+
+    buf = encode_flow_records(
+        ep_id=np.asarray([12], np.uint32),
+        identity=np.asarray([256], np.uint32),
+        saddr=np.asarray([0x0A000001], np.uint32),
+        daddr=np.asarray([0x0A000002], np.uint32),
+        sport=np.asarray([4001], np.uint16),
+        dport=np.asarray([80], np.uint16),
+        proto=np.asarray([6], np.uint8),
+        direction=np.asarray([0], np.uint8),
+        is_fragment=np.asarray([0], np.uint8),
+    )
+    lines = list(dissect_flow_buffer(buf))
+    assert lines == [
+        "tcp 10.0.0.1:4001 -> 10.0.0.2:80 ingress ep=12 identity=256"
+    ]
+    assert connection_summary(
+        0x0A000001, 0x0A000002, 53, 53, 17
+    ) == "udp 10.0.0.1:53 -> 10.0.0.2:53"
+
+    assert dissect_event(
+        {"event": "DropNotify", "source": 7, "src_label": 256,
+         "reason": 133}
+    ) == "xx drop (Policy denied (L3)) to endpoint 7, identity 256"
+    assert dissect_event(
+        {"event": "PolicyVerdictNotify", "source": 9,
+         "src_label": 300, "dport": 443, "proto": 6,
+         "ingress": True, "allowed": True, "proxy_port": 10005}
+    ) == (
+        "Policy verdict log: flow to endpoint 9, ingress, "
+        "identity 300, dport 443/tcp, action allow, "
+        "redirected to proxy 10005"
+    )
+    assert dissect_event(
+        {"event": "TraceNotify", "source": 3, "dst_id": 5,
+         "src_label": 42}
+    ) == "-> endpoint 5 from endpoint 3, identity 42"
+
+
+def test_cli_monitor_verbose_renders_dissected(tmp_path, capsys):
+    """`cilium monitor -v` prints dissected lines, not JSON."""
+    import threading
+    import time
+
+    from cilium_tpu import cli
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.monitor.events import DropNotify
+
+    d = Daemon()
+    sock = str(tmp_path / "monv.sock")
+    server = APIServer(d, sock).start()
+    try:
+        def publish_later():
+            time.sleep(0.3)
+            d.monitor.publish(
+                DropNotify(source=7, reason=133, src_label=256)
+            )
+
+        threading.Thread(target=publish_later, daemon=True).start()
+        rc = cli.main(
+            ["--socket", sock, "monitor", "--count", "1", "-v",
+             "--timeout", "5"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "xx drop (Policy denied (L3)) to endpoint 7" in out
+    finally:
+        server.stop()

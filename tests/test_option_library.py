@@ -1,0 +1,174 @@
+"""Runtime option library (pkg/option/option.go:41,163 +
+runtime_options.go): descriptor table with parse/verify hooks,
+dependency propagation, and REAL behavioral effects — each option
+observably changes datapath/monitor/CT output, not just a stored bit."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu import option
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.labels import Label, Labels
+
+
+def _fresh_opts():
+    return option.default_opts()
+
+
+def test_library_descriptors_and_formats():
+    lib = option.DAEMON_OPTION_LIBRARY
+    assert option.CONNTRACK_ACCOUNTING in lib
+    assert lib[option.CONNTRACK_ACCOUNTING].requires == (
+        option.CONNTRACK,
+    )
+    assert lib[option.DEBUG].define == "DEBUG"
+    assert lib[option.NAT46].define == "ENABLE_NAT46"
+    opts = _fresh_opts()
+    desc = opts.describe()
+    assert desc[option.CONNTRACK]["value"] == "Enabled"
+    assert desc[option.POLICY_TRACING]["value"] == "Disabled"
+    assert desc[option.CONNTRACK_ACCOUNTING]["requires"] == [
+        option.CONNTRACK
+    ]
+
+
+def test_parse_and_verify_hooks():
+    opts = _fresh_opts()
+    # string/int/bool forms all parse (ParseOption's CLI contract)
+    assert opts.parse_validate(option.DEBUG, "true") == 1
+    assert opts.parse_validate(option.DEBUG, "Disabled") == 0
+    assert opts.parse_validate(option.DEBUG, 1) == 1
+    with pytest.raises(ValueError):
+        opts.parse_validate(option.DEBUG, "maybe")
+    with pytest.raises(ValueError):
+        opts.parse_validate("NotAThing", True)
+    # MonitorAggregationLevel parses names and bounded ints
+    assert opts.parse_validate(
+        option.MONITOR_AGGREGATION, "medium"
+    ) == option.MONITOR_AGG_MEDIUM
+    assert opts.parse_validate(option.MONITOR_AGGREGATION, 0) == 0
+    with pytest.raises(ValueError):
+        opts.parse_validate(option.MONITOR_AGGREGATION, 9)
+    # NAT46 fails loudly (no datapath lowering)
+    with pytest.raises(ValueError):
+        opts.parse_validate(option.NAT46, True)
+
+
+def test_dependency_propagation():
+    opts = option.OptionMap()
+    # enabling an option enables what it requires (option.go:419)
+    opts.apply({option.CONNTRACK_ACCOUNTING: True})
+    assert opts.is_enabled(option.CONNTRACK)
+    # disabling an option disables its dependents (option.go:445)
+    changed = []
+    opts.apply(
+        {option.CONNTRACK: False},
+        changed_hook=lambda k, v: changed.append((k, v)),
+    )
+    assert not opts.is_enabled(option.CONNTRACK_ACCOUNTING)
+    assert (option.CONNTRACK_ACCOUNTING, 0) in changed
+
+
+def test_conntrack_accounting_gates_counters():
+    from cilium_tpu.ct.table import CT_INGRESS, CTMap, CTTuple
+
+    ct = CTMap()
+    tup = CTTuple(1, 2, 80, 999, 6)
+    ct.create(tup, CT_INGRESS)
+    key = next(iter(ct.entries))
+    ct.lookup(tup, CT_INGRESS, pkt_len=100)
+    assert ct.entries[key].rx_packets == 1
+    ct.accounting = False  # the daemon's option hook flips this
+    ct.lookup(tup, CT_INGRESS, pkt_len=100)
+    assert ct.entries[key].rx_packets == 1  # gated off
+
+    # the daemon wires the option to ITS map only (standalone maps
+    # keep accounting — no process-global coupling)
+    d = Daemon()
+    d.policy_trigger.close(wait=True)
+    assert d.ct.accounting
+    d.config_patch({"options": {"ConntrackAccounting": False}})
+    assert not d.ct.accounting
+    assert ct is not d.ct
+
+
+def test_options_change_monitor_output_end_to_end():
+    """DropNotification / TraceNotification / MonitorAggregationLevel
+    round-trip via PATCH /config and observably change process_flows'
+    monitor output."""
+    from cilium_tpu.monitor.events import DropNotify, TraceNotify
+    from tests.test_replay import _daemon_with_policy, _make_buf
+
+    saved = dict(option.Config.opts)
+    try:
+        option.Config.opts.clear()
+        option.Config.opts.update(option.default_opts())
+        d, server, client = _daemon_with_policy()
+        q = d.monitor.subscribe_queue()
+        rng = np.random.default_rng(3)
+        cid = client.security_identity.id
+        buf = _make_buf(rng, 64, [10], [cid, 999999])
+
+        # boot defaults: drops on, but aggregation MEDIUM keeps
+        # per-packet traces off (the monitor fold is host-side
+        # Python; per-flow traces are an operator opt-in)
+        stats = d.process_flows(buf, batch_size=32)
+        drops = [e for e in q if isinstance(e, DropNotify)]
+        assert len(drops) == stats.denied > 0
+        assert not any(isinstance(e, TraceNotify) for e in q)
+
+        # aggregation dialed to none → per-flow traces appear, with
+        # the local endpoint as the trace DESTINATION (ingress)
+        d.config_patch(
+            {"options": {"MonitorAggregationLevel": "none"}}
+        )
+        q.clear()
+        d.process_flows(buf, batch_size=32)
+        traces = [e for e in q if isinstance(e, TraceNotify)]
+        assert len(traces) == stats.allowed > 0
+        assert all(t.dst_id == 10 and t.source == 0 for t in traces)
+        d.config_patch(
+            {"options": {"MonitorAggregationLevel": "medium"}}
+        )
+
+        # DropNotification off → no drop events
+        d.config_patch({"options": {"DropNotification": False}})
+        q.clear()
+        d.process_flows(buf, batch_size=32)
+        assert not any(isinstance(e, DropNotify) for e in q)
+
+        # TraceNotification off entirely: even aggregation none
+        # emits nothing
+        d.config_patch(
+            {"options": {"DropNotification": True,
+                         "TraceNotification": False,
+                         "MonitorAggregationLevel": "none"}}
+        )
+        q.clear()
+        d.process_flows(buf, batch_size=32)
+        assert not any(isinstance(e, TraceNotify) for e in q)
+        assert any(isinstance(e, DropNotify) for e in q)
+    finally:
+        option.Config.opts.clear()
+        option.Config.opts.update(saved)
+
+
+def test_conntrack_off_flushes_and_stops_gc():
+    saved = dict(option.Config.opts)
+    try:
+        option.Config.opts.clear()
+        option.Config.opts.update(option.default_opts())
+        d = Daemon()
+        d.policy_trigger.close(wait=True)
+        from cilium_tpu.ct.table import CT_INGRESS, CTTuple
+
+        d.ct.create(CTTuple(1, 2, 80, 999, 6), CT_INGRESS)
+        assert len(d.ct.entries) == 1
+        out = d.config_patch({"options": {"Conntrack": False}})
+        assert len(d.ct.entries) == 0  # flushed
+        # accounting was disabled by dependency propagation
+        assert not bool(out["options"].get("ConntrackAccounting"))
+        d._ct_gc()  # no-op, must not raise
+    finally:
+        option.Config.opts.clear()
+        option.Config.opts.update(saved)

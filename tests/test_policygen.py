@@ -1,0 +1,351 @@
+"""Combinatorial policy e2e sweep — the policygen analog.
+
+The reference sweeps generated policy matrices and asserts
+connectivity outcomes (/root/reference/test/helpers/policygen/
+models.go: source kind x L4 spec x L7 policy combinations with
+expected results computed from the spec).  This sweep generates the
+L3 x L4 x L7 x direction matrix, drives EVERY combination through the
+real control plane at once (policy_add → regenerate → published
+tables), probes each with four peer kinds (team member, member of
+another team, stranger identity, unknown/world source) and — for L7
+combinations — matching AND non-matching requests through the fused
+datapath + fleet L7, asserting each case's connectivity outcome
+against the expectation derived from the combination itself,
+independent of the engine's own oracle.
+
+Isolation: each combination owns a distinct (endpoint, team, port)
+triple, so 100+ generated rules coexist in one daemon without
+interacting.  (CIDR x ToPorts combinations are excluded: the 1.0 API
+rejects them — rule.py PolicyValidationError, api/rule Sanitize.)"""
+
+import ipaddress
+import itertools
+
+import numpy as np
+
+from cilium_tpu.daemon import Daemon
+from cilium_tpu.labels import Label, LabelArray, Labels
+from cilium_tpu.policy.api import (
+    EndpointSelector,
+    IngressRule,
+    PortProtocol,
+    PortRule,
+    Rule,
+)
+from cilium_tpu.policy.api.rule import (
+    CIDRRule,
+    EgressRule,
+    L7Rules,
+    PortRuleHTTP,
+    PortRuleKafka,
+)
+
+L3_KINDS = ("team", "cidr", "all", "none")
+L4_KINDS = ("tcp", "udp", "l3only", "wrongport")
+L7_KINDS = ("none", "http", "kafka")
+DIRECTIONS = ("ingress", "egress")
+PEERS = ("member", "other", "stranger", "world")
+
+
+def _valid(direction, l3, l4, l7):
+    if l7 != "none" and l4 != "tcp":
+        return False  # L7 rules ride TCP port rules
+    if l3 == "cidr" and l4 != "l3only":
+        return False  # CIDR x ToPorts rejected by the 1.0 API
+    if l3 == "none" and (l4 != "tcp" or direction == "egress"):
+        return False  # one no-rule case suffices
+    if direction == "egress" and l3 == "cidr":
+        return False  # covered by dedicated CIDR egress tests
+    return True
+
+
+COMBOS = [
+    (dirn, l3, l4, l7)
+    for dirn, l3, l4, l7 in itertools.product(
+        DIRECTIONS, L3_KINDS, L4_KINDS, L7_KINDS
+    )
+    if _valid(dirn, l3, l4, l7)
+]
+
+
+def _expected(l3, l4, l7, peer, req_match):
+    """(allowed, redirected, l7_allowed) from the combination alone."""
+    if l3 == "none":
+        # DEFAULT enforcement: an endpoint no rule selects is
+        # unenforced — everything passes (policy.go EnableEnforcement)
+        return (True, False, False)
+    if l3 == "team" and peer != "member":
+        return (False, False, False)
+    if l3 == "cidr" and peer != "member":
+        return (False, False, False)
+    if l4 == "wrongport":
+        return (False, False, False)
+    if l7 == "none":
+        return (True, False, False)
+    return (True, True, req_match)
+
+
+def _cases():
+    out = []
+    for ctx_i, combo in enumerate(COMBOS):
+        _, l3, l4, l7 = combo
+        peers = PEERS if l3 != "none" else ("member",)
+        for peer in peers:
+            if l7 == "none":
+                out.append((ctx_i, peer, True))
+            else:
+                out.append((ctx_i, peer, True))
+                out.append((ctx_i, peer, False))
+    return out
+
+
+def _build_world():
+    d = Daemon(num_workers=4)
+    d.policy_trigger.close(wait=True)
+
+    from cilium_tpu.ipcache.ipcache import IPIdentity
+
+    combo_ctx = []
+    rules = []
+    stranger, _ = d.identity_allocator.allocate(
+        Labels({"team": Label("team", "stranger", "k8s")})
+    )
+    stranger_ip = "10.99.0.250"
+    d.ipcache.upsert(stranger_ip, IPIdentity(stranger.id, "kvstore"))
+    other, _ = d.identity_allocator.allocate(
+        Labels({"team": Label("team", "pgother", "k8s")})
+    )
+    other_ip = "10.99.0.251"
+    d.ipcache.upsert(other_ip, IPIdentity(other.id, "kvstore"))
+    world_ip = "8.8.4.4"  # not in the ipcache → RESERVED_WORLD
+
+    for i, (dirn, l3, l4, l7) in enumerate(COMBOS):
+        app = f"pg{i}"
+        ep_id = 500 + i
+        ep_ip = f"10.60.{i // 200}.{(i % 200) + 1}"
+        d.create_endpoint(
+            ep_id,
+            Labels({"app": Label("app", app, "k8s")}),
+            ipv4=ep_ip,
+            name=app,
+        )
+        team = f"pgteam{i}"
+        member, _ = d.identity_allocator.allocate(
+            Labels({"team": Label("team", team, "k8s")})
+        )
+        member_ip = f"10.70.{i // 200}.{(i % 200) + 1}"
+        d.ipcache.upsert(member_ip, IPIdentity(member.id, "kvstore"))
+        cidr = f"10.80.{i}.0/24"
+        cidr_ip = f"10.80.{i}.9"
+        port = 20000 + i
+        ctx = dict(
+            i=i, dirn=dirn, l3=l3, l4=l4, l7=l7, ep_id=ep_id,
+            ep_ip=ep_ip, port=port, member_ip=member_ip,
+            cidr_ip=cidr_ip, other_ip=other_ip,
+            stranger_ip=stranger_ip, world_ip=world_ip,
+        )
+        combo_ctx.append(ctx)
+        if l3 == "none":
+            continue
+
+        if l3 == "team":
+            src = [EndpointSelector(match_labels={"k8s.team": team})]
+            cidr_set = []
+        elif l3 == "cidr":
+            src = []
+            cidr_set = [CIDRRule(cidr=cidr)]
+        else:  # all
+            src = [EndpointSelector()]
+            cidr_set = []
+
+        if l4 == "l3only":
+            ports = []
+        else:
+            proto = "UDP" if l4 == "udp" else "TCP"
+            rule_port = (
+                port if l4 != "wrongport" else ((port + 7) % 65000) + 1
+            )
+            l7_rules = None
+            if l7 == "http":
+                l7_rules = L7Rules(
+                    http=[PortRuleHTTP(method="GET",
+                                       path=f"/pg{i}/[a-z]+")]
+                )
+            elif l7 == "kafka":
+                l7_rules = L7Rules(
+                    kafka=[PortRuleKafka(topic=f"pgtopic{i}")]
+                )
+            ports = [
+                PortRule(
+                    ports=[PortProtocol(port=str(rule_port),
+                                        protocol=proto)],
+                    rules=l7_rules,
+                )
+            ]
+
+        if dirn == "ingress":
+            section = dict(
+                ingress=[
+                    IngressRule(
+                        from_endpoints=src,
+                        from_cidr_set=cidr_set,
+                        to_ports=ports,
+                    )
+                ]
+            )
+        else:
+            section = dict(
+                egress=[
+                    EgressRule(to_endpoints=src, to_ports=ports)
+                ]
+            )
+        rules.append(
+            Rule(
+                endpoint_selector=EndpointSelector(
+                    match_labels={"k8s.app": app}
+                ),
+                labels=LabelArray.parse(f"policygen-{i}"),
+                **section,
+            )
+        )
+
+    d.policy_add(rules)
+    d.regenerate_all("policygen sweep")
+    return d, combo_ctx
+
+
+def test_policygen_matrix_connectivity():
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.ct.table import CTMap
+    from cilium_tpu.engine.datapath import (
+        DatapathTables,
+        FlowBatch,
+        datapath_step,
+    )
+    from cilium_tpu.ipcache.lpm import specialize_ipcache_to_idx
+    from cilium_tpu.l7.fleet import compile_fleet_l7, evaluate_fleet_l7
+    from cilium_tpu.l7.http import pad_requests
+    from cilium_tpu.l7.kafka import KafkaRequest, pad_kafka_requests
+    from cilium_tpu.lb.device import compile_lb
+    from cilium_tpu.lb.service import ServiceManager
+    from cilium_tpu.prefilter import build_prefilter
+
+    d, combos = _build_world()
+    cases = _cases()
+    assert len(cases) >= 100, len(cases)
+
+    _, tables_pol, index = d.endpoint_manager.published()
+    world = DatapathTables(
+        prefilter=build_prefilter({"203.0.113.0/24": 1}),
+        ipcache=specialize_ipcache_to_idx(
+            d.lpm_builder.tables(), tables_pol
+        ),
+        ct=compile_ct(CTMap()),
+        lb=compile_lb(ServiceManager()),
+        policy=tables_pol,
+    )
+    fleet = compile_fleet_l7(d)
+
+    def u32(ip):
+        return int(ipaddress.IPv4Address(ip))
+
+    n = len(cases)
+    f = dict(
+        ep_index=np.zeros(n, np.int64),
+        saddr=np.zeros(n, np.uint32),
+        daddr=np.zeros(n, np.uint32),
+        sport=np.full(n, 4001, np.int64),
+        dport=np.zeros(n, np.int64),
+        proto=np.full(n, 6, np.int64),
+        direction=np.zeros(n, np.int64),
+    )
+    reqs = []
+    kreqs = []
+    for row, (ctx_i, peer, req_match) in enumerate(cases):
+        ctx = combos[ctx_i]
+        peer_ip = {
+            "member": (
+                ctx["cidr_ip"] if ctx["l3"] == "cidr"
+                else ctx["member_ip"]
+            ),
+            "other": ctx["other_ip"],
+            "stranger": ctx["stranger_ip"],
+            "world": ctx["world_ip"],
+        }[peer]
+        f["ep_index"][row] = index[ctx["ep_id"]]
+        f["dport"][row] = ctx["port"]
+        f["proto"][row] = 17 if ctx["l4"] == "udp" else 6
+        if ctx["dirn"] == "ingress":
+            f["saddr"][row] = u32(peer_ip)
+            f["daddr"][row] = u32(ctx["ep_ip"])
+            f["direction"][row] = 0
+        else:
+            f["saddr"][row] = u32(ctx["ep_ip"])
+            f["daddr"][row] = u32(peer_ip)
+            f["direction"][row] = 1
+        tag = ctx["i"] if req_match else 999999
+        reqs.append((b"GET", f"/pg{tag}/ok".encode(), b""))
+        kreqs.append(
+            KafkaRequest(kind=0, version=0, client_id="c",
+                         topics=(f"pgtopic{tag}",), parsed=True)
+        )
+
+    flows = FlowBatch.from_numpy(**f)
+    out = datapath_step(world, flows)
+    allowed = np.asarray(out.allowed)
+    proxy = np.asarray(out.proxy_port)
+
+    m, ml, p, pl, h, hl, ovf = pad_requests(reqs)
+    assert not ovf.any()
+    kf = pad_kafka_requests(fleet.kafka, kreqs)
+    id_index, _ = d.endpoint_manager.identity_index()
+    sec_idx = np.asarray(
+        [id_index.get(int(s), 0) for s in np.asarray(out.sec_id)],
+        np.int32,
+    )
+    import jax.numpy as jnp
+
+    l7_ok = np.asarray(
+        evaluate_fleet_l7(
+            fleet,
+            flows.ep_index,
+            flows.direction,
+            out.l4_slot,
+            jnp.asarray(sec_idx),
+            jnp.ones(n, bool),
+            http_fields=tuple(
+                jnp.asarray(x) for x in (m, ml, p, pl, h, hl)
+            ),
+            kafka_fields=tuple(
+                jnp.asarray(np.asarray(x)) for x in kf
+            ),
+        )
+    )
+
+    failures = []
+    for row, (ctx_i, peer, req_match) in enumerate(cases):
+        ctx = combos[ctx_i]
+        want_allow, want_redirect, want_l7 = _expected(
+            ctx["l3"], ctx["l4"], ctx["l7"], peer, req_match
+        )
+        got_allow = bool(allowed[row])
+        got_redirect = bool(proxy[row] > 0) and got_allow
+        tag = (
+            f"combo {ctx['i']} {ctx['dirn']} l3={ctx['l3']} "
+            f"l4={ctx['l4']} l7={ctx['l7']} peer={peer} "
+            f"req_match={req_match}"
+        )
+        if got_allow != want_allow or got_redirect != want_redirect:
+            failures.append(
+                f"{tag}: allow={got_allow} (want {want_allow}) "
+                f"redirect={got_redirect} (want {want_redirect})"
+            )
+            continue
+        if want_redirect and bool(l7_ok[row]) != want_l7:
+            failures.append(
+                f"{tag}: l7={bool(l7_ok[row])} (want {want_l7})"
+            )
+    assert not failures, (
+        f"{len(failures)} of {len(cases)} cases diverged:\n"
+        + "\n".join(failures[:20])
+    )
