@@ -413,6 +413,7 @@ class Daemon:
         wait_group = WaitGroup()
         dirty = False
         attempted = []  # (endpoint, realized map before this attempt)
+        universe_unchanged = universe_version == prev_version
         for endpoint in self.endpoint_manager.endpoints():
             l4 = endpoint.desired_l4_policy
             if l4 is None or not l4.has_redirect():
@@ -421,6 +422,16 @@ class Daemon:
                         endpoint, cache, id_index, n_identities,
                         self.selector_cache,
                     )
+                continue
+            if (
+                universe_unchanged
+                and not endpoint.last_policy_changed
+                and endpoint.realized_redirects
+            ):
+                # unchanged policy + unchanged identity universe ⇒ the
+                # resolved matcher inputs are identical to the live
+                # redirects' — skip even the re-resolution (the
+                # fingerprint check would skip only the compile)
                 continue
             before = dict(endpoint.realized_redirects)
             realized = self.proxy.update_endpoint_redirects(
@@ -932,6 +943,10 @@ class Daemon:
                 ),
             )
         stats.seconds = _time.perf_counter() - t0
+        if stats.seconds > 0:
+            metrics.verdict_throughput.set(
+                stats.total / stats.seconds
+            )
         return stats
 
     def status(self) -> Dict:

@@ -116,6 +116,9 @@ class Endpoint:
         self.prev_identity_cache: Optional[IdentityCache] = None
         self.prev_universe_version: Optional[int] = None
         self.force_policy_compute = False
+        # did the last regeneration change this endpoint's desired
+        # policy?  (gates redirect re-resolution per sweep)
+        self.last_policy_changed = True
         self.ingress_policy_enabled = False
         self.egress_policy_enabled = False
         self.desired_l4_policy: Optional[L4Policy] = None
@@ -144,11 +147,22 @@ class Endpoint:
 
     # -- state machine -------------------------------------------------------
 
+    @staticmethod
+    def _count_state_change(old: str, new: str) -> None:
+        # endpoint_state gauge (metrics.go): kept on transitions, as
+        # the reference bumps it inside setState
+        from cilium_tpu.metrics import registry as metrics
+
+        if old:  # the initial "" pseudo-state is not a series
+            metrics.endpoint_state_count.dec(old)
+        metrics.endpoint_state_count.inc(new)
+
     def set_state(self, to_state: str, reason: str = "") -> bool:
         """SetStateLocked (endpoint.go:1983): invalid transitions are
         skipped, not raised."""
         with self.lock:
             if to_state in _TRANSITIONS.get(self.state, set()):
+                self._count_state_change(self.state, to_state)
                 self.state = to_state
                 return True
             return False
@@ -157,6 +171,7 @@ class Endpoint:
         """BuilderSetStateLocked (endpoint.go:2077)."""
         with self.lock:
             if to_state in _BUILDER_TRANSITIONS.get(self.state, set()):
+                self._count_state_change(self.state, to_state)
                 self.state = to_state
                 return True
             return False

@@ -116,6 +116,7 @@ class EndpointManager:
             ):
                 # not queued for regeneration (e.g. disconnecting)
                 return False
+            metrics.endpoint_count_regenerating.inc()
             try:
                 changed = endpoint.regenerate_policy(
                     repo,
@@ -126,6 +127,7 @@ class EndpointManager:
                     affected_identities=affected_identities,
                     affected_revision=affected_revision,
                 )
+                endpoint.last_policy_changed = bool(changed)
                 if changed:
                     endpoint.sync_policy_map()
                 endpoint.bump_policy_revision()
@@ -138,6 +140,8 @@ class EndpointManager:
                     STATE_WAITING_TO_REGENERATE, "regeneration failed"
                 )
                 raise
+            finally:
+                metrics.endpoint_count_regenerating.dec()
 
     def regenerate_all(
         self,

@@ -18,3 +18,4 @@ proxylib init() hooks do.
 """
 
 from cilium_tpu.l7 import memcached as _memcached  # noqa: F401
+from cilium_tpu.l7 import testparsers as _testparsers  # noqa: F401

@@ -127,6 +127,29 @@ def verdicts_to_events(
     allowed = np.asarray(verdicts.allowed)
     kind = np.asarray(verdicts.match_kind)
     proxy = np.asarray(verdicts.proxy_port)
+    # datapath traffic counters (metrics.go drop_count_total /
+    # forward_count_total), batched — one inc per (reason, direction)
+    from cilium_tpu.metrics import registry as _metrics
+
+    for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
+        in_dir = np.asarray(directions) == dirv
+        fwd = int((allowed.astype(bool) & in_dir).sum())
+        if fwd:
+            _metrics.forward_count.inc(dname, value=fwd)
+        denied = (~allowed.astype(bool)) & in_dir
+        frag = denied & (kind == MATCH_FRAG_DROP)
+        pol = denied & ~frag
+        if int(pol.sum()):
+            _metrics.drop_count.inc(
+                "Policy denied", dname, value=int(pol.sum())
+            )
+        if int(frag.sum()):
+            _metrics.drop_count.inc(
+                "Fragmented packet", dname, value=int(frag.sum())
+            )
+    import time as _time
+
+    _metrics.event_ts.set(_time.time(), "api")
     n = 0
     per_ep = None
     if emit_allowed:

@@ -42,6 +42,7 @@ from cilium_tpu.l7.kafka import (
     rule_spec_from_port_rule,
 )
 from cilium_tpu.l7.proxylib import GenericL7Tables
+from cilium_tpu.metrics import registry as metrics
 from cilium_tpu.monitor.bus import MonitorBus
 from cilium_tpu.monitor.events import LogRecordNotify
 from cilium_tpu.policy.l4 import L4Filter, proxy_id
@@ -228,6 +229,7 @@ class Proxy:
             with self._lock:
                 if self._pids.get(pid) is state and state.gen == gen:
                     self.redirects[pid] = redirect
+            self._update_redirect_gauge()
             if wait_group is not None:
                 wait_group.add_completion().complete()
             return redirect
@@ -236,6 +238,7 @@ class Proxy:
             with self._lock:
                 if self._pids.get(pid) is state and state.gen == gen:
                     self.redirects[pid] = redirect
+            self._update_redirect_gauge()
             return redirect
 
         completion = wait_group.add_completion()
@@ -251,6 +254,7 @@ class Proxy:
                 # resurrect — the newest generation wins
                 if self._pids.get(pid) is state and state.gen == gen:
                     self.redirects[pid] = redirect
+            self._update_redirect_gauge()
             completion.complete()
 
         self._compiler.submit(job)
@@ -328,7 +332,28 @@ class Proxy:
             if state is None:
                 return False
             self._ports_in_use.discard(state.port)
-            return True
+        self._update_redirect_gauge()
+        return True
+
+    def _update_redirect_gauge(self) -> None:
+        """proxy_redirects{protocol} (metrics.go): installed
+        redirects by parser."""
+        from collections import Counter as _C
+
+        with self._lock:
+            by_parser = _C(r.parser for r in self.redirects.values())
+            # zero every label ever seen, then set current counts —
+            # a parser whose last redirect vanished must not stay
+            # stale in the exposition
+            seen = self._gauge_parsers = getattr(
+                self, "_gauge_parsers", set()
+            )
+            seen.update(by_parser)
+            seen.update((PARSER_HTTP, PARSER_KAFKA))
+        for parser in seen:
+            metrics.proxy_redirects.set(
+                float(by_parser.get(parser, 0)), parser
+            )
 
     def redirect_for(
         self, endpoint_id: int, ingress: bool, protocol: str, port: int
@@ -371,6 +396,12 @@ class Proxy:
         if known is None:
             known = np.ones(len(requests), dtype=bool)
         allowed = evaluate(tables, requests, ident_idx, known)
+        n_fwd = int(np.asarray(allowed).sum())
+        metrics.policy_l7_total.inc("received", value=len(requests))
+        metrics.policy_l7_total.inc("forwarded", value=n_fwd)
+        metrics.policy_l7_total.inc(
+            "denied", value=len(requests) - n_fwd
+        )
         if log and self.monitor is not None:
             for i, request in enumerate(requests):
                 self.log_record(

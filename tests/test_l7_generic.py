@@ -217,3 +217,97 @@ def test_daemon_e2e_l7proto_redirect():
         redirect, requests, ident, log=True
     )
     assert list(allowed) == [True, True, False, False]
+
+
+# ---------------------------------------------------------------------------
+# proxylib test parsers (proxylib/testparsers/*.go): the framing
+# edge cases that prove the registry contract beyond one consumer
+# ---------------------------------------------------------------------------
+
+
+def test_lineparser_framing_and_verdicts():
+    from cilium_tpu.l7.proxylib import get_parser
+
+    p = get_parser("test.lineparser")
+    reqs, consumed = p.decode_stream(b"PASS hello\nDROP x\nPAR")
+    assert consumed == len(b"PASS hello\nDROP x\n")  # partial tail waits
+    assert [r.get("line") for r in reqs] == ["PASS hello\n", "DROP x\n"]
+    specs = p.compile_rules([], [1, 2])
+    assert p.rule_matches(reqs[0], specs[0])
+    assert not p.rule_matches(reqs[1], specs[0])
+    assert p.deny_response(reqs[1]) == b"DROPPED\n"
+
+
+def test_blockparser_framing_edges():
+    from cilium_tpu.l7.proxylib import get_parser
+    from cilium_tpu.l7.testparsers import FramingError
+
+    p = get_parser("test.blockparser")
+    # "<len>:<content>" where len counts digits + content
+    buf = b"5:PASS" + b"7:DROPme"
+    reqs, consumed = p.decode_stream(buf)
+    assert consumed == len(buf)
+    assert [r.get("block") for r in reqs] == ["PASS", "DROPme"]
+    # partial frame: length known, content incomplete → wait
+    reqs, consumed = p.decode_stream(b"12:PASS123")
+    assert reqs == [] and consumed == 0
+    # partial length prefix → wait
+    reqs, consumed = p.decode_stream(b"123")
+    assert reqs == [] and consumed == 0
+    # invalid length → framing error (ERROR_INVALID_FRAME_LENGTH)
+    import pytest as _pytest
+
+    with _pytest.raises(FramingError):
+        p.decode_stream(b"xx:PASS")
+    with _pytest.raises(FramingError):
+        p.decode_stream(b"1:PASS")  # length shorter than its digits
+
+
+def test_headerparser_policy_rules():
+    from cilium_tpu.l7.proxylib import get_parser
+
+    p = get_parser("test.headerparser")
+    specs = p.compile_rules(
+        [
+            {"HasPrefix": "GET"},
+            {"Contains": "secret", "HasSuffix": "42"},
+        ],
+        [3],
+    )
+    reqs, _ = p.decode_stream(
+        b"GET /x\n  has secret suffix 42  \nPOST /y\n"
+    )
+    assert len(reqs) == 3
+    # line 1 matches rule 0; line 2 matches rule 1 (trimmed); line 3
+    # matches nothing → deny
+    assert p.rule_matches(reqs[0], specs[0])
+    assert not p.rule_matches(reqs[0], specs[1])
+    assert p.rule_matches(reqs[1], specs[1])
+    assert not any(p.rule_matches(reqs[2], s) for s in specs)
+
+
+def test_testparser_through_daemon_redirect():
+    """A test parser rides the SAME daemon redirect path as the
+    bundled memcached parser (l7proto dispatch, compiled generic
+    tables, request verdicts)."""
+    import numpy as np
+
+    from cilium_tpu.l7.proxylib import (
+        compile_generic_rules,
+        evaluate_requests,
+    )
+
+    tables = compile_generic_rules(
+        "test.headerparser",
+        [([0, 1], [{"HasPrefix": "GET"}])],
+        4,
+    )
+    from cilium_tpu.l7.proxylib import get_parser
+
+    p = get_parser("test.headerparser")
+    reqs, _ = p.decode_stream(b"GET /ok\nPUT /no\n")
+    allowed = evaluate_requests(
+        tables, reqs, np.asarray([0, 0], np.int32),
+        np.ones(2, dtype=bool),
+    )
+    assert list(allowed) == [True, False]

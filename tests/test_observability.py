@@ -280,3 +280,67 @@ def test_cli_monitor_verbose_renders_dissected(tmp_path, capsys):
         assert "xx drop (Policy denied (L3)) to endpoint 7" in out
     finally:
         server.stop()
+
+
+def test_metrics_breadth_wired():
+    """metrics.go:120-278 breadth: drop/forward counters, event_ts,
+    proxy_redirects, policy_l7_total, endpoint_state — all LIVE, fed
+    by the real paths, not just declared."""
+    from cilium_tpu.metrics import registry as metrics
+    from tests.test_replay import _daemon_with_policy, _make_buf
+
+    d, server, client = _daemon_with_policy()
+    rng = np.random.default_rng(5)
+    cid = client.security_identity.id
+    buf = _make_buf(rng, 64, [10], [cid, 999999])
+
+    drops_before = metrics.drop_count.get("Policy denied", "INGRESS")
+    fwd_before = metrics.forward_count.get("INGRESS")
+    stats = d.process_flows(buf, batch_size=32)
+    assert (
+        metrics.drop_count.get("Policy denied", "INGRESS")
+        - drops_before
+        == stats.denied
+        > 0
+    )
+    assert (
+        metrics.forward_count.get("INGRESS") - fwd_before
+        == stats.allowed
+        > 0
+    )
+    assert metrics.event_ts.get("api") > 0
+    assert metrics.verdict_throughput.get() > 0
+
+    # endpoint_state gauge tracks transitions (ready after regen)
+    assert metrics.endpoint_state_count.get("ready") >= 1
+
+    exposition = metrics.expose()
+    assert "cilium_drop_count_total" in exposition
+    assert "cilium_forward_count_total" in exposition
+
+
+def test_proxy_l7_metrics():
+    from cilium_tpu.metrics import registry as metrics
+    from cilium_tpu.l7.http import HTTPRuleSpec, compile_http_rules
+    from cilium_tpu.proxy.proxy import Proxy, Redirect
+
+    proxy = Proxy()
+    redirect = Redirect(
+        id="t:i:tcp:80", proxy_port=10001, parser="http",
+        endpoint_id=4, ingress=True,
+    )
+    redirect.http_policy = compile_http_rules(
+        [HTTPRuleSpec(identity_indices=[1], method="GET", path="/a")],
+        n_identities=8,
+    )
+    received = metrics.policy_l7_total.get("received")
+    denied = metrics.policy_l7_total.get("denied")
+    allowed = proxy.verdict_http(
+        redirect,
+        [(b"GET", b"/a", b""), (b"POST", b"/a", b"")],
+        np.asarray([1, 1], np.int32),
+        log=False,
+    )
+    assert list(allowed) == [True, False]
+    assert metrics.policy_l7_total.get("received") - received == 2
+    assert metrics.policy_l7_total.get("denied") - denied == 1
