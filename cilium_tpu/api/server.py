@@ -106,6 +106,52 @@ class DaemonAPI:
             "ipam_cidr": str(self.daemon.ipam.cidr),
         }
 
+    def debug_profile(self) -> dict:
+        """The pprof/loadinfo analog (the reference serves
+        /debug/pprof and logs loadinfo on slow operations): a
+        point-in-time profile of every live thread's stack plus the
+        daemon's accumulated regeneration span statistics — enough to
+        diagnose a wedged agent over the API, which is what the
+        reference's handlers exist for."""
+        import sys as _sys
+        import threading as _threading
+        import traceback as _traceback
+
+        frames = _sys._current_frames()
+        threads = []
+        for t in _threading.enumerate():
+            frame = frames.get(t.ident)
+            threads.append(
+                {
+                    "name": t.name,
+                    "daemon": t.daemon,
+                    "stack": (
+                        _traceback.format_stack(frame)
+                        if frame is not None
+                        else []
+                    ),
+                }
+            )
+        spans = {
+            name: {
+                "success_total_s": s.success_total,
+                "failure_total_s": s.failure_total,
+                "num_success": s.num_success,
+                "num_failure": s.num_failure,
+            }
+            for name, s in self.daemon.regen_spans.items()
+        }
+        try:
+            load1, load5, load15 = __import__("os").getloadavg()
+        except OSError:  # pragma: no cover - platform-dependent
+            load1 = load5 = load15 = -1.0
+        return {
+            "threads": threads,
+            "num_threads": len(threads),
+            "regeneration_spans": spans,
+            "loadavg": [load1, load5, load15],
+        }
+
     def policy_get(self) -> dict:
         repo = self.daemon.repo
         return {
@@ -472,6 +518,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(200, api.ipcache_dump())
             if path == "/metrics":
                 return self._reply(200, api.metrics_dump())
+            if path == "/debug/profile":
+                return self._reply(200, api.debug_profile())
             if path == "/service":
                 return self._reply(200, api.service_list())
             if path == "/ct":

@@ -160,6 +160,10 @@ class Daemon:
         self._pending_rule_selectors: Optional[list] = []
         self.monitor = MonitorBus()
         self.proxy = Proxy(monitor=self.monitor)
+        # accumulated per-phase regeneration spans (pkg/spanstat; the
+        # reference logs one SpanStat per phase, policy.go:689-699) —
+        # served by GET /debug/profile
+        self.regen_spans = SpanStats()
         self.controllers = ControllerManager()
         # periodic CT GC (pkg/maps/ctmap GC; endpointmanager
         # conntrack.go loop)
@@ -359,6 +363,18 @@ class Daemon:
             self._pending_rule_selectors = None
         self.policy_trigger.trigger_with_reason(reason)
 
+    def _accumulate_regen_span(
+        self, stats: SpanStats, success: bool
+    ) -> None:
+        """Fold one run's spans into the lifetime accumulators served
+        by GET /debug/profile (pkg/spanstat's success/failure split)."""
+        for name, span in stats.items():
+            acc = self.regen_spans.span(name)
+            acc.success_total += span.success_total
+            acc.failure_total += span.failure_total
+            acc.num_success += span.num_success
+            acc.num_failure += span.num_failure
+
     def _regenerate_for_reasons(self, reasons: List[str]) -> None:
         self.regenerate_all(", ".join(reasons) or "trigger")
 
@@ -367,7 +383,8 @@ class Daemon:
             return self._regenerate_all_locked(reason)
 
     def _regenerate_all_locked(self, reason: str = "") -> int:
-        stats = SpanStats()
+        stats = SpanStats()  # fresh per run: the histogram observes
+        # THIS run's duration; regen_spans accumulates across runs
         stats.span("total").start()
         cache = self.identity_cache()
         prev_version = self.selector_cache.version
@@ -456,7 +473,8 @@ class Daemon:
             for endpoint, before in attempted:
                 endpoint.realized_redirects = before
                 endpoint.force_policy_compute = True
-            stats.span("total").end()
+            stats.span("total").end(success=False)
+            self._accumulate_regen_span(stats, success=False)
             return n
         if dirty:
             self.endpoint_manager.regenerate_all(
@@ -473,6 +491,7 @@ class Daemon:
         metrics.endpoint_regeneration_seconds.observe(
             stats.span("total").total()
         )
+        self._accumulate_regen_span(stats, success=True)
         return n
 
     # -- endpoint API (daemon/endpoint.go) ----------------------------------

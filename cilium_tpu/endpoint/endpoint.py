@@ -149,13 +149,17 @@ class Endpoint:
 
     @staticmethod
     def _count_state_change(old: str, new: str) -> None:
-        # endpoint_state gauge (metrics.go): kept on transitions, as
-        # the reference bumps it inside setState
+        # endpoint_state gauge, kept on transitions; the reference
+        # deliberately does NOT count the terminal disconnected state
+        # (endpoint.go:2065-2069: "the final state, after which the
+        # endpoint is gone") — counting it would grow unboundedly as
+        # endpoints come and go
         from cilium_tpu.metrics import registry as metrics
 
         if old:  # the initial "" pseudo-state is not a series
             metrics.endpoint_state_count.dec(old)
-        metrics.endpoint_state_count.inc(new)
+        if new != STATE_DISCONNECTED:
+            metrics.endpoint_state_count.inc(new)
 
     def set_state(self, to_state: str, reason: str = "") -> bool:
         """SetStateLocked (endpoint.go:1983): invalid transitions are

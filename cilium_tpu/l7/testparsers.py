@@ -135,7 +135,8 @@ register_parser(
         decode_stream=_decode_blocks,
         compile_rules=_line_compile,
         rule_matches=_block_matches,
-        deny_response=lambda req: b"7:DROPPED",
+        # length counts digits + content: 1 + len("DROPPED") = 8
+        deny_response=lambda req: b"8:DROPPED",
     )
 )
 

@@ -1,0 +1,184 @@
+"""Terminating Kafka TCP proxy — the kafkaListener loop.
+
+Behavioral port of /root/reference/pkg/proxy/kafka.go:405
+(kafkaRedirect.Listen + handleRequestConnection/
+handleResponseConnection): a real socket listener on the redirect's
+proxy port terminates client connections, decodes Kafka request
+frames off the stream (l7/kafka_wire.decode_request over a growing
+buffer), applies the redirect's compiled policy per request, FORWARDS
+allowed frames to the upstream broker over a second connection, and
+answers denied requests itself with the synthesized error response
+(TopicAuthorizationFailed) — the broker never sees them.  Broker
+responses stream back matched through the CorrelationCache, so the
+access log can pair verdicts with responses the way
+correlation_cache.go does.
+
+The identity of the client connection comes from a caller-provided
+resolver (the reference derives it from the socket mark the datapath
+set; here the datapath's ipcache serves the same answer by source
+address)."""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional, Tuple
+
+from cilium_tpu.l7.kafka import matches_rules_host
+from cilium_tpu.l7.kafka_wire import (
+    CorrelationCache,
+    KafkaIncompleteFrame,
+    KafkaParseError,
+    decode_request,
+    encode_deny_response,
+)
+from cilium_tpu.metrics import registry as metrics
+
+
+class KafkaProxyListener:
+    """One redirect's terminating listener."""
+
+    def __init__(
+        self,
+        redirect,  # proxy.Redirect with kafka_tables compiled
+        identity_resolver: Callable[[Tuple[str, int]], int],
+        upstream: Tuple[str, int],
+        host: str = "127.0.0.1",
+        port: int = 0,  # 0 = ephemeral (tests); redirect.proxy_port
+        access_log: Optional[Callable[[str, str], None]] = None,
+    ) -> None:
+        self.redirect = redirect
+        self.identity_resolver = identity_resolver
+        self.upstream = upstream
+        self.access_log = access_log
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one client connection
+                outer._handle_connection(self.request,
+                                         self.client_address)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.address = self._server.server_address
+
+    def start(self) -> "KafkaProxyListener":
+        threading.Thread(
+            target=self._server.serve_forever,
+            name="kafka-proxy",
+            daemon=True,
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- the connection loop (handleRequestConnection) ----------------------
+
+    def _handle_connection(self, client: socket.socket, addr) -> None:
+        tables = self.redirect.kafka_tables
+        if tables is None:
+            client.close()
+            return
+        ident_idx = int(self.identity_resolver(addr))
+        cache = CorrelationCache()
+        try:
+            broker = socket.create_connection(self.upstream, timeout=5)
+        except OSError:
+            client.close()
+            return
+
+        stop = threading.Event()
+
+        def pump_responses() -> None:
+            """handleResponseConnection: broker → client, pairing
+            responses with their requests for the access log."""
+            rbuf = b""
+            try:
+                while not stop.is_set():
+                    chunk = broker.recv(65536)
+                    if not chunk:
+                        break
+                    rbuf += chunk
+                    # responses: i32 length + i32 correlation id
+                    while len(rbuf) >= 8:
+                        (length,) = struct.unpack_from(">i", rbuf)
+                        if length < 4 or len(rbuf) < 4 + length:
+                            break
+                        (cid,) = struct.unpack_from(">i", rbuf, 4)
+                        req = cache.match(cid)
+                        if req is not None and self.access_log:
+                            self.access_log(
+                                "Response", f"cid={cid}"
+                            )
+                        client.sendall(rbuf[: 4 + length])
+                        rbuf = rbuf[4 + length :]
+            except OSError:
+                pass
+            finally:
+                stop.set()
+                try:
+                    client.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        resp_thread = threading.Thread(
+            target=pump_responses, daemon=True
+        )
+        resp_thread.start()
+
+        buf = b""
+        try:
+            while not stop.is_set():
+                chunk = client.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                off = 0
+                while True:
+                    try:
+                        req, cid, end = decode_request(buf, off)
+                    except KafkaIncompleteFrame:
+                        break
+                    except KafkaParseError:
+                        # connection-fatal, as the reference closes on
+                        # unparseable frames
+                        stop.set()
+                        break
+                    frame = buf[off:end]
+                    off = end
+                    allowed = matches_rules_host(
+                        req, tables.specs, ident_idx
+                    )
+                    metrics.policy_l7_total.inc("received")
+                    if allowed:
+                        metrics.policy_l7_total.inc("forwarded")
+                        cache.record(cid, req)
+                        broker.sendall(frame)
+                        if self.access_log:
+                            self.access_log(
+                                "Forwarded", f"cid={cid}"
+                            )
+                    else:
+                        metrics.policy_l7_total.inc("denied")
+                        client.sendall(
+                            encode_deny_response(req, cid)
+                        )
+                        if self.access_log:
+                            self.access_log("Denied", f"cid={cid}")
+                buf = buf[off:]
+        except OSError:
+            pass
+        finally:
+            stop.set()
+            for s in (broker, client):
+                try:
+                    s.close()
+                except OSError:
+                    pass

@@ -146,6 +146,12 @@ class Proxy:
         self._compiler = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="proxy-compile"
         )
+        # versioned view of installed redirects (pkg/envoy/xds cache:
+        # every install/remove is one cache transaction; NPDS-style
+        # consumers observe versions and long-poll get_resources)
+        from cilium_tpu.proxy.xds import Cache as _XDSCache
+
+        self.xds = _XDSCache()
 
     # -- port allocation (proxy.go allocatePort) ----------------------------
 
@@ -229,6 +235,7 @@ class Proxy:
             with self._lock:
                 if self._pids.get(pid) is state and state.gen == gen:
                     self.redirects[pid] = redirect
+            self._publish_xds(redirect, prev)
             self._update_redirect_gauge()
             if wait_group is not None:
                 wait_group.add_completion().complete()
@@ -237,7 +244,12 @@ class Proxy:
             self._compile_tables(redirect, resolved, n_identities)
             with self._lock:
                 if self._pids.get(pid) is state and state.gen == gen:
+                    installed = True
                     self.redirects[pid] = redirect
+                else:
+                    installed = False
+            if installed:
+                self._publish_xds(redirect, prev)
             self._update_redirect_gauge()
             return redirect
 
@@ -253,7 +265,12 @@ class Proxy:
                 # superseded by a newer compile, or removed: do not
                 # resurrect — the newest generation wins
                 if self._pids.get(pid) is state and state.gen == gen:
+                    installed = True
                     self.redirects[pid] = redirect
+                else:
+                    installed = False
+            if installed:
+                self._publish_xds(redirect, prev)
             self._update_redirect_gauge()
             completion.complete()
 
@@ -328,12 +345,31 @@ class Proxy:
         invalidates any in-flight compile for it."""
         with self._lock:
             state = self._pids.pop(pid, None)
-            self.redirects.pop(pid, None)
+            removed = self.redirects.pop(pid, None)
             if state is None:
                 return False
             self._ports_in_use.discard(state.port)
+        if removed is not None:
+            self.xds.delete(
+                self._xds_typeurl(removed.parser), removed.id
+            )
         self._update_redirect_gauge()
         return True
+
+    @staticmethod
+    def _xds_typeurl(parser: str) -> str:
+        return f"type.cilium.io/{parser}NetworkPolicy"
+
+    def _publish_xds(
+        self, redirect: "Redirect", prev: "Optional[Redirect]" = None
+    ) -> None:
+        if prev is not None and prev.parser != redirect.parser:
+            # a pid whose parser changed must not linger under the
+            # old type URL for long-polling consumers
+            self.xds.delete(self._xds_typeurl(prev.parser), prev.id)
+        self.xds.upsert(
+            self._xds_typeurl(redirect.parser), redirect.id, redirect
+        )
 
     def _update_redirect_gauge(self) -> None:
         """proxy_redirects{protocol} (metrics.go): installed
@@ -344,13 +380,15 @@ class Proxy:
             by_parser = _C(r.parser for r in self.redirects.values())
             # zero every label ever seen, then set current counts —
             # a parser whose last redirect vanished must not stay
-            # stale in the exposition
+            # stale in the exposition.  Snapshot under the lock:
+            # concurrent installs mutate the seen-set.
             seen = self._gauge_parsers = getattr(
                 self, "_gauge_parsers", set()
             )
             seen.update(by_parser)
             seen.update((PARSER_HTTP, PARSER_KAFKA))
-        for parser in seen:
+            snapshot = tuple(seen)
+        for parser in snapshot:
             metrics.proxy_redirects.set(
                 float(by_parser.get(parser, 0)), parser
             )

@@ -409,3 +409,28 @@ def test_monitor_poll_redelivers_unacked_batch(tmp_path):
         assert [e["source"] for e in got3["events"]] == [9]
     finally:
         server.stop()
+
+
+def test_debug_profile_endpoint(tmp_path):
+    """GET /debug/profile — the pprof analog: live thread stacks +
+    accumulated regeneration spans + load averages."""
+    from cilium_tpu.api.client import APIClient
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    d.policy_trigger.close(wait=True)
+    d.regenerate_all("profile test")
+    sock = str(tmp_path / "prof.sock")
+    server = APIServer(d, sock).start()
+    try:
+        got = APIClient(sock)._request("GET", "/debug/profile")
+        assert got["num_threads"] >= 1
+        assert any(
+            t["stack"] for t in got["threads"]
+        )  # real stacks captured
+        spans = got["regeneration_spans"]
+        assert "total" in spans and spans["total"]["num_success"] >= 1
+        assert len(got["loadavg"]) == 3
+    finally:
+        server.stop()

@@ -185,3 +185,112 @@ def test_kafka_fuzz_device_vs_host(seed):
     for i, (request, idx) in enumerate(zip(requests, idents)):
         want = matches_rules_host(request, specs, idx)
         assert got[i] == want, (i, request, idx)
+
+
+# ---------------------------------------------------------------------------
+# terminating TCP listener (pkg/proxy/kafka.go:405 kafkaListener)
+# ---------------------------------------------------------------------------
+
+
+def test_kafka_terminating_tcp_listener():
+    """A real client connection through the proxy: allowed requests
+    reach the broker and their responses stream back; denied requests
+    are answered by the PROXY with TopicAuthorizationFailed and never
+    reach the broker."""
+    import socket
+    import socketserver
+    import struct
+    import threading
+
+    from cilium_tpu.l7.kafka import KafkaRuleSpec, compile_kafka_rules
+    from cilium_tpu.l7.kafka_wire import decode_request, encode_request
+    from cilium_tpu.proxy.kafka_listener import KafkaProxyListener
+    from cilium_tpu.proxy.proxy import Redirect
+
+    seen_by_broker = []
+
+    class FakeBroker(socketserver.BaseRequestHandler):
+        def handle(self):
+            buf = b""
+            while True:
+                try:
+                    chunk = self.request.recv(65536)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                while len(buf) >= 4:
+                    (length,) = struct.unpack_from(">i", buf)
+                    if len(buf) < 4 + length:
+                        break
+                    frame = buf[: 4 + length]
+                    buf = buf[4 + length :]
+                    req, cid, _ = decode_request(frame)
+                    seen_by_broker.append((req.topics, cid))
+                    # minimal OK response: len + cid + empty topics
+                    body = struct.pack(">ii", cid, 0)
+                    self.request.sendall(
+                        struct.pack(">i", len(body)) + body
+                    )
+
+    broker_srv = socketserver.ThreadingTCPServer(
+        ("127.0.0.1", 0), FakeBroker
+    )
+    broker_srv.daemon_threads = True
+    threading.Thread(
+        target=broker_srv.serve_forever, daemon=True
+    ).start()
+
+    tables = compile_kafka_rules(
+        [KafkaRuleSpec(identity_indices=[7], topic="orders")], 16
+    )
+    redirect = Redirect(
+        id="4:i:tcp:9092", proxy_port=0, parser="kafka",
+        endpoint_id=4, ingress=True, kafka_tables=tables,
+    )
+    logs = []
+    listener = KafkaProxyListener(
+        redirect,
+        identity_resolver=lambda addr: 7,
+        upstream=broker_srv.server_address,
+        access_log=lambda verdict, info: logs.append(verdict),
+    ).start()
+    try:
+        c = socket.create_connection(listener.address, timeout=5)
+        from cilium_tpu.l7.kafka import KafkaRequest
+
+        ok = KafkaRequest(kind=0, version=0, client_id="c",
+                          topics=("orders",), parsed=True)
+        bad = KafkaRequest(kind=0, version=0, client_id="c",
+                           topics=("secrets",), parsed=True)
+        c.sendall(encode_request(ok, correlation_id=11))
+        c.sendall(encode_request(bad, correlation_id=12))
+
+        got = {}
+        buf = b""
+        c.settimeout(5)
+        while len(got) < 2:
+            chunk = c.recv(65536)
+            assert chunk, "connection closed early"
+            buf += chunk
+            while len(buf) >= 8:
+                (length,) = struct.unpack_from(">i", buf)
+                if len(buf) < 4 + length:
+                    break
+                (cid,) = struct.unpack_from(">i", buf, 4)
+                got[cid] = buf[: 4 + length]
+                buf = buf[4 + length :]
+        # the allowed request reached the broker; the denied one did
+        # NOT, and its response came from the proxy (per-topic error)
+        assert [t for t, _ in seen_by_broker] == [("orders",)]
+        assert 11 in got and 12 in got
+        # denied produce response carries the topic error block
+        assert b"secrets" in got[12]
+        assert logs.count("Denied") == 1
+        assert logs.count("Forwarded") == 1
+        c.close()
+    finally:
+        listener.stop()
+        broker_srv.shutdown()
+        broker_srv.server_close()

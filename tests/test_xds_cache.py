@@ -1,0 +1,128 @@
+"""Generic versioned-resource cache (pkg/envoy/xds/cache.go + set.go
++ ack.go): transactions bump one monotonic version, observers learn of
+new versions, get_resources long-polls past a known version, and the
+ACK gate completes a WaitGroup when an observed version lands."""
+
+import threading
+import time
+
+from cilium_tpu.proxy.xds import Cache, wait_for_version
+from cilium_tpu.utils.completion import WaitGroup
+
+
+def test_tx_versioning_and_idempotence():
+    c = Cache()
+    v1, updated = c.upsert("t/A", "r1", {"x": 1})
+    assert updated and v1 == 1
+    # same object again: no version bump (cache.go tx updated=false)
+    same = c.lookup("t/A", "r1")
+    v2, updated = c.upsert("t/A", "r1", same)
+    assert not updated and v2 == v1
+    # a different type URL shares the SAME version counter
+    v3, _ = c.upsert("t/B", "r9", {"y": 2})
+    assert v3 == v1 + 1
+    v4, updated = c.delete("t/A", "r1")
+    assert updated and v4 == v3 + 1
+    assert c.lookup("t/A", "r1") is None
+    _, updated = c.delete("t/A", "r1")
+    assert not updated
+
+
+def test_get_resources_long_poll():
+    c = Cache()
+    c.upsert("t/A", "r1", "one")
+    version, res = c.get_resources("t/A")
+    assert res == {"r1": "one"}
+
+    got = {}
+
+    def poll():
+        got["out"] = c.get_resources(
+            "t/A", last_version=version, timeout=5
+        )
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.1)
+    assert "out" not in got  # blocked on the unchanged version
+    c.upsert("t/A", "r2", "two")
+    t.join(timeout=5)
+    v2, res2 = got["out"]
+    assert res2 == {"r1": "one", "r2": "two"} and v2 > version
+    # timeout path
+    assert c.get_resources("t/A", last_version=v2, timeout=0.05) is None
+
+
+def test_observers_and_ack_gate():
+    c = Cache()
+    seen = []
+    c.add_observer("t/A", lambda t, v: seen.append(v))
+    v1, _ = c.upsert("t/A", "r1", "one")
+    assert seen == [v1]
+
+    wg = WaitGroup()
+    wait_for_version(c, "t/A", v1 + 1, wg)
+    assert wg.pending
+    c.upsert("t/A", "r2", "two")
+    assert wg.wait(timeout=5)
+
+    # already-reached versions complete immediately
+    wg2 = WaitGroup()
+    wait_for_version(c, "t/A", 1, wg2)
+    assert wg2.wait(timeout=1)
+
+
+def test_proxy_publishes_redirects_to_xds():
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.labels import Label, Labels
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.rule import L7Rules, PortRuleHTTP
+
+    d = Daemon(num_workers=2)
+    d.policy_trigger.close(wait=True)
+    d.create_endpoint(
+        100, Labels({"app": Label("app", "w", "k8s")}),
+        ipv4="10.5.0.1", name="w",
+    )
+    d.policy_add(
+        [
+            Rule(
+                endpoint_selector=EndpointSelector(
+                    match_labels={"k8s.app": "w"}
+                ),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[EndpointSelector()],
+                        to_ports=[
+                            PortRule(
+                                ports=[PortProtocol(port="8080",
+                                                    protocol="TCP")],
+                                rules=L7Rules(
+                                    http=[PortRuleHTTP(method="GET")]
+                                ),
+                            )
+                        ],
+                    )
+                ],
+            )
+        ]
+    )
+    d.regenerate_all("xds test")
+    typeurl = "type.cilium.io/httpNetworkPolicy"
+    version, res = d.proxy.xds.get_resources(typeurl)
+    assert len(res) == 1
+    (redirect,) = res.values()
+    assert redirect.proxy_port >= 10000
+    # policy removal tears the redirect down AND the cache entry
+    from cilium_tpu.labels import LabelArray
+
+    d.policy_delete(LabelArray.parse())  # delete-all by empty labels
+    d.regenerate_all("teardown")
+    v2, res2 = d.proxy.xds.get_resources(typeurl)
+    assert res2 == {} and v2 > version
