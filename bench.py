@@ -10,7 +10,16 @@ bpf_lxc.c:440/899 being one program).  A composed-host-oracle
 bit-identity gate runs on a subsample before timing; divergence aborts
 the bench.
 
-Configs 1-4 (one JSON line each):
+Config 5 also emits:
+  * config5_combined_verdicts_per_sec — the fused datapath PLUS
+    inline fleet-L7 matching of redirected flows in ONE measured
+    pipeline (the kernel-datapath+Envoy system), with its own
+    composed oracle incl. L7;
+  * incremental_update_ms — one rule added to the full world →
+    delta-scoped regenerate → freshly published tables;
+  * ct_churn / lattice / control-plane compile supporting lines.
+
+Configs 1-4, 6 (one JSON line each):
   1. L3/L4 identity-pair allowlist from real rules, 1k tuples — the
      minimum end-to-end slice, oracle-gated.
   2. CIDR ruleset: DIR-24-8 ipcache LPM identity derivation feeding
@@ -20,6 +29,8 @@ Configs 1-4 (one JSON line each):
      oracle subsample.
   4. Kafka L7: field-equality tensors, 1M requests, MatchesRule host
      oracle subsample.
+  6. The fused IPv6 program (prefilter6 → lb6/DNAT → CT6 → ipcache6
+     → shared lattice), 1M tuples, composed-oracle subsample.
 
 Output: one JSON line per config; the final line is
 {"metric": "verdicts_per_sec_per_chip", ...} for config 5 through the
