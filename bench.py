@@ -518,10 +518,7 @@ def run_config5(args) -> None:
     # --- timed fused replay: args.tuples sampled from the pool -------------
     tables = jax.device_put(tables)
     n_batches = max(args.tuples // args.batch, 1)
-    from cilium_tpu.engine.datapath import (
-        datapath_step_accum_egress,
-        datapath_step_accum_ingress,
-    )
+    from cilium_tpu.engine.datapath import datapath_step_accum_pair
     from cilium_tpu.engine.verdict import make_counter_buffers
 
     # The datapath is direction-specialized (bpf_lxc's separate
@@ -549,11 +546,8 @@ def run_config5(args) -> None:
         flow_batches.append(tuple(pair))
     # warmup/compile (counters scatter into a carried donated buffer)
     acc = jax.device_put(make_counter_buffers(tables.policy))
-    out_i, acc = datapath_step_accum_ingress(
-        tables, flow_batches[0][0], acc
-    )
-    out_e, acc = datapath_step_accum_egress(
-        tables, flow_batches[0][1], acc
+    out_i, out_e, acc = datapath_step_accum_pair(
+        tables, flow_batches[0][0], flow_batches[0][1], acc
     )
     jax.block_until_ready((out_i, out_e, acc))
     # force the device into real-sync mode BEFORE timing: the first
@@ -567,8 +561,9 @@ def run_config5(args) -> None:
     outs = []
     for i in range(n_batches):
         fin, feg = flow_batches[i % len(flow_batches)]
-        out_i, acc = datapath_step_accum_ingress(tables, fin, acc)
-        out_e, acc = datapath_step_accum_egress(tables, feg, acc)
+        out_i, out_e, acc = datapath_step_accum_pair(
+            tables, fin, feg, acc
+        )
         outs.append((out_i, out_e))
         if len(outs) > 4:
             jax.block_until_ready(outs.pop(0))
@@ -685,7 +680,8 @@ def run_config5(args) -> None:
             vps * gather_bytes_per_tuple / 1e9, 1
         ),
         pipeline=(
-            "fused per-direction programs: prefilter+LB/DNAT+CT+"
+            "paired per-direction programs, one dispatch + one "
+            "merged counter scatter per pair: prefilter+LB/DNAT+CT+"
             "ipcache+lattice+counters"
         ),
     )

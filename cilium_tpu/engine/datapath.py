@@ -270,6 +270,7 @@ def _datapath_core(
     acc=None,
     emit_sec_id: bool = True,
     static_direction=None,
+    defer_counters: bool = False,
 ):
     """The fused per-packet pipeline.  With an idx-form ipcache
     (specialize_ipcache_to_idx) the identity lookup yields the dense
@@ -424,12 +425,21 @@ def _datapath_core(
         tables.policy, resolved, idx_known=idx_known
     )
     v = _combine(probe1, probe2, probe3, proxy, resolved.is_fragment)
+    deferred = None
     if with_counters:
-        if acc is None:
-            acc = make_counter_buffers(tables.policy)
-        acc = _accumulate_counters(
-            v, resolved, j, idx, acc, tables.policy.l4_meta.shape[2]
-        )
+        if defer_counters:
+            # hand the scatter ingredients back to the caller: the
+            # paired-dispatch program concatenates both directions'
+            # columns and pays ONE scatter per pair instead of two
+            # (scatter cost is near size-independent on this chip)
+            deferred = (resolved, j, idx)
+        else:
+            if acc is None:
+                acc = make_counter_buffers(tables.policy)
+            acc = _accumulate_counters(
+                v, resolved, j, idx, acc,
+                tables.policy.l4_meta.shape[2],
+            )
 
     # -- 6. combine (bpf_lxc.c:962-985) -------------------------------------
     pol_allow = v.allowed.astype(bool)
@@ -481,6 +491,8 @@ def _datapath_core(
         l4_slot=j,
     )
     if with_counters:
+        if defer_counters:
+            return out, (v, *deferred)
         return out, acc
     return out
 
@@ -546,6 +558,41 @@ datapath_step_accum_ingress = jax.jit(
 )
 datapath_step_accum_egress = jax.jit(
     _accum_dir_kernel(EGRESS), donate_argnums=(2,)
+)
+
+
+def _datapath_kernel_accum_pair(tables, flows_in, flows_eg, acc):
+    """BOTH direction-specialized programs in ONE dispatch, with the
+    two batches' counter hits concatenated into a SINGLE scatter.
+    Per pair of half-batches this saves one dispatch floor and one
+    scatter relative to alternating the per-direction programs —
+    a measurable slice of the headline loop on v5e — while computing
+    bit-identical verdicts and counters (scatter-adds commute)."""
+    from cilium_tpu.engine.verdict import _counter_cols
+
+    out_i, (v_i, res_i, j_i, idx_i) = _datapath_core(
+        tables, flows_in, with_counters=True, emit_sec_id=False,
+        static_direction=INGRESS, defer_counters=True,
+    )
+    out_e, (v_e, res_e, j_e, idx_e) = _datapath_core(
+        tables, flows_eg, with_counters=True, emit_sec_id=False,
+        static_direction=EGRESS, defer_counters=True,
+    )
+    kg = tables.policy.l4_meta.shape[2]
+    ep_i, d_i, c_i, w_i = _counter_cols(v_i, res_i, j_i, idx_i, kg)
+    ep_e, d_e, c_e, w_e = _counter_cols(v_e, res_e, j_e, idx_e, kg)
+    acc = acc.at[
+        jnp.concatenate([ep_i, ep_e]),
+        jnp.concatenate([d_i, d_e]),
+        jnp.concatenate([c_i, c_e]),
+    ].add(jnp.concatenate([w_i, w_e]))
+    return out_i, out_e, acc
+
+
+# the headline streaming shape: one dispatch evaluates an ingress
+# half-batch AND an egress half-batch with one merged counter scatter
+datapath_step_accum_pair = jax.jit(
+    _datapath_kernel_accum_pair, donate_argnums=(3,)
 )
 
 

@@ -341,6 +341,18 @@ def _verdict_kernel(tables: PolicyTables, batch: TupleBatch) -> Verdicts:
     return _combine(probe1, probe2, probe3, proxy, batch.is_fragment)
 
 
+def _counter_cols(v, batch, j, idx, kg: int):
+    """Scatter ingredients for the per-entry counters: returns
+    (ep_index, direction, col, weight) — shared by the in-kernel
+    accumulate and the paired-dispatch merged scatter so the two can
+    never diverge."""
+    hit_l4 = (v.match_kind == MATCH_L4) | (v.match_kind == MATCH_L4_WILD)
+    hit_l3 = v.match_kind == MATCH_L3
+    col = jnp.where(hit_l4, j, kg + idx)
+    weight = (hit_l4 | hit_l3).astype(jnp.uint32)
+    return batch.ep_index, batch.direction, col, weight
+
+
 def _accumulate_counters(v, batch, j, idx, acc, kg: int):
     """Scatter the batch's lattice hits into the carried counter
     buffer (policy_entry packets, policy.h:66-68) — ONE scatter: the
@@ -350,11 +362,8 @@ def _accumulate_counters(v, batch, j, idx, acc, kg: int):
     `kg` is the static slot count (tables.l4_meta.shape[2]).  Callers
     donate the buffer across batches (XLA updates in place) instead of
     materializing fresh [E, 2, N] tensors per batch."""
-    hit_l4 = (v.match_kind == MATCH_L4) | (v.match_kind == MATCH_L4_WILD)
-    hit_l3 = v.match_kind == MATCH_L3
-    col = jnp.where(hit_l4, j, kg + idx)
-    weight = (hit_l4 | hit_l3).astype(jnp.uint32)
-    return acc.at[batch.ep_index, batch.direction, col].add(weight)
+    ep, d, col, weight = _counter_cols(v, batch, j, idx, kg)
+    return acc.at[ep, d, col].add(weight)
 
 
 def make_counter_buffers(tables: PolicyTables):

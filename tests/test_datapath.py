@@ -397,3 +397,57 @@ def test_direction_specialized_kernels_match_generic(direction):
             err_msg=field,
         )
     np.testing.assert_array_equal(np.asarray(acc_a), np.asarray(acc_b))
+
+
+def test_paired_dispatch_matches_sequential():
+    """The one-dispatch ingress+egress pair program must produce
+    bit-identical verdicts AND counters to running the two
+    direction-specialized programs sequentially."""
+    import jax
+
+    from cilium_tpu.engine.datapath import (
+        datapath_step_accum_egress,
+        datapath_step_accum_ingress,
+        datapath_step_accum_pair,
+    )
+    from cilium_tpu.engine.verdict import make_counter_buffers
+
+    (rng, _, _, ct, _, states, tables, n_eps) = _build_world(23)
+    pool = _random_flows(rng, 256, n_eps)
+    idx_in = np.nonzero(pool["direction"] == 0)[0]
+    idx_eg = np.nonzero(pool["direction"] == 1)[0]
+    half = 96
+    from cilium_tpu.engine.datapath import FlowBatch
+
+    def batch_of(rows):
+        picks = rows[rng.integers(0, len(rows), size=half)]
+        return FlowBatch.from_numpy(
+            **{k: pool[k][picks] for k in (
+                "ep_index", "saddr", "daddr", "sport", "dport",
+                "proto", "direction", "is_fragment",
+            )}
+        )
+
+    fin, feg = batch_of(idx_in), batch_of(idx_eg)
+
+    acc1 = make_counter_buffers(tables.policy)
+    oi1, acc1 = datapath_step_accum_ingress(tables, fin, acc1)
+    oe1, acc1 = datapath_step_accum_egress(tables, feg, acc1)
+
+    acc2 = make_counter_buffers(tables.policy)
+    oi2, oe2, acc2 = datapath_step_accum_pair(tables, fin, feg, acc2)
+
+    for a, b in ((oi1, oi2), (oe1, oe2)):
+        np.testing.assert_array_equal(
+            np.asarray(a.allowed), np.asarray(b.allowed)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.proxy_port), np.asarray(b.proxy_port)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.sec_id), np.asarray(b.sec_id)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.l4_slot), np.asarray(b.l4_slot)
+        )
+    np.testing.assert_array_equal(np.asarray(acc1), np.asarray(acc2))
