@@ -502,8 +502,8 @@ def run_config5(args) -> None:
     from cilium_tpu.engine.datapath import datapath_step
 
     got = datapath_step(tables, flows)
-    want_allow, want_proxy, want_sec = composed_oracle(
-        oracle_ctx, states, pool, list(sample)
+    want_allow, want_proxy, want_sec, want_stages = composed_oracle(
+        oracle_ctx, states, pool, list(sample), return_stages=True
     )
     assert (np.asarray(got.allowed) == want_allow).all(), (
         "fused datapath diverges from composed oracle (allow)"
@@ -514,12 +514,44 @@ def run_config5(args) -> None:
     assert (np.asarray(got.sec_id) == want_sec).all(), (
         "fused datapath diverges from composed oracle (sec_id)"
     )
+    # per-stage bit-identity: the telemetry plane's stage columns
+    # must agree with the oracle's intermediate decisions per tuple
+    for col, key in (
+        ("pre_dropped", "pre_drop"),
+        ("ct_result", "ct_res"),
+        ("match_kind", "match_kind"),
+        ("ipcache_miss", "ipcache_miss"),
+    ):
+        assert (
+            np.asarray(getattr(got, col)).astype(np.int64)
+            == want_stages[key].astype(np.int64)
+        ).all(), f"stage divergence vs composed oracle ({col})"
+    assert (
+        (np.asarray(got.lb_slave) > 0) == want_stages["lb_hit"]
+    ).all(), "stage divergence vs composed oracle (lb_hit)"
 
     # --- timed fused replay: args.tuples sampled from the pool -------------
     tables = jax.device_put(tables)
     n_batches = max(args.tuples // args.batch, 1)
-    from cilium_tpu.engine.datapath import datapath_step_accum_pair
-    from cilium_tpu.engine.verdict import make_counter_buffers
+    from cilium_tpu.engine.datapath import (
+        datapath_step_accum_pair,
+        datapath_step_accum_pair_telem,
+    )
+    from cilium_tpu.engine.verdict import (
+        make_counter_buffers,
+        make_telemetry_buffers,
+    )
+    from cilium_tpu.metrics import registry as metrics_registry
+    from cilium_tpu.spanstat import SpanStats
+    from cilium_tpu.telemetry import (
+        fold_telemetry,
+        telemetry_consistent,
+        telemetry_from_outputs,
+        telemetry_summary,
+    )
+
+    bench_spans = SpanStats()
+    bench_spans.span("host_pack").start()
 
     # The datapath is direction-specialized (bpf_lxc's separate
     # ingress/egress programs): sample each timed batch as one
@@ -544,35 +576,161 @@ def run_config5(args) -> None:
                 )
             )
         flow_batches.append(tuple(pair))
-    # warmup/compile (counters scatter into a carried donated buffer)
+    bench_spans.span("host_pack").end()
+    # warmup/compile both forms: the INSTRUMENTED pair program (the
+    # headline pipeline — counters + the [2, T] telemetry reductions
+    # ride the one dispatch) and the bare pair program (the
+    # telemetry_overhead_pct reference)
     acc = jax.device_put(make_counter_buffers(tables.policy))
-    out_i, out_e, acc = datapath_step_accum_pair(
-        tables, flow_batches[0][0], flow_batches[0][1], acc
+    telem = jax.device_put(make_telemetry_buffers())
+    out_i, out_e, acc, telem = datapath_step_accum_pair_telem(
+        tables, flow_batches[0][0], flow_batches[0][1], acc, telem
     )
-    jax.block_until_ready((out_i, out_e, acc))
+    jax.block_until_ready((out_i, out_e, acc, telem))
+    acc_bare = jax.device_put(make_counter_buffers(tables.policy))
+    out_i, out_e, acc_bare = datapath_step_accum_pair(
+        tables, flow_batches[0][0], flow_batches[0][1], acc_bare
+    )
+    jax.block_until_ready((out_i, out_e, acc_bare))
     # force the device into real-sync mode BEFORE timing: the first
     # D2H transfer permanently switches the transport from
     # enqueue-acknowledge to synchronous completion; timing before it
     # would measure enqueue latency, not execution
     _ = np.asarray(flow_batches[0][0].sport[:4])
-    # fresh buffer so counter_hits reflects exactly the timed tuples
+
+    # --- telemetry gate: on-device stage counters bit-identical to the
+    # host fold of per-tuple outputs on one ≥1M-tuple batch pair -----------
+    gate_in, gate_eg = flow_batches[0]
+    out_full_in = datapath_step(tables, gate_in)
+    out_full_eg = datapath_step(tables, gate_eg)
+    want_telem = telemetry_from_outputs(
+        out_full_in, np.zeros(half, np.int64)
+    ) + telemetry_from_outputs(out_full_eg, np.ones(half, np.int64))
+    acc_gate = jax.device_put(make_counter_buffers(tables.policy))
+    telem_gate = jax.device_put(make_telemetry_buffers())
+    _, _, acc_gate, telem_gate = datapath_step_accum_pair_telem(
+        tables, gate_in, gate_eg, acc_gate, telem_gate
+    )
+    got_telem = np.asarray(telem_gate).astype(np.uint64)
+    assert (got_telem == want_telem).all(), (
+        "device telemetry diverges from host per-stage fold:\n"
+        f"device={got_telem}\nhost={want_telem}"
+    )
+    assert telemetry_consistent(got_telem), got_telem
+    del acc_gate, telem_gate, out_full_in, out_full_eg
+
+    # fresh buffers so counter_hits/telemetry reflect exactly the
+    # timed tuples
     acc = jax.device_put(make_counter_buffers(tables.policy))
+    telem = jax.device_put(make_telemetry_buffers())
+    bench_spans.span("dispatch").start()
     t0 = time.perf_counter()
     outs = []
     for i in range(n_batches):
         fin, feg = flow_batches[i % len(flow_batches)]
-        out_i, out_e, acc = datapath_step_accum_pair(
-            tables, fin, feg, acc
+        out_i, out_e, acc, telem = datapath_step_accum_pair_telem(
+            tables, fin, feg, acc, telem
+        )
+        outs.append((out_i, out_e))
+        if len(outs) > 4:
+            jax.block_until_ready(outs.pop(0))
+    bench_spans.span("dispatch").end()
+    bench_spans.span("device").start()
+    jax.block_until_ready(outs)
+    jax.block_until_ready((acc, telem))
+    dt = time.perf_counter() - t0
+    bench_spans.span("device").end()
+    total = n_batches * 2 * half
+    vps = total / dt
+
+    # --- bare reference loop: the same batches through the
+    # uninstrumented pair program → telemetry_overhead_pct ------------------
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(n_batches):
+        fin, feg = flow_batches[i % len(flow_batches)]
+        out_i, out_e, acc_bare = datapath_step_accum_pair(
+            tables, fin, feg, acc_bare
         )
         outs.append((out_i, out_e))
         if len(outs) > 4:
             jax.block_until_ready(outs.pop(0))
     jax.block_until_ready(outs)
-    jax.block_until_ready(acc)
-    dt = time.perf_counter() - t0
-    total = n_batches * 2 * half
-    vps = total / dt
+    jax.block_until_ready(acc_bare)
+    dt_bare = time.perf_counter() - t0
+    del acc_bare
+    overhead_pct = (dt - dt_bare) / dt_bare * 100.0
+    emit(
+        "telemetry_overhead_pct",
+        round(overhead_pct, 2),
+        "%",
+        instrumented_verdicts_per_sec=round(total / dt),
+        bare_verdicts_per_sec=round(total / dt_bare),
+        note=(
+            "instrumented headline pipeline (counters + [2, T] "
+            "stage reductions fused into the pair dispatch) vs the "
+            "bare pair program over identical batches"
+        ),
+    )
+
+    # --- scatter fold: device accumulators → host registry -----------------
+    bench_spans.span("scatter_fold").start()
     counter_total = int(np.asarray(acc).sum())
+    telem_host = np.asarray(telem).astype(np.uint64)
+    fold_telemetry(telem_host)
+    bench_spans.span("scatter_fold").end()
+
+    # --- event fold: sampled DropNotify/PolicyVerdictNotify from the
+    # last pair's outputs onto a monitor bus --------------------------------
+    bench_spans.span("event_fold").start()
+    from types import SimpleNamespace
+
+    from cilium_tpu.metrics import Registry
+    from cilium_tpu.monitor import MonitorBus, verdicts_to_events
+
+    bus = MonitorBus()
+    # the timed traffic was already folded into the process registry
+    # from the device accumulator; the sampled event fold counts into
+    # a throwaway registry so nothing double-counts
+    event_registry = Registry()
+    sample_cap = 4096
+    id_table_host = np.asarray(tables.policy.id_table)
+    n_events = 0
+    for dirv, out_last in ((0, out_i), (1, out_e)):
+        sl = slice(0, 1 << 16)  # head slice: event fold is sampled
+        sec_idx = np.asarray(out_last.sec_id[sl]).astype(np.int64)
+        n_events += verdicts_to_events(
+            bus,
+            SimpleNamespace(
+                allowed=np.asarray(out_last.allowed[sl]),
+                match_kind=np.asarray(out_last.match_kind[sl]),
+                proxy_port=np.asarray(out_last.proxy_port[sl]),
+            ),
+            ep_ids=np.zeros(sec_idx.shape, np.int64),
+            identities=id_table_host[
+                np.minimum(sec_idx, len(id_table_host) - 1)
+            ],
+            dports=np.asarray(out_last.final_dport[sl]),
+            protos=np.full(sec_idx.shape, 6),
+            directions=np.full(sec_idx.shape, dirv),
+            sample=sample_cap,
+            metrics_registry=event_registry,
+        )
+    bench_spans.span("event_fold").end()
+
+    # --- windowed batch latency: a short synchronous segment ---------------
+    for i in range(8):
+        fin, feg = flow_batches[i % len(flow_batches)]
+        b0 = time.perf_counter()
+        out_i, out_e, acc, telem = datapath_step_accum_pair_telem(
+            tables, fin, feg, acc, telem
+        )
+        jax.block_until_ready((out_i, out_e))
+        metrics_registry.batch_duration.observe(
+            time.perf_counter() - b0
+        )
+    p50_batch_s = metrics_registry.batch_duration.window_quantile(0.5)
+    p99_batch_s = metrics_registry.batch_duration.window_quantile(0.99)
 
     # secondary: the bare lattice on the same tables (round 1/2 metric)
     from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
@@ -656,7 +814,6 @@ def run_config5(args) -> None:
         ),
     )
 
-    p50_ms = dt / n_batches * 1000
     # achieved HBM gather traffic of the headline loop (roofline
     # context for regressions): bytes actually gathered per tuple —
     # 3×4B lattice probes + 4 CT windowed probes (svc + effective
@@ -674,15 +831,24 @@ def run_config5(args) -> None:
         vs_baseline=round(vps / BASELINE_PER_CHIP, 3),
         tuples=total,
         batch=args.batch,
-        p50_batch_ms=round(p50_ms, 1),
+        p50_batch_ms=round(p50_batch_s * 1000, 1),
+        p99_batch_ms=round(p99_batch_s * 1000, 1),
         counter_hits=counter_total,
+        telemetry_overhead_pct=round(overhead_pct, 2),
+        telemetry=telemetry_summary(telem_host),
+        telemetry_spans_s={
+            name: round(s.total(), 3)
+            for name, s in bench_spans.items()
+        },
+        monitor_events_sampled=n_events,
         gathered_gb_per_sec=round(
             vps * gather_bytes_per_tuple / 1e9, 1
         ),
         pipeline=(
-            "paired per-direction programs, one dispatch + one "
-            "merged counter scatter per pair: prefilter+LB/DNAT+CT+"
-            "ipcache+lattice+counters"
+            "instrumented paired per-direction programs, one "
+            "dispatch + one merged counter scatter + fused [2, T] "
+            "stage-telemetry reductions per pair: prefilter+LB/DNAT"
+            "+CT+ipcache+lattice+counters+telemetry"
         ),
     )
 

@@ -93,6 +93,9 @@ class APIClient:
     def policy_resolve(self, body: dict):
         return self._request("POST", "/policy/resolve", body=body)
 
+    def trace_tuple(self, body: dict):
+        return self._request("POST", "/policy/trace-tuple", body=body)
+
     def endpoint_list(self):
         return self._request("GET", "/endpoint")
 

@@ -132,23 +132,32 @@ class DaemonAPI:
                     ),
                 }
             )
-        spans = {
-            name: {
-                "success_total_s": s.success_total,
-                "failure_total_s": s.failure_total,
-                "num_success": s.num_success,
-                "num_failure": s.num_failure,
+        def _span_dict(spanstats):
+            return {
+                name: {
+                    "success_total_s": s.success_total,
+                    "failure_total_s": s.failure_total,
+                    "num_success": s.num_success,
+                    "num_failure": s.num_failure,
+                }
+                for name, s in spanstats.items()
             }
-            for name, s in self.daemon.regen_spans.items()
-        }
+
         try:
             load1, load5, load15 = __import__("os").getloadavg()
         except OSError:  # pragma: no cover - platform-dependent
             load1 = load5 = load15 = -1.0
+        from cilium_tpu.metrics import registry as _metrics
+
         return {
             "threads": threads,
             "num_threads": len(threads),
-            "regeneration_spans": spans,
+            "regeneration_spans": _span_dict(self.daemon.regen_spans),
+            "datapath_spans": _span_dict(self.daemon.datapath_spans),
+            "batch_latency": {
+                "p50_s": _metrics.batch_duration.window_quantile(0.5),
+                "p99_s": _metrics.batch_duration.window_quantile(0.99),
+            },
             "loadavg": [load1, load5, load15],
         }
 
@@ -170,6 +179,35 @@ class DaemonAPI:
             LabelArray.parse(*labels)
         )
         return {"revision": revision, "deleted": deleted}
+
+    def trace_tuple(self, body: dict) -> dict:
+        """POST /policy/trace-tuple: the single-tuple datapath
+        explain (policy.trace.trace_tuple) over the REST contract."""
+        direction = body.get("direction", "ingress")
+        if isinstance(direction, str):
+            try:
+                direction = {"ingress": 0, "egress": 1}[
+                    direction.lower()
+                ]
+            except KeyError:
+                raise ValueError(
+                    f"direction must be ingress or egress, "
+                    f"got {direction!r}"
+                )
+        elif direction not in (0, 1):
+            raise ValueError(
+                f"direction must be 0 or 1, got {direction!r}"
+            )
+        return self.daemon.trace_tuple(
+            ep_id=int(body["ep_id"]),
+            saddr=body["saddr"],
+            daddr=body["daddr"],
+            dport=int(body["dport"]),
+            proto=int(body.get("proto", 6)),
+            direction=direction,
+            sport=int(body.get("sport", 0)),
+            is_fragment=bool(body.get("is_fragment", False)),
+        )
 
     def policy_resolve(self, body: dict) -> dict:
         ctx = SearchContext(
@@ -465,6 +503,13 @@ class DaemonAPI:
     def metrics_dump(self) -> dict:
         return {"text": metrics.expose()}
 
+    def metrics_prometheus(self) -> str:
+        """GET /metrics/prometheus: the raw Prometheus text
+        exposition (text/plain; version=0.0.4) — what a Prometheus
+        scrape job points at; the JSON /metrics route stays for the
+        CLI contract."""
+        return metrics.expose()
+
 
 class _Handler(BaseHTTPRequestHandler):
     # quiet the default stderr access log
@@ -484,6 +529,18 @@ class _Handler(BaseHTTPRequestHandler):
             # long-poll handler is mid-reply): there is nobody to
             # answer, and an exception escaping a handler thread is
             # just teardown noise
+            pass
+
+    def _reply_text(self, code: int, text: str,
+                    content_type: str = "text/plain") -> None:
+        data = text.encode()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError, OSError):
             pass
 
     def _body(self):
@@ -518,6 +575,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(200, api.ipcache_dump())
             if path == "/metrics":
                 return self._reply(200, api.metrics_dump())
+            if path == "/metrics/prometheus":
+                return self._reply_text(
+                    200,
+                    api.metrics_prometheus(),
+                    content_type=(
+                        "text/plain; version=0.0.4; charset=utf-8"
+                    ),
+                )
             if path == "/debug/profile":
                 return self._reply(200, api.debug_profile())
             if path == "/service":
@@ -564,6 +629,30 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(
                     200, api.policy_resolve(json.loads(self._body()))
                 )
+            if path == "/policy/trace-tuple":
+                try:
+                    body = json.loads(self._body() or "{}")
+                except json.JSONDecodeError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                # missing required fields are a 400 (caller error);
+                # only an unknown endpoint id is 404
+                missing = [
+                    k for k in ("ep_id", "saddr", "daddr", "dport")
+                    if k not in body
+                ]
+                if missing:
+                    return self._reply(
+                        400,
+                        {"error": f"missing fields: {missing}"},
+                    )
+                try:
+                    return self._reply(
+                        200, api.trace_tuple(body)
+                    )
+                except KeyError as exc:
+                    return self._reply(404, {"error": str(exc)})
             if path == "/monitor":
                 return self._reply(201, api.monitor_open())
             if path == "/service":

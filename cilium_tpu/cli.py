@@ -66,6 +66,38 @@ def cmd_policy_delete(api, args) -> int:
     return 0
 
 
+def cmd_policy_trace_tuple(api, args) -> int:
+    """Single-tuple datapath explain: every stage's decision plus the
+    matching rules (the `cilium policy trace` analogue run through
+    the composed pipeline stages)."""
+    proto = args.proto.lower()
+    proto_num = {"tcp": 6, "udp": 17}.get(proto)
+    if proto_num is None:
+        try:
+            proto_num = int(proto)
+        except ValueError:
+            print(f"error: unknown protocol {args.proto!r}",
+                  file=sys.stderr)
+            return 2
+    got = api.trace_tuple(
+        {
+            "ep_id": args.ep_id,
+            "saddr": args.saddr,
+            "daddr": args.daddr,
+            "dport": args.dport,
+            "sport": args.sport,
+            "proto": proto_num,
+            "direction": args.direction,
+            "is_fragment": args.fragment,
+        }
+    )
+    if args.json:
+        print(json.dumps(got, indent=2))
+    else:
+        print(got["text"], end="")
+    return 0 if got["verdict"] == "allowed" else 1
+
+
 def cmd_policy_trace(api, args) -> int:
     got = api.policy_resolve(
         {
@@ -236,6 +268,23 @@ def make_parser() -> argparse.ArgumentParser:
     trace.add_argument("--dst", required=True)
     trace.add_argument("--dport", action="append")
     trace.set_defaults(func=cmd_policy_trace)
+    ttuple = psub.add_parser(
+        "trace-tuple",
+        help="stage-accurate single-tuple datapath explain",
+    )
+    ttuple.add_argument("--ep-id", type=int, required=True)
+    ttuple.add_argument("--saddr", required=True)
+    ttuple.add_argument("--daddr", required=True)
+    ttuple.add_argument("--dport", type=int, required=True)
+    ttuple.add_argument("--sport", type=int, default=0)
+    ttuple.add_argument("--proto", default="tcp",
+                        help="tcp|udp|<number>")
+    ttuple.add_argument("--direction", default="ingress",
+                        choices=["ingress", "egress"])
+    ttuple.add_argument("--fragment", action="store_true")
+    ttuple.add_argument("--json", action="store_true",
+                        help="machine-readable stage dump")
+    ttuple.set_defaults(func=cmd_policy_trace_tuple)
 
     endpoint = sub.add_parser("endpoint")
     esub = endpoint.add_subparsers(dest="subcmd", required=True)

@@ -164,6 +164,17 @@ class Daemon:
         # reference logs one SpanStat per phase, policy.go:689-699) —
         # served by GET /debug/profile
         self.regen_spans = SpanStats()
+        # datapath-loop phase spans (host pack / dispatch / event
+        # fold), fed by process_flows — the hot path's SpanStat
+        # instrumentation, also served by GET /debug/profile
+        self.datapath_spans = SpanStats()
+        # XDP-style deny-by-CIDR prefilter (daemon/prefilter.go):
+        # daemon-owned so trace_tuple, process_flows and datapath
+        # assembly (prefilter.tables()) consult ONE authoritative
+        # CIDR set
+        from cilium_tpu.prefilter import PreFilter
+
+        self.prefilter = PreFilter()
         self.controllers = ControllerManager()
         # periodic CT GC (pkg/maps/ctmap GC; endpointmanager
         # conntrack.go loop)
@@ -306,8 +317,8 @@ class Daemon:
             for rule in rules:
                 self._note_rule_change(rule.endpoint_selector)
             revision = self.repo.add_list(list(rules))
-            metrics.policy_count.set(self.repo.num_rules())
-            metrics.policy_revision.set(revision)
+            metrics.policy_count.set(value=self.repo.num_rules())
+            metrics.policy_revision.set(value=revision)
             log.info(
                 "policy rules imported",
                 extra={"fields": {
@@ -339,7 +350,7 @@ class Daemon:
                 release_cidrs(
                     self.ipcache, self.identity_allocator, prefixes
                 )
-            metrics.policy_count.set(self.repo.num_rules())
+            metrics.policy_count.set(value=self.repo.num_rules())
         if n_deleted:
             self.trigger_policy_updates("policy rules deleted")
         return revision, n_deleted
@@ -347,6 +358,16 @@ class Daemon:
     def policy_resolve(self, ctx: SearchContext):
         """GET /policy/resolve (daemon/policy.go:66)."""
         return trace_policy(self.repo, ctx)
+
+    def trace_tuple(self, **kwargs):
+        """Single-tuple datapath explain (`cilium policy trace` made
+        stage-accurate): rerun one tuple through prefilter → LB/DNAT
+        → CT → ipcache → lattice → combine against THIS daemon's
+        state, reporting each stage's decision and the matching
+        rules.  See policy.trace.trace_tuple."""
+        from cilium_tpu.policy.trace import trace_tuple
+
+        return trace_tuple(self, **kwargs)
 
     # -- regeneration (daemon/policy.go:47 TriggerPolicyUpdates) ------------
 
@@ -914,6 +935,8 @@ class Daemon:
         # events to — the endpoint that happens to sit there).  ONE
         # decode pass: the filtered SoA feeds batching directly, and
         # the drop count is surfaced in stats.
+        spans = self.datapath_spans
+        spans.span("host_pack").start()
         rec = decode_flow_records(buf)
         known = np.isin(
             rec["ep_id"], np.fromiter(index, dtype=np.int64)
@@ -921,6 +944,39 @@ class Daemon:
         n_dropped = int((~known).sum())
         if n_dropped:
             rec = {k: v[known] for k, v in rec.items()}
+        # XDP prefilter (the daemon-owned deny-by-CIDR set,
+        # bpf_xdp.c): flows from denied sources drop BEFORE the
+        # policy program and count under the canonical CIDR reason —
+        # keeps this audit path in agreement with trace_tuple's
+        # prefilter stage
+        n_prefiltered = 0
+        prefilter_cidrs = self.prefilter.dump()
+        if prefilter_cidrs:
+            import ipaddress as _ipaddress
+
+            from cilium_tpu.monitor.events import drop_reason_name
+
+            hit = np.zeros(len(rec["saddr"]), bool)
+            saddr = rec["saddr"].astype(np.uint64)
+            for cidr in prefilter_cidrs:
+                net = _ipaddress.ip_network(cidr, strict=False)
+                if net.version != 4:
+                    continue
+                hit |= (saddr & int(net.netmask)) == int(
+                    net.network_address
+                )
+            n_prefiltered = int(hit.sum())
+            if n_prefiltered:
+                for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
+                    count = int(
+                        (hit & (rec["direction"] == dirv)).sum()
+                    )
+                    if count:
+                        metrics.drop_count.inc(
+                            drop_reason_name(-162), dname,
+                            value=count,
+                        )
+                rec = {k: v[~hit] for k, v in rec.items()}
         # vectorized index→endpoint-id translation (inverse of
         # replay._ep_index_of's LUT)
         rev_lut = np.zeros(
@@ -929,15 +985,24 @@ class Daemon:
         for ep_id, idx in index.items():
             rev_lut[idx] = ep_id
         verdict_eps = self.verdict_notification_endpoints()
+        spans.span("host_pack").end()
         stats = ReplayStats()
         stats.dropped = n_dropped
+        # prefiltered flows received a verdict (deny) without
+        # evaluation — they count toward the totals
+        stats.total += n_prefiltered
+        stats.denied += n_prefiltered
         t0 = _time.perf_counter()
         for batch, valid in read_batches_from_rec(
             rec, batch_size, dict(index)
         ):
+            batch_t0 = _time.perf_counter()
+            spans.span("dispatch").start()
             out = evaluate_batch(tables, batch)
             _tally(out, valid, stats)
+            spans.span("dispatch").end()
             stats.batches += 1
+            spans.span("event_fold").start()
             ep_idx = np.asarray(batch.ep_index)[:valid]
             v = SimpleNamespace(
                 allowed=np.asarray(out.allowed)[:valid],
@@ -961,10 +1026,15 @@ class Daemon:
                     == option.MONITOR_AGG_NONE
                 ),
             )
+            spans.span("event_fold").end()
+            metrics.batch_duration.observe(
+                _time.perf_counter() - batch_t0
+            )
         stats.seconds = _time.perf_counter() - t0
+        stats.spans = spans
         if stats.seconds > 0:
             metrics.verdict_throughput.set(
-                stats.total / stats.seconds
+                value=stats.total / stats.seconds
             )
         return stats
 

@@ -236,6 +236,9 @@ class DatapathVerdicts:
     # i32 [B] global L4 slot of the matched entry (0 on L3/no match) —
     # keys the fleet L7 scope tables (l7/fleet.py) for redirected flows
     l4_slot: jax.Array = None
+    # bool [B] identity derivation fell back to WORLD (ipcache miss) —
+    # the telemetry plane's ipcache_world stage column
+    ipcache_miss: jax.Array = None
 
     def tree_flatten(self):
         return (
@@ -254,6 +257,7 @@ class DatapathVerdicts:
                 self.ct_delete,
                 self.tunnel_endpoint,
                 self.l4_slot,
+                self.ipcache_miss,
             ),
             None,
         )
@@ -271,6 +275,7 @@ def _datapath_core(
     emit_sec_id: bool = True,
     static_direction=None,
     defer_counters: bool = False,
+    collect_telemetry: bool = False,
 ):
     """The fused per-packet pipeline.  With an idx-form ipcache
     (specialize_ipcache_to_idx) the identity lookup yields the dense
@@ -374,6 +379,7 @@ def _datapath_core(
         )
         n = tables.policy.id_table.shape[0]
         miss = looked == 0
+        ipc_miss = miss
         # UNKNOWN_IDX = ipcache entry whose identity is outside the
         # policy universe: present (no WORLD fallback) but not-known
         vp = jnp.where(
@@ -407,6 +413,7 @@ def _datapath_core(
         lattice_identity = jnp.zeros_like(looked)  # unused
     else:
         looked = _lookup_kernel(tables.ipcache, sec_ip)
+        ipc_miss = looked == 0
         sec_id = jnp.where(
             looked == 0, jnp.uint32(RESERVED_WORLD), looked
         ).astype(jnp.uint32)
@@ -489,12 +496,39 @@ def _datapath_core(
         ct_delete=ct_delete,
         tunnel_endpoint=tunnel_ep,
         l4_slot=j,
+        ipcache_miss=ipc_miss,
     )
+    trow = None
+    if collect_telemetry:
+        # [2, TELEM_COLS] u32 stage histogram of THIS batch: the same
+        # shared mask definitions the host fold applies to per-tuple
+        # outputs, reduced per direction inside the fused program —
+        # ~20 masked sums ride the dispatch (no extra launch, no
+        # per-tuple D2H)
+        from cilium_tpu.engine.verdict import telemetry_masks
+
+        masks = telemetry_masks(
+            pre_drop, ct_res, v.match_kind, allowed, ct_delete,
+            proxy, lb_slave, ipc_miss,
+        )
+        # one reduction pair per column: the egress row is the
+        # column total minus the ingress row (direction partitions
+        # the batch), so 2T sums become T+T-with-const-folding —
+        # and in the direction-specialized programs `ingress` is a
+        # constant, so XLA folds one of the two rows to zeros
+        row_in = jnp.stack(
+            [jnp.sum(m & ingress, dtype=jnp.uint32) for m in masks]
+        )
+        col_total = jnp.stack(
+            [jnp.sum(m, dtype=jnp.uint32) for m in masks]
+        )
+        trow = jnp.stack([row_in, col_total - row_in])
     if with_counters:
         if defer_counters:
-            return out, (v, *deferred)
-        return out, acc
-    return out
+            tail = (v, *deferred)
+            return (out, tail, trow) if collect_telemetry else (out, tail)
+        return (out, acc, trow) if collect_telemetry else (out, acc)
+    return (out, trow) if collect_telemetry else out
 
 
 def _datapath_kernel(
@@ -593,6 +627,69 @@ def _datapath_kernel_accum_pair(tables, flows_in, flows_eg, acc):
 # half-batch AND an egress half-batch with one merged counter scatter
 datapath_step_accum_pair = jax.jit(
     _datapath_kernel_accum_pair, donate_argnums=(3,)
+)
+
+
+def _datapath_kernel_telem(tables: DatapathTables, flows: FlowBatch):
+    """One-shot instrumented step: full verdicts + this batch's
+    [2, TELEM_COLS] stage histogram (tests, trace tooling, smoke)."""
+    return _datapath_core(
+        tables, flows, with_counters=False, collect_telemetry=True
+    )
+
+
+def _datapath_kernel_accum_telem(
+    tables: DatapathTables, flows: FlowBatch, acc, telem
+):
+    """Streaming fused step + telemetry: the counter scatter AND the
+    stage-histogram reduction both ride the one dispatch; `telem` is
+    a carried donated [2, TELEM_COLS] u32 buffer
+    (verdict.make_telemetry_buffers)."""
+    out, acc, trow = _datapath_core(
+        tables, flows, with_counters=True, acc=acc,
+        emit_sec_id=False, collect_telemetry=True,
+    )
+    return out, acc, telem + trow
+
+
+def _datapath_kernel_accum_pair_telem(
+    tables, flows_in, flows_eg, acc, telem
+):
+    """The instrumented headline shape: the paired-dispatch program
+    (one dispatch, one merged counter scatter per direction pair)
+    plus per-direction stage accounting folded into the carried
+    telemetry buffer — bit-identical verdicts and counters to
+    datapath_step_accum_pair, with the [2, TELEM_COLS] reductions
+    fused into the same program."""
+    from cilium_tpu.engine.verdict import _counter_cols
+
+    out_i, (v_i, res_i, j_i, idx_i), trow_i = _datapath_core(
+        tables, flows_in, with_counters=True, emit_sec_id=False,
+        static_direction=INGRESS, defer_counters=True,
+        collect_telemetry=True,
+    )
+    out_e, (v_e, res_e, j_e, idx_e), trow_e = _datapath_core(
+        tables, flows_eg, with_counters=True, emit_sec_id=False,
+        static_direction=EGRESS, defer_counters=True,
+        collect_telemetry=True,
+    )
+    kg = tables.policy.l4_meta.shape[2]
+    ep_i, d_i, c_i, w_i = _counter_cols(v_i, res_i, j_i, idx_i, kg)
+    ep_e, d_e, c_e, w_e = _counter_cols(v_e, res_e, j_e, idx_e, kg)
+    acc = acc.at[
+        jnp.concatenate([ep_i, ep_e]),
+        jnp.concatenate([d_i, d_e]),
+        jnp.concatenate([c_i, c_e]),
+    ].add(jnp.concatenate([w_i, w_e]))
+    return out_i, out_e, acc, telem + trow_i + trow_e
+
+
+datapath_step_telem = jax.jit(_datapath_kernel_telem)
+datapath_step_accum_telem = jax.jit(
+    _datapath_kernel_accum_telem, donate_argnums=(2, 3)
+)
+datapath_step_accum_pair_telem = jax.jit(
+    _datapath_kernel_accum_pair_telem, donate_argnums=(3, 4)
 )
 
 

@@ -54,12 +54,67 @@ class HostLPM:
         return 0
 
 
-def composed_oracle(ctx, states, flows_dict, idx_list):
+def lb_select_host(ct, svc, saddr, daddr, sport, dport, proto):
+    """Host-side backend selection for one flow against a looked-up
+    service: the CT service-scope stickiness probe first (lb4_local's
+    ct lookup over both key layouts), fnv1a hash fallback.  The ONE
+    reference implementation — composed_oracle and
+    policy.trace.trace_tuple both call it, so the explain tool can
+    never diverge from the oracle's backend choice.  Returns
+    (slave 1-based, sticky bool)."""
+    from cilium_tpu.ct.table import (
+        CT_ESTABLISHED,
+        CT_REPLY,
+        CT_SERVICE,
+        CTTuple,
+        TUPLE_F_SERVICE,
+    )
+    from cilium_tpu.engine.hashtable import _fnv1a_host
+
+    slave = 0
+    sticky = False
+    st_res = ct.lookup(
+        CTTuple(daddr, saddr, dport, sport, proto), CT_SERVICE
+    )
+    if st_res in (CT_ESTABLISHED, CT_REPLY):
+        for key in (
+            CTTuple(saddr, daddr, sport, dport, proto,
+                    TUPLE_F_SERVICE | 1),
+            CTTuple(daddr, saddr, dport, sport, proto,
+                    TUPLE_F_SERVICE),
+            CTTuple(saddr, daddr, sport, dport, proto,
+                    TUPLE_F_SERVICE),
+            CTTuple(daddr, saddr, dport, sport, proto,
+                    TUPLE_F_SERVICE | 1),
+        ):
+            e = ct.entries.get(key)
+            if e is not None:
+                slave = e.slave
+                sticky = True
+                break
+    if not (0 < slave <= len(svc.backends)):
+        words = np.array(
+            [[saddr, daddr, (sport << 16) | dport, proto]],
+            dtype=np.uint32,
+        )
+        slave = (
+            int(_fnv1a_host(words)[0]) % len(svc.backends)
+        ) + 1
+        sticky = False
+    return slave, sticky
+
+
+def composed_oracle(ctx, states, flows_dict, idx_list,
+                    return_stages: bool = False):
     """Per-tuple host evaluation of the FULL fused pipeline.  `ctx`
     carries {"prefilter": HostLPM, "ipcache": HostLPM, "ct": CTMap,
     "mgr": ServiceManager}; `states` is the per-endpoint realized map
     state list in endpoint-axis order.  Returns (allowed, proxy,
-    sec_id) arrays for the sampled indices."""
+    sec_id) arrays for the sampled indices; with `return_stages` a
+    fourth dict {pre_drop, ct_res, match_kind, lb_hit, ipcache_miss}
+    of per-stage intermediate decisions rides along — the telemetry
+    plane's per-stage bit-identity gate compares the device's stage
+    columns against these."""
     from cilium_tpu.ct.table import (
         CT_EGRESS,
         CT_ESTABLISHED,
@@ -67,11 +122,8 @@ def composed_oracle(ctx, states, flows_dict, idx_list):
         CT_NEW,
         CT_RELATED,
         CT_REPLY,
-        CT_SERVICE,
         CTTuple,
-        TUPLE_F_SERVICE,
     )
-    from cilium_tpu.engine.hashtable import _fnv1a_host
     from cilium_tpu.engine.oracle import policy_can_access
     from cilium_tpu.identity import RESERVED_WORLD
     from cilium_tpu.lb.service import L3n4Addr
@@ -83,6 +135,11 @@ def composed_oracle(ctx, states, flows_dict, idx_list):
     out_allow = np.zeros(len(idx_list), np.uint8)
     out_proxy = np.zeros(len(idx_list), np.int32)
     out_sec = np.zeros(len(idx_list), np.uint32)
+    st_pre = np.zeros(len(idx_list), bool)
+    st_ct = np.zeros(len(idx_list), np.uint8)
+    st_kind = np.zeros(len(idx_list), np.uint8)
+    st_lb = np.zeros(len(idx_list), bool)
+    st_miss = np.zeros(len(idx_list), bool)
     f = flows_dict
     for row, i in enumerate(idx_list):
         ep = int(f["ep_index"][i])
@@ -100,36 +157,13 @@ def composed_oracle(ctx, states, flows_dict, idx_list):
                 L3n4Addr(str(ipaddress.ip_address(daddr)), dport, proto)
             )
             if svc is not None and svc.backends:
-                slave = 0
-                st_res = ct.lookup(
-                    CTTuple(daddr, saddr, dport, sport, proto), CT_SERVICE
+                slave, _ = lb_select_host(
+                    ct, svc, saddr, daddr, sport, dport, proto
                 )
-                if st_res in (CT_ESTABLISHED, CT_REPLY):
-                    for key in (
-                        CTTuple(saddr, daddr, sport, dport, proto,
-                                TUPLE_F_SERVICE | 1),
-                        CTTuple(daddr, saddr, dport, sport, proto,
-                                TUPLE_F_SERVICE),
-                        CTTuple(saddr, daddr, sport, dport, proto,
-                                TUPLE_F_SERVICE),
-                        CTTuple(daddr, saddr, dport, sport, proto,
-                                TUPLE_F_SERVICE | 1),
-                    ):
-                        e = ct.entries.get(key)
-                        if e is not None:
-                            slave = e.slave
-                            break
-                if not (0 < slave <= len(svc.backends)):
-                    words = np.array(
-                        [[saddr, daddr, (sport << 16) | dport, proto]],
-                        dtype=np.uint32,
-                    )
-                    slave = (
-                        int(_fnv1a_host(words)[0]) % len(svc.backends)
-                    ) + 1
                 b = svc.backends[slave - 1]
                 eff_daddr = b.addr.ip_u32()
                 eff_dport = b.addr.port
+                st_lb[row] = True
 
         ct_res = ct.lookup(
             CTTuple(eff_daddr, saddr, eff_dport, sport, proto),
@@ -140,6 +174,7 @@ def composed_oracle(ctx, states, flows_dict, idx_list):
         sec_id = ipc.lookup(sec_ip)
         if sec_id == 0:
             sec_id = RESERVED_WORLD
+            st_miss[row] = True
 
         v = policy_can_access(
             states[ep], sec_id, eff_dport, proto, direction, frag
@@ -154,4 +189,15 @@ def composed_oracle(ctx, states, flows_dict, idx_list):
         out_allow[row] = 1 if allowed else 0
         out_proxy[row] = proxy
         out_sec[row] = sec_id
+        st_pre[row] = pre_drop
+        st_ct[row] = ct_res
+        st_kind[row] = v.match_kind
+    if return_stages:
+        return out_allow, out_proxy, out_sec, {
+            "pre_drop": st_pre,
+            "ct_res": st_ct,
+            "match_kind": st_kind,
+            "lb_hit": st_lb,
+            "ipcache_miss": st_miss,
+        }
     return out_allow, out_proxy, out_sec

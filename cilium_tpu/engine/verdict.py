@@ -366,6 +366,139 @@ def _accumulate_counters(v, batch, j, idx, acc, kg: int):
     return acc.at[ep, d, col].add(weight)
 
 
+# ---------------------------------------------------------------------------
+# On-device telemetry: per-direction stage/drop accounting
+# ---------------------------------------------------------------------------
+# Column space of the [2, TELEM_COLS] u32 telemetry accumulator the
+# instrumented datapath kernels carry alongside the per-entry counter
+# buffer (row 0 = ingress, row 1 = egress).  The columns partition the
+# batch by stage outcome, so the host fold can reconstruct
+# cilium_drop_count_total{reason,direction} /
+# cilium_policy_verdict_total / cilium_forward_count_total without
+# pulling per-tuple verdict columns off the device:
+#
+#   * TOTAL/FORWARDED/DENIED: final combine outcome;
+#   * DROP_*: disjoint drop attribution (prefilter first, then the
+#     lattice's frag/policy split — bpf/lib/common.h reason codes);
+#   * MATCH_*: the lattice verdict histogram (the per-tuple
+#     match_kind, summed);
+#   * LB/CT/IPCACHE/PROXY: intermediate stage outcomes (DNAT applied,
+#     conntrack state, world fallback, proxy redirect).
+TELEM_TOTAL = 0
+TELEM_FORWARDED = 1
+TELEM_DENIED = 2
+TELEM_DROP_PREFILTER = 3
+TELEM_DROP_POLICY = 4
+TELEM_DROP_FRAG = 5
+TELEM_MATCH_L4 = 6
+TELEM_MATCH_L3 = 7
+TELEM_MATCH_L4_WILD = 8
+TELEM_MATCH_NONE = 9
+TELEM_MATCH_FRAG = 10
+TELEM_LB_DNAT = 11
+TELEM_CT_NEW = 12
+TELEM_CT_ESTABLISHED = 13
+TELEM_CT_REPLY = 14
+TELEM_CT_RELATED = 15
+TELEM_CT_BYPASS_ALLOW = 16
+TELEM_CT_DELETE = 17
+TELEM_IPCACHE_WORLD = 18
+TELEM_PROXY_REDIRECT = 19
+TELEM_COLS = 20
+
+TELEM_NAMES = (
+    "total",
+    "forwarded",
+    "denied",
+    "drop_prefilter",
+    "drop_policy",
+    "drop_frag",
+    "match_l4",
+    "match_l3",
+    "match_l4_wild",
+    "match_none",
+    "match_frag",
+    "lb_dnat",
+    "ct_new",
+    "ct_established",
+    "ct_reply",
+    "ct_related",
+    "ct_bypass_allow",
+    "ct_delete",
+    "ipcache_world",
+    "proxy_redirect",
+)
+
+
+def make_telemetry_buffers():
+    """Zeroed [2, TELEM_COLS] u32 device telemetry accumulator
+    (direction-major, TELEM_* columns) — carried and donated across
+    batches like the counter buffer; fold host-side with
+    cilium_tpu.telemetry.fold_telemetry."""
+    return jnp.zeros((2, TELEM_COLS), jnp.uint32)
+
+
+def telemetry_masks(
+    pre_dropped,
+    ct_result,
+    match_kind,
+    allowed,
+    ct_delete,
+    proxy_port,
+    lb_slave,
+    ipcache_miss,
+    xp=jnp,
+):
+    """The TELEM_* column masks as a list of bool [B] arrays, in
+    column order.  One implementation serves BOTH the traced device
+    kernel (xp=jnp) and the numpy host fold (xp=np): the bit-identity
+    gate between the on-device accumulator and the host per-stage
+    histogram holds by construction.
+
+    All inputs are the DatapathVerdicts columns of the same names
+    (any integer/bool dtype)."""
+    from cilium_tpu.ct.table import (
+        CT_ESTABLISHED,
+        CT_NEW,
+        CT_RELATED,
+        CT_REPLY,
+    )
+
+    allowed = allowed.astype(bool)
+    pre = pre_dropped.astype(bool)
+    kind = match_kind
+    denied = ~allowed
+    post = denied & ~pre  # lattice-attributed drops
+    pass_ct = (ct_result == CT_REPLY) | (ct_result == CT_RELATED)
+    pol_allow = (
+        (kind == MATCH_L4)
+        | (kind == MATCH_L3)
+        | (kind == MATCH_L4_WILD)
+    )
+    return [
+        xp.ones(allowed.shape, bool),
+        allowed,
+        denied,
+        pre,
+        post & (kind == MATCH_NONE),
+        post & (kind == MATCH_FRAG_DROP),
+        kind == MATCH_L4,
+        kind == MATCH_L3,
+        kind == MATCH_L4_WILD,
+        kind == MATCH_NONE,
+        kind == MATCH_FRAG_DROP,
+        lb_slave > 0,
+        ct_result == CT_NEW,
+        ct_result == CT_ESTABLISHED,
+        ct_result == CT_REPLY,
+        ct_result == CT_RELATED,
+        pass_ct & ~pol_allow & ~pre,
+        ct_delete.astype(bool),
+        ipcache_miss.astype(bool),
+        (proxy_port > 0) & allowed,
+    ]
+
+
 def make_counter_buffers(tables: PolicyTables):
     """Zeroed device counter buffer [E, 2, Kg + N] u32 — L4 slot
     columns first, then L3 identity columns (split with

@@ -64,3 +64,59 @@ def node_health(node_watcher) -> Dict[str, bool]:
     """Node liveness view from the registry (lease-expired nodes are
     already gone — everything present is alive)."""
     return {name: True for name in node_watcher.nodes}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (the cilium-agent --prometheus-serve-addr
+# endpoint): the whole metrics registry as text-format scrape output
+# ---------------------------------------------------------------------------
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+DEFAULT_METRICS_PORT = 9962  # cilium-agent's default Prometheus port
+
+
+def metrics_text(registry=None) -> str:
+    """The registry's Prometheus text exposition (process-global
+    registry by default) — serve verbatim with
+    PROMETHEUS_CONTENT_TYPE."""
+    if registry is None:
+        from cilium_tpu.metrics import registry as registry_
+        registry = registry_
+    return registry.expose()
+
+
+def start_metrics_server(
+    port: int = DEFAULT_METRICS_PORT,
+    host: str = "127.0.0.1",
+    registry=None,
+):
+    """Serve GET /metrics as Prometheus text on a daemon thread (the
+    agent's --prometheus-serve-addr listener; port 0 binds an
+    ephemeral port).  Returns the HTTPServer — read the bound port
+    from .server_address, stop with .shutdown()."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _MetricsHandler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def do_GET(self):  # noqa: N802
+            if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = metrics_text(registry).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    server = ThreadingHTTPServer((host, port), _MetricsHandler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="metrics-exporter",
+        daemon=True,
+    )
+    thread.start()
+    return server

@@ -9,10 +9,39 @@ verbatim; no prometheus client dependency in the image).
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, List, Tuple
 
 NAMESPACE = "cilium"
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped (exposition spec "Line format");
+    raw interpolation corrupts the exposition for values like drop
+    reasons containing quotes."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (not quotes)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_labels(
+    label_names: Tuple[str, ...], label_values: Tuple[str, ...]
+) -> str:
+    """`{k="v",...}` selector with escaped values ('' when unlabeled)."""
+    sel = ",".join(
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in zip(label_names, label_values)
+    )
+    return f"{{{sel}}}" if sel else ""
 
 
 class Counter:
@@ -33,21 +62,22 @@ class Counter:
 
     def expose(self) -> List[str]:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} counter",
         ]
         with self._lock:
             for labels, value in sorted(self._values.items()):
-                sel = ",".join(
-                    f'{k}="{v}"' for k, v in zip(self.label_names, labels)
-                )
-                suffix = f"{{{sel}}}" if sel else ""
+                suffix = format_labels(self.label_names, labels)
                 lines.append(f"{self.name}{suffix} {value}")
         return lines
 
 
 class Gauge(Counter):
-    def set(self, value: float, *label_values: str) -> None:
+    def set(self, *label_values: str, value: float) -> None:
+        """Labels-first, keyword-only value — the same shape as
+        Counter.inc(*labels, value=), so the two verbs can't be
+        confused at a call site (the old value-first positional form
+        silently read a label as the value and vice versa)."""
         with self._lock:
             self._values[label_values] = float(value)
 
@@ -86,9 +116,28 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (linear within the
+        landing bucket, the same estimator promql's histogram_quantile
+        applies to the exposition)."""
+        with self._lock:
+            n = self._n
+            if n == 0:
+                return 0.0
+            rank = q * n
+            cumulative = 0
+            lo = 0.0
+            for b, c in zip(self.buckets, self._counts):
+                if cumulative + c >= rank:
+                    frac = (rank - cumulative) / c if c else 0.0
+                    return lo + (b - lo) * frac
+                cumulative += c
+                lo = b
+            return self.buckets[-1]
+
     def expose(self) -> List[str]:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} histogram",
         ]
         cumulative = 0
@@ -104,6 +153,35 @@ class Histogram:
             lines.append(f"{self.name}_sum {self._sum}")
             lines.append(f"{self.name}_count {self._n}")
         return lines
+
+
+class WindowedHistogram(Histogram):
+    """Histogram plus a bounded window of recent raw observations for
+    EXACT short-horizon quantiles (the p50/p99 batch-latency lines the
+    bench and `cilium status` surface): the cumulative buckets feed
+    Prometheus; the window answers "what is p99 right now" without
+    bucket-resolution error."""
+
+    def __init__(self, name, help_text, buckets=None, window: int = 512):
+        super().__init__(name, help_text, buckets)
+        self._window = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        super().observe(value)
+        with self._lock:
+            self._window.append(value)
+
+    def window_quantile(self, q: float) -> float:
+        """Exact quantile over the last `window` observations
+        (nearest-rank); 0.0 when nothing has been observed."""
+        with self._lock:
+            if not self._window:
+                return 0.0
+            ordered = sorted(self._window)
+            rank = min(
+                len(ordered) - 1, max(0, int(q * len(ordered)))
+            )
+            return ordered[rank]
 
 
 class Registry:
@@ -172,6 +250,26 @@ class Registry:
         self.verdict_throughput = Gauge(
             f"{ns}_verdicts_per_second",
             "Device verdict throughput (TPU-native metric)",
+        )
+        self.policy_verdict_total = Counter(
+            f"{ns}_policy_verdict_total",
+            "Policy verdicts by direction, match type and action",
+            ("direction", "match", "action"),
+        )
+        self.datapath_stage_total = Counter(
+            f"{ns}_datapath_stage_total",
+            "Datapath stage outcomes by stage and direction "
+            "(LB DNAT, CT states, ipcache world fallback, proxy "
+            "redirects) folded from the on-device accumulator",
+            ("stage", "direction"),
+        )
+        self.batch_duration = WindowedHistogram(
+            f"{ns}_datapath_batch_duration_seconds",
+            "Wall time of one datapath batch (dispatch to drained)",
+            buckets=(
+                0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5,
+            ),
         )
 
     def expose(self) -> str:

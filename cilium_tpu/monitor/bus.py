@@ -20,7 +20,13 @@ from typing import Callable, Deque, List, Optional
 
 import numpy as np
 
-from cilium_tpu.engine.oracle import MATCH_NONE, MATCH_FRAG_DROP
+from cilium_tpu.engine.oracle import (
+    MATCH_FRAG_DROP,
+    MATCH_L3,
+    MATCH_L4,
+    MATCH_L4_WILD,
+    MATCH_NONE,
+)
 from cilium_tpu.monitor.events import (
     DropNotify,
     PolicyVerdictNotify,
@@ -112,6 +118,8 @@ def verdicts_to_events(
     verdict_eps: "Optional[set]" = None,
     emit_drops: bool = True,
     emit_trace: bool = False,
+    sample: Optional[int] = None,
+    metrics_registry=None,
 ) -> int:
     """Fold a batch: denied tuples → DropNotify (+ verdict events when
     PolicyVerdictNotification is on / emit_allowed).  `verdict_eps`
@@ -122,14 +130,27 @@ def verdicts_to_events(
     (DROP_NOTIFY #define); `emit_trace` emits a per-flow TraceNotify
     for allowed tuples — the TraceNotification option at
     MonitorAggregationLevel none (TRACE_NOTIFY; higher aggregation
-    levels suppress per-packet traces, monitor.go).  Returns the
-    number of events published."""
+    levels suppress per-packet traces, monitor.go).  `sample` caps
+    the number of per-tuple events PUBLISHED this call (the
+    MonitorAggregation analog for batch folds: the aggregate
+    counters below stay exact over the whole batch; only the
+    per-event fan-out is head-sampled) — None publishes everything.
+    `metrics_registry` redirects the counter feed away from the
+    process-global registry — callers whose traffic was ALREADY
+    folded there (e.g. from the device telemetry accumulator) pass a
+    private Registry so the same tuples aren't counted twice.
+    Returns the number of events published."""
     allowed = np.asarray(verdicts.allowed)
     kind = np.asarray(verdicts.match_kind)
     proxy = np.asarray(verdicts.proxy_port)
     # datapath traffic counters (metrics.go drop_count_total /
-    # forward_count_total), batched — one inc per (reason, direction)
-    from cilium_tpu.metrics import registry as _metrics
+    # forward_count_total / policy_verdict_total), batched — one inc
+    # per label set, canonical bpf/lib/common.h reason names
+    if metrics_registry is None:
+        from cilium_tpu.metrics import registry as _metrics
+    else:
+        _metrics = metrics_registry
+    from cilium_tpu.monitor.events import drop_reason_name
 
     for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
         in_dir = np.asarray(directions) == dirv
@@ -141,15 +162,30 @@ def verdicts_to_events(
         pol = denied & ~frag
         if int(pol.sum()):
             _metrics.drop_count.inc(
-                "Policy denied", dname, value=int(pol.sum())
+                drop_reason_name(-DROP_POLICY_CODE), dname,
+                value=int(pol.sum()),
             )
         if int(frag.sum()):
             _metrics.drop_count.inc(
-                "Fragmented packet", dname, value=int(frag.sum())
+                drop_reason_name(-DROP_FRAG_CODE), dname,
+                value=int(frag.sum()),
             )
+        # the lattice verdict histogram (match kind implies action)
+        for code, match, action in (
+            (MATCH_L4, "l4", "allowed"),
+            (MATCH_L3, "l3", "allowed"),
+            (MATCH_L4_WILD, "l4_wild", "allowed"),
+            (MATCH_NONE, "none", "denied"),
+            (MATCH_FRAG_DROP, "frag", "denied"),
+        ):
+            n_kind = int(((kind == code) & in_dir).sum())
+            if n_kind:
+                _metrics.policy_verdict_total.inc(
+                    dname, match, action, value=n_kind
+                )
     import time as _time
 
-    _metrics.event_ts.set(_time.time(), "api")
+    _metrics.event_ts.set("api", value=_time.time())
     n = 0
     per_ep = None
     if emit_allowed:
@@ -178,6 +214,8 @@ def verdicts_to_events(
         from cilium_tpu.monitor.events import TraceNotify
 
         for i in np.nonzero(allowed)[0]:
+            if sample is not None and n >= sample:
+                break
             # the local endpoint is the DESTINATION of an ingress
             # flow and the SOURCE of an egress one (send_trace_notify
             # carries distinct src/dst; 0 = remote/unknown)
@@ -191,6 +229,8 @@ def verdicts_to_events(
             )
             n += 1
     for i in idx:
+        if sample is not None and n >= sample:
+            break
         if allowed[i]:
             bus.publish(_verdict_event(i, True))
         else:

@@ -8,11 +8,19 @@ The reference exposes decision tracing at two levels, both kept here:
   - datapath-level: per-tuple attribution — which policy-map entry
     (exact / L3-only / wildcard probe) produced the verdict
     (the per-entry counters of bpf/lib/policy.h:66 made queryable).
+
+`trace_tuple` is the telemetry plane's single-tuple EXPLAIN kernel:
+it reruns the whole fused-pipeline stage order (prefilter → LB/DNAT
+→ CT → ipcache → lattice → combine) host-side against the daemon's
+live state, reporting every stage's intermediate decision plus the
+repository rules that produced the matched map entry — the
+`cilium policy trace` analogue made stage-accurate.
 """
 
 from __future__ import annotations
 
 import io
+import ipaddress
 from typing import Tuple
 
 from cilium_tpu.engine.oracle import (
@@ -20,6 +28,7 @@ from cilium_tpu.engine.oracle import (
     MATCH_L3,
     MATCH_L4,
     MATCH_L4_WILD,
+    MATCH_NONE,
     policy_can_access,
 )
 from cilium_tpu.maps.policymap import PolicyMapState
@@ -47,6 +56,21 @@ def explain_tuple(
     """Datapath attribution for one tuple against one endpoint's map
     state: which probe of the 3-probe lattice decided, and on which
     entry."""
+    verdict, why = _explain_verdict(
+        state, identity, dport, proto, direction, is_fragment
+    )
+    action = "ALLOW" if verdict.allowed else "DENY"
+    return verdict.allowed, f"{action}: {why}"
+
+
+def _explain_verdict(
+    state, identity, dport, proto, direction, is_fragment=False
+):
+    """One lattice evaluation + attribution text.  Deepcopies the
+    state once (probe hits bump entry counters, policy.h:66, and an
+    explain must not perturb what it reads); returns (Verdict, why)
+    so trace_tuple gets match_kind/proxy_port without a second
+    evaluation."""
     import copy
 
     verdict = policy_can_access(
@@ -80,5 +104,281 @@ def explain_tuple(
         why = "fragment without L3-only allow (DROP_FRAG_NOSUPPORT)"
     else:
         why = "no matching entry (DROP_POLICY)"
-    action = "ALLOW" if verdict.allowed else "DENY"
-    return verdict.allowed, f"{action}: {why}"
+    return verdict, why
+
+
+def _ip_u32(ip) -> int:
+    return (
+        int(ip)
+        if isinstance(ip, int)
+        else int(ipaddress.IPv4Address(ip))
+    )
+
+
+def _lpm_match(mappings, ip_u32: int):
+    """Longest-prefix match over a {cidr: identity} dict; returns
+    (prefix, identity) or (None, 0).  Single-tuple explain path —
+    clarity over speed."""
+    best = (None, 0, -1)
+    for cidr, num_id in mappings.items():
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 4:
+            continue
+        if (ip_u32 & int(net.netmask)) == int(net.network_address):
+            if net.prefixlen > best[2]:
+                best = (cidr, num_id, net.prefixlen)
+    return best[0], best[1]
+
+
+def _matching_rules(daemon, ep_labels, peer_labels, peer_addr_u32,
+                    dport, proto, direction, match_kind):
+    """Repository rules consistent with the matched map entry: the
+    rule must select the endpoint, and its direction clause must
+    admit the peer (from_endpoints/to_endpoints selector over the
+    peer identity's labels, or a CIDR clause covering the address)
+    on the matched port (exact/wildcard L4) or with no port clause
+    (L3-only).  Returns [(rule index, rule labels string)]."""
+    proto_name = {6: "TCP", 17: "UDP"}.get(proto, str(proto))
+    out = []
+    for i, repo_rule in enumerate(daemon.repo.rules):
+        # repo entries are PolicyRule wrappers around the api.Rule
+        rule = getattr(repo_rule, "rule", repo_rule)
+        if not rule.endpoint_selector.matches(ep_labels):
+            continue
+        clauses = rule.ingress if direction == 0 else rule.egress
+        for clause in clauses:
+            sels = (
+                clause.from_endpoints
+                if direction == 0
+                else getattr(clause, "to_endpoints", [])
+            )
+            peer_ok = any(
+                s.matches(peer_labels) for s in sels
+            ) if peer_labels is not None else False
+            cidrs = [str(c) for c in getattr(
+                clause, "from_cidr" if direction == 0 else "to_cidr", []
+            )] + [str(c.cidr) for c in getattr(
+                clause,
+                "from_cidr_set" if direction == 0 else "to_cidr_set",
+                [],
+            )]
+            for cidr in cidrs:
+                net = ipaddress.ip_network(cidr, strict=False)
+                if net.version == 4 and (
+                    peer_addr_u32 & int(net.netmask)
+                ) == int(net.network_address):
+                    peer_ok = True
+            ports = [
+                (pp.port, (pp.protocol or "TCP").upper())
+                for pr in clause.to_ports
+                for pp in pr.ports
+            ]
+            if match_kind == MATCH_L3:
+                port_ok = not ports
+            elif match_kind in (MATCH_L4, MATCH_L4_WILD):
+                port_ok = any(
+                    p == str(dport) and pn in (proto_name, "ANY", "")
+                    for p, pn in ports
+                )
+                # an L4 wildcard entry needs no peer selector at all
+                if match_kind == MATCH_L4_WILD and port_ok and not sels:
+                    peer_ok = True
+            else:
+                port_ok = False
+            if peer_ok and port_ok:
+                out.append((i, str(rule.labels)))
+                break
+    return out
+
+
+def trace_tuple(
+    daemon,
+    ep_id: int,
+    saddr,
+    daddr,
+    dport: int,
+    proto: int = 6,
+    direction: int = 0,
+    sport: int = 0,
+    is_fragment: bool = False,
+) -> dict:
+    """Single-tuple datapath explain: rerun the fused pipeline's
+    stage order host-side against the daemon's live state, emitting
+    each stage's intermediate decision and the matching rules.
+
+    Returns {"verdict", "allowed", "proxy_port", "stages": [{stage,
+    decision, detail}], "rules": [{index, labels}], "text"} — the
+    payload behind POST /policy/trace-tuple and
+    `cilium-tpu policy trace-tuple`."""
+    from cilium_tpu.ct.table import (
+        CT_EGRESS,
+        CT_ESTABLISHED,
+        CT_INGRESS,
+        CT_NEW,
+        CT_RELATED,
+        CT_REPLY,
+        CTTuple,
+    )
+    from cilium_tpu.identity import RESERVED_WORLD
+    from cilium_tpu.lb.service import L3n4Addr
+
+    stages = []
+
+    def stage(name, decision, detail):
+        stages.append(
+            {"stage": name, "decision": decision, "detail": detail}
+        )
+
+    saddr_u32 = _ip_u32(saddr)
+    daddr_u32 = _ip_u32(daddr)
+    dir_name = "ingress" if direction == 0 else "egress"
+
+    endpoint = daemon.endpoint_manager.lookup(ep_id)
+    if endpoint is None:
+        raise KeyError(f"no endpoint {ep_id}")
+
+    # -- 1. XDP prefilter ---------------------------------------------------
+    pre_cidr, _ = _lpm_match(
+        {c: 1 for c in daemon.prefilter.dump()}, saddr_u32
+    )
+    pre_drop = pre_cidr is not None
+    stage(
+        "prefilter",
+        "DROP" if pre_drop else "pass",
+        f"source in denied CIDR {pre_cidr}" if pre_drop
+        else "source not in any denied CIDR",
+    )
+
+    # -- 2. LB service / DNAT (egress only) ---------------------------------
+    eff_daddr, eff_dport = daddr_u32, int(dport)
+    if direction != 0:
+        frontend = L3n4Addr(
+            str(ipaddress.IPv4Address(daddr_u32)), int(dport), proto
+        )
+        svc = daemon.services.lookup(frontend)
+        if svc is not None and svc.backends:
+            from cilium_tpu.engine.hostpath import lb_select_host
+
+            slave, sticky = lb_select_host(
+                daemon.ct, svc, saddr_u32, daddr_u32, sport, dport,
+                proto,
+            )
+            backend = svc.backends[slave - 1]
+            eff_daddr = backend.addr.ip_u32()
+            eff_dport = backend.addr.port
+            stage(
+                "lb",
+                "DNAT",
+                f"service {frontend.ip}:{frontend.port} -> backend "
+                f"{backend.addr.ip}:{backend.addr.port} "
+                f"(slave {slave}, "
+                f"{'CT-sticky' if sticky else 'hash-selected'})",
+            )
+        else:
+            stage("lb", "pass", "destination is not a service VIP")
+    else:
+        stage("lb", "skip", "ingress flows do not traverse lb4_local")
+
+    # -- 3. conntrack -------------------------------------------------------
+    ct_res = daemon.ct.lookup(
+        CTTuple(eff_daddr, saddr_u32, eff_dport, sport, proto),
+        CT_INGRESS if direction == 0 else CT_EGRESS,
+    )
+    ct_name = {
+        CT_NEW: "NEW",
+        CT_ESTABLISHED: "ESTABLISHED",
+        CT_REPLY: "REPLY",
+        CT_RELATED: "RELATED",
+    }[ct_res]
+    stage("conntrack", ct_name, f"ct_lookup4 on the {dir_name} tuple")
+
+    # -- 4. ipcache identity derivation -------------------------------------
+    sec_ip = saddr_u32 if direction == 0 else eff_daddr
+    prefix, sec_id = _lpm_match(
+        dict(daemon.lpm_builder.mappings), sec_ip
+    )
+    if sec_id == 0:
+        sec_id = RESERVED_WORLD
+        stage(
+            "ipcache",
+            f"identity {sec_id}",
+            "no ipcache entry — WORLD fallback",
+        )
+    else:
+        stage(
+            "ipcache",
+            f"identity {sec_id}",
+            f"LPM hit {prefix}",
+        )
+
+    # -- 5. policy lattice --------------------------------------------------
+    state = endpoint.realized_map_state
+    verdict, why = _explain_verdict(
+        state, sec_id, eff_dport, proto, direction, is_fragment
+    )
+    allowed_pol = verdict.allowed
+    stage("policy", "ALLOW" if allowed_pol else "DENY", why)
+
+    # -- 6. combine (bpf_lxc.c:962-985) -------------------------------------
+    pass_ct = ct_res in (CT_REPLY, CT_RELATED)
+    allowed = (not pre_drop) and (pass_ct or allowed_pol)
+    proxy_port = (
+        verdict.proxy_port
+        if allowed_pol
+        and ct_res in (CT_NEW, CT_ESTABLISHED)
+        and allowed
+        else 0
+    )
+    if pre_drop:
+        detail = "prefilter drop overrides everything"
+    elif pass_ct and not allowed_pol:
+        detail = f"{ct_name} flow bypasses the policy deny"
+    elif proxy_port:
+        detail = f"allowed, redirected to proxy port {proxy_port}"
+    else:
+        detail = "policy verdict stands"
+    stage("combine", "ALLOW" if allowed else "DROP", detail)
+
+    # -- rule attribution ---------------------------------------------------
+    peer_labels = daemon.identity_cache().get(sec_id)
+    ep_labels = (
+        endpoint.security_identity.label_array
+        if endpoint.security_identity is not None
+        else None
+    )
+    rules = []
+    if ep_labels is not None and verdict.match_kind != MATCH_NONE:
+        rules = [
+            {"index": i, "labels": labels}
+            for i, labels in _matching_rules(
+                daemon, ep_labels, peer_labels, sec_ip,
+                eff_dport, proto, direction, verdict.match_kind,
+            )
+        ]
+
+    lines = [
+        f"Tracing {dir_name} tuple ep={ep_id} "
+        f"{ipaddress.IPv4Address(saddr_u32)}:{sport} -> "
+        f"{ipaddress.IPv4Address(daddr_u32)}:{dport} proto={proto}"
+    ]
+    for s in stages:
+        lines.append(
+            f"  [{s['stage']:>9}] {s['decision']}: {s['detail']}"
+        )
+    for r in rules:
+        lines.append(
+            f"  matched rule #{r['index']} labels={r['labels']}"
+        )
+    lines.append(
+        f"Final verdict: {'ALLOWED' if allowed else 'DENIED'}"
+    )
+    return {
+        "verdict": "allowed" if allowed else "denied",
+        "allowed": allowed,
+        "proxy_port": proxy_port,
+        "match_kind": int(verdict.match_kind),
+        "identity": int(sec_id),
+        "stages": stages,
+        "rules": rules,
+        "text": "\n".join(lines) + "\n",
+    }

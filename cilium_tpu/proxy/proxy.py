@@ -390,7 +390,7 @@ class Proxy:
             snapshot = tuple(seen)
         for parser in snapshot:
             metrics.proxy_redirects.set(
-                float(by_parser.get(parser, 0)), parser
+                parser, value=float(by_parser.get(parser, 0))
             )
 
     def redirect_for(

@@ -381,6 +381,12 @@ class ReplayStats:
     # filtered by Daemon.process_flows) — totals must account for
     # every input record
     dropped: int = 0
+    # per-phase wall-time accumulators (SpanStats: host_pack /
+    # dispatch / drain), populated by replay()'s instrumented loop
+    spans: object = None
+    # [2, TELEM_COLS] u64 stage/drop histogram of the replayed
+    # traffic (replay(collect_telemetry=True))
+    telemetry: object = None
 
     @property
     def verdicts_per_sec(self) -> float:
@@ -489,6 +495,7 @@ def replay(
     ep_map: Optional[Dict[int, int]] = None,
     manager=None,
     ct_map=None,
+    collect_telemetry: bool = False,
 ) -> tuple:
     """Run all records through the FULL fused datapath step
     (engine/datapath.datapath_step_accum — counters scatter into
@@ -503,6 +510,20 @@ def replay(
     kernel datapath seeing its own CT writes.  Without it batches
     evaluate against the fixed snapshot and stay pipelined.
 
+    With `collect_telemetry` the fused dispatch additionally carries
+    the [2, TELEM_COLS] stage/drop accumulator
+    (datapath_step_accum_telem); the folded histogram lands in
+    stats.telemetry AND increments the process metrics registry
+    (cilium_drop_count_total / policy_verdict_total / ...).  Not
+    offered in churn mode (the churn programs fuse intent compaction
+    instead).
+
+    Phase wall times (host_pack / dispatch / drain) accumulate into
+    stats.spans, and per-iteration wall time feeds the registry's
+    batch-duration histogram — the SpanStat instrumentation the
+    reference hangs off its regeneration phases, applied to the
+    datapath loop.
+
     Returns (ReplayStats, l4_counts, l3_counts); the counter arrays
     are u64 sums across batches with shapes [E, 2, Kg] and [E, 2, N]
     (policy_entry packets, bpf/lib/policy.h:66-68), or (stats, None,
@@ -516,8 +537,11 @@ def replay(
         DatapathTables,
         datapath_step,
         datapath_step_accum,
+        datapath_step_accum_telem,
     )
     from cilium_tpu.engine.verdict import make_counter_buffers
+    from cilium_tpu.metrics import registry as _metrics
+    from cilium_tpu.spanstat import SpanStats
 
     if manager is not None:
         # stale-table guard at the layer that actually reads the
@@ -527,6 +551,8 @@ def replay(
         manager.check_tables_current(tables.policy)
 
     stats = ReplayStats()
+    spans = SpanStats()
+    stats.spans = spans
     # pin every table on device once — jitted steps re-upload host
     # numpy leaves on EVERY call otherwise (268 MB of policy tables
     # per batch at config5 scale)
@@ -542,12 +568,35 @@ def replay(
     fold_every = max(1, _COUNTER_FOLD_MAX_INCR // max(batch_size, 1))
     if accumulate_counters:
         acc = jax.device_put(make_counter_buffers(tables.policy))
+    telem_dev = None
+    telem_total = None
+    if collect_telemetry and ct_map is None:
+        from cilium_tpu.engine.verdict import (
+            TELEM_COLS,
+            make_telemetry_buffers,
+        )
+
+        telem_total = np.zeros((2, TELEM_COLS), np.uint64)
+        if accumulate_counters:
+            telem_dev = jax.device_put(make_telemetry_buffers())
 
     def _fold_counters():
-        nonlocal acc, acc_total, batches_since_fold
+        nonlocal acc, acc_total, batches_since_fold, telem_dev
+        nonlocal telem_total
         host = np.asarray(acc).astype(np.uint64)
         acc_total = host if acc_total is None else acc_total + host
         acc = jax.device_put(make_counter_buffers(tables.policy))
+        if telem_dev is not None:
+            # the telemetry buffer wraps at the same u32 horizon as
+            # the counter buffer — fold it on the same cadence
+            from cilium_tpu.engine.verdict import (
+                make_telemetry_buffers,
+            )
+
+            telem_total = telem_total + np.asarray(telem_dev).astype(
+                np.uint64
+            )
+            telem_dev = jax.device_put(make_telemetry_buffers())
         batches_since_fold = 0
 
     churn = None
@@ -570,9 +619,35 @@ def replay(
         )
         churn_step, churn_step_accum = _churn_fns()[:2]
 
+    def _drain_item(item):
+        """Drain one pending batch; host-fold its telemetry when the
+        dispatch couldn't carry the device accumulator (partial tail
+        batches, or the no-counter audit path)."""
+        nonlocal telem_total
+        out, valid, fold_direction = item
+        spans.span("drain").start()
+        _drain_fused((out, valid), stats)
+        if fold_direction is not None:
+            from cilium_tpu.telemetry import telemetry_from_outputs
+
+            telem_total = telem_total + telemetry_from_outputs(
+                out, np.asarray(fold_direction), valid=valid
+            )
+        spans.span("drain").end()
+
     pending = []  # pipelined dispatch, bounded depth
     t0 = time.perf_counter()
-    for flows, valid in read_flow_batches(buf, batch_size, ep_map):
+    batch_iter = iter(read_flow_batches(buf, batch_size, ep_map))
+    while True:
+        # host pack phase: record decode + pad + H2D upload of the
+        # next batch (read_flow_batches does all three in next())
+        spans.span("host_pack").start()
+        item = next(batch_iter, None)
+        spans.span("host_pack").end(success=item is not None)
+        if item is None:
+            break
+        flows, valid = item
+        iter_t0 = time.perf_counter()
         if ct_map is not None:
             # sustained churn: the compaction runs FUSED with the
             # datapath step (one dispatch per round), the 16-byte
@@ -594,6 +669,7 @@ def replay(
                     policy=tables.policy,
                     tunnel=tables.tunnel,
                 )
+                spans.span("dispatch").start()
                 if first_pass and accumulate_counters:
                     header_d, intents_d, acc = churn_step_accum(
                         tables, flows, valid, acc
@@ -607,28 +683,61 @@ def replay(
                     header_d, intents_d = churn_step(
                         tables, flows, valid
                     )
+                spans.span("dispatch").end()
+                spans.span("drain").start()
                 remaining = churn.drain(
                     header_d, intents_d, stats, int(valid), first_pass
                 )
+                spans.span("drain").end()
                 first_pass = False
                 if remaining == 0:
                     break
+            _metrics.batch_duration.observe(
+                time.perf_counter() - iter_t0
+            )
             continue
+        fold_direction = None
+        spans.span("dispatch").start()
         if accumulate_counters:
-            out, acc = datapath_step_accum(tables, flows, acc)
+            if telem_dev is not None and valid == batch_size:
+                out, acc, telem_dev = datapath_step_accum_telem(
+                    tables, flows, acc, telem_dev
+                )
+            else:
+                out, acc = datapath_step_accum(tables, flows, acc)
+                if telem_total is not None:
+                    # partial tail batch: the device accumulator
+                    # would count the padding rows, so this batch's
+                    # histogram folds host-side on the valid prefix
+                    fold_direction = flows.direction
             batches_since_fold += 1
             if batches_since_fold >= fold_every:
                 _fold_counters()
         else:
             out = datapath_step(tables, flows)
-        pending.append((out, valid))
+            if telem_total is not None:
+                fold_direction = flows.direction
+        spans.span("dispatch").end()
+        pending.append((out, valid, fold_direction))
         stats.batches += 1
         if len(pending) >= 4:
-            _drain_fused(pending.pop(0), stats)
+            _drain_item(pending.pop(0))
+        _metrics.batch_duration.observe(time.perf_counter() - iter_t0)
     while pending:
-        _drain_fused(pending.pop(0), stats)
+        _drain_item(pending.pop(0))
     if churn is not None:
         churn.stash()
+    if telem_total is not None:
+        from cilium_tpu.telemetry import fold_telemetry
+
+        if telem_dev is not None:
+            telem_total = telem_total + np.asarray(telem_dev).astype(
+                np.uint64
+            )
+            telem_dev = None  # consumed; the trailing counter fold
+            # must not fold this buffer a second time
+        stats.telemetry = telem_total
+        fold_telemetry(telem_total)
     stats.seconds = time.perf_counter() - t0
 
     if not accumulate_counters:

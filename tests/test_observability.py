@@ -81,7 +81,7 @@ def test_metrics_registry_exposition():
     r.endpoint_regenerations.inc("fail")
     r.drop_count.inc("Policy denied (L3)", "ingress", value=7)
     r.endpoint_regeneration_seconds.observe(0.2)
-    r.policy_count.set(3)
+    r.policy_count.set(value=3)
     text = r.expose()
     assert 'cilium_endpoint_regenerations{outcome="success"} 2.0' in text
     assert 'cilium_drop_count_total{reason="Policy denied (L3)",direction="ingress"} 7.0' in text
@@ -294,11 +294,13 @@ def test_metrics_breadth_wired():
     cid = client.security_identity.id
     buf = _make_buf(rng, 64, [10], [cid, 999999])
 
-    drops_before = metrics.drop_count.get("Policy denied", "INGRESS")
+    drops_before = metrics.drop_count.get(
+        "Policy denied (L3)", "INGRESS"
+    )
     fwd_before = metrics.forward_count.get("INGRESS")
     stats = d.process_flows(buf, batch_size=32)
     assert (
-        metrics.drop_count.get("Policy denied", "INGRESS")
+        metrics.drop_count.get("Policy denied (L3)", "INGRESS")
         - drops_before
         == stats.denied
         > 0

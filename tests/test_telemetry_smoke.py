@@ -1,0 +1,29 @@
+"""tools/telemetry_smoke.py as a tier-1 test: one instrumented
+batch, scrape the exposition, assert it parses (fast, not slow)."""
+
+import json
+
+
+def test_telemetry_smoke_tool(capsys):
+    from tools.telemetry_smoke import main
+
+    assert main() == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    got = json.loads(out)
+    assert got["smoke"] == "ok"
+    assert got["samples"] > 0
+    assert got["forwarded"] + got["denied"] == 2048
+
+
+def test_exposition_parser_rejects_malformed():
+    import pytest
+
+    from tools.telemetry_smoke import parse_exposition
+
+    assert parse_exposition(
+        '# HELP m h\n# TYPE m counter\nm{a="b"} 1.0\nm 2\n'
+    ) == 2
+    with pytest.raises(ValueError):
+        parse_exposition('m{a="unterminated} 1.0\n')
+    with pytest.raises(ValueError):
+        parse_exposition("m novalue\n")

@@ -1,0 +1,242 @@
+"""Telemetry-plane smoke: one instrumented batch → /metrics scrape →
+exposition parse check.
+
+Builds a small self-contained world (compiled map states + ipcache +
+prefilter + CT + LB), runs ONE batch through the instrumented fused
+step (counters + the [2, TELEM_COLS] stage reductions in one
+dispatch), folds the device telemetry into the process metrics
+registry, serves the registry with health.start_metrics_server,
+scrapes it over HTTP, and verifies:
+
+  * the scrape parses as Prometheus text format (HELP/TYPE/sample
+    line grammar, escaped label values);
+  * the folded drop/forward counters equal the device's stage
+    columns;
+  * the device histogram equals the host per-tuple fold bit-for-bit.
+
+Runs in tier-1 (tests/test_telemetry_smoke.py, not slow) and
+standalone:  python tools/telemetry_smoke.py
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import os
+import re
+import sys
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+
+def ip_u32(s: str) -> int:
+    return int(ipaddress.ip_address(s))
+
+
+def build_world(seed: int = 5):
+    """A small but full datapath world: 2 endpoints, mixed L3/L4
+    map states, CIDR'd ipcache, one denied prefilter CIDR, one
+    2-backend service, a few established CT entries."""
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.ct.table import CT_INGRESS, CTMap, CTTuple
+    from cilium_tpu.engine.datapath import DatapathTables
+    from cilium_tpu.ipcache.lpm import build_ipcache
+    from cilium_tpu.lb.device import compile_lb
+    from cilium_tpu.lb.service import L3n4Addr, ServiceManager
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+    from cilium_tpu.prefilter import build_prefilter
+
+    ids = [256, 257, 300]
+    states = [
+        {
+            PolicyKey(256, 80, 6, INGRESS): PolicyMapStateEntry(),
+            PolicyKey(257, 0, 0, INGRESS): PolicyMapStateEntry(),
+            PolicyKey(0, 443, 6, INGRESS): PolicyMapStateEntry(
+                proxy_port=15001
+            ),
+            PolicyKey(256, 8080, 6, 1): PolicyMapStateEntry(),
+        },
+        {
+            PolicyKey(300, 0, 0, INGRESS): PolicyMapStateEntry(),
+        },
+    ]
+    policy = compile_map_states(states, ids, 32, 16)
+    ipcache_map = {
+        "10.0.0.0/16": 256,
+        "10.1.0.0/16": 257,
+        "10.2.0.0/16": 300,
+    }
+    mgr = ServiceManager()
+    mgr.upsert(
+        L3n4Addr("172.16.0.1", 80, 6),
+        [L3n4Addr("10.0.0.10", 8080, 6)],
+    )
+    ct = CTMap()
+    ct.create(
+        CTTuple(ip_u32("10.0.0.10"), ip_u32("10.1.0.1"), 80, 4001, 6),
+        CT_INGRESS,
+    )
+    tables = DatapathTables(
+        prefilter=build_prefilter({"203.0.113.0/24": 1}),
+        ipcache=build_ipcache(ipcache_map),
+        ct=compile_ct(ct),
+        lb=compile_lb(mgr),
+        policy=policy,
+    )
+    return tables, states
+
+
+def make_flows(rng, n: int):
+    from cilium_tpu.engine.datapath import FlowBatch
+
+    pool = [
+        "10.0.0.1", "10.0.0.10", "10.1.0.1", "10.2.0.2",
+        "203.0.113.9", "8.8.8.8",
+    ]
+    return FlowBatch.from_numpy(
+        ep_index=rng.integers(0, 2, size=n),
+        saddr=np.array(
+            [ip_u32(rng.choice(pool)) for _ in range(n)], np.uint32
+        ),
+        daddr=np.array(
+            [
+                ip_u32(rng.choice(pool + ["172.16.0.1"]))
+                for _ in range(n)
+            ],
+            np.uint32,
+        ),
+        sport=rng.integers(1024, 60000, size=n),
+        dport=rng.choice([53, 80, 443, 8080], size=n),
+        proto=rng.choice([6, 17], size=n),
+        direction=rng.integers(0, 2, size=n),
+        is_fragment=rng.random(size=n) < 0.05,
+    )
+
+
+# Prometheus text-format line grammar (enough to catch a corrupted
+# exposition: bad label escaping, missing value, stray text)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" [0-9eE.+\-]+(?: [0-9]+)?$"
+)
+
+
+def parse_exposition(text: str) -> int:
+    """Validate every line of a text-format exposition; returns the
+    number of sample lines.  Raises ValueError on the first
+    malformed line."""
+    n_samples = 0
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            if len(line.split(None, 3)) < 4:
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            continue
+        if line.startswith("#"):
+            continue
+        if not _SAMPLE_RE.match(line):
+            raise ValueError(f"line {lineno}: bad sample {line!r}")
+        n_samples += 1
+    return n_samples
+
+
+def main() -> int:
+    import jax
+
+    from cilium_tpu.engine.datapath import datapath_step_accum_telem
+    from cilium_tpu.engine.verdict import (
+        TELEM_DENIED,
+        TELEM_FORWARDED,
+        make_counter_buffers,
+        make_telemetry_buffers,
+    )
+    from cilium_tpu.health import start_metrics_server
+    from cilium_tpu.metrics import Registry
+    from cilium_tpu.telemetry import (
+        fold_telemetry,
+        telemetry_consistent,
+        telemetry_from_outputs,
+        telemetry_summary,
+    )
+
+    rng = np.random.default_rng(11)
+    tables, states = build_world()
+    flows = make_flows(rng, 2048)
+
+    # one instrumented batch: counters + telemetry in one dispatch
+    acc = jax.device_put(make_counter_buffers(tables.policy))
+    telem = jax.device_put(make_telemetry_buffers())
+    out, acc, telem = datapath_step_accum_telem(
+        tables, flows, acc, telem
+    )
+    telem_host = np.asarray(telem).astype(np.uint64)
+
+    # device histogram == host per-tuple fold, and internally sane
+    want = telemetry_from_outputs(out, np.asarray(flows.direction))
+    assert (telem_host == want).all(), (telem_host, want)
+    assert telemetry_consistent(telem_host), telem_host
+
+    # fold into a PRIVATE registry (the smoke must not pollute the
+    # process registry when run inside the test suite), serve it,
+    # scrape it, parse it
+    registry = Registry()
+    fold_telemetry(telem_host, registry=registry)
+    # a hostile label value proves the exposition escaping
+    registry.drop_count.inc('bad"reason\\with\nnewline', "INGRESS")
+    server = start_metrics_server(port=0, registry=registry)
+    try:
+        host, port = server.server_address
+        text = (
+            urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10
+            )
+            .read()
+            .decode()
+        )
+    finally:
+        server.shutdown()
+
+    n_samples = parse_exposition(text)
+    assert n_samples > 0, "empty exposition"
+    assert "cilium_forward_count_total" in text
+    assert "cilium_drop_count_total" in text
+    assert "cilium_policy_verdict_total" in text
+    assert 'bad\\"reason\\\\with\\nnewline' in text, (
+        "label escaping missing from exposition"
+    )
+
+    # the folded counters must equal the device columns
+    fwd = sum(
+        registry.forward_count.get(d) for d in ("INGRESS", "EGRESS")
+    )
+    assert fwd == int(telem_host[:, TELEM_FORWARDED].sum())
+    total_denied = int(telem_host[:, TELEM_DENIED].sum())
+    print(
+        json.dumps(
+            {
+                "smoke": "ok",
+                "samples": n_samples,
+                "forwarded": int(fwd),
+                "denied": total_denied,
+                "telemetry": telemetry_summary(telem_host),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
