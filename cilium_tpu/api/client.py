@@ -44,7 +44,10 @@ class APIClient:
         try:
             payload = None
             headers = {}
-            if body is not None:
+            if isinstance(body, bytes):
+                payload = body
+                headers["Content-Type"] = "application/octet-stream"
+            elif body is not None:
                 payload = (
                     body if isinstance(body, str) else json.dumps(body)
                 )
@@ -165,3 +168,22 @@ class APIClient:
 
     def metrics_dump(self):
         return self._request("GET", "/metrics")
+
+    # -- fault injection / serving plane -------------------------------------
+
+    def fault_list(self):
+        return self._request("GET", "/debug/faults")
+
+    def fault_arm(self, body: dict):
+        return self._request("POST", "/debug/faults", body=body)
+
+    def fault_disarm(self, site=None):
+        path = (
+            f"/debug/faults/{site}" if site else "/debug/faults"
+        )
+        return self._request("DELETE", path)
+
+    def process_flows(self, buf: bytes):
+        """POST a binary flow-record buffer through the serving
+        plane; malformed buffers surface as APIError(400)."""
+        return self._request("POST", "/datapath/flows", body=buf)

@@ -68,16 +68,30 @@ class DaemonAPI:
     def healthz(self) -> dict:
         from cilium_tpu.health import probe_endpoints
 
+        # the resilience rollup first: breaker state and stuck
+        # controllers flip health to degraded even when the probe
+        # below succeeds (degraded-but-serving is the whole point of
+        # the host-path failover)
+        health = self.daemon.health()
         try:
             probes = probe_endpoints(self.daemon.endpoint_manager)
             reachable = sum(1 for p in probes if p.reachable)
             return {
-                "status": "ok",
+                "status": health["status"],
+                "reasons": health["reasons"],
+                "breaker": health["breaker"],
+                "degraded_batches": health["degraded_batches"],
+                "shed_flows": health["shed_flows"],
                 "endpoints": len(probes),
                 "reachable": reachable,
             }
         except Exception as exc:
-            return {"status": "degraded", "detail": str(exc)}
+            return {
+                "status": "degraded",
+                "reasons": health["reasons"] + [str(exc)],
+                "breaker": health["breaker"],
+                "detail": str(exc),
+            }
 
     def status(self) -> dict:
         return self.daemon.status()
@@ -500,6 +514,55 @@ class DaemonAPI:
         for _, q in expired:
             self.daemon.monitor.unsubscribe_queue(q)
 
+    # -- fault injection (the chaos framework's REST surface) ----------------
+
+    def fault_list(self) -> dict:
+        from cilium_tpu import faultinject
+
+        return {
+            "sites": list(faultinject.SITES),
+            "armed": faultinject.armed(),
+        }
+
+    def fault_arm(self, body: dict) -> dict:
+        from cilium_tpu import faultinject
+
+        site = body.get("site")
+        if not site:
+            raise ValueError("site required")
+        faultinject.arm(site, body.get("spec", "raise"))
+        return {"armed": faultinject.armed()}
+
+    def fault_disarm(self, site: Optional[str] = None) -> dict:
+        from cilium_tpu import faultinject
+
+        if site:
+            disarmed = 1 if faultinject.disarm(site) else 0
+        else:
+            disarmed = faultinject.disarm_all()
+        return {
+            "disarmed": disarmed,
+            "armed": faultinject.armed(),
+        }
+
+    def process_flows(self, buf: bytes) -> dict:
+        """POST /datapath/flows: run a binary flow-record buffer
+        through the serving plane (the audit-path ingress over REST).
+        Malformed buffers raise ValueError → HTTP 400 at the route;
+        the stream itself completes even under dispatch faults
+        (host-path failover)."""
+        stats = self.daemon.process_flows(buf)
+        return {
+            "total": stats.total,
+            "allowed": stats.allowed,
+            "denied": stats.denied,
+            "dropped": stats.dropped,
+            "shed": stats.shed,
+            "batches": stats.batches,
+            "degraded_batches": stats.degraded_batches,
+            "seconds": stats.seconds,
+        }
+
     def metrics_dump(self) -> dict:
         return {"text": metrics.expose()}
 
@@ -547,6 +610,11 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(n).decode() if n else ""
 
+    def _body_raw(self) -> bytes:
+        """Raw request body (binary routes: flow-record buffers)."""
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
     def do_GET(self) -> None:  # noqa: N802
         api: DaemonAPI = self.server.api  # type: ignore
         path = self.path.split("?", 1)[0]
@@ -585,6 +653,8 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if path == "/debug/profile":
                 return self._reply(200, api.debug_profile())
+            if path == "/debug/faults":
+                return self._reply(200, api.fault_list())
             if path == "/service":
                 return self._reply(200, api.service_list())
             if path == "/ct":
@@ -655,6 +725,27 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._reply(404, {"error": str(exc)})
             if path == "/monitor":
                 return self._reply(201, api.monitor_open())
+            if path == "/debug/faults":
+                try:
+                    body = json.loads(self._body() or "{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be an object")
+                    return self._reply(200, api.fault_arm(body))
+                except (json.JSONDecodeError, ValueError) as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+            if path == "/datapath/flows":
+                # a truncated/corrupt record buffer is the CLIENT's
+                # fault: clean 400, never a daemon crash
+                try:
+                    return self._reply(
+                        200, api.process_flows(self._body_raw())
+                    )
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
             if path == "/service":
                 try:
                     body = json.loads(self._body() or "{}")
@@ -807,6 +898,11 @@ class _Handler(BaseHTTPRequestHandler):
                         400, {"error": f"bad request: {exc}"}
                     )
                 return self._reply(200, api.service_delete(body))
+            if path == "/debug/faults":
+                return self._reply(200, api.fault_disarm())
+            if path.startswith("/debug/faults/"):
+                site = path.split("/debug/faults/", 1)[1]
+                return self._reply(200, api.fault_disarm(site))
             if path.startswith("/monitor/"):
                 sid = path.split("/monitor/", 1)[1]
                 return self._reply(200, api.monitor_close(sid))

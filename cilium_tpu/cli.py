@@ -232,6 +232,33 @@ def cmd_monitor(api, args) -> int:
     return 0
 
 
+def cmd_fault_list(api, args) -> int:
+    print(json.dumps(api.fault_list(), indent=2))
+    return 0
+
+
+def cmd_fault_arm(api, args) -> int:
+    """Arm a chaos fault site ("cilium-tpu fault arm engine.dispatch
+    raise:next=3") — the CLI face of the fault-injection framework."""
+    got = api.fault_arm({"site": args.site, "spec": args.spec})
+    print(json.dumps(got, indent=2))
+    return 0
+
+
+def cmd_fault_disarm(api, args) -> int:
+    # disarming EVERYTHING must be the explicit --all, never the
+    # default of a bare `fault disarm` mid-chaos-run
+    if args.site is None and not args.all:
+        print(
+            "error: give a site to disarm, or --all",
+            file=sys.stderr,
+        )
+        return 2
+    got = api.fault_disarm(None if args.all else args.site)
+    print(json.dumps(got, indent=2))
+    return 0
+
+
 def cmd_status(api, args) -> int:
     print(json.dumps(api.status(), indent=2))
     return 0
@@ -331,6 +358,24 @@ def make_parser() -> argparse.ArgumentParser:
         help="Option=true|false pairs (or policy-enforcement=MODE)",
     )
     cset.set_defaults(func=cmd_config_set)
+
+    fault = sub.add_parser(
+        "fault", help="fault-injection framework (chaos testing)"
+    )
+    fsub = fault.add_subparsers(dest="fault_cmd", required=True)
+    flist = fsub.add_parser("list")
+    flist.set_defaults(func=cmd_fault_list)
+    farm = fsub.add_parser("arm")
+    farm.add_argument("site", help="e.g. engine.dispatch")
+    farm.add_argument(
+        "spec", nargs="?", default="raise",
+        help='schedule, e.g. "raise:next=3", "hang:delay=0.5"',
+    )
+    farm.set_defaults(func=cmd_fault_arm)
+    fdisarm = fsub.add_parser("disarm")
+    fdisarm.add_argument("site", nargs="?", default=None)
+    fdisarm.add_argument("--all", action="store_true")
+    fdisarm.set_defaults(func=cmd_fault_disarm)
 
     status = sub.add_parser("status")
     status.set_defaults(func=cmd_status)

@@ -541,6 +541,6 @@ def apply_new_flows(
         )
         if key in ct.entries:
             continue
-        ct.create(tup, d, now=now)
-        n += 1
+        if ct.create_best_effort(tup, d, now=now):
+            n += 1
     return n

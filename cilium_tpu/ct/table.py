@@ -289,6 +289,14 @@ class CTMap:
         orig_daddr: int = 0,
         orig_dport: int = 0,
     ) -> CTEntry:
+        # chaos seam: an armed ct.insert site fails the map write the
+        # way a full kernel map fails ct_create4.  Raises to THIS
+        # caller; the datapath writeback paths go through
+        # create_best_effort, which contains the failure (drop
+        # accounting, stream continues)
+        from cilium_tpu import faultinject
+
+        faultinject.fire("ct.insert")
         if dir == CT_INGRESS:
             flags = TUPLE_F_OUT
         elif dir == CT_EGRESS:
@@ -319,3 +327,48 @@ class CTMap:
         if dead:
             self.mutations += 1
         return len(dead)
+
+    def create_best_effort(self, tup: CTTuple, dir: int, **kw) -> bool:
+        """CT creation is best-effort, like ct_create4 in the kernel
+        datapath: a failed map write (full map — OverflowError — or
+        an armed ct.insert fault) drops THIS entry under the
+        canonical reason and the caller's stream continues; the
+        flow's create retries on its next appearance.  Returns True
+        when the entry landed."""
+        try:
+            self.create(tup, dir, **kw)
+            return True
+        except Exception as exc:
+            from cilium_tpu.logging import get_logger
+            from cilium_tpu.metrics import registry as _metrics
+            from cilium_tpu.monitor.events import drop_reason_name
+
+            _metrics.drop_count.inc(
+                drop_reason_name(-155),  # "CT: Map insertion failed"
+                # service-scope stickiness entries are neither
+                # datapath direction — attribute them distinctly
+                {CT_INGRESS: "INGRESS", CT_EGRESS: "EGRESS"}.get(
+                    dir, "SERVICE"
+                ),
+            )
+            get_logger("ct").warning(
+                "CT create failed; entry dropped (best-effort)",
+                extra={"fields": {"error": str(exc)}},
+            )
+            return False
+
+    def evict_to(self, target_entries: int) -> int:
+        """Emergency eviction (the watermark GC's last resort, the
+        analog of ctmap's pressure-driven GC interval floor): drop
+        soonest-to-expire entries until the map holds at most
+        `target_entries`.  Returns the number evicted."""
+        excess = len(self.entries) - max(0, target_entries)
+        if excess <= 0:
+            return 0
+        victims = sorted(
+            self.entries.items(), key=lambda kv: kv[1].lifetime
+        )[:excess]
+        for key, _ in victims:
+            del self.entries[key]
+        self.mutations += 1
+        return len(victims)

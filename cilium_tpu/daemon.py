@@ -50,6 +50,12 @@ from cilium_tpu.policy.repository import Repository
 from cilium_tpu.policy.search import SearchContext
 from cilium_tpu.policy.trace import trace_policy
 from cilium_tpu.proxy import Proxy
+from cilium_tpu.resilience import (
+    STATE_CODES,
+    AdmissionGate,
+    CircuitBreaker,
+    DispatchWatchdog,
+)
 from cilium_tpu.spanstat import SpanStats
 from cilium_tpu.utils.controller import ControllerManager
 from cilium_tpu.utils.trigger import Trigger
@@ -176,6 +182,39 @@ class Daemon:
 
         self.prefilter = PreFilter()
         self.controllers = ControllerManager()
+        # a controller stuck failing on its background thread flips
+        # node health to degraded at this many consecutive failures
+        # (pkg/controller's failure bookkeeping surfaced, instead of
+        # failing silently off the request path)
+        self.controller_failure_threshold = 3
+        # -- resilience plane (cilium_tpu.resilience) ------------------
+        # Device dispatch runs under retry + a circuit breaker; when
+        # the breaker opens the serving plane degrades to the
+        # bit-identical host lattice fold instead of erroring the
+        # stream, and half-open probes restore TPU service.
+        self.dispatch_retries = 2
+        self.dispatch_retry_base = 0.002
+        self.dispatch_breaker = CircuitBreaker(
+            name="engine.dispatch",
+            failure_threshold=3,
+            recovery_timeout=1.0,
+            on_transition=self._breaker_event,
+        )
+        # per-batch dispatch deadline (a wedged XLA launch must fail
+        # the batch, not hang the stream); <=0 disables
+        self.dispatch_watchdog = DispatchWatchdog(timeout=30.0)
+        # bounded admission: flows in flight across concurrent
+        # process_flows calls; excess batches shed under the
+        # canonical Overload drop reason (None = unbounded)
+        self.admission = AdmissionGate(limit=None)
+        self.degraded_batches = 0
+        # CT occupancy watermarks → emergency GC with adaptive backoff
+        self.ct_high_watermark = 0.90
+        self.ct_low_watermark = 0.75
+        self._ct_gc_backoff_base = 0.1
+        self._ct_gc_backoff_max = 30.0
+        self._ct_gc_backoff = self._ct_gc_backoff_base
+        self._ct_gc_not_before = 0.0
         # periodic CT GC (pkg/maps/ctmap GC; endpointmanager
         # conntrack.go loop)
         from cilium_tpu.utils.controller import Controller
@@ -452,14 +491,31 @@ class Daemon:
         dirty = False
         attempted = []  # (endpoint, realized map before this attempt)
         universe_unchanged = universe_version == prev_version
+        upcall_failed = False
         for endpoint in self.endpoint_manager.endpoints():
             l4 = endpoint.desired_l4_policy
             if l4 is None or not l4.has_redirect():
                 if endpoint.realized_redirects:
-                    self.proxy.update_endpoint_redirects(
-                        endpoint, cache, id_index, n_identities,
-                        self.selector_cache,
-                    )
+                    try:
+                        self.proxy.update_endpoint_redirects(
+                            endpoint, cache, id_index, n_identities,
+                            self.selector_cache,
+                        )
+                    except Exception as exc:
+                        # a failed proxy upcall (dead envoy, injected
+                        # proxy.upcall fault) must not crash the
+                        # sweep's thread: the endpoint keeps its old
+                        # redirects and retries next trigger
+                        upcall_failed = True
+                        endpoint.force_policy_compute = True
+                        log.warning(
+                            "proxy upcall failed; keeping old "
+                            "redirects",
+                            extra={"fields": {
+                                logfields.ENDPOINT_ID: endpoint.id,
+                                "error": str(exc),
+                            }},
+                        )
                 continue
             if (
                 universe_unchanged
@@ -472,14 +528,32 @@ class Daemon:
                 # fingerprint check would skip only the compile)
                 continue
             before = dict(endpoint.realized_redirects)
-            realized = self.proxy.update_endpoint_redirects(
-                endpoint, cache, id_index, n_identities,
-                self.selector_cache, wait_group=wait_group,
-            )
+            try:
+                realized = self.proxy.update_endpoint_redirects(
+                    endpoint, cache, id_index, n_identities,
+                    self.selector_cache, wait_group=wait_group,
+                )
+            except Exception as exc:
+                # same containment as above: roll this endpoint back
+                # to its pre-attempt redirects, flag the retry, let
+                # every other endpoint's regeneration proceed
+                upcall_failed = True
+                endpoint.realized_redirects = before
+                endpoint.force_policy_compute = True
+                log.warning(
+                    "proxy upcall failed; keeping old redirects",
+                    extra={"fields": {
+                        logfields.ENDPOINT_ID: endpoint.id,
+                        "error": str(exc),
+                    }},
+                )
+                continue
             attempted.append((endpoint, before))
             if realized != before:
                 endpoint.force_policy_compute = True
                 dirty = True
+        if upcall_failed:
+            metrics.endpoint_regenerations.inc("fail")
         # ACK gate (pkg/completion + pkg/envoy/xds/ack.go): the table
         # flip below happens only once EVERY submitted matcher
         # compile — port change or not — has ACKed its version; on
@@ -754,6 +828,134 @@ class Daemon:
         ):
             return
         self.ct.gc(now=self.ct.now())
+        self._ct_pressure_check()
+
+    def _ct_pressure_check(self) -> None:
+        """CT occupancy watermarks (ctmap's pressure-scaled GC
+        interval made explicit): past the high watermark run an
+        emergency sweep — expiry GC first, then soonest-to-expire
+        eviction down to the low watermark — with adaptive backoff so
+        sustained pressure can't turn every batch into a GC storm."""
+        import time as _time
+
+        cap = self.ct.max_entries or 1
+        occupancy = len(self.ct.entries) / cap
+        metrics.ct_occupancy.set(value=occupancy)
+        if occupancy < self.ct_high_watermark:
+            self._ct_gc_backoff = self._ct_gc_backoff_base
+            return
+        now = _time.monotonic()
+        if now < self._ct_gc_not_before:
+            return
+        expired = self.ct.gc(now=self.ct.now())
+        target = int(cap * self.ct_low_watermark)
+        evicted = self.ct.evict_to(target)
+        metrics.ct_emergency_gc_total.inc()
+        metrics.ct_occupancy.set(value=len(self.ct.entries) / cap)
+        # adaptive backoff: each consecutive emergency sweep doubles
+        # the spacing (an ineffective sweep repeated immediately only
+        # burns the hot path); any drop below the high watermark
+        # resets it
+        self._ct_gc_not_before = now + self._ct_gc_backoff
+        self._ct_gc_backoff = min(
+            self._ct_gc_backoff * 2, self._ct_gc_backoff_max
+        )
+        from cilium_tpu.monitor.events import AgentNotify
+
+        self.monitor.publish(
+            AgentNotify(
+                kind="ct-emergency-gc",
+                text=(
+                    f"occupancy {occupancy:.2f}: expired {expired}, "
+                    f"evicted {evicted}"
+                ),
+            )
+        )
+        log.warning(
+            "CT high watermark: emergency GC",
+            extra={"fields": {
+                "occupancy": round(occupancy, 3),
+                "expired": expired,
+                "evicted": evicted,
+                "next_backoff_s": self._ct_gc_backoff,
+            }},
+        )
+
+    # -- resilience (circuit breaker / degraded serving) ---------------------
+
+    def _breaker_event(
+        self, name: str, old: str, new: str, reason: str
+    ) -> None:
+        """CircuitBreaker transition listener: gauge + monitor event
+        + log — breaker state is observable through every plane the
+        telemetry PR wired (Prometheus, `cilium monitor`, agent
+        log)."""
+        from cilium_tpu.monitor.events import AgentNotify
+
+        metrics.breaker_state.set(name, value=STATE_CODES[new])
+        self.monitor.publish(
+            AgentNotify(
+                kind="circuit-breaker",
+                text=f"{name}: {old} -> {new} ({reason})",
+            )
+        )
+        log.warning(
+            "circuit breaker transition",
+            extra={"fields": {
+                "breaker": name,
+                "from": old,
+                "to": new,
+                "reason": reason,
+            }},
+        )
+
+    def _dispatch_or_degrade(
+        self, tables, batch, host_args, pad_to: int
+    ):
+        """One batch through the guarded device dispatch: the
+        engine.dispatch fault seam fires first, the watchdog bounds
+        the launch, retry_call absorbs transient failures (counted in
+        dispatch_retries_total), and the circuit breaker decides
+        admission.  On breaker-open or exhausted retries the batch is
+        served by the bit-identical host lattice fold
+        (engine.hostpath.lattice_fold_host) — the stream completes,
+        degraded_batches_total counts the failover.
+
+        Returns (verdicts, degraded flag); verdicts satisfy the
+        Verdicts contract (allowed/proxy_port/match_kind, padded)."""
+        from cilium_tpu.engine.hostpath import lattice_fold_host
+        from cilium_tpu.engine.verdict import evaluate_batch
+        from cilium_tpu.resilience import guarded_dispatch
+
+        if self.dispatch_breaker.allow():
+            try:
+                out = guarded_dispatch(
+                    evaluate_batch,
+                    tables,
+                    batch,
+                    retries=self.dispatch_retries,
+                    base_delay=self.dispatch_retry_base,
+                    watchdog=self.dispatch_watchdog,
+                )
+                self.dispatch_breaker.record_success()
+                return out, False
+            except Exception as exc:
+                self.dispatch_breaker.record_failure(str(exc))
+                log.warning(
+                    "device dispatch failed; serving batch from "
+                    "host path",
+                    extra={"fields": {"error": str(exc)}},
+                )
+        states, ep_index, identity, dport, proto, direction, frag = (
+            host_args()
+        )
+        out = lattice_fold_host(
+            states, ep_index, identity, dport, proto, direction,
+            is_fragment=frag, pad_to=pad_to,
+        )
+        self.degraded_batches += 1
+        metrics.degraded_batches_total.inc()
+        return out, True
 
     def service_upsert(
         self, frontend, backends
@@ -774,6 +976,8 @@ class Daemon:
         regeneration, exactly as the reference recompiles on config
         change (config IS part of the compiled program — the options
         feed the compiler cache key)."""
+        from cilium_tpu import faultinject
+
         applied = 0
         verdict_affecting = False
         with self.lock:
@@ -786,6 +990,21 @@ class Daemon:
             raw_opts = changes.get("options") or {}
             for k, v in raw_opts.items():
                 option.Config.opts.parse_validate(k, v)
+            # fault-site arming ({"faults": {site: spec | null}}) —
+            # the config_patch surface of the chaos framework;
+            # validated up front like the options
+            raw_faults = changes.get("faults") or {}
+            parsed_faults = {}
+            for site, spec in raw_faults.items():
+                if site not in faultinject.SITES:
+                    raise ValueError(
+                        f"unknown fault site {site!r}"
+                    )
+                parsed_faults[site] = (
+                    None
+                    if spec is None
+                    else faultinject.FaultSpec.parse(spec)
+                )
             enforcement = changes.get("policy_enforcement")
             if enforcement is not None and enforcement not in (
                 option.DEFAULT_ENFORCEMENT,
@@ -816,16 +1035,28 @@ class Daemon:
                     option.Config.policy_enforcement = enforcement
                     applied += 1
                     verdict_affecting = True
+            # fault arming applies last and never triggers a regen
+            # sweep (it changes no compiled state)
+            fault_applied = 0
+            for site, spec in parsed_faults.items():
+                if spec is None:
+                    if faultinject.disarm(site):
+                        fault_applied += 1
+                else:
+                    faultinject.arm(site, spec)
+                    fault_applied += 1
         if applied:
             # enforcement changes alter verdicts → full sweep; pure
             # observability toggles (tracing, notifications) do not
             self.trigger_policy_updates(
                 "configuration changed", full=verdict_affecting
             )
+        applied += fault_applied
         return {
             "applied": applied,
             "policy_enforcement": option.Config.policy_enforcement,
             "options": dict(option.Config.opts),
+            "faults": faultinject.armed(),
         }
 
     def _option_changed(self, name: str, value: int) -> None:
@@ -899,7 +1130,10 @@ class Daemon:
         }
 
     def process_flows(
-        self, buf: bytes, batch_size: int = 1 << 20
+        self,
+        buf: bytes,
+        batch_size: int = 1 << 20,
+        collect_verdicts: bool = False,
     ) -> "object":
         """Datapath execution under the agent with monitor folding —
         the production path behind `cilium monitor`: replay the
@@ -911,22 +1145,44 @@ class Daemon:
         This is the Hubble-style audit form (identity pre-resolved in
         the record); it reads verdict bits back per batch, which is
         the monitoring cost the reference pays through its perf ring.
-        Returns ReplayStats."""
+
+        Resilience semantics (the graceful-degradation contract the
+        chaos storm asserts): a malformed record buffer raises a
+        clean ValueError (HTTP 400 at the API seam); device dispatch
+        runs under retry + the dispatch circuit breaker and fails
+        over per batch to the bit-identical host lattice fold —
+        the verdict stream completes, bit-identical, with
+        degraded_batches_total counting the failovers; bounded
+        admission (self.admission) sheds whole batches under the
+        canonical Overload drop reason instead of queueing
+        unboundedly.
+
+        With `collect_verdicts` the per-tuple verdict columns of
+        every evaluated batch land in stats.verdicts (allowed /
+        match_kind / proxy_port, stream order) — the chaos harness's
+        bit-identity probe.  Returns ReplayStats."""
         import time as _time
         from types import SimpleNamespace
 
         import numpy as np
 
-        from cilium_tpu.engine.verdict import evaluate_batch
         from cilium_tpu.monitor import verdicts_to_events
         from cilium_tpu.native import decode_flow_records
         from cilium_tpu.replay import (
             ReplayStats,
+            _ep_index_of,
             _tally,
             read_batches_from_rec,
         )
 
-        version, tables, index = self.endpoint_manager.published()
+        # tables AND the map-state snapshot they were compiled from,
+        # read under one lock: the degraded host fold evaluates
+        # against these exact states, so its verdicts stay
+        # bit-identical to the device path no matter what
+        # regenerations land mid-stream
+        version, tables, index, host_states = (
+            self.endpoint_manager.published_with_states()
+        )
         if tables is None:
             raise RuntimeError("no published tables")
         # records for endpoints this node doesn't own are dropped up
@@ -985,6 +1241,13 @@ class Daemon:
         for ep_id, idx in index.items():
             rev_lut[idx] = ep_id
         verdict_eps = self.verdict_notification_endpoints()
+        # CT occupancy check on the serving path (the watermark
+        # trigger must not wait for the 30 s GC controller tick)
+        self._ct_pressure_check()
+        # host-side endpoint-axis translation of the (filtered)
+        # record stream — the degraded host fold and the shed
+        # accounting read these slices without touching the device
+        ep_idx_host = _ep_index_of(rec, dict(index))
         spans.span("host_pack").end()
         stats = ReplayStats()
         stats.dropped = n_dropped
@@ -992,59 +1255,156 @@ class Daemon:
         # evaluation — they count toward the totals
         stats.total += n_prefiltered
         stats.denied += n_prefiltered
+        collected = [] if collect_verdicts else None
         t0 = _time.perf_counter()
+        offset = 0
         for batch, valid in read_batches_from_rec(
-            rec, batch_size, dict(index)
+            rec, batch_size, ep_index=ep_idx_host
         ):
+            start, end = offset, offset + valid
+            offset = end
             batch_t0 = _time.perf_counter()
-            spans.span("dispatch").start()
-            out = evaluate_batch(tables, batch)
-            _tally(out, valid, stats)
-            spans.span("dispatch").end()
-            stats.batches += 1
-            spans.span("event_fold").start()
-            ep_idx = np.asarray(batch.ep_index)[:valid]
-            v = SimpleNamespace(
-                allowed=np.asarray(out.allowed)[:valid],
-                match_kind=np.asarray(out.match_kind)[:valid],
-                proxy_port=np.asarray(out.proxy_port)[:valid],
-            )
-            opts = option.Config.opts
-            verdicts_to_events(
-                self.monitor,
-                v,
-                ep_ids=rev_lut[ep_idx],
-                identities=np.asarray(batch.identity)[:valid],
-                dports=np.asarray(batch.dport)[:valid],
-                protos=np.asarray(batch.proto)[:valid],
-                directions=np.asarray(batch.direction)[:valid],
-                verdict_eps=verdict_eps,
-                emit_drops=opts.is_enabled(option.DROP_NOTIFICATION),
-                emit_trace=(
-                    opts.is_enabled(option.TRACE_NOTIFICATION)
-                    and opts.level(option.MONITOR_AGGREGATION)
-                    == option.MONITOR_AGG_NONE
-                ),
-            )
-            spans.span("event_fold").end()
+            # bounded admission: a batch the gate refuses is SHED —
+            # counted under the canonical Overload drop reason, never
+            # queued (backpressure on the datapath is attribution,
+            # not buffering)
+            if not self.admission.reserve(valid):
+                stats.shed += valid
+                metrics.shed_flows_total.inc(value=valid)
+                from cilium_tpu.monitor.events import (
+                    DROP_OVERLOAD,
+                    drop_reason_name,
+                )
+
+                for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
+                    count = int(
+                        (rec["direction"][start:end] == dirv).sum()
+                    )
+                    if count:
+                        metrics.drop_count.inc(
+                            drop_reason_name(DROP_OVERLOAD), dname,
+                            value=count,
+                        )
+                continue
+            try:
+                spans.span("dispatch").start()
+
+                def _host_args(s=start, e=end):
+                    return (
+                        host_states,
+                        ep_idx_host[s:e],
+                        rec["identity"][s:e],
+                        rec["dport"][s:e],
+                        rec["proto"][s:e],
+                        rec["direction"][s:e],
+                        rec["is_fragment"][s:e].astype(bool),
+                    )
+
+                out, degraded = self._dispatch_or_degrade(
+                    tables, batch, _host_args, batch_size
+                )
+                _tally(out, valid, stats)
+                spans.span("dispatch").end(success=not degraded)
+                stats.batches += 1
+                if degraded:
+                    stats.degraded_batches += 1
+                spans.span("event_fold").start()
+                ep_idx = ep_idx_host[start:end]
+                v = SimpleNamespace(
+                    allowed=np.asarray(out.allowed)[:valid],
+                    match_kind=np.asarray(out.match_kind)[:valid],
+                    proxy_port=np.asarray(out.proxy_port)[:valid],
+                )
+                if collected is not None:
+                    collected.append(v)
+                opts = option.Config.opts
+                verdicts_to_events(
+                    self.monitor,
+                    v,
+                    ep_ids=rev_lut[ep_idx],
+                    identities=rec["identity"][start:end],
+                    dports=rec["dport"][start:end],
+                    protos=rec["proto"][start:end],
+                    directions=rec["direction"][start:end],
+                    verdict_eps=verdict_eps,
+                    emit_drops=opts.is_enabled(
+                        option.DROP_NOTIFICATION
+                    ),
+                    emit_trace=(
+                        opts.is_enabled(option.TRACE_NOTIFICATION)
+                        and opts.level(option.MONITOR_AGGREGATION)
+                        == option.MONITOR_AGG_NONE
+                    ),
+                )
+                spans.span("event_fold").end()
+            finally:
+                self.admission.release(valid)
             metrics.batch_duration.observe(
                 _time.perf_counter() - batch_t0
             )
         stats.seconds = _time.perf_counter() - t0
         stats.spans = spans
+        if collected is not None:
+            stats.verdicts = {
+                field: np.concatenate(
+                    [np.asarray(getattr(c, field)) for c in collected]
+                )
+                if collected
+                else np.zeros(0)
+                for field in ("allowed", "match_kind", "proxy_port")
+            }
         if stats.seconds > 0:
             metrics.verdict_throughput.set(
                 value=stats.total / stats.seconds
             )
         return stats
 
+    def health(self) -> Dict:
+        """Node health rollup (status.go's aggregate): degraded when
+        the dispatch breaker is not closed (serving from the host
+        path) or any controller is stuck failing past the threshold —
+        background-thread failures must surface, not rot silently."""
+        reasons = []
+        breaker_state = self.dispatch_breaker.state
+        if breaker_state != "closed":
+            reasons.append(
+                f"dispatch breaker {breaker_state}: device verdicts "
+                f"degraded to host path"
+            )
+        for name, s in self.controllers.statuses().items():
+            if (
+                s.consecutive_failures
+                >= self.controller_failure_threshold
+            ):
+                reasons.append(
+                    f"controller {name} failing "
+                    f"({s.consecutive_failures} consecutive: "
+                    f"{s.last_error})"
+                )
+        return {
+            "status": "degraded" if reasons else "ok",
+            "reasons": reasons,
+            "breaker": {
+                **self.dispatch_breaker.snapshot(),
+                "state": breaker_state,
+            },
+            "degraded_batches": self.degraded_batches,
+            "shed_flows": self.admission.shed_total,
+        }
+
     def status(self) -> Dict:
         version, tables, index = self.endpoint_manager.published()
         build_fail_count, build_fail_last = (
             self.endpoint_manager.build_failure_snapshot()
         )
+        health = self.health()
         return {
             "node": self.node_name,
+            "health": health["status"],
+            "health_reasons": health["reasons"],
+            "breaker": health["breaker"],
+            "degraded_batches": self.degraded_batches,
+            "shed_flows": self.admission.shed_total,
             "policy_revision": self.repo.get_revision(),
             "num_rules": self.repo.num_rules(),
             "num_endpoints": len(self.endpoint_manager.endpoints()),
@@ -1063,6 +1423,7 @@ class Daemon:
                 name: {
                     "success": s.success_count,
                     "failure": s.failure_count,
+                    "consecutive_failures": s.consecutive_failures,
                     "last_error": s.last_error,
                 }
                 for name, s in self.controllers.statuses().items()

@@ -215,13 +215,18 @@ class EndpointManager:
         Incremental: unchanged endpoints (by map_state_revision) reuse
         their cached rows; identity/slot tables rebuild only when the
         universe or key set changes (SURVEY §7 hard part 4)."""
-        eps = sorted(self.endpoints(), key=lambda e: e.id)
+        return self._fleet_compiler.compile(
+            self._capture_entries(), list(identity_cache)
+        )
+
+    def _capture_entries(self) -> list:
+        """Per-endpoint (id, realized state, cache token) snapshot.
+        (state, token) must be read atomically: sync_policy_map
+        publishes a fresh dict and bumps the revision under the same
+        lock; pairing a new dict with an old token would wrongly
+        reuse cached rows."""
         entries = []
-        for e in eps:
-            # (state, token) must be read atomically: sync_policy_map
-            # publishes a fresh dict and bumps the revision under the
-            # same lock; pairing a new dict with an old token would
-            # wrongly reuse cached rows.
+        for e in sorted(self.endpoints(), key=lambda ep: ep.id):
             with e.lock:
                 entries.append(
                     (
@@ -230,22 +235,47 @@ class EndpointManager:
                         (e.instance_nonce, e.map_state_revision),
                     )
                 )
-        return self._fleet_compiler.compile(entries, list(identity_cache))
+        return entries
 
     def publish_tables(self, identity_cache: IdentityCache) -> int:
         """Double-buffered flip: compile the new version, then swap the
         published pointer atomically (consumers holding the old tables
         keep a consistent snapshot — the ACK-gated versioned flip of
-        SURVEY §5)."""
-        tables, index = self.compile_fleet(identity_cache)
+        SURVEY §5).
+
+        The EXACT map states the tables were compiled from are
+        published alongside (endpoint-axis order): the daemon's
+        degraded host fold evaluates against these, so its verdicts
+        stay bit-identical to the device tables no matter what
+        regenerations land mid-stream."""
+        entries = self._capture_entries()
+        tables, index = self._fleet_compiler.compile(
+            entries, list(identity_cache)
+        )
+        states_by_id = {eid: state for eid, state, _ in entries}
+        states: list = [None] * (max(index.values(), default=-1) + 1)
+        for ep_id, idx in index.items():
+            states[idx] = states_by_id.get(ep_id)
         with self._lock:
             version = self._published[0] + 1
             self._published = (version, tables, index)
+            self._published_states = states
             return version
 
     def published(self) -> Tuple[int, Optional[PolicyTables], Dict[int, int]]:
         with self._lock:
             return self._published
+
+    def published_with_states(self):
+        """(version, tables, index, states) read under ONE lock —
+        `states` is the per-axis realized-map-state snapshot the
+        published tables were compiled from (the host fold's
+        substrate)."""
+        with self._lock:
+            version, tables, index = self._published
+            return version, tables, index, getattr(
+                self, "_published_states", []
+            )
 
     def build_failure_snapshot(self) -> Tuple[int, List[Tuple[int, str, str]]]:
         """(total count, last batch) read atomically — the two fields

@@ -753,13 +753,13 @@ def apply_ct_writeback_host(
             c_odaddr != c_daddr or c_odport != c_dport
         )
         if key not in ct.entries:
-            ct.create(
+            if ct.create_best_effort(
                 CTTuple(c_daddr, c_saddr, c_dport, c_sport, c_proto),
                 c_dir, now=now, rev_nat_index=c_rev, slave=c_slave,
                 orig_daddr=c_odaddr if dnat else 0,
                 orig_dport=c_odport if dnat else 0,
-            )
-            created_keys.append(key)
+            ):
+                created_keys.append(key)
         if dnat:
             # the service-scope stickiness entry (lb4_local)
             svc_key = CTTuple(
@@ -767,14 +767,14 @@ def apply_ct_writeback_host(
                 TUPLE_F_SERVICE,
             )
             if svc_key not in ct.entries:
-                ct.create(
+                if ct.create_best_effort(
                     CTTuple(
                         c_odaddr, c_saddr, c_odport, c_sport, c_proto
                     ),
                     CT_SERVICE, now=now, rev_nat_index=c_rev,
                     slave=c_slave,
-                )
-                created_keys.append(svc_key)
+                ):
+                    created_keys.append(svc_key)
     delete_cols = [daddr, saddr, dport, sport, proto, direction]
     for row in _unique_rows(delete_cols, delete):
         c_daddr, c_saddr, c_dport, c_sport, c_proto, c_dir = (

@@ -605,15 +605,15 @@ def apply_ct_writeback6(
         )
         if create[i]:
             if key not in ct.entries:
-                ct.create(
+                if ct.create_best_effort(
                     CTTuple(
                         d_int, s_int, int(fdport[i]), int(sport[i]),
                         int(proto[i]),
                     ),
                     dirv, now=now, rev_nat_index=int(rev[i]),
                     slave=int(slave[i]),
-                )
-                created += 1
+                ):
+                    created += 1
             if int(rev[i]) > 0:
                 o_int = _int_of_limbs(odaddr[i])
                 svc_key = CTTuple(
@@ -621,7 +621,7 @@ def apply_ct_writeback6(
                     int(proto[i]), TUPLE_F_SERVICE,
                 )
                 if svc_key not in ct.entries:
-                    ct.create(
+                    if ct.create_best_effort(
                         CTTuple(
                             o_int, s_int, int(odport[i]),
                             int(sport[i]), int(proto[i]),
@@ -629,8 +629,8 @@ def apply_ct_writeback6(
                         CT_SERVICE, now=now,
                         rev_nat_index=int(rev[i]),
                         slave=int(slave[i]),
-                    )
-                    created += 1
+                    ):
+                        created += 1
         elif delete[i]:
             if ct.entries.pop(key, None) is not None:
                 deleted += 1

@@ -104,6 +104,64 @@ def lb_select_host(ct, svc, saddr, daddr, sport, dport, proto):
     return slave, sticky
 
 
+def lattice_fold_host(
+    states,
+    ep_index,
+    identity,
+    dport,
+    proto,
+    direction,
+    is_fragment=None,
+    pad_to: int = 0,
+):
+    """Host-path fold of the bare verdict lattice — the degraded-mode
+    twin of engine.verdict.evaluate_batch: the ONE lattice reference
+    (engine.oracle.policy_can_access, counterless form) applied per
+    tuple over the per-endpoint realized map states, so verdicts are
+    bit-identical to the device kernel on any input.  The daemon
+    fails over to this when the dispatch circuit breaker opens.
+
+    `count_hits=False` on the oracle call: the device path this
+    substitutes for (evaluate_batch) carries no entry counters, and
+    degraded service must not leave different observable state than
+    healthy service.  Missing endpoints (None state) evaluate
+    against an empty map: default-deny, like an axis the compiler
+    padded.
+
+    Returns a Verdicts-shaped namespace (allowed u8, proxy_port i32,
+    match_kind u8), zero-padded to `pad_to` when given — the batch
+    shape the drain/event-fold slices with [:valid]."""
+    from types import SimpleNamespace
+
+    from cilium_tpu.engine.oracle import policy_can_access
+
+    b = len(ep_index)
+    n = max(b, pad_to)
+    allowed = np.zeros(n, np.uint8)
+    proxy = np.zeros(n, np.int32)
+    kind = np.zeros(n, np.uint8)
+    if is_fragment is None:
+        is_fragment = np.zeros(b, bool)
+    empty: Dict = {}
+    for i in range(b):
+        state = states[int(ep_index[i])]
+        v = policy_can_access(
+            empty if state is None else state,
+            int(identity[i]),
+            int(dport[i]),
+            int(proto[i]),
+            int(direction[i]),
+            bool(is_fragment[i]),
+            count_hits=False,
+        )
+        allowed[i] = 1 if v.allowed else 0
+        proxy[i] = v.proxy_port
+        kind[i] = v.match_kind
+    return SimpleNamespace(
+        allowed=allowed, proxy_port=proxy, match_kind=kind
+    )
+
+
 def composed_oracle(ctx, states, flows_dict, idx_list,
                     return_stages: bool = False):
     """Per-tuple host evaluation of the FULL fused pipeline.  `ctx`

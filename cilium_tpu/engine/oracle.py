@@ -53,28 +53,36 @@ def policy_can_access(
     direction: int,
     is_fragment: bool = False,
     pkt_len: int = 0,
+    count_hits: bool = True,
 ) -> Verdict:
-    """One tuple through the lattice (policy.h:46)."""
+    """One tuple through the lattice (policy.h:46).  With
+    `count_hits=False` the matched entry's packet/byte counters are
+    left untouched — the degraded-serving host fold substitutes for
+    the counterless device kernel (evaluate_batch) and must not
+    leave different observable state than healthy service."""
     if not is_fragment:
         entry = state.get(
             PolicyKey(identity, dport, proto, direction)
         )
         if entry is not None:
-            entry.packets += 1
-            entry.bytes += pkt_len
+            if count_hits:
+                entry.packets += 1
+                entry.bytes += pkt_len
             return Verdict(True, entry.proxy_port, MATCH_L4)
 
     entry = state.get(PolicyKey(identity, 0, 0, direction))
     if entry is not None:
-        entry.packets += 1
-        entry.bytes += pkt_len
+        if count_hits:
+            entry.packets += 1
+            entry.bytes += pkt_len
         return Verdict(True, 0, MATCH_L3)
 
     if not is_fragment:
         entry = state.get(PolicyKey(0, dport, proto, direction))
         if entry is not None:
-            entry.packets += 1
-            entry.bytes += pkt_len
+            if count_hits:
+                entry.packets += 1
+                entry.bytes += pkt_len
             return Verdict(True, entry.proxy_port, MATCH_L4_WILD)
 
     if is_fragment:

@@ -1,0 +1,332 @@
+"""Deterministic, seedable fault injection for the serving plane.
+
+The analog of the reference's chaos suites
+(/root/reference/test/runtime/chaos.go kills agents;
+test/k8sT/Chaos.go restarts nodes) brought INSIDE the process: named
+instrumentation sites in the hot path consult a process-global
+registry, and an armed site fails its callers on a deterministic
+schedule — so resilience machinery (retry, circuit breaker, host-path
+failover, kvstore redial) can be *proven* instead of assumed.
+
+Sites (dotted names; the instrumented seams):
+
+  engine.dispatch   device verdict dispatch (Daemon.process_flows,
+                    replay.replay) — the XLA launch that a wedged TPU
+                    runtime or dispatch failure takes down
+  native.decode     flow-record decode (native.decode_flow_records)
+  kvstore.conn      socket transport send path (kvstore RemoteBackend)
+                    — custom action: the call site severs its socket
+  ct.insert         host CT map insertion (CTMap.create)
+  proxy.upcall      proxy redirect realization (Proxy.
+                    update_endpoint_redirects)
+
+Schedules are deterministic and composable:
+
+  "raise"                    fail every call while armed
+  "raise:next=3"             fail the next 3 calls, then pass
+  "raise:every=5"            fail every 5th call
+  "raise:prob=0.1;seed=7"    seeded Bernoulli (reproducible)
+  "hang:delay=0.5"           sleep `delay` then pass (watchdog bait)
+  "corrupt:next=1"           data-mode: corrupt_bytes() mangles the
+                             payload (truncation) instead of raising
+
+Arming surfaces: `registry.arm()` in-process, the
+CILIUM_TPU_FAULTS env var at import ("site=spec,site=spec"),
+`PATCH /config {"faults": {...}}` via the daemon, the REST
+`/debug/faults` routes, and `cilium-tpu fault arm/disarm/list`.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from cilium_tpu.logging import get_logger
+
+log = get_logger("faultinject")
+
+# the instrumented seams; arming anything else is a caller error
+SITES = (
+    "engine.dispatch",
+    "native.decode",
+    "kvstore.conn",
+    "ct.insert",
+    "proxy.upcall",
+)
+
+MODES = ("raise", "hang", "corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """An armed site fired (mode=raise)."""
+
+    def __init__(self, site: str) -> None:
+        super().__init__(f"injected fault at {site}")
+        self.site = site
+
+
+@dataclass
+class FaultSpec:
+    """One site's failure schedule."""
+
+    mode: str = "raise"
+    next_n: int = 0  # fail the next N calls (0 = no next-N window)
+    every: int = 0  # fail every Kth call (0 = off)
+    prob: float = 0.0  # seeded Bernoulli (0 = off)
+    seed: int = 0
+    delay: float = 0.05  # hang duration (mode=hang)
+
+    def __post_init__(self) -> None:
+        if self.mode not in MODES:
+            raise ValueError(
+                f"unknown fault mode {self.mode!r} "
+                f"(one of {'/'.join(MODES)})"
+            )
+        if self.next_n < 0 or self.every < 0:
+            raise ValueError("next/every must be >= 0")
+        if not (0.0 <= self.prob <= 1.0):
+            raise ValueError("prob must be in [0, 1]")
+
+    @staticmethod
+    def parse(text: str) -> "FaultSpec":
+        """Spec string → FaultSpec: "mode[:k=v[;k=v...]]"."""
+        mode, _, params = str(text).strip().partition(":")
+        kw: Dict[str, object] = {}
+        if params:
+            for pair in params.split(";"):
+                if not pair:
+                    continue
+                key, sep, value = pair.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"fault spec param {pair!r} is not k=v"
+                    )
+                key = key.strip()
+                if key == "next":
+                    kw["next_n"] = int(value)
+                elif key in ("every", "seed"):
+                    kw[key] = int(value)
+                elif key in ("prob", "delay"):
+                    kw[key] = float(value)
+                else:
+                    raise ValueError(
+                        f"unknown fault spec param {key!r}"
+                    )
+        return FaultSpec(mode=mode or "raise", **kw)
+
+    def describe(self) -> Dict[str, object]:
+        return {
+            "mode": self.mode,
+            "next": self.next_n,
+            "every": self.every,
+            "prob": self.prob,
+            "seed": self.seed,
+            "delay": self.delay,
+        }
+
+
+@dataclass
+class _ArmedSite:
+    spec: FaultSpec
+    calls: int = 0
+    fired: int = 0
+    rng: random.Random = field(default_factory=random.Random)
+
+    def __post_init__(self) -> None:
+        self.rng = random.Random(self.spec.seed)
+
+    def decide(self) -> bool:
+        """One call through the schedule (caller holds the lock)."""
+        self.calls += 1
+        spec = self.spec
+        if spec.next_n:
+            if self.fired < spec.next_n:
+                self.fired += 1
+                return True
+            return False
+        if spec.every:
+            hit = self.calls % spec.every == 0
+        elif spec.prob:
+            hit = self.rng.random() < spec.prob
+        else:
+            hit = True
+        if hit:
+            self.fired += 1
+        return hit
+
+
+class FaultRegistry:
+    """Process-global armed-site table; all decisions under one lock
+    so schedules stay deterministic under concurrent callers."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._armed: Dict[str, _ArmedSite] = {}
+
+    def arm(self, site: str, spec) -> FaultSpec:
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} "
+                f"(one of {', '.join(SITES)})"
+            )
+        if isinstance(spec, str):
+            spec = FaultSpec.parse(spec)
+        with self._lock:
+            self._armed[site] = _ArmedSite(spec)
+        log.warning(
+            "fault site armed",
+            extra={"fields": {"site": site, **spec.describe()}},
+        )
+        return spec
+
+    def disarm(self, site: str) -> bool:
+        with self._lock:
+            return self._armed.pop(site, None) is not None
+
+    def disarm_all(self) -> int:
+        with self._lock:
+            n = len(self._armed)
+            self._armed.clear()
+        return n
+
+    def armed(self) -> Dict[str, Dict[str, object]]:
+        """Snapshot for the REST/CLI surface."""
+        with self._lock:
+            return {
+                site: {
+                    **armed.spec.describe(),
+                    "calls": armed.calls,
+                    "fired": armed.fired,
+                }
+                for site, armed in self._armed.items()
+            }
+
+    # -- the instrumentation verbs ------------------------------------------
+
+    # NOTE on the lock-free `if not self._armed` fast paths below:
+    # the instrumentation verbs sit on per-flow/per-frame hot paths
+    # (every CTMap.create, every kvstore frame, every dispatch), so
+    # the nothing-armed case — production — must not take the global
+    # lock.  Reading the dict's emptiness without the lock is a
+    # benign race: arming is advisory (a fault armed concurrently
+    # with a call may miss that one call), and dict reads are atomic
+    # under the GIL.
+
+    def should_fire(self, site: str) -> bool:
+        """Count one call; True when the schedule says fail.  For
+        call sites with a CUSTOM fault action (kvstore.conn severs
+        its socket) — fire() applies the generic raise/hang action."""
+        if not self._armed:
+            return False
+        with self._lock:
+            armed = self._armed.get(site)
+            if armed is None:
+                return False
+            hit = armed.decide()
+        if hit:
+            self._count(site, armed.spec.mode)
+        return hit
+
+    def fire(self, site: str) -> None:
+        """The generic instrumentation hook: no-op unless armed; an
+        armed raise-site raises FaultInjected, a hang-site sleeps
+        its delay (the dispatch watchdog's bait).  corrupt-mode
+        sites never act here — corrupt_bytes() is their verb."""
+        if not self._armed:
+            return
+        with self._lock:
+            armed = self._armed.get(site)
+            if armed is None or armed.spec.mode == "corrupt":
+                return
+            hit = armed.decide()
+            mode = armed.spec.mode
+            delay = armed.spec.delay
+        if not hit:
+            return
+        self._count(site, mode)
+        if mode == "hang":
+            time.sleep(delay)
+            return
+        raise FaultInjected(site)
+
+    def corrupt_bytes(self, site: str, buf: bytes) -> bytes:
+        """Data-plane verb: an armed corrupt-site mangles the buffer
+        (drops the trailing byte — a truncated record stream, the
+        classic partial-read corruption) on its schedule."""
+        if not self._armed:
+            return buf
+        with self._lock:
+            armed = self._armed.get(site)
+            if armed is None or armed.spec.mode != "corrupt":
+                return buf
+            hit = armed.decide()
+        if not hit or not buf:
+            return buf
+        self._count(site, "corrupt")
+        return buf[:-1]
+
+    @staticmethod
+    def _count(site: str, mode: str) -> None:
+        # late import: metrics must stay importable without this
+        # module and vice versa
+        from cilium_tpu.metrics import registry as metrics
+
+        metrics.fault_injections_total.inc(site, mode)
+        log.warning(
+            "injected fault fired",
+            extra={"fields": {"site": site, "mode": mode}},
+        )
+
+
+registry = FaultRegistry()
+
+# module-level conveniences (the instrumented call sites use these)
+arm = registry.arm
+disarm = registry.disarm
+disarm_all = registry.disarm_all
+armed = registry.armed
+fire = registry.fire
+should_fire = registry.should_fire
+corrupt_bytes = registry.corrupt_bytes
+
+
+class injected:
+    """Context manager for tests: arm on enter, disarm on exit."""
+
+    def __init__(self, site: str, spec="raise") -> None:
+        self.site = site
+        self.spec = spec
+
+    def __enter__(self) -> FaultSpec:
+        return arm(self.site, self.spec)
+
+    def __exit__(self, *exc) -> None:
+        disarm(self.site)
+
+
+FAULTS_ENV = "CILIUM_TPU_FAULTS"
+
+
+def _arm_from_env() -> None:
+    """CILIUM_TPU_FAULTS="site=spec,site=spec" armed at import —
+    chaos runs of unmodified entrypoints (agent, bench, tools)."""
+    raw = os.environ.get(FAULTS_ENV, "")
+    if not raw:
+        return
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        site, sep, spec = item.partition("=")
+        if not sep:
+            raise ValueError(
+                f"{FAULTS_ENV} entry {item!r} is not site=spec"
+            )
+        arm(site.strip(), spec)
+
+
+_arm_from_env()

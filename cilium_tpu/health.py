@@ -26,6 +26,13 @@ class ProbeResult:
     ingress_allowed: bool
     egress_allowed: bool
 
+    @property
+    def reachable(self) -> bool:
+        """The cilium-health liveness criterion: the probe must be
+        able to reach the endpoint (ingress) — egress policy denying
+        the health identity is an operator choice, not ill health."""
+        return self.ingress_allowed
+
 
 def probe_endpoints(manager, dport: int = 4240, proto: int = 6) -> List[ProbeResult]:
     """Evaluate health-identity tuples against every endpoint's

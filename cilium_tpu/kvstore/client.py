@@ -38,18 +38,44 @@ from cilium_tpu.kvstore.store import (
 )
 
 
+# how long a lock acquisition spins before giving up: a holder that
+# never releases (wedged peer whose lease hasn't expired yet) must
+# surface as a TimeoutError the caller can handle, not an eternal
+# busy-wait on a background thread (etcd.go's ctx-scoped Lock)
+DEFAULT_LOCK_TIMEOUT = 30.0
+
+
 class RemoteLock:
     """Distributed lock: lease-scoped CAS key on the server (mutual
     exclusion across processes; liveness by lease expiry on client
     death).  Context-manager like the in-process RLock."""
 
-    def __init__(self, backend: "RemoteBackend", path: str) -> None:
+    def __init__(
+        self,
+        backend: "RemoteBackend",
+        path: str,
+        timeout: Optional[float] = DEFAULT_LOCK_TIMEOUT,
+    ) -> None:
         self._backend = backend
         self._path = path
+        self._timeout = timeout
 
     def __enter__(self) -> "RemoteLock":
+        deadline = (
+            None
+            if self._timeout is None
+            else time.monotonic() + self._timeout
+        )
         backoff = 0.005
         while not self._backend._call("lock_acquire", key=self._path):
+            if (
+                deadline is not None
+                and time.monotonic() >= deadline
+            ):
+                raise TimeoutError(
+                    f"lock {self._path!r} not acquired within "
+                    f"{self._timeout}s"
+                )
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.25)
         return self
@@ -123,6 +149,18 @@ class RemoteBackend:
                 backoff = min(backoff * 2, 0.5)
 
     def _send(self, frame: dict) -> None:
+        # chaos seam: an armed kvstore.conn site severs THIS client's
+        # connection (the mid-watch socket drop the reconnect tests
+        # inject) — the read loop sees EOF, redials and re-establishes
+        # watches + lease keys exactly as for a real network fault
+        from cilium_tpu import faultinject
+
+        if faultinject.should_fire("kvstore.conn"):
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise ConnectionError("kvstore connection lost (injected)")
         data = (json.dumps(frame) + "\n").encode()
         self._sock.sendall(data)
 
@@ -312,8 +350,12 @@ class RemoteBackend:
                 del self._lease_keys[k]
         return self._call("delete_prefix", key=prefix)
 
-    def lock_path(self, path: str) -> RemoteLock:
-        return RemoteLock(self, path)
+    def lock_path(
+        self,
+        path: str,
+        timeout: Optional[float] = DEFAULT_LOCK_TIMEOUT,
+    ) -> RemoteLock:
+        return RemoteLock(self, path, timeout=timeout)
 
     def expire_session(self, session: str) -> int:
         return self._call("expire_session", session=session)

@@ -271,6 +271,40 @@ class Registry:
                 0.25, 0.5, 1.0, 2.5,
             ),
         )
+        # -- resilience plane (circuit breaker / degraded mode /
+        # overload shedding / fault injection) --------------------------
+        self.breaker_state = Gauge(
+            f"{ns}_circuit_breaker_state",
+            "Circuit breaker state (0=closed, 1=open, 2=half-open)",
+            ("breaker",),
+        )
+        self.dispatch_retries_total = Counter(
+            f"{ns}_dispatch_retries_total",
+            "Device dispatch attempts retried after a failure",
+        )
+        self.degraded_batches_total = Counter(
+            f"{ns}_degraded_batches_total",
+            "Batches served by the host-path fallback while the "
+            "device dispatch breaker was open or failing",
+        )
+        self.shed_flows_total = Counter(
+            f"{ns}_shed_flows_total",
+            "Flows shed by bounded admission under overload",
+        )
+        self.ct_occupancy = Gauge(
+            f"{ns}_ct_occupancy_ratio",
+            "Conntrack map occupancy as a fraction of capacity",
+        )
+        self.ct_emergency_gc_total = Counter(
+            f"{ns}_ct_emergency_gc_total",
+            "Emergency CT garbage collections triggered by the "
+            "occupancy high watermark",
+        )
+        self.fault_injections_total = Counter(
+            f"{ns}_fault_injections_total",
+            "Injected faults fired, by site and mode",
+            ("site", "mode"),
+        )
 
     def expose(self) -> str:
         lines: List[str] = []

@@ -57,7 +57,13 @@ DROP_REASONS: Dict[int, str] = {
     -160: "No tunnel/encapsulation endpoint",
     -161: "Failed to insert into proxymap",
     -162: "Policy denied (CIDR)",
+    # framework extension: bounded-admission overload shedding (the
+    # serving plane drops with attribution instead of queueing
+    # unboundedly; no bpf/lib/common.h analog in the snapshot ported)
+    -163: "Overload",
 }
+
+DROP_OVERLOAD = -163
 
 
 def drop_reason_name(code: int) -> str:

@@ -149,7 +149,23 @@ def _ptr(arr: np.ndarray, ctype):
 
 
 def decode_flow_records(buf: bytes):
-    """Binary flow records → SoA dict of arrays."""
+    """Binary flow records → SoA dict of arrays.
+
+    A buffer whose length is not a whole number of records is
+    REJECTED with ValueError: a truncated/corrupt stream silently
+    dropping its tail (native path) or crashing deep in numpy
+    (fallback path) would either hide data loss or take the daemon
+    down — the API server maps this to HTTP 400."""
+    from cilium_tpu import faultinject
+
+    faultinject.fire("native.decode")
+    buf = faultinject.corrupt_bytes("native.decode", buf)
+    if len(buf) % FLOW_RECORD_DTYPE.itemsize:
+        raise ValueError(
+            f"truncated flow record buffer: {len(buf)} bytes is not "
+            f"a multiple of the {FLOW_RECORD_DTYPE.itemsize}-byte "
+            f"record size"
+        )
     n = len(buf) // FLOW_RECORD_DTYPE.itemsize
     out = {
         "ep_id": np.empty(n, np.uint32),

@@ -546,6 +546,13 @@ class Proxy:
         """addNewRedirects/removeOldRedirects for one endpoint; returns
         the realized proxy-id → port map to feed back into the next
         computeDesiredPolicyMapState."""
+        # chaos seam: an armed proxy.upcall site fails redirect
+        # realization the way a dead envoy fails the xDS upcall — the
+        # regeneration's ACK gate rolls back, exactly the failure the
+        # rollback exists for
+        from cilium_tpu import faultinject
+
+        faultinject.fire("proxy.upcall")
         realized: Dict[str, int] = {}
         l4_policy = endpoint.desired_l4_policy
         wanted = set()

@@ -35,6 +35,17 @@ from cilium_tpu.native import decode_flow_records
 # to a cell), leaving 2× headroom below the u32 wrap
 _COUNTER_FOLD_MAX_INCR = 1 << 31
 
+def _guarded_dispatch(fn, *args, donated=False):
+    """One jitted dispatch under the shared guard
+    (resilience.guarded_dispatch): engine.dispatch fault seam +
+    bounded retry; the Daemon's breaker + host-path failover handles
+    anything persistent.  `donated=True` for the accumulator-carrying
+    steps whose jit donates buffers — those retry only the
+    pre-launch injected fault (see guarded_dispatch)."""
+    from cilium_tpu.resilience import guarded_dispatch
+
+    return guarded_dispatch(fn, *args, donated=donated)
+
 # churn-mode intent compaction capacity: create/delete intents per
 # batch round that travel device→host (the transport is
 # latency/bandwidth constrained, so only deduped flagged rows move;
@@ -381,6 +392,15 @@ class ReplayStats:
     # filtered by Daemon.process_flows) — totals must account for
     # every input record
     dropped: int = 0
+    # flows shed by bounded admission (Daemon.process_flows overload
+    # shedding; like `dropped`, NOT part of `total`)
+    shed: int = 0
+    # batches served by the host-path fallback while the dispatch
+    # circuit breaker was open/failing (verdicts bit-identical)
+    degraded_batches: int = 0
+    # per-tuple verdict columns in stream order (process_flows
+    # collect_verdicts=True): {"allowed", "match_kind", "proxy_port"}
+    verdicts: object = None
     # per-phase wall-time accumulators (SpanStats: host_pack /
     # dispatch / drain), populated by replay()'s instrumented loop
     spans: object = None
@@ -438,12 +458,16 @@ def read_batches_from_rec(
     rec: Dict[str, np.ndarray],
     batch_size: int,
     ep_map: Optional[Dict[int, int]] = None,
+    ep_index: Optional[np.ndarray] = None,
 ) -> Iterator[Tuple[TupleBatch, int]]:
     """read_batches over an ALREADY-decoded record SoA — callers that
     pre-filter records (Daemon.process_flows) avoid a second decode
-    pass over the buffer."""
+    pass over the buffer.  `ep_index` supplies an already-computed
+    endpoint-axis translation (callers that keep one host-side for
+    event folding skip the second O(n) LUT pass)."""
     n = len(rec["ep_id"])
-    ep_index = _ep_index_of(rec, ep_map)
+    if ep_index is None:
+        ep_index = _ep_index_of(rec, ep_map)
     for start, end in _batch_slices(n, batch_size):
         p = lambda a, fill=0: _padded(a, start, end, batch_size, fill)
         yield (
@@ -671,8 +695,9 @@ def replay(
                 )
                 spans.span("dispatch").start()
                 if first_pass and accumulate_counters:
-                    header_d, intents_d, acc = churn_step_accum(
-                        tables, flows, valid, acc
+                    header_d, intents_d, acc = _guarded_dispatch(
+                        churn_step_accum, tables, flows, valid, acc,
+                        donated=True,
                     )
                     batches_since_fold += 1
                     if batches_since_fold >= fold_every:
@@ -680,8 +705,8 @@ def replay(
                 else:
                     # convergence passes skip counter accumulation —
                     # the first pass already counted this batch
-                    header_d, intents_d = churn_step(
-                        tables, flows, valid
+                    header_d, intents_d = _guarded_dispatch(
+                        churn_step, tables, flows, valid
                     )
                 spans.span("dispatch").end()
                 spans.span("drain").start()
@@ -700,11 +725,16 @@ def replay(
         spans.span("dispatch").start()
         if accumulate_counters:
             if telem_dev is not None and valid == batch_size:
-                out, acc, telem_dev = datapath_step_accum_telem(
-                    tables, flows, acc, telem_dev
+                out, acc, telem_dev = _guarded_dispatch(
+                    datapath_step_accum_telem,
+                    tables, flows, acc, telem_dev,
+                    donated=True,
                 )
             else:
-                out, acc = datapath_step_accum(tables, flows, acc)
+                out, acc = _guarded_dispatch(
+                    datapath_step_accum, tables, flows, acc,
+                    donated=True,
+                )
                 if telem_total is not None:
                     # partial tail batch: the device accumulator
                     # would count the padding rows, so this batch's
@@ -714,7 +744,7 @@ def replay(
             if batches_since_fold >= fold_every:
                 _fold_counters()
         else:
-            out = datapath_step(tables, flows)
+            out = _guarded_dispatch(datapath_step, tables, flows)
             if telem_total is not None:
                 fold_direction = flows.direction
         spans.span("dispatch").end()
@@ -898,7 +928,7 @@ def replay_lattice(
     pending = []  # pipelined dispatch, bounded depth
     t0 = time.perf_counter()
     for batch, valid in read_batches(buf, batch_size, ep_map):
-        out = step(tables, batch)
+        out = _guarded_dispatch(step, tables, batch)
         pending.append((out, valid))
         stats.batches += 1
         if len(pending) >= 4:

@@ -1,0 +1,432 @@
+"""Resilience primitives for the verdict serving plane.
+
+The survivability layer the reference spreads across pkg/controller
+(exponential error backoff), pkg/health (degraded-mode reporting) and
+the agent's restart story, distilled into three host-side primitives
+the hot path composes:
+
+  * retry_call — bounded retries with exponential backoff + jitter
+    and a hard deadline (controller.go:175's backoff, per-call);
+  * CircuitBreaker — closed/open/half-open over any dependency (the
+    TPU dispatch, here): trip after consecutive failures, shed load
+    while open, probe with limited half-open trials, close on
+    success.  Transitions invoke a listener so the daemon can flip
+    /healthz to degraded, publish AgentNotify monitor events and
+    set the breaker_state gauge;
+  * DispatchWatchdog — run a callable under a wall-clock deadline on
+    a worker thread (a wedged XLA dispatch cannot be cancelled; the
+    watchdog abandons it and fails the call so the breaker can open
+    instead of the flow stream hanging forever);
+  * AdmissionGate — bounded in-flight admission for overload
+    shedding (the perf ring's finite depth: past the watermark the
+    datapath drops with a reason instead of queueing unboundedly).
+
+Everything is deterministic under a seed (jittered backoff included)
+so chaos-storm runs reproduce.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from cilium_tpu.logging import get_logger
+
+log = get_logger("resilience")
+
+
+class DeadlineExceeded(TimeoutError):
+    """A watchdogged call outlived its deadline."""
+
+
+class BreakerOpen(RuntimeError):
+    """Fast-fail: the circuit is open; the dependency is shed."""
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 2,
+    base_delay: float = 0.005,
+    max_delay: float = 0.5,
+    deadline: Optional[float] = None,
+    jitter: float = 0.5,
+    seed: Optional[int] = None,
+    retry_on: Tuple[type, ...] = (Exception,),
+    on_retry: Optional[Callable] = None,
+    **kwargs,
+):
+    """Call `fn` with up to `retries` retries: exponential backoff
+    (base * 2^attempt, capped at max_delay) with multiplicative
+    jitter in [1-jitter, 1+jitter] — seeded when `seed` is given, so
+    schedules are reproducible.  `deadline` bounds the WHOLE call in
+    seconds: no retry starts past it, and the last failure re-raises
+    (controller.go's backoff loop with pkg/endpoint's generation
+    timeout semantics).  `on_retry(attempt, exc)` observes each
+    retry — the daemon counts dispatch_retries_total through it."""
+    rng = random.Random(seed) if seed is not None else random
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            attempt += 1
+            if attempt > retries:
+                raise
+            if (
+                deadline is not None
+                and time.monotonic() - t0 >= deadline
+            ):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            delay = min(base_delay * (2 ** (attempt - 1)), max_delay)
+            if jitter:
+                delay *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+            if deadline is not None:
+                delay = min(
+                    delay,
+                    max(0.0, deadline - (time.monotonic() - t0)),
+                )
+            if delay > 0:
+                time.sleep(delay)
+
+
+# breaker states (numeric codes are the breaker_state gauge values)
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+STATE_CODES: Dict[str, int] = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Closed → (failure_threshold consecutive failures) → open →
+    (recovery_timeout) → half-open → (success_threshold probe
+    successes) → closed; a half-open probe failure re-opens.
+
+    allow() is the admission question ("may I try the dependency?");
+    callers pair it with record_success()/record_failure(), or use
+    call() which wraps all three and raises BreakerOpen when shed.
+    While half-open at most `half_open_max` probes are in flight at
+    once — the rest are shed as if open (xDS's probe-one semantics).
+
+    `on_transition(name, old, new, reason)` runs OUTSIDE the lock on
+    every state change.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 3,
+        recovery_timeout: float = 1.0,
+        success_threshold: int = 1,
+        half_open_max: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable] = None,
+    ) -> None:
+        self.name = name
+        self.failure_threshold = max(1, failure_threshold)
+        self.recovery_timeout = recovery_timeout
+        self.success_threshold = max(1, success_threshold)
+        self.half_open_max = max(1, half_open_max)
+        self._clock = clock
+        self.on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._half_open_successes = 0
+        self._half_open_inflight = 0
+        self._opened_at = 0.0
+        self.opened_total = 0
+
+    # -- state machine --------------------------------------------------------
+
+    def _transition(self, new: str, reason: str):
+        """Caller holds the lock; returns the listener thunk to run
+        outside it (a listener that logs/publishes must never hold
+        the breaker lock)."""
+        old, self._state = self._state, new
+        if new == OPEN:
+            self._opened_at = self._clock()
+            self.opened_total += 1
+        if new == HALF_OPEN:
+            self._half_open_successes = 0
+            self._half_open_inflight = 0
+        if new == CLOSED:
+            self._consecutive_failures = 0
+        listener = self.on_transition
+        if listener is None or old == new:
+            return None
+        return lambda: listener(self.name, old, new, reason)
+
+    def allow(self) -> bool:
+        notify = None
+        with self._lock:
+            if self._state == OPEN:
+                if (
+                    self._clock() - self._opened_at
+                    >= self.recovery_timeout
+                ):
+                    notify = self._transition(
+                        HALF_OPEN, "recovery timeout elapsed"
+                    )
+                else:
+                    ok = False
+            if self._state == HALF_OPEN:
+                ok = self._half_open_inflight < self.half_open_max
+                if ok:
+                    self._half_open_inflight += 1
+            elif self._state == CLOSED:
+                ok = True
+        if notify is not None:
+            notify()
+        return ok
+
+    def record_success(self) -> None:
+        notify = None
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._half_open_inflight = max(
+                    0, self._half_open_inflight - 1
+                )
+                self._half_open_successes += 1
+                if (
+                    self._half_open_successes
+                    >= self.success_threshold
+                ):
+                    notify = self._transition(
+                        CLOSED, "half-open probes succeeded"
+                    )
+        if notify is not None:
+            notify()
+
+    def record_failure(self, reason: str = "") -> None:
+        notify = None
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                self._half_open_inflight = max(
+                    0, self._half_open_inflight - 1
+                )
+                notify = self._transition(
+                    OPEN, reason or "half-open probe failed"
+                )
+            elif (
+                self._state == CLOSED
+                and self._consecutive_failures
+                >= self.failure_threshold
+            ):
+                notify = self._transition(
+                    OPEN,
+                    reason
+                    or f"{self._consecutive_failures} consecutive "
+                    f"failures",
+                )
+        if notify is not None:
+            notify()
+
+    def call(self, fn: Callable, *args, **kwargs):
+        if not self.allow():
+            raise BreakerOpen(f"circuit {self.name!r} is open")
+        try:
+            got = fn(*args, **kwargs)
+        except Exception as exc:
+            self.record_failure(str(exc))
+            raise
+        self.record_success()
+        return got
+
+    # -- introspection --------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # surface the would-be half-open state so status reads
+            # don't lag behind the next allow()
+            if (
+                self._state == OPEN
+                and self._clock() - self._opened_at
+                >= self.recovery_timeout
+            ):
+                return HALF_OPEN
+            return self._state
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "opened_total": self.opened_total,
+                "failure_threshold": self.failure_threshold,
+                "recovery_timeout": self.recovery_timeout,
+            }
+
+    def reset(self) -> None:
+        """Force-close (tests / operator action)."""
+        notify = None
+        with self._lock:
+            if self._state != CLOSED:
+                notify = self._transition(CLOSED, "reset")
+            self._consecutive_failures = 0
+        if notify is not None:
+            notify()
+
+
+class DispatchWatchdog:
+    """Per-batch dispatch deadline: run `fn` on a persistent worker
+    thread and give up after `timeout` seconds.  The abandoned
+    dispatch keeps running on its (daemon) worker — XLA launches
+    cannot be cancelled — but the CALLER gets a DeadlineExceeded it
+    can feed the breaker, instead of the whole flow stream wedging
+    with the runtime.
+
+    Workers are pooled and EXCLUSIVE: each run() takes (or spawns) an
+    idle long-lived worker, so the deadline clocks only this call's
+    execution — never queue-wait behind a concurrent caller — and an
+    abandoned worker can hold nothing but its own wedged call.  A
+    healthy worker returns to the pool (no thread-per-batch churn); a
+    worker that blew its deadline drains its stuck call, sees the
+    stop sentinel and exits, while the caller's retry gets a fresh
+    one."""
+
+    def __init__(self, timeout: float = 30.0) -> None:
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._idle: list = []  # stack of idle workers' queues
+
+    @staticmethod
+    def _work_loop(q) -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, args, out, done = item
+            try:
+                out.append(("ok", fn(*args)))
+            except BaseException as exc:  # noqa: BLE001
+                out.append(("err", exc))
+            done.set()
+
+    def run(self, fn: Callable, *args, timeout: Optional[float] = None):
+        import queue as _queue
+
+        timeout = self.timeout if timeout is None else timeout
+        if timeout is None or timeout <= 0:
+            return fn(*args)
+        with self._lock:
+            q = self._idle.pop() if self._idle else None
+        if q is None:
+            q = _queue.Queue()
+            threading.Thread(
+                target=self._work_loop,
+                args=(q,),
+                name="dispatch-watchdog",
+                daemon=True,
+            ).start()
+        out: list = []
+        done = threading.Event()
+        q.put((fn, args, out, done))
+        if not done.wait(timeout):
+            # abandon THIS worker only; it exits once the wedged
+            # call drains
+            q.put(None)
+            log.warning(
+                "dispatch exceeded watchdog deadline; abandoning "
+                "worker",
+                extra={"fields": {"timeout_s": timeout}},
+            )
+            raise DeadlineExceeded(
+                f"dispatch exceeded {timeout}s watchdog deadline"
+            )
+        with self._lock:
+            self._idle.append(q)
+        status, value = out[0]
+        if status == "err":
+            raise value
+        return value
+
+
+def guarded_dispatch(
+    fn: Callable,
+    *args,
+    retries: int = 2,
+    base_delay: float = 0.002,
+    watchdog: Optional["DispatchWatchdog"] = None,
+    site: str = "engine.dispatch",
+    seed: int = 0,
+    donated: bool = False,
+):
+    """THE device-dispatch guard, shared by Daemon.process_flows and
+    replay(): the fault seam fires BEFORE the launch (an injected
+    failure never burns a donated buffer), the optional watchdog
+    bounds the launch, and bounded seeded-backoff retry absorbs
+    transients — each retry counted in dispatch_retries_total.
+    Anything persistent propagates for the caller's breaker/failover
+    to handle.
+
+    `donated=True` marks call sites whose jit donates input buffers
+    (the accumulator-carrying steps): a REAL mid-launch failure has
+    already invalidated the donated argument, so only the pre-launch
+    injected fault is retryable there — anything else re-raises
+    immediately instead of masking the original error with an
+    invalid-buffer retry."""
+    from cilium_tpu import faultinject
+    from cilium_tpu.metrics import registry as metrics
+
+    def _once():
+        faultinject.fire(site)
+        if watchdog is not None:
+            return watchdog.run(fn, *args)
+        return fn(*args)
+
+    return retry_call(
+        _once,
+        retries=retries,
+        base_delay=base_delay,
+        seed=seed,
+        retry_on=(
+            (faultinject.FaultInjected,) if donated else (Exception,)
+        ),
+        on_retry=lambda attempt, exc: (
+            metrics.dispatch_retries_total.inc()
+        ),
+    )
+
+
+class AdmissionGate:
+    """Bounded in-flight admission (flows, not batches): reserve()
+    admits `n` units when the outstanding total stays within the
+    limit, else refuses — the caller sheds that batch under the
+    canonical Overload drop reason.  Never blocks: backpressure on
+    the datapath means dropping with attribution, not queueing
+    (the perf ring overwrites, it does not wait)."""
+
+    def __init__(self, limit: Optional[int] = None) -> None:
+        self.limit = limit  # None = unbounded
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.shed_total = 0
+
+    def reserve(self, n: int) -> bool:
+        with self._lock:
+            if (
+                self.limit is not None
+                and self._inflight + n > self.limit
+            ):
+                self.shed_total += n
+                return False
+            self._inflight += n
+            return True
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - n)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight

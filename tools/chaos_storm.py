@@ -1,0 +1,244 @@
+"""Chaos storm: replay a tuple stream through the daemon's serving
+plane under an injected fault schedule and prove graceful degradation.
+
+The runtime chaos suite of the reference
+(/root/reference/test/runtime/chaos.go restarts the agent and asserts
+endpoints recover) applied to the TPU serving plane: instead of
+killing the process, the storm arms the `engine.dispatch` fault site
+so consecutive device dispatches FAIL mid-replay, and asserts the
+graceful-degradation contract end to end:
+
+  1. the daemon completes the stream with ZERO exceptions — retries
+     absorb transients, the circuit breaker opens on persistence, and
+     open-state batches are served by the bit-identical host lattice
+     fold (engine.hostpath.lattice_fold_host);
+  2. the verdict stream is BIT-IDENTICAL to the fault-free run
+     (allowed / match_kind / proxy_port, every tuple, stream order);
+  3. degraded_batches_total counted the failovers (> 0);
+  4. after the fault schedule ends, half-open probes restore TPU
+     service and the breaker returns to CLOSED;
+  5. the monitor bus carried AgentNotify breaker-transition events
+     and /metrics exposes breaker_state / degraded_batches_total.
+
+Also storms the satellite seams: overload shedding under a bounded
+admission gate (shed flows counted under the canonical Overload drop
+reason) and a corrupt record buffer rejected with a clean ValueError.
+
+Fast single-cycle coverage runs in tier-1
+(tests/test_chaos_storm.py); THIS standalone form is the full storm —
+bigger stream, multiple breaker cycles:  python tools/chaos_storm.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+
+def build_daemon():
+    """Two-endpoint world with an L4 + L3 policy (the test suite's
+    canonical replay world, built self-contained)."""
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.labels import Label, LabelArray, Labels
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+
+    def k8s_labels(**kv):
+        return Labels(
+            {k: Label(k, v, "k8s") for k, v in kv.items()}
+        )
+
+    def es(**kv):
+        return EndpointSelector(
+            match_labels={f"k8s.{k}": v for k, v in kv.items()}
+        )
+
+    d = Daemon()
+    d.create_endpoint(
+        10, k8s_labels(app="server"), ipv4="10.0.0.10", name="server-0"
+    )
+    client = d.create_endpoint(
+        11, k8s_labels(app="client"), ipv4="10.0.0.11", name="client-0"
+    )
+    d.policy_add(
+        [
+            Rule(
+                endpoint_selector=es(app="server"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[es(app="client")],
+                        to_ports=[
+                            PortRule(
+                                ports=[
+                                    PortProtocol(
+                                        port="80", protocol="TCP"
+                                    )
+                                ]
+                            )
+                        ],
+                    )
+                ],
+                labels=LabelArray.parse("storm-rule"),
+            )
+        ]
+    )
+    d.policy_trigger.close(wait=True)
+    return d, client
+
+
+def make_stream(rng, n, client_id):
+    from cilium_tpu.native import encode_flow_records
+
+    return encode_flow_records(
+        ep_id=np.full(n, 10, np.uint32),
+        identity=rng.choice(
+            [client_id, 999999], size=n
+        ).astype(np.uint32),
+        saddr=np.zeros(n, np.uint32),
+        daddr=np.zeros(n, np.uint32),
+        sport=np.full(n, 40000, np.uint16),
+        dport=rng.choice([80, 443], size=n).astype(np.uint16),
+        proto=np.full(n, 6, np.uint8),
+        direction=np.zeros(n, np.uint8),
+        is_fragment=np.zeros(n, np.uint8),
+    )
+
+
+def assert_verdicts_identical(want, got) -> None:
+    for field in ("allowed", "match_kind", "proxy_port"):
+        np.testing.assert_array_equal(
+            np.asarray(want.verdicts[field]),
+            np.asarray(got.verdicts[field]),
+            err_msg=f"verdict stream diverged in {field}",
+        )
+
+
+def run_storm(
+    n_flows: int = 4096,
+    batch_size: int = 128,
+    fail_next: int = 10,
+    seed: int = 7,
+    verbose: bool = True,
+) -> dict:
+    """One full storm cycle; returns a result dict (the asserts ARE
+    the test — reaching the return means the invariants held)."""
+    from cilium_tpu import faultinject
+    from cilium_tpu.metrics import registry as metrics
+    from cilium_tpu.monitor.events import AgentNotify
+
+    rng = np.random.default_rng(seed)
+    d, client = build_daemon()
+    buf = make_stream(rng, n_flows, client.security_identity.id)
+
+    # ---- fault-free reference run --------------------------------------
+    want = d.process_flows(
+        buf, batch_size=batch_size, collect_verdicts=True
+    )
+    assert want.degraded_batches == 0
+
+    # ---- the storm -----------------------------------------------------
+    q = d.monitor.subscribe_queue()
+    d.dispatch_retries = 0  # 1 schedule tick per batch: deterministic
+    d.dispatch_breaker.recovery_timeout = 0.05
+    degraded_before = metrics.degraded_batches_total.get()
+    faultinject.arm("engine.dispatch", f"raise:next={fail_next}")
+    try:
+        got = d.process_flows(
+            buf, batch_size=batch_size, collect_verdicts=True
+        )
+    finally:
+        faultinject.disarm("engine.dispatch")
+
+    # 1+2: stream completed, verdicts bit-identical
+    assert got.total == want.total
+    assert_verdicts_identical(want, got)
+    # 3: host-path failover counted
+    assert got.degraded_batches > 0
+    assert metrics.degraded_batches_total.get() > degraded_before
+    # 5: transitions observable (monitor events + gauge exposed)
+    transitions = [
+        e for e in q
+        if isinstance(e, AgentNotify) and e.kind == "circuit-breaker"
+    ]
+    assert any("-> open" in e.text for e in transitions), transitions
+    assert "cilium_circuit_breaker_state" in metrics.expose()
+
+    # 4: the schedule is spent — traffic restores TPU service and the
+    # breaker closes (half-open probe succeeds)
+    deadline = time.monotonic() + 5.0
+    while (
+        d.dispatch_breaker.state != "closed"
+        and time.monotonic() < deadline
+    ):
+        time.sleep(d.dispatch_breaker.recovery_timeout)
+        after = d.process_flows(
+            buf, batch_size=batch_size, collect_verdicts=True
+        )
+        if d.dispatch_breaker.state == "closed":
+            assert_verdicts_identical(want, after)
+    assert d.dispatch_breaker.state == "closed", (
+        "breaker failed to close after the fault schedule ended"
+    )
+    assert d.status()["health"] == "ok"
+
+    # ---- satellite storms ----------------------------------------------
+    # overload shedding: a gate below the batch size sheds every batch
+    # under the canonical Overload reason
+    shed_before = metrics.shed_flows_total.get()
+    d.admission.limit = batch_size // 2
+    shed = d.process_flows(buf, batch_size=batch_size)
+    d.admission.limit = None
+    assert shed.shed == n_flows and shed.total == 0
+    assert metrics.shed_flows_total.get() - shed_before == n_flows
+    assert d.status()["shed_flows"] >= n_flows
+
+    # corrupt input: clean ValueError, daemon still serving
+    try:
+        d.process_flows(buf[:-3], batch_size=batch_size)
+        raise AssertionError("truncated buffer not rejected")
+    except ValueError:
+        pass
+    final = d.process_flows(
+        buf, batch_size=batch_size, collect_verdicts=True
+    )
+    assert_verdicts_identical(want, final)
+
+    result = {
+        "flows": n_flows,
+        "batches": int(want.batches),
+        "degraded_batches": int(got.degraded_batches),
+        "breaker_opened_total": d.dispatch_breaker.opened_total,
+        "breaker_state": d.dispatch_breaker.state,
+        "shed_flows": int(shed.shed),
+        "transitions": [e.text for e in transitions],
+    }
+    if verbose:
+        print("chaos storm: all invariants held")
+        for k, v in result.items():
+            print(f"  {k}: {v}")
+    return result
+
+
+def main() -> int:
+    run_storm()
+    # a second, harsher cycle: schedule longer than the stream's
+    # batch count — the whole tail serves from the host path
+    run_storm(n_flows=2048, batch_size=256, fail_next=64, seed=11)
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
