@@ -166,6 +166,22 @@ class APIClient:
     def monitor_close(self, sid: str):
         return self._request("DELETE", f"/monitor/{sid}")
 
+    def flows_get(self, params: dict = None):
+        """GET /flows with Hubble-like filter params; the HTTP socket
+        budget outlives the server's (clamped) follow long-poll
+        window, like monitor_poll — the params dict carries the
+        long-poll `timeout` itself."""
+        from urllib.parse import urlencode
+
+        params = dict(params or {})
+        budget = min(float(params.get("timeout", 5.0)), 30.0) + 15.0
+        qs = urlencode(params)
+        path = f"/flows?{qs}" if qs else "/flows"
+        return self._request("GET", path, timeout=budget)
+
+    def flows_summary(self, top: int = 10):
+        return self._request("GET", f"/flows/summary?top={top}")
+
     def metrics_dump(self):
         return self._request("GET", "/metrics")
 

@@ -33,6 +33,10 @@ Routes (the api/v1 subset this framework's daemon implements):
   POST   /monitor            open a monitor session (persistent queue)
   GET    /monitor/{sid}      long-poll events (?timeout=s&max=n)
   DELETE /monitor/{sid}      close the session
+  GET    /flows              filtered flow records (Hubble observe;
+                             ?follow=1&since-seq=N long-polls)
+  GET    /flows/summary      flow aggregations (top drop reasons,
+                             denied identity pairs, per-chip counts)
 """
 
 from __future__ import annotations
@@ -563,6 +567,75 @@ class DaemonAPI:
             "seconds": stats.seconds,
         }
 
+    # -- flow observability (the Hubble observe surface over REST) -----------
+
+    FLOW_FOLLOW_TIMEOUT_MAX = 30.0
+
+    def flows_get(self, params: dict) -> dict:
+        """GET /flows: filtered read of the flow-record ring.
+
+        Hubble-like filter params: verdict=FORWARDED|DROPPED,
+        drop-reason=<canonical name>, identity=<id> (either side),
+        ep=<endpoint id>, port=<dport>, proto=tcp|udp|<n>,
+        direction=ingress|egress, since=<unix s | 30s/5m/1h>,
+        chip=<ordinal>.  Pagination: last=N (newest N matches,
+        default 1024).  Follow mode: follow=1&since-seq=<cursor>
+        long-polls (timeout=s, clamped) until a MATCHING record newer
+        than the cursor lands — poll again with the reply's
+        `last_seq` as the next cursor, the MonitorBus long-poll
+        contract over flows."""
+        from cilium_tpu.flow import FlowFilter
+
+        params = dict(params)
+        follow = str(params.pop("follow", "")).lower() in (
+            "1", "true", "yes", "on",
+        )
+        last_raw = params.pop("last", None)
+        last = int(last_raw) if last_raw is not None else 1024
+        timeout = min(
+            float(params.pop("timeout", 5.0)),
+            self.FLOW_FOLLOW_TIMEOUT_MAX,
+        )
+        since_seq_raw = params.pop("since-seq", None)
+        since_seq = (
+            int(since_seq_raw) if since_seq_raw is not None else None
+        )
+        flt = FlowFilter.from_params(params)
+        store = self.daemon.flow_store
+        if follow:
+            cursor = (
+                since_seq if since_seq is not None else store.last_seq
+            )
+            records = store.wait_for_flows(cursor, timeout, flt)
+            if last:
+                # follow keeps the OLDEST N of a burst: the reply's
+                # last_seq then resumes exactly after the delivered
+                # tail, so the trimmed remainder arrives on the next
+                # poll instead of being skipped forever (one-shot
+                # mode trims newest — there is no cursor to protect)
+                records = records[:last]
+            # a timed-out poll reports the UNCHANGED cursor: records
+            # landing between the timeout and this reply must be
+            # seen by the client's next poll, not skipped
+            last_seq = records[-1].seq if records else cursor
+        else:
+            records = store.query(flt, last=last, after_seq=since_seq)
+            last_seq = (
+                records[-1].seq if records else store.last_seq
+            )
+        return {
+            "flows": [r.to_dict() for r in records],
+            "matched": len(records),
+            "last_seq": last_seq,
+            "captured_total": store.captured_total,
+            "evicted": store.evicted,
+        }
+
+    def flows_summary(self, top: int = 10) -> dict:
+        """GET /flows/summary: ring aggregations — top drop reasons,
+        top denied identity pairs, per-chip counts + imbalance."""
+        return self.daemon.flow_store.summary(top=top)
+
     def metrics_dump(self) -> dict:
         return {"text": metrics.expose()}
 
@@ -651,6 +724,28 @@ class _Handler(BaseHTTPRequestHandler):
                         "text/plain; version=0.0.4; charset=utf-8"
                     ),
                 )
+            if path == "/flows":
+                from urllib.parse import parse_qs
+
+                qs = parse_qs(self.path.partition("?")[2])
+                params = {k: v[0] for k, v in qs.items()}
+                try:
+                    return self._reply(200, api.flows_get(params))
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+            if path == "/flows/summary":
+                from urllib.parse import parse_qs
+
+                qs = parse_qs(self.path.partition("?")[2])
+                try:
+                    top = int(qs.get("top", ["10"])[0])
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                return self._reply(200, api.flows_summary(top=top))
             if path == "/debug/profile":
                 return self._reply(200, api.debug_profile())
             if path == "/debug/faults":

@@ -106,6 +106,19 @@ def collect(daemon, out_dir: str) -> str:
             for name, st in daemon.controllers.statuses().items()
         },
     )
+    # flow-record plane dump (the `hubble observe` snapshot the
+    # reference bugtool can't have: here the ring lives in-agent)
+    flow_store = getattr(daemon, "flow_store", None)
+    if flow_store is not None:
+        write(
+            "flows.json",
+            {
+                "summary": flow_store.summary(),
+                "records": [
+                    r.to_dict() for r in flow_store.snapshot()[-4096:]
+                ],
+            },
+        )
     with open(os.path.join(root, "metrics.prom"), "w") as f:
         f.write(metrics.expose())
 

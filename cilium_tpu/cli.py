@@ -232,6 +232,89 @@ def cmd_monitor(api, args) -> int:
     return 0
 
 
+def _format_flow_compact(flow: dict) -> str:
+    """One `hubble observe -o compact`-style line per record."""
+    import time as _time
+
+    from cilium_tpu.monitor.dissect import proto_name
+
+    stamp = _time.strftime(
+        "%b %d %H:%M:%S", _time.localtime(flow.get("ts", 0))
+    )
+    line = (
+        f"{stamp} [chip {flow.get('chip', 0)}] "
+        f"identity {flow.get('src_identity', 0)} -> "
+        f"{flow.get('dst_identity', 0)} "
+        f"ep={flow.get('ep_id', 0)} "
+        f":{flow.get('dport', 0)}/{proto_name(flow.get('proto', 0))} "
+        f"{flow.get('direction', '')} {flow.get('verdict', '')}"
+    )
+    if flow.get("drop_reason"):
+        line += f" ({flow['drop_reason']})"
+    if flow.get("proxy_port"):
+        line += f" -> proxy {flow['proxy_port']}"
+    return line
+
+
+def cmd_observe(api, args) -> int:
+    """`cilium-tpu observe` — the hubble observe analog: filtered
+    one-shot dump of the agent's flow ring, or --follow to tail it
+    (long-polls riding the FlowStore condvar)."""
+    params = {}
+    for key, val in (
+        ("verdict", args.verdict),
+        ("drop-reason", args.drop_reason),
+        ("identity", args.identity),
+        ("ep", args.ep),
+        ("port", args.port),
+        ("proto", args.proto),
+        ("direction", args.direction),
+        ("since", args.since),
+        ("chip", args.chip),
+    ):
+        if val is not None:
+            params[key] = val
+    params["last"] = args.last
+
+    def emit(flows) -> None:
+        for flow in flows:
+            if args.output == "json":
+                print(json.dumps(flow))
+            else:
+                print(_format_flow_compact(flow))
+
+    if args.summary:
+        print(json.dumps(api.flows_summary(top=args.top), indent=2))
+        return 0
+    if not args.follow:
+        got = api.flows_get(params)
+        emit(got["flows"])
+        if got.get("evicted"):
+            print(
+                f"# ring evicted {got['evicted']} records",
+                file=sys.stderr,
+            )
+        return 0
+    # follow mode: start from the current cursor, re-poll with the
+    # reply's last_seq so nothing is skipped or repeated
+    cursor = api.flows_get({"last": 0})["last_seq"]
+    try:
+        while True:
+            got = api.flows_get(
+                {
+                    **params,
+                    "follow": 1,
+                    "since-seq": cursor,
+                    "timeout": args.timeout,
+                    "last": 0,
+                }
+            )
+            emit(got["flows"])
+            cursor = max(cursor, got["last_seq"])
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_fault_list(api, args) -> int:
     print(json.dumps(api.fault_list(), indent=2))
     return 0
@@ -337,6 +420,39 @@ def make_parser() -> argparse.ArgumentParser:
     ctsub = ctp.add_subparsers(dest="ct_cmd", required=True)
     clist = ctsub.add_parser("list")
     clist.set_defaults(func=cmd_ct_list)
+
+    obs = sub.add_parser(
+        "observe",
+        help="flow observability (the hubble observe analog): "
+        "filtered dump or --follow tail of the agent's flow ring",
+    )
+    obs.add_argument("--follow", action="store_true",
+                     help="tail new flows (long-poll)")
+    obs.add_argument("-o", "--output", choices=["json", "compact"],
+                     default="compact")
+    obs.add_argument("--last", type=int, default=256,
+                     help="newest N matches (one-shot mode)")
+    obs.add_argument("--verdict", default=None,
+                     help="FORWARDED|DROPPED")
+    obs.add_argument("--drop-reason", default=None,
+                     help='canonical reason, e.g. "Policy denied (L3)"')
+    obs.add_argument("--identity", type=int, default=None,
+                     help="matches either side of the pair")
+    obs.add_argument("--ep", type=int, default=None)
+    obs.add_argument("--port", type=int, default=None)
+    obs.add_argument("--proto", default=None, help="tcp|udp|<number>")
+    obs.add_argument("--direction", default=None,
+                     choices=["ingress", "egress"])
+    obs.add_argument("--since", default=None,
+                     help="unix seconds or 30s/5m/1h window")
+    obs.add_argument("--chip", type=int, default=None)
+    obs.add_argument("--timeout", type=float, default=5.0,
+                     help="follow-mode poll timeout")
+    obs.add_argument("--summary", action="store_true",
+                     help="aggregations instead of records")
+    obs.add_argument("--top", type=int, default=10,
+                     help="rows per summary ranking")
+    obs.set_defaults(func=cmd_observe)
 
     mon = sub.add_parser("monitor")
     mon.add_argument("--count", type=int, default=0,

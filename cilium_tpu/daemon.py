@@ -165,6 +165,14 @@ class Daemon:
         # None = a non-policy reason forced a full sweep
         self._pending_rule_selectors: Optional[list] = []
         self.monitor = MonitorBus()
+        # Hubble-style flow-record plane (cilium_tpu.flow): a bounded
+        # ring of structured per-flow records fed by process_flows —
+        # all drops plus head-sampled allows (the
+        # MonitorAggregationLevel knob, shared with the monitor
+        # fold) — served by GET /flows and `cilium-tpu observe`
+        from cilium_tpu.flow import FlowStore
+
+        self.flow_store = FlowStore()
         self.proxy = Proxy(monitor=self.monitor)
         # accumulated per-phase regeneration spans (pkg/spanstat; the
         # reference logs one SpanStat per phase, policy.go:689-699) —
@@ -434,6 +442,17 @@ class Daemon:
             acc.failure_total += span.failure_total
             acc.num_success += span.num_success
             acc.num_failure += span.num_failure
+        self._export_spans("regeneration", self.regen_spans)
+
+    @staticmethod
+    def _export_spans(scope: str, spans: SpanStats) -> None:
+        """Mirror a SpanStats accumulator into the metrics registry
+        (one gauge sample per phase, labels-first) so /debug/profile
+        and /metrics/prometheus report the SAME numbers."""
+        for name, span in spans.items():
+            metrics.spanstat_seconds.set(
+                scope, name, value=span.total()
+            )
 
     def _regenerate_for_reasons(self, reasons: List[str]) -> None:
         self.regenerate_all(", ".join(reasons) or "trigger")
@@ -1160,12 +1179,21 @@ class Daemon:
         With `collect_verdicts` the per-tuple verdict columns of
         every evaluated batch land in stats.verdicts (allowed /
         match_kind / proxy_port, stream order) — the chaos harness's
-        bit-identity probe.  Returns ReplayStats."""
+        bit-identity probe.
+
+        Flow observability: every batch additionally folds into
+        self.flow_store (cilium_tpu.flow) — ALL drops plus allows
+        head-sampled per the MonitorAggregationLevel knob, classified
+        through the same telemetry_masks definitions as the PR 1
+        histogram.  Shed (Overload) flows are accounted in metrics
+        only: building per-flow records under overload would amplify
+        the overload being shed.  Returns ReplayStats."""
         import time as _time
         from types import SimpleNamespace
 
         import numpy as np
 
+        from cilium_tpu.flow import allow_sample_for_level, capture_batch
         from cilium_tpu.monitor import verdicts_to_events
         from cilium_tpu.native import decode_flow_records
         from cilium_tpu.replay import (
@@ -1200,6 +1228,26 @@ class Daemon:
         n_dropped = int((~known).sum())
         if n_dropped:
             rec = {k: v[known] for k, v in rec.items()}
+        # endpoint-axis → local endpoint identity LUT: flow records
+        # orient each tuple as src→dst (the local endpoint is the
+        # DESTINATION of an ingress flow and the SOURCE of an egress
+        # one, the send_trace_notify convention)
+        local_ident_lut = np.zeros(
+            max(index.values(), default=0) + 1, dtype=np.int64
+        )
+        for lut_ep_id, lut_idx in index.items():
+            lut_ep = self.endpoint_manager.lookup(lut_ep_id)
+            if (
+                lut_ep is not None
+                and lut_ep.security_identity is not None
+            ):
+                local_ident_lut[lut_idx] = lut_ep.security_identity.id
+        # allowed-flow record budget per batch — the SAME aggregation
+        # knob that gates the monitor fold's per-packet traces; drops
+        # are never sampled
+        flow_allow_sample = allow_sample_for_level(
+            option.Config.opts.level(option.MONITOR_AGGREGATION)
+        )
         # XDP prefilter (the daemon-owned deny-by-CIDR set,
         # bpf_xdp.c): flows from denied sources drop BEFORE the
         # policy program and count under the canonical CIDR reason —
@@ -1232,6 +1280,33 @@ class Daemon:
                             drop_reason_name(-162), dname,
                             value=count,
                         )
+                # prefiltered flows are real drops: record them in
+                # the flow plane (pre_dropped mask → the canonical
+                # CIDR reason) before they leave the stream
+                pre_idx = _ep_index_of(
+                    {"ep_id": rec["ep_id"][hit]}, dict(index)
+                )
+                pre_dirs = rec["direction"][hit]
+                pre_peer = rec["identity"][hit].astype(np.int64)
+                pre_local = local_ident_lut[pre_idx]
+                capture_batch(
+                    self.flow_store,
+                    ep_ids=rec["ep_id"][hit],
+                    src_identities=np.where(
+                        pre_dirs == 0, pre_peer, pre_local
+                    ),
+                    dst_identities=np.where(
+                        pre_dirs == 0, pre_local, pre_peer
+                    ),
+                    dports=rec["dport"][hit],
+                    protos=rec["proto"][hit],
+                    directions=pre_dirs,
+                    allowed=np.zeros(n_prefiltered, bool),
+                    match_kind=np.zeros(n_prefiltered, np.int32),
+                    pre_dropped=np.ones(n_prefiltered, bool),
+                    allow_sample=0,
+                    metrics_registry=metrics,
+                )
                 rec = {k: v[~hit] for k, v in rec.items()}
         # vectorized index→endpoint-id translation (inverse of
         # replay._ep_index_of's LUT)
@@ -1337,6 +1412,28 @@ class Daemon:
                     ),
                 )
                 spans.span("event_fold").end()
+                # flow-record fold (the Hubble plane): all drops +
+                # head-sampled allows, classified through the shared
+                # telemetry_masks definitions
+                spans.span("flow_capture").start()
+                dirs = rec["direction"][start:end]
+                peer = rec["identity"][start:end].astype(np.int64)
+                local = local_ident_lut[ep_idx]
+                capture_batch(
+                    self.flow_store,
+                    ep_ids=rev_lut[ep_idx],
+                    src_identities=np.where(dirs == 0, peer, local),
+                    dst_identities=np.where(dirs == 0, local, peer),
+                    dports=rec["dport"][start:end],
+                    protos=rec["proto"][start:end],
+                    directions=dirs,
+                    allowed=v.allowed,
+                    match_kind=v.match_kind,
+                    proxy_port=v.proxy_port,
+                    allow_sample=flow_allow_sample,
+                    metrics_registry=metrics,
+                )
+                spans.span("flow_capture").end()
             finally:
                 self.admission.release(valid)
             metrics.batch_duration.observe(
@@ -1344,6 +1441,7 @@ class Daemon:
             )
         stats.seconds = _time.perf_counter() - t0
         stats.spans = spans
+        self._export_spans("datapath", spans)
         if collected is not None:
             stats.verdicts = {
                 field: np.concatenate(

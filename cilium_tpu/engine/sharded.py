@@ -36,9 +36,25 @@ from cilium_tpu.engine.verdict import (
 )
 
 try:  # jax>=0.4.30 moved shard_map out of experimental
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f=None, **kwargs):
+    """shard_map with the replication-check knob spelled per the
+    installed jax: newer releases renamed check_rep → check_vma, and
+    passing the wrong name is a TypeError at decoration time."""
+    import inspect
+
+    params = inspect.signature(_shard_map).parameters
+    if "check_vma" not in params and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" not in params and "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    if f is None:
+        return partial(_shard_map, **kwargs)
+    return _shard_map(f, **kwargs)
 
 
 def table_specs(batch_axis: str, table_axis: str) -> PolicyTables:
@@ -71,14 +87,28 @@ def batch_specs(batch_axis: str) -> TupleBatch:
 
 
 def make_mesh_evaluator(
-    mesh: Mesh, batch_axis: str = "batch", table_axis: str = "table"
+    mesh: Mesh,
+    batch_axis: str = "batch",
+    table_axis: str = "table",
+    collect_telemetry: bool = False,
 ):
     """Jitted full datapath step over a 2D (batch × table) mesh.
 
     Returns fn(tables, batch) -> (Verdicts, l4_counts, l3_counts):
       l4_counts u32 [E, 2, Kg]       replicated
       l3_counts u32 [E, 2, N]        sharded along identity (table) axis
-    """
+
+    With `collect_telemetry` the step additionally returns a
+    PER-CHIP stage histogram u32 [n_batch_shards, 2, TELEM_COLS]:
+    each batch shard reduces its own [2, T] rows inside the dispatch
+    (the same ~20 masked sums the single-chip instrumented kernels
+    fuse, from the SAME telemetry_masks definition set) and the rows
+    all-gather along the batch axis — so ONE host fold
+    (telemetry.fold_telemetry_per_chip) yields both the mesh-total
+    counters and the `chip`-labeled per-chip rows of the ROADMAP's
+    multi-chip aggregation item.  The lattice path carries no
+    LB/CT/prefilter stages; their columns fold as zeros, exactly
+    what they contribute on this path."""
     t_specs = table_specs(batch_axis, table_axis)
     b_specs = batch_specs(batch_axis)
     v_specs = Verdicts(
@@ -86,12 +116,15 @@ def make_mesh_evaluator(
         proxy_port=P(batch_axis),
         match_kind=P(batch_axis),
     )
+    out_specs = (v_specs, P(), P(None, None, table_axis))
+    if collect_telemetry:
+        out_specs = out_specs + (P(batch_axis, None, None),)
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(t_specs, b_specs),
-        out_specs=(v_specs, P(), P(None, None, table_axis)),
+        out_specs=out_specs,
         check_vma=False,
     )
     def step(tables_l: PolicyTables, batch_l: TupleBatch):
@@ -150,7 +183,29 @@ def make_mesh_evaluator(
 
         l4_counts = jax.lax.psum(l4_counts, batch_axis)
         l3_counts = jax.lax.psum(l3_counts, batch_axis)
-        return v, l4_counts, l3_counts
+        if not collect_telemetry:
+            return v, l4_counts, l3_counts
+
+        # -- per-chip stage telemetry: this batch shard's [2, T] rows,
+        # computed from the globally-combined verdict columns (v is
+        # identical across the table axis after the psums above, so
+        # every table shard of one batch shard emits the same rows)
+        from cilium_tpu.engine.verdict import telemetry_masks
+
+        zeros = jnp.zeros(v.allowed.shape, jnp.int32)
+        masks = telemetry_masks(
+            zeros, zeros, v.match_kind, v.allowed, zeros,
+            v.proxy_port, zeros, zeros,
+        )
+        ingress = batch_l.direction == 0
+        row_in = jnp.stack(
+            [jnp.sum(m & ingress, dtype=jnp.uint32) for m in masks]
+        )
+        col_total = jnp.stack(
+            [jnp.sum(m, dtype=jnp.uint32) for m in masks]
+        )
+        trow = jnp.stack([row_in, col_total - row_in])
+        return v, l4_counts, l3_counts, trow[None]
 
     in_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s), t_specs),

@@ -305,6 +305,34 @@ class Registry:
             "Injected faults fired, by site and mode",
             ("site", "mode"),
         )
+        # -- flow observability plane (cilium_tpu.flow) ------------------
+        self.flow_records_captured_total = Counter(
+            f"{ns}_flow_records_captured_total",
+            "Flow records accounted by the capture fold, by verdict "
+            "(every drop counts here even when a drop storm exceeds "
+            "ring capacity — the excess shows in flow_store_evicted)",
+            ("verdict",),
+        )
+        self.flow_store_evicted = Gauge(
+            f"{ns}_flow_store_evicted",
+            "Flow records lost to the bounded FlowStore ring "
+            "(overflow eviction + drop-storm truncation): what a "
+            "late reader can no longer see",
+        )
+        # -- phase spans + mesh telemetry --------------------------------
+        self.spanstat_seconds = Gauge(
+            f"{ns}_spanstat_seconds",
+            "Accumulated wall seconds per SpanStat phase "
+            "(success + failure), mirroring /debug/profile",
+            ("scope", "phase"),
+        )
+        self.telemetry_per_chip = Counter(
+            f"{ns}_datapath_telemetry_per_chip_total",
+            "Per-chip datapath stage histogram on a sharded mesh "
+            "(TELEM_* column names); summing a column over `chip` "
+            "equals the mesh-total counters",
+            ("chip", "column", "direction"),
+        )
 
     def expose(self) -> str:
         lines: List[str] = []

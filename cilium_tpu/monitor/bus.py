@@ -95,10 +95,16 @@ class MonitorBus:
         with self._lock:
             for q in self._subscribers:
                 if len(q) == q.maxlen:
+                    # full ring: drop the NEWEST event, like a full
+                    # perf ring rejecting the producer's write.  The
+                    # old deque-maxlen append silently evicted the
+                    # OLDEST instead, so the lost-event counter
+                    # disagreed with which event was actually gone.
                     self.lost_events += 1
                     self._drops[id(q)] = (
                         self._drops.get(id(q), 0) + 1
                     )
+                    continue
                 q.append(event)
             callbacks = list(self._callbacks)
             self._cond.notify_all()

@@ -520,6 +520,8 @@ def replay(
     manager=None,
     ct_map=None,
     collect_telemetry: bool = False,
+    flow_store=None,
+    chip: int = 0,
 ) -> tuple:
     """Run all records through the FULL fused datapath step
     (engine/datapath.datapath_step_accum — counters scatter into
@@ -548,6 +550,14 @@ def replay(
     reference hangs off its regeneration phases, applied to the
     datapath loop.
 
+    With `flow_store` (a cilium_tpu.flow.FlowStore) every drained
+    batch folds flow records into the ring — all drops plus allows
+    head-sampled per the MonitorAggregationLevel knob — tagged with
+    `chip` and classified through the shared telemetry_masks
+    definitions; the peer identity rides src/dst per direction, the
+    local side is 0 (replay has no endpoint-identity context).  Not
+    offered in churn mode, like collect_telemetry.
+
     Returns (ReplayStats, l4_counts, l3_counts); the counter arrays
     are u64 sums across batches with shapes [E, 2, Kg] and [E, 2, N]
     (policy_entry packets, bpf/lib/policy.h:66-68), or (stats, None,
@@ -573,6 +583,12 @@ def replay(
         # those rows rewritten in place (FleetCompiler double
         # buffering) and would return wrong verdicts silently
         manager.check_tables_current(tables.policy)
+    if flow_store is not None and ct_map is not None:
+        raise ValueError(
+            "flow capture is not offered in churn mode (the churn "
+            "programs fuse intent compaction instead of returning "
+            "per-tuple verdict columns)"
+        )
 
     stats = ReplayStats()
     spans = SpanStats()
@@ -643,12 +659,40 @@ def replay(
         )
         churn_step, churn_step_accum = _churn_fns()[:2]
 
+    id_table_host = (
+        np.asarray(tables.policy.id_table)
+        if flow_store is not None
+        else None
+    )
+    # out.sec_id is a raw identity INDEX only when BOTH hold: the
+    # dispatch was the emit_sec_id=False telem program AND the
+    # ipcache is idx-form (the hash-form branch emits the real id
+    # regardless of emit_sec_id) — see _datapath_core
+    ipcache_idx_form = False
+    if flow_store is not None:
+        from cilium_tpu.ipcache.lpm import IPCacheDevice
+
+        ipcache_idx_form = bool(
+            isinstance(tables.ipcache, IPCacheDevice)
+            and tables.ipcache.values_are_idx
+        )
+    # record ep_ids must be ENDPOINT ids: invert the record→axis
+    # translation the loader applied (the daemon path's rev_lut)
+    ep_rev_lut = None
+    if flow_store is not None and ep_map:
+        ep_rev_lut = np.zeros(
+            max(ep_map.values()) + 1, dtype=np.int64
+        )
+        for rev_ep_id, rev_idx in ep_map.items():
+            ep_rev_lut[rev_idx] = rev_ep_id
+
     def _drain_item(item):
         """Drain one pending batch; host-fold its telemetry when the
         dispatch couldn't carry the device accumulator (partial tail
-        batches, or the no-counter audit path)."""
+        batches, or the no-counter audit path), and fold flow records
+        when a flow_store rides along."""
         nonlocal telem_total
-        out, valid, fold_direction = item
+        out, valid, fold_direction, flows_ref, sec_is_idx = item
         spans.span("drain").start()
         _drain_fused((out, valid), stats)
         if fold_direction is not None:
@@ -656,6 +700,11 @@ def replay(
 
             telem_total = telem_total + telemetry_from_outputs(
                 out, np.asarray(fold_direction), valid=valid
+            )
+        if flow_store is not None:
+            _capture_replay_flows(
+                flow_store, out, flows_ref, int(valid), sec_is_idx,
+                id_table_host, chip, ep_rev_lut,
             )
         spans.span("drain").end()
 
@@ -722,8 +771,15 @@ def replay(
             )
             continue
         fold_direction = None
+        sec_is_idx = False
         spans.span("dispatch").start()
         if accumulate_counters:
+            # BOTH accum kernels run emit_sec_id=False: with an
+            # idx-form ipcache their sec output is the raw identity
+            # index, which flow capture translates through id_table
+            # host-side (the non-counter datapath_step emits the
+            # real id, so it stays False)
+            sec_is_idx = ipcache_idx_form
             if telem_dev is not None and valid == batch_size:
                 out, acc, telem_dev = _guarded_dispatch(
                     datapath_step_accum_telem,
@@ -748,7 +804,15 @@ def replay(
             if telem_total is not None:
                 fold_direction = flows.direction
         spans.span("dispatch").end()
-        pending.append((out, valid, fold_direction))
+        pending.append(
+            (
+                out,
+                valid,
+                fold_direction,
+                flows if flow_store is not None else None,
+                sec_is_idx,
+            )
+        )
         stats.batches += 1
         if len(pending) >= 4:
             _drain_item(pending.pop(0))
@@ -775,6 +839,56 @@ def replay(
     _fold_counters()
     kg = tables.policy.l4_meta.shape[2]
     return stats, acc_total[:, :, :kg], acc_total[:, :, kg:]
+
+
+def _capture_replay_flows(
+    flow_store, out, flows, valid: int, sec_is_idx: bool,
+    id_table_host: np.ndarray, chip: int,
+    ep_rev_lut: "Optional[np.ndarray]" = None,
+) -> None:
+    """Fold one drained batch's DatapathVerdicts into the flow ring
+    (replay's Hubble feed): the full fused-path columns — CT state,
+    prefilter attribution, post-DNAT dport — are available here,
+    unlike the lattice-only audit path.  The derived peer identity
+    (out.sec_id: src of an ingress flow, dst of an egress one) rides
+    the matching side of the pair; the other side is 0 (replay has
+    no endpoint-identity context)."""
+    from cilium_tpu import option as _option
+    from cilium_tpu.flow import allow_sample_for_level, capture_batch
+
+    sec = np.asarray(out.sec_id)[:valid].astype(np.int64)
+    if sec_is_idx:
+        sec = id_table_host[
+            np.minimum(sec, len(id_table_host) - 1)
+        ].astype(np.int64)
+    dirs = np.asarray(flows.direction)[:valid]
+    zeros = np.zeros(valid, np.int64)
+    ep_ids = np.asarray(flows.ep_index)[:valid]
+    if ep_rev_lut is not None:
+        ep_ids = ep_rev_lut[
+            np.minimum(ep_ids, len(ep_rev_lut) - 1)
+        ]
+    capture_batch(
+        flow_store,
+        ep_ids=ep_ids,
+        src_identities=np.where(dirs == 0, sec, zeros),
+        dst_identities=np.where(dirs == 0, zeros, sec),
+        dports=np.asarray(out.final_dport)[:valid],
+        protos=np.asarray(flows.proto)[:valid],
+        directions=dirs,
+        allowed=np.asarray(out.allowed)[:valid],
+        match_kind=np.asarray(out.match_kind)[:valid],
+        proxy_port=np.asarray(out.proxy_port)[:valid],
+        pre_dropped=np.asarray(out.pre_dropped)[:valid],
+        ct_result=np.asarray(out.ct_result)[:valid],
+        ct_delete=np.asarray(out.ct_delete)[:valid],
+        lb_slave=np.asarray(out.lb_slave)[:valid],
+        ipcache_miss=np.asarray(out.ipcache_miss)[:valid],
+        chip=chip,
+        allow_sample=allow_sample_for_level(
+            _option.Config.opts.level(_option.MONITOR_AGGREGATION)
+        ),
+    )
 
 
 def replay_pool(

@@ -159,6 +159,34 @@ def fold_telemetry(telem, registry=None) -> None:
                 )
 
 
+def fold_telemetry_per_chip(per_chip, registry=None) -> np.ndarray:
+    """Fold an all-gathered [n_chips, 2, TELEM_COLS] per-chip stage
+    histogram DELTA (engine.sharded.make_mesh_evaluator with
+    collect_telemetry) into the registry: the chip-summed mesh total
+    goes through fold_telemetry — so ONE /metrics/prometheus scrape
+    covers the whole mesh — and each chip's rows land under the
+    `chip` label in cilium_datapath_telemetry_per_chip_total for
+    imbalance debugging.  Summing a column over `chip` equals the
+    mesh-total counters by construction.  Returns the mesh-total
+    [2, TELEM_COLS] u64 histogram."""
+    if registry is None:
+        from cilium_tpu.metrics import registry as registry_
+        registry = registry_
+    per_chip = np.asarray(per_chip).astype(np.uint64)
+    total = per_chip.sum(axis=0)
+    fold_telemetry(total, registry=registry)
+    for chip in range(per_chip.shape[0]):
+        for d, dname in enumerate(DIRECTION_NAMES):
+            row = per_chip[chip, d]
+            for col, name in enumerate(TELEM_NAMES):
+                if int(row[col]):
+                    registry.telemetry_per_chip.inc(
+                        str(chip), name, dname,
+                        value=int(row[col]),
+                    )
+    return total
+
+
 def telemetry_summary(telem) -> Dict[str, Dict[str, int]]:
     """{direction: {column name: count}} rendering of a stage
     histogram, for bench JSON lines and `cilium status`-style dumps

@@ -1,0 +1,519 @@
+"""FlowStore ring / FlowFilter / capture-fold unit coverage, the
+replay() flow hook, `cilium-tpu observe`, and the bugtool flow dump."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.flow import (
+    VERDICT_DROPPED,
+    VERDICT_FORWARDED,
+    FlowFilter,
+    FlowRecord,
+    FlowStore,
+    allow_sample_for_level,
+    capture_batch,
+    chip_of_rows,
+)
+
+
+def _record(seq_hint=0, **kw):
+    base = dict(
+        ts=time.time(), chip=0, ep_id=10, src_identity=256,
+        dst_identity=300, dport=80, proto=6, direction=0,
+        verdict=VERDICT_FORWARDED, match_kind=1,
+    )
+    base.update(kw)
+    return FlowRecord(**base)
+
+
+def test_ring_bounds_seq_and_eviction():
+    s = FlowStore(capacity=4)
+    for i in range(6):
+        s.append(_record(dport=i))
+    assert len(s) == 4
+    assert s.captured_total == 6
+    assert s.evicted == 2
+    assert s.last_seq == 6
+    # the OLDEST records fell off; the survivors keep their seq
+    assert [r.seq for r in s.snapshot()] == [3, 4, 5, 6]
+    assert [r.dport for r in s.snapshot()] == [2, 3, 4, 5]
+
+
+def test_filter_parsing_and_matching():
+    flt = FlowFilter.from_params(
+        {
+            "verdict": "dropped",
+            "identity": "256",
+            "port": "80",
+            "proto": "tcp",
+            "direction": "ingress",
+        }
+    )
+    hit = _record(verdict=VERDICT_DROPPED)
+    assert flt.matches(hit)
+    assert not flt.matches(_record())  # forwarded
+    assert not flt.matches(
+        _record(verdict=VERDICT_DROPPED, dport=443)
+    )
+    # identity matches EITHER side
+    assert flt.matches(
+        _record(
+            verdict=VERDICT_DROPPED,
+            src_identity=999,
+            dst_identity=256,
+        )
+    )
+    with pytest.raises(ValueError):
+        FlowFilter.from_params({"verdict": "MAYBE"})
+    with pytest.raises(ValueError):
+        FlowFilter.from_params({"nope": "1"})
+    with pytest.raises(ValueError):
+        FlowFilter.from_params({"direction": "sideways"})
+    # relative since window
+    flt2 = FlowFilter.from_params({"since": "5m"})
+    assert flt2.matches(_record())
+    assert not flt2.matches(_record(ts=time.time() - 3600))
+
+
+def test_query_last_and_after_seq():
+    s = FlowStore()
+    for i in range(10):
+        s.append(_record(dport=i))
+    assert [r.dport for r in s.query(last=3)] == [7, 8, 9]
+    assert [r.seq for r in s.query(after_seq=8)] == [9, 10]
+    assert s.query(last=0) == []
+
+
+def test_capture_classification_matches_telemetry_masks():
+    """Records classify through the SAME telemetry_masks definitions
+    as the device histogram: per-reason record counts equal the
+    histogram's drop columns for identical inputs."""
+    from cilium_tpu.engine.verdict import (
+        TELEM_DROP_FRAG,
+        TELEM_DROP_POLICY,
+        TELEM_DROP_PREFILTER,
+        telemetry_masks,
+    )
+
+    rng = np.random.default_rng(7)
+    b = 256
+    allowed = rng.integers(0, 2, b).astype(np.uint8)
+    kind = np.where(
+        allowed, rng.choice([1, 2, 3], b),
+        rng.choice([0, 4], b),
+    ).astype(np.uint8)
+    pre = (~allowed.astype(bool)) & (rng.random(b) < 0.3)
+    s = FlowStore()
+    capture_batch(
+        s,
+        ep_ids=np.full(b, 10),
+        src_identities=np.full(b, 256),
+        dst_identities=np.full(b, 300),
+        dports=np.full(b, 80),
+        protos=np.full(b, 6),
+        directions=rng.integers(0, 2, b),
+        allowed=allowed,
+        match_kind=kind,
+        pre_dropped=pre,
+        allow_sample=0,
+    )
+    z = np.zeros(b, np.int32)
+    masks = telemetry_masks(
+        pre, z, kind, allowed, z, z, z, z, xp=np
+    )
+    per_reason = {}
+    for r in s.snapshot():
+        per_reason[r.drop_reason] = (
+            per_reason.get(r.drop_reason, 0) + 1
+        )
+    assert per_reason.get("Policy denied (CIDR)", 0) == int(
+        masks[TELEM_DROP_PREFILTER].sum()
+    )
+    assert per_reason.get("Policy denied (L3)", 0) == int(
+        masks[TELEM_DROP_POLICY].sum()
+    )
+    assert per_reason.get("Fragmentation needed", 0) == int(
+        masks[TELEM_DROP_FRAG].sum()
+    )
+    assert len(s) == int((~allowed.astype(bool)).sum())
+
+
+def test_capture_allow_sampling_never_drops_drops():
+    s = FlowStore()
+    b = 100
+    allowed = np.ones(b, np.uint8)
+    allowed[::4] = 0  # 25 drops
+    capture_batch(
+        s,
+        ep_ids=np.zeros(b), src_identities=np.zeros(b),
+        dst_identities=np.zeros(b), dports=np.zeros(b),
+        protos=np.zeros(b), directions=np.zeros(b),
+        allowed=allowed, match_kind=np.zeros(b),
+        allow_sample=5,
+    )
+    snap = s.snapshot()
+    assert sum(r.verdict == VERDICT_DROPPED for r in snap) == 25
+    assert sum(r.verdict == VERDICT_FORWARDED for r in snap) == 5
+    # the knob mapping: `none` captures everything, higher levels cut
+    assert allow_sample_for_level(0) is None
+    assert allow_sample_for_level(3) == 64
+    assert (
+        allow_sample_for_level(1) > allow_sample_for_level(2)
+        > allow_sample_for_level(3)
+    )
+
+
+def test_chip_of_rows():
+    chips = chip_of_rows(8, 4)
+    assert chips.tolist() == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert chip_of_rows(5, 1).tolist() == [0] * 5
+    s = FlowStore()
+    capture_batch(
+        s,
+        ep_ids=np.zeros(8), src_identities=np.zeros(8),
+        dst_identities=np.zeros(8), dports=np.zeros(8),
+        protos=np.zeros(8), directions=np.zeros(8),
+        allowed=np.zeros(8), match_kind=np.zeros(8),
+        chip=chips,
+    )
+    assert s.summary()["per_chip"] == {
+        "0": 2, "1": 2, "2": 2, "3": 2,
+    }
+
+
+def test_wait_for_flows_wakes_and_times_out():
+    s = FlowStore()
+    got = {}
+
+    def waiter():
+        got["r"] = s.wait_for_flows(0, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    s.append(_record())
+    t.join(timeout=5)
+    assert not t.is_alive() and len(got["r"]) == 1
+    # filtered waiter ignores non-matching records, then times out
+    flt = FlowFilter(verdict=VERDICT_DROPPED)
+    t0 = time.monotonic()
+    assert s.wait_for_flows(s.last_seq, 0.2, flt) == []
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_summary_rankings():
+    s = FlowStore()
+    for _ in range(3):
+        s.append(
+            _record(
+                verdict=VERDICT_DROPPED,
+                drop_reason="Policy denied (L3)",
+                src_identity=1, dst_identity=2,
+            )
+        )
+    s.append(
+        _record(
+            verdict=VERDICT_DROPPED,
+            drop_reason="Fragmentation needed",
+            src_identity=3, dst_identity=4, chip=1,
+        )
+    )
+    got = s.summary(top=1)
+    assert got["top_drop_reasons"] == [
+        {"reason": "Policy denied (L3)", "count": 3}
+    ]
+    assert got["top_denied_pairs"] == [
+        {"src_identity": 1, "dst_identity": 2, "count": 3}
+    ]
+    assert got["per_chip"] == {"0": 3, "1": 1}
+    assert got["chip_imbalance"] == 3.0
+
+
+def test_replay_flow_store_hook():
+    """replay(flow_store=...) folds drained DatapathVerdicts into the
+    ring — full fused-path columns (CT state, chip tag), every drop
+    recorded."""
+    from tools.telemetry_smoke import build_world
+
+    from cilium_tpu import option
+    from cilium_tpu.native import encode_flow_records
+    from cilium_tpu.replay import replay
+
+    option.Config.opts[option.MONITOR_AGGREGATION] = (
+        option.MONITOR_AGG_NONE
+    )
+    tables, _ = build_world()
+    rng = np.random.default_rng(3)
+    n = 512
+    buf = encode_flow_records(
+        ep_id=rng.integers(0, 2, n).astype(np.uint32),
+        identity=np.zeros(n, np.uint32),
+        saddr=rng.choice(
+            [0x0A000001, 0x0A010001, 0xCB007109], size=n
+        ).astype(np.uint32),
+        daddr=np.full(n, 0x0A000010, np.uint32),
+        sport=np.full(n, 40000, np.uint16),
+        dport=rng.choice([80, 443, 8080], size=n).astype(np.uint16),
+        proto=np.full(n, 6, np.uint8),
+        direction=rng.integers(0, 2, n).astype(np.uint8),
+        is_fragment=np.zeros(n, np.uint8),
+    )
+    store = FlowStore()
+    stats, _, _ = replay(
+        tables, buf, batch_size=128, flow_store=store, chip=2
+    )
+    snap = store.snapshot()
+    drops = [r for r in snap if r.verdict == VERDICT_DROPPED]
+    assert stats.total == n
+    assert len(drops) == stats.denied > 0
+    assert len(snap) == n  # sampling disabled: allows recorded too
+    assert all(r.chip == 2 for r in snap)
+    # prefiltered source (203.0.113.9) attributes to the CIDR reason
+    assert any(
+        r.drop_reason == "Policy denied (CIDR)" for r in drops
+    )
+    # churn mode refuses the hook
+    from cilium_tpu.ct.table import CTMap
+
+    with pytest.raises(ValueError):
+        replay(tables, buf, flow_store=store, ct_map=CTMap())
+
+
+def test_replay_flow_identities_hash_and_idx_ipcache():
+    """Regression: out.sec_id is a raw identity INDEX only for the
+    telem program over an idx-form ipcache — records must carry REAL
+    identities with BOTH ipcache forms."""
+    from tools.telemetry_smoke import build_world
+
+    from cilium_tpu import option
+    from cilium_tpu.engine.datapath import DatapathTables
+    from cilium_tpu.identity import RESERVED_WORLD
+    from cilium_tpu.ipcache.lpm import specialize_ipcache_to_idx
+    from cilium_tpu.native import encode_flow_records
+    from cilium_tpu.replay import replay
+
+    option.Config.opts[option.MONITOR_AGGREGATION] = (
+        option.MONITOR_AGG_NONE
+    )
+    tables, _ = build_world()
+    idx_tables = DatapathTables(
+        prefilter=tables.prefilter,
+        ipcache=specialize_ipcache_to_idx(
+            tables.ipcache, tables.policy
+        ),
+        ct=tables.ct,
+        lb=tables.lb,
+        policy=tables.policy,
+    )
+    rng = np.random.default_rng(4)
+    n = 256  # == batch_size so the telem dispatch path triggers
+    buf = encode_flow_records(
+        ep_id=rng.integers(0, 2, n).astype(np.uint32),
+        identity=np.zeros(n, np.uint32),
+        saddr=rng.choice(
+            [0x0A000001, 0x0A010001, 0x0A020002], size=n
+        ).astype(np.uint32),
+        daddr=np.full(n, 0x0A000010, np.uint32),
+        sport=np.full(n, 40000, np.uint16),
+        dport=rng.choice([80, 443], size=n).astype(np.uint16),
+        proto=np.full(n, 6, np.uint8),
+        direction=rng.integers(0, 2, n).astype(np.uint8),
+        is_fragment=np.zeros(n, np.uint8),
+    )
+    known_ids = {256, 257, 300, RESERVED_WORLD, 0}
+    # every dispatch variant replay() can pick: the full-batch telem
+    # program, the plain accum program (both emit_sec_id=False —
+    # partial batch_size forces tail batches through accum), and the
+    # no-counter program (emits the real id) — across BOTH ipcache
+    # forms the records must carry real identities
+    cases = [
+        ("telem", dict(batch_size=n, collect_telemetry=True)),
+        ("accum", dict(batch_size=n)),
+        ("accum-tail", dict(batch_size=96, collect_telemetry=True)),
+        ("no-counters", dict(batch_size=n, accumulate_counters=False)),
+    ]
+    for form, t in (("hash", tables), ("idx", idx_tables)):
+        for label, kw in cases:
+            store = FlowStore()
+            stats = replay(t, buf, flow_store=store, **kw)[0]
+            assert stats.total == n and len(store) == n
+            idents = {
+                r.src_identity if r.direction == 0 else r.dst_identity
+                for r in store.snapshot()
+            }
+            assert idents <= known_ids, (
+                form, label, idents - known_ids,
+            )
+            # the real world ids actually appear (not all WORLD/0)
+            assert idents & {256, 257, 300}, (form, label, idents)
+
+
+def test_replay_flow_ep_map_translates_back():
+    """Regression: with an ep_map the loader translated record
+    endpoint ids to table-axis indices; flow records must carry the
+    ENDPOINT ids back."""
+    from tools.telemetry_smoke import build_world
+
+    from cilium_tpu.native import encode_flow_records
+    from cilium_tpu.replay import replay
+
+    tables, _ = build_world()
+    n = 64
+    buf = encode_flow_records(
+        ep_id=np.where(np.arange(n) % 2 == 0, 700, 800).astype(
+            np.uint32
+        ),
+        identity=np.zeros(n, np.uint32),
+        saddr=np.full(n, 0x0A000001, np.uint32),
+        daddr=np.full(n, 0x0A000010, np.uint32),
+        sport=np.full(n, 40000, np.uint16),
+        dport=np.full(n, 80, np.uint16),
+        proto=np.full(n, 6, np.uint8),
+        direction=np.zeros(n, np.uint8),
+        is_fragment=np.zeros(n, np.uint8),
+    )
+    store = FlowStore()
+    replay(
+        tables, buf, batch_size=32, flow_store=store,
+        ep_map={700: 0, 800: 1},
+    )
+    assert {r.ep_id for r in store.snapshot()} == {700, 800}
+
+
+def test_follow_mode_last_keeps_oldest():
+    """Regression: a follow reply trimmed by `last` must keep the
+    OLDEST matches and resume after them — trimming the newest would
+    advance the cursor past records that are then lost forever."""
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    for i in range(5):
+        d.flow_store.append(
+            _record(verdict=VERDICT_DROPPED, dport=i)
+        )
+    api = DaemonAPI(d)
+    got = api.flows_get(
+        {"follow": "1", "since-seq": "0", "last": "2",
+         "timeout": "0.1"}
+    )
+    assert [f["dport"] for f in got["flows"]] == [0, 1]
+    assert got["last_seq"] == got["flows"][-1]["seq"]
+    rest = api.flows_get(
+        {"follow": "1", "since-seq": str(got["last_seq"]),
+         "last": "0", "timeout": "0.1"}
+    )
+    assert [f["dport"] for f in rest["flows"]] == [2, 3, 4]
+
+
+def test_capture_truncates_drop_storm_to_capacity():
+    """A batch with more drops than the ring holds builds only the
+    newest capacity's worth of records; the excess is charged as
+    visible eviction (never silent)."""
+    s = FlowStore(capacity=8)
+    b = 20
+    capture_batch(
+        s,
+        ep_ids=np.zeros(b), src_identities=np.zeros(b),
+        dst_identities=np.zeros(b), dports=np.arange(b),
+        protos=np.zeros(b), directions=np.zeros(b),
+        allowed=np.zeros(b), match_kind=np.zeros(b),
+    )
+    assert len(s) == 8
+    assert [r.dport for r in s.snapshot()] == list(range(12, 20))
+    assert s.evicted == 12
+    assert s.captured_total == 8
+
+
+def test_cli_observe_and_summary(capsys):
+    """`cilium-tpu observe` one-shot compact + json + --summary over
+    the in-process DaemonAPI."""
+    from cilium_tpu import cli
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    d.flow_store.append(
+        _record(
+            verdict=VERDICT_DROPPED,
+            drop_reason="Policy denied (L3)",
+            dport=443,
+        )
+    )
+    d.flow_store.append(_record(proxy_port=15001))
+    api = DaemonAPI(d)
+    rc = cli.main(["observe"], api=api)
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = out.strip().splitlines()
+    assert len(lines) == 2
+    assert "DROPPED (Policy denied (L3))" in lines[0]
+    assert ":443/tcp" in lines[0]
+    assert "-> proxy 15001" in lines[1]
+
+    rc = cli.main(["observe", "--verdict", "DROPPED", "-o", "json"],
+                  api=api)
+    out = capsys.readouterr().out
+    assert rc == 0
+    got = [json.loads(line) for line in out.strip().splitlines()]
+    assert len(got) == 1 and got[0]["verdict"] == "DROPPED"
+
+    rc = cli.main(["observe", "--summary"], api=api)
+    out = capsys.readouterr().out
+    assert rc == 0
+    summary = json.loads(out)
+    assert summary["verdicts"] == {"DROPPED": 1, "FORWARDED": 1}
+
+
+def test_flows_rest_route_over_socket(tmp_path):
+    """GET /flows and /flows/summary over the real unix socket, bad
+    filters → 400."""
+    from cilium_tpu.api.client import APIClient, APIError
+    from cilium_tpu.api.server import APIServer
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    d.flow_store.append(
+        _record(verdict=VERDICT_DROPPED, drop_reason="Overload")
+    )
+    sock = str(tmp_path / "flows.sock")
+    server = APIServer(d, sock).start()
+    try:
+        client = APIClient(sock)
+        got = client.flows_get({"verdict": "DROPPED"})
+        assert got["matched"] == 1
+        assert got["flows"][0]["drop_reason"] == "Overload"
+        assert client.flows_summary()["records"] == 1
+        with pytest.raises(APIError) as err:
+            client.flows_get({"direction": "sideways"})
+        assert err.value.status == 400
+        with pytest.raises(APIError) as err:
+            client.flows_get({"bogus": "1"})
+        assert err.value.status == 400
+    finally:
+        server.stop()
+
+
+def test_bugtool_gathers_flow_dump(tmp_path):
+    import tarfile
+
+    from cilium_tpu.bugtool import collect
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    d.flow_store.append(
+        _record(
+            verdict=VERDICT_DROPPED, drop_reason="Policy denied (L3)"
+        )
+    )
+    archive = collect(d, str(tmp_path))
+    with tarfile.open(archive) as tar:
+        names = [n for n in tar.getnames() if n.endswith("flows.json")]
+        assert names
+        payload = json.load(tar.extractfile(names[0]))
+    assert payload["summary"]["records"] == 1
+    assert payload["records"][0]["drop_reason"] == "Policy denied (L3)"

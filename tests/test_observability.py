@@ -1,6 +1,7 @@
 """Monitor bus, metrics registry, spanstat, policy trace/explain."""
 
 import numpy as np
+import pytest
 
 from cilium_tpu.metrics import Registry
 from cilium_tpu.monitor import (
@@ -72,6 +73,166 @@ def test_verdicts_to_events():
     assert not events[1].allowed
     assert isinstance(events[2], DropNotify)
     assert events[2].reason == 133 and events[2].src_label == 256
+
+
+def test_bus_overflow_drops_newest():
+    """A full subscriber queue drops the NEWEST event, like a full
+    perf ring rejecting the producer's write — so the lost-event
+    counter and the event that actually vanished agree (the old
+    deque-maxlen append silently evicted the OLDEST instead)."""
+    bus = MonitorBus(queue_size=2)
+    q = bus.subscribe_queue()
+    for i in range(5):
+        bus.publish(DropNotify(source=i))
+    # the survivors are the FIRST two; events 2..4 were rejected
+    assert [e.source for e in q] == [0, 1]
+    assert bus.lost_events == 3
+    assert bus.queue_drops(q) == 3
+    # delta semantics: reset reads then clears
+    assert bus.queue_drops(q, reset=True) == 3
+    assert bus.queue_drops(q) == 0
+    # draining frees capacity: the next publish is accepted
+    q.popleft()
+    bus.publish(DropNotify(source=9))
+    assert [e.source for e in q] == [1, 9]
+    assert bus.lost_events == 3
+    # per-subscriber attribution: a fresh (empty) queue is not
+    # charged for another subscriber's overflow
+    q2 = bus.subscribe_queue()
+    bus.publish(DropNotify(source=10))
+    assert bus.queue_drops(q2) == 0
+    assert bus.queue_drops(q) == 1  # q was full again
+    assert [e.source for e in q2] == [10]
+
+
+def test_spanstat_phases_exported_to_registry():
+    """SpanStats phases mirror into the spanstat_seconds gauge
+    (labels-first) so /metrics/prometheus and /debug/profile report
+    the SAME numbers."""
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.metrics import registry as metrics
+    from tests.test_replay import _daemon_with_policy, _make_buf
+
+    d, server, client = _daemon_with_policy()
+    # the regeneration sweep exported its phases
+    regen_total = metrics.spanstat_seconds.get("regeneration", "total")
+    assert regen_total == d.regen_spans.span("total").total() > 0
+
+    rng = np.random.default_rng(9)
+    buf = _make_buf(rng, 64, [10], [client.security_identity.id])
+    d.process_flows(buf, batch_size=32)
+    prof = DaemonAPI(d).debug_profile()
+    for phase in (
+        "host_pack", "dispatch", "event_fold", "flow_capture",
+    ):
+        gauge = metrics.spanstat_seconds.get("datapath", phase)
+        span = d.datapath_spans.span(phase)
+        assert gauge == span.total() > 0, phase
+        assert prof["datapath_spans"][phase][
+            "success_total_s"
+        ] + prof["datapath_spans"][phase][
+            "failure_total_s"
+        ] == pytest.approx(gauge)
+    exposition = metrics.expose()
+    assert (
+        'cilium_spanstat_seconds{scope="datapath",phase="dispatch"}'
+        in exposition
+    )
+
+
+def test_dissect_remaining_event_kinds():
+    """monitor/dissect.py breadth: the kinds the formats test didn't
+    cover — L7 log records, agent events, unknown kinds (never
+    dropped silently), deny verdicts, proto-name fallback, the list
+    helper, and multi-record buffers."""
+    from cilium_tpu.monitor.dissect import (
+        dissect_event,
+        dissect_events,
+        dissect_flow_buffer,
+        proto_name,
+    )
+    from cilium_tpu.native import encode_flow_records
+
+    assert proto_name(6) == "tcp" and proto_name(17) == "udp"
+    assert proto_name(1) == "icmp" and proto_name(58) == "icmpv6"
+    assert proto_name(99) == "99"  # unknown → numeric, not a crash
+
+    assert dissect_event(
+        {"event": "LogRecordNotify", "l7_proto": "http",
+         "verdict": "denied", "info": "GET /admin"}
+    ) == "http denied GET /admin"
+    assert dissect_event(
+        {"event": "AgentNotify", "kind": "policy-updated",
+         "text": "revision 7"}
+    ) == "agent: revision 7"
+    got = dissect_event({"event": "FutureNotify", "x": 1})
+    assert got.startswith("FutureNotify:") and "x" in got
+    assert dissect_event({}).startswith("unknown")
+    # deny verdict renders action deny, no proxy suffix
+    line = dissect_event(
+        {"event": "PolicyVerdictNotify", "source": 4,
+         "src_label": 77, "dport": 53, "proto": 17,
+         "ingress": False, "allowed": False, "proxy_port": 0}
+    )
+    assert "egress" in line and "action deny" in line
+    assert "proxy" not in line
+
+    evs = [{"event": "AgentNotify", "text": "a"},
+           {"event": "AgentNotify", "text": "b"}]
+    assert dissect_events(evs) == ["agent: a", "agent: b"]
+
+    buf = encode_flow_records(
+        ep_id=np.asarray([1, 2], np.uint32),
+        identity=np.asarray([256, 300], np.uint32),
+        saddr=np.asarray([0x0A000001, 0x0A000003], np.uint32),
+        daddr=np.asarray([0x0A000002, 0x0A000004], np.uint32),
+        sport=np.asarray([1, 2], np.uint16),
+        dport=np.asarray([80, 53], np.uint16),
+        proto=np.asarray([6, 17], np.uint8),
+        direction=np.asarray([0, 1], np.uint8),
+        is_fragment=np.asarray([0, 0], np.uint8),
+    )
+    lines = list(dissect_flow_buffer(buf))
+    assert len(lines) == 2
+    assert lines[1].startswith("udp 10.0.0.3:2 -> 10.0.0.4:53 egress")
+
+
+def test_telemetry_consistent_rejects_corruption():
+    """telemetry_consistent accepts a real histogram and rejects
+    deliberate corruption of each invariant family."""
+    from cilium_tpu.engine.verdict import (
+        TELEM_COLS,
+        TELEM_CT_ESTABLISHED,
+        TELEM_CT_NEW,
+        TELEM_DENIED,
+        TELEM_DROP_POLICY,
+        TELEM_FORWARDED,
+        TELEM_TOTAL,
+    )
+    from cilium_tpu.telemetry import telemetry_consistent
+
+    telem = np.zeros((2, TELEM_COLS), np.uint64)
+    for d in (0, 1):
+        telem[d, TELEM_TOTAL] = 10
+        telem[d, TELEM_FORWARDED] = 6
+        telem[d, TELEM_DENIED] = 4
+        telem[d, TELEM_DROP_POLICY] = 4
+        telem[d, TELEM_CT_NEW] = 7
+        telem[d, TELEM_CT_ESTABLISHED] = 3
+    assert telemetry_consistent(telem)
+
+    # outcome partition broken: forwarded + denied != total
+    bad = telem.copy()
+    bad[0, TELEM_FORWARDED] += 1
+    assert not telemetry_consistent(bad)
+    # drop attribution broken: drop columns don't cover the denials
+    bad = telem.copy()
+    bad[1, TELEM_DROP_POLICY] -= 1
+    assert not telemetry_consistent(bad)
+    # CT partition broken
+    bad = telem.copy()
+    bad[0, TELEM_CT_NEW] += 2
+    assert not telemetry_consistent(bad)
 
 
 def test_metrics_registry_exposition():

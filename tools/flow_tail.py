@@ -1,0 +1,283 @@
+"""Flow-plane smoke: batch → FlowStore → query-plane exactness.
+
+Builds a live daemon world (endpoints, an L3+L4 policy, a denied
+prefilter CIDR), disables allow-sampling (MonitorAggregationLevel
+none — the monitor fold's knob, shared by flow capture), runs a
+record stream through Daemon.process_flows, and asserts the flow
+plane's contract:
+
+  * EVERY denied tuple appears exactly once as a queryable DROPPED
+    record (drops are never sampled);
+  * per-reason record counts equal the telemetry plane's
+    cilium_drop_count_total deltas — the bit-consistency gate
+    between the FlowStore and the PR 1 histogram (both classify
+    through engine.verdict.telemetry_masks);
+  * with sampling disabled every allowed tuple is recorded too;
+  * GET /flows filter subsets are EXACT: every filtered query equals
+    a brute-force filter of the full dump.
+
+Runs in tier-1 (tests/test_flow_tail.py, not slow) and standalone:
+python tools/flow_tail.py
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+DENIED_CIDR = "203.0.113.0/24"
+
+
+def ip_u32(s: str) -> int:
+    return int(ipaddress.ip_address(s))
+
+
+def build_world():
+    """A live daemon: server/client endpoints, client→server:80/TCP
+    plus an L3 peer rule, one denied prefilter CIDR.  Returns
+    (daemon, server_identity, client_identity, peer_identity)."""
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.labels import Label, LabelArray, Labels
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+
+    def labels(**kv):
+        return Labels(
+            {k: Label(k, v, "k8s") for k, v in kv.items()}
+        )
+
+    def selector(**kv):
+        return EndpointSelector(
+            match_labels={f"k8s.{k}": v for k, v in kv.items()}
+        )
+
+    d = Daemon()
+    server = d.create_endpoint(
+        10, labels(app="server"), ipv4="10.0.0.10", name="server-0"
+    )
+    client = d.create_endpoint(
+        11, labels(app="client"), ipv4="10.0.0.11", name="client-0"
+    )
+    peer = d.create_endpoint(
+        12, labels(app="peer"), ipv4="10.0.0.12", name="peer-0"
+    )
+    d.policy_add(
+        [
+            Rule(
+                endpoint_selector=selector(app="server"),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[selector(app="client")],
+                        to_ports=[
+                            PortRule(
+                                ports=[
+                                    PortProtocol(
+                                        port="80", protocol="TCP"
+                                    )
+                                ]
+                            )
+                        ],
+                    ),
+                    IngressRule(from_endpoints=[selector(app="peer")]),
+                ],
+                labels=LabelArray.parse("flow-tail-policy"),
+            )
+        ]
+    )
+    d.prefilter.insert([DENIED_CIDR])
+    # publish synchronously — the async trigger may not have fired yet
+    d.regenerate_all("flow-tail smoke")
+    return (
+        d,
+        server.security_identity.id,
+        client.security_identity.id,
+        peer.security_identity.id,
+    )
+
+
+def make_buf(rng, n: int, client_id: int, peer_id: int) -> bytes:
+    """n ingress records against endpoint 10: allowed L4 (client:80),
+    allowed L3 (peer:any), denied policy (unknown identity), denied
+    frag, and prefiltered sources in DENIED_CIDR."""
+    from cilium_tpu.native import encode_flow_records
+
+    identities = rng.choice(
+        [client_id, peer_id, 999999], size=n
+    ).astype(np.uint32)
+    saddr = np.full(n, ip_u32("10.0.0.11"), np.uint32)
+    # every 7th record arrives from the denied CIDR
+    pre = np.arange(n) % 7 == 0
+    saddr[pre] = ip_u32("203.0.113.9")
+    frag = (np.arange(n) % 11 == 0).astype(np.uint8)
+    return encode_flow_records(
+        ep_id=np.full(n, 10, np.uint32),
+        identity=identities,
+        saddr=saddr,
+        daddr=np.full(n, ip_u32("10.0.0.10"), np.uint32),
+        sport=np.full(n, 40000, np.uint16),
+        dport=rng.choice([80, 443], size=n).astype(np.uint16),
+        proto=np.full(n, 6, np.uint8),
+        direction=np.zeros(n, np.uint8),
+        is_fragment=frag,
+    )
+
+
+# the three reasons this world can produce, in canonical spelling
+REASONS = (
+    "Policy denied (CIDR)",
+    "Policy denied (L3)",
+    "Fragmentation needed",
+)
+
+
+def run_smoke(n: int = 512, batch_size: int = 128) -> dict:
+    from cilium_tpu import option
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.flow.store import VERDICT_DROPPED, VERDICT_FORWARDED
+    from cilium_tpu.metrics import registry as metrics
+
+    rng = np.random.default_rng(17)
+    d, server_id, client_id, peer_id = build_world()
+    # sampling DISABLED: level `none` captures every allow; drops are
+    # never sampled at any level
+    option.Config.opts[option.MONITOR_AGGREGATION] = (
+        option.MONITOR_AGG_NONE
+    )
+    buf = make_buf(rng, n, client_id, peer_id)
+
+    drop_before = {
+        reason: sum(
+            metrics.drop_count.get(reason, dname)
+            for dname in ("INGRESS", "EGRESS")
+        )
+        for reason in REASONS
+    }
+    seq_before = d.flow_store.last_seq
+    stats = d.process_flows(buf, batch_size=batch_size)
+    assert stats.total == n, (stats.total, n)
+
+    records = [
+        r for r in d.flow_store.snapshot() if r.seq > seq_before
+    ]
+    drops = [r for r in records if r.verdict == VERDICT_DROPPED]
+    allows = [r for r in records if r.verdict == VERDICT_FORWARDED]
+
+    # -- every denied tuple appears EXACTLY once ------------------------
+    assert len(drops) == stats.denied, (len(drops), stats.denied)
+    # sampling disabled → every allow recorded too
+    assert len(allows) == stats.allowed, (len(allows), stats.allowed)
+
+    # -- bit-consistency with the telemetry plane: per-reason record
+    # counts == cilium_drop_count_total deltas --------------------------
+    per_reason = {
+        reason: sum(1 for r in drops if r.drop_reason == reason)
+        for reason in REASONS
+    }
+    for reason in REASONS:
+        delta = (
+            sum(
+                metrics.drop_count.get(reason, dname)
+                for dname in ("INGRESS", "EGRESS")
+            )
+            - drop_before[reason]
+        )
+        assert per_reason[reason] == delta, (
+            reason, per_reason[reason], delta,
+        )
+    assert per_reason["Policy denied (CIDR)"] > 0
+    assert per_reason["Policy denied (L3)"] > 0
+    assert per_reason["Fragmentation needed"] > 0
+
+    # -- filter subsets are EXACT over the query plane ------------------
+    api = DaemonAPI(d)
+    full = api.flows_get({"last": "0", "since-seq": str(seq_before)})
+    assert full["matched"] == 0  # last=0 is the cursor probe
+    dump = api.flows_get(
+        {"last": str(n + 64), "since-seq": str(seq_before)}
+    )["flows"]
+    assert len(dump) == len(records)
+
+    def brute(pred):
+        return [f for f in dump if pred(f)]
+
+    subsets = {
+        "verdict=DROPPED": (
+            {"verdict": "DROPPED"},
+            lambda f: f["verdict"] == "DROPPED",
+        ),
+        "drop-reason=CIDR": (
+            {"drop-reason": "Policy denied (CIDR)"},
+            lambda f: f["drop_reason"] == "Policy denied (CIDR)",
+        ),
+        "identity=client": (
+            {"identity": str(client_id)},
+            lambda f: client_id
+            in (f["src_identity"], f["dst_identity"]),
+        ),
+        "port=80": (
+            {"port": "80"},
+            lambda f: f["dport"] == 80,
+        ),
+        "proto=tcp": (
+            {"proto": "tcp"},
+            lambda f: f["proto"] == 6,
+        ),
+        "direction=ingress": (
+            {"direction": "ingress"},
+            lambda f: f["direction"] == "ingress",
+        ),
+        "ep=10": ({"ep": "10"}, lambda f: f["ep_id"] == 10),
+        "dropped&port=443": (
+            {"verdict": "DROPPED", "port": "443"},
+            lambda f: f["verdict"] == "DROPPED"
+            and f["dport"] == 443,
+        ),
+    }
+    for name, (params, pred) in subsets.items():
+        got = api.flows_get(
+            {
+                **params,
+                "last": str(n + 64),
+                "since-seq": str(seq_before),
+            }
+        )["flows"]
+        want = brute(pred)
+        assert got == want, (
+            f"filter {name} not exact: {len(got)} != {len(want)}"
+        )
+
+    summary = api.flows_summary()
+    assert summary["top_drop_reasons"][0]["count"] == max(
+        per_reason.values()
+    )
+    return {
+        "smoke": "ok",
+        "total": stats.total,
+        "denied": stats.denied,
+        "allowed": stats.allowed,
+        "per_reason": per_reason,
+        "records": len(records),
+        "filters_checked": len(subsets),
+    }
+
+
+def main() -> int:
+    print(json.dumps(run_smoke()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
