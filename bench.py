@@ -409,6 +409,51 @@ def encode_pool_sample(pool, picks):
     )
 
 
+def add_one_rule(
+    d, port: int, app: str = "app0", team: str = "t0",
+    label_prefix: str = "bench-incr",
+) -> None:
+    """The one-rule churn unit shared by the incremental/delta bench
+    sections and tools/churnprof.py: allow `team` → `app` on one TCP
+    port.  Keeping ONE builder means every churn metric measures the
+    same rule shape."""
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+
+    d.policy_add(
+        [
+            Rule(
+                endpoint_selector=EndpointSelector(
+                    match_labels={"k8s.app": app}
+                ),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[
+                            EndpointSelector(
+                                match_labels={"k8s.team": team}
+                            )
+                        ],
+                        to_ports=[
+                            PortRule(ports=[
+                                PortProtocol(
+                                    port=str(port), protocol="TCP"
+                                )
+                            ])
+                        ],
+                    )
+                ],
+                labels=LabelArray.parse(f"{label_prefix}-{port}"),
+            )
+        ]
+    )
+
+
 def run_config5(args) -> None:
     import jax
 
@@ -893,38 +938,9 @@ def run_config5(args) -> None:
     # (pkg/endpoint/policy.go:540-552): adding one rule re-lowers only
     # the endpoints it selects.  Measured: policy_add → delta-scoped
     # regenerate → fresh published tables.
-    from cilium_tpu.labels import LabelArray
-    from cilium_tpu.policy.api import (
-        EndpointSelector as _ES,
-        IngressRule as _IR,
-        PortProtocol as _PP,
-        PortRule as _PR,
-        Rule as _Rule,
-    )
-
     ver_before = d.endpoint_manager.published()[0]
     t0 = time.perf_counter()
-    d.policy_add(
-        [
-            _Rule(
-                endpoint_selector=_ES(
-                    match_labels={"k8s.app": "app0"}
-                ),
-                ingress=[
-                    _IR(
-                        from_endpoints=[
-                            _ES(match_labels={"k8s.team": "t0"})
-                        ],
-                        to_ports=[
-                            _PR(ports=[_PP(port="4242",
-                                           protocol="TCP")])
-                        ],
-                    )
-                ],
-                labels=LabelArray.parse("bench-incremental"),
-            )
-        ]
-    )
+    add_one_rule(d, 4242, label_prefix="bench-incremental")
     d.regenerate_all("incremental-update bench")
     incr_ms = (time.perf_counter() - t0) * 1000
     assert d.endpoint_manager.published()[0] > ver_before
@@ -935,6 +951,69 @@ def run_config5(args) -> None:
         note=(
             "one rule added to the full world -> delta-scoped "
             "regenerate -> new published tables"
+        ),
+    )
+
+    # --- delta DEVICE publication: one rule -> in-place epoch scatter ------
+    # The reference updates individual policymap entries in place
+    # (pkg/maps/policymap) — here the compiler diffs the lowered rows
+    # and the device store patches the standby epoch with
+    # `.at[idx].set(rows)` instead of re-uploading every table.
+    from cilium_tpu.compiler.delta import tables_nbytes
+
+    em = d.endpoint_manager
+
+    def _one_rule(port: int) -> None:
+        add_one_rule(d, port, label_prefix="bench-delta")
+        d.regenerate_all("delta-update bench")
+        em.published_device()
+
+    # prime both epochs + the scatter jit's payload shape classes so
+    # the timed update measures the steady-state delta path
+    em.published_device()
+    for port in (4301, 4302, 4303):
+        _one_rule(port)
+    t0 = time.perf_counter()
+    _one_rule(4304)
+    delta_ms = (time.perf_counter() - t0) * 1000
+    st = em.last_publish_stats
+    assert st is not None and st.mode == "delta", (
+        f"steady-state update did not take the delta path: {st}"
+    )
+    # bit-identity gate: every device-epoch leaf equals the host
+    # compile it was scattered from
+    _, host_tables, _, _ = em.published_with_states()
+    _, dev_tables, _ = em.published_device()
+    for leaf in (
+        "id_table", "id_direct", "id_lo_len", "port_slot", "l4_meta",
+        "l4_allow_bits", "l3_allow_bits", "l4_hash_rows",
+        "l4_hash_stash", "l4_wild_rows", "l4_wild_stash",
+    ):
+        assert np.array_equal(
+            np.asarray(getattr(dev_tables, leaf)),
+            np.asarray(getattr(host_tables, leaf)),
+        ), f"delta-built device epoch diverged from host ({leaf})"
+    full_bytes = tables_nbytes(host_tables)
+    emit(
+        "delta_update_ms",
+        round(delta_ms, 1),
+        "ms",
+        note=(
+            "one rule added to the full world -> delta-scoped "
+            "regenerate -> in-place device epoch scatter "
+            "(bit-identical to the host compile)"
+        ),
+    )
+    emit(
+        "delta_update_bytes_h2d",
+        int(st.bytes_h2d),
+        "bytes",
+        full_upload_bytes=int(full_bytes),
+        reduction=round(full_bytes / max(int(st.bytes_h2d), 1), 1),
+        scatter_leaves=st.scatter_leaves,
+        note=(
+            "bytes shipped host->device per delta publish vs "
+            "re-uploading every table"
         ),
     )
 

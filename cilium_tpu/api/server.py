@@ -425,9 +425,19 @@ class DaemonAPI:
             # each delivered batch; the batch stays in `pending`
             # until the client's NEXT poll acknowledges it (ack=seq),
             # so a reply lost to a client hang-up mid-write is
-            # re-delivered instead of silently dropped
+            # re-delivered instead of silently dropped.  `lock`
+            # serializes polls per session: two concurrent polls
+            # would each drain events and overwrite the single
+            # `pending` slot, silently dropping one delivered-but-
+            # unacked batch
             self._monitor_sessions[sid] = (
-                q, [_time.monotonic()], {"seq": 0, "pending": None},
+                q,
+                [_time.monotonic()],
+                {
+                    "seq": 0,
+                    "pending": None,
+                    "lock": threading.Lock(),
+                },
             )
         return {"session": sid}
 
@@ -445,56 +455,80 @@ class DaemonAPI:
                 return None
             q, last, state = entry
             last[0] = _time.monotonic()
-            if state["pending"] is not None:
-                if ack is None or ack == state["seq"]:
-                    # ack'd — or a legacy client that never acks
-                    # (implicit ack keeps old pollers moving; only
-                    # ack-aware clients get the re-delivery guarantee)
-                    state["pending"] = None
-                else:
-                    # the previous reply never reached the client
-                    # (hang-up mid-write): re-deliver the same batch
-                    # under the same seq
-                    return dict(state["pending"])
-        deadline = _time.monotonic() + min(timeout, 30.0)
-        max_events = max(1, max_events)
-        events = []
-        while not events:
-            # blocking wakeup from MonitorBus.publish — no spin
-            remaining = deadline - _time.monotonic()
-            if remaining <= 0:
-                break
-            if not self.daemon.monitor.wait_for_events(
-                q, remaining
-            ):
-                break
+            poll_lock = state.setdefault("lock", threading.Lock())
+        # Serialize polls per session OUTSIDE the registry lock: a
+        # second concurrent poll waits for the first to finish (its
+        # blocking wait is bounded by the 30 s timeout clamp below)
+        # instead of racing it for the single pending slot; a poller
+        # that cannot get the lock within that bound reports busy
+        # rather than corrupting the ack protocol.  Clamp garbage
+        # timeouts (negative, NaN) to 0 — Lock.acquire raises on
+        # them, and a bad query param must not become a 500.
+        timeout = min(timeout, 30.0)
+        if not timeout > 0:
+            timeout = 0.0
+        if not poll_lock.acquire(timeout=timeout + 5.0):
+            return {"events": [], "lost": 0, "busy": True}
+        try:
             with self._monitor_lock:
-                # concurrent polls on one sid: drain under the lock
-                # so both cannot popleft the same event
-                while q and len(events) < max_events:
-                    ev = q.popleft()
-                    events.append(
-                        {
-                            "event": type(ev).__name__,
-                            **dataclasses.asdict(ev),
-                        }
-                    )
-        reply = {
-            "events": events,
-            # THIS session's drops since the LAST poll, not the
-            # bus-global count (one abandoned subscriber must not
-            # inflate everyone's loss report, and a one-time overflow
-            # must not read as ongoing loss forever)
-            "lost": self.daemon.monitor.queue_drops(q, reset=True),
-        }
-        with self._monitor_lock:
-            entry = self._monitor_sessions.get(sid)
-            if entry is not None and events:
-                state = entry[2]
-                state["seq"] += 1
-                reply["seq"] = state["seq"]
-                state["pending"] = dict(reply)
-        return reply
+                entry = self._monitor_sessions.get(sid)
+                if entry is None:  # expired while waiting
+                    return None
+                q, last, state = entry
+                last[0] = _time.monotonic()
+                if state["pending"] is not None:
+                    if ack is None or ack == state["seq"]:
+                        # ack'd — or a legacy client that never acks
+                        # (implicit ack keeps old pollers moving;
+                        # only ack-aware clients get the re-delivery
+                        # guarantee)
+                        state["pending"] = None
+                    else:
+                        # the previous reply never reached the client
+                        # (hang-up mid-write): re-deliver the same
+                        # batch under the same seq
+                        return dict(state["pending"])
+            deadline = _time.monotonic() + min(timeout, 30.0)
+            max_events = max(1, max_events)
+            events = []
+            while not events:
+                # blocking wakeup from MonitorBus.publish — no spin
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                if not self.daemon.monitor.wait_for_events(
+                    q, remaining
+                ):
+                    break
+                with self._monitor_lock:
+                    while q and len(events) < max_events:
+                        ev = q.popleft()
+                        events.append(
+                            {
+                                "event": type(ev).__name__,
+                                **dataclasses.asdict(ev),
+                            }
+                        )
+            reply = {
+                "events": events,
+                # THIS session's drops since the LAST poll, not the
+                # bus-global count (one abandoned subscriber must not
+                # inflate everyone's loss report, and a one-time
+                # overflow must not read as ongoing loss forever)
+                "lost": self.daemon.monitor.queue_drops(
+                    q, reset=True
+                ),
+            }
+            with self._monitor_lock:
+                entry = self._monitor_sessions.get(sid)
+                if entry is not None and events:
+                    state = entry[2]
+                    state["seq"] += 1
+                    reply["seq"] = state["seq"]
+                    state["pending"] = dict(reply)
+            return reply
+        finally:
+            poll_lock.release()
 
     def monitor_close(self, sid: str) -> dict:
         with self._monitor_lock:

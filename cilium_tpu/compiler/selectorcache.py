@@ -87,6 +87,8 @@ class SelectorCache:
         self._postings: Dict[int, List[Tuple[str, str]]] = {}
         self._all: Set[int] = set()
         self.version = 0
+        # (allocator cache version, own version) of the last full sync
+        self._synced: Tuple[int, int] = (-1, -1)
         self._memo: "weakref.WeakKeyDictionary[EndpointSelector, Tuple[int, FrozenSet[int]]]" = (
             weakref.WeakKeyDictionary()
         )
@@ -146,16 +148,31 @@ class SelectorCache:
                 self._unindex_identity(num_id)
                 self.version += 1
 
-    def sync(self, identity_cache: IdentityCache) -> int:
+    def sync(
+        self, identity_cache: IdentityCache, cache_version=None
+    ) -> int:
         """Diff the universe against a full identity-cache snapshot
         (getLabelsMap, policy.go:194) and apply adds/changes/removes
-        incrementally.  Returns the resulting version."""
+        incrementally.  Returns the resulting version.
+
+        `cache_version` is the allocator's version stamp for this
+        snapshot: when it matches the previously synced stamp (and no
+        out-of-band upsert/remove moved the cache since), the
+        O(universe) diff is skipped entirely — the hot path for
+        rule-only churn, where the identity universe is untouched."""
         with self._lock:
+            if (
+                cache_version is not None
+                and self._synced == (cache_version, self.version)
+            ):
+                return self.version
             for num_id in list(self._universe):
                 if num_id not in identity_cache:
                     self.remove_identity(num_id)
             for num_id, labels in identity_cache.items():
                 self.upsert_identity(num_id, labels)
+            if cache_version is not None:
+                self._synced = (cache_version, self.version)
             return self.version
 
     def identities(self) -> FrozenSet[int]:

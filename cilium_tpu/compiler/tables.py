@@ -29,8 +29,9 @@ and verdict bits are integers end-to-end — no floats (hard part 5).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -109,29 +110,21 @@ def l4h_key1(dport, proto, ep):
     )
 
 
-def build_l4_hash(
-    ep: np.ndarray,
-    d: np.ndarray,
-    idx: np.ndarray,
-    dport: np.ndarray,
-    proto: np.ndarray,
+def place_l4_hash(
+    w0: np.ndarray,
+    w1: np.ndarray,
     value: np.ndarray,
-    min_rows: int = 64,
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Vectorized bucket placement of T entries → (rows u32 [R, 128],
-    stash u32 [L4H_STASH, 3]).  R is a power of two sized for ~16
-    entries per 42-capacity row; rows double until the overflow fits
-    the stash (never in practice — the tail is Poisson)."""
-    t = len(ep)
-    if np.any((idx >= L4H_WILD_IDX) & (idx != L4H_WILD_IDX)):
-        raise ValueError("identity index exceeds 22-bit hash key space")
-    if t and int(ep.max()) >= 65536:
-        # the empty-lane marker relies on ep >> 9 < 128; the reference
-        # caps endpoint ids at 65535 too (pkg/endpoint/endpoint.go)
-        raise ValueError("endpoint axis exceeds the 16-bit key space")
-    w0 = l4h_key0(idx, d, ep)
-    w1 = l4h_key1(dport, proto, ep)
-    h = _fnv1a_host_2(w0, w1)
+    h: np.ndarray,
+    min_rows: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Sizing + bucket placement over precomputed key/hash columns —
+    THE layout implementation, shared by build_l4_hash and the
+    incremental delta builder (compiler/delta.py), whose bit-identity
+    contract depends on there being exactly one copy of this logic.
+    Returns (rows, stash, overflow_positions, buckets): the last two
+    let the delta builder reconstruct its per-bucket overflow state
+    without re-deriving the placement."""
+    t = len(w0)
     n_rows = _pow2_at_least(max(t // L4H_LOAD, 1), min_rows)
     while True:
         b = (h & np.uint32(n_rows - 1)).astype(np.int64)
@@ -158,6 +151,33 @@ def build_l4_hash(
     stash[: len(so), 0] = w0[so]
     stash[: len(so), 1] = w1[so]
     stash[: len(so), 2] = value[so]
+    return rows, stash, so, b
+
+
+def build_l4_hash(
+    ep: np.ndarray,
+    d: np.ndarray,
+    idx: np.ndarray,
+    dport: np.ndarray,
+    proto: np.ndarray,
+    value: np.ndarray,
+    min_rows: int = 64,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized bucket placement of T entries → (rows u32 [R, 128],
+    stash u32 [L4H_STASH, 3]).  R is a power of two sized for ~16
+    entries per 42-capacity row; rows double until the overflow fits
+    the stash (never in practice — the tail is Poisson)."""
+    t = len(ep)
+    if np.any((idx >= L4H_WILD_IDX) & (idx != L4H_WILD_IDX)):
+        raise ValueError("identity index exceeds 22-bit hash key space")
+    if t and int(ep.max()) >= 65536:
+        # the empty-lane marker relies on ep >> 9 < 128; the reference
+        # caps endpoint ids at 65535 too (pkg/endpoint/endpoint.go)
+        raise ValueError("endpoint axis exceeds the 16-bit key space")
+    w0 = l4h_key0(idx, d, ep)
+    w1 = l4h_key1(dport, proto, ep)
+    h = _fnv1a_host_2(w0, w1)
+    rows, stash, _, _ = place_l4_hash(w0, w1, value, h, min_rows)
     return rows, stash
 
 
@@ -544,10 +564,24 @@ class FleetCompiler:
         self._reset()
 
     def _reset(self) -> None:
+        from cilium_tpu.compiler.delta import IncrementalHashPair
+
+        # monotone reset marker: a reset mid-compile (identity
+        # removal) invalidates every delta precondition captured
+        # before it
+        self._reset_count = getattr(self, "_reset_count", 0) + 1
         self._id_list: List[int] = []
         self._id_index: Dict[int, int] = {}
         self._slot_of: Dict[Tuple[int, int], int] = {}
         self._slot_list: List[Tuple[int, int]] = []  # arrival order
+        # delta-publication state: the incremental hashed-table pair,
+        # the last compile's shape class, the per-publish change
+        # records delta_for merges, and the caller-provided universe
+        # version that short-circuits _sync_universe
+        self._hash_pair = IncrementalHashPair()
+        self._shape_state: Optional[dict] = None
+        self._pub_records = deque(maxlen=8)
+        self._universe_token = None
         # (len, sorted_pairs, order) cache for _slot_pair_lut
         self._slot_lut_cache = None
         # double-buffered port_slot: each buffer tracks how many slots
@@ -915,22 +949,45 @@ class FleetCompiler:
         self,
         endpoints: Sequence[Tuple[int, PolicyMapState, int]],
         identity_ids: Sequence[int],
+        universe_token=None,
     ) -> Tuple[PolicyTables, Dict[int, int]]:
         """Lower the fleet incrementally.
 
         `endpoints` is [(ep_id, realized_map_state, state_token)];
         rows are relowered only when the token differs from the cached
-        one.  Returns (tables, ep_id → endpoint-axis index).
+        one.  `universe_token`, when provided, is the caller's version
+        stamp of `identity_ids` (the identity-allocator version): a
+        compile whose token matches the previous one skips the
+        O(universe) identity-set diff entirely — the caller warrants
+        the id set is unchanged.  Returns (tables, ep_id →
+        endpoint-axis index).
         """
         with self._compile_lock:
-            return self._compile_locked(endpoints, identity_ids)
+            return self._compile_locked(
+                endpoints, identity_ids, universe_token
+            )
 
     def _compile_locked(
         self,
         endpoints: Sequence[Tuple[int, PolicyMapState, int]],
         identity_ids: Sequence[int],
+        universe_token=None,
     ) -> Tuple[PolicyTables, Dict[int, int]]:
-        self._sync_universe(identity_ids)
+        prev_id_len = len(self._id_list)
+        prev_slots = len(self._slot_list)
+        shape_prev = self._shape_state
+        reset_before = self._reset_count
+        if (
+            universe_token is None
+            or self._universe_token is None
+            or universe_token != self._universe_token
+        ):
+            self._sync_universe(identity_ids)
+            if self._reset_count != reset_before:  # _reset() ran
+                prev_id_len = 0
+                prev_slots = 0
+                shape_prev = None
+            self._universe_token = universe_token
 
         live = {ep_id for ep_id, _, _ in endpoints}
         for gone in set(self._rows) - live:
@@ -966,8 +1023,10 @@ class FleetCompiler:
             l4_bits = np.zeros((1, 2, kg, w), dtype=np.uint32)
             l3_bits = np.zeros((1, 2, w), dtype=np.uint32)
 
-        hash_rows, hash_stash, wild_rows, wild_stash = (
-            self._build_hash(order)
+        (hash_rows, hash_stash, wild_rows, wild_stash), hash_info = (
+            self._hash_pair.build(
+                order, self._rows, [ep_id for ep_id, _, _ in dirty]
+            )
         )
         tables = PolicyTables(
             id_table=self._id_table,
@@ -986,7 +1045,204 @@ class FleetCompiler:
         tables.generation = np.uint64(
             (self._instance_nonce << 32) | self._generation
         )
+        self._record_publish(
+            shape_prev, order, index, dirty, n, w, kg,
+            prev_id_len, prev_slots, hash_info,
+        )
         return tables, index
+
+    # -- delta publication records -------------------------------------------
+
+    def _record_publish(
+        self,
+        shape_prev: Optional[dict],
+        order: List[int],
+        index: Dict[int, int],
+        dirty: list,
+        n: int,
+        w: int,
+        kg: int,
+        prev_id_len: int,
+        prev_slots: int,
+        hash_info: dict,
+    ) -> None:
+        """Append the per-publish change record delta_for merges: per
+        leaf, either the indices that changed since the previous
+        publish or None (= the leaf's shape class moved and it must
+        ship whole)."""
+        order_t = tuple(order)
+        direct_len = (
+            len(self._id_direct) if self._id_direct is not None else 0
+        )
+        shape_now = {
+            "order": order_t, "kg": kg, "w": w, "n": n,
+            "direct_len": direct_len, "lo_len": self._id_lo_len,
+        }
+        stack_full = (
+            shape_prev is None
+            or shape_prev["order"] != order_t
+            or shape_prev["kg"] != kg
+            or shape_prev["w"] != w
+        )
+        id_full = shape_prev is None or shape_prev["n"] != n
+        direct_full = (
+            shape_prev is None
+            or shape_prev["direct_len"] != direct_len
+            or shape_prev["lo_len"] != self._id_lo_len
+        )
+        new_ids = self._id_list[prev_id_len:]
+        direct_pos = None
+        if not direct_full:
+            direct_pos = np.asarray(
+                [
+                    i if i < LOCAL_ID_BASE
+                    else self._id_lo_len + i - LOCAL_ID_BASE
+                    for i in new_ids
+                ],
+                np.int64,
+            )
+        rec = {
+            "gen": self._generation,
+            "stack": (
+                None if stack_full
+                else sorted({index[ep_id] for ep_id, _, _ in dirty})
+            ),
+            "id_table": (
+                None if id_full else (prev_id_len, len(self._id_list))
+            ),
+            "id_direct": direct_pos,
+            "slots": (prev_slots, len(self._slot_list)),
+            "hash_exact": hash_info.get("exact"),
+            "hash_exact_stash": hash_info.get("exact_stash", True),
+            "hash_wild": hash_info.get("wild"),
+            "hash_wild_stash": hash_info.get("wild_stash", True),
+        }
+        self._pub_records.append(rec)
+        self._shape_state = shape_now
+
+    def delta_for(
+        self, base_stamp: Optional[int], tables: PolicyTables
+    ):
+        """TableDelta describing every change from the publish stamped
+        `base_stamp` to `tables` (which must be THIS compiler's most
+        recent compile), or None when no delta can be derived (unknown
+        base, record gap, different compiler instance) and the caller
+        must full-upload.  Scatter values are fresh copies taken from
+        `tables` — safe to ship asynchronously."""
+        from cilium_tpu.compiler.delta import LeafUpdate, TableDelta
+
+        with self._compile_lock:
+            if not base_stamp:
+                return None
+            if (base_stamp >> 32) != self._instance_nonce:
+                return None
+            cur_stamp = int(np.asarray(tables.generation))
+            if cur_stamp != (
+                (self._instance_nonce << 32) | self._generation
+            ):
+                return None
+            base_gen = base_stamp & 0xFFFFFFFF
+            if base_gen == self._generation:
+                return TableDelta(base_stamp, cur_stamp)
+            recs = [
+                r for r in self._pub_records
+                if base_gen < r["gen"] <= self._generation
+            ]
+            if len(recs) != self._generation - base_gen:
+                return None  # record gap (reset or deque overflow)
+            delta = TableDelta(base_stamp, cur_stamp)
+            delta.replace["generation"] = np.uint64(cur_stamp)
+
+            def scatter1(name, arr, idx_list):
+                idx = np.asarray(sorted(idx_list), np.int64)
+                if len(idx):
+                    delta.updates[name] = LeafUpdate(
+                        (idx,), arr[idx]
+                    )
+
+            # stacked per-endpoint rows
+            if any(r["stack"] is None for r in recs):
+                delta.replace["l4_meta"] = tables.l4_meta
+                delta.replace["l4_allow_bits"] = tables.l4_allow_bits
+                delta.replace["l3_allow_bits"] = tables.l3_allow_bits
+            else:
+                rows = set()
+                for r in recs:
+                    rows.update(r["stack"])
+                scatter1("l4_meta", tables.l4_meta, rows)
+                scatter1("l4_allow_bits", tables.l4_allow_bits, rows)
+                scatter1("l3_allow_bits", tables.l3_allow_bits, rows)
+
+            # identity universe
+            if any(r["id_table"] is None for r in recs):
+                delta.replace["id_table"] = tables.id_table
+            else:
+                lo = min(r["id_table"][0] for r in recs)
+                hi = max(r["id_table"][1] for r in recs)
+                if hi > lo:
+                    delta.updates["id_table"] = LeafUpdate(
+                        (np.arange(lo, hi, dtype=np.int64),),
+                        tables.id_table[lo:hi].copy(),
+                    )
+            if any(r["id_direct"] is None for r in recs):
+                delta.replace["id_direct"] = tables.id_direct
+                delta.replace["id_lo_len"] = np.int32(
+                    self._id_lo_len
+                )
+            else:
+                pos = np.unique(
+                    np.concatenate(
+                        [r["id_direct"] for r in recs]
+                        + [np.zeros(0, np.int64)]
+                    )
+                )
+                if len(pos):
+                    delta.updates["id_direct"] = LeafUpdate(
+                        (pos,), tables.id_direct[pos]
+                    )
+
+            # (proto, dport) → slot cells: append-only, write-once
+            slot_lo = min(r["slots"][0] for r in recs)
+            slot_hi = max(r["slots"][1] for r in recs)
+            if slot_hi > slot_lo:
+                cells = self._slot_list[slot_lo:slot_hi]
+                delta.updates["port_slot"] = LeafUpdate(
+                    (
+                        np.asarray(
+                            [pr & 0xFF for _, pr in cells], np.int64
+                        ),
+                        np.asarray([dp for dp, _ in cells], np.int64),
+                    ),
+                    np.arange(slot_lo, slot_hi, dtype=np.uint16),
+                )
+
+            # hashed entry tables
+            for leaf, stash_leaf, key in (
+                ("l4_hash_rows", "l4_hash_stash", "hash_exact"),
+                ("l4_wild_rows", "l4_wild_stash", "hash_wild"),
+            ):
+                arr = getattr(tables, leaf)
+                if any(r[key] is None for r in recs) or (
+                    arr.shape[0]
+                    != getattr(
+                        self._hash_pair,
+                        "exact" if key == "hash_exact" else "wild",
+                    ).n_rows
+                ):
+                    delta.replace[leaf] = arr
+                    delta.replace[stash_leaf] = getattr(
+                        tables, stash_leaf
+                    )
+                    continue
+                rows = set()
+                for r in recs:
+                    rows.update(r[key])
+                scatter1(leaf, arr, rows)
+                if any(r[key + "_stash"] for r in recs):
+                    delta.replace[stash_leaf] = getattr(
+                        tables, stash_leaf
+                    )
+            return delta
 
     def check_tables_current(self, tables) -> None:
         """Enforce the documented one-flip staleness window on the
@@ -1015,29 +1271,6 @@ class FleetCompiler:
                 f"double-buffered rows have been overwritten)"
             )
 
-    def _build_hash(self, order: List[int]):
-        """Concatenate every endpoint's cached entry columns (adding
-        the stack-position ep bits, which are only known here) and
-        place them into the hashed probe table.  O(total entries) with
-        vectorized hashing/placement — ~0.5 s for 4M entries."""
-        ents = [self._rows[ep_id]["ent"] for ep_id in order]
-        if not ents:
-            return build_l4_hash_pair(*([np.zeros(0, np.uint32)] * 6))
-        ep = np.concatenate(
-            [
-                np.full(len(e["d"]), i, np.uint32)
-                for i, e in enumerate(ents)
-            ]
-        )
-        cat = {
-            k: np.concatenate([e[k] for e in ents])
-            for k in ("d", "idx", "dport", "proto", "val")
-        }
-        return build_l4_hash_pair(
-            ep, cat["d"], cat["idx"], cat["dport"], cat["proto"],
-            cat["val"],
-        )
-
     def _stacked(self, order: List[int], kg: int, w: int):
         """Write rows into the standby stacked buffer, copying only
         endpoints whose token differs from what this buffer already
@@ -1065,4 +1298,17 @@ class FleetCompiler:
             buf["l4"][i] = rows["l4"]
             buf["l3"][i] = rows["l3"]
             tokens[ep_id] = rows["token"]
+        # pre-warm the standby buffer: its first full clone happens
+        # at full-(re)stack time, so the next publish copies only the
+        # endpoints dirtied in between instead of the whole fleet
+        other_i = self._stack_flip ^ 1
+        other = self._stack_bufs[other_i]
+        if other is None or other["shape_key"] != shape_key:
+            self._stack_bufs[other_i] = {
+                "shape_key": shape_key,
+                "meta": buf["meta"].copy(),
+                "l4": buf["l4"].copy(),
+                "l3": buf["l3"].copy(),
+                "tokens": dict(tokens),
+            }
         return buf["meta"], buf["l4"], buf["l3"]

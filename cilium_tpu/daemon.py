@@ -211,6 +211,9 @@ class Daemon:
         # per-batch dispatch deadline (a wedged XLA launch must fail
         # the batch, not hang the stream); <=0 disables
         self.dispatch_watchdog = DispatchWatchdog(timeout=30.0)
+        # device table-publication backoff (monotonic deadline): a
+        # failed epoch publish must not be retried per batch
+        self._device_publish_retry_at = 0.0
         # bounded admission: flows in flight across concurrent
         # process_flows calls; excess batches shed under the
         # canonical Overload drop reason (None = unbounded)
@@ -465,9 +468,13 @@ class Daemon:
         stats = SpanStats()  # fresh per run: the histogram observes
         # THIS run's duration; regen_spans accumulates across runs
         stats.span("total").start()
-        cache = self.identity_cache()
+        cache, cache_version = (
+            self.identity_allocator.identity_cache_versioned()
+        )
         prev_version = self.selector_cache.version
-        universe_version = self.selector_cache.sync(cache)
+        universe_version = self.selector_cache.sync(
+            cache, cache_version=cache_version
+        )
         # Swap the pending set and snapshot the repo revision under
         # the daemon lock: a concurrent policy_add after the swap must
         # not be fast-forwarded past (its selector isn't in `pending`).
@@ -495,6 +502,7 @@ class Daemon:
             universe_version=universe_version,
             affected_identities=affected,
             affected_revision=affected_revision,
+            identity_cache_token=cache_version,
         )
         # Two-phase redirect realization (pkg/endpoint/bpf.go:488 +
         # policy.go:157-166): the first pass computes desired L4
@@ -599,6 +607,7 @@ class Daemon:
                 rule_index=self.rule_index,
                 universe_version=universe_version,
                 affected_revision=affected_revision,
+                identity_cache_token=cache_version,
             )
         metrics.policy_regeneration_count.inc(value=n)
         stats.span("total").end()
@@ -1213,6 +1222,29 @@ class Daemon:
         )
         if tables is None:
             raise RuntimeError("no published tables")
+        # dispatch against the device-resident epoch of THIS snapshot:
+        # repeated process_flows calls stop re-uploading the world per
+        # batch stream, and a policy publish since the last call lands
+        # as a delta-scoped scatter into the standby epoch
+        # (endpoint/manager.published_device); host_states stays the
+        # degraded fold's bit-identical substrate either way.  A
+        # failed publication latches a backoff: with the device down,
+        # per-batch delta attempts (fresh row copies + a WARNING each)
+        # would hammer exactly the degraded hot path.
+        if _time.monotonic() >= self._device_publish_retry_at:
+            try:
+                tables = self.endpoint_manager.device_tables_for(
+                    tables
+                )
+            except Exception as exc:  # device down → numpy tables
+                self._device_publish_retry_at = (
+                    _time.monotonic() + 30.0
+                )
+                log.warning(
+                    "device table publication failed; dispatching "
+                    "host arrays (retrying in 30s)",
+                    extra={"fields": {"error": str(exc)}},
+                )
         # records for endpoints this node doesn't own are dropped up
         # front (the index→axis mapping sends unknown ids to axis 0,
         # which would evaluate them under — and attribute their

@@ -53,6 +53,13 @@ class EndpointManager:
         # incremental lowering: caches identity/slot tables and
         # per-endpoint rows across publishes (delta compilation)
         self._fleet_compiler = FleetCompiler()
+        # device-resident table epochs (engine/publish.py): created
+        # lazily on the first published_device() call so control-plane
+        #-only users never pay a device upload; publishes after that
+        # apply delta scatters instead of re-uploading the world
+        self._device_store = None
+        self._device_lock = threading.RLock()
+        self.last_publish_stats = None
         # builder failure bookkeeping (endpoint.go's bpf.go:442 retry
         # counter analog): (endpoint_id, reason, repr(exc)) of the
         # most recent failed builds, surfaced via daemon status
@@ -153,6 +160,7 @@ class EndpointManager:
         universe_version=None,
         affected_identities=None,
         affected_revision=None,
+        identity_cache_token=None,
     ) -> int:
         """RegenerateAllEndpoints: mark + rebuild every endpoint (N
         builders in parallel), then publish fresh fleet tables."""
@@ -201,7 +209,9 @@ class EndpointManager:
             with self._lock:
                 self.build_failures += len(failures)
                 self.last_build_failures = failures
-        self.publish_tables(identity_cache)
+        self.publish_tables(
+            identity_cache, universe_token=identity_cache_token
+        )
         return n
 
     # -- fleet realization ---------------------------------------------------
@@ -237,11 +247,19 @@ class EndpointManager:
                 )
         return entries
 
-    def publish_tables(self, identity_cache: IdentityCache) -> int:
+    def publish_tables(
+        self,
+        identity_cache: IdentityCache,
+        universe_token=None,
+    ) -> int:
         """Double-buffered flip: compile the new version, then swap the
         published pointer atomically (consumers holding the old tables
         keep a consistent snapshot — the ACK-gated versioned flip of
         SURVEY §5).
+
+        `universe_token` is the identity-allocator version stamp of
+        `identity_cache` (see FleetCompiler.compile): matching tokens
+        skip the O(universe) identity diff inside the compiler.
 
         The EXACT map states the tables were compiled from are
         published alongside (endpoint-axis order): the daemon's
@@ -250,7 +268,7 @@ class EndpointManager:
         regenerations land mid-stream."""
         entries = self._capture_entries()
         tables, index = self._fleet_compiler.compile(
-            entries, list(identity_cache)
+            entries, list(identity_cache), universe_token=universe_token
         )
         states_by_id = {eid: state for eid, state, _ in entries}
         states: list = [None] * (max(index.values(), default=-1) + 1)
@@ -277,6 +295,66 @@ class EndpointManager:
                 self, "_published_states", []
             )
 
+    # -- device-resident epochs (engine/publish.py) ---------------------------
+
+    def _ensure_device_store(self):
+        from cilium_tpu.engine.publish import DeviceTableStore
+
+        with self._device_lock:
+            if self._device_store is None:
+                self._device_store = DeviceTableStore()
+            return self._device_store
+
+    def published_device(self):
+        """(version, device-epoch PolicyTables, index): the published
+        tables RESIDENT on device.  The first call pays a full upload;
+        later calls return the live epoch, and a publish that landed
+        since is installed as a delta-scoped scatter into the standby
+        epoch (FleetCompiler.delta_for) — in-flight batches finish on
+        the previous epoch untouched."""
+        with self._lock:
+            version, tables, index = self._published
+        if tables is None:
+            return version, None, index
+        return version, self._device_tables(tables), index
+
+    def device_tables_for(self, tables):
+        """Device-resident epoch for an EXACT published host snapshot
+        (the daemon's serving path reads tables + host states under
+        one lock and must dispatch against those same tables);
+        installs it into the store when not yet resident."""
+        return self._device_tables(tables)
+
+    def _device_tables(self, tables):
+        import numpy as np
+
+        store = self._ensure_device_store()
+        stamp = int(np.asarray(tables.generation))
+        with self._device_lock:
+            got = store.get(stamp)
+            if got is not None:
+                return got
+            delta = self._fleet_compiler.delta_for(
+                store.spare_stamp(), tables
+            )
+            dev, stats = store.publish(tables, delta)
+            self.last_publish_stats = stats
+            metrics.table_publish_total.inc(stats.mode)
+            metrics.table_publish_bytes.inc(
+                stats.mode, value=stats.bytes_h2d
+            )
+            metrics.table_publish_seconds.set(value=stats.seconds)
+            log.info(
+                "device table epoch published",
+                extra={"fields": {
+                    "epoch": stats.epoch,
+                    "mode": stats.mode,
+                    "bytes_h2d": stats.bytes_h2d,
+                    "seconds": round(stats.seconds, 4),
+                }},
+            )
+            return dev
+
     def build_failure_snapshot(self) -> Tuple[int, List[Tuple[int, str, str]]]:
         """(total count, last batch) read atomically — the two fields
         are updated together under the manager lock."""
@@ -284,8 +362,26 @@ class EndpointManager:
             return self.build_failures, list(self.last_build_failures)
 
     def check_tables_current(self, tables) -> None:
-        """See FleetCompiler.check_tables_current: raises if `tables`
-        is more than one publish old (its buffers have been reused)."""
+        """Raises if `tables` is no longer a valid snapshot: a HOST
+        compile more than one publish old (its stacked buffers have
+        been reused — FleetCompiler.check_tables_current), or a device
+        epoch that is no longer one of the two LIVE epochs (its
+        buffers were donated to a newer publish)."""
+        import numpy as np
+
+        store = self._device_store
+        if store is not None:
+            raw = getattr(tables, "generation", None)
+            stamp = int(np.asarray(raw)) if raw is not None else 0
+            if stamp:
+                if store.holds(tables):
+                    return
+                if (stamp >> 32) == 0 and store.live_stamps():
+                    # a device round trip without x64 truncates the
+                    # stamp to the publish counter — the store owns
+                    # the staleness verdict for such tables
+                    store.check_current(tables)
+                    return
         self._fleet_compiler.check_tables_current(tables)
 
     def identity_index(self) -> Tuple[Dict[int, int], int]:

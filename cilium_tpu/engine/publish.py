@@ -1,0 +1,307 @@
+"""Device-resident table epochs: versioned double-buffered publication.
+
+The serving path used to re-upload every PolicyTables leaf after each
+control-plane publish (device_put of ~hundreds of MB of numpy per
+flip).  This store keeps TWO device-resident epochs ping-ponging, the
+device analog of the realized/backup map shuffle
+(pkg/datapath/ipcache/listener.go:167):
+
+  * `publish(tables, delta)` installs the new generation into the
+    SPARE epoch.  With a TableDelta covering the spare's stamp, the
+    update is a compact jitted scatter (`tables.at[idx].set(rows)`,
+    donate_argnums on the spare pytree so XLA patches the resident
+    buffers in place) — bytes shipped are proportional to the CHANGE,
+    not the world.  Without a delta (shape-class change, stale spare)
+    it falls back to a full upload.
+  * in-flight batches dispatched against the CURRENT epoch finish on
+    it untouched; only the spare's buffers are donated.
+  * `check_current` raises for tables whose epoch has since been
+    donated — the device-side extension of
+    FleetCompiler.check_tables_current's one-flip window.
+
+Replication: pass `shardings` (a PolicyTables pytree of NamedSharding)
+and every chip of a mesh receives the same scatter — tables are
+replicated across the mesh (engine/sharded.py), so one delta updates
+the whole fleet of chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu.compiler.delta import TableDelta, tables_nbytes
+from cilium_tpu.compiler.tables import PolicyTables
+
+
+def _pad_pow2(update):
+    """Pad scatter payloads to the next power of two by repeating the
+    last entry (duplicate identical writes are deterministic), so the
+    jitted updater recompiles per size CLASS instead of per size."""
+    k = len(update.values)
+    size = 1
+    while size < k:
+        size <<= 1
+    if size == k:
+        return update.idx, update.values
+    pad = size - k
+    idx = tuple(
+        np.concatenate([i, np.repeat(i[-1:], pad)]) for i in update.idx
+    )
+    values = np.concatenate(
+        [update.values, np.repeat(update.values[-1:], pad, axis=0)]
+    )
+    return idx, values
+
+
+@dataclass
+class PublishStats:
+    epoch: int
+    mode: str  # "full" | "delta"
+    bytes_h2d: int
+    seconds: float
+    scatter_leaves: int = 0
+    replaced_leaves: int = 0
+
+
+class StaleEpochError(ValueError):
+    pass
+
+
+class DeviceTableStore:
+    """Two device table epochs with scatter-delta publication."""
+
+    def __init__(self, shardings: Optional[PolicyTables] = None) -> None:
+        self._lock = threading.Lock()
+        # each slot: dict(tables=<device pytree>, stamp=int, epoch=int)
+        self._slots = [None, None]
+        self._cur = 0
+        self._epoch = 0
+        self._shardings = shardings
+        self._apply_cache: Dict[tuple, object] = {}
+
+    # -- device placement ----------------------------------------------------
+
+    def _put(self, value, leaf: Optional[str] = None):
+        import jax
+
+        if self._shardings is None:
+            return jax.device_put(value)
+        sharding = (
+            getattr(self._shardings, leaf)
+            if leaf is not None and hasattr(self._shardings, leaf)
+            else None
+        )
+        if sharding is None:
+            # payload arrays replicate (every chip applies the same
+            # scatter); use any leaf's mesh via the generation spec
+            sharding = self._shardings.generation
+        return jax.device_put(value, sharding)
+
+    def _put_tables(self, tables: PolicyTables):
+        import jax
+
+        if self._shardings is None:
+            return jax.device_put(tables)
+        return jax.tree.map(
+            lambda leaf, s: (
+                None if leaf is None else jax.device_put(leaf, s)
+            ),
+            tables,
+            self._shardings,
+            is_leaf=lambda x: x is None,
+        )
+
+    # -- scatter updater -----------------------------------------------------
+
+    def _apply_fn(self, fields: Tuple[str, ...]):
+        """Jitted donated scatter: patch `fields` of the spare epoch
+        in place and stamp the new generation.  Cached per field set
+        (payload shapes are pow2-padded, so the per-set jit cache
+        stays small)."""
+        import jax
+
+        fn = self._apply_cache.get(fields)
+        if fn is not None:
+            return fn
+
+        def apply(tables, payloads, generation):
+            kw = {}
+            for name, (idx, values) in zip(fields, payloads):
+                kw[name] = getattr(tables, name).at[idx].set(values)
+            kw["generation"] = generation
+            return dataclasses.replace(tables, **kw)
+
+        fn = jax.jit(apply, donate_argnums=(0,))
+        self._apply_cache[fields] = fn
+        return fn
+
+    # -- publication ---------------------------------------------------------
+
+    def publish(
+        self, tables: PolicyTables, delta: Optional[TableDelta] = None
+    ) -> Tuple[PolicyTables, PublishStats]:
+        """Install `tables` (host arrays) as the new current epoch.
+        `delta` must describe every change from the SPARE slot's stamp
+        to `tables` (see FleetCompiler.delta_for); anything else —
+        or delta=None — forces a full upload."""
+        import jax
+
+        with self._lock:
+            t0 = time.perf_counter()
+            spare_i = self._cur ^ 1
+            spare = self._slots[spare_i]
+            stamp = int(np.asarray(tables.generation))
+            use_delta = (
+                delta is not None
+                and spare is not None
+                and spare["stamp"] == delta.base_stamp
+                and stamp == delta.new_stamp
+            )
+            if use_delta:
+                try:
+                    dev, stats = self._publish_delta(
+                        spare["tables"], tables, delta
+                    )
+                except Exception:
+                    # the donated scatter may have consumed the spare
+                    # epoch's buffers before failing — de-register the
+                    # slot so the next publish full-uploads instead of
+                    # scattering into deleted arrays forever
+                    self._slots[spare_i] = None
+                    raise
+            else:
+                dev = self._put_tables(tables)
+                jax.block_until_ready(dev)
+                stats = PublishStats(
+                    epoch=0, mode="full", bytes_h2d=tables_nbytes(tables),
+                    seconds=0.0,
+                )
+            self._epoch += 1
+            self._slots[spare_i] = {
+                "tables": dev, "stamp": stamp, "epoch": self._epoch,
+            }
+            self._cur = spare_i
+            stats.epoch = self._epoch
+            stats.seconds = time.perf_counter() - t0
+            return dev, stats
+
+    def _publish_delta(
+        self,
+        spare_dev: PolicyTables,
+        tables: PolicyTables,
+        delta: TableDelta,
+    ):
+        import jax
+
+        n_scatter = 0
+        n_replace = 0
+        # whole-leaf replacements land outside the jit: fresh uploads
+        # swapped into the donated pytree (the old leaf is dropped)
+        replaced = {}
+        for name, arr in delta.replace.items():
+            replaced[name] = self._put(arr, name)
+            n_replace += 1
+        base = spare_dev
+        if replaced:
+            base = dataclasses.replace(base, **replaced)
+        fields = tuple(sorted(delta.updates))
+        gen_dev = self._put(np.uint64(np.asarray(tables.generation)))
+        if fields:
+            payloads = []
+            for name in fields:
+                idx, values = _pad_pow2(delta.updates[name])
+                payloads.append(
+                    (
+                        tuple(self._put(i) for i in idx),
+                        self._put(values),
+                    )
+                )
+                n_scatter += 1
+            dev = self._apply_fn(fields)(base, tuple(payloads), gen_dev)
+        else:
+            dev = dataclasses.replace(base, generation=gen_dev)
+        jax.block_until_ready(dev)
+        return dev, PublishStats(
+            epoch=0, mode="delta", bytes_h2d=delta.bytes_h2d,
+            seconds=0.0, scatter_leaves=n_scatter,
+            replaced_leaves=n_replace,
+        )
+
+    # -- consumers -----------------------------------------------------------
+
+    def current(self) -> Optional[Tuple[int, PolicyTables]]:
+        with self._lock:
+            slot = self._slots[self._cur]
+            if slot is None:
+                return None
+            return slot["epoch"], slot["tables"]
+
+    def current_stamp(self) -> Optional[int]:
+        with self._lock:
+            slot = self._slots[self._cur]
+            return None if slot is None else slot["stamp"]
+
+    def get(self, stamp: int) -> Optional[PolicyTables]:
+        """The live epoch carrying `stamp`, if still resident (a
+        reader that snapshotted an older publish reuses its epoch
+        instead of flipping the store backward)."""
+        with self._lock:
+            for slot in self._slots:
+                if slot is not None and slot["stamp"] == stamp:
+                    return slot["tables"]
+            return None
+
+    def spare_stamp(self) -> Optional[int]:
+        """Stamp held by the standby epoch — the base the next delta
+        must cover."""
+        with self._lock:
+            spare = self._slots[self._cur ^ 1]
+            return None if spare is None else spare["stamp"]
+
+    def live_stamps(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(
+                s["stamp"] for s in self._slots if s is not None
+            )
+
+    @staticmethod
+    def _norm(stamp: int) -> int:
+        # without jax x64 the device generation leaf truncates to its
+        # low 32 bits (the publish counter); stamps are store-scoped,
+        # so comparing the counter bits stays unambiguous
+        return int(stamp) & 0xFFFFFFFF
+
+    def holds(self, tables) -> bool:
+        """True when `tables` IS one of the live (undonated) epoch
+        pytrees.  Object identity, not stamp comparison: a HOST
+        snapshot can share a stamp with a lagging device epoch while
+        its own stacked buffers have been rewritten — such tables
+        must fall through to the compiler's staleness check."""
+        with self._lock:
+            return any(
+                slot is not None and slot["tables"] is tables
+                for slot in self._slots
+            )
+
+    def check_current(self, tables) -> None:
+        """Raise unless `tables` is one of the two live epochs: older
+        epochs' buffers have been donated to a newer publish and may
+        have been overwritten in place."""
+        raw = getattr(tables, "generation", None)
+        stamp = self._norm(
+            int(np.asarray(raw)) if raw is not None else 0
+        )
+        live = self.live_stamps()
+        if not live or stamp in {self._norm(s) for s in live}:
+            return
+        raise StaleEpochError(
+            f"stale device epoch: generation {stamp} is no longer "
+            f"resident (live epochs: {live}) — its buffers were "
+            f"donated to a newer publish"
+        )

@@ -79,6 +79,31 @@ def table_specs(batch_axis: str, table_axis: str) -> PolicyTables:
     )
 
 
+def replicated_table_shardings(mesh: Mesh) -> PolicyTables:
+    """NamedShardings replicating every PolicyTables leaf across the
+    mesh — the layout make_sharded_evaluator consumes (tables
+    replicate like per-node BPF maps)."""
+    r = NamedSharding(mesh, P())
+    return PolicyTables(
+        id_table=r, id_direct=r, id_lo_len=r, port_slot=r,
+        l4_meta=r, l4_allow_bits=r, l3_allow_bits=r, generation=r,
+        l4_hash_rows=r, l4_hash_stash=r, l4_wild_rows=r,
+        l4_wild_stash=r,
+    )
+
+
+def make_replicated_store(mesh: Mesh):
+    """DeviceTableStore whose epochs replicate across `mesh`: one
+    delta publish applies the same in-place scatter on EVERY chip
+    (tables are replicated, so each chip's copy receives identical
+    `.at[idx].set(rows)` updates inside one SPMD program)."""
+    from cilium_tpu.engine.publish import DeviceTableStore
+
+    return DeviceTableStore(
+        shardings=replicated_table_shardings(mesh)
+    )
+
+
 def batch_specs(batch_axis: str) -> TupleBatch:
     s = P(batch_axis)
     return TupleBatch(

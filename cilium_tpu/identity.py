@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from cilium_tpu import labels as lbl
 from cilium_tpu.labels import Label, LabelArray, Labels
@@ -72,7 +72,14 @@ class Identity:
 
     @property
     def label_array(self) -> LabelArray:
-        return self.labels.to_label_array()
+        # labels never mutate after allocation, so the array form is
+        # computed once — identity_cache() walks every identity per
+        # snapshot and the conversion dominated control-plane latency
+        arr = self.__dict__.get("_label_array")
+        if arr is None:
+            arr = self.labels.to_label_array()
+            self.__dict__["_label_array"] = arr
+        return arr
 
     @property
     def sha256(self) -> str:
@@ -121,6 +128,11 @@ class IdentityAllocator:
         self._next_local = self.LOCAL_IDENTITY_BASE
         self._events: List = []
         self._listeners: List = []
+        # universe version: bumps whenever the id → labels map
+        # changes; identity_cache() snapshots are cached against it
+        # and the fleet compiler uses it as its universe_token
+        self._version = 0
+        self._cache_snapshot = None
         # Optional distributed backend (runtime.kvstore.Allocator shim).
         self._backend = backend
 
@@ -156,6 +168,7 @@ class IdentityAllocator:
             self._by_key[key] = ident
             self._by_id[num] = ident
             self._refs[num] = 1
+            self._version += 1
             self._notify("upsert", ident)
             return ident, True
 
@@ -173,6 +186,7 @@ class IdentityAllocator:
             del self._refs[ident.id]
             self._by_key.pop(key, None)
             self._by_id.pop(ident.id, None)
+            self._version += 1
             if self._backend is not None:
                 self._backend.release(key)
             self._notify("delete", ident)
@@ -207,17 +221,38 @@ class IdentityAllocator:
 
     # -- universe snapshot ---------------------------------------------------
 
+    @property
+    def version(self) -> int:
+        """Universe version — pairs with identity_cache() snapshots
+        (the fleet compiler's universe_token)."""
+        with self._lock:
+            return self._version
+
     def identity_cache(self) -> IdentityCache:
         """GetIdentityCache + reserved ids (endpoint getLabelsMap,
         pkg/endpoint/policy.go:194-211): snapshot of all known identities
-        including the reserved ones."""
-        cache: IdentityCache = {}
+        including the reserved ones.
+
+        Cached against the allocator version: rebuilding this map is
+        O(universe) and used to dominate every regeneration sweep.
+        Consumers treat the returned dict as read-only."""
         with self._lock:
-            for num, ident in self._by_id.items():
-                cache[num] = ident.label_array
-        for num in RESERVED_IDENTITY_NAMES:
-            cache[num] = reserved_identity(num).label_array
-        return cache
+            cached = self._cache_snapshot
+            if cached is not None and cached[0] == self._version:
+                return cached[1]
+            cache: IdentityCache = {
+                num: ident.label_array
+                for num, ident in self._by_id.items()
+            }
+            for num in RESERVED_IDENTITY_NAMES:
+                cache[num] = reserved_identity(num).label_array
+            self._cache_snapshot = (self._version, cache)
+            return cache
+
+    def identity_cache_versioned(self) -> Tuple[IdentityCache, int]:
+        """(identity_cache(), version) read under one lock."""
+        with self._lock:
+            return self.identity_cache(), self._version
 
     # -- events (identity/cache.go:82 identityWatcher) -----------------------
 

@@ -267,10 +267,14 @@ class K8sWatcher:
             if frontend is not None and self.services is not None:
                 self.services.delete(frontend)
             info = self._svc_info.pop(key, None)
-            self._svc_ports.pop(key, None)
             if self.services is not None:
-                # dependent ingress frontends drop to empty backends
+                # dependent ingress frontends drop to empty backends.
+                # _svc_ports must still hold this service's entry:
+                # a NAMED servicePort resolves through it, and popping
+                # first would resolve port 0 and leave the stale
+                # external frontend (old port, old backends) installed
                 self._sync_ingresses_for(namespace, name)
+            self._svc_ports.pop(key, None)
             if info is not None:
                 self._retranslate(info, revert=True)
             return

@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import ipaddress
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Dict, Iterable, List, Optional
 
 PATH_DELIMITER = "."
@@ -125,8 +126,11 @@ def new_label(key: str, value: str = "", source: str = "") -> Label:
     return Label(key=key, value=value, source=source)
 
 
+@lru_cache(maxsize=4096)
 def parse_label(s: str) -> Label:
-    """Parse ``[source:]key[=value]`` (labels.go:605)."""
+    """Parse ``[source:]key[=value]`` (labels.go:605).  Label is
+    frozen, so the parse memoizes safely — selector matching parses
+    the same handful of key strings millions of times per sweep."""
     src, nxt = parse_source(s)
     source = src if src != "" else SOURCE_UNSPEC
     key_split = nxt.split("=", 1)

@@ -319,6 +319,25 @@ class Registry:
             "(overflow eviction + drop-storm truncation): what a "
             "late reader can no longer see",
         )
+        # -- delta table publication (engine/publish.py) -----------------
+        self.table_publish_total = Counter(
+            f"{ns}_table_publish_total",
+            "Device table-epoch publications by mode (delta = "
+            "in-place scatter of the changed rows, full = whole "
+            "upload)",
+            ("mode",),
+        )
+        self.table_publish_bytes = Counter(
+            f"{ns}_table_publish_bytes_total",
+            "Bytes shipped host->device by table publications, "
+            "by mode",
+            ("mode",),
+        )
+        self.table_publish_seconds = Gauge(
+            f"{ns}_table_publish_last_seconds",
+            "Wall seconds of the most recent device table "
+            "publication",
+        )
         # -- phase spans + mesh telemetry --------------------------------
         self.spanstat_seconds = Gauge(
             f"{ns}_spanstat_seconds",

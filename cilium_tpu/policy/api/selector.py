@@ -148,13 +148,39 @@ class EndpointSelector:
         return reqs
 
     def matches(self, labels_to_match: Optional[LabelArray]) -> bool:
-        """selector.go:277: reserved.all short-circuits; else AND of reqs."""
+        """selector.go:277: reserved.all short-circuits; else AND of reqs.
+
+        Memoized per label-array OBJECT (identity-pinned): per-endpoint
+        resolution matches the same selectors against the same cached
+        identity label arrays every sweep, and both sides are stable
+        after construction.  The requirement list is cached too —
+        matches() used to rebuild it per call."""
+        memoize = labels_to_match is not None
         if labels_to_match is None:
+            # fresh object per call — memoizing it would only churn
+            # the cache with never-hittable ids
             labels_to_match = LabelArray()
+        memo = self.__dict__.setdefault("_match_memo", {})
+        if memoize:
+            hit = memo.get(id(labels_to_match))
+            if hit is not None and hit[0] is labels_to_match:
+                return hit[1]
         for k in self.match_labels:
             if k == lbl.SOURCE_RESERVED_KEY_PREFIX + lbl.ID_NAME_ALL:
+                # no memo insert: the short-circuit is already O(1),
+                # and memoizing here would grow a wildcard selector's
+                # memo unboundedly (this path skips the cap below)
                 return True
-        return all(r.matches(labels_to_match) for r in self.requirements())
+        reqs = self.__dict__.get("_reqs_cache")
+        if reqs is None:
+            reqs = self.requirements()
+            self.__dict__["_reqs_cache"] = reqs
+        result = all(r.matches(labels_to_match) for r in reqs)
+        if memoize:
+            if len(memo) > 4096:
+                memo.clear()
+            memo[id(labels_to_match)] = (labels_to_match, result)
+        return result
 
     def is_wildcard(self) -> bool:
         """selector.go:305."""

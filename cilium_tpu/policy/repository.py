@@ -9,7 +9,7 @@ gated on revision (the ACK-flip pattern, SURVEY.md §5).
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from cilium_tpu.labels import LabelArray
 
@@ -199,6 +199,21 @@ class Repository:
 
     # -- L3-allow -> L7-wildcard injection (repository.go:128-235) ----------
 
+    @staticmethod
+    def _l7_filter_index(l4_policy: L4PolicyMap):
+        """(protocol → port → [keys]) over the L7-carrying filters —
+        _wildcard_l3l4_rule's scan was O(rules × filters) per resolve;
+        protocol/port/parser of a filter never change while the
+        wildcard pass runs, so one index serves every rule."""
+        index: Dict[str, Dict[int, List]] = {}
+        for k, f in l4_policy.items():
+            if f.l7_parser == PARSER_TYPE_NONE:
+                continue
+            index.setdefault(f.protocol, {}).setdefault(
+                f.port, []
+            ).append(k)
+        return index
+
     def _wildcard_l3l4_rule(
         self,
         proto: str,
@@ -206,10 +221,21 @@ class Repository:
         endpoints: List,
         rule_labels: LabelArray,
         l4_policy: L4PolicyMap,
+        index=None,
     ) -> None:
         """repository.go:128: endpoints allowed at L3/L4 get wildcarded
         into every L7 filter on a matching (proto, port)."""
-        for k, f in l4_policy.items():
+        if index is not None:
+            ports = index.get(proto, {})
+            keys = (
+                [k for lst in ports.values() for k in lst]
+                if port == 0
+                else list(ports.get(port, ()))
+            )
+            items = [(k, l4_policy[k]) for k in keys]
+        else:
+            items = list(l4_policy.items())
+        for k, f in items:
             if proto != f.protocol or (port != 0 and port != f.port):
                 continue
             if f.l7_parser == PARSER_TYPE_NONE:
@@ -239,6 +265,7 @@ class Repository:
         rules=None,
     ) -> None:
         """repository.go:170."""
+        index = self._l7_filter_index(l4_policy)
         for r in self.rules if rules is None else rules:
             if ingress:
                 if not r.endpoint_selector.matches(ctx.to_labels):
@@ -250,10 +277,12 @@ class Repository:
                     rule_labels = LabelArray(r.rule.labels)
                     if len(rule.to_ports) == 0:
                         self._wildcard_l3l4_rule(
-                            PROTO_TCP, 0, from_endpoints, rule_labels, l4_policy
+                            PROTO_TCP, 0, from_endpoints, rule_labels,
+                            l4_policy, index,
                         )
                         self._wildcard_l3l4_rule(
-                            PROTO_UDP, 0, from_endpoints, rule_labels, l4_policy
+                            PROTO_UDP, 0, from_endpoints, rule_labels,
+                            l4_policy, index,
                         )
                     else:
                         for to_port in rule.to_ports:
@@ -268,6 +297,7 @@ class Repository:
                                         from_endpoints,
                                         rule_labels,
                                         l4_policy,
+                                        index,
                                     )
             else:
                 if not r.endpoint_selector.matches(ctx.from_labels):
@@ -279,10 +309,12 @@ class Repository:
                     rule_labels = LabelArray(r.rule.labels)
                     if len(rule.to_ports) == 0:
                         self._wildcard_l3l4_rule(
-                            PROTO_TCP, 0, to_endpoints, rule_labels, l4_policy
+                            PROTO_TCP, 0, to_endpoints, rule_labels,
+                            l4_policy, index,
                         )
                         self._wildcard_l3l4_rule(
-                            PROTO_UDP, 0, to_endpoints, rule_labels, l4_policy
+                            PROTO_UDP, 0, to_endpoints, rule_labels,
+                            l4_policy, index,
                         )
                     else:
                         for to_port in rule.to_ports:
@@ -297,6 +329,7 @@ class Repository:
                                         to_endpoints,
                                         rule_labels,
                                         l4_policy,
+                                        index,
                                     )
 
     # -- CIDR ----------------------------------------------------------------

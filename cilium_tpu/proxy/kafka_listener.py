@@ -109,7 +109,27 @@ class KafkaProxyListener:
                     # responses: i32 length + i32 correlation id
                     while len(rbuf) >= 8:
                         (length,) = struct.unpack_from(">i", rbuf)
-                        if length < 4 or len(rbuf) < 4 + length:
+                        if length < 4:
+                            # framing error: connection-fatal, as the
+                            # reference closes on an invalid frame —
+                            # break-ing with the malformed prefix
+                            # retained would buffer the broker stream
+                            # unboundedly while forwarding nothing.
+                            # shutdown (not just close): the request
+                            # pump blocks in recv on these sockets
+                            # and must wake to tear down its side
+                            stop.set()
+                            for s in (broker, client):
+                                try:
+                                    s.shutdown(socket.SHUT_RDWR)
+                                except OSError:
+                                    pass
+                                try:
+                                    s.close()
+                                except OSError:
+                                    pass
+                            return
+                        if len(rbuf) < 4 + length:
                             break
                         (cid,) = struct.unpack_from(">i", rbuf, 4)
                         req = cache.match(cid)

@@ -434,3 +434,57 @@ def test_debug_profile_endpoint(tmp_path):
         assert len(got["loadavg"]) == 3
     finally:
         server.stop()
+
+
+def test_monitor_concurrent_polls_keep_unacked_batch():
+    """Two concurrent polls on one session are serialized: a
+    delivered-but-unacked batch survives concurrency instead of being
+    overwritten in the single pending slot (one poller draining while
+    another sets pending used to silently drop a batch)."""
+    import threading
+    import time as _time
+
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.monitor.events import DropNotify
+
+    d = Daemon()
+    api = DaemonAPI(d)
+    sid = api.monitor_open()["session"]
+
+    results = {}
+
+    def poll(tag, **kw):
+        results[tag] = api.monitor_poll(sid, **kw)
+
+    # poller 1 blocks waiting for events while HOLDING the session's
+    # poll slot; poller 2 arrives while it waits
+    t1 = threading.Thread(
+        target=poll, args=("p1",), kwargs={"timeout": 3, "ack": 0}
+    )
+    t1.start()
+    _time.sleep(0.3)
+    t2 = threading.Thread(
+        target=poll, args=("p2",), kwargs={"timeout": 3, "ack": 0}
+    )
+    t2.start()
+    _time.sleep(0.3)
+    d.monitor.publish(DropNotify(source=7, reason=133))
+    t1.join(timeout=10)
+    # a second event lands AFTER poller 1 took its batch — the racy
+    # code would let poller 2 drain it and overwrite the pending slot
+    d.monitor.publish(DropNotify(source=8, reason=133))
+    t2.join(timeout=10)
+    assert not t1.is_alive() and not t2.is_alive()
+
+    got1, got2 = results["p1"], results["p2"]
+    # poller 1 delivered the first batch (still unacked)
+    assert [e["source"] for e in got1["events"]] == [7]
+    # poller 2's stale ack re-delivers that SAME batch — it must not
+    # have drained new events over the unacked pending slot
+    assert got2["seq"] == got1["seq"]
+    assert [e["source"] for e in got2["events"]] == [7]
+    # acking the batch advances to the second event: nothing was lost
+    got3 = api.monitor_poll(sid, timeout=3, ack=got1["seq"])
+    assert [e["source"] for e in got3["events"]] == [8]
+    api.monitor_close(sid)

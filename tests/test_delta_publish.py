@@ -1,0 +1,372 @@
+"""Delta table publication: churn bit-identity + device epoch swap.
+
+The incremental hashed-table maintenance and the device scatter
+publication are only admissible if they are BYTE-identical to a full
+rebuild/upload at every step — these tests drive random rule churn
+through the FleetCompiler and pin:
+
+  * the hashed L4 entry tables against a from-scratch
+    build_l4_hash_pair over the same concatenated entries (the
+    ground-truth placement the incremental path must reproduce);
+  * every device-epoch leaf against the host-compiled arrays after
+    each delta publish (np.array_equal, including forced shape-class
+    growth → whole-leaf fallback);
+  * the epoch swap: a batch dispatched against the previous epoch
+    completes on the old tables; epochs older than the live pair are
+    rejected by check_current.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cilium_tpu.compiler.tables import (
+    FleetCompiler,
+    build_l4_hash_pair,
+)
+from cilium_tpu.maps.policymap import (
+    EGRESS,
+    INGRESS,
+    PolicyKey,
+    PolicyMapStateEntry,
+)
+
+LEAVES = (
+    "id_table",
+    "id_direct",
+    "id_lo_len",
+    "port_slot",
+    "l4_meta",
+    "l4_allow_bits",
+    "l3_allow_bits",
+    "l4_hash_rows",
+    "l4_hash_stash",
+    "l4_wild_rows",
+    "l4_wild_stash",
+)
+
+
+def ground_truth_hash(compiler: FleetCompiler, order):
+    """From-scratch placement over the compiler's cached entry
+    columns — what _build_hash computed before the incremental
+    pair."""
+    ents = [compiler._rows[ep]["ent"] for ep in order]
+    if not ents:
+        return build_l4_hash_pair(*([np.zeros(0, np.uint32)] * 6))
+    ep = np.concatenate(
+        [np.full(len(e["d"]), i, np.uint32) for i, e in enumerate(ents)]
+    )
+    cat = {
+        k: np.concatenate([e[k] for e in ents])
+        for k in ("d", "idx", "dport", "proto", "val")
+    }
+    return build_l4_hash_pair(
+        ep, cat["d"], cat["idx"], cat["dport"], cat["proto"], cat["val"]
+    )
+
+
+def random_entry(rng, ids, ports):
+    ident = int(rng.choice(ids)) if rng.random() > 0.15 else 0
+    kind = rng.random()
+    if kind < 0.15 and ident != 0:
+        key = PolicyKey(ident, 0, 0, int(rng.integers(0, 2)))
+    else:
+        key = PolicyKey(
+            ident,
+            int(rng.choice(ports)),
+            6 if rng.random() < 0.8 else 17,
+            int(rng.integers(0, 2)),
+        )
+    return key, PolicyMapStateEntry(proxy_port=0)
+
+
+def churn_step(rng, states, ids, ports):
+    """Mutate a random endpoint's map state: add/remove/update."""
+    ep = int(rng.choice(list(states)))
+    st = states[ep]
+    op = rng.random()
+    if op < 0.55 or not st:
+        k, v = random_entry(rng, ids, ports)
+        st[k] = v
+    elif op < 0.85:
+        k = list(st)[int(rng.integers(0, len(st)))]
+        del st[k]
+    else:  # proxy-port style update: replace an entry wholesale
+        k = list(st)[int(rng.integers(0, len(st)))]
+        del st[k]
+        k2, v2 = random_entry(rng, ids, ports)
+        st[k2] = v2
+    return ep
+
+
+def entries_of(states, tokens):
+    return [(ep, dict(st), tokens[ep]) for ep, st in sorted(states.items())]
+
+
+def assert_tables_equal(a, b, context=""):
+    for leaf in LEAVES:
+        la, lb = getattr(a, leaf), getattr(b, leaf)
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+            f"{context}: leaf {leaf} diverged"
+        )
+
+
+def test_churn_hash_bit_identity():
+    """N random add/remove/update steps: the incrementally maintained
+    hashed tables equal a from-scratch placement after EVERY step."""
+    rng = np.random.default_rng(11)
+    ids = [256 + i for i in range(40)]
+    ports = [80, 443, 1000, 1001, 1002, 8080, 9090, 5353]
+    comp = FleetCompiler(identity_pad=32, filter_pad=4)
+    states = {100 + e: {} for e in range(6)}
+    tokens = {ep: 0 for ep in states}
+    for ep in states:
+        for _ in range(8):
+            k, v = random_entry(rng, ids, ports)
+            states[ep][k] = v
+    for step in range(60):
+        ep = churn_step(rng, states, ids, ports)
+        tokens[ep] += 1
+        # occasionally grow the identity universe (append-only path)
+        if step % 13 == 5:
+            ids.append(256 + len(ids))
+        tables, index = comp.compile(entries_of(states, tokens), ids)
+        order = sorted(states)
+        want = ground_truth_hash(comp, order)
+        got = (
+            tables.l4_hash_rows,
+            tables.l4_hash_stash,
+            tables.l4_wild_rows,
+            tables.l4_wild_stash,
+        )
+        for name, g, w in zip(
+            ("rows", "stash", "wild_rows", "wild_stash"), got, want
+        ):
+            assert np.array_equal(g, w), (
+                f"step {step}: hashed table {name} diverged from "
+                f"full placement"
+            )
+
+
+def test_churn_device_delta_bit_identity():
+    """Every device-epoch leaf equals the host-compiled arrays after
+    each delta publish, including forced shape-class growth (new
+    slots past the filter pad, identity-axis growth) falling back to
+    whole-leaf replacement."""
+    jax = pytest.importorskip("jax")
+    from cilium_tpu.engine.publish import DeviceTableStore
+
+    rng = np.random.default_rng(7)
+    ids = [256 + i for i in range(40)]
+    # spare identities never referenced by entries: removing one
+    # forces the compiler's full universe reset mid-churn
+    spare = [1000, 1001, 1002]
+    ports = [80, 443, 1000, 1001]
+    comp = FleetCompiler(identity_pad=32, filter_pad=4)
+    store = DeviceTableStore()
+    states = {100 + e: {} for e in range(4)}
+    tokens = {ep: 0 for ep in states}
+    for ep in states:
+        for _ in range(6):
+            k, v = random_entry(rng, ids, ports)
+            states[ep][k] = v
+    modes = []
+    for step in range(40):
+        ep = churn_step(rng, states, ids, ports)
+        tokens[ep] += 1
+        if step == 15:
+            # force slot-space growth past filter_pad=4 → kg grows →
+            # stacked shape class moves → replace leaves
+            ports.extend([7000 + i for i in range(8)])
+        if step == 25:
+            # identity REMOVAL → compiler-wide reset → records
+            # cleared → the next device publish must fall back to a
+            # full upload and stay bit-identical
+            spare.pop()
+        if step % 11 == 7:
+            ids.append(256 + len(ids))
+        tables, _ = comp.compile(
+            entries_of(states, tokens), ids + spare
+        )
+        delta = comp.delta_for(store.spare_stamp(), tables)
+        dev, stats = store.publish(tables, delta)
+        modes.append(stats.mode)
+        assert_tables_equal(dev, tables, context=f"step {step}")
+        if stats.mode == "delta":
+            full_bytes = sum(
+                np.asarray(getattr(tables, leaf)).nbytes
+                for leaf in LEAVES
+            )
+            assert stats.bytes_h2d <= full_bytes
+    # the steady state must actually exercise the delta path
+    assert modes.count("delta") > len(modes) // 2
+    # ... and the one-rule-style steps must ship far less than the
+    # full upload (bytes proportional to the change)
+    assert any(
+        m == "delta" for m in modes[2:]
+    ), "delta publication never engaged"
+
+
+def test_epoch_swap_in_flight_batch():
+    """A batch dispatched against the previous epoch completes on the
+    OLD tables; two publishes later the old epoch is rejected."""
+    jax = pytest.importorskip("jax")
+    from cilium_tpu.engine.publish import (
+        DeviceTableStore,
+        StaleEpochError,
+    )
+    from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+
+    ids = [256, 257, 258, 259]
+    comp = FleetCompiler(identity_pad=32, filter_pad=4)
+    store = DeviceTableStore()
+    key_a = PolicyKey(256, 80, 6, INGRESS)
+    key_b = PolicyKey(257, 443, 6, INGRESS)
+    states = {1: {key_a: PolicyMapStateEntry()}}
+    tables1, index = comp.compile(
+        [(1, dict(states[1]), 0)], ids
+    )
+    epoch1, _ = store.publish(tables1, None)
+    batch = TupleBatch.from_numpy(
+        ep_index=np.zeros(4, np.int64),
+        identity=np.asarray([256, 257, 258, 256], np.uint32),
+        dport=np.asarray([80, 443, 80, 81]),
+        proto=np.full(4, 6),
+        direction=np.zeros(4, np.int64),
+    )
+    v1 = evaluate_batch(epoch1, batch)
+    allowed1 = np.asarray(v1.allowed).copy()
+    assert allowed1.tolist() == [1, 0, 0, 0]
+
+    # publish epoch 2 (adds key_b) as a delta
+    states[1][key_b] = PolicyMapStateEntry()
+    tables2, _ = comp.compile([(1, dict(states[1]), 1)], ids)
+    delta = comp.delta_for(store.spare_stamp(), tables2)
+    epoch2, stats2 = store.publish(tables2, delta)
+
+    # the in-flight batch's epoch is untouched: same verdicts
+    v1_again = evaluate_batch(epoch1, batch)
+    assert np.array_equal(np.asarray(v1_again.allowed), allowed1)
+    store.check_current(epoch1)  # still a live epoch
+    v2 = evaluate_batch(epoch2, batch)
+    assert np.asarray(v2.allowed).tolist() == [1, 1, 0, 0]
+
+    # third publish donates epoch 1's buffers → stale
+    del states[1][key_a]
+    tables3, _ = comp.compile([(1, dict(states[1]), 2)], ids)
+    delta = comp.delta_for(store.spare_stamp(), tables3)
+    epoch3, _ = store.publish(tables3, delta)
+    store.check_current(epoch3)
+    store.check_current(epoch2)
+    with pytest.raises(StaleEpochError):
+        store.check_current(epoch1)
+
+
+def test_manager_check_accepts_live_epochs():
+    """EndpointManager.check_tables_current accepts device epochs that
+    are still resident and keeps rejecting stale host compiles."""
+    pytest.importorskip("jax")
+    from cilium_tpu.endpoint.manager import EndpointManager
+    from cilium_tpu.identity import IdentityAllocator
+    from cilium_tpu.labels import Label, Labels
+    from cilium_tpu.policy.repository import Repository
+
+    alloc = IdentityAllocator()
+    mgr = EndpointManager(num_workers=1)
+    repo = Repository()
+    from cilium_tpu.endpoint.endpoint import Endpoint
+
+    ep = Endpoint(5, ipv4="10.0.0.5", name="ep5")
+    ident, _ = alloc.allocate(
+        Labels({"app": Label("app", "a", "k8s")})
+    )
+    ep.set_identity(ident)
+    mgr.insert(ep)
+    mgr.regenerate_all(repo, alloc.identity_cache(), "t")
+    v1, dev1, _ = mgr.published_device()
+    assert dev1 is not None
+    mgr.check_tables_current(dev1)
+    # a second and third publish rotate the device epochs
+    for i in range(2):
+        mgr.publish_tables(alloc.identity_cache())
+        mgr.published_device()
+    with pytest.raises(ValueError):
+        mgr.check_tables_current(dev1)
+
+
+def test_mesh_delta_publish_identical_verdicts():
+    """A delta publish into a mesh-replicated store applies the same
+    scatter on every chip: the sharded evaluator's verdicts equal the
+    single-device kernel's on the host-compiled tables, and every
+    epoch leaf is np.array_equal to the host arrays."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    from cilium_tpu.engine.sharded import make_replicated_store
+    from cilium_tpu.engine.verdict import (
+        TupleBatch,
+        evaluate_batch,
+        make_sharded_evaluator,
+    )
+
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()).reshape(-1), ("batch",)
+    )
+    store = make_replicated_store(mesh)
+    evaluator = make_sharded_evaluator(mesh)
+
+    rng = np.random.default_rng(3)
+    ids = [256 + i for i in range(30)]
+    ports = [80, 443, 1000, 1001]
+    comp = FleetCompiler(identity_pad=32, filter_pad=4)
+    states = {100 + e: {} for e in range(3)}
+    tokens = {ep: 0 for ep in states}
+    for ep in states:
+        for _ in range(6):
+            k, v = random_entry(rng, ids, ports)
+            states[ep][k] = v
+    host = None
+    for step in range(6):
+        ep = churn_step(rng, states, ids, ports)
+        tokens[ep] += 1
+        host, _ = comp.compile(entries_of(states, tokens), ids)
+        delta = comp.delta_for(store.spare_stamp(), host)
+        dev, stats = store.publish(host, delta)
+        assert_tables_equal(dev, host, context=f"mesh step {step}")
+    assert stats.mode == "delta"
+
+    b = 8 * 16
+    batch = TupleBatch.from_numpy(
+        ep_index=rng.integers(0, 3, size=b),
+        identity=rng.choice(
+            np.asarray(ids + [9999], np.uint32), size=b
+        ),
+        dport=rng.choice(np.asarray(ports + [7]), size=b),
+        proto=rng.choice(np.asarray([6, 17]), size=b),
+        direction=rng.integers(0, 2, size=b),
+    )
+    dev_tables = store.current()[1]
+    got = evaluator(dev_tables, batch)
+    want = evaluate_batch(host, batch)
+    for leaf in ("allowed", "proxy_port", "match_kind"):
+        assert np.array_equal(
+            np.asarray(getattr(got, leaf)),
+            np.asarray(getattr(want, leaf)),
+        ), f"mesh verdicts diverged after delta publish ({leaf})"
+
+
+def test_universe_token_skips_resync():
+    """Matching universe tokens skip the O(universe) identity diff;
+    a changed universe with a new token is still picked up."""
+    ids = [256, 257]
+    comp = FleetCompiler(identity_pad=32, filter_pad=4)
+    st = {PolicyKey(256, 80, 6, INGRESS): PolicyMapStateEntry()}
+    t1, _ = comp.compile([(1, st, 0)], ids, universe_token=1)
+    # same token: identity list ignored (caller-warranted unchanged)
+    t2, _ = comp.compile([(1, st, 0)], ids, universe_token=1)
+    assert np.array_equal(t1.id_table, t2.id_table)
+    # new token with a grown universe: the new id lands in the table
+    ids2 = ids + [258]
+    t3, _ = comp.compile([(1, st, 0)], ids2, universe_token=2)
+    assert 258 in t3.id_table.tolist()

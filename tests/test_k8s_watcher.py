@@ -371,3 +371,65 @@ def test_ingress_creates_external_lb_service():
     watcher.drain()
     assert services.lookup(frontend) is None
     watcher.close()
+
+
+def test_named_port_ingress_teardown_on_service_delete():
+    """Deleting a Service whose ingress references a NAMED servicePort
+    must still resolve the port for the teardown pass: the external
+    frontend drops to empty backends exactly like numeric-port
+    ingresses (previously _svc_ports was popped first, the named port
+    resolved to 0 and the stale frontend stayed installed)."""
+    d, api, services, watcher = _world()
+    watcher.start()
+    api.upsert(
+        "Service",
+        {
+            "kind": "Service",
+            "metadata": {"name": "shop", "namespace": "default"},
+            "spec": {
+                "selector": {"app": "shop"},
+                "clusterIP": "172.20.0.9",
+                "ports": [
+                    {"name": "web", "port": 8080, "protocol": "TCP"}
+                ],
+            },
+        },
+    )
+    api.upsert(
+        "Endpoints",
+        {
+            "kind": "Endpoints",
+            "metadata": {"name": "shop", "namespace": "default"},
+            "subsets": [
+                {"addresses": [{"ip": "10.12.0.1"},
+                               {"ip": "10.12.0.2"}]}
+            ],
+        },
+    )
+    api.upsert(
+        "Ingress",
+        {
+            "kind": "Ingress",
+            "metadata": {"name": "shop-ing", "namespace": "default"},
+            "spec": {
+                "backend": {
+                    "serviceName": "shop", "servicePort": "web"
+                }
+            },
+        },
+    )
+    watcher.drain()
+    frontend = L3n4Addr(watcher.host_ip, 8080, 6)
+    svc = services.lookup(frontend)
+    assert svc is not None
+    assert sorted(b.addr.ip for b in svc.backends) == [
+        "10.12.0.1", "10.12.0.2",
+    ]
+    # Service deletion: the named port must still resolve for the
+    # teardown sync, leaving the frontend with EMPTY backends (the
+    # numeric-port behavior), not the stale backend set
+    api.delete("Service", "default", "shop")
+    watcher.drain()
+    svc = services.lookup(frontend)
+    assert svc is None or list(svc.backends) == []
+    watcher.close()

@@ -294,3 +294,75 @@ def test_kafka_terminating_tcp_listener():
         listener.stop()
         broker_srv.shutdown()
         broker_srv.server_close()
+
+
+def test_kafka_broker_framing_error_is_connection_fatal():
+    """A broker response frame with length < 4 can never parse: the
+    proxy must treat it as connection-fatal (as the reference does)
+    instead of retaining the malformed prefix and buffering the
+    broker stream unboundedly while forwarding nothing."""
+    import socket
+    import socketserver
+    import struct
+    import threading
+
+    from cilium_tpu.l7.kafka import KafkaRequest, KafkaRuleSpec, compile_kafka_rules
+    from cilium_tpu.l7.kafka_wire import encode_request
+    from cilium_tpu.proxy.kafka_listener import KafkaProxyListener
+    from cilium_tpu.proxy.proxy import Redirect
+
+    class EvilBroker(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                self.request.recv(65536)  # swallow the request
+                # malformed: i32 length = 2 (< 4, no room for the
+                # correlation id), followed by stream garbage
+                self.request.sendall(
+                    struct.pack(">i", 2) + b"\x00" * 64
+                )
+                self.request.recv(65536)  # linger until closed
+            except OSError:
+                pass
+
+    broker_srv = socketserver.ThreadingTCPServer(
+        ("127.0.0.1", 0), EvilBroker
+    )
+    broker_srv.daemon_threads = True
+    threading.Thread(
+        target=broker_srv.serve_forever, daemon=True
+    ).start()
+
+    tables = compile_kafka_rules(
+        [KafkaRuleSpec(identity_indices=[7], topic="orders")], 16
+    )
+    redirect = Redirect(
+        id="4:i:tcp:9092", proxy_port=0, parser="kafka",
+        endpoint_id=4, ingress=True, kafka_tables=tables,
+    )
+    listener = KafkaProxyListener(
+        redirect,
+        identity_resolver=lambda addr: 7,
+        upstream=broker_srv.server_address,
+    ).start()
+    try:
+        c = socket.create_connection(listener.address, timeout=5)
+        ok = KafkaRequest(kind=0, version=0, client_id="c",
+                          topics=("orders",), parsed=True)
+        c.sendall(encode_request(ok, correlation_id=1))
+        c.settimeout(5)
+        # the proxy must tear the connection down, not hang buffering
+        data = b""
+        while True:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        assert data == b"", (
+            "no valid broker frame existed, nothing should have "
+            "been forwarded"
+        )
+        c.close()
+    finally:
+        listener.stop()
+        broker_srv.shutdown()
+        broker_srv.server_close()

@@ -78,6 +78,45 @@ def main():
     for k, v in phases.items():
         print(f"  {k}: {v:.2f}s", flush=True)
 
+    # --- delta vs full table publication -----------------------------------
+    # one-rule churn through the real control plane: host recompile
+    # latency, then the device publish both ways — full upload of
+    # every leaf vs the delta-scoped epoch scatter
+    from cilium_tpu.compiler.delta import tables_nbytes
+
+    em = d.endpoint_manager
+
+    def one_rule(port):
+        B.add_one_rule(d, port, label_prefix="churnprof")
+        t0 = time.perf_counter()
+        d.regenerate_all("churnprof delta")
+        host_ms = (time.perf_counter() - t0) * 1000
+        t0 = time.perf_counter()
+        em.published_device()
+        dev_ms = (time.perf_counter() - t0) * 1000
+        return host_ms, dev_ms
+
+    # full-upload comparator: a fresh epoch pays the whole world
+    host_tables = em.published()[1]
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(host_tables))
+    full_ms = (time.perf_counter() - t0) * 1000
+    print(
+        f"full upload: {full_ms:.1f} ms "
+        f"({tables_nbytes(host_tables) / 1e6:.1f} MB)",
+        flush=True,
+    )
+    em.published_device()  # prime epoch A
+    for i, port in enumerate((4401, 4402, 4403, 4404, 4405)):
+        host_ms, dev_ms = one_rule(port)
+        st = em.last_publish_stats
+        print(
+            f"delta publish {i}: host recompile {host_ms:.1f} ms, "
+            f"device {st.mode} {dev_ms:.1f} ms, "
+            f"{st.bytes_h2d / 1e6:.2f} MB shipped",
+            flush=True,
+        )
+
 
 if __name__ == "__main__":
     main()
