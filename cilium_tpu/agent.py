@@ -22,7 +22,17 @@ def main() -> None:
     ap.add_argument("--kvstore", default=None, help="host:port")
     ap.add_argument("--state-dir", default=None)
     ap.add_argument("--node", default="node-0")
+    ap.add_argument(
+        "--trace-sample-rate", type=float, default=None,
+        help="span-plane head-sampling probability (default 1.0: "
+        "trace every request; turn down under load)",
+    )
     args = ap.parse_args()
+
+    if args.trace_sample_rate is not None:
+        from cilium_tpu import tracing
+
+        tracing.tracer.sample_rate = args.trace_sample_rate
 
     kvstore = None
     if args.kvstore:

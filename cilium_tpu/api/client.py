@@ -39,11 +39,11 @@ class APIClient:
         self.socket_path = socket_path
 
     def _request(self, method: str, path: str, body=None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, headers=None):
         conn = _UnixHTTPConnection(self.socket_path, timeout=timeout)
         try:
             payload = None
-            headers = {}
+            headers = dict(headers or {})
             if isinstance(body, bytes):
                 payload = body
                 headers["Content-Type"] = "application/octet-stream"
@@ -199,7 +199,30 @@ class APIClient:
         )
         return self._request("DELETE", path)
 
-    def process_flows(self, buf: bytes):
+    def process_flows(self, buf: bytes, traceparent=None):
         """POST a binary flow-record buffer through the serving
-        plane; malformed buffers surface as APIError(400)."""
-        return self._request("POST", "/datapath/flows", body=buf)
+        plane; malformed buffers surface as APIError(400).
+        `traceparent` (a `00-<trace>-<span>-01` string) propagates
+        the caller's trace context — the reply's `trace_id` and the
+        batch's spans/flow records then carry the caller's ids."""
+        headers = (
+            {"traceparent": traceparent} if traceparent else None
+        )
+        return self._request(
+            "POST", "/datapath/flows", body=buf, headers=headers
+        )
+
+    # -- span plane (GET /debug/traces, /debug/profile) -----------------------
+
+    def traces_get(self, params: dict = None):
+        """GET /debug/traces with the span-plane query params
+        (trace-id, min-ms, site, last, slowest)."""
+        from urllib.parse import urlencode
+
+        qs = urlencode(dict(params or {}))
+        path = f"/debug/traces?{qs}" if qs else "/debug/traces"
+        return self._request("GET", path)
+
+    def debug_profile(self, reset: bool = False):
+        path = "/debug/profile" + ("?reset=1" if reset else "")
+        return self._request("GET", path)

@@ -37,6 +37,14 @@ Routes (the api/v1 subset this framework's daemon implements):
                              ?follow=1&since-seq=N long-polls)
   GET    /flows/summary      flow aggregations (top drop reasons,
                              denied identity pairs, per-chip counts)
+  GET    /debug/profile      thread stacks + cumulative SpanStat
+                             phase totals (?reset=1 zeroes after)
+  GET    /debug/traces       span-plane query: ?trace-id=, ?min-ms=,
+                             ?site=, ?last=N, ?slowest=N
+
+Every request runs under a root `http.request` span; an inbound
+`traceparent` header adopts the caller's trace and the reply carries
+`traceparent`/X-Trace-Id response headers (cilium_tpu.tracing).
 """
 
 from __future__ import annotations
@@ -124,13 +132,19 @@ class DaemonAPI:
             "ipam_cidr": str(self.daemon.ipam.cidr),
         }
 
-    def debug_profile(self) -> dict:
+    def debug_profile(self, reset: bool = False) -> dict:
         """The pprof/loadinfo analog (the reference serves
         /debug/pprof and logs loadinfo on slow operations): a
         point-in-time profile of every live thread's stack plus the
         daemon's accumulated regeneration span statistics — enough to
         diagnose a wedged agent over the API, which is what the
-        reference's handlers exist for."""
+        reference's handlers exist for.
+
+        The SpanStat numbers are CUMULATIVE since daemon start (or
+        the last reset).  `?reset=1` returns the profile and then
+        zeroes the accumulators, so before/after experiments don't
+        need a daemon restart — the reply always shows the pre-reset
+        totals."""
         import sys as _sys
         import threading as _threading
         import traceback as _traceback
@@ -167,9 +181,10 @@ class DaemonAPI:
             load1 = load5 = load15 = -1.0
         from cilium_tpu.metrics import registry as _metrics
 
-        return {
+        reply = {
             "threads": threads,
             "num_threads": len(threads),
+            "cumulative_since_reset": True,
             "regeneration_spans": _span_dict(self.daemon.regen_spans),
             "datapath_spans": _span_dict(self.daemon.datapath_spans),
             "batch_latency": {
@@ -177,6 +192,51 @@ class DaemonAPI:
                 "p99_s": _metrics.batch_duration.window_quantile(0.99),
             },
             "loadavg": [load1, load5, load15],
+        }
+        if reset:
+            self.daemon.reset_profile()
+            reply["reset"] = True
+        return reply
+
+    def traces_get(self, params: dict) -> dict:
+        """GET /debug/traces: the span-plane query surface.
+
+        Params: trace-id=<32 hex> (one trace, oldest-first),
+        min-ms=<float> (only spans at least that long),
+        site=<instrumentation site>, last=<N> (newest N spans,
+        default 1024), slowest=<N> (trace-level ranking by root
+        duration instead of a span list)."""
+        params = dict(params)
+        tracer = self.daemon.tracer
+        slowest_raw = params.pop("slowest", None)
+        if slowest_raw is not None:
+            return {
+                "traces": tracer.slowest_traces(int(slowest_raw)),
+                "dropped": tracer.dropped,
+                "finished_total": tracer.finished_total,
+            }
+        trace_id = params.pop("trace-id", None)
+        min_ms_raw = params.pop("min-ms", None)
+        site = params.pop("site", None)
+        last_raw = params.pop("last", None)
+        if params:
+            raise ValueError(
+                f"unknown trace filter {sorted(params)[0]!r}"
+            )
+        spans = tracer.query(
+            trace_id=trace_id,
+            min_duration_ms=(
+                float(min_ms_raw) if min_ms_raw is not None else None
+            ),
+            site=site,
+            last=int(last_raw) if last_raw is not None else 1024,
+        )
+        return {
+            "spans": [s.to_dict() for s in spans],
+            "matched": len(spans),
+            "dropped": tracer.dropped,
+            "finished_total": tracer.finished_total,
+            "sample_rate": tracer.sample_rate,
         }
 
     def policy_get(self) -> dict:
@@ -589,6 +649,8 @@ class DaemonAPI:
         Malformed buffers raise ValueError → HTTP 400 at the route;
         the stream itself completes even under dispatch faults
         (host-path failover)."""
+        from cilium_tpu import tracing
+
         stats = self.daemon.process_flows(buf)
         return {
             "total": stats.total,
@@ -599,6 +661,9 @@ class DaemonAPI:
             "batches": stats.batches,
             "degraded_batches": stats.degraded_batches,
             "seconds": stats.seconds,
+            # the span-plane join key of THIS request (also in the
+            # traceparent/X-Trace-Id response headers)
+            "trace_id": tracing.current_trace_id(),
         }
 
     # -- flow observability (the Hubble observe surface over REST) -----------
@@ -686,12 +751,64 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
+    def _handle_traced(self, inner) -> None:
+        """Every request runs under a root `http.request` span: an
+        inbound `traceparent` header adopts the caller's trace (so a
+        client's id shows on every child span and flow record), and
+        the reply echoes the span's context back (`traceparent` +
+        X-Trace-Id response headers) — the Dapper propagation seam of
+        the REST surface.
+
+        Long-poll routes (monitor polls, /flows follow mode) are NOT
+        traced: their duration is the client's idle wait, so their
+        spans would dominate `trace --slowest` and churn real batch
+        traces out of the bounded ring."""
+        from cilium_tpu import tracing
+
+        path, _, query = self.path.partition("?")
+        if self.command == "GET" and (
+            path.startswith("/monitor/")
+            or (path == "/flows" and "follow=1" in query)
+        ):
+            return inner()
+        parent = tracing.parse_traceparent(
+            self.headers.get(tracing.TRACEPARENT_HEADER)
+        )
+        with tracing.tracer.span(
+            "http.request",
+            site="api.server",
+            parent=parent,
+            attrs={"method": self.command, "path": path},
+        ) as sp:
+            self._span = sp
+            try:
+                inner()
+            finally:
+                self._span = None
+
+    def _trace_headers(self, code: int) -> None:
+        """Emit span-context response headers (sampled spans only)."""
+        from cilium_tpu import tracing
+
+        span = getattr(self, "_span", None)
+        if span is None or not getattr(span, "trace_id", ""):
+            return
+        span.attrs["status_code"] = code
+        if code >= 500:
+            span.status = "error"
+        self.send_header(
+            tracing.TRACEPARENT_HEADER,
+            tracing.format_traceparent(span),
+        )
+        self.send_header(tracing.TRACE_ID_HEADER, span.trace_id)
+
     def _reply(self, code: int, body) -> None:
         data = json.dumps(body).encode()
         try:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            self._trace_headers(code)
             self.end_headers()
             self.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError, OSError):
@@ -708,6 +825,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            self._trace_headers(code)
             self.end_headers()
             self.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError, OSError):
@@ -723,6 +841,21 @@ class _Handler(BaseHTTPRequestHandler):
         return self.rfile.read(n) if n else b""
 
     def do_GET(self) -> None:  # noqa: N802
+        self._handle_traced(self._route_get)
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._handle_traced(self._route_post)
+
+    def do_PUT(self) -> None:  # noqa: N802
+        self._handle_traced(self._route_put)
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        self._handle_traced(self._route_patch)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._handle_traced(self._route_delete)
+
+    def _route_get(self) -> None:
         api: DaemonAPI = self.server.api  # type: ignore
         path = self.path.split("?", 1)[0]
         try:
@@ -781,7 +914,19 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 return self._reply(200, api.flows_summary(top=top))
             if path == "/debug/profile":
-                return self._reply(200, api.debug_profile())
+                reset = "reset=1" in (self.path.partition("?")[2] or "")
+                return self._reply(200, api.debug_profile(reset=reset))
+            if path == "/debug/traces":
+                from urllib.parse import parse_qs
+
+                qs = parse_qs(self.path.partition("?")[2])
+                params = {k: v[0] for k, v in qs.items()}
+                try:
+                    return self._reply(200, api.traces_get(params))
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
             if path == "/debug/faults":
                 return self._reply(200, api.fault_list())
             if path == "/service":
@@ -815,7 +960,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:
             return self._reply(500, {"error": str(exc)})
 
-    def do_POST(self) -> None:  # noqa: N802
+    def _route_post(self) -> None:
         api: DaemonAPI = self.server.api  # type: ignore
         path, _, query = self.path.partition("?")
         try:
@@ -908,7 +1053,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:
             return self._reply(500, {"error": str(exc)})
 
-    def do_PUT(self) -> None:  # noqa: N802
+    def _route_put(self) -> None:
         from cilium_tpu.daemon import EndpointConflict
 
         api: DaemonAPI = self.server.api  # type: ignore
@@ -969,7 +1114,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"bad request: {exc}"})
             return None, True
 
-    def do_PATCH(self) -> None:  # noqa: N802
+    def _route_patch(self) -> None:
         api: DaemonAPI = self.server.api  # type: ignore
         path = self.path.split("?", 1)[0]
         try:
@@ -1010,7 +1155,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:
             return self._reply(500, {"error": str(exc)})
 
-    def do_DELETE(self) -> None:  # noqa: N802
+    def _route_delete(self) -> None:
         api: DaemonAPI = self.server.api  # type: ignore
         path = self.path.split("?", 1)[0]
         try:

@@ -119,6 +119,24 @@ def collect(daemon, out_dir: str) -> str:
                 ],
             },
         )
+    # span-plane ring dump: the same trace ids the live
+    # /debug/traces API serves, so offline debugging can join
+    # traces ↔ flows.json records ↔ the metrics snapshot
+    daemon_tracer = getattr(daemon, "tracer", None)
+    if daemon_tracer is not None:
+        write(
+            "traces.json",
+            {
+                "spans": [
+                    s.to_dict() for s in daemon_tracer.snapshot()
+                ],
+                "dropped": daemon_tracer.dropped,
+                "finished_total": daemon_tracer.finished_total,
+                "sample_rate": daemon_tracer.sample_rate,
+            },
+        )
+    # the /metrics/prometheus text snapshot (same exposition a live
+    # scrape sees — label sets join against traces.json/flows.json)
     with open(os.path.join(root, "metrics.prom"), "w") as f:
         f.write(metrics.expose())
 

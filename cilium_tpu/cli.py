@@ -271,6 +271,7 @@ def cmd_observe(api, args) -> int:
         ("direction", args.direction),
         ("since", args.since),
         ("chip", args.chip),
+        ("trace-id", args.trace_id),
     ):
         if val is not None:
             params[key] = val
@@ -313,6 +314,49 @@ def cmd_observe(api, args) -> int:
             cursor = max(cursor, got["last_seq"])
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_trace(api, args) -> int:
+    """`cilium-tpu trace` — the span-plane reader: render one trace
+    as an indented tree with per-span ms (`trace <trace_id>`), or
+    rank traces by root duration (`trace --slowest N`)."""
+    from cilium_tpu.tracing import render_span_tree
+
+    if args.slowest is not None:
+        got = api.traces_get({"slowest": args.slowest})
+        if args.json:
+            print(json.dumps(got, indent=2))
+            return 0
+        if not got["traces"]:
+            print("(no traces)")
+            return 0
+        for row in got["traces"]:
+            print(
+                f"{row['trace_id']}  {row['duration_ms']:>10.3f}ms  "
+                f"{row['spans']:>4} spans  {row['root']} "
+                f"({row['site']})"
+                + ("" if row["status"] == "ok" else f" [{row['status']}]")
+            )
+        return 0
+    if not args.trace_id:
+        print(
+            "error: give a trace id, or --slowest N", file=sys.stderr
+        )
+        return 2
+    got = api.traces_get({"trace-id": args.trace_id})
+    spans = got["spans"]
+    if args.json:
+        print(json.dumps(got, indent=2))
+        return 0 if spans else 1
+    if not spans:
+        print(
+            f"no spans for trace {args.trace_id} "
+            f"(ring dropped {got.get('dropped', 0)})",
+            file=sys.stderr,
+        )
+        return 1
+    print(render_span_tree(spans), end="")
+    return 0
 
 
 def cmd_fault_list(api, args) -> int:
@@ -446,6 +490,9 @@ def make_parser() -> argparse.ArgumentParser:
     obs.add_argument("--since", default=None,
                      help="unix seconds or 30s/5m/1h window")
     obs.add_argument("--chip", type=int, default=None)
+    obs.add_argument("--trace-id", default=None,
+                     help="only flows captured under this trace "
+                     "(the /debug/traces join key)")
     obs.add_argument("--timeout", type=float, default=5.0,
                      help="follow-mode poll timeout")
     obs.add_argument("--summary", action="store_true",
@@ -453,6 +500,20 @@ def make_parser() -> argparse.ArgumentParser:
     obs.add_argument("--top", type=int, default=10,
                      help="rows per summary ranking")
     obs.set_defaults(func=cmd_observe)
+
+    trc = sub.add_parser(
+        "trace",
+        help="span-plane reader: tree view of one trace, or "
+        "--slowest N ranking (GET /debug/traces)",
+    )
+    trc.add_argument("trace_id", nargs="?", default=None,
+                     help="32-hex trace id (as returned in "
+                     "X-Trace-Id / flow records)")
+    trc.add_argument("--slowest", type=int, default=None,
+                     help="rank the N slowest traces by root span")
+    trc.add_argument("--json", action="store_true",
+                     help="machine-readable span dump")
+    trc.set_defaults(func=cmd_trace)
 
     mon = sub.add_parser("monitor")
     mon.add_argument("--count", type=int, default=0,

@@ -962,10 +962,18 @@ class FleetCompiler:
         the id set is unchanged.  Returns (tables, ep_id →
         endpoint-axis index).
         """
-        with self._compile_lock:
-            return self._compile_locked(
+        from cilium_tpu import tracing
+
+        with self._compile_lock, tracing.tracer.span(
+            "compiler.compile", site="compiler",
+            attrs={"endpoints": len(endpoints)},
+        ) as sp:
+            tables, index = self._compile_locked(
                 endpoints, identity_ids, universe_token
             )
+            sp.attrs["identities"] = len(self._id_list)
+            sp.attrs["slots"] = len(self._slot_list)
+            return tables, index
 
     def _compile_locked(
         self,
@@ -1129,9 +1137,12 @@ class FleetCompiler:
         base, record gap, different compiler instance) and the caller
         must full-upload.  Scatter values are fresh copies taken from
         `tables` — safe to ship asynchronously."""
+        from cilium_tpu import tracing
         from cilium_tpu.compiler.delta import LeafUpdate, TableDelta
 
-        with self._compile_lock:
+        with self._compile_lock, tracing.tracer.span(
+            "compiler.delta_for", site="compiler"
+        ):
             if not base_stamp:
                 return None
             if (base_stamp >> 32) != self._instance_nonce:

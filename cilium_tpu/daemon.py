@@ -33,7 +33,7 @@ from cilium_tpu.logging import get_logger
 
 log = get_logger("daemon")
 
-from cilium_tpu import option
+from cilium_tpu import option, tracing
 from cilium_tpu.endpoint import Endpoint, EndpointManager
 from cilium_tpu.endpoint.checkpoint import restore_endpoints, save_endpoint
 from cilium_tpu.identity import IdentityAllocator
@@ -195,6 +195,13 @@ class Daemon:
         # (pkg/controller's failure bookkeeping surfaced, instead of
         # failing silently off the request path)
         self.controller_failure_threshold = 3
+        # -- trace plane (cilium_tpu.tracing) --------------------------
+        # the process-global tracer (the metrics-registry shape):
+        # REST handlers open root spans, every serving-path phase
+        # below nests under them via contextvars; `/debug/traces`
+        # serves the ring
+        self.tracer = tracing.tracer
+        self._traced_evaluate = None  # jit-tracked evaluate_batch
         # -- resilience plane (cilium_tpu.resilience) ------------------
         # Device dispatch runs under retry + a circuit breaker; when
         # the breaker opens the serving plane degrades to the
@@ -456,18 +463,45 @@ class Daemon:
             metrics.spanstat_seconds.set(
                 scope, name, value=span.total()
             )
+        metrics.trace_spans_total.set(
+            value=tracing.tracer.finished_total
+        )
+        metrics.trace_spans_dropped.set(value=tracing.tracer.dropped)
+
+    def reset_profile(self) -> None:
+        """GET /debug/profile?reset=1: zero the cumulative SpanStat
+        accumulators (regeneration + datapath) so before/after
+        experiments don't need a daemon restart.  The mirrored
+        spanstat_seconds gauges are zeroed too, so /metrics and
+        /debug/profile keep agreeing."""
+        for scope, spans in (
+            ("regeneration", self.regen_spans),
+            ("datapath", self.datapath_spans),
+        ):
+            for name in spans:
+                metrics.spanstat_seconds.set(scope, name, value=0.0)
+            spans.clear()
 
     def _regenerate_for_reasons(self, reasons: List[str]) -> None:
         self.regenerate_all(", ".join(reasons) or "trigger")
 
     def regenerate_all(self, reason: str = "") -> int:
         with self._regen_lock:
-            return self._regenerate_all_locked(reason)
+            # the regen sweep's root span: compile/publish pipeline
+            # spans (FleetCompiler, DeviceTableStore) and proxy
+            # upcalls nest under it
+            with self.tracer.span(
+                "daemon.regenerate", site="daemon",
+                attrs={"reason": reason},
+            ):
+                return self._regenerate_all_locked(reason)
 
     def _regenerate_all_locked(self, reason: str = "") -> int:
         stats = SpanStats()  # fresh per run: the histogram observes
         # THIS run's duration; regen_spans accumulates across runs
-        stats.span("total").start()
+        total_span = tracing.stat_span(
+            stats, "total", site="daemon.regenerate", trc=self.tracer
+        ).start()
         cache, cache_version = (
             self.identity_allocator.identity_cache_versioned()
         )
@@ -595,7 +629,7 @@ class Daemon:
             for endpoint, before in attempted:
                 endpoint.realized_redirects = before
                 endpoint.force_policy_compute = True
-            stats.span("total").end(success=False)
+            total_span.end(success=False)
             self._accumulate_regen_span(stats, success=False)
             return n
         if dirty:
@@ -610,7 +644,7 @@ class Daemon:
                 identity_cache_token=cache_version,
             )
         metrics.policy_regeneration_count.inc(value=n)
-        stats.span("total").end()
+        total_span.end()
         metrics.endpoint_regeneration_seconds.observe(
             stats.span("total").total()
         )
@@ -950,37 +984,66 @@ class Daemon:
         degraded_batches_total counts the failover.
 
         Returns (verdicts, degraded flag); verdicts satisfy the
-        Verdicts contract (allowed/proxy_port/match_kind, padded)."""
+        Verdicts contract (allowed/proxy_port/match_kind, padded).
+
+        Span-plane attribution: the device attempt runs under an
+        `engine.dispatch` span (error status + breaker events when it
+        fails, per-chip children when it succeeds); the failover fold
+        runs under `engine.hostpath` — one trace shows which plane
+        served the batch and why."""
         from cilium_tpu.engine.hostpath import lattice_fold_host
         from cilium_tpu.engine.verdict import evaluate_batch
         from cilium_tpu.resilience import guarded_dispatch
 
+        if self._traced_evaluate is None:
+            # jit-cache hit/miss accounting on the serving entry
+            # point (a fresh batch shape class = an XLA recompile the
+            # stream waits for)
+            self._traced_evaluate = tracing.track_jit(
+                evaluate_batch, "engine.dispatch"
+            )
         if self.dispatch_breaker.allow():
-            try:
-                out = guarded_dispatch(
-                    evaluate_batch,
-                    tables,
-                    batch,
-                    retries=self.dispatch_retries,
-                    base_delay=self.dispatch_retry_base,
-                    watchdog=self.dispatch_watchdog,
+            with self.tracer.span(
+                "engine.dispatch", site="engine.dispatch"
+            ) as sp:
+                try:
+                    out = guarded_dispatch(
+                        self._traced_evaluate,
+                        tables,
+                        batch,
+                        retries=self.dispatch_retries,
+                        base_delay=self.dispatch_retry_base,
+                        watchdog=self.dispatch_watchdog,
+                    )
+                    self.dispatch_breaker.record_success()
+                    dispatched = out
+                except Exception as exc:
+                    sp.status = "error"
+                    sp.attrs["error"] = str(exc)
+                    self.dispatch_breaker.record_failure(str(exc))
+                    log.warning(
+                        "device dispatch failed; serving batch from "
+                        "host path",
+                        extra={"fields": {"error": str(exc)}},
+                    )
+                    dispatched = None
+            if dispatched is not None:
+                tracing.record_chip_spans(
+                    self.tracer, sp, 1,
+                    int(batch.ep_index.shape[0]), "engine.dispatch",
                 )
-                self.dispatch_breaker.record_success()
-                return out, False
-            except Exception as exc:
-                self.dispatch_breaker.record_failure(str(exc))
-                log.warning(
-                    "device dispatch failed; serving batch from "
-                    "host path",
-                    extra={"fields": {"error": str(exc)}},
-                )
-        states, ep_index, identity, dport, proto, direction, frag = (
-            host_args()
-        )
-        out = lattice_fold_host(
-            states, ep_index, identity, dport, proto, direction,
-            is_fragment=frag, pad_to=pad_to,
-        )
+                return dispatched, False
+        with self.tracer.span(
+            "engine.hostpath", site="engine.hostpath",
+            attrs={"failover": True},
+        ):
+            states, ep_index, identity, dport, proto, direction, frag = (
+                host_args()
+            )
+            out = lattice_fold_host(
+                states, ep_index, identity, dport, proto, direction,
+                is_fragment=frag, pad_to=pad_to,
+            )
         self.degraded_batches += 1
         metrics.degraded_batches_total.inc()
         return out, True
@@ -1196,7 +1259,26 @@ class Daemon:
         through the same telemetry_masks definitions as the PR 1
         histogram.  Shed (Overload) flows are accounted in metrics
         only: building per-flow records under overload would amplify
-        the overload being shed.  Returns ReplayStats."""
+        the overload being shed.  Returns ReplayStats.
+
+        Tracing: the whole call runs under a `daemon.process_flows`
+        span (a child of the REST request's root span when driven
+        over the API); each phase/batch below opens child spans that
+        SHARE their clock window with the SpanStat accumulators
+        (tracing.StatSpan), so `/debug/profile` totals and
+        `/debug/traces` durations agree; captured FlowRecords carry
+        the trace id (GET /flows?trace-id=...)."""
+        with self.tracer.span(
+            "daemon.process_flows", site="daemon",
+            attrs={"bytes": len(buf)},
+        ) as proc_span:
+            return self._process_flows_traced(
+                buf, batch_size, collect_verdicts, proc_span
+            )
+
+    def _process_flows_traced(
+        self, buf, batch_size, collect_verdicts, proc_span
+    ):
         import time as _time
         from types import SimpleNamespace
 
@@ -1233,9 +1315,16 @@ class Daemon:
         # would hammer exactly the degraded hot path.
         if _time.monotonic() >= self._device_publish_retry_at:
             try:
-                tables = self.endpoint_manager.device_tables_for(
-                    tables
-                )
+                # epoch lookup/publication under its own span: a
+                # trace distinguishes "the batch was slow" from "the
+                # batch paid a delta scatter / full upload first"
+                with self.tracer.span(
+                    "publish.epoch_lookup", site="engine.publish",
+                    attrs={"version": version},
+                ):
+                    tables = self.endpoint_manager.device_tables_for(
+                        tables
+                    )
             except Exception as exc:  # device down → numpy tables
                 self._device_publish_retry_at = (
                     _time.monotonic() + 30.0
@@ -1252,7 +1341,9 @@ class Daemon:
         # decode pass: the filtered SoA feeds batching directly, and
         # the drop count is surfaced in stats.
         spans = self.datapath_spans
-        spans.span("host_pack").start()
+        host_pack = tracing.stat_span(
+            spans, "host_pack", site="daemon", trc=self.tracer
+        ).start()
         rec = decode_flow_records(buf)
         known = np.isin(
             rec["ep_id"], np.fromiter(index, dtype=np.int64)
@@ -1338,6 +1429,7 @@ class Daemon:
                     pre_dropped=np.ones(n_prefiltered, bool),
                     allow_sample=0,
                     metrics_registry=metrics,
+                    trace_id=tracing.current_trace_id(),
                 )
                 rec = {k: v[~hit] for k, v in rec.items()}
         # vectorized index→endpoint-id translation (inverse of
@@ -1355,7 +1447,7 @@ class Daemon:
         # record stream — the degraded host fold and the shed
         # accounting read these slices without touching the device
         ep_idx_host = _ep_index_of(rec, dict(index))
-        spans.span("host_pack").end()
+        host_pack.end()
         stats = ReplayStats()
         stats.dropped = n_dropped
         # prefiltered flows received a verdict (deny) without
@@ -1394,7 +1486,11 @@ class Daemon:
                         )
                 continue
             try:
-                spans.span("dispatch").start()
+                dispatch_span = tracing.stat_span(
+                    spans, "dispatch", site="daemon",
+                    attrs={"batch": stats.batches, "rows": valid},
+                    trc=self.tracer,
+                ).start()
 
                 def _host_args(s=start, e=end):
                     return (
@@ -1411,11 +1507,14 @@ class Daemon:
                     tables, batch, _host_args, batch_size
                 )
                 _tally(out, valid, stats)
-                spans.span("dispatch").end(success=not degraded)
+                dispatch_span.end(success=not degraded)
                 stats.batches += 1
                 if degraded:
                     stats.degraded_batches += 1
-                spans.span("event_fold").start()
+                event_fold = tracing.stat_span(
+                    spans, "event_fold", site="daemon",
+                    trc=self.tracer,
+                ).start()
                 ep_idx = ep_idx_host[start:end]
                 v = SimpleNamespace(
                     allowed=np.asarray(out.allowed)[:valid],
@@ -1443,11 +1542,14 @@ class Daemon:
                         == option.MONITOR_AGG_NONE
                     ),
                 )
-                spans.span("event_fold").end()
+                event_fold.end()
                 # flow-record fold (the Hubble plane): all drops +
                 # head-sampled allows, classified through the shared
                 # telemetry_masks definitions
-                spans.span("flow_capture").start()
+                flow_capture = tracing.stat_span(
+                    spans, "flow_capture", site="daemon",
+                    trc=self.tracer,
+                ).start()
                 dirs = rec["direction"][start:end]
                 peer = rec["identity"][start:end].astype(np.int64)
                 local = local_ident_lut[ep_idx]
@@ -1464,8 +1566,9 @@ class Daemon:
                     proxy_port=v.proxy_port,
                     allow_sample=flow_allow_sample,
                     metrics_registry=metrics,
+                    trace_id=tracing.current_trace_id(),
                 )
-                spans.span("flow_capture").end()
+                flow_capture.end()
             finally:
                 self.admission.release(valid)
             metrics.batch_duration.observe(
@@ -1473,6 +1576,12 @@ class Daemon:
             )
         stats.seconds = _time.perf_counter() - t0
         stats.spans = spans
+        proc_span.attrs.update(
+            total=stats.total, batches=stats.batches,
+            allowed=stats.allowed, denied=stats.denied,
+            dropped=stats.dropped, shed=stats.shed,
+            degraded_batches=stats.degraded_batches,
+        )
         self._export_spans("datapath", spans)
         if collected is not None:
             stats.verdicts = {

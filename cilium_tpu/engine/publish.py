@@ -35,8 +35,10 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from cilium_tpu import tracing
 from cilium_tpu.compiler.delta import TableDelta, tables_nbytes
 from cilium_tpu.compiler.tables import PolicyTables
+from cilium_tpu.metrics import registry as metrics
 
 
 def _pad_pow2(update):
@@ -137,7 +139,12 @@ class DeviceTableStore:
             kw["generation"] = generation
             return dataclasses.replace(tables, **kw)
 
-        fn = jax.jit(apply, donate_argnums=(0,))
+        # jit-cache observability rides the scatter entry point: a
+        # payload outside the known pow2 classes shows up as a miss +
+        # compile seconds in the same scrape as the publish bytes
+        fn = tracing.track_jit(
+            jax.jit(apply, donate_argnums=(0,)), "publish.scatter"
+        )
         self._apply_cache[fields] = fn
         return fn
 
@@ -152,7 +159,9 @@ class DeviceTableStore:
         or delta=None — forces a full upload."""
         import jax
 
-        with self._lock:
+        with self._lock, tracing.tracer.span(
+            "publish.epoch", site="engine.publish"
+        ) as sp:
             t0 = time.perf_counter()
             spare_i = self._cur ^ 1
             spare = self._slots[spare_i]
@@ -174,7 +183,13 @@ class DeviceTableStore:
                     # slot so the next publish full-uploads instead of
                     # scattering into deleted arrays forever
                     self._slots[spare_i] = None
+                    self._sample_bytes()
                     raise
+                # the standby's resident buffers were donated (patched
+                # in place) — HBM reused, not reallocated
+                metrics.device_table_retired_bytes.inc(
+                    value=spare.get("nbytes", 0)
+                )
             else:
                 dev = self._put_tables(tables)
                 jax.block_until_ready(dev)
@@ -185,11 +200,32 @@ class DeviceTableStore:
             self._epoch += 1
             self._slots[spare_i] = {
                 "tables": dev, "stamp": stamp, "epoch": self._epoch,
+                "nbytes": tables_nbytes(tables),
             }
             self._cur = spare_i
             stats.epoch = self._epoch
             stats.seconds = time.perf_counter() - t0
+            self._sample_bytes()
+            sp.attrs.update(
+                mode=stats.mode, epoch=stats.epoch,
+                bytes_h2d=stats.bytes_h2d,
+                scatter_leaves=stats.scatter_leaves,
+                replaced_leaves=stats.replaced_leaves,
+            )
             return dev, stats
+
+    def _sample_bytes(self) -> None:
+        """cilium_device_table_bytes{epoch}: per-slot resident bytes,
+        sampled at every publish (caller holds the lock) — the HBM
+        line of the device-resource accounting plane."""
+        cur = self._slots[self._cur]
+        spare = self._slots[self._cur ^ 1]
+        metrics.device_table_bytes.set(
+            "live", value=(cur or {}).get("nbytes", 0)
+        )
+        metrics.device_table_bytes.set(
+            "standby", value=(spare or {}).get("nbytes", 0)
+        )
 
     def _publish_delta(
         self,

@@ -101,6 +101,7 @@ def capture_batch(
     allow_sample: Optional[int] = None,
     now: Optional[float] = None,
     metrics_registry=None,
+    trace_id: str = "",
 ) -> int:
     """Fold one batch's per-tuple columns into the store.  All
     columns are host arrays of one length (the batch's VALID prefix —
@@ -110,7 +111,9 @@ def capture_batch(
     ``metrics_registry`` additionally feeds
     flow_records_captured_total / flow_store_evicted (None = no
     metrics — tools and benches that must not touch the process
-    registry).  Returns the number of records captured."""
+    registry).  ``trace_id`` stamps the span-plane join key on every
+    record of a traced batch (GET /flows?trace-id=...).  Returns the
+    number of records captured."""
     allowed = np.asarray(allowed).astype(bool)
     kind = np.asarray(match_kind)
     b = len(allowed)
@@ -177,6 +180,7 @@ def capture_batch(
             drop_reason=str(reason[i]),
             proxy_port=int(proxy[i]),
             ct_state=int(ct_res[i]),
+            trace_id=trace_id,
         )
         for i in idx
     ]

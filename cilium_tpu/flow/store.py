@@ -53,6 +53,7 @@ class FlowRecord:
     proxy_port: int = 0
     ct_state: int = 0  # CT_* result (0 = stateless/audit path)
     seq: int = 0  # store-assigned monotonic sequence
+    trace_id: str = ""  # span-plane join key ("" when untraced)
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -122,6 +123,7 @@ class FlowFilter:
     direction: Optional[int] = None
     since: Optional[float] = None
     chip: Optional[int] = None
+    trace_id: Optional[str] = None
 
     # GET /flows query-param name → field + parser
     PARAM_FIELDS = {
@@ -134,6 +136,7 @@ class FlowFilter:
         "direction": ("direction", parse_direction),
         "since": ("since", _parse_since),
         "chip": ("chip", int),
+        "trace-id": ("trace_id", lambda v: str(v).lower()),
     }
 
     @classmethod
@@ -181,6 +184,8 @@ class FlowFilter:
         if self.since is not None and r.ts < self.since:
             return False
         if self.chip is not None and r.chip != self.chip:
+            return False
+        if self.trace_id is not None and r.trace_id != self.trace_id:
             return False
         return True
 

@@ -338,6 +338,52 @@ class Registry:
             "Wall seconds of the most recent device table "
             "publication",
         )
+        # -- device-resource accounting (publish layer + jitted entry
+        # points): HBM growth and recompile storms in one scrape ---------
+        self.device_table_bytes = Gauge(
+            f"{ns}_device_table_bytes",
+            "Device-resident policy-table bytes per epoch slot "
+            "(live = the serving epoch, standby = the double-buffered "
+            "spare awaiting the next delta scatter)",
+            ("epoch",),
+        )
+        self.device_table_retired_bytes = Counter(
+            f"{ns}_device_table_donation_retired_bytes_total",
+            "Bytes of standby-epoch buffers consumed (donated in "
+            "place) by delta publications — HBM reused, not "
+            "reallocated",
+        )
+        self.jit_cache_hits = Counter(
+            f"{ns}_jit_cache_hits",
+            "Calls into an instrumented jitted entry point served "
+            "from the executable cache, by site",
+            ("site",),
+        )
+        self.jit_cache_misses = Counter(
+            f"{ns}_jit_cache_misses",
+            "Calls into an instrumented jitted entry point that "
+            "grew the executable cache (fresh XLA trace+compile), "
+            "by site",
+            ("site",),
+        )
+        self.jit_compile_seconds = Counter(
+            f"{ns}_jit_cache_compile_seconds",
+            "Wall seconds spent in cache-growing calls (compile + "
+            "first execution), by site — the recompile-storm signal",
+            ("site",),
+        )
+        # -- trace plane (cilium_tpu.tracing) -----------------------------
+        self.trace_spans_total = Gauge(
+            f"{ns}_trace_spans_total",
+            "Spans exported to the trace ring since process start "
+            "(sampled from the tracer at span-export points)",
+        )
+        self.trace_spans_dropped = Gauge(
+            f"{ns}_trace_spans_dropped",
+            "Spans lost to the bounded trace ring (oldest-first "
+            "eviction): what a late /debug/traces reader can no "
+            "longer see",
+        )
         # -- phase spans + mesh telemetry --------------------------------
         self.spanstat_seconds = Gauge(
             f"{ns}_spanstat_seconds",

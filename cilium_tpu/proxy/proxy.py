@@ -545,42 +545,51 @@ class Proxy:
     ) -> Dict[str, int]:
         """addNewRedirects/removeOldRedirects for one endpoint; returns
         the realized proxy-id → port map to feed back into the next
-        computeDesiredPolicyMapState."""
+        computeDesiredPolicyMapState.  Runs under a `proxy.upcall`
+        span (error status on an injected/real failure), so a traced
+        regeneration shows which endpoint's redirect realization cost
+        or failed the sweep."""
         # chaos seam: an armed proxy.upcall site fails redirect
         # realization the way a dead envoy fails the xDS upcall — the
         # regeneration's ACK gate rolls back, exactly the failure the
         # rollback exists for
-        from cilium_tpu import faultinject
+        from cilium_tpu import faultinject, tracing
 
-        faultinject.fire("proxy.upcall")
-        realized: Dict[str, int] = {}
-        l4_policy = endpoint.desired_l4_policy
-        wanted = set()
-        if l4_policy is not None:
-            for l4map in (l4_policy.ingress, l4_policy.egress):
-                for f in l4map.values():
-                    if not f.is_redirect():
-                        continue
-                    pid = proxy_id(
-                        endpoint.id, f.ingress, f.protocol, f.port
-                    )
-                    redirect = self.create_or_update_redirect(
-                        f, pid, endpoint.id, identity_cache, id_index,
-                        n_identities, selector_cache,
-                        wait_group=wait_group,
-                    )
-                    realized[pid] = redirect.proxy_port
-                    wanted.add(pid)
-        with self._lock:
-            stale = [
-                p
-                for p, st in self._pids.items()
-                if st.endpoint_id == endpoint.id and p not in wanted
-            ]
-        for pid in stale:
-            self.remove_redirect(pid)
-        endpoint.realized_redirects = realized
-        return realized
+        with tracing.tracer.span(
+            "proxy.upcall", site="proxy.upcall",
+            attrs={"endpoint": endpoint.id},
+        ) as sp:
+            faultinject.fire("proxy.upcall")
+            realized: Dict[str, int] = {}
+            l4_policy = endpoint.desired_l4_policy
+            wanted = set()
+            if l4_policy is not None:
+                for l4map in (l4_policy.ingress, l4_policy.egress):
+                    for f in l4map.values():
+                        if not f.is_redirect():
+                            continue
+                        pid = proxy_id(
+                            endpoint.id, f.ingress, f.protocol, f.port
+                        )
+                        redirect = self.create_or_update_redirect(
+                            f, pid, endpoint.id, identity_cache,
+                            id_index, n_identities, selector_cache,
+                            wait_group=wait_group,
+                        )
+                        realized[pid] = redirect.proxy_port
+                        wanted.add(pid)
+            with self._lock:
+                stale = [
+                    p
+                    for p, st in self._pids.items()
+                    if st.endpoint_id == endpoint.id
+                    and p not in wanted
+                ]
+            for pid in stale:
+                self.remove_redirect(pid)
+            endpoint.realized_redirects = realized
+            sp.attrs["redirects"] = len(realized)
+            return realized
 
     # -- access logging (pkg/proxy/logger) -----------------------------------
 

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from cilium_tpu import tracing
 from cilium_tpu.logging import get_logger
 
 log = get_logger("resilience")
@@ -183,6 +184,14 @@ class CircuitBreaker:
                 ok = True
         if notify is not None:
             notify()
+        # span-plane attribution: the admission question's answer
+        # lands on the active span (the per-batch dispatch span), so
+        # a trace shows WHY a batch failed over without cross-
+        # referencing the breaker gauge's scrape timeline
+        tracing.add_event(
+            "breaker.decision", breaker=self.name,
+            state=self._state, allowed=ok,
+        )
         return ok
 
     def record_success(self) -> None:
@@ -205,6 +214,9 @@ class CircuitBreaker:
             notify()
 
     def record_failure(self, reason: str = "") -> None:
+        tracing.add_event(
+            "breaker.failure", breaker=self.name, reason=reason
+        )
         notify = None
         with self._lock:
             self._consecutive_failures += 1
@@ -304,9 +316,14 @@ class DispatchWatchdog:
             item = q.get()
             if item is None:
                 return
-            fn, args, out, done = item
+            fn, args, out, done, ctx = item
             try:
-                out.append(("ok", fn(*args)))
+                # run under the CALLER's contextvars snapshot: spans
+                # opened inside the watchdogged call (jit.compile,
+                # nested dispatch children) parent to the caller's
+                # active span instead of starting orphan traces on
+                # this worker thread
+                out.append(("ok", ctx.run(fn, *args)))
             except BaseException as exc:  # noqa: BLE001
                 out.append(("err", exc))
             done.set()
@@ -327,9 +344,11 @@ class DispatchWatchdog:
                 name="dispatch-watchdog",
                 daemon=True,
             ).start()
+        import contextvars
+
         out: list = []
         done = threading.Event()
-        q.put((fn, args, out, done))
+        q.put((fn, args, out, done, contextvars.copy_context()))
         if not done.wait(timeout):
             # abandon THIS worker only; it exits once the wedged
             # call drains
@@ -392,7 +411,10 @@ def guarded_dispatch(
             (faultinject.FaultInjected,) if donated else (Exception,)
         ),
         on_retry=lambda attempt, exc: (
-            metrics.dispatch_retries_total.inc()
+            metrics.dispatch_retries_total.inc(),
+            tracing.add_event(
+                "dispatch.retry", attempt=attempt, error=repr(exc)
+            ),
         ),
     )
 
@@ -418,6 +440,10 @@ class AdmissionGate:
                 and self._inflight + n > self.limit
             ):
                 self.shed_total += n
+                tracing.add_event(
+                    "admission.shed", flows=n,
+                    inflight=self._inflight, limit=self.limit,
+                )
                 return False
             self._inflight += n
             return True

@@ -21,6 +21,13 @@ class SpanStat:
         self._start: Optional[float] = None
 
     def start(self) -> "SpanStat":
+        """Begin a measurement window.  A start() while a span is
+        already running folds the in-flight elapsed time as a
+        SUCCESS first (the old behavior silently discarded it —
+        wrong once spans wrap re-entrant regen phases): no wall time
+        observed by a start/start/end sequence is ever lost."""
+        if self._start is not None:
+            self.end(success=True)
         self._start = time.perf_counter()
         return self
 
@@ -29,11 +36,17 @@ class SpanStat:
             return self
         d = time.perf_counter() - self._start
         self._start = None
+        return self.observe(d, success=success)
+
+    def observe(self, duration: float, success: bool = True) -> "SpanStat":
+        """Fold an externally measured duration — the ONE fold
+        implementation shared by end() and tracing.StatSpan, so the
+        /debug/profile and /debug/traces planes can't drift."""
         if success:
-            self.success_total += d
+            self.success_total += duration
             self.num_success += 1
         else:
-            self.failure_total += d
+            self.failure_total += duration
             self.num_failure += 1
         return self
 

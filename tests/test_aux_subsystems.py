@@ -153,6 +153,9 @@ def test_bugtool_collect(tmp_path):
     d.service_upsert(
         L3n4Addr("10.250.2.2", 80), [L3n4Addr("10.0.0.1", 8080)]
     )
+    # a synchronous sweep guarantees at least one traced operation
+    # is in the span ring when the archive is cut
+    d.regenerate_all("bugtool test")
     archive = bugtool.collect(d, str(tmp_path))
     assert os.path.exists(archive)
     with tarfile.open(archive) as tar:
@@ -170,4 +173,21 @@ def test_bugtool_collect(tmp_path):
                 next(n for n in names if n.endswith("services.json"))
             )
         )
+        # span-plane ring dump: the archive's traces join against
+        # flows.json and metrics.prom by trace id offline
+        traces = json.load(
+            tar.extractfile(
+                next(n for n in names if n.endswith("traces.json"))
+            )
+        )
     assert svc and svc[0]["frontend"] == "10.250.2.2:80"
+    assert {"spans", "dropped", "sample_rate"} <= traces.keys()
+    regen = [
+        s for s in traces["spans"]
+        if s["name"] == "daemon.regenerate"
+    ]
+    assert regen, "endpoint create's regen sweep must be traced"
+    assert all(
+        len(s["trace_id"]) == 32 and len(s["span_id"]) == 16
+        for s in traces["spans"]
+    )

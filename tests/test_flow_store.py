@@ -410,6 +410,57 @@ def test_follow_mode_last_keeps_oldest():
     assert [f["dport"] for f in rest["flows"]] == [2, 3, 4]
 
 
+def test_follow_cursor_evicted_resumes_at_oldest_retained():
+    """Satellite: GET /flows?follow=1&since-seq=N where N has been
+    evicted from the ring — the cursor must resume at the OLDEST
+    retained record, neither skipping nor duplicating live records
+    across subsequent polls."""
+    import threading as _threading
+
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.daemon import Daemon
+
+    d = Daemon()
+    d.flow_store = FlowStore(capacity=8)
+    for i in range(20):  # seqs 1..20; ring retains 13..20
+        d.flow_store.append(
+            _record(verdict=VERDICT_DROPPED, dport=i)
+        )
+    api = DaemonAPI(d)
+    # cursor seq 5 was evicted (oldest retained is seq 13)
+    got = api.flows_get(
+        {"follow": "1", "since-seq": "5", "last": "0",
+         "timeout": "0.1"}
+    )
+    assert [f["seq"] for f in got["flows"]] == list(range(13, 21))
+    assert [f["dport"] for f in got["flows"]] == list(range(12, 20))
+    assert got["last_seq"] == 20
+    # resuming from the reply's cursor: nothing is re-delivered, and
+    # a record landing later arrives exactly once
+    def _late_append():
+        time.sleep(0.05)
+        d.flow_store.append(
+            _record(verdict=VERDICT_DROPPED, dport=99)
+        )
+
+    t = _threading.Thread(target=_late_append)
+    t.start()
+    nxt = api.flows_get(
+        {"follow": "1", "since-seq": str(got["last_seq"]),
+         "last": "0", "timeout": "5"}
+    )
+    t.join()
+    assert [f["dport"] for f in nxt["flows"]] == [99]
+    assert nxt["last_seq"] == 21
+    # an evicted cursor combined with `last` still keeps the OLDEST
+    # of the retained burst (the cursor-protection contract)
+    trimmed = api.flows_get(
+        {"follow": "1", "since-seq": "2", "last": "3",
+         "timeout": "0.1"}
+    )
+    assert [f["seq"] for f in trimmed["flows"]] == [14, 15, 16]
+
+
 def test_capture_truncates_drop_storm_to_capacity():
     """A batch with more drops than the ring holds builds only the
     newest capacity's worth of records; the excess is charged as

@@ -97,6 +97,66 @@ def test_mesh_counters_match_single_device(dp, tp):
     assert int(np.asarray(got_l4).sum()) + int(np.asarray(got_l3).sum()) > 0
 
 
+def test_traced_dispatch_per_chip_spans():
+    """engine.sharded.traced_dispatch: verdicts pass through
+    untouched, jit cache hits/misses are counted per call, and each
+    dispatch lands a mesh.dispatch span whose per-chip children
+    partition it — one child per mesh device, rows split evenly."""
+    from cilium_tpu import tracing
+    from cilium_tpu.engine.sharded import traced_dispatch
+    from cilium_tpu.metrics import registry as metrics
+
+    states, tables, t = _build(seed=3)
+    mesh = _mesh(4, 2)
+    batch = TupleBatch.from_numpy(**t)
+    want, _, _ = make_mesh_evaluator(mesh)(tables, batch)
+
+    tracer = tracing.Tracer(seed=55)
+    site = "engine.sharded.test"
+    hits0 = metrics.jit_cache_hits.get(site)
+    miss0 = metrics.jit_cache_misses.get(site)
+    step = traced_dispatch(
+        make_mesh_evaluator(mesh), mesh, site=site
+    )
+    tok = tracing._current.set(None)
+    old_tracer, tracing.tracer = tracing.tracer, tracer
+    try:
+        got, _, _ = step(tables, batch)
+        got2, _, _ = step(tables, batch)
+    finally:
+        tracing.tracer = old_tracer
+        tracing._current.reset(tok)
+    np.testing.assert_array_equal(
+        np.asarray(got.allowed), np.asarray(want.allowed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got2.allowed), np.asarray(want.allowed)
+    )
+    assert metrics.jit_cache_misses.get(site) == miss0 + 1
+    assert metrics.jit_cache_hits.get(site) == hits0 + 1
+
+    parents = [
+        s for s in tracer.snapshot() if s.name == "mesh.dispatch"
+    ]
+    assert len(parents) == 2
+    for parent in parents:
+        assert parent.attrs["chips"] == 8
+        assert parent.attrs["rows"] == len(t["identity"])
+        chips = [
+            s
+            for s in tracer.snapshot()
+            if s.name == "chip.dispatch"
+            and s.parent_id == parent.span_id
+        ]
+        assert [c.attrs["chip"] for c in chips] == list(range(8))
+        assert all(
+            c.attrs["rows"] == len(t["identity"]) // 8
+            for c in chips
+        )
+        total = sum(c.duration for c in chips)
+        assert total == pytest.approx(parent.duration, rel=1e-6)
+
+
 def test_multiword_per_shard_universe():
     """identity_pad=256 → 8 bit-words; at table=2 each shard owns 4
     words, so word-offset clipping and per-shard L3 counter slices are
