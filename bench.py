@@ -61,7 +61,12 @@ def emit(metric: str, value, unit: str, vs_baseline=None, **extra) -> None:
         line["vs_baseline"] = vs_baseline
     line.update(extra)
     if metric == "verdicts_per_sec_per_chip":
-        _HEADLINE = line
+        # the mid-run emission is a crash-safety copy (config 5 runs
+        # first so a budget kill can't lose the headline); it is
+        # LABELED provisional so trajectory parsers see exactly one
+        # canonical record — the clean re-emission at exit
+        _HEADLINE = {k: v for k, v in line.items() if k != "provisional"}
+        line["provisional"] = True
     print(json.dumps(line), flush=True)
 
 
@@ -664,8 +669,76 @@ def run_config5(args) -> None:
     assert telemetry_consistent(got_telem), got_telem
     del acc_gate, telem_gate, out_full_in, out_full_eg
 
-    # fresh buffers so counter_hits/telemetry reflect exactly the
-    # timed tuples
+    # --- hot/cold + packed4 staging gate: the headline program (hot
+    # policy plane only, [4, B] u32 staged columns unpacked in-jit)
+    # computes bit-identical verdict columns, counters AND telemetry
+    # to the u32-column pair program on the full tables ---------------------
+    from cilium_tpu.compiler.tables import split_hot
+    from cilium_tpu.engine.datapath import pack_flow_records4
+
+    def _packed4_of(fb):
+        return pack_flow_records4(
+            ep_index=np.asarray(fb.ep_index),
+            saddr=np.asarray(fb.saddr),
+            daddr=np.asarray(fb.daddr),
+            sport=np.asarray(fb.sport),
+            dport=np.asarray(fb.dport),
+            proto=np.asarray(fb.proto),
+            direction=np.asarray(fb.direction),
+            is_fragment=np.asarray(fb.is_fragment),
+        )
+
+    tables_hot = DatapathTables(
+        prefilter=tables.prefilter,
+        ipcache=tables.ipcache,
+        ct=tables.ct,
+        lb=tables.lb,
+        policy=split_hot(tables.policy),
+    )
+    from cilium_tpu.engine.datapath import (
+        datapath_step_accum_pair_telem_packed4_stacked,
+    )
+
+    acc_p = jax.device_put(make_counter_buffers(tables.policy))
+    telem_p = jax.device_put(make_telemetry_buffers())
+    acc_r = jax.device_put(make_counter_buffers(tables.policy))
+    telem_r = jax.device_put(make_telemetry_buffers())
+    pk_pair = jax.device_put(
+        np.stack([_packed4_of(gate_in), _packed4_of(gate_eg)])
+    )
+    got_i, got_e, acc_p, telem_p = (
+        datapath_step_accum_pair_telem_packed4_stacked(
+            tables_hot, pk_pair, acc_p, telem_p
+        )
+    )
+    ref_i, ref_e, acc_r, telem_r = datapath_step_accum_pair_telem(
+        tables, gate_in, gate_eg, acc_r, telem_r
+    )
+    for got, ref in ((got_i, ref_i), (got_e, ref_e)):
+        for col in (
+            "allowed", "proxy_port", "match_kind", "sec_id",
+            "ct_result", "pre_dropped", "final_daddr", "final_dport",
+            "rev_nat", "lb_slave", "ct_create", "ct_delete",
+            "l4_slot", "ipcache_miss",
+        ):
+            assert np.array_equal(
+                np.asarray(getattr(got, col)),
+                np.asarray(getattr(ref, col)),
+            ), f"packed4/hot-split divergence in verdict column {col}"
+    assert np.array_equal(np.asarray(acc_p), np.asarray(acc_r)), (
+        "packed4/hot-split counter divergence"
+    )
+    assert np.array_equal(np.asarray(telem_p), np.asarray(telem_r)), (
+        "packed4/hot-split telemetry divergence"
+    )
+    del acc_p, telem_p, acc_r, telem_r, got_i, got_e, ref_i, ref_e
+    del pk_pair
+
+    # --- instrumented reference loop (device-resident batches): the
+    # telemetry A/B substrate — the same pairs the bare loop below
+    # replays, through the instrumented program.  The HEADLINE number
+    # now comes from the autotuned async staging loop further down;
+    # this loop only prices the instrumentation.
     acc = jax.device_put(make_counter_buffers(tables.policy))
     telem = jax.device_put(make_telemetry_buffers())
     bench_spans.span("dispatch").start()
@@ -685,8 +758,6 @@ def run_config5(args) -> None:
     jax.block_until_ready((acc, telem))
     dt = time.perf_counter() - t0
     bench_spans.span("device").end()
-    total = n_batches * 2 * half
-    vps = total / dt
 
     # --- bare reference loop: the same batches through the
     # uninstrumented pair program → telemetry_overhead_pct ------------------
@@ -705,12 +776,13 @@ def run_config5(args) -> None:
     dt_bare = time.perf_counter() - t0
     del acc_bare
     overhead_pct = (dt - dt_bare) / dt_bare * 100.0
+    total_ref = n_batches * 2 * half
     emit(
         "telemetry_overhead_pct",
         round(overhead_pct, 2),
         "%",
-        instrumented_verdicts_per_sec=round(total / dt),
-        bare_verdicts_per_sec=round(total / dt_bare),
+        instrumented_verdicts_per_sec=round(total_ref / dt),
+        bare_verdicts_per_sec=round(total_ref / dt_bare),
         note=(
             "instrumented headline pipeline (counters + [2, T] "
             "stage reductions fused into the pair dispatch) vs the "
@@ -909,6 +981,237 @@ def run_config5(args) -> None:
         ),
     )
 
+    # --- autotune: pow2 batch sizes × hot-plane pack widths ----------------
+    # A small measured search (cached per table shape class) picks
+    # the jit class the headline loop runs: candidates maximize
+    # verdicts/s subject to the p99 batch-latency bound.  Pack-width
+    # candidates re-place the hashed entry tables via
+    # repack_hash_lanes — no policy recompile, and the layout stamp
+    # keeps delta publication honest about the changed layout.
+    from cilium_tpu.engine import autotune as at
+    from cilium_tpu.compiler.tables import repack_hash_lanes
+
+    cur_lanes = int(np.asarray(tables.policy.l4_hash_rows).shape[1])
+    lane_tables = {cur_lanes: tables_hot}
+
+    def _tables_for(lanes):
+        if lanes not in lane_tables:
+            lane_tables[lanes] = jax.device_put(
+                DatapathTables(
+                    prefilter=tables.prefilter,
+                    ipcache=tables.ipcache,
+                    ct=tables.ct,
+                    lb=tables.lb,
+                    policy=split_hot(
+                        repack_hash_lanes(tables.policy, lanes)
+                    ),
+                )
+            )
+        return lane_tables[lanes]
+
+    def _host_pairs_packed(prng, half_c, k):
+        """k host-staged [2, 4, half] u32 pair pre-packs from the
+        per-direction pool subsets (the host half of the staging;
+        ONE array per pair = one device_put per batch)."""
+        pairs = []
+        for _ in range(k):
+            pair = np.empty((2, 4, half_c), np.uint32)
+            for row, subset in enumerate((idx_ingress, idx_egress)):
+                picks = subset[
+                    prng.integers(0, len(subset), size=half_c)
+                ]
+                pair[row] = pack_flow_records4(
+                    ep_index=pool["ep_index"][picks],
+                    saddr=pool["saddr"][picks],
+                    daddr=pool["daddr"][picks],
+                    sport=pool["sport"][picks],
+                    dport=pool["dport"][picks],
+                    proto=pool["proto"][picks],
+                    direction=pool["direction"][picks],
+                    is_fragment=pool["is_fragment"][picks],
+                )
+            pairs.append(pair)
+        return pairs
+
+    def _run_candidate(params):
+        t_c = _tables_for(params["hash_lanes"])
+        half_c = params["batch"] // 2
+        pairs = _host_pairs_packed(
+            np.random.default_rng(31), half_c, 2
+        )
+        state = {
+            "acc": jax.device_put(
+                make_counter_buffers(tables.policy)
+            ),
+            "telem": jax.device_put(make_telemetry_buffers()),
+            "i": 0,
+        }
+
+        def step(pair):
+            o_i, o_e, state["acc"], state["telem"] = (
+                datapath_step_accum_pair_telem_packed4_stacked(
+                    t_c, jnp_dev(pair),
+                    state["acc"], state["telem"],
+                )
+            )
+            return o_i.allowed, o_e.allowed
+
+        def make_args():
+            state["i"] += 1
+            return (pairs[state["i"] % len(pairs)],)
+
+        return at.measure_dispatch(
+            step, make_args, params["batch"], reps=3,
+            outstanding=2, sync_reps=2,
+        )
+
+    import jax.numpy as _jnp
+
+    def jnp_dev(a):
+        return _jnp.asarray(a)
+
+    if args.no_autotune:
+        choice = at.TuneChoice(
+            params={"batch": args.batch, "hash_lanes": cur_lanes},
+            verdicts_per_sec=0.0, p99_batch_ms=0.0,
+        )
+    else:
+        cands = []
+        for lanes in dict.fromkeys((cur_lanes, 128)):
+            for bs in dict.fromkeys(
+                (max(args.batch >> 1, 1 << 20), args.batch)
+            ):
+                cands.append({"batch": bs, "hash_lanes": lanes})
+        choice = at.autotune(
+            cands,
+            _run_candidate,
+            p99_bound_ms=args.autotune_p99_ms,
+            cache_key=at.shape_class_key(tables.policy),
+            log=lambda msg: print(f"# {msg}", file=sys.stderr),
+        )
+    chosen_bs = choice.params["batch"]
+    chosen_lanes = choice.params["hash_lanes"]
+    tables_chosen = _tables_for(chosen_lanes)
+    emit(
+        "autotune_choice",
+        chosen_bs,
+        "tuples/batch",
+        hash_lanes=chosen_lanes,
+        p99_bound_ms=args.autotune_p99_ms,
+        trials=[
+            {
+                "batch": t.params["batch"],
+                "hash_lanes": t.params["hash_lanes"],
+                "verdicts_per_sec": round(t.verdicts_per_sec),
+                "p99_batch_ms": round(t.p99_batch_ms, 1),
+                "admitted": t.admitted,
+            }
+            for t in choice.trials
+        ],
+        note=(
+            "pow2 batch sizes x hot-plane pack widths, cached per "
+            "table shape class (jit classes bounded; see "
+            "cilium_jit_cache_* metrics)"
+        ),
+    )
+
+    # --- HEADLINE: double-buffered async staging loop ----------------------
+    # The host stages batch N+1 ([4, B] u32 packed columns,
+    # jax.device_put) while the device computes batch N; results
+    # drain one batch behind (engine.publish.AsyncBatchDispatcher —
+    # the epoch ping-pong applied to batches).
+    from cilium_tpu.engine.publish import AsyncBatchDispatcher
+
+    half_h = chosen_bs // 2
+    n_batches_h = max(args.tuples // chosen_bs, 1)
+    host_pairs = _host_pairs_packed(
+        np.random.default_rng(41), half_h, min(n_batches_h, 6)
+    )
+    acc = jax.device_put(make_counter_buffers(tables.policy))
+    telem = jax.device_put(make_telemetry_buffers())
+    hstate = {"acc": acc, "telem": telem, "last": None}
+
+    def _h_dispatch(pair_dev):
+        o_i, o_e, hstate["acc"], hstate["telem"] = (
+            datapath_step_accum_pair_telem_packed4_stacked(
+                tables_chosen, pair_dev,
+                hstate["acc"], hstate["telem"],
+            )
+        )
+        hstate["last"] = (o_i, o_e)
+        return (o_i, o_e)
+
+    disp = AsyncBatchDispatcher(
+        pack_fn=lambda pair: (jax.device_put(pair),),
+        dispatch_fn=_h_dispatch,
+        depth=max(args.async_depth, 0),
+    )
+    # warmup the chosen class (autotune already compiled it unless
+    # --no-autotune picked a fresh shape)
+    w_i, w_e, hstate["acc"], hstate["telem"] = (
+        datapath_step_accum_pair_telem_packed4_stacked(
+            tables_chosen,
+            jax.device_put(host_pairs[0]),
+            hstate["acc"], hstate["telem"],
+        )
+    )
+    jax.block_until_ready((w_i, w_e))
+    del w_i, w_e
+    # fresh accumulators so counter_hits/telemetry reflect exactly
+    # the timed tuples
+    hstate["acc"] = jax.device_put(make_counter_buffers(tables.policy))
+    hstate["telem"] = jax.device_put(make_telemetry_buffers())
+    bench_spans.span("async_dispatch").start()
+    t0 = time.perf_counter()
+    for i in range(n_batches_h):
+        drained = disp.submit((host_pairs[i % len(host_pairs)],))
+        for _, _, exc in drained:
+            if exc is not None:
+                raise exc
+    for _, _, exc in disp.flush():
+        if exc is not None:
+            raise exc
+    jax.block_until_ready((hstate["acc"], hstate["telem"]))
+    dt = time.perf_counter() - t0
+    bench_spans.span("async_dispatch").end()
+    total = n_batches_h * chosen_bs
+    vps = total / dt
+    acc = hstate["acc"]
+    telem = hstate["telem"]
+    out_i, out_e = hstate["last"]
+
+    # --- windowed batch latency + overlap efficiency -----------------------
+    # Synchronous segment at the chosen class with PRE-STAGED device
+    # args: per-batch device latency (p50/p99) and the device-busy
+    # estimate behind overlap_efficiency_pct (device seconds that
+    # the async wall clock must at least cover; 100% = staging fully
+    # hidden behind device compute).
+    dev_pair = jax.device_put(host_pairs[0])
+    acc_s = jax.device_put(make_counter_buffers(tables.policy))
+    telem_s = jax.device_put(make_telemetry_buffers())
+    sync_lat = []
+    for i in range(8):
+        b0 = time.perf_counter()
+        s_i, s_e, acc_s, telem_s = (
+            datapath_step_accum_pair_telem_packed4_stacked(
+                tables_chosen, dev_pair, acc_s, telem_s,
+            )
+        )
+        jax.block_until_ready((s_i, s_e))
+        lat = time.perf_counter() - b0
+        sync_lat.append(lat)
+        metrics_registry.batch_duration.observe(lat)
+    del acc_s, telem_s
+    p50_batch_s = metrics_registry.batch_duration.window_quantile(0.5)
+    p99_batch_s = metrics_registry.batch_duration.window_quantile(0.99)
+    device_est_s = float(np.median(sync_lat)) * n_batches_h
+    overlap_pct = disp.overlap_efficiency_pct(device_est_s)
+
+    # gather-byte accounting: the bytes-moved model behind the split
+    profile = at.hot_gather_profile(tables_chosen, packed_io=True)
+    hot_bpt = at.hot_bytes_per_tuple(tables_chosen, packed_io=True)
+    cold_bpt = at.cold_bytes_per_tuple(tables_chosen)
+
     # --- scatter fold: device accumulators → host registry -----------------
     bench_spans.span("scatter_fold").start()
     counter_total = int(np.asarray(acc).sum())
@@ -953,20 +1256,6 @@ def run_config5(args) -> None:
             metrics_registry=event_registry,
         )
     bench_spans.span("event_fold").end()
-
-    # --- windowed batch latency: a short synchronous segment ---------------
-    for i in range(8):
-        fin, feg = flow_batches[i % len(flow_batches)]
-        b0 = time.perf_counter()
-        out_i, out_e, acc, telem = datapath_step_accum_pair_telem(
-            tables, fin, feg, acc, telem
-        )
-        jax.block_until_ready((out_i, out_e))
-        metrics_registry.batch_duration.observe(
-            time.perf_counter() - b0
-        )
-    p50_batch_s = metrics_registry.batch_duration.window_quantile(0.5)
-    p99_batch_s = metrics_registry.batch_duration.window_quantile(0.99)
 
     # secondary: the bare lattice on the same tables (round 1/2 metric)
     from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
@@ -1084,15 +1373,28 @@ def run_config5(args) -> None:
         ),
     )
 
-    # achieved HBM gather traffic of the headline loop (roofline
-    # context for regressions): bytes actually gathered per tuple —
-    # 3×4B lattice probes + 4 CT windowed probes (svc + effective
-    # tuple, fwd+rev each: PROBE_WINDOW slots × 4 key words × 4B) +
-    # 1 LB window (2 key words) + LPM 8B ×2 + batch IO
-    from cilium_tpu.engine.hashtable import PROBE_WINDOW
-
-    gather_bytes_per_tuple = (
-        12 + 4 * (PROBE_WINDOW * 4 * 4) + PROBE_WINDOW * 2 * 4 + 16 + 30
+    # achieved gather traffic of the headline loop (roofline context
+    # for regressions): the per-leaf bytes-moved model of the
+    # hot/cold split (engine.autotune.hot_gather_profile) — hot-plane
+    # bytes are what the fused kernel actually gathers per tuple
+    emit(
+        "hot_bytes_per_tuple",
+        round(hot_bpt, 1),
+        "bytes",
+        cold_bytes_per_tuple=round(cold_bpt, 1),
+        per_leaf=[
+            {
+                "stage": r["stage"], "leaf": r["leaf"],
+                "plane": r["plane"],
+                "bytes_per_tuple": round(r["bytes_per_tuple"], 1),
+            }
+            for r in profile
+        ],
+        note=(
+            "bytes gathered per tuple by the fused per-direction "
+            "pipeline; cold-plane leaves are never gathered (and "
+            "never shipped by a hot-only publication)"
+        ),
     )
     emit(
         "verdicts_per_sec_per_chip",
@@ -1100,7 +1402,8 @@ def run_config5(args) -> None:
         "verdicts/s",
         vs_baseline=round(vps / BASELINE_PER_CHIP, 3),
         tuples=total,
-        batch=args.batch,
+        batch=chosen_bs,
+        hash_lanes=chosen_lanes,
         p50_batch_ms=round(p50_batch_s * 1000, 1),
         p99_batch_ms=round(p99_batch_s * 1000, 1),
         counter_hits=counter_total,
@@ -1112,14 +1415,17 @@ def run_config5(args) -> None:
             for name, s in bench_spans.items()
         },
         monitor_events_sampled=n_events,
-        gathered_gb_per_sec=round(
-            vps * gather_bytes_per_tuple / 1e9, 1
-        ),
+        hot_bytes_per_tuple=round(hot_bpt, 1),
+        gathered_gb_per_sec=round(vps * hot_bpt / 1e9, 1),
+        overlap_efficiency_pct=round(overlap_pct, 1),
+        staging_pack_s=round(disp.pack_s, 3),
+        drain_block_s=round(disp.block_s, 3),
         pipeline=(
-            "instrumented paired per-direction programs, one "
-            "dispatch + one merged counter scatter + fused [2, T] "
-            "stage-telemetry reductions per pair: prefilter+LB/DNAT"
-            "+CT+ipcache+lattice+counters+telemetry"
+            "autotuned hot-plane pipeline: packed4 staged columns + "
+            "hot/cold-split tables through the instrumented paired "
+            "per-direction program (one dispatch, one merged counter "
+            "scatter, fused [2, T] telemetry), double-buffered async "
+            "staging overlapping host pack with device compute"
         ),
     )
 
@@ -2018,6 +2324,21 @@ def main() -> None:
     )
     ap.add_argument("--cidr-tuples", type=int, default=100_000)
     ap.add_argument("--l7-requests", type=int, default=1_000_000)
+    ap.add_argument(
+        "--no-autotune", action="store_true",
+        help="skip the batch-size / pack-width search and run the "
+        "headline loop at --batch with the compiled pack width",
+    )
+    ap.add_argument(
+        "--autotune-p99-ms", type=float, default=2000.0,
+        help="p99 batch-latency bound the autotuner must respect "
+        "when maximizing verdicts/s",
+    )
+    ap.add_argument(
+        "--async-depth", type=int, default=2,
+        help="batches in flight beyond the drain point in the "
+        "double-buffered headline dispatch loop",
+    )
     args = ap.parse_args()
 
     sys.path.insert(0, "/root/repo")

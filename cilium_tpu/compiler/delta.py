@@ -59,12 +59,20 @@ class TableDelta:
 
     `updates` leaves scatter in place; `replace` leaves ship whole
     (their shape class moved, or they are cheap scalars).  Leaves in
-    neither dict are byte-identical between the generations."""
+    neither dict are byte-identical between the generations.
+
+    `layout` stamps the hot/cold + pack-width layout
+    (compiler.tables.tables_layout_version) the delta's leaf set was
+    recorded against: the device store refuses to scatter it into an
+    epoch holding a different layout (and falls back to a full
+    upload) — indices recorded against one lane width or leaf split
+    are meaningless against another."""
 
     base_stamp: int
     new_stamp: int
     updates: Dict[str, LeafUpdate] = field(default_factory=dict)
     replace: Dict[str, np.ndarray] = field(default_factory=dict)
+    layout: int = 0
 
     @property
     def bytes_h2d(self) -> int:
@@ -269,8 +277,11 @@ class _IncrementalTable:
     through a PendingBuffer pair.  `stash` is rebuilt per publish
     (64×3 — cheaper to rebuild than to track)."""
 
-    def __init__(self, min_rows: int) -> None:
+    def __init__(self, min_rows: int, lanes: Optional[int] = None) -> None:
+        from cilium_tpu.compiler.tables import L4H_LANES
+
         self.min_rows = min_rows
+        self.lanes = L4H_LANES if lanes is None else lanes
         self.rows: Optional[np.ndarray] = None
         self.stash: Optional[np.ndarray] = None
         self.n_rows = 0
@@ -279,10 +290,18 @@ class _IncrementalTable:
         self.pub = PendingBuffer()
         self.stash_dirty = True
 
-    def _sized_rows(self, t: int) -> int:
-        from cilium_tpu.compiler.tables import _pow2_at_least, L4H_LOAD
+    @property
+    def entries(self) -> int:
+        from cilium_tpu.compiler.tables import l4h_entries
 
-        return _pow2_at_least(max(t // L4H_LOAD, 1), self.min_rows)
+        return l4h_entries(self.lanes)
+
+    def _sized_rows(self, t: int) -> int:
+        from cilium_tpu.compiler.tables import _pow2_at_least, l4h_load
+
+        return _pow2_at_least(
+            max(t // l4h_load(self.lanes), 1), self.min_rows
+        )
 
     def full_build(self, cols: dict) -> Set[int]:
         """From-scratch placement — delegates to the ONE shared
@@ -294,7 +313,7 @@ class _IncrementalTable:
 
         rows, stash, so, b = place_l4_hash(
             cols["w0"], cols["w1"], cols["val"], cols["h"],
-            self.min_rows,
+            self.min_rows, lanes=self.lanes,
         )
         self.overflow = {}
         for pos in so.tolist():  # already (bucket, order)-sorted
@@ -345,16 +364,13 @@ class _IncrementalTable:
         changed-row set, or None when the delta preconditions fail
         (size class moved / stash overflow) and the caller must
         full_build."""
-        from cilium_tpu.compiler.tables import (
-            L4H_ENTRIES,
-            L4H_STASH,
-        )
+        from cilium_tpu.compiler.tables import L4H_STASH
 
         if self.rows is None or self._sized_rows(t_new) != self.n_rows:
             return None
         if len(affected) == 0:
             return set()
-        e = L4H_ENTRIES
+        e = self.entries
         placed: Dict[int, list] = {}
         over_total = sum(len(v) for v in self.overflow.values())
         for bb in affected.tolist():
@@ -416,8 +432,12 @@ class _IncrementalTable:
 
     def published(self) -> Tuple[np.ndarray, np.ndarray]:
         """(rows, stash) safe to hand out: rows through the publish
-        pair, stash freshly owned by this generation."""
-        return self.pub.publish(self.rows), self.stash
+        pair, stash freshly owned by this generation and trimmed to
+        its occupied pow2 prefix (tables.trim_stash) — the published
+        layout the probes broadcast-compare."""
+        from cilium_tpu.compiler.tables import trim_stash
+
+        return self.pub.publish(self.rows), trim_stash(self.stash)
 
 
 class IncrementalHashPair:
@@ -425,14 +445,15 @@ class IncrementalHashPair:
     compiles (see module docstring).  `build` is the FleetCompiler's
     replacement for the from-scratch _build_hash."""
 
-    def __init__(self) -> None:
+    def __init__(self, lanes: Optional[int] = None) -> None:
         self._sections: Dict[int, dict] = {}  # ep_id -> cols per table
         self._order: Optional[Tuple[int, ...]] = None
-        self.exact = _IncrementalTable(min_rows=64)
-        self.wild = _IncrementalTable(min_rows=16)
+        self.exact = _IncrementalTable(min_rows=64, lanes=lanes)
+        self.wild = _IncrementalTable(min_rows=16, lanes=lanes)
+        self.lanes = self.exact.lanes
 
     def reset(self) -> None:
-        self.__init__()
+        self.__init__(self.lanes)
 
     def _concat(self, order: Sequence[int], table: str) -> dict:
         secs = [self._sections[ep][table] for ep in order]

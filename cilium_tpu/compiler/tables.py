@@ -75,20 +75,49 @@ NUM_DIRECTIONS = 2  # INGRESS, EGRESS
 # per-endpoint BPF hash maps are entry-proportional too,
 # pkg/maps/policymap) rather than E×Kg×identities.
 #
-# Row = one bucket of 42 planar 3-word entries:
-#   lanes [0, 42)   key0 = idx | dir << 22 | (ep & 0x1FF) << 23
-#   lanes [42, 84)  key1 = dport << 16 | proto << 8 | ep >> 9
-#   lanes [84, 126) value = j << 16 | proxy_port
+# Row = one bucket of `lanes // 3` planar 3-word entries (E below):
+#   lanes [0, E)    key0 = idx | dir << 22 | (ep & 0x1FF) << 23
+#   lanes [E, 2E)   key1 = dport << 16 | proto << 8 | ep >> 9
+#   lanes [2E, 3E)  value = j << 16 | proxy_port
 # Wildcard (identity 0) entries store idx = L4H_WILD_IDX.  Empty lanes
 # hold key1 = 0xFFFFFFFF, unreachable because ep >> 9 < 128 for any
 # endpoint index < 2^16 (the reference's endpoint-id cap).
-L4H_ENTRIES = 42
+#
+# The lane width is the HOT-PLANE PACK WIDTH: the per-tuple probe
+# gathers exactly one `lanes`-wide row and lane-compares E entries, so
+# bytes-moved-per-tuple and compare work both scale linearly with it.
+# The default is 64 lanes (21 entries, ~8 average load): halving the
+# legacy 128-lane rows halves the dominant gather of the fused
+# pipeline while the overflow tail (Poisson beyond 21 at lambda=8)
+# stays far below the stash.  Build and probe both derive E from the
+# row shape — the array IS the layout contract.
+L4H_LANES = 64
 L4H_WILD_IDX = np.uint32((1 << 22) - 1)
 L4H_STASH = 64
-# average entries per 42-capacity bucket row at build time; the
-# Poisson tail beyond 42 at lambda=16 is ~1e-8 per bucket, so the
-# stash is headroom, not a working set
-L4H_LOAD = 16
+
+
+def l4h_entries(lanes: int) -> int:
+    """Entries per bucket row at a given lane width (3 words each)."""
+    return lanes // 3
+
+
+def l4h_load(lanes: int) -> int:
+    """Target average entries per bucket when sizing the row count —
+    lanes/8 keeps the overflow tail roughly constant across widths."""
+    return max(lanes // 8, 2)
+
+
+def trim_stash(stash: np.ndarray) -> np.ndarray:
+    """Trim a [L4H_STASH, 3] stash to the pow2 prefix that holds its
+    occupied rows (front-filled; empty rows carry w1 = 0xFFFFFFFF).
+    The probe broadcast-compares EVERY stash lane against every tuple,
+    so an empty stash shipped at capacity charges the hot path 64
+    never-matching compares per table per tuple; verdicts are
+    unchanged by construction (trimmed lanes can never match)."""
+    from cilium_tpu.engine.hashtable import trim_pow2_prefix
+
+    used = int((stash[:, 1] != np.uint32(0xFFFFFFFF)).sum())
+    return trim_pow2_prefix(stash, used)
 
 
 def l4h_key0(idx, d, ep):
@@ -116,6 +145,7 @@ def place_l4_hash(
     value: np.ndarray,
     h: np.ndarray,
     min_rows: int,
+    lanes: int = L4H_LANES,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Sizing + bucket placement over precomputed key/hash columns —
     THE layout implementation, shared by build_l4_hash and the
@@ -125,26 +155,27 @@ def place_l4_hash(
     let the delta builder reconstruct its per-bucket overflow state
     without re-deriving the placement."""
     t = len(w0)
-    n_rows = _pow2_at_least(max(t // L4H_LOAD, 1), min_rows)
+    entries = l4h_entries(lanes)
+    n_rows = _pow2_at_least(max(t // l4h_load(lanes), 1), min_rows)
     while True:
         b = (h & np.uint32(n_rows - 1)).astype(np.int64)
         order = np.argsort(b, kind="stable")
         sb = b[order]
         first = np.searchsorted(sb, sb)
         rank = np.arange(t, dtype=np.int64) - first
-        main = rank < L4H_ENTRIES
+        main = rank < entries
         if int((~main).sum()) <= L4H_STASH:
             break
         n_rows <<= 1
-    rows = np.zeros((n_rows, 128), dtype=np.uint32)
-    rows[:, L4H_ENTRIES : 2 * L4H_ENTRIES] = np.uint32(0xFFFFFFFF)
+    rows = np.zeros((n_rows, lanes), dtype=np.uint32)
+    rows[:, entries : 2 * entries] = np.uint32(0xFFFFFFFF)
     flat = rows.reshape(-1)
     # `main`/`rank` index SORTED positions; `order` maps them back
     mo = order[main]
-    base = sb[main] * 128 + rank[main]
+    base = sb[main] * lanes + rank[main]
     flat[base] = w0[mo]
-    flat[base + L4H_ENTRIES] = w1[mo]
-    flat[base + 2 * L4H_ENTRIES] = value[mo]
+    flat[base + entries] = w1[mo]
+    flat[base + 2 * entries] = value[mo]
     stash = np.zeros((L4H_STASH, 3), dtype=np.uint32)
     stash[:, 1] = np.uint32(0xFFFFFFFF)
     so = order[~main]
@@ -162,11 +193,13 @@ def build_l4_hash(
     proto: np.ndarray,
     value: np.ndarray,
     min_rows: int = 64,
+    lanes: int = L4H_LANES,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Vectorized bucket placement of T entries → (rows u32 [R, 128],
-    stash u32 [L4H_STASH, 3]).  R is a power of two sized for ~16
-    entries per 42-capacity row; rows double until the overflow fits
-    the stash (never in practice — the tail is Poisson)."""
+    """Vectorized bucket placement of T entries → (rows u32
+    [R, lanes], stash u32 [pow2 used, 3]).  R is a power of two sized
+    for ~lanes/8 entries per lanes//3-capacity row; rows double until
+    the overflow fits the stash (never in practice — the tail is
+    Poisson)."""
     t = len(ep)
     if np.any((idx >= L4H_WILD_IDX) & (idx != L4H_WILD_IDX)):
         raise ValueError("identity index exceeds 22-bit hash key space")
@@ -177,8 +210,10 @@ def build_l4_hash(
     w0 = l4h_key0(idx, d, ep)
     w1 = l4h_key1(dport, proto, ep)
     h = _fnv1a_host_2(w0, w1)
-    rows, stash, _, _ = place_l4_hash(w0, w1, value, h, min_rows)
-    return rows, stash
+    rows, stash, _, _ = place_l4_hash(
+        w0, w1, value, h, min_rows, lanes=lanes
+    )
+    return rows, trim_stash(stash)
 
 
 def build_l4_hash_pair(
@@ -188,6 +223,7 @@ def build_l4_hash_pair(
     dport: np.ndarray,
     proto: np.ndarray,
     value: np.ndarray,
+    lanes: int = L4H_LANES,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Partition entries into the main (exact) and wildcard tables:
     (rows, stash, wild_rows, wild_stash)."""
@@ -195,11 +231,11 @@ def build_l4_hash_pair(
     keep = ~wild
     rows, stash = build_l4_hash(
         ep[keep], d[keep], idx[keep], dport[keep], proto[keep],
-        value[keep],
+        value[keep], lanes=lanes,
     )
     wrows, wstash = build_l4_hash(
         ep[wild], d[wild], idx[wild], dport[wild], proto[wild],
-        value[wild], min_rows=16,
+        value[wild], min_rows=16, lanes=lanes,
     )
     return rows, stash, wrows, wstash
 
@@ -335,6 +371,122 @@ def _register_pytree() -> None:
 _register_pytree()
 
 
+# -- hot/cold leaf planes ----------------------------------------------------
+# The fused single-chip kernels (engine/verdict._probes with the
+# hashed entry tables, engine/datapath) touch only the HOT leaves:
+# everything the per-tuple verdict gathers read.  The COLD leaves are
+# the dense-bitmap fallback layout — the 32 MB (proto, dport) slot
+# table and the [E, 2, Kg, W] allow bitmap, by far the largest leaves
+# — consumed only by the table-axis-sharded mesh evaluator and
+# hand-built tables without the hash pair.  A hot-only publication
+# (engine/publish.DeviceTableStore(hot_only=True)) keeps the cold
+# plane host-resident: HBM holds and delta publishes ship only the
+# words the verdict path can ever gather.
+HOT_LEAVES = (
+    "id_table",
+    "id_direct",
+    "id_lo_len",
+    "l4_meta",
+    "l3_allow_bits",
+    "generation",
+    "l4_hash_rows",
+    "l4_hash_stash",
+    "l4_wild_rows",
+    "l4_wild_stash",
+)
+COLD_LEAVES = ("port_slot", "l4_allow_bits")
+
+
+def split_hot(tables: "PolicyTables") -> "PolicyTables":
+    """The hot plane of `tables`: cold leaves dropped (None).  Only
+    valid for tables carrying the hashed entry pair — without it the
+    kernel's fallback path needs the cold dense layout."""
+    if tables.l4_hash_rows is None:
+        raise ValueError(
+            "hot/cold split requires the hashed L4 entry tables "
+            "(dense-fallback tables gather the cold plane)"
+        )
+    import dataclasses
+
+    return dataclasses.replace(
+        tables, **{leaf: None for leaf in COLD_LEAVES}
+    )
+
+
+def is_hot_only(tables) -> bool:
+    return any(getattr(tables, leaf) is None for leaf in COLD_LEAVES)
+
+
+def tables_layout_version(tables) -> int:
+    """Layout stamp of a PolicyTables instance: hashed-table pack
+    widths + hot/cold coldness bits.  Two tables with different
+    stamps have structurally different leaf sets or lane widths, so a
+    TableDelta recorded against one cannot scatter into an epoch
+    holding the other — DeviceTableStore falls back to a full upload
+    on mismatch (the layout guard beside the reset-counter guard)."""
+    if tables is None:
+        return 0
+    rows = getattr(tables, "l4_hash_rows", None)
+    wrows = getattr(tables, "l4_wild_rows", None)
+    lanes = 0 if rows is None else int(rows.shape[1])
+    wlanes = 0 if wrows is None else int(wrows.shape[1])
+    cold_bits = 0
+    for i, leaf in enumerate(COLD_LEAVES):
+        if getattr(tables, leaf, None) is None:
+            cold_bits |= 1 << i
+    return lanes | (wlanes << 11) | (cold_bits << 22)
+
+
+def _hash_entry_cols(
+    rows: np.ndarray, stash: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(w0, w1, value) columns of every occupied entry of one hashed
+    table, in (bucket, lane) order then stash order."""
+    e = rows.shape[1] // 3
+    w0 = rows[:, :e].reshape(-1)
+    w1 = rows[:, e : 2 * e].reshape(-1)
+    val = rows[:, 2 * e : 3 * e].reshape(-1)
+    keep = w1 != np.uint32(0xFFFFFFFF)
+    skeep = stash[:, 1] != np.uint32(0xFFFFFFFF)
+    return (
+        np.concatenate([w0[keep], stash[skeep, 0]]),
+        np.concatenate([w1[keep], stash[skeep, 1]]),
+        np.concatenate([val[keep], stash[skeep, 2]]),
+    )
+
+
+def repack_hash_lanes(
+    tables: "PolicyTables", lanes: int
+) -> "PolicyTables":
+    """Re-place both hashed entry tables at a different hot-plane
+    pack width — the autotuner's layout knob.  Entry keys/values are
+    read back from the existing rows, so no compiler state is needed;
+    verdicts are identical by construction (probe hits are keyed, not
+    positional).  The result's layout stamp differs from the source
+    compiler's, so delta publication refuses it (full upload) — the
+    repacked layout is a dispatch-side choice, not a new compile."""
+    import dataclasses
+
+    if tables.l4_hash_rows is None:
+        raise ValueError("no hashed entry tables to repack")
+    out = {}
+    for rows_leaf, stash_leaf, min_rows in (
+        ("l4_hash_rows", "l4_hash_stash", 64),
+        ("l4_wild_rows", "l4_wild_stash", 16),
+    ):
+        w0, w1, val = _hash_entry_cols(
+            np.asarray(getattr(tables, rows_leaf)),
+            np.asarray(getattr(tables, stash_leaf)),
+        )
+        h = _fnv1a_host_2(w0, w1)
+        rows, stash, _, _ = place_l4_hash(
+            w0, w1, val, h, min_rows, lanes=lanes
+        )
+        out[rows_leaf] = rows
+        out[stash_leaf] = trim_stash(stash)
+    return dataclasses.replace(tables, **out)
+
+
 def build_id_table(
     identity_ids: Sequence[int], identity_pad: int = 1024
 ) -> np.ndarray:
@@ -379,6 +531,7 @@ def lower_map_state(
     states: Sequence[PolicyMapState],
     id_table: np.ndarray,
     filter_pad: int = 64,
+    hash_lanes: int = L4H_LANES,
 ) -> PolicyTables:
     """Lower E desired map states onto a shared identity universe.
 
@@ -487,6 +640,7 @@ def lower_map_state(
         np.asarray(h_dport, np.uint32),
         np.asarray(h_proto, np.uint32),
         np.asarray(h_val, np.uint32),
+        lanes=hash_lanes,
     )
     return PolicyTables(
         id_table=id_table,
@@ -508,10 +662,14 @@ def compile_map_states(
     identity_ids: Sequence[int],
     identity_pad: int = 1024,
     filter_pad: int = 64,
+    hash_lanes: int = L4H_LANES,
 ) -> PolicyTables:
     """One-shot: build the shared identity table and lower E states."""
     return lower_map_state(
-        states, build_id_table(identity_ids, identity_pad), filter_pad
+        states,
+        build_id_table(identity_ids, identity_pad),
+        filter_pad,
+        hash_lanes=hash_lanes,
     )
 
 
@@ -543,10 +701,17 @@ class FleetCompiler:
     """
 
     def __init__(
-        self, identity_pad: int = 1024, filter_pad: int = 64
+        self,
+        identity_pad: int = 1024,
+        filter_pad: int = 64,
+        hash_lanes: int = L4H_LANES,
     ) -> None:
         self.identity_pad = identity_pad
         self.filter_pad = filter_pad
+        # hot-plane pack width of the hashed entry tables; fixed for
+        # the compiler's lifetime (the delta machinery's row/stash
+        # state is lane-width-specific)
+        self.hash_lanes = hash_lanes
         # publish generation: tables one generation old are intact
         # (double buffering); older ones may have been mutated in
         # place.  Survives _reset() — it counts publishes, not state.
@@ -578,7 +743,7 @@ class FleetCompiler:
         # the last compile's shape class, the per-publish change
         # records delta_for merges, and the caller-provided universe
         # version that short-circuits _sync_universe
-        self._hash_pair = IncrementalHashPair()
+        self._hash_pair = IncrementalHashPair(lanes=self.hash_lanes)
         self._shape_state: Optional[dict] = None
         self._pub_records = deque(maxlen=8)
         self._universe_token = None
@@ -1152,16 +1317,17 @@ class FleetCompiler:
                 (self._instance_nonce << 32) | self._generation
             ):
                 return None
+            layout = tables_layout_version(tables)
             base_gen = base_stamp & 0xFFFFFFFF
             if base_gen == self._generation:
-                return TableDelta(base_stamp, cur_stamp)
+                return TableDelta(base_stamp, cur_stamp, layout=layout)
             recs = [
                 r for r in self._pub_records
                 if base_gen < r["gen"] <= self._generation
             ]
             if len(recs) != self._generation - base_gen:
                 return None  # record gap (reset or deque overflow)
-            delta = TableDelta(base_stamp, cur_stamp)
+            delta = TableDelta(base_stamp, cur_stamp, layout=layout)
             delta.replace["generation"] = np.uint64(cur_stamp)
 
             def scatter1(name, arr, idx_list):

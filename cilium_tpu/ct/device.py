@@ -126,7 +126,10 @@ class CTSnapshot:
     static aux so churn rebuilds share one jit cache entry)."""
 
     buckets: "np.ndarray"  # u32 [Cb, 128]
-    stash: "np.ndarray"  # u32 [STASH_ENTRIES, ENTRY_WORDS]
+    # u32 [S, ENTRY_WORDS]: the occupied pow2 prefix of the
+    # STASH_ENTRIES-capacity overflow stash (trim_ct_stash) — empty
+    # at the default envelope, so S is 1 in the steady state
+    stash: "np.ndarray"
     n_buckets: int
 
     def tree_flatten(self):
@@ -253,7 +256,7 @@ class CTBucketIndex:
         stash[:, 3] = _EMPTY_W3
         for i, key in enumerate(self.stash_keys):
             stash[i] = _pack_entry(key, self.ct.entries[key])
-        return stash
+        return trim_ct_stash(stash)
 
     def full_snapshot(self) -> CTSnapshot:
         buckets = np.zeros((self.n_buckets, BUCKET_LANES), dtype=np.uint32)
@@ -321,10 +324,26 @@ class CTBucketIndex:
         return idx, rows, self._stash_rows() if stash_dirty else None
 
 
+def trim_ct_stash(stash: np.ndarray) -> np.ndarray:
+    """Trim the overflow stash to the pow2 prefix holding its
+    occupied rows (front-filled; empty rows carry w3 = _EMPTY_W3).
+    Every probe broadcast-compares EVERY stash lane against every
+    tuple — at the default envelope the stash is empty, so shipping
+    it at the 128-row capacity charges the fused pipeline ~10 wasted
+    [B, 128] compares per probe.  Trimmed lanes can never match, so
+    results are bit-identical; the stash shape only crosses a pow2
+    class (one bounded recompile) when overflow actually grows."""
+    from cilium_tpu.engine.hashtable import trim_pow2_prefix
+
+    used = int((stash[:, 3] != _EMPTY_W3).sum())
+    return trim_pow2_prefix(stash, used)
+
+
 def compile_ct(ct: CTMap) -> CTSnapshot:
-    """Snapshot the host CT into device bucket tables.  Shapes are
-    pinned by ct.max_entries (pkg/maps/ctmap/ctmap.go:71's envelope),
-    identical across churn rebuilds."""
+    """Snapshot the host CT into device bucket tables.  Bucket shapes
+    are pinned by ct.max_entries (pkg/maps/ctmap/ctmap.go:71's
+    envelope), identical across churn rebuilds; the stash ships at
+    its occupied pow2 prefix (trim_ct_stash)."""
     return CTBucketIndex(ct).full_snapshot()
 
 

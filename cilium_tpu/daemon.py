@@ -218,6 +218,11 @@ class Daemon:
         # per-batch dispatch deadline (a wedged XLA launch must fail
         # the batch, not hang the stream); <=0 disables
         self.dispatch_watchdog = DispatchWatchdog(timeout=30.0)
+        # double-buffered async dispatch depth: batches in flight
+        # beyond the one being drained (process_flows overlaps the
+        # host pack of batch N+1 with device compute of batch N);
+        # 0 = fully synchronous per-batch serving
+        self.dispatch_async_depth = 1
         # device table-publication backoff (monotonic deadline): a
         # failed epoch publish must not be retried per batch
         self._device_publish_retry_at = 0.0
@@ -1225,6 +1230,7 @@ class Daemon:
         buf: bytes,
         batch_size: int = 1 << 20,
         collect_verdicts: bool = False,
+        async_depth: "Optional[int]" = None,
     ) -> "object":
         """Datapath execution under the agent with monitor folding —
         the production path behind `cilium monitor`: replay the
@@ -1253,6 +1259,15 @@ class Daemon:
         match_kind / proxy_port, stream order) — the chaos harness's
         bit-identity probe.
 
+        Dispatch is double-buffered (`async_depth`, default
+        self.dispatch_async_depth = 1): the host packs batch N+1
+        while the device computes batch N, and results drain one
+        batch behind in submission order — event/flow/telemetry
+        folds see identical ordering and counts to synchronous
+        serving (async_depth=0).  A device failure surfacing at
+        drain time fails over that in-flight batch to the host fold
+        under the breaker, same as a submit-time failure.
+
         Flow observability: every batch additionally folds into
         self.flow_store (cilium_tpu.flow) — ALL drops plus allows
         head-sampled per the MonitorAggregationLevel knob, classified
@@ -1273,11 +1288,13 @@ class Daemon:
             attrs={"bytes": len(buf)},
         ) as proc_span:
             return self._process_flows_traced(
-                buf, batch_size, collect_verdicts, proc_span
+                buf, batch_size, collect_verdicts, proc_span,
+                async_depth,
             )
 
     def _process_flows_traced(
-        self, buf, batch_size, collect_verdicts, proc_span
+        self, buf, batch_size, collect_verdicts, proc_span,
+        async_depth=None,
     ):
         import time as _time
         from types import SimpleNamespace
@@ -1290,7 +1307,6 @@ class Daemon:
         from cilium_tpu.replay import (
             ReplayStats,
             _ep_index_of,
-            _tally,
             read_batches_from_rec,
         )
 
@@ -1457,72 +1473,115 @@ class Daemon:
         collected = [] if collect_verdicts else None
         t0 = _time.perf_counter()
         offset = 0
-        for batch, valid in read_batches_from_rec(
-            rec, batch_size, ep_index=ep_idx_host
-        ):
-            start, end = offset, offset + valid
-            offset = end
-            batch_t0 = _time.perf_counter()
-            # bounded admission: a batch the gate refuses is SHED —
-            # counted under the canonical Overload drop reason, never
-            # queued (backpressure on the datapath is attribution,
-            # not buffering)
-            if not self.admission.reserve(valid):
-                stats.shed += valid
-                metrics.shed_flows_total.inc(value=valid)
-                from cilium_tpu.monitor.events import (
-                    DROP_OVERLOAD,
-                    drop_reason_name,
-                )
+        # Double-buffered async dispatch (engine/publish's epoch
+        # ping-pong applied to BATCHES): the device computes batch N
+        # while the host packs batch N+1 — read_batches_from_rec's
+        # next() does the decode-slice + single-transfer upload after
+        # _dispatch_or_degrade has merely ENQUEUED the previous
+        # batch.  Results drain one batch behind, in submission
+        # order, so the event fold / flow capture / tracing planes
+        # keep their exact per-batch ordering and counts; admission
+        # units stay reserved until their batch drains (the in-flight
+        # accounting covers the whole pipeline, not just the
+        # enqueue).  depth 0 restores fully synchronous serving.
+        #
+        # Kept inline rather than on AsyncBatchDispatcher: the
+        # per-batch failover/span/admission interleaving (dispatch
+        # span at submit, breaker + host-fold at drain, release in
+        # the drain's finally) is daemon policy the generic pipeline
+        # deliberately doesn't know about; the ordering semantics are
+        # the same and pinned by tests/test_async_dispatch.py.
+        #
+        # batch_duration semantics under overlap: observed from
+        # submit to drain-complete — the PIPELINE latency of the
+        # batch, which at depth N includes up to N later batches'
+        # pack+enqueue time.  depth 0 restores the historical
+        # synchronous reading exactly.
+        from collections import deque as _dq
 
-                for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
-                    count = int(
-                        (rec["direction"][start:end] == dirv).sum()
-                    )
-                    if count:
-                        metrics.drop_count.inc(
-                            drop_reason_name(DROP_OVERLOAD), dname,
-                            value=count,
-                        )
-                continue
+        depth = (
+            self.dispatch_async_depth
+            if async_depth is None
+            else async_depth
+        )
+        pending = _dq()
+        trace_ctx = tracing.current_trace_id()
+
+        def _host_args_for(s, e):
+            return (
+                host_states,
+                ep_idx_host[s:e],
+                rec["identity"][s:e],
+                rec["dport"][s:e],
+                rec["proto"][s:e],
+                rec["direction"][s:e],
+                rec["is_fragment"][s:e].astype(bool),
+            )
+
+        def _drain_oldest():
+            from cilium_tpu.engine.hostpath import lattice_fold_host
+
+            out, degraded, start, end, valid, batch_t0 = (
+                pending.popleft()
+            )
             try:
-                dispatch_span = tracing.stat_span(
-                    spans, "dispatch", site="daemon",
-                    attrs={"batch": stats.batches, "rows": valid},
-                    trc=self.tracer,
+                drain_span = tracing.stat_span(
+                    spans, "drain", site="daemon", trc=self.tracer,
                 ).start()
-
-                def _host_args(s=start, e=end):
-                    return (
-                        host_states,
-                        ep_idx_host[s:e],
-                        rec["identity"][s:e],
-                        rec["dport"][s:e],
-                        rec["proto"][s:e],
-                        rec["direction"][s:e],
-                        rec["is_fragment"][s:e].astype(bool),
+                try:
+                    v = SimpleNamespace(
+                        allowed=np.asarray(out.allowed)[:valid],
+                        match_kind=np.asarray(out.match_kind)[:valid],
+                        proxy_port=np.asarray(out.proxy_port)[:valid],
                     )
-
-                out, degraded = self._dispatch_or_degrade(
-                    tables, batch, _host_args, batch_size
-                )
-                _tally(out, valid, stats)
-                dispatch_span.end(success=not degraded)
+                except Exception as exc:
+                    # the overlapped batch died ON DEVICE after a
+                    # successful enqueue: the breaker learns the
+                    # failure and the in-flight batch drains through
+                    # the bit-identical host fold instead of
+                    # vanishing mid-pipeline
+                    self.dispatch_breaker.record_failure(str(exc))
+                    log.warning(
+                        "async drain failed; serving in-flight "
+                        "batch from host path",
+                        extra={"fields": {"error": str(exc)}},
+                    )
+                    with self.tracer.span(
+                        "engine.hostpath", site="engine.hostpath",
+                        attrs={"failover": True, "drain": True},
+                    ):
+                        host_out = lattice_fold_host(
+                            *_host_args_for(start, end),
+                            pad_to=batch_size,
+                        )
+                    degraded = True
+                    self.degraded_batches += 1
+                    metrics.degraded_batches_total.inc()
+                    v = SimpleNamespace(
+                        allowed=np.asarray(host_out.allowed)[:valid],
+                        match_kind=np.asarray(
+                            host_out.match_kind
+                        )[:valid],
+                        proxy_port=np.asarray(
+                            host_out.proxy_port
+                        )[:valid],
+                    )
+                drain_span.end()
+                n_allowed = int(v.allowed.sum())
+                stats.total += int(valid)
+                stats.allowed += n_allowed
+                stats.denied += int(valid) - n_allowed
+                stats.redirected += int((v.proxy_port > 0).sum())
                 stats.batches += 1
                 if degraded:
                     stats.degraded_batches += 1
+                if collected is not None:
+                    collected.append(v)
                 event_fold = tracing.stat_span(
                     spans, "event_fold", site="daemon",
                     trc=self.tracer,
                 ).start()
                 ep_idx = ep_idx_host[start:end]
-                v = SimpleNamespace(
-                    allowed=np.asarray(out.allowed)[:valid],
-                    match_kind=np.asarray(out.match_kind)[:valid],
-                    proxy_port=np.asarray(out.proxy_port)[:valid],
-                )
-                if collected is not None:
-                    collected.append(v)
                 opts = option.Config.opts
                 verdicts_to_events(
                     self.monitor,
@@ -1566,7 +1625,7 @@ class Daemon:
                     proxy_port=v.proxy_port,
                     allow_sample=flow_allow_sample,
                     metrics_registry=metrics,
-                    trace_id=tracing.current_trace_id(),
+                    trace_id=trace_ctx,
                 )
                 flow_capture.end()
             finally:
@@ -1574,6 +1633,71 @@ class Daemon:
             metrics.batch_duration.observe(
                 _time.perf_counter() - batch_t0
             )
+
+        try:
+            for batch, valid in read_batches_from_rec(
+                rec, batch_size, ep_index=ep_idx_host
+            ):
+                start, end = offset, offset + valid
+                offset = end
+                batch_t0 = _time.perf_counter()
+                # bounded admission: a batch the gate refuses is
+                # SHED — counted under the canonical Overload drop
+                # reason, never queued (backpressure on the datapath
+                # is attribution, not buffering)
+                if not self.admission.reserve(valid):
+                    stats.shed += valid
+                    metrics.shed_flows_total.inc(value=valid)
+                    from cilium_tpu.monitor.events import (
+                        DROP_OVERLOAD,
+                        drop_reason_name,
+                    )
+
+                    for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
+                        count = int(
+                            (rec["direction"][start:end] == dirv).sum()
+                        )
+                        if count:
+                            metrics.drop_count.inc(
+                                drop_reason_name(DROP_OVERLOAD),
+                                dname, value=count,
+                            )
+                    continue
+                try:
+                    dispatch_span = tracing.stat_span(
+                        spans, "dispatch", site="daemon",
+                        attrs={
+                            "batch": stats.batches + len(pending),
+                            "rows": valid,
+                        },
+                        trc=self.tracer,
+                    ).start()
+
+                    def _host_args(s=start, e=end):
+                        return _host_args_for(s, e)
+
+                    out, degraded = self._dispatch_or_degrade(
+                        tables, batch, _host_args, batch_size
+                    )
+                    dispatch_span.end(success=not degraded)
+                except Exception:
+                    self.admission.release(valid)
+                    raise
+                pending.append(
+                    (out, degraded, start, end, valid, batch_t0)
+                )
+                while len(pending) > depth:
+                    _drain_oldest()
+            while pending:
+                _drain_oldest()
+        finally:
+            # an exception escaping mid-stream (decode, drain-side
+            # fold, host-fold failure) must not leak the reserved
+            # admission units of batches still in flight — the gate's
+            # outstanding count would stay inflated forever and later
+            # calls would spuriously shed
+            while pending:
+                self.admission.release(pending.popleft()[4])
         stats.seconds = _time.perf_counter() - t0
         stats.spans = spans
         proc_span.attrs.update(

@@ -1,0 +1,255 @@
+"""Batch-size / jit-class autotuning + gather-byte accounting.
+
+The fused dispatch has two knobs that trade dispatch overhead against
+latency and bytes-moved-per-tuple:
+
+  * the BATCH SIZE — bigger batches amortize the per-dispatch floor
+    but push p99 batch latency up linearly;
+  * the HOT-PLANE PACK WIDTH — the hashed L4 entry tables' row lane
+    count (compiler.tables.L4H_LANES): narrower rows halve the
+    dominant per-tuple gather and lane-compare work, wider rows halve
+    the bucket count (compiler.tables.repack_hash_lanes re-places the
+    entries at any width without recompiling policy).
+
+`autotune` runs a caller-supplied measurement over a small candidate
+grid and picks the highest verdicts/s whose p99 batch latency stays
+under the bound.  The choice is cached per TABLE SHAPE CLASS (the jit
+cache key the dispatch programs compile against), so a long-running
+server tunes once per layout instead of per publish — recompile
+storms would otherwise show up in the existing
+`cilium_jit_cache_*{site}` metrics this module deliberately rides.
+
+`hot_gather_profile` is the bytes-moved model behind the tuner and
+the bench's `hot_bytes_per_tuple` line: per-leaf bytes GATHERED per
+tuple by the fused per-direction pipeline, split into the hot plane
+(leaves the hashed-probe kernels actually gather) and the cold plane
+(dense-fallback leaves a hot-only publication never ships).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class Trial:
+    params: dict
+    verdicts_per_sec: float
+    p99_batch_ms: float
+    admitted: bool
+
+
+@dataclass
+class TuneChoice:
+    params: dict
+    verdicts_per_sec: float
+    p99_batch_ms: float
+    trials: List[Trial] = field(default_factory=list)
+    cached: bool = False
+
+
+# shape-class key → TuneChoice (process-lifetime: the jit caches the
+# tuned programs live exactly as long)
+_CHOICES: Dict[tuple, TuneChoice] = {}
+
+
+def shape_class_key(policy_tables) -> tuple:
+    """The table shape class a tuned choice is valid for — the same
+    axes that key the dispatch programs' jit cache entries."""
+    rows = getattr(policy_tables, "l4_hash_rows", None)
+    wrows = getattr(policy_tables, "l4_wild_rows", None)
+    return (
+        tuple(policy_tables.l4_meta.shape),
+        int(policy_tables.id_table.shape[0]),
+        None if rows is None else tuple(rows.shape),
+        None if wrows is None else tuple(wrows.shape),
+    )
+
+
+def cached_choice(key: tuple) -> Optional[TuneChoice]:
+    return _CHOICES.get(key)
+
+
+def autotune(
+    candidates: Sequence[dict],
+    run_candidate: Callable[[dict], Tuple[float, float]],
+    p99_bound_ms: float = float("inf"),
+    cache_key: Optional[tuple] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> TuneChoice:
+    """Measure every candidate (`run_candidate(params)` →
+    (verdicts_per_sec, p99_batch_ms)) and pick the fastest admitted
+    one; candidates over the p99 bound are rejected unless nothing
+    fits (then the lowest-latency candidate wins — a serving plane
+    must pick SOMETHING).  With `cache_key` the choice is memoized
+    per table shape class."""
+    if cache_key is not None:
+        hit = _CHOICES.get(cache_key)
+        if hit is not None:
+            return hit
+    trials: List[Trial] = []
+    for params in candidates:
+        vps, p99 = run_candidate(dict(params))
+        admitted = p99 <= p99_bound_ms
+        trials.append(Trial(dict(params), vps, p99, admitted))
+        if log is not None:
+            log(
+                f"autotune candidate {params}: "
+                f"{vps / 1e6:.1f}M verdicts/s, p99 {p99:.0f} ms"
+                f"{'' if admitted else ' (over p99 bound)'}"
+            )
+    admitted = [t for t in trials if t.admitted]
+    if admitted:
+        best = max(admitted, key=lambda t: t.verdicts_per_sec)
+    else:
+        # bound unsatisfiable on this hardware: throughput wins
+        # (shrinking the batch further only lowers BOTH)
+        best = max(trials, key=lambda t: t.verdicts_per_sec)
+    choice = TuneChoice(
+        params=best.params,
+        verdicts_per_sec=best.verdicts_per_sec,
+        p99_batch_ms=best.p99_batch_ms,
+        trials=trials,
+    )
+    if cache_key is not None:
+        _CHOICES[cache_key] = choice
+        choice.cached = True
+    return choice
+
+
+def measure_dispatch(
+    step: Callable,
+    make_args: Callable[[], tuple],
+    n_tuples_per_call: int,
+    reps: int = 4,
+    outstanding: int = 2,
+    sync_reps: int = 3,
+) -> Tuple[float, float]:
+    """One candidate measurement: a short pipelined loop for
+    sustained verdicts/s (dispatch overlap, like the serving loop)
+    plus a few synchronous calls for the per-batch latency tail.
+    `make_args()` returns fresh call args per rep (carried/donated
+    buffers must be re-made by the caller's closure)."""
+    import jax
+
+    # warmup/compile
+    out = step(*make_args())
+    jax.block_until_ready(out)
+    outs = []
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        outs.append(step(*make_args()))
+        if len(outs) > outstanding:
+            jax.block_until_ready(outs.pop(0))
+    jax.block_until_ready(outs)
+    vps = reps * n_tuples_per_call / (time.perf_counter() - t0)
+    lat = []
+    for _ in range(sync_reps):
+        t1 = time.perf_counter()
+        jax.block_until_ready(step(*make_args()))
+        lat.append(time.perf_counter() - t1)
+    # p99 over a handful of sync reps is the max — the honest tail
+    # estimate at this sample count
+    return vps, max(lat) * 1000.0
+
+
+# ---------------------------------------------------------------------------
+# Gather-byte accounting (the bytes-moved model)
+# ---------------------------------------------------------------------------
+
+
+def hot_gather_profile(tables, packed_io: bool = True) -> List[dict]:
+    """Per-leaf bytes gathered per tuple by the fused per-direction
+    pipeline, with the plane ('hot'/'cold') and pipeline stage of
+    each.  `tables` is an engine.datapath.DatapathTables; cold rows
+    are reported at ZERO bytes when the policy tables carry the
+    hashed entry pair (the kernel never gathers them) and at their
+    dense-probe cost otherwise.
+
+    Broadcast compares (stashes, prefilter ranges) move no
+    gather bytes — they are compute, priced separately — so they do
+    not appear here."""
+    rows: List[dict] = []
+    pol = tables.policy
+
+    def add(stage, leaf, plane, nbytes, note=""):
+        rows.append(
+            {
+                "stage": stage,
+                "leaf": leaf,
+                "plane": plane,
+                "bytes_per_tuple": float(nbytes),
+                "note": note,
+            }
+        )
+
+    # CT: one bucket-row gather serves the service + flow probes
+    ct_lanes = int(np.asarray(tables.ct.buckets).shape[1])
+    add("ct", "ct.buckets", "hot", ct_lanes * 4, "1 row gather")
+    # LB: service bucket row gather (egress only — averaged at 1/2);
+    # the inline layout keys+backends in one row, the classic layout
+    # pays a second backend-row gather on service hits (rare, priced
+    # at the key row only)
+    lb_rows = getattr(tables.lb, "rows", None)
+    if lb_rows is None:
+        lb_rows = getattr(tables.lb, "buckets", None)
+    if lb_rows is not None:
+        lb_lanes = int(np.asarray(lb_rows).shape[1])
+        add(
+            "lb", "lb.rows", "hot", lb_lanes * 4 / 2,
+            "egress half-batches only",
+        )
+    # ipcache: DIR-24-8 two-level lookup + optional l3 plane word
+    add("ipcache", "ipcache.dir24_8", "hot", 8, "2 element gathers")
+    hash_rows = getattr(pol, "l4_hash_rows", None)
+    if hash_rows is not None:
+        lanes = int(np.asarray(hash_rows).shape[1])
+        wlanes = int(np.asarray(pol.l4_wild_rows).shape[1])
+        add(
+            "lattice", "l4_hash_rows", "hot", lanes * 4,
+            f"pack width {lanes}",
+        )
+        add(
+            "lattice", "l4_wild_rows", "hot", wlanes * 4,
+            f"pack width {wlanes}",
+        )
+        # identity index rides the idx-form ipcache when present;
+        # otherwise one id_direct element gather
+        add("lattice", "id_direct", "hot", 4, "skipped w/ idx ipcache")
+        for leaf in ("port_slot", "l4_allow_bits"):
+            add("lattice", leaf, "cold", 0, "hashed probe active")
+        add("lattice", "l3_allow_bits", "hot", 0, "l3-plane ipcache")
+    else:
+        add("lattice", "port_slot", "cold", 2, "dense slot probe")
+        add("lattice", "l4_allow_bits", "cold", 4, "dense bit probe")
+        add("lattice", "l4_meta", "cold", 4, "dense meta probe")
+        add("lattice", "l3_allow_bits", "hot", 4, "l3 word gather")
+        add("lattice", "id_direct", "hot", 4, "identity index")
+    # batch IO: packed flow columns in, packed verdict words out
+    add(
+        "io", "flow_batch", "hot", 16 if packed_io else 32,
+        "H2D packed columns" if packed_io else "H2D u32 columns",
+    )
+    return rows
+
+
+def hot_bytes_per_tuple(tables, packed_io: bool = True) -> float:
+    """Total HOT-plane bytes gathered per tuple (the headline
+    `hot_bytes_per_tuple` bench metric)."""
+    return sum(
+        r["bytes_per_tuple"]
+        for r in hot_gather_profile(tables, packed_io=packed_io)
+        if r["plane"] == "hot"
+    )
+
+
+def cold_bytes_per_tuple(tables) -> float:
+    return sum(
+        r["bytes_per_tuple"]
+        for r in hot_gather_profile(tables)
+        if r["plane"] == "cold"
+    )

@@ -249,7 +249,17 @@ def compile_ct6(ct: CTMap) -> CT6Snapshot:
             sfill += 1
         else:
             raise ValueError("CT6 bucket and stash overflow")
-    return CT6Snapshot(buckets=buckets, stash=stash, n_buckets=nb)
+    # ship the stash at its occupied pow2 prefix: every probe
+    # broadcast-compares every stash lane with ELEVEN word compares
+    # here, so an empty stash at the 128-row capacity is pure wasted
+    # hot-path compute; trimmed lanes can never match
+    from cilium_tpu.engine.hashtable import trim_pow2_prefix
+
+    return CT6Snapshot(
+        buckets=buckets,
+        stash=trim_pow2_prefix(stash, sfill),
+        n_buckets=nb,
+    )
 
 
 def ct6_lookup_batch(

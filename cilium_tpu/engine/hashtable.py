@@ -42,6 +42,22 @@ FNV_OFFSET = np.uint32(2166136261)
 FNV_PRIME = np.uint32(16777619)
 
 
+def trim_pow2_prefix(arr: np.ndarray, used: int) -> np.ndarray:
+    """THE stash-trim helper: slice a front-filled overflow stash (or
+    any capacity-allocated table) to the smallest pow2 prefix holding
+    its `used` occupied rows (never below 1 row — probes expect a
+    non-empty axis).  Probes broadcast-compare EVERY stash row
+    against every tuple, so capacity rows with never-matching
+    sentinels are pure hot-path waste; trimming is bit-identity-safe
+    by construction.  One implementation serves the policy hash
+    stashes, CT v4/v6, LB inline v4/v6 and the ipcache — callers
+    count their own emptiness sentinel and pass `used`."""
+    size = 1
+    while size < max(used, 1):
+        size <<= 1
+    return arr[:size]
+
+
 def _fnv1a_host(words: np.ndarray) -> np.ndarray:
     """FNV-1a over u32 words, vectorized: words [N, KW] → u32 [N]."""
     h = np.full(words.shape[0], FNV_OFFSET, dtype=np.uint64)

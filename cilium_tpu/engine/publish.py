@@ -37,8 +37,19 @@ import numpy as np
 
 from cilium_tpu import tracing
 from cilium_tpu.compiler.delta import TableDelta, tables_nbytes
-from cilium_tpu.compiler.tables import PolicyTables
+from cilium_tpu.compiler.tables import (
+    COLD_LEAVES,
+    PolicyTables,
+    split_hot,
+    tables_layout_version,
+)
 from cilium_tpu.metrics import registry as metrics
+
+# low bits of a layout stamp carrying the hashed-table pack widths;
+# the high bits are the hot/cold coldness mask (see
+# tables_layout_version) — the store compares pack widths across the
+# delta/epoch seam and owns the coldness decision itself
+_LAYOUT_LANES_MASK = (1 << 22) - 1
 
 
 def _pad_pow2(update):
@@ -76,15 +87,31 @@ class StaleEpochError(ValueError):
 
 
 class DeviceTableStore:
-    """Two device table epochs with scatter-delta publication."""
+    """Two device table epochs with scatter-delta publication.
 
-    def __init__(self, shardings: Optional[PolicyTables] = None) -> None:
+    With `hot_only=True` every published epoch carries only the HOT
+    leaf plane (compiler.tables.HOT_LEAVES — the words the fused
+    hashed-probe kernels can ever gather); the COLD leaves (the 32 MB
+    port_slot and the dense allow bitmap, the two largest tables by
+    an order of magnitude) never reach the device, and deltas
+    touching them are filtered before the scatter.  Epochs carry a
+    layout stamp (tables_layout_version): a delta recorded against a
+    different pack width or leaf split than the resident spare is
+    refused and the publish falls back to a full upload."""
+
+    def __init__(
+        self,
+        shardings: Optional[PolicyTables] = None,
+        hot_only: bool = False,
+    ) -> None:
         self._lock = threading.Lock()
-        # each slot: dict(tables=<device pytree>, stamp=int, epoch=int)
+        # each slot: dict(tables=<device pytree>, stamp=int,
+        # epoch=int, layout=int)
         self._slots = [None, None]
         self._cur = 0
         self._epoch = 0
         self._shardings = shardings
+        self._hot_only = hot_only
         self._apply_cache: Dict[tuple, object] = {}
 
     # -- device placement ----------------------------------------------------
@@ -163,6 +190,9 @@ class DeviceTableStore:
             "publish.epoch", site="engine.publish"
         ) as sp:
             t0 = time.perf_counter()
+            if self._hot_only:
+                tables = split_hot(tables)
+            layout = tables_layout_version(tables)
             spare_i = self._cur ^ 1
             spare = self._slots[spare_i]
             stamp = int(np.asarray(tables.generation))
@@ -171,6 +201,14 @@ class DeviceTableStore:
                 and spare is not None
                 and spare["stamp"] == delta.base_stamp
                 and stamp == delta.new_stamp
+                # layout guard: a delta's scatter indices are only
+                # meaningful against the exact hot/cold + pack-width
+                # layout the spare epoch holds (pack widths must
+                # match end to end; coldness is the store's own
+                # setting, already applied to both sides)
+                and spare["layout"] == layout
+                and (delta.layout & _LAYOUT_LANES_MASK)
+                == (layout & _LAYOUT_LANES_MASK)
             )
             if use_delta:
                 try:
@@ -200,7 +238,7 @@ class DeviceTableStore:
             self._epoch += 1
             self._slots[spare_i] = {
                 "tables": dev, "stamp": stamp, "epoch": self._epoch,
-                "nbytes": tables_nbytes(tables),
+                "nbytes": tables_nbytes(tables), "layout": layout,
             }
             self._cur = spare_i
             stats.epoch = self._epoch
@@ -237,16 +275,26 @@ class DeviceTableStore:
 
         n_scatter = 0
         n_replace = 0
+        bytes_h2d = 0
+        # hot-only epochs never receive cold-plane payloads — their
+        # leaves are None on device and the host arrays are the
+        # authority for the cold plane anyway
+        skip = set(COLD_LEAVES) if self._hot_only else ()
         # whole-leaf replacements land outside the jit: fresh uploads
         # swapped into the donated pytree (the old leaf is dropped)
         replaced = {}
         for name, arr in delta.replace.items():
+            if name in skip:
+                continue
             replaced[name] = self._put(arr, name)
+            bytes_h2d += np.asarray(arr).nbytes
             n_replace += 1
         base = spare_dev
         if replaced:
             base = dataclasses.replace(base, **replaced)
-        fields = tuple(sorted(delta.updates))
+        fields = tuple(
+            sorted(n for n in delta.updates if n not in skip)
+        )
         gen_dev = self._put(np.uint64(np.asarray(tables.generation)))
         if fields:
             payloads = []
@@ -258,13 +306,14 @@ class DeviceTableStore:
                         self._put(values),
                     )
                 )
+                bytes_h2d += delta.updates[name].nbytes
                 n_scatter += 1
             dev = self._apply_fn(fields)(base, tuple(payloads), gen_dev)
         else:
             dev = dataclasses.replace(base, generation=gen_dev)
         jax.block_until_ready(dev)
         return dev, PublishStats(
-            epoch=0, mode="delta", bytes_h2d=delta.bytes_h2d,
+            epoch=0, mode="delta", bytes_h2d=bytes_h2d,
             seconds=0.0, scatter_leaves=n_scatter,
             replaced_leaves=n_replace,
         )
@@ -341,3 +390,113 @@ class DeviceTableStore:
             f"resident (live epochs: {live}) — its buffers were "
             f"donated to a newer publish"
         )
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered async batch dispatch
+# ---------------------------------------------------------------------------
+
+
+class AsyncBatchDispatcher:
+    """The epoch ping-pong machinery applied to BATCHES instead of
+    tables: a bounded staging pipeline that overlaps the host pack of
+    batch N+1 with the device compute of batch N.
+
+      * `submit(host_args, meta)` runs `pack_fn` (encode + H2D
+        staging — the host half) and `dispatch_fn` (a non-blocking
+        jit enqueue — the device half), then drains AT MOST the
+        batches beyond `depth` in FIFO order, so at any time up to
+        `depth + 1` batches are in flight: one computing, one being
+        packed.
+      * results come back ONE BATCH BEHIND through the values
+        returned from submit()/flush(): `(meta, result, exc)` tuples
+        in exact submission order — consumers that fold events /
+        flow records / telemetry per batch keep their ordering and
+        per-batch counts unchanged relative to synchronous dispatch.
+      * a failure at pack/enqueue time OR at drain (readback) time is
+        captured as `exc` on that batch's tuple instead of poisoning
+        the pipeline — the caller decides failover (the daemon serves
+        the batch from the bit-identical host path).
+
+    Overlap accounting: `pack_s` (host-side staging time), `block_s`
+    (time spent blocked waiting on device results) and `wall_s`
+    (first submit → flush) let callers derive the device-busy
+    fraction during sustained dispatch (bench's
+    overlap_efficiency_pct)."""
+
+    def __init__(self, pack_fn, dispatch_fn, depth: int = 1) -> None:
+        from collections import deque
+
+        self.pack_fn = pack_fn
+        self.dispatch_fn = dispatch_fn
+        self.depth = max(int(depth), 0)
+        self._pending = deque()
+        self.pack_s = 0.0
+        self.block_s = 0.0
+        self._t_first = None
+        self._t_last = None
+        self.submitted = 0
+        self.failed = 0
+
+    def _drain_one(self):
+        import jax
+
+        meta, out, exc = self._pending.popleft()
+        if exc is None:
+            t0 = time.perf_counter()
+            try:
+                jax.block_until_ready(out)
+            except Exception as drain_exc:  # device died mid-compute
+                out, exc = None, drain_exc
+                self.failed += 1
+            self.block_s += time.perf_counter() - t0
+        self._t_last = time.perf_counter()
+        return meta, out, exc
+
+    def submit(self, host_args: tuple, meta=None) -> list:
+        """Stage + enqueue one batch; returns the drained (meta,
+        result, exc) tuples that completed (possibly empty)."""
+        if self._t_first is None:
+            self._t_first = time.perf_counter()
+        self.submitted += 1
+        out, exc = None, None
+        t0 = time.perf_counter()
+        try:
+            dev_args = self.pack_fn(*host_args)
+        except Exception as pack_exc:
+            exc = pack_exc
+            self.failed += 1
+        self.pack_s += time.perf_counter() - t0
+        if exc is None:
+            try:
+                out = self.dispatch_fn(*dev_args)
+            except Exception as disp_exc:
+                out, exc = None, disp_exc
+                self.failed += 1
+        self._pending.append((meta, out, exc))
+        done = []
+        while len(self._pending) > self.depth:
+            done.append(self._drain_one())
+        return done
+
+    def flush(self) -> list:
+        """Drain every in-flight batch, in order."""
+        done = []
+        while self._pending:
+            done.append(self._drain_one())
+        return done
+
+    @property
+    def wall_s(self) -> float:
+        if self._t_first is None or self._t_last is None:
+            return 0.0
+        return self._t_last - self._t_first
+
+    def overlap_efficiency_pct(self, device_seconds: float) -> float:
+        """Device-busy fraction during sustained dispatch, given an
+        independently measured estimate of pure device seconds for
+        the submitted batches (e.g. sync per-batch latency × count).
+        100% = the host pack was fully hidden behind device compute."""
+        if self.wall_s <= 0:
+            return 0.0
+        return min(100.0, 100.0 * device_seconds / self.wall_s)

@@ -239,6 +239,53 @@ def make_mesh_evaluator(
     return jax.jit(step, in_shardings=in_shardings)
 
 
+def make_async_mesh_dispatcher(
+    step, mesh, batch_axis: str = "batch", depth: int = 1
+):
+    """Double-buffered dispatch over a mesh evaluator
+    (engine.publish.AsyncBatchDispatcher applied to SPMD batches):
+    the host packs + shards batch N+1 across the mesh while the
+    chips compute batch N.  `step` is a one-argument closure
+    batch → result with the tables already bound (e.g.
+    `partial(make_sharded_evaluator(mesh), dev_tables)`);
+    `submit((ep_index, identity, dport, proto, direction[,
+    is_fragment]), meta)` stages a TupleBatch with the batch axis
+    sharded; results drain one batch behind in submission order.
+
+    This is the mesh serving loop's missing overlap: the sharded
+    device_put (scatter of the batch across chips) is exactly the
+    host-side work the single-chip path hides behind compute."""
+    import numpy as np
+
+    from cilium_tpu.engine.publish import AsyncBatchDispatcher
+
+    sharded = NamedSharding(mesh, P(batch_axis))
+
+    def pack(ep_index, identity, dport, proto, direction,
+             is_fragment=None):
+        b = len(ep_index)
+        if is_fragment is None:
+            is_fragment = np.zeros(b, dtype=bool)
+        put = lambda a, dt: jax.device_put(
+            np.asarray(a).astype(dt, copy=False), sharded
+        )
+        return (
+            TupleBatch(
+                ep_index=put(ep_index, np.int32),
+                identity=put(identity, np.uint32),
+                dport=put(dport, np.int32),
+                proto=put(proto, np.int32),
+                direction=put(direction, np.int32),
+                is_fragment=put(is_fragment, bool),
+            ),
+        )
+
+    def dispatch(batch):
+        return step(batch)
+
+    return AsyncBatchDispatcher(pack, dispatch, depth=depth)
+
+
 def traced_dispatch(step, mesh, site: str = "engine.sharded"):
     """Wrap a mesh evaluator with span-plane dispatch attribution:
     each call opens a `mesh.dispatch` span (blocking on the result so

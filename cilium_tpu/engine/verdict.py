@@ -187,17 +187,16 @@ def _index(tables: PolicyTables, batch: TupleBatch):
 
 
 def _l4hash_probe(hash_rows, hash_stash, ep, dirn, idx, dport, proto):
-    """One probe of a hashed L4 entry table: a single 128-lane row
-    gather + lane compares (+ a small stash broadcast).  Returns
-    (hit bool [B], value u32 [B] = j << 16 | proxy_port)."""
-    from cilium_tpu.compiler.tables import (
-        L4H_ENTRIES,
-        l4h_key0,
-        l4h_key1,
-    )
+    """One probe of a hashed L4 entry table: a single row gather +
+    lane compares (+ a small stash broadcast).  Returns (hit bool
+    [B], value u32 [B] = j << 16 | proxy_port).  The entry count per
+    bucket derives from the row width (the hot-plane pack width,
+    compiler.tables.L4H_LANES by default) — probe and build share the
+    layout through the array shape itself."""
+    from cilium_tpu.compiler.tables import l4h_key0, l4h_key1
     from cilium_tpu.engine.hashtable import fnv1a_device
 
-    e = L4H_ENTRIES
+    e = hash_rows.shape[1] // 3
     # the key packing helpers are dtype-generic — build side and
     # probe side MUST stay one implementation
     w0 = l4h_key0(idx, dirn, ep)
@@ -205,7 +204,7 @@ def _l4hash_probe(hash_rows, hash_stash, ep, dirn, idx, dport, proto):
     h = fnv1a_device(jnp.stack([w0, w1], axis=1))
     n_rows = hash_rows.shape[0]
     b = (h & jnp.uint32(n_rows - 1)).astype(jnp.int32)
-    rows = jnp.asarray(hash_rows)[b]  # [B, 128] — 1 gather
+    rows = jnp.asarray(hash_rows)[b]  # [B, lanes] — 1 gather
     hit = (rows[:, :e] == w0[:, None]) & (
         rows[:, e : 2 * e] == w1[:, None]
     )

@@ -207,6 +207,17 @@ class IPCacheDevice:
 IP_ENTRIES_PER_BUCKET = 64
 IP_STASH = 128
 MAX_RANGES = 512
+
+
+def _trim_ip_stash(stash: np.ndarray, fill: int) -> np.ndarray:
+    """Ship the overflow stash at its occupied pow2 prefix: the
+    lookup broadcast-compares every stash row against every tuple,
+    so the empty capacity rows are pure hot-path waste (the stash is
+    empty at the 16-of-64 bucket load).  Trimmed rows can never
+    match — results are bit-identical."""
+    from cilium_tpu.engine.hashtable import trim_pow2_prefix
+
+    return trim_pow2_prefix(stash, fill)
 _EMPTY_IP = np.uint32(0xFFFFFFFF)
 # idx-form sentinel: ipcache entry exists but its identity is not in
 # the policy universe — must NOT be treated as a miss (WORLD), the
@@ -302,7 +313,7 @@ def build_ipcache(prefix_to_id: Dict[str, int]):
         base[i], mask[i], plen[i], value[i] = b_, m_, l_ + 1, v_
     return IPCacheDevice(
         buckets=buckets,
-        stash=stash,
+        stash=_trim_ip_stash(stash, stash_fill),
         range_base=base,
         range_mask=mask,
         range_plen=plen,
@@ -403,9 +414,11 @@ def specialize_ipcache_to_idx(
 
     if not with_l3:
         # idx-form only, 64 entries × 2 planar words per bucket
+        # (stash allocated at CAPACITY — the input stash may arrive
+        # trimmed — and re-trimmed on return)
         buckets = np.zeros_like(dev.buckets)
         buckets[:, :e] = _EMPTY_IP
-        stash = np.zeros_like(dev.stash)
+        stash = np.zeros((IP_STASH, 2), dtype=np.uint32)
         stash[:, 0] = _EMPTY_IP
         nb = dev.n_buckets
         fill = [0] * nb
@@ -424,7 +437,7 @@ def specialize_ipcache_to_idx(
                 sfill += 1
         return IPCacheDevice(
             buckets=buckets,
-            stash=stash,
+            stash=_trim_ip_stash(stash, sfill),
             range_base=dev.range_base,
             range_mask=dev.range_mask,
             range_plen=dev.range_plen,
@@ -467,7 +480,7 @@ def specialize_ipcache_to_idx(
     w_l3i, w_l3o = l3_words(np.array([world], np.uint32))
     return IPCacheDevice(
         buckets=buckets,
-        stash=stash,
+        stash=_trim_ip_stash(stash, sfill),
         range_base=dev.range_base,
         range_mask=dev.range_mask,
         range_plen=dev.range_plen,

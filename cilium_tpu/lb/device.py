@@ -172,7 +172,17 @@ def compile_lb_inline(mgr: ServiceManager) -> "LBInline | None":
                 ok = False
                 break
         if ok:
-            return LBInline(rows=rows, stash=stash, n_buckets=nb)
+            # ship the stash at its occupied pow2 prefix (trimmed
+            # lanes can never match — the probe broadcast-compares
+            # every stash row per tuple, so capacity rows are wasted
+            # hot-path work; empty at realistic service counts)
+            from cilium_tpu.engine.hashtable import trim_pow2_prefix
+
+            return LBInline(
+                rows=rows,
+                stash=trim_pow2_prefix(stash, stash_fill),
+                n_buckets=nb,
+            )
         nb *= 2
     return None  # pathological hash collisions: caller uses classic
 

@@ -142,7 +142,14 @@ def compile_lb6(mgr: ServiceManager) -> LB6Inline:
                 ok = False
                 break
         if ok:
-            return LB6Inline(rows=rows, stash=stash, n_buckets=nb)
+            # occupied pow2 prefix only (see v4 compile_lb_inline)
+            from cilium_tpu.engine.hashtable import trim_pow2_prefix
+
+            return LB6Inline(
+                rows=rows,
+                stash=trim_pow2_prefix(stash, sfill),
+                n_buckets=nb,
+            )
         nb *= 2
     raise ValueError("LB6 bucket overflow (pathological collisions)")
 

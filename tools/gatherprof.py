@@ -1,0 +1,126 @@
+"""Per-leaf gather-byte profile of the fused datapath: hot vs cold.
+
+Builds the bench's config-5 world at reduced control-plane scale and
+dumps, per pipeline stage and table leaf, the bytes GATHERED per
+tuple by the fused per-direction programs — before (legacy 128-lane
+rows, no split) and after (packed hot-plane rows, hot/cold split) —
+then asserts the hot plane stays under a byte budget.
+
+The model is cilium_tpu.engine.autotune.hot_gather_profile: the same
+accounting bench.py emits as `hot_bytes_per_tuple`, so a regression
+here is a regression in the headline's roofline.
+
+Usage:
+    python tools/gatherprof.py [--budget-bytes 800] [--rules 500]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def profile_tables(tables, packed_io=True):
+    from cilium_tpu.engine.autotune import (
+        cold_bytes_per_tuple,
+        hot_bytes_per_tuple,
+        hot_gather_profile,
+    )
+
+    return (
+        hot_gather_profile(tables, packed_io=packed_io),
+        hot_bytes_per_tuple(tables, packed_io=packed_io),
+        cold_bytes_per_tuple(tables),
+    )
+
+
+def dump(title, rows, hot, cold):
+    print(f"--- {title} ---")
+    for r in rows:
+        print(
+            f"  {r['stage']:8s} {r['leaf']:18s} {r['plane']:4s} "
+            f"{r['bytes_per_tuple']:7.1f} B/tuple  {r['note']}"
+        )
+    print(f"  hot total  {hot:7.1f} B/tuple")
+    print(f"  cold total {cold:7.1f} B/tuple")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=500)
+    ap.add_argument("--endpoints", type=int, default=8)
+    ap.add_argument("--identities", type=int, default=4096)
+    ap.add_argument("--pool", type=int, default=5000)
+    ap.add_argument("--batch", type=int, default=1 << 16)
+    ap.add_argument(
+        "--budget-bytes", type=float, default=1400.0,
+        help="hot-plane bytes-gathered-per-tuple budget (assert): "
+        "the packed layout sits ~1.3 KB/tuple (CT row 512 + two "
+        "64-lane hash rows 512 + LB/ipcache/IO), the legacy "
+        "unsplit layout ~1.9 KB",
+    )
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    args.oracle_sample = 64
+
+    import dataclasses
+
+    import bench as B
+    from cilium_tpu.compiler.tables import (
+        repack_hash_lanes,
+        split_hot,
+    )
+
+    rng = np.random.default_rng(7)
+    d, tables, index, pool, oracle_ctx, timings, ct, mgr = (
+        B.build_config5(args, rng)
+    )
+
+    # BEFORE: legacy 128-lane rows, no hot/cold split
+    legacy = dataclasses.replace(
+        tables, policy=repack_hash_lanes(tables.policy, 128)
+    )
+    rows_b, hot_b, cold_b = profile_tables(legacy, packed_io=False)
+    # AFTER: compiled pack width + hot/cold split + packed4 staging
+    packed = dataclasses.replace(
+        tables, policy=split_hot(tables.policy)
+    )
+    rows_a, hot_a, cold_a = profile_tables(packed, packed_io=True)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "before": {"rows": rows_b, "hot": hot_b,
+                               "cold": cold_b},
+                    "after": {"rows": rows_a, "hot": hot_a,
+                              "cold": cold_a},
+                }
+            )
+        )
+    else:
+        dump("before: 128-lane rows, unsplit", rows_b, hot_b, cold_b)
+        dump("after: packed hot plane + split", rows_a, hot_a, cold_a)
+        print(
+            f"hot-plane reduction: {hot_b + cold_b:.0f} -> "
+            f"{hot_a:.0f} B/tuple "
+            f"({(hot_b + cold_b) / max(hot_a, 1e-9):.2f}x)"
+        )
+
+    assert hot_a <= args.budget_bytes, (
+        f"hot plane gathers {hot_a:.0f} B/tuple, over the "
+        f"{args.budget_bytes:.0f} B budget"
+    )
+    assert hot_a < hot_b + cold_b, (
+        "the split+pack must strictly reduce gathered bytes"
+    )
+    print("gatherprof OK")
+
+
+if __name__ == "__main__":
+    main()
