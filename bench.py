@@ -1396,6 +1396,61 @@ def run_config5(args) -> None:
             "never shipped by a hot-only publication)"
         ),
     )
+
+    # sharded-table scale headroom: the partition-rule model
+    # (compiler/partition.py) over the REAL config-5 tables — what
+    # partitioning the identity-major leaves across a mesh buys.
+    # tools/shardprof.py measures the same numbers on a live mesh;
+    # cilium_device_table_bytes_per_chip reports them at publish.
+    from cilium_tpu.compiler import partition as pt_rules
+
+    n_chips = max(len(jax.devices()), 1)
+    _, per_chip_b, repl_b = pt_rules.shard_bytes_model(
+        tables.policy, n_chips
+    )
+    emit(
+        "table_bytes_per_chip",
+        int(per_chip_b),
+        "bytes",
+        num_shards=n_chips,
+        replicated_bytes_per_chip=int(tables_nbytes(tables.policy)),
+        replicated_leaf_overhead=int(repl_b),
+        note=(
+            "per-chip HBM under the identity-sharded partition "
+            "rules; the replicated layout pays "
+            "replicated_bytes_per_chip on EVERY chip"
+        ),
+    )
+    emit(
+        "universe_max_identities",
+        int(
+            pt_rules.universe_max_identities(tables.policy, n_chips)
+        ),
+        "identities",
+        num_shards=n_chips,
+        curve={
+            str(ns): int(
+                pt_rules.universe_max_identities(tables.policy, ns)
+            )
+            for ns in (1, 8, 64)
+        },
+        note=(
+            "identity-universe cap at 16 GB HBM/chip under the "
+            "partition rules — the scale headroom table sharding "
+            "buys (num_shards=1 is the replicated cap)"
+        ),
+    )
+    emit(
+        "alltoall_bytes_per_tuple",
+        pt_rules.alltoall_bytes_per_tuple(n_chips),
+        "bytes",
+        num_shards=n_chips,
+        note=(
+            "collective bytes per tuple the routed-gather evaluator "
+            "moves along the identity axis (one psum pair: exact-"
+            "probe verdict column + L3 word bit)"
+        ),
+    )
     emit(
         "verdicts_per_sec_per_chip",
         round(vps),

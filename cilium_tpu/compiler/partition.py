@@ -1,0 +1,330 @@
+"""Declarative per-leaf partition rules for the device table pytrees.
+
+The replicated layout (engine/sharded.replicated_table_shardings) caps
+the identity universe at ONE chip's HBM: every chip holds every leaf,
+so a 50k-rule/65k-identity world costs ~442 MB on each chip and a
+mesh buys zero capacity.  This module is the t5x-style answer
+(PAPERS.md [1], arXiv:2203.17189): a REGEX RULE TABLE matched over
+the named pytree — `match_partition_rules` + `named_tree_map`, the
+SNIPPETS.md [2]/[3] pattern — instead of hand-placed shardings, with
+`replicated` as the explicit fallback so small leaves (stashes, the
+identity index tables, DFA transition tables) stay replicated while
+the identity-major leaves shard:
+
+  * `l4_hash_rows`     — the hashed L4 entry plane, sharded along the
+                         bucket-row axis (each chip owns a contiguous
+                         row slice; the probe routes each tuple's
+                         bucket to its owning shard);
+  * `l3_allow_bits`    — the L3-only lattice rows, sharded along the
+                         identity WORD axis (the layout the 2D mesh
+                         evaluator already combines with a psum);
+  * `l4_allow_bits`    — the dense allow bitmap (the cold fallback
+                         plane), same word axis;
+  * ipcache `buckets`  — the /32 prefix-row plane, bucket-row axis.
+
+Everything else — `id_table`/`id_direct` (a few MB even at 512k ids),
+`port_slot`, stashes, `l4_wild_rows` (per-(ep,port) — identity-free
+and tiny), scalars — matches the fallback rule and replicates.
+
+The rule table is DATA: `partition_digest` hashes it into a stamp the
+device store folds into its epoch layout, so a delta recorded against
+one partitioning can never scatter into an epoch laid out under
+another.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# The mesh axis the identity-major leaves shard along (the existing
+# 2D (batch × table) mesh of engine/sharded.py).
+TABLE_AXIS = "table"
+
+
+def tree_path_to_string(path, sep: str = "/") -> str:
+    """jax key-path → 'a/b/c' (SNIPPETS.md [3] tree_path_to_string)."""
+    keys = []
+    for key in path:
+        if isinstance(key, jax.tree_util.SequenceKey):
+            keys.append(str(key.idx))
+        elif isinstance(key, jax.tree_util.DictKey):
+            keys.append(str(key.key))
+        elif isinstance(key, jax.tree_util.GetAttrKey):
+            keys.append(str(key.name))
+        elif isinstance(key, jax.tree_util.FlattenedIndexKey):
+            keys.append(str(key.key))
+        else:
+            keys.append(str(key))
+    return sep.join(keys)
+
+
+def named_tree_map(f, tree, *rest, is_leaf=None, sep: str = "/"):
+    """tree_map where `f` receives (path-string, leaf, *rest-leaves) —
+    the extended tree_map of SNIPPETS.md [2]/[3].  For dict/list
+    pytrees the names are real key paths; the registered table
+    dataclasses flatten positionally, so the helpers below pair their
+    children with the explicit *_LEAF_NAMES tables instead."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x, *r: f(tree_path_to_string(path, sep=sep), x, *r),
+        tree,
+        *rest,
+        is_leaf=is_leaf,
+    )
+
+
+# Child order of the registered table pytrees (== tree_flatten order;
+# the pytrees flatten positionally, so the names live here, beside
+# the rules that consume them).
+POLICY_LEAF_NAMES = (
+    "id_table", "id_direct", "id_lo_len", "port_slot", "l4_meta",
+    "l4_allow_bits", "l3_allow_bits", "generation", "l4_hash_rows",
+    "l4_hash_stash", "l4_wild_rows", "l4_wild_stash",
+)
+IPCACHE_LEAF_NAMES = (
+    "buckets", "stash", "range_base", "range_mask", "range_plen",
+    "range_value", "range_l3_in", "range_l3_out", "range_rows",
+)
+
+
+# -- the rule tables ---------------------------------------------------------
+# (regex, PartitionSpec) pairs, first match wins; the final catch-all
+# IS the replicated fallback — explicit, so a new leaf added to
+# PolicyTables replicates by default instead of failing to place.
+
+
+def default_table_rules(table_axis: str = TABLE_AXIS) -> List[tuple]:
+    """The PolicyTables rule table (identity-major leaves sharded)."""
+    return [
+        # dense allow bitmap [E, 2, Kg, W]: identity WORD axis
+        (r"^l4_allow_bits$", P(None, None, None, table_axis)),
+        # L3-only rows [E, 2, W]: identity WORD axis
+        (r"^l3_allow_bits$", P(None, None, table_axis)),
+        # hashed L4 entry plane [R, lanes]: bucket-row axis (the row
+        # count is pow2 and identities spread uniformly by hash, so
+        # equal row slices carry near-equal entry loads)
+        (r"^l4_hash_rows$", P(table_axis)),
+        # wild rows are per-(ep, dir, port, proto) — identity-free and
+        # a few KB; stashes are ≤64 rows: replicated (the fallback
+        # would catch them too, but the intent is worth spelling out)
+        (r"^l4_(wild_rows|hash_stash|wild_stash)$", P()),
+        # replicated fallback: id tables, port_slot, generation, ...
+        (r".*", P()),
+    ]
+
+
+def default_ipcache_rules(table_axis: str = TABLE_AXIS) -> List[tuple]:
+    """IPCacheDevice rule table: the /32 bucket-row plane shards; the
+    small range-class plane and stash replicate."""
+    return [
+        (r"^buckets$", P(table_axis)),
+        (r".*", P()),
+    ]
+
+
+def match_partition_rules(
+    rules: Sequence[tuple], names: Sequence[str], leaves: Sequence
+) -> list:
+    """PartitionSpec per leaf: each `names[i]` is matched against
+    `rules` in order; scalars/0-d/None leaves never partition.
+    Unmatched leaves raise — the catch-all fallback rule makes that
+    unreachable for the default tables."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    out = []
+    for name, leaf in zip(names, leaves):
+        if leaf is None:
+            out.append(P())
+            continue
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            out.append(P())  # never partition scalars
+            continue
+        for rx, spec in compiled:
+            if rx.search(name) is not None:
+                out.append(spec)
+                break
+        else:
+            raise ValueError(
+                f"partition rule not found for leaf: {name}"
+            )
+    return out
+
+
+def policy_partition_specs(tables, table_axis: str = TABLE_AXIS):
+    """PartitionSpecs for a PolicyTables pytree under the default
+    rule table, as a PolicyTables of specs (shape-aware: scalars
+    replicate regardless of rules)."""
+    children, _ = tables.tree_flatten()
+    specs = match_partition_rules(
+        default_table_rules(table_axis), POLICY_LEAF_NAMES, children
+    )
+    return type(tables).tree_unflatten(None, tuple(specs))
+
+
+def ipcache_partition_specs(dev, table_axis: str = TABLE_AXIS):
+    """PartitionSpecs for an IPCacheDevice (or replicated specs for
+    the DIR-24-8 fallback form)."""
+    from cilium_tpu.ipcache.lpm import IPCacheDevice
+
+    if not isinstance(dev, IPCacheDevice):
+        children, aux = dev.tree_flatten()
+        return type(dev).tree_unflatten(
+            aux, tuple(P() for _ in children)
+        )
+    children, aux = dev.tree_flatten()
+    specs = match_partition_rules(
+        default_ipcache_rules(table_axis), IPCACHE_LEAF_NAMES, children
+    )
+    return type(dev).tree_unflatten(aux, tuple(specs))
+
+
+def partition_digest(rules: Sequence[tuple]) -> int:
+    """Stable 32-bit digest of a rule table — folded into the device
+    store's epoch layout stamp so cross-partitioning deltas are
+    refused (engine/publish.DeviceTableStore)."""
+    text = ";".join(
+        f"{pat}->{tuple(spec)}" for pat, spec in rules
+    ).encode()
+    return zlib.crc32(text) & 0xFFFFFFFF
+
+
+def _divisible(spec: P, shape, ntp: int, table_axis: str) -> bool:
+    for axis, name in enumerate(spec):
+        if name == table_axis and (
+            axis >= len(shape) or shape[axis] % ntp != 0
+        ):
+            return False
+    return True
+
+
+def divisible_partition_specs(
+    tables, ntp: int, table_axis: str = TABLE_AXIS
+):
+    """policy_partition_specs with the shard-axis divisibility check
+    applied: leaves whose sharded axis does not split evenly over
+    `ntp` shards fall back to replicated (the shard_map evaluator and
+    the store must agree on this, so it lives in the rule layer)."""
+    specs = policy_partition_specs(tables, table_axis)
+    spec_children, _ = specs.tree_flatten()
+    leaf_children, _ = tables.tree_flatten()
+    out = []
+    for spec, leaf in zip(spec_children, leaf_children):
+        if leaf is None or not _divisible(
+            spec, getattr(leaf, "shape", ()), ntp, table_axis
+        ):
+            spec = P()
+        out.append(spec)
+    return type(tables).tree_unflatten(None, tuple(out))
+
+
+def table_shardings(mesh: Mesh, tables, table_axis: str = TABLE_AXIS):
+    """NamedShardings pytree for device_put / DeviceTableStore: the
+    default rule table resolved against `mesh`.  Leaves whose sharded
+    axis does not divide by the mesh's table-axis size fall back to
+    replicated (correctness first; tools/shardprof.py reports it)."""
+    specs = divisible_partition_specs(
+        tables, int(mesh.shape[table_axis]), table_axis
+    )
+    spec_children, _ = specs.tree_flatten()
+    out = tuple(NamedSharding(mesh, s) for s in spec_children)
+    return type(tables).tree_unflatten(None, out)
+
+
+# -- bytes / headroom models -------------------------------------------------
+
+
+def shard_bytes_model(tables, num_shards: int,
+                      table_axis: str = TABLE_AXIS):
+    """Per-leaf per-chip bytes under the default rule table.  Returns
+    (rows, per_chip_total, replicated_total): rows are dicts with
+    leaf/sharded/bytes; replicated_total is the per-chip overhead the
+    acceptance bound allows on top of sharded_bytes / num_shards.
+    Applies the same divisibility fallback as table_shardings, so the
+    model classifies each leaf exactly as the store will place it."""
+    specs_tree = divisible_partition_specs(
+        tables, num_shards, table_axis
+    )
+    children, _ = tables.tree_flatten()
+    specs, _ = specs_tree.tree_flatten()
+    rows = []
+    per_chip = 0
+    replicated = 0
+    for name, leaf, spec in zip(POLICY_LEAF_NAMES, children, specs):
+        if leaf is None:
+            continue
+        nbytes = int(getattr(leaf, "nbytes", None) or np.asarray(leaf).nbytes)
+        sharded = any(ax == table_axis for ax in spec)
+        chip = (
+            (nbytes + num_shards - 1) // num_shards
+            if sharded
+            else nbytes
+        )
+        if not sharded:
+            replicated += nbytes
+        per_chip += chip
+        rows.append(
+            {"leaf": name, "sharded": sharded,
+             "bytes_total": nbytes, "bytes_per_chip": chip}
+        )
+    return rows, per_chip, replicated
+
+
+def universe_max_identities(
+    tables,
+    num_shards: int,
+    hbm_bytes: int = 16 << 30,
+    table_axis: str = TABLE_AXIS,
+) -> int:
+    """Headroom model: the identity-universe size one mesh can hold.
+
+    Identity-major leaf bytes scale linearly with the padded identity
+    count N and divide across `num_shards`; replicated leaves are a
+    per-chip constant.  Solving
+        replicated + identity_bytes_per_id * N / num_shards ≤ hbm
+    for N gives the `universe_max_identities` line bench emits — the
+    capacity the sharding refactor actually buys (the replicated
+    layout is the num_shards=1 row).
+
+    Classification is by RULE INTENT, not current-shape divisibility:
+    at the universe being solved for, the identity axis is padded to
+    a shard multiple, so a leaf the rules shard contributes to the
+    per-id slope even if today's word count happens not to divide by
+    `num_shards` (shard_bytes_model, which accounts the CURRENT
+    shapes, applies the divisibility fallback instead)."""
+    children, _ = tables.tree_flatten()
+    specs = match_partition_rules(
+        default_table_rules(table_axis), POLICY_LEAF_NAMES, children
+    )
+    n = int(tables.id_table.shape[0])
+    id_bytes = 0
+    replicated = 0
+    for leaf, spec in zip(children, specs):
+        if leaf is None:
+            continue
+        nbytes = int(getattr(leaf, "nbytes", None) or np.asarray(leaf).nbytes)
+        if any(ax == table_axis for ax in spec):
+            id_bytes += nbytes
+        else:
+            replicated += nbytes
+    per_id = id_bytes / max(n, 1)
+    budget = hbm_bytes - replicated
+    if per_id <= 0 or budget <= 0:
+        return 0
+    return int(budget * num_shards / per_id)
+
+
+def alltoall_bytes_per_tuple(num_shards: int) -> float:
+    """Collective bytes the routed-gather evaluator moves per tuple
+    along the identity axis: each routed probe returns its verdict
+    column to the originating shard through one integer psum —
+    exact-probe found+value (8 B) plus the L3 word-probe bit (4 B).
+    A 1-shard mesh moves nothing (the psum folds away)."""
+    if num_shards <= 1:
+        return 0.0
+    return 12.0

@@ -59,6 +59,13 @@ class EndpointManager:
         # apply delta scatters instead of re-uploading the world
         self._device_store = None
         self._device_lock = threading.RLock()
+        # optional store factory (e.g. engine.sharded's
+        # make_partitioned_store bound to a mesh): set before the
+        # first published_device() call to serve the daemon's
+        # dispatch from identity-SHARDED epochs — the same delta
+        # publish path then scatters each payload into the owning
+        # chip's shard only
+        self.device_store_factory = None
         self.last_publish_stats = None
         # builder failure bookkeeping (endpoint.go's bpf.go:442 retry
         # counter analog): (endpoint_id, reason, repr(exc)) of the
@@ -302,7 +309,11 @@ class EndpointManager:
 
         with self._device_lock:
             if self._device_store is None:
-                self._device_store = DeviceTableStore()
+                factory = self.device_store_factory
+                self._device_store = (
+                    factory() if factory is not None
+                    else DeviceTableStore()
+                )
             return self._device_store
 
     def published_device(self):

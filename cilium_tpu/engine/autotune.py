@@ -203,8 +203,34 @@ def hot_gather_profile(tables, packed_io: bool = True) -> List[dict]:
             "lb", "lb.rows", "hot", lb_lanes * 4 / 2,
             "egress half-batches only",
         )
-    # ipcache: DIR-24-8 two-level lookup + optional l3 plane word
-    add("ipcache", "ipcache.dir24_8", "hot", 8, "2 element gathers")
+    # ipcache: the bucketized form pays one bucket-row gather plus
+    # one range-class row gather per distinct non-/32 prefix length
+    # (the hashed table that replaced the [B, P] broadcast scan);
+    # the DIR-24-8 fallback is two element gathers
+    from cilium_tpu.ipcache.lpm import IPCacheDevice
+
+    ipc = getattr(tables, "ipcache", None)
+    if isinstance(ipc, IPCacheDevice):
+        ip_lanes = int(np.asarray(ipc.buckets).shape[1])
+        add(
+            "ipcache", "ipcache.buckets", "hot", ip_lanes * 4,
+            "1 bucket-row gather",
+        )
+        if ipc.range_rows is not None:
+            n_classes = len(ipc.range_class_plens)
+            rw = int(np.asarray(ipc.range_rows).shape[1])
+            add(
+                "ipcache", "ipcache.range_rows", "hot",
+                n_classes * rw * 4,
+                f"{n_classes} prefix-length class gathers",
+            )
+        else:
+            add(
+                "ipcache", "ipcache.ranges", "hot", 0,
+                "[B, P] broadcast scan (compute, not gathers)",
+            )
+    else:
+        add("ipcache", "ipcache.dir24_8", "hot", 8, "2 element gathers")
     hash_rows = getattr(pol, "l4_hash_rows", None)
     if hash_rows is not None:
         lanes = int(np.asarray(hash_rows).shape[1])

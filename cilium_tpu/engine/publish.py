@@ -72,6 +72,25 @@ def _pad_pow2(update):
     return idx, values
 
 
+def _chip_resident_bytes(dev_tables) -> Dict[int, int]:
+    """Actual per-device resident bytes of a device table pytree,
+    summed from each leaf's addressable shards — the measured (not
+    modeled) per-chip HBM footprint of one epoch.  On a sharded
+    store the identity-major leaves contribute 1/num_shards per
+    chip; replicated leaves contribute their full size everywhere."""
+    import jax
+
+    per: Dict[int, int] = {}
+    for leaf in jax.tree.leaves(dev_tables):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            continue
+        for sh in shards:
+            ordinal = int(sh.device.id)
+            per[ordinal] = per.get(ordinal, 0) + int(sh.data.nbytes)
+    return per
+
+
 @dataclass
 class PublishStats:
     epoch: int
@@ -103,15 +122,28 @@ class DeviceTableStore:
         self,
         shardings: Optional[PolicyTables] = None,
         hot_only: bool = False,
+        shardings_fn=None,
+        partition_digest: int = 0,
     ) -> None:
         self._lock = threading.Lock()
         # each slot: dict(tables=<device pytree>, stamp=int,
-        # epoch=int, layout=int)
+        # epoch=int, layout=int, chip_bytes={ordinal: bytes})
         self._slots = [None, None]
         self._cur = 0
         self._epoch = 0
         self._shardings = shardings
+        # shape-aware sharding resolver (tables → NamedShardings
+        # pytree, e.g. compiler.partition.table_shardings bound to a
+        # mesh): the partition rules are declarative but leaf
+        # divisibility depends on the published shapes, so the
+        # resolved pytree is recomputed per publish
+        self._shardings_fn = shardings_fn
         self._hot_only = hot_only
+        # rule-table digest (compiler.partition.partition_digest),
+        # folded into every epoch's layout stamp: a delta recorded
+        # against one partitioning can never scatter into an epoch
+        # laid out under another
+        self.partition_digest = int(partition_digest)
         self._apply_cache: Dict[tuple, object] = {}
 
     # -- device placement ----------------------------------------------------
@@ -192,7 +224,11 @@ class DeviceTableStore:
             t0 = time.perf_counter()
             if self._hot_only:
                 tables = split_hot(tables)
-            layout = tables_layout_version(tables)
+            if self._shardings_fn is not None:
+                self._shardings = self._shardings_fn(tables)
+            layout = tables_layout_version(tables) | (
+                self.partition_digest << 32
+            )
             spare_i = self._cur ^ 1
             spare = self._slots[spare_i]
             stamp = int(np.asarray(tables.generation))
@@ -239,6 +275,7 @@ class DeviceTableStore:
             self._slots[spare_i] = {
                 "tables": dev, "stamp": stamp, "epoch": self._epoch,
                 "nbytes": tables_nbytes(tables), "layout": layout,
+                "chip_bytes": _chip_resident_bytes(dev),
             }
             self._cur = spare_i
             stats.epoch = self._epoch
@@ -264,6 +301,14 @@ class DeviceTableStore:
         metrics.device_table_bytes.set(
             "standby", value=(spare or {}).get("nbytes", 0)
         )
+        # cilium_device_table_bytes_per_chip{chip}: per-shard
+        # resident bytes over both epoch slots — identity-sharded
+        # leaves divide across chips, replicated ones repeat, so the
+        # per-chip line is what the universe headroom model bounds
+        for ordinal, nbytes in sorted(self._chip_bytes_locked().items()):
+            metrics.device_table_bytes_per_chip.set(
+                str(ordinal), value=nbytes
+            )
 
     def _publish_delta(
         self,
@@ -354,6 +399,21 @@ class DeviceTableStore:
             return tuple(
                 s["stamp"] for s in self._slots if s is not None
             )
+
+    def chip_bytes(self) -> Dict[int, int]:
+        """Measured per-chip resident bytes over both epoch slots —
+        the numbers behind cilium_device_table_bytes_per_chip."""
+        with self._lock:
+            return self._chip_bytes_locked()
+
+    def _chip_bytes_locked(self) -> Dict[int, int]:
+        per: Dict[int, int] = {}
+        for slot in self._slots:
+            for ordinal, nbytes in (
+                (slot or {}).get("chip_bytes", {}) or {}
+            ).items():
+                per[ordinal] = per.get(ordinal, 0) + nbytes
+        return per
 
     @staticmethod
     def _norm(stamp: int) -> int:

@@ -111,6 +111,64 @@ def batch_specs(batch_axis: str) -> TupleBatch:
     )
 
 
+def _counts_and_telemetry(
+    v,
+    tables_l,
+    batch_l,
+    j,
+    idx,
+    p2_local,
+    word_off,
+    w_local,
+    batch_axis,
+    collect_telemetry,
+):
+    """Shared counter + per-chip telemetry epilogue of the mesh and
+    partitioned evaluators.  The bit-identity contract across the
+    fused kernel and both mesh evaluators depends on there being ONE
+    copy of this logic: L4-slot hits come from globally-combined
+    verdict columns (identical on every table shard), the L3 hit
+    counter stays shard-local (`p2_local` true only on the identity
+    word's owner, `word_off` that shard's first bit-word), and the
+    [2, T] stage rows reduce from the same telemetry_masks set the
+    single-chip instrumented kernels fuse."""
+    e_count, _, kg = tables_l.l4_meta.shape
+    hit_l4 = (v.match_kind == MATCH_L4) | (
+        v.match_kind == MATCH_L4_WILD
+    )
+    l4_counts = jnp.zeros((e_count, 2, kg), jnp.uint32).at[
+        batch_l.ep_index, batch_l.direction, j
+    ].add(hit_l4.astype(jnp.uint32))
+    l3_hit_here = p2_local & (v.match_kind == MATCH_L3)
+    idx_l = jnp.clip(idx - word_off * 32, 0, w_local * 32 - 1)
+    l3_counts = jnp.zeros(
+        (e_count, 2, w_local * 32), jnp.uint32
+    ).at[
+        batch_l.ep_index, batch_l.direction, idx_l
+    ].add(l3_hit_here.astype(jnp.uint32))
+    l4_counts = jax.lax.psum(l4_counts, batch_axis)
+    l3_counts = jax.lax.psum(l3_counts, batch_axis)
+    if not collect_telemetry:
+        return v, l4_counts, l3_counts
+
+    from cilium_tpu.engine.verdict import telemetry_masks
+
+    zeros = jnp.zeros(v.allowed.shape, jnp.int32)
+    masks = telemetry_masks(
+        zeros, zeros, v.match_kind, v.allowed, zeros,
+        v.proxy_port, zeros, zeros,
+    )
+    ingress = batch_l.direction == 0
+    row_in = jnp.stack(
+        [jnp.sum(m & ingress, dtype=jnp.uint32) for m in masks]
+    )
+    col_total = jnp.stack(
+        [jnp.sum(m, dtype=jnp.uint32) for m in masks]
+    )
+    trow = jnp.stack([row_in, col_total - row_in])
+    return v, l4_counts, l3_counts, trow[None]
+
+
 def make_mesh_evaluator(
     mesh: Mesh,
     batch_axis: str = "batch",
@@ -190,53 +248,278 @@ def make_mesh_evaluator(
 
         v = _combine(p1g, p2g, p3, proxy, batch_l.is_fragment)
 
-        # Counters.  L4-slot hits are determined by globally-combined
-        # bits, so every table shard computes the same array.
-        e_count, _, kg = tables_l.l4_meta.shape
-        hit_l4 = (v.match_kind == MATCH_L4) | (
-            v.match_kind == MATCH_L4_WILD
+        return _counts_and_telemetry(
+            v, tables_l, batch_l, j, idx, p2, off, w_local,
+            batch_axis, collect_telemetry,
         )
-        l4_counts = jnp.zeros((e_count, 2, kg), jnp.uint32).at[
-            batch_l.ep_index, batch_l.direction, j
-        ].add(hit_l4.astype(jnp.uint32))
-        # L3 hit whose identity bit-word lives in *this* shard.
-        l3_hit_here = p2 & (v.match_kind == MATCH_L3)
-        idx_l = jnp.clip(idx - off * 32, 0, w_local * 32 - 1)
-        l3_counts = jnp.zeros((e_count, 2, w_local * 32), jnp.uint32).at[
-            batch_l.ep_index, batch_l.direction, idx_l
-        ].add(l3_hit_here.astype(jnp.uint32))
-
-        l4_counts = jax.lax.psum(l4_counts, batch_axis)
-        l3_counts = jax.lax.psum(l3_counts, batch_axis)
-        if not collect_telemetry:
-            return v, l4_counts, l3_counts
-
-        # -- per-chip stage telemetry: this batch shard's [2, T] rows,
-        # computed from the globally-combined verdict columns (v is
-        # identical across the table axis after the psums above, so
-        # every table shard of one batch shard emits the same rows)
-        from cilium_tpu.engine.verdict import telemetry_masks
-
-        zeros = jnp.zeros(v.allowed.shape, jnp.int32)
-        masks = telemetry_masks(
-            zeros, zeros, v.match_kind, v.allowed, zeros,
-            v.proxy_port, zeros, zeros,
-        )
-        ingress = batch_l.direction == 0
-        row_in = jnp.stack(
-            [jnp.sum(m & ingress, dtype=jnp.uint32) for m in masks]
-        )
-        col_total = jnp.stack(
-            [jnp.sum(m, dtype=jnp.uint32) for m in masks]
-        )
-        trow = jnp.stack([row_in, col_total - row_in])
-        return v, l4_counts, l3_counts, trow[None]
 
     in_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s), t_specs),
         jax.tree.map(lambda s: NamedSharding(mesh, s), b_specs),
     )
     return jax.jit(step, in_shardings=in_shardings)
+
+
+def make_partitioned_store(
+    mesh: Mesh,
+    table_axis: str = "table",
+    hot_only: bool = False,
+):
+    """DeviceTableStore whose epochs PARTITION across `mesh` under
+    the declarative rule table (compiler/partition.py): the
+    identity-major leaves — hashed L4 entry rows, L3/L4 allow-bit
+    words — each live on exactly one chip's HBM slice, small leaves
+    replicate, and a delta publish scatters each payload into the
+    OWNING chip's shard only (the scatter runs over the sharded
+    resident pytree, so XLA routes every row to the chip that holds
+    it — no full-table re-upload, no cross-chip copies of unchanged
+    rows).  The rule-table digest is folded into every epoch's
+    layout stamp."""
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.engine.publish import DeviceTableStore
+
+    return DeviceTableStore(
+        shardings_fn=lambda tables: partition.table_shardings(
+            mesh, tables, table_axis
+        ),
+        partition_digest=partition.partition_digest(
+            partition.default_table_rules(table_axis)
+        ),
+        hot_only=hot_only,
+    )
+
+
+def make_partitioned_evaluator(
+    mesh: Mesh,
+    tables: PolicyTables,
+    batch_axis: str = "batch",
+    table_axis: str = "table",
+    collect_telemetry: bool = False,
+):
+    """Routed-gather evaluator over identity-SHARDED tables.
+
+    Where make_mesh_evaluator shards only the dense bitmap word axis
+    and replicates the hashed entry plane, this evaluator consumes
+    the declarative rule table (compiler/partition.py): the hashed
+    L4 entry rows shard along the bucket-row axis and the L3 words
+    along the identity word axis, so per-chip HBM holds ~1/num_shards
+    of the identity-major bytes — the refactor that lifts the
+    universe cap past one chip.
+
+    Routing: inside shard_map each tuple's global bucket/word index
+    is offset into the local shard; the shard that OWNS the row
+    gathers it (everyone else contributes a masked zero) and the
+    verdict columns return to the originating batch shard through
+    one integer psum per probe — bit-identical to the replicated
+    evaluator at every mesh size because each key lives in exactly
+    one shard, so the sums are exact 0/1 combinations (the same
+    argument as make_mesh_evaluator's psum lattice).
+
+    `tables` supplies the SHAPES the partition layout is derived
+    from (which leaves divide evenly, bucket/word counts); the
+    returned fn(tables, batch) is jitted against those shapes.
+    Requires the hashed entry pair (FleetCompiler always builds it).
+
+    Returns fn(tables, batch) -> (Verdicts, l4_counts, l3_counts
+    [, per-chip telemetry rows]) with the same output contract as
+    make_mesh_evaluator."""
+    from cilium_tpu.compiler.partition import (
+        divisible_partition_specs,
+    )
+    from cilium_tpu.compiler.tables import (
+        L4H_WILD_IDX,
+        l4h_key0,
+        l4h_key1,
+    )
+    from cilium_tpu.engine.hashtable import fnv1a_device
+    from cilium_tpu.engine.verdict import (
+        MATCH_L3,
+        _index_identity,
+        _l4hash_probe,
+    )
+
+    if tables.l4_hash_rows is None:
+        raise ValueError(
+            "partitioned evaluator requires the hashed L4 entry "
+            "tables (hand-built dense tables: use "
+            "make_mesh_evaluator)"
+        )
+    ntp = int(mesh.shape[table_axis])
+    t_specs = divisible_partition_specs(tables, ntp, table_axis)
+    # static layout facts the kernel routes by (closure, not traced)
+    rows_sharded = table_axis in tuple(
+        ax for ax in t_specs.l4_hash_rows
+    )
+    l3_sharded = table_axis in tuple(
+        ax for ax in t_specs.l3_allow_bits
+    )
+    n_rows_global = int(tables.l4_hash_rows.shape[0])
+
+    b_specs = batch_specs(batch_axis)
+    v_specs = Verdicts(
+        allowed=P(batch_axis),
+        proxy_port=P(batch_axis),
+        match_kind=P(batch_axis),
+    )
+    l3c_spec = P(None, None, table_axis) if l3_sharded else P()
+    out_specs = (v_specs, P(), l3c_spec)
+    if collect_telemetry:
+        out_specs = out_specs + (P(batch_axis, None, None),)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(t_specs, b_specs),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def step(tables_l: PolicyTables, batch_l: TupleBatch):
+        # identity index from the replicated direct table (global)
+        idx, known = _index_identity(tables_l, batch_l)
+        proto = jnp.clip(batch_l.proto, 0, 255).astype(jnp.int32)
+        dport = jnp.clip(batch_l.dport, 0, 65535).astype(jnp.int32)
+
+        # -- routed exact probe: the bucket row lives on ONE shard ------
+        w0 = l4h_key0(
+            idx.astype(jnp.uint32), batch_l.direction,
+            batch_l.ep_index,
+        )
+        w1 = l4h_key1(dport, proto, batch_l.ep_index)
+        h = fnv1a_device(jnp.stack([w0, w1], axis=1))
+        bucket = (h & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
+        rows_l = tables_l.l4_hash_rows
+        n_local = rows_l.shape[0]
+        e = rows_l.shape[1] // 3
+        if rows_sharded:
+            off = jax.lax.axis_index(table_axis) * n_local
+            bl = bucket - off
+            owns = (bl >= 0) & (bl < n_local)
+            bl = jnp.clip(bl, 0, n_local - 1)
+        else:
+            owns = jnp.ones(bucket.shape, bool)
+            bl = bucket
+        row = rows_l[bl]  # local gather: only the owning shard's hit
+        hit = (
+            (row[:, :e] == w0[:, None])
+            & (row[:, e : 2 * e] == w1[:, None])
+            & owns[:, None]
+        )
+        val_local = jnp.sum(
+            jnp.where(hit, row[:, 2 * e : 3 * e], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        found_local = jnp.any(hit, axis=1)
+        if rows_sharded:
+            # return the verdict column to the originating shard:
+            # the key lives in exactly one shard, so the sums are
+            # exact (this psum pair is the alltoall_bytes_per_tuple
+            # the bench models)
+            val1 = jax.lax.psum(val_local, table_axis)
+            found1 = (
+                jax.lax.psum(
+                    found_local.astype(jnp.int32), table_axis
+                )
+                > 0
+            )
+        else:
+            val1, found1 = val_local, found_local
+        # overflow stash replicates (≤64 rows): same on every shard
+        stash = tables_l.l4_hash_stash
+        s_hit = (stash[None, :, 0] == w0[:, None]) & (
+            stash[None, :, 1] == w1[:, None]
+        )
+        val1 = val1 + jnp.sum(
+            jnp.where(s_hit, stash[None, :, 2], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        found1 = found1 | jnp.any(s_hit, axis=1)
+
+        # -- wildcard probe: identity-free, tiny, replicated ------------
+        wild_idx = jnp.full(
+            idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
+        )
+        hit3, val3 = _l4hash_probe(
+            tables_l.l4_wild_rows, tables_l.l4_wild_stash,
+            batch_l.ep_index, batch_l.direction, wild_idx,
+            dport, proto,
+        )
+        probe1 = known & found1
+        probe3 = hit3
+        val = jnp.where(probe1, val1, val3)
+        proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        j = (val >> jnp.uint32(16)).astype(jnp.int32)
+
+        # -- routed L3 probe: the identity's bit-word has one owner -----
+        word = idx >> 5
+        bit = (idx & 31).astype(jnp.uint32)
+        w_local = tables_l.l3_allow_bits.shape[-1]
+        if l3_sharded:
+            offw = jax.lax.axis_index(table_axis) * w_local
+            wl = word - offw
+            owns_w = (wl >= 0) & (wl < w_local)
+            wl = jnp.clip(wl, 0, w_local - 1)
+        else:
+            offw = 0
+            owns_w = jnp.ones(word.shape, bool)
+            wl = word
+        l3_words = tables_l.l3_allow_bits[
+            batch_l.ep_index, batch_l.direction, wl
+        ]
+        p2_local = (
+            known & owns_w & ((l3_words >> bit) & 1).astype(bool)
+        )
+        if l3_sharded:
+            probe2 = (
+                jax.lax.psum(p2_local.astype(jnp.int32), table_axis)
+                > 0
+            )
+        else:
+            probe2 = p2_local
+
+        v = _combine(probe1, probe2, probe3, proxy,
+                     batch_l.is_fragment)
+
+        return _counts_and_telemetry(
+            v, tables_l, batch_l, j, idx, p2_local, offw, w_local,
+            batch_axis, collect_telemetry,
+        )
+
+    in_shardings = (
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), t_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), b_specs),
+    )
+    jitted = jax.jit(step, in_shardings=in_shardings)
+    # the routing mask (n_rows_global) and shard flags are closure
+    # constants derived from the build-time shapes; a retrace on
+    # different shapes would route buckets with a stale mask and
+    # silently mis-verdict, so refuse loudly instead
+    built_geom = (
+        tuple(tables.l4_hash_rows.shape),
+        tuple(tables.l3_allow_bits.shape),
+    )
+
+    def run(tables_in: PolicyTables, batch: TupleBatch):
+        if tables_in.l4_hash_rows is None:
+            raise ValueError(
+                "partitioned evaluator requires the hashed L4 "
+                "entry tables"
+            )
+        got = (
+            tuple(tables_in.l4_hash_rows.shape),
+            tuple(tables_in.l3_allow_bits.shape),
+        )
+        if got != built_geom:
+            raise ValueError(
+                "partitioned evaluator was built for table geometry "
+                f"{built_geom} but called with {got}; rebuild with "
+                "make_partitioned_evaluator"
+            )
+        return jitted(tables_in, batch)
+
+    return run
 
 
 def make_async_mesh_dispatcher(

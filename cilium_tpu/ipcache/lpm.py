@@ -131,11 +131,14 @@ def build_lpm(prefix_to_id: Dict[str, int]) -> LPMTables:
 class IPCacheDevice:
     """Bucketized ipcache: the /32 population (endpoints — the bulk of
     a real ipcache) lives in hash-bucket rows resolved by ONE row
-    gather, and the (few hundred at most) wider prefixes are
-    (base, mask, plen, value) arrays resolved by a broadcast
-    longest-prefix compare with no gathers at all.  This replaces the
-    DIR-24-8 double gather on the fused path; DIR-24-8 remains the
-    fallback for range-heavy tables (build_ipcache chooses).
+    gather, and the (few hundred at most) wider prefixes live in a
+    hashed range-class table (`range_rows`) resolved by one row
+    gather per distinct prefix length (≤ RANGE_CLASS_MAX, longest
+    first) — the (base, mask, plen, value) arrays remain as the
+    build source and the [B, P] broadcast fallback for tables with
+    more length classes.  This replaces the DIR-24-8 double gather
+    on the fused path; DIR-24-8 remains the fallback for range-heavy
+    tables (build_ipcache chooses).
 
     Bucket row layout (planar, 64 entries × 2 words): lanes [0, 64)
     hold entry ips, lanes [64, 128) hold entry values.  Empty lanes
@@ -166,6 +169,15 @@ class IPCacheDevice:
     world_l3_out: int = 0
     range_l3_in: "np.ndarray | None" = None
     range_l3_out: "np.ndarray | None" = None
+    # hashed range-class table (see _build_range_rows): the non-/32
+    # prefixes bucketized by (masked base, stored plen) so the lookup
+    # does ONE row gather per distinct prefix length instead of the
+    # [B, P] broadcast compare over every range.  None → the
+    # broadcast fallback (more than RANGE_CLASS_MAX distinct
+    # lengths).  `range_class_plens` is the static probe schedule:
+    # STORED (+1) prefix lengths, longest first.
+    range_rows: "np.ndarray | None" = None
+    range_class_plens: tuple = ()
 
     def tree_flatten(self):
         return (
@@ -178,6 +190,7 @@ class IPCacheDevice:
                 self.range_value,
                 self.range_l3_in,
                 self.range_l3_out,
+                self.range_rows,
             ),
             (
                 self.n_buckets,
@@ -186,6 +199,7 @@ class IPCacheDevice:
                 self.l3_planes,
                 self.world_l3_in,
                 self.world_l3_out,
+                self.range_class_plens,
             ),
         )
 
@@ -201,12 +215,129 @@ class IPCacheDevice:
             world_l3_out=aux[5],
             range_l3_in=children[6],
             range_l3_out=children[7],
+            range_rows=children[8],
+            range_class_plens=aux[6],
         )
 
 
 IP_ENTRIES_PER_BUCKET = 64
 IP_STASH = 128
 MAX_RANGES = 512
+# hashed range-class table: a real ipcache's non-/32 population
+# clusters at a handful of prefix lengths (/8 /12 /16 /24 pod and
+# node CIDRs), so ≤4 distinct lengths cover it; more falls back to
+# the broadcast scan (correctness first, tools report it)
+RANGE_CLASS_MAX = 4
+RANGE_ENTRIES_PER_BUCKET = 8
+
+
+def _build_range_rows(base, mask, plen, value, l3_in=None, l3_out=None):
+    """Bucketize the non-/32 ranges by (masked base, stored plen) —
+    the PagedAttention move applied to the ipcache: stop SCANNING
+    every range per tuple ([B, P] broadcast, P up to MAX_RANGES),
+    INDEX the owning block instead.  One row gather per distinct
+    prefix length resolves the class; the longest length that hits
+    wins, exactly the broadcast's longest-prefix selection.
+
+    Row layout is planar like the L4 hash rows: E entries × 3 planes
+    (masked base, stored plen, value), or 5 planes with the
+    per-endpoint L3 words when the idx/l3 specialized form carries
+    them.  Empty lanes hold plen 0, unreachable (stored plens are
+    +1).  Returns (rows, class_plens) — class_plens is the static
+    probe schedule, stored (+1) lengths longest first — or
+    (None, ()) when the table needs more than RANGE_CLASS_MAX
+    classes and the caller must keep the broadcast fallback."""
+    from cilium_tpu.engine.hashtable import _fnv1a_host
+
+    live = plen > 0
+    nlive = int(live.sum())
+    planes = 3 if l3_in is None else 5
+    e = RANGE_ENTRIES_PER_BUCKET
+    if nlive == 0:
+        return np.zeros((1, planes * e), np.uint32), ()
+    plens = tuple(
+        sorted({int(p) for p in plen[live]}, reverse=True)
+    )
+    if len(plens) > RANGE_CLASS_MAX:
+        return None, ()
+    # mask at build time so the stored hash key matches what the
+    # device probe hashes (ips & class mask) even if a caller ever
+    # hands an un-normalized base
+    w0 = (base[live] & mask[live]).astype(np.uint32)
+    w1 = plen[live].astype(np.uint32)
+    cols = [w0, w1, value[live].astype(np.uint32)]
+    if planes == 5:
+        cols += [
+            l3_in[live].astype(np.uint32),
+            l3_out[live].astype(np.uint32),
+        ]
+    h = _fnv1a_host(np.stack([w0, w1], axis=1))
+    n_rows = 8
+    while n_rows * e < 2 * nlive:
+        n_rows <<= 1
+    while True:
+        b = (h & np.uint32(n_rows - 1)).astype(np.int64)
+        if np.bincount(b, minlength=n_rows).max() <= e:
+            break
+        n_rows <<= 1
+        if n_rows > (1 << 16):  # pathological collisions
+            return None, ()
+    rows = np.zeros((n_rows, planes * e), np.uint32)
+    fill = np.zeros(n_rows, np.int64)
+    for i in range(nlive):
+        r = int(b[i])
+        k = int(fill[r])
+        fill[r] = k + 1
+        for p, col in enumerate(cols):
+            rows[r, p * e + k] = col[i]
+    return rows, plens
+
+
+def _range_hash_probe(dev: "IPCacheDevice", ips):
+    """Device half of the hashed range classes: one row gather +
+    lane compares per distinct prefix length (≤ RANGE_CLASS_MAX),
+    longest first.  Returns (found [B], value [B], l3_in [B],
+    l3_out [B]) — the same selection the broadcast scan computes."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.engine.hashtable import fnv1a_device
+
+    rows = jnp.asarray(dev.range_rows)
+    planes = 5 if dev.l3_planes else 3
+    e = rows.shape[1] // planes
+    n_rows = rows.shape[0]
+    found = jnp.zeros(ips.shape, bool)
+    val = jnp.zeros(ips.shape, jnp.uint32)
+    l3i = jnp.zeros(ips.shape, jnp.uint32)
+    l3o = jnp.zeros(ips.shape, jnp.uint32)
+    for sp in dev.range_class_plens:  # static schedule, longest first
+        raw = int(sp) - 1
+        m = jnp.uint32(
+            (0xFFFFFFFF << (32 - raw)) & 0xFFFFFFFF if raw else 0
+        )
+        w0 = ips & m
+        w1 = jnp.full(ips.shape, jnp.uint32(sp), jnp.uint32)
+        h = fnv1a_device(jnp.stack([w0, w1], axis=1))
+        row = rows[(h & jnp.uint32(n_rows - 1)).astype(jnp.int32)]
+        hit = (row[:, :e] == w0[:, None]) & (
+            row[:, e : 2 * e] == jnp.uint32(sp)
+        )
+        hitc = jnp.any(hit, axis=1)
+
+        def msum(p, hit=hit, row=row):
+            return jnp.sum(
+                jnp.where(hit, row[:, p * e : (p + 1) * e], 0),
+                axis=1,
+                dtype=jnp.uint32,
+            )
+
+        take = hitc & ~found
+        val = jnp.where(take, msum(2), val)
+        if planes == 5:
+            l3i = jnp.where(take, msum(3), l3i)
+            l3o = jnp.where(take, msum(4), l3o)
+        found = found | hitc
+    return found, val, l3i, l3o
 
 
 def _trim_ip_stash(stash: np.ndarray, fill: int) -> np.ndarray:
@@ -311,6 +442,7 @@ def build_ipcache(prefix_to_id: Dict[str, int]):
     value = np.zeros(p, dtype=np.uint32)
     for i, (b_, m_, l_, v_) in enumerate(ranges):
         base[i], mask[i], plen[i], value[i] = b_, m_, l_ + 1, v_
+    rrows, rplens = _build_range_rows(base, mask, plen, value)
     return IPCacheDevice(
         buckets=buckets,
         stash=_trim_ip_stash(stash, stash_fill),
@@ -319,6 +451,8 @@ def build_ipcache(prefix_to_id: Dict[str, int]):
         range_plen=plen,
         range_value=value,
         n_buckets=nb,
+        range_rows=rrows,
+        range_class_plens=rplens,
     )
 
 
@@ -435,6 +569,10 @@ def specialize_ipcache_to_idx(
             else:
                 stash[sfill] = (ip, v)
                 sfill += 1
+        rrows, rplens = _build_range_rows(
+            dev.range_base, dev.range_mask, dev.range_plen,
+            range_value,
+        )
         return IPCacheDevice(
             buckets=buckets,
             stash=_trim_ip_stash(stash, sfill),
@@ -445,6 +583,8 @@ def specialize_ipcache_to_idx(
             n_buckets=nb,
             values_are_idx=True,
             world_plus1=world,
+            range_rows=rrows,
+            range_class_plens=rplens,
         )
 
     # idx + l3-plane form: 32 entries × 4 planar words per bucket
@@ -478,6 +618,10 @@ def specialize_ipcache_to_idx(
             raise ValueError("ipcache bucket and stash overflow")
     r_l3i, r_l3o = l3_words(range_value)
     w_l3i, w_l3o = l3_words(np.array([world], np.uint32))
+    rrows, rplens = _build_range_rows(
+        dev.range_base, dev.range_mask, dev.range_plen, range_value,
+        l3_in=r_l3i, l3_out=r_l3o,
+    )
     return IPCacheDevice(
         buckets=buckets,
         stash=_trim_ip_stash(stash, sfill),
@@ -493,6 +637,8 @@ def specialize_ipcache_to_idx(
         world_l3_out=int(w_l3o[0]),
         range_l3_in=r_l3i,
         range_l3_out=r_l3o,
+        range_rows=rrows,
+        range_class_plens=rplens,
     )
 
 
@@ -533,28 +679,41 @@ def ipcache_lookup_fused(dev: IPCacheDevice, ips, ingress=None):
 
     exact_val = exact_val + ssum(1)
 
-    # ranges: longest matching prefix wins (plen stored +1 so zero
-    # padding never wins); same-length ranges can't overlap, so the
-    # masked value sum at the winning length is exact
-    match = (ips[:, None] & jnp.asarray(dev.range_mask)[None, :]) == (
-        jnp.asarray(dev.range_base)[None, :]
-    )
-    plen = jnp.asarray(dev.range_plen)
-    best = jnp.max(jnp.where(match, plen[None, :], 0), axis=1)  # [B]
-    range_sel = match & (plen[None, :] == best[:, None])
-
-    def rsum(arr):
-        return jnp.sum(
-            jnp.where(range_sel, jnp.asarray(arr)[None, :], 0),
-            axis=1,
-            dtype=jnp.uint32,
+    # ranges: longest matching prefix wins.  The hashed class table
+    # resolves it in ≤ RANGE_CLASS_MAX row gathers (one per distinct
+    # prefix length, longest first); tables with more length classes
+    # keep the [B, P] broadcast scan (plen stored +1 so zero padding
+    # never wins; same-length ranges can't overlap, so the masked
+    # value sum at the winning length is exact).
+    if dev.range_rows is not None:
+        range_found, range_val, r_l3i, r_l3o = _range_hash_probe(
+            dev, ips
         )
+    else:
+        match = (
+            ips[:, None] & jnp.asarray(dev.range_mask)[None, :]
+        ) == jnp.asarray(dev.range_base)[None, :]
+        plen = jnp.asarray(dev.range_plen)
+        best = jnp.max(
+            jnp.where(match, plen[None, :], 0), axis=1
+        )  # [B]
+        range_sel = match & (plen[None, :] == best[:, None])
 
-    range_found = best > 0
+        def rsum(arr):
+            return jnp.sum(
+                jnp.where(range_sel, jnp.asarray(arr)[None, :], 0),
+                axis=1,
+                dtype=jnp.uint32,
+            )
+
+        range_found = best > 0
+        range_val = rsum(dev.range_value)
+        if dev.l3_planes:
+            r_l3i = rsum(dev.range_l3_in)
+            r_l3o = rsum(dev.range_l3_out)
+
     value = jnp.where(
-        exact_found,
-        exact_val,
-        jnp.where(range_found, rsum(dev.range_value), 0),
+        exact_found, exact_val, jnp.where(range_found, range_val, 0)
     )
     if not dev.l3_planes:
         return value, None
@@ -567,11 +726,7 @@ def ipcache_lookup_fused(dev: IPCacheDevice, ips, ingress=None):
     l3_exact = msum(l3_plane) + jnp.where(
         jnp.asarray(ingress), ssum(2), ssum(3)
     )
-    l3_range = jnp.where(
-        jnp.asarray(ingress),
-        rsum(dev.range_l3_in),
-        rsum(dev.range_l3_out),
-    )
+    l3_range = jnp.where(jnp.asarray(ingress), r_l3i, r_l3o)
     l3 = jnp.where(
         exact_found, l3_exact, jnp.where(range_found, l3_range, 0)
     )

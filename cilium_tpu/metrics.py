@@ -347,6 +347,15 @@ class Registry:
             "spare awaiting the next delta scatter)",
             ("epoch",),
         )
+        self.device_table_bytes_per_chip = Gauge(
+            f"{ns}_device_table_bytes_per_chip",
+            "Device-resident policy-table bytes per mesh chip "
+            "(live + standby epochs), sampled at publish — the "
+            "per-shard HBM line behind the universe_max_identities "
+            "headroom model (identity-sharded tables divide across "
+            "chips; replicated leaves repeat on every chip)",
+            ("chip",),
+        )
         self.device_table_retired_bytes = Counter(
             f"{ns}_device_table_donation_retired_bytes_total",
             "Bytes of standby-epoch buffers consumed (donated in "

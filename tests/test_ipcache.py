@@ -182,6 +182,101 @@ def test_lpm_fuzz_vs_host_oracle(seed):
     np.testing.assert_array_equal(got, want)
 
 
+def test_ipcache_hashed_range_classes_vs_host_oracle():
+    """The non-/32 ranges resolve through the hashed per-prefix-
+    length-class table (≤4 row gathers) — bit-identical to the host
+    LPM oracle, including shadowing between lengths and the /32
+    bucket plane."""
+    from cilium_tpu.ipcache.lpm import (
+        RANGE_CLASS_MAX,
+        IPCacheDevice,
+        _lookup_kernel,
+        build_ipcache,
+    )
+
+    rng = np.random.default_rng(7)
+    mapping = {"0.0.0.0/0": 2}
+    for plen in (8, 16, 24):
+        for _ in range(40):
+            base = int(rng.integers(0, 1 << 32)) & (
+                ~((1 << (32 - plen)) - 1) & 0xFFFFFFFF
+            )
+            mapping[f"{_ip(base)}/{plen}"] = int(
+                rng.integers(1, 1 << 20)
+            )
+    for _ in range(200):  # the /32 endpoint population
+        mapping[f"{_ip(int(rng.integers(0, 1 << 32)))}/32"] = int(
+            rng.integers(1, 1 << 20)
+        )
+    dev = build_ipcache(mapping)
+    assert isinstance(dev, IPCacheDevice)
+    assert dev.range_rows is not None
+    assert 0 < len(dev.range_class_plens) <= RANGE_CLASS_MAX
+    # longest first: /24 probes before /16 before /8 before /0
+    assert list(dev.range_class_plens) == sorted(
+        dev.range_class_plens, reverse=True
+    )
+
+    probes = [int(rng.integers(0, 1 << 32)) for _ in range(128)]
+    for cidr in list(mapping)[:64]:
+        net = ipaddress.ip_network(cidr)
+        probes.append(int(net.network_address))
+        probes.append(int(net.broadcast_address))
+    # 255.255.255.255 is the bucket empty-lane marker (the reference
+    # ipcache never maps the broadcast address — IPCacheDevice
+    # docstring); the /0 broadcast probe would hit it
+    probes = [p for p in probes if p != 0xFFFFFFFF]
+    ips = np.array(probes, dtype=np.uint32)
+    import jax
+
+    got = np.asarray(jax.jit(_lookup_kernel)(dev, jnp.asarray(ips)))
+    want = np.array(
+        [lookup_host(mapping, _ip(p)) for p in probes],
+        dtype=np.uint32,
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ipcache_many_range_classes_fall_back_to_broadcast():
+    """More distinct non-/32 prefix lengths than RANGE_CLASS_MAX:
+    the build keeps the broadcast scan (range_rows None) and stays
+    bit-identical to the host oracle."""
+    from cilium_tpu.ipcache.lpm import (
+        IPCacheDevice,
+        _lookup_kernel,
+        build_ipcache,
+    )
+
+    rng = np.random.default_rng(8)
+    mapping = {}
+    for plen in (4, 8, 12, 16, 20, 24, 28):  # 7 classes
+        for _ in range(8):
+            base = int(rng.integers(0, 1 << 32)) & (
+                ~((1 << (32 - plen)) - 1) & 0xFFFFFFFF
+            )
+            mapping[f"{_ip(base)}/{plen}"] = int(
+                rng.integers(1, 1 << 20)
+            )
+    dev = build_ipcache(mapping)
+    assert isinstance(dev, IPCacheDevice)
+    assert dev.range_rows is None
+
+    probes = [int(rng.integers(0, 1 << 32)) for _ in range(64)]
+    for cidr in list(mapping)[:32]:
+        net = ipaddress.ip_network(cidr)
+        probes.append(int(net.network_address))
+        probes.append(int(net.broadcast_address))
+    ips = np.array(probes, dtype=np.uint32)
+    import jax
+
+    got = np.asarray(jax.jit(_lookup_kernel)(dev, jnp.asarray(ips)))
+    want = np.array(
+        [lookup_host(mapping, _ip(p)) for p in probes],
+        dtype=np.uint32,
+    )
+    np.testing.assert_array_equal(got, want)
+
+
 def test_lpm_builder_follows_ipcache():
     c = IPCache()
     b = LPMBuilder()

@@ -49,6 +49,24 @@ def test_two_process_global_mesh():
     for w in workers:
         out, _ = w.communicate(timeout=150)
         outputs.append(out)
+    # some backends (this container's CPU jax) cannot run
+    # multi-process SPMD at all — skip cleanly so the test stays
+    # live on real meshes without failing every CPU-only CI run
+    unsupported = (
+        "aren't implemented on the CPU backend",
+        "not implemented on the CPU backend",
+        "multiprocess computations aren't implemented",
+        "UNIMPLEMENTED: multiprocess",
+    )
+    if any(
+        w.returncode != 0
+        and any(m.lower() in out.lower() for m in unsupported)
+        for w, out in zip(workers, outputs)
+    ):
+        pytest.skip(
+            "backend reports multi-process SPMD unsupported "
+            "(CPU jax) — live on real meshes only"
+        )
     for pid, (w, out) in enumerate(zip(workers, outputs)):
         assert w.returncode == 0, (
             f"worker {pid} failed (rc {w.returncode}):\n{out}"

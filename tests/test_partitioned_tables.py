@@ -1,0 +1,503 @@
+"""Identity-sharded device tables: partition rules, routed-gather
+evaluator bit-identity, and shard-local delta publication.
+
+The tentpole contract (ISSUE 7): partitioning the identity-major
+leaves across the mesh's `table` axis must be INVISIBLE to every
+consumer —
+
+  * the routed-gather evaluator (`make_partitioned_evaluator`) is
+    bit-identical to the replicated evaluator and the host oracle on
+    the full verdict/counter/telemetry surface at table-axis sizes
+    {1, 2, 4};
+  * a delta publish on a partitioned store scatters each payload into
+    the OWNING chip's shard only: after every churn step each chip's
+    resident slice equals the corresponding host-compile slice, and
+    bytes_h2d stays proportional to the change (no full-table
+    re-upload on rule-only churn);
+  * per-chip resident bytes obey the headroom model: sharded leaves
+    divide by num_shards, replicated leaves repeat.
+
+Runs on the 8-virtual-device CPU mesh forced by conftest.py.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from cilium_tpu.compiler import partition
+from cilium_tpu.compiler.tables import (
+    FleetCompiler,
+    compile_map_states,
+)
+from cilium_tpu.engine.oracle import evaluate_batch_oracle
+from cilium_tpu.engine.sharded import (
+    make_mesh_evaluator,
+    make_partitioned_evaluator,
+    make_partitioned_store,
+)
+from cilium_tpu.engine.verdict import (
+    TELEM_COLS,
+    TupleBatch,
+    _verdict_kernel_with_counters,
+    telemetry_masks,
+)
+from cilium_tpu.maps.policymap import (
+    INGRESS,
+    PolicyKey,
+    PolicyMapStateEntry,
+)
+
+from tests.test_verdict_engine import random_map_state, random_tuples
+
+WIDE_IDS = [1, 2, 3, 4, 5] + [256 + i for i in range(120)] + [65536, 70000]
+
+
+def _mesh(dp, tp):
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must force 8 virtual devices"
+    return jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+
+
+def _build(seed, n_eps=3, identity_pad=256, batch=768):
+    rng = np.random.default_rng(seed)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(n_eps)
+    ]
+    tables = compile_map_states(
+        states, WIDE_IDS, identity_pad=identity_pad, filter_pad=16
+    )
+    t = random_tuples(rng, batch, n_eps, WIDE_IDS)
+    return states, tables, t
+
+
+# ---------------------------------------------------------------------------
+# the declarative rule layer
+# ---------------------------------------------------------------------------
+
+
+def test_match_partition_rules_first_match_and_fallback():
+    rules = [
+        (r"^l3_allow_bits$", P(None, None, "table")),
+        (r".*", P()),
+    ]
+    leaves = [np.zeros((2, 2, 8), np.uint32), np.zeros(4, np.uint32)]
+    specs = partition.match_partition_rules(
+        rules, ["l3_allow_bits", "id_table"], leaves
+    )
+    assert specs == [P(None, None, "table"), P()]
+
+
+def test_match_partition_rules_scalars_never_partition():
+    rules = [(r".*", P("table"))]
+    specs = partition.match_partition_rules(
+        rules,
+        ["generation", "one_elem", "none_leaf"],
+        [np.uint64(7), np.zeros((1,), np.uint32), None],
+    )
+    assert specs == [P(), P(), P()]
+
+
+def test_match_partition_rules_unmatched_raises():
+    with pytest.raises(ValueError, match="partition rule not found"):
+        partition.match_partition_rules(
+            [(r"^only_this$", P())],
+            ["something_else"],
+            [np.zeros(8, np.uint32)],
+        )
+
+
+def test_default_rules_shard_identity_major_leaves_only():
+    _, tables, _ = _build(seed=0)
+    specs = partition.policy_partition_specs(tables)
+    assert specs.l4_hash_rows == P("table")
+    assert specs.l3_allow_bits == P(None, None, "table")
+    assert specs.l4_allow_bits == P(None, None, None, "table")
+    # the small planes stay replicated — the explicit fallback
+    for leaf in (
+        "id_table", "id_direct", "port_slot", "l4_meta",
+        "l4_hash_stash", "l4_wild_rows", "l4_wild_stash",
+    ):
+        assert getattr(specs, leaf) == P(), leaf
+
+
+def test_divisibility_fallback_replicates_odd_leaves():
+    """A leaf whose sharded axis does not split evenly falls back to
+    replicated — the store and the evaluator must agree on layout, so
+    the decision lives in the rule layer."""
+    _, tables, _ = _build(seed=0)
+    # ntp=5 divides neither the 64 hash rows nor the 8 l3 words
+    specs = partition.divisible_partition_specs(tables, 5)
+    assert specs.l4_hash_rows == P()
+    assert specs.l3_allow_bits == P()
+    # ntp=4 divides both
+    specs = partition.divisible_partition_specs(tables, 4)
+    assert specs.l4_hash_rows == P("table")
+    assert specs.l3_allow_bits == P(None, None, "table")
+
+
+def test_partition_digest_is_rule_table_data():
+    d1 = partition.partition_digest(partition.default_table_rules())
+    d2 = partition.partition_digest(partition.default_table_rules())
+    assert d1 == d2 and 0 < d1 <= 0xFFFFFFFF
+    other = partition.partition_digest(
+        partition.default_table_rules("other_axis")
+    )
+    assert other != d1
+
+
+def test_alltoall_bytes_model():
+    assert partition.alltoall_bytes_per_tuple(1) == 0.0
+    assert partition.alltoall_bytes_per_tuple(4) == 12.0
+
+
+def test_named_tree_map_real_key_paths():
+    """For dict/list pytrees the rule layer can match REAL key paths
+    (the t5x named_tree_map form); the registered table dataclasses
+    flatten positionally and use the *_LEAF_NAMES tables instead."""
+    tree = {"a": np.zeros(4), "sub": {"b": np.ones(2), "c": [np.ones(1)]}}
+    seen = {}
+    partition.named_tree_map(
+        lambda name, leaf: seen.setdefault(name, leaf.shape), tree
+    )
+    assert seen == {"a": (4,), "sub/b": (2,), "sub/c/0": (1,)}
+
+
+def test_ipcache_partition_specs_both_forms():
+    """The bucketized IPCacheDevice shards its /32 bucket plane; the
+    DIR-24-8 fallback form replicates everything (the rule table for
+    the ROADMAP's ipcache-plane sharding follow-on)."""
+    from cilium_tpu.ipcache.lpm import IPCacheDevice, build_ipcache, build_lpm
+
+    dev = build_ipcache({"10.0.0.1/32": 7, "10.1.0.0/16": 9})
+    assert isinstance(dev, IPCacheDevice)
+    specs = partition.ipcache_partition_specs(dev)
+    assert specs.buckets == P("table")
+    assert specs.stash == P()
+    assert specs.range_rows == P()
+
+    lpm = build_lpm({"10.0.0.1/32": 7})
+    lpm_specs = partition.ipcache_partition_specs(lpm)
+    assert all(
+        s == P() for s in lpm_specs.tree_flatten()[0]
+    )
+
+
+def test_partitioned_evaluator_rejects_stale_geometry():
+    """The routing mask is a closure constant of the build-time
+    shapes: calling the evaluator with a re-grown hash plane must
+    raise instead of silently masking buckets with stale geometry."""
+    import dataclasses
+
+    _, tables, t = _build(seed=0)
+    ev = make_partitioned_evaluator(_mesh(2, 4), tables)
+    grown = dataclasses.replace(
+        tables,
+        l4_hash_rows=np.vstack(
+            [tables.l4_hash_rows, tables.l4_hash_rows]
+        ),
+    )
+    with pytest.raises(ValueError, match="geometry"):
+        ev(grown, TupleBatch.from_numpy(**t))
+
+
+# ---------------------------------------------------------------------------
+# routed-gather evaluator bit-identity (table-axis sizes 1, 2, 4)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp,tp", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_partitioned_matches_oracle_and_replicated(dp, tp, seed):
+    """The full output surface — every verdict column, both counter
+    tensors — bit-identical to the host oracle, the single-device
+    kernel, and the replicated mesh evaluator."""
+    states, tables, t = _build(seed)
+    mesh = _mesh(dp, tp)
+    batch = TupleBatch.from_numpy(**t)
+
+    want_allow, want_proxy, want_kind = evaluate_batch_oracle(
+        copy.deepcopy(states), **t
+    )
+    ref_v, ref_l4, ref_l3 = jax.jit(_verdict_kernel_with_counters)(
+        tables, batch
+    )
+    repl_v, repl_l4, repl_l3 = make_mesh_evaluator(mesh)(tables, batch)
+
+    got_v, got_l4, got_l3 = make_partitioned_evaluator(mesh, tables)(
+        tables, batch
+    )
+    np.testing.assert_array_equal(np.asarray(got_v.allowed), want_allow)
+    np.testing.assert_array_equal(
+        np.asarray(got_v.proxy_port), want_proxy
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_v.match_kind), want_kind
+    )
+    for got, ref, repl in (
+        (got_l4, ref_l4, repl_l4),
+        (got_l3, ref_l3, repl_l3),
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(repl)
+        )
+    # not vacuous
+    assert int(np.asarray(got_l4).sum() + np.asarray(got_l3).sum()) > 0
+
+
+@pytest.mark.parametrize("dp,tp", [(8, 1), (2, 4)])
+def test_partitioned_telemetry_bit_identical(dp, tp):
+    """collect_telemetry over sharded tables: per-batch-shard rows
+    equal the host telemetry_masks fold of that shard's slice and the
+    chip-sum equals the whole-batch fold — same contract as the
+    replicated evaluator's."""
+    states, tables, t = _build(seed=5)
+    mesh = _mesh(dp, tp)
+    batch = TupleBatch.from_numpy(**t)
+    v, _, _, per_chip = make_partitioned_evaluator(
+        mesh, tables, collect_telemetry=True
+    )(tables, batch)
+    per_chip = np.asarray(per_chip).astype(np.uint64)
+    assert per_chip.shape == (dp, 2, TELEM_COLS)
+
+    allowed = np.asarray(v.allowed)
+    kind = np.asarray(v.match_kind)
+    proxy = np.asarray(v.proxy_port)
+    dirs = np.asarray(t["direction"])
+    z = np.zeros(len(allowed), np.int32)
+    masks = telemetry_masks(z, z, kind, allowed, z, proxy, z, z, xp=np)
+    shard = len(allowed) // dp
+    for chip in range(dp):
+        sl = slice(chip * shard, (chip + 1) * shard)
+        for d in (0, 1):
+            in_dir = dirs[sl] == d
+            for c, m in enumerate(masks):
+                assert per_chip[chip, d, c] == int(
+                    np.sum(m[sl] & in_dir)
+                ), (chip, d, c)
+
+
+def test_partitioned_requires_hashed_tables():
+    _, tables, _ = _build(seed=0)
+    import dataclasses
+
+    dense = dataclasses.replace(
+        tables, l4_hash_rows=None, l4_hash_stash=None,
+        l4_wild_rows=None, l4_wild_stash=None,
+    )
+    with pytest.raises(ValueError, match="hashed L4 entry"):
+        make_partitioned_evaluator(_mesh(2, 4), dense)
+
+
+def test_partitioned_indivisible_universe_still_correct():
+    """identity_pad=160 → 5 bit-words: indivisible by tp=2, so the L3
+    plane replicates (rule-layer fallback) while the 64 hash rows
+    still shard — mixed layouts must stay bit-identical too."""
+    states, tables, t = _build(seed=2, identity_pad=160)
+    assert tables.l3_allow_bits.shape[-1] == 5
+    mesh = _mesh(4, 2)
+    specs = partition.divisible_partition_specs(tables, 2)
+    assert specs.l3_allow_bits == P()
+    assert specs.l4_hash_rows == P("table")
+    batch = TupleBatch.from_numpy(**t)
+    want_allow, want_proxy, want_kind = evaluate_batch_oracle(
+        copy.deepcopy(states), **t
+    )
+    got, _, _ = make_partitioned_evaluator(mesh, tables)(tables, batch)
+    np.testing.assert_array_equal(np.asarray(got.allowed), want_allow)
+    np.testing.assert_array_equal(
+        np.asarray(got.proxy_port), want_proxy
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.match_kind), want_kind
+    )
+
+
+# ---------------------------------------------------------------------------
+# partitioned store: shard-local delta publication
+# ---------------------------------------------------------------------------
+
+SHARDED_LEAVES = (
+    ("l3_allow_bits", 2),
+    ("l4_allow_bits", 3),
+    ("l4_hash_rows", 0),
+)
+CHECK_LEAVES = (
+    "id_table", "id_direct", "id_lo_len", "port_slot", "l4_meta",
+    "l4_allow_bits", "l3_allow_bits", "l4_hash_rows",
+    "l4_hash_stash", "l4_wild_rows", "l4_wild_stash",
+)
+
+
+def _table_col(mesh, device_id):
+    """Mesh column (table-axis ordinal) of a device id."""
+    pos = {
+        int(d.id): tuple(idx)
+        for idx, d in np.ndenumerate(mesh.devices)
+    }
+    return pos[int(device_id)][1]
+
+
+def _assert_shards_match_host(mesh, dev, tables, ntp):
+    """Every chip's resident slice of each sharded leaf equals the
+    owning slice of the host compile; every leaf equals the host
+    compile globally (generation excluded: u64→u32 device
+    truncation, see DeviceTableStore._norm)."""
+    for leaf in CHECK_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dev, leaf)),
+            np.asarray(getattr(tables, leaf)),
+            err_msg=leaf,
+        )
+    for leaf, axis in SHARDED_LEAVES:
+        h = np.asarray(getattr(tables, leaf))
+        d = getattr(dev, leaf)
+        if h.shape[axis] % ntp != 0:
+            continue  # rule layer fell back to replicated
+        n = h.shape[axis] // ntp
+        for sh in d.addressable_shards:
+            col = _table_col(mesh, sh.device.id)
+            sl = [slice(None)] * h.ndim
+            sl[axis] = slice(col * n, (col + 1) * n)
+            np.testing.assert_array_equal(
+                np.asarray(sh.data), h[tuple(sl)],
+                err_msg=f"{leaf} shard on device {sh.device.id}",
+            )
+
+
+def test_partitioned_store_delta_lands_on_owning_shard():
+    """60-step rule churn against a partitioned store: every
+    steady-state publish takes the delta path, every chip's resident
+    slice stays equal to the host compile's owning slice, and the
+    total bytes shipped stay far below one full upload."""
+    rng = np.random.default_rng(3)
+    mesh = _mesh(2, 4)
+    ntp = 4
+    store = make_partitioned_store(mesh)
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(3)
+    ]
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            WIDE_IDS,
+        )[0]
+
+    # prime both epochs + the scatter jit classes
+    store.publish(compile_eps())
+    store.publish(compile_eps())
+
+    ids = list(WIDE_IDS)
+    full_bytes = None
+    delta_bytes = 0
+    n_delta = 0
+    for step in range(60):
+        base = store.spare_stamp()
+        ep = step % 3
+        kind = step % 4
+        if kind == 3:
+            # remove one L4 rule (rule-only churn, different shape)
+            l4_keys = [
+                k for k in states[ep] if not k.is_l3_only()
+            ]
+            if l4_keys:
+                del states[ep][l4_keys[step % len(l4_keys)]]
+        else:
+            states[ep][
+                PolicyKey(
+                    int(rng.choice(ids)), 5000 + step, 6, INGRESS
+                )
+            ] = PolicyMapStateEntry()
+        tables = compile_eps()
+        delta = fc.delta_for(base, tables)
+        dev, st = store.publish(tables, delta)
+        from cilium_tpu.compiler.delta import tables_nbytes
+
+        full_bytes = tables_nbytes(tables)
+        if st.mode == "delta":
+            n_delta += 1
+            delta_bytes += st.bytes_h2d
+            assert st.bytes_h2d < full_bytes / 10
+        if step % 6 == 0 or step == 59:
+            _assert_shards_match_host(mesh, dev, tables, ntp)
+    # rule-only churn must ride the delta path, not full re-uploads
+    assert n_delta >= 55, n_delta
+    assert delta_bytes < full_bytes, (delta_bytes, full_bytes)
+
+
+def test_partitioned_store_per_chip_bytes_bound():
+    """Acceptance bound: per-chip resident bytes ≤ replicated bytes /
+    num_shards + replicated-leaf overhead (per epoch), and every chip
+    carries the same load (equal slices)."""
+    _, tables, _ = _build(seed=9)
+    mesh = _mesh(2, 4)
+    store = make_partitioned_store(mesh)
+    store.publish(tables)
+    per_chip = store.chip_bytes()
+    assert set(per_chip) == {int(d.id) for d in mesh.devices.flat}
+    vals = sorted(per_chip.values())
+    assert vals[0] == vals[-1]  # equal row/word slices
+
+    from cilium_tpu.compiler.delta import tables_nbytes
+
+    full = tables_nbytes(tables)
+    rows, per_chip_model, replicated = partition.shard_bytes_model(
+        tables, 4
+    )
+    # one epoch resident (the measured generation scalar is 4 bytes
+    # on device — u64→u32 without jax x64 — vs 8 in the host model)
+    assert vals[0] <= full // 4 + replicated
+    assert abs(vals[0] - per_chip_model) <= 8
+    sharded_bytes = sum(
+        r["bytes_total"] for r in rows if r["sharded"]
+    )
+    assert full == pytest.approx(sharded_bytes + replicated)
+    # the model's headroom line grows with the shard count
+    assert partition.universe_max_identities(
+        tables, 8
+    ) > partition.universe_max_identities(tables, 1)
+
+
+def test_partition_digest_gates_delta_publish():
+    """A delta recorded under one partitioning must not scatter into
+    an epoch laid out under another: flipping the store's rule-table
+    digest between publishes forces the full-upload fallback."""
+    rng = np.random.default_rng(4)
+    mesh = _mesh(2, 4)
+    store = make_partitioned_store(mesh)
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(2)
+    ]
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            WIDE_IDS,
+        )[0]
+
+    store.publish(compile_eps())
+    store.publish(compile_eps())
+    base = store.spare_stamp()
+    states[0][PolicyKey(1, 7777, 6, INGRESS)] = PolicyMapStateEntry()
+    tables = compile_eps()
+    delta = fc.delta_for(base, tables)
+    assert delta is not None
+    store.partition_digest ^= 0x5A5A5A5A  # rule table changed
+    _, st = store.publish(tables, delta)
+    assert st.mode == "full"

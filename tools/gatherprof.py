@@ -58,11 +58,14 @@ def main() -> None:
     ap.add_argument("--pool", type=int, default=5000)
     ap.add_argument("--batch", type=int, default=1 << 16)
     ap.add_argument(
-        "--budget-bytes", type=float, default=1400.0,
+        "--budget-bytes", type=float, default=2100.0,
         help="hot-plane bytes-gathered-per-tuple budget (assert): "
-        "the packed layout sits ~1.3 KB/tuple (CT row 512 + two "
-        "64-lane hash rows 512 + LB/ipcache/IO), the legacy "
-        "unsplit layout ~1.9 KB",
+        "the packed layout sits ~2.0 KB/tuple (CT row 512 + ipcache "
+        "bucket row 512 + hashed range classes + two 64-lane hash "
+        "rows 512 + LB/IO), the legacy unsplit layout ~2.5 KB — the "
+        "ipcache bucket row and the per-prefix-length-class range "
+        "gathers are priced since the [B, P] range broadcast became "
+        "row gathers",
     )
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()

@@ -1,0 +1,244 @@
+"""Per-chip table bytes + shard imbalance under the partition rules.
+
+The identity-sharded layout (compiler/partition.py) only buys
+capacity if the per-chip slices stay BALANCED: equal byte slices by
+construction, and near-equal hashed-entry loads because identities
+spread uniformly by hash.  This tool extends tools/gatherprof.py's
+bytes-moved model to the sharded dimension — it dumps, per shard
+count:
+
+  * the per-leaf bytes model (sharded leaves divide, replicated ones
+    repeat) and the per-chip total vs the replicated layout;
+  * the `universe_max_identities` headroom line bench emits;
+  * MEASURED per-chip resident bytes from a real partitioned store
+    publish on the virtual CPU mesh (both epoch slots);
+  * the hashed-row occupied-entry load per shard slice,
+
+and asserts max/min shard skew ≤ --skew-bound (default 1.5×) for
+both the measured bytes and the entry loads.
+
+Usage:
+    python tools/shardprof.py [--shards 2 4 8] [--identities 8192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_world(n_identities: int, n_endpoints: int, n_rules: int):
+    """Synthetic fleet at identity-major scale: enough L4 entries
+    that the hashed rows dominate, enough identities that the bit
+    planes stretch over many words."""
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.maps.policymap import (
+        EGRESS,
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    rng = np.random.default_rng(11)
+    ids = [1, 2] + [256 + i for i in range(n_identities - 2)]
+    states = []
+    for _ in range(n_endpoints):
+        state = {}
+        for _ in range(n_rules):
+            ident = int(rng.choice(ids))
+            if rng.random() < 0.25:
+                state[PolicyKey(ident, 0, 0, INGRESS)] = (
+                    PolicyMapStateEntry()
+                )
+            else:
+                state[
+                    PolicyKey(
+                        ident,
+                        int(rng.integers(1, 30000)),
+                        int(rng.choice([6, 17])),
+                        int(rng.integers(0, 2)) and EGRESS or INGRESS,
+                    )
+                ] = PolicyMapStateEntry()
+        states.append(state)
+    return compile_map_states(
+        states, ids, identity_pad=1024, filter_pad=64
+    )
+
+
+def entry_load_per_shard(rows: np.ndarray, ntp: int):
+    """Occupied hashed entries per table-axis shard slice (the key1
+    plane marks empty lanes with 0xFFFFFFFF)."""
+    e = rows.shape[1] // 3
+    occupied = rows[:, e : 2 * e] != np.uint32(0xFFFFFFFF)
+    n = rows.shape[0] // ntp
+    return [
+        int(occupied[i * n : (i + 1) * n].sum()) for i in range(ntp)
+    ]
+
+
+def skew(values) -> float:
+    lo = min(values)
+    return float(max(values)) / float(lo) if lo else float("inf")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, nargs="+", default=[2, 4, 8])
+    ap.add_argument("--identities", type=int, default=8192)
+    ap.add_argument("--endpoints", type=int, default=8)
+    ap.add_argument("--rules", type=int, default=2000)
+    ap.add_argument("--skew-bound", type=float, default=1.5)
+    ap.add_argument("--hbm-gb", type=float, default=16.0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.compiler.delta import tables_nbytes
+    from cilium_tpu.engine.sharded import make_partitioned_store
+
+    tables = build_world(
+        args.identities, args.endpoints, args.rules
+    )
+    full = tables_nbytes(tables)
+    hbm = int(args.hbm_gb * (1 << 30))
+    report = {"replicated_bytes_per_chip": full, "shards": []}
+    devs = jax.devices()
+
+    for ntp in args.shards:
+        rows, per_chip_model, replicated = (
+            partition.shard_bytes_model(tables, ntp)
+        )
+        entry = {
+            "num_shards": ntp,
+            "bytes_per_chip_model": per_chip_model,
+            "replicated_leaf_overhead": replicated,
+            "universe_max_identities": (
+                partition.universe_max_identities(
+                    tables, ntp, hbm_bytes=hbm
+                )
+            ),
+            "alltoall_bytes_per_tuple": (
+                partition.alltoall_bytes_per_tuple(ntp)
+            ),
+            "leaves": rows,
+        }
+        # hashed-entry load balance across the row slices — only when
+        # the row count splits evenly; otherwise the rule layer
+        # replicates the leaf and there is no split to gate
+        hash_rows = np.asarray(tables.l4_hash_rows)
+        if hash_rows.shape[0] % ntp == 0:
+            loads = entry_load_per_shard(hash_rows, ntp)
+            entry["entry_load_per_shard"] = loads
+            entry["entry_load_skew"] = round(skew(loads), 3)
+        else:
+            entry["entry_load_per_shard"] = None
+            entry["entry_load_skew"] = None
+        # measured per-chip bytes from a real partitioned publish
+        if len(devs) % ntp == 0:
+            mesh = jax.sharding.Mesh(
+                np.array(devs).reshape(len(devs) // ntp, ntp),
+                ("batch", "table"),
+            )
+            store = make_partitioned_store(mesh)
+            store.publish(tables)
+            per_chip = store.chip_bytes()
+            entry["bytes_per_chip_measured"] = dict(
+                sorted((str(k), v) for k, v in per_chip.items())
+            )
+            entry["bytes_skew"] = round(
+                skew(list(per_chip.values())), 3
+            )
+        report["shards"].append(entry)
+
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(
+            f"replicated layout: {full / 1e6:.1f} MB on EVERY chip"
+        )
+        for entry in report["shards"]:
+            ntp = entry["num_shards"]
+            print(f"--- {ntp} shards ---")
+            for r in entry["leaves"]:
+                tag = "shard" if r["sharded"] else "repl "
+                print(
+                    f"  {r['leaf']:15s} {tag} "
+                    f"{r['bytes_total'] / 1e6:9.2f} MB total "
+                    f"{r['bytes_per_chip'] / 1e6:9.2f} MB/chip"
+                )
+            print(
+                f"  per-chip {entry['bytes_per_chip_model'] / 1e6:.1f}"
+                f" MB (repl overhead "
+                f"{entry['replicated_leaf_overhead'] / 1e6:.1f} MB), "
+                f"universe_max_identities "
+                f"{entry['universe_max_identities']:,} @ "
+                f"{args.hbm_gb:.0f} GB HBM, alltoall "
+                f"{entry['alltoall_bytes_per_tuple']:.0f} B/tuple"
+            )
+            if entry["entry_load_per_shard"] is not None:
+                print(
+                    f"  entry load/shard "
+                    f"{entry['entry_load_per_shard']}"
+                    f" (skew {entry['entry_load_skew']}x)"
+                )
+            else:
+                print(
+                    "  entry load/shard: rows indivisible — "
+                    "l4_hash_rows replicates at this shard count"
+                )
+            if "bytes_skew" in entry:
+                vals = list(
+                    entry["bytes_per_chip_measured"].values()
+                )
+                print(
+                    f"  measured bytes/chip {vals[0] / 1e6:.1f} MB "
+                    f"(skew {entry['bytes_skew']}x, both epochs)"
+                )
+
+    for entry in report["shards"]:
+        if entry["entry_load_skew"] is not None:
+            assert entry["entry_load_skew"] <= args.skew_bound, (
+                f"{entry['num_shards']}-shard hashed-entry load skew "
+                f"{entry['entry_load_skew']}x over the "
+                f"{args.skew_bound}x bound"
+            )
+        if "bytes_skew" in entry:
+            assert entry["bytes_skew"] <= args.skew_bound, (
+                f"{entry['num_shards']}-shard byte skew over bound"
+            )
+        # the acceptance bound: per-chip ≤ replicated/num_shards +
+        # replicated-leaf overhead — asserted for the model AND the
+        # measured resident bytes (one published epoch)
+        bound = (
+            full // entry["num_shards"]
+            + entry["replicated_leaf_overhead"]
+        )
+        assert entry["bytes_per_chip_model"] <= bound
+        if "bytes_per_chip_measured" in entry:
+            measured = max(
+                entry["bytes_per_chip_measured"].values()
+            )
+            assert measured <= bound, (
+                f"{entry['num_shards']}-shard measured per-chip "
+                f"{measured} over the acceptance bound {bound}"
+            )
+    print("shardprof OK")
+
+
+if __name__ == "__main__":
+    main()
