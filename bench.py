@@ -1486,6 +1486,148 @@ def run_config5(args) -> None:
 
 
 # ---------------------------------------------------------------------------
+# per-chip failover bench: degraded throughput + re-admission cost
+# ---------------------------------------------------------------------------
+
+
+def run_failover_bench(args) -> None:
+    """The per-chip failure domain's two bench lines:
+
+      * degraded_verdicts_per_sec_per_chip — sustained throughput per
+        SURVIVING chip with one chip's breaker open (its batch shard
+        re-split across survivors, its table rows served from the
+        N+1 replicas); the companion fields carry the healthy
+        baseline so the trajectory shows the retention ratio, which
+        should sit near (N-1)/N of healthy per-chip throughput;
+      * readmit_rebalance_ms — wall time of the half-open
+        re-admission rebalance (replaying the rows the chip missed
+        through the delta-scatter path), with its bytes_h2d against
+        the full-upload comparator.
+
+    Runs on whatever mesh the process sees at bench startup (the
+    driver's multi-chip box).  A single-device environment has no
+    chip to lose and emits a skip marker — on a plain CPU box that
+    is the expected outcome: jax is already initialized by the
+    config-5 headline before this runs, so the chaos tools'
+    xla_force_host_platform_device_count virtual mesh cannot take
+    effect here (use tools/chaos_storm.py --mesh, a fresh process,
+    for the virtual-mesh exercise)."""
+    import jax
+
+    from cilium_tpu import faultinject
+    from cilium_tpu.compiler.delta import tables_nbytes
+    from cilium_tpu.engine.failover import ChipFailoverRouter
+    from cilium_tpu.engine.oracle import evaluate_batch_oracle
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+    from cilium_tpu.resilience import ChipBreakerBank
+    from tools.chaos_storm import _mesh_tuples, _mesh_world
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2 or n % 2:
+        emit(
+            "degraded_verdicts_per_sec_per_chip", 0, "verdicts/s",
+            skipped=f"{n} device(s): no chip to lose",
+        )
+        return
+    tp = 2
+    dp = n // tp
+    mesh = jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+    rng = np.random.default_rng(3)
+    states, ids, fc, compile_eps = _mesh_world(
+        seed=3, n_eps=8, identity_pad=1024
+    )
+    tables = compile_eps()
+    bank = ChipBreakerBank(
+        recovery_timeout=0.05, failure_threshold=1
+    )
+    router = ChipFailoverRouter(mesh, tables, bank=bank)
+    router.publish(tables)
+    router.publish(compile_eps())
+    b = 1 << 14
+    tuples = _mesh_tuples(rng, b, len(states), ids)
+    reps = 6
+
+    def loop():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            res = router.dispatch(**tuples)
+        return reps * b / (time.perf_counter() - t0), res
+
+    router.dispatch(**tuples)  # warmup (jit)
+    healthy_vps, res = loop()
+    # bit-identity gate before timing means anything
+    want = evaluate_batch_oracle(
+        [dict(s) for s in states], **tuples
+    )
+    assert np.array_equal(res.verdicts.allowed, want[0])
+
+    victim = int(router.ordinals[dp - 1, tp - 1])
+    faultinject.arm("engine.dispatch", f"raise:chip={victim}")
+    try:
+        router.dispatch(**tuples)  # trips the breaker + retrace
+        degraded_vps, res_deg = loop()
+    finally:
+        faultinject.disarm("engine.dispatch")
+    assert np.array_equal(res_deg.verdicts.allowed, want[0])
+    survivors = n - 1
+    emit(
+        "degraded_verdicts_per_sec_per_chip",
+        round(degraded_vps / survivors),
+        "verdicts/s",
+        chips=n,
+        survivors=survivors,
+        healthy_verdicts_per_sec_per_chip=round(healthy_vps / n),
+        retention_pct=round(
+            100.0 * (degraded_vps / survivors)
+            / max(healthy_vps / n, 1e-9),
+            1,
+        ),
+        replica_hits=res_deg.replica_hits,
+        note=(
+            "per-surviving-chip throughput with one chip's breaker "
+            "open: batch shard re-split across survivors, table "
+            "rows served from N+1 replicas, verdicts bit-identical "
+            "to the healthy mesh"
+        ),
+    )
+
+    # churn one delta while the chip is out, then time re-admission
+    base = router.store.spare_stamp()
+    states[0][
+        PolicyKey(int(ids[0]), 7321, 6, INGRESS)
+    ] = PolicyMapStateEntry()
+    fresh = compile_eps()
+    delta = fc.delta_for(base, fresh)
+    router.publish(fresh, delta)
+    time.sleep(bank.recovery_timeout * 2)
+    res_back = router.dispatch(**tuples)
+    assert victim in res_back.rebalanced_chips, (
+        "re-admission did not rebalance the victim chip"
+    )
+    full = tables_nbytes(fresh)
+    emit(
+        "readmit_rebalance_ms",
+        round(res_back.rebalance_ms, 2),
+        "ms",
+        rebalance_bytes_h2d=res_back.rebalance_bytes,
+        full_upload_bytes=int(full),
+        missed_deltas=1,
+        note=(
+            "half-open re-admission: the rows the chip missed "
+            "while out replay through the delta-scatter path "
+            "(bytes strictly below a full upload)"
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
 # config 5 combined: fused datapath + inline L7 (the datapath+proxy
 # system, envoy/cilium_l7policy.cc:193 / pkg/proxy/kafka.go:116)
 # ---------------------------------------------------------------------------
@@ -2407,6 +2549,9 @@ def main() -> None:
     configs = {c.strip() for c in args.configs.split(",")}
     if "5" in configs:
         run_config5(args)
+        # the per-chip failover lines ride config 5 (cheap: a small
+        # dedicated world, not the 50k-rule fleet)
+        run_failover_bench(args)
     if "1" in configs:
         config1()
     if "2" in configs:

@@ -88,7 +88,7 @@ class DaemonAPI:
         try:
             probes = probe_endpoints(self.daemon.endpoint_manager)
             reachable = sum(1 for p in probes if p.reachable)
-            return {
+            out = {
                 "status": health["status"],
                 "reasons": health["reasons"],
                 "breaker": health["breaker"],
@@ -97,6 +97,12 @@ class DaemonAPI:
                 "endpoints": len(probes),
                 "reachable": reachable,
             }
+            if "chips" in health:
+                # per-chip breaker states (mesh failover router
+                # attached): which ordinal is out, not just
+                # "degraded"
+                out["chips"] = health["chips"]
+            return out
         except Exception as exc:
             return {
                 "status": "degraded",

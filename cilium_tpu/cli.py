@@ -546,7 +546,10 @@ def make_parser() -> argparse.ArgumentParser:
     farm.add_argument("site", help="e.g. engine.dispatch")
     farm.add_argument(
         "spec", nargs="?", default="raise",
-        help='schedule, e.g. "raise:next=3", "hang:delay=0.5"',
+        help='schedule, e.g. "raise:next=3", "hang:delay=0.5"; '
+        'add chip=<ordinal> to kill exactly one mesh chip '
+        '("raise:chip=3" — only the failover router\'s per-chip '
+        "attribution probes see it)",
     )
     farm.set_defaults(func=cmd_fault_arm)
     fdisarm = fsub.add_parser("disarm")

@@ -236,6 +236,201 @@ def table_shardings(mesh: Mesh, tables, table_axis: str = TABLE_AXIS):
     return type(tables).tree_unflatten(None, out)
 
 
+# -- N+1 shard replicas (per-chip failover placement) ------------------------
+#
+# The t5x lesson — placement rules are DATA — applied to failure
+# domains: each identity-sharded leaf's rows also live on a BACKUP
+# owner, the next shard over (slice i's replica sits on shard
+# (i+1) % ntp), so a chip whose breaker opens takes down one COPY of
+# its rows, not the rows themselves.  The failover evaluator
+# (engine/sharded.make_failover_evaluator) routes a tuple's gather to
+# the backup region when the primary owner is dead; the host lattice
+# fold remains the terminal fallback only when primary AND backup are
+# both gone.
+#
+# Replication applies to the leaves the ROUTED evaluator gathers —
+# the hashed L4 entry rows and the L3 bit-words.  The dense
+# l4_allow_bits fallback plane stays single-sharded: nothing on the
+# hashed hot path reads it, and doubling the largest leaf would spend
+# the replica HBM budget on rows no routed gather can reach.
+
+REPLICA_LEAVES = ("l4_hash_rows", "l3_allow_bits")
+# slice i's backup owner is shard (i + REPLICA_BACKUP_OFFSET) % ntp
+REPLICA_BACKUP_OFFSET = 1
+
+
+def replica_axes(tables, ntp: int, table_axis: str = TABLE_AXIS):
+    """{leaf name: sharded-axis position} for the leaves the replica
+    layout augments: REPLICA_LEAVES that the divisibility-checked
+    rule layer actually shards at `ntp` (an indivisible leaf falls
+    back to replicated and needs no backup copy)."""
+    specs = divisible_partition_specs(tables, ntp, table_axis)
+    out = {}
+    for name in REPLICA_LEAVES:
+        spec = getattr(specs, name)
+        for axis, ax_name in enumerate(spec):
+            if ax_name == table_axis:
+                out[name] = axis
+                break
+    return out
+
+
+def replicate_shard_axis(arr, ntp: int, axis: int):
+    """Augment one sharded leaf with its backup copies: the sharded
+    axis [S] becomes [2S], laid out per shard q as
+    [primary slice q ; copy of slice (q - 1) % ntp] — so a
+    NamedSharding along the same axis gives every chip its own rows
+    plus its left neighbour's, and the in-kernel backup gather is
+    `n + (i mod n)` on shard (owner + 1) % ntp."""
+    arr = np.asarray(arr)
+    n = arr.shape[axis] // ntp
+    slices = [
+        np.take(arr, np.arange(q * n, (q + 1) * n), axis=axis)
+        for q in range(ntp)
+    ]
+    parts = []
+    for q in range(ntp):
+        parts.append(slices[q])
+        parts.append(slices[(q - REPLICA_BACKUP_OFFSET) % ntp])
+    return np.concatenate(parts, axis=axis)
+
+
+def replicate_table_leaves(tables, ntp: int,
+                           table_axis: str = TABLE_AXIS):
+    """PolicyTables with every replica-rule leaf augmented (the
+    device layout the replica store publishes); non-replica leaves
+    pass through untouched."""
+    import dataclasses
+
+    axes = replica_axes(tables, ntp, table_axis)
+    return dataclasses.replace(
+        tables,
+        **{
+            name: replicate_shard_axis(
+                getattr(tables, name), ntp, axis
+            )
+            for name, axis in axes.items()
+        },
+    )
+
+
+def replica_positions(idx, n: int, ntp: int):
+    """Map original global sharded-axis indices to their two
+    positions in the augmented layout: (primary, backup)."""
+    idx = np.asarray(idx)
+    shard = idx // n
+    within = idx % n
+    primary = shard * (2 * n) + within
+    backup = (
+        ((shard + REPLICA_BACKUP_OFFSET) % ntp) * (2 * n)
+        + n
+        + within
+    )
+    return primary, backup
+
+
+def replica_delta(delta, tables, ntp: int,
+                  table_axis: str = TABLE_AXIS):
+    """Rewrite a TableDelta recorded against the un-augmented layout
+    into augmented coordinates, so one delta publish keeps primary
+    and backup copies bit-identical.  Two shapes of update exist:
+
+      * the scatter INDEXES the sharded axis (l4_hash_rows: idx[0]
+        is the bucket row) — every row lands twice, at its primary
+        and backup augmented positions, values repeated;
+      * the scatter indexes LEADING axes only and its values SPAN
+        the sharded axis (l3_allow_bits: idx is the endpoint, values
+        are whole [2, W] slabs) — the values augment along the
+        corresponding value axis, exactly as the resident leaf did.
+
+    Whole-leaf replacements of replica leaves ship in augmented
+    form; leaves outside the replica set pass through untouched."""
+    from cilium_tpu.compiler.delta import LeafUpdate, TableDelta
+
+    axes = replica_axes(tables, ntp, table_axis)
+    updates = {}
+    for name, up in delta.updates.items():
+        axis = axes.get(name)
+        if axis is None:
+            updates[name] = up
+            continue
+        n = getattr(tables, name).shape[axis] // ntp
+        if axis < len(up.idx):
+            primary, backup = replica_positions(
+                up.idx[axis], n, ntp
+            )
+            idx = tuple(
+                np.concatenate([primary, backup])
+                if i == axis
+                else np.concatenate([comp, comp])
+                for i, comp in enumerate(up.idx)
+            )
+            values = np.concatenate([up.values, up.values], axis=0)
+        else:
+            # leaf axis `axis` sits inside the values: idx consumes
+            # the first len(idx) leaf axes, the values' axis 0 is
+            # the scatter row, so leaf axis a maps to values axis
+            # a - len(idx) + 1
+            idx = up.idx
+            values = replicate_shard_axis(
+                up.values, ntp, axis - len(up.idx) + 1
+            )
+        updates[name] = LeafUpdate(idx=idx, values=values)
+    replace = {
+        name: (
+            replicate_shard_axis(arr, ntp, axes[name])
+            if name in axes
+            else arr
+        )
+        for name, arr in delta.replace.items()
+    }
+    return TableDelta(
+        base_stamp=delta.base_stamp,
+        new_stamp=delta.new_stamp,
+        updates=updates,
+        replace=replace,
+        layout=delta.layout,
+    )
+
+
+def replica_partition_digest(table_axis: str = TABLE_AXIS) -> int:
+    """Digest of the replica placement (rule table + replica set +
+    backup offset): a replica-layout epoch can never accept a delta
+    recorded under plain sharding, and vice versa."""
+    text = ";".join(
+        f"{pat}->{tuple(spec)}"
+        for pat, spec in default_table_rules(table_axis)
+    )
+    text += (
+        f";replicas={','.join(REPLICA_LEAVES)}"
+        f";backup_offset={REPLICA_BACKUP_OFFSET}"
+    )
+    return zlib.crc32(text.encode()) & 0xFFFFFFFF
+
+
+def replica_bytes_model(tables, num_shards: int,
+                        table_axis: str = TABLE_AXIS):
+    """shard_bytes_model under the N+1 replica layout: replica leaves
+    cost 2/num_shards per chip (their own slice + the neighbour's
+    backup copy), everything else as the plain sharded model.
+    Returns (rows, per_chip_total, replica_overhead_per_chip) where
+    the overhead is exactly the backup copies' bytes — the quantity
+    tools/shardprof.py bounds by sharded_bytes / num_shards."""
+    axes = replica_axes(tables, num_shards, table_axis)
+    rows, per_chip, _replicated = shard_bytes_model(
+        tables, num_shards, table_axis
+    )
+    overhead = 0
+    for r in rows:
+        if r["leaf"] in axes and r["sharded"]:
+            r["replicated_n_plus_1"] = True
+            overhead += r["bytes_per_chip"]
+            r["bytes_per_chip"] *= 2
+        else:
+            r["replicated_n_plus_1"] = False
+    return rows, per_chip + overhead, overhead
+
+
 # -- bytes / headroom models -------------------------------------------------
 
 

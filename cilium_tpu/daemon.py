@@ -226,6 +226,13 @@ class Daemon:
         # device table-publication backoff (monotonic deadline): a
         # failed epoch publish must not be retried per batch
         self._device_publish_retry_at = 0.0
+        # per-chip failure domain (engine/failover.py): when a mesh
+        # router is attached, its ChipBreakerBank's transitions flow
+        # through the same observability planes as the process-wide
+        # breaker (cilium_chip_breaker_state{chip} gauge, AgentNotify
+        # monitor events, health()/status() degraded reasons) — the
+        # mesh refinement of the dispatch breaker above
+        self.mesh_router = None
         # bounded admission: flows in flight across concurrent
         # process_flows calls; excess batches shed under the
         # canonical Overload drop reason (None = unbounded)
@@ -975,6 +982,31 @@ class Daemon:
                 "reason": reason,
             }},
         )
+
+    def attach_mesh_router(self, router) -> None:
+        """Adopt a ChipFailoverRouter (engine/failover.py): per-chip
+        breaker transitions publish AgentNotify monitor events beside
+        the router's own gauge/span-event wiring, and health() gains
+        per-chip degraded reasons — a mesh losing one chip reports
+        WHICH ordinal is out, not just "degraded"."""
+        from cilium_tpu.monitor.events import AgentNotify
+
+        self.mesh_router = router
+        outer = router._on_chip_transition
+
+        def _notify(ordinal, old, new, reason):
+            self.monitor.publish(
+                AgentNotify(
+                    kind="chip-breaker",
+                    text=(
+                        f"chip {ordinal}: {old} -> {new} ({reason})"
+                    ),
+                )
+            )
+            if outer is not None:
+                outer(ordinal, old, new, reason)
+
+        router._on_chip_transition = _notify
 
     def _dispatch_or_degrade(
         self, tables, batch, host_args, pad_to: int
@@ -1734,6 +1766,16 @@ class Daemon:
                 f"dispatch breaker {breaker_state}: device verdicts "
                 f"degraded to host path"
             )
+        chip_states = {}
+        if self.mesh_router is not None:
+            chip_states = self.mesh_router.chip_states()
+            for ordinal, state in chip_states.items():
+                if state != "closed":
+                    reasons.append(
+                        f"chip {ordinal} breaker {state}: its batch "
+                        f"shard re-splits across survivors and its "
+                        f"table rows serve from replicas"
+                    )
         for name, s in self.controllers.statuses().items():
             if (
                 s.consecutive_failures
@@ -1744,7 +1786,7 @@ class Daemon:
                     f"({s.consecutive_failures} consecutive: "
                     f"{s.last_error})"
                 )
-        return {
+        out = {
             "status": "degraded" if reasons else "ok",
             "reasons": reasons,
             "breaker": {
@@ -1754,6 +1796,11 @@ class Daemon:
             "degraded_batches": self.degraded_batches,
             "shed_flows": self.admission.shed_total,
         }
+        if self.mesh_router is not None:
+            out["chips"] = {
+                str(o): s for o, s in chip_states.items()
+            }
+        return out
 
     def status(self) -> Dict:
         version, tables, index = self.endpoint_manager.published()

@@ -1,0 +1,623 @@
+"""Per-chip failover: the shard router in front of the mesh
+evaluators.
+
+PR 2's resilience plane trips ONE process-wide breaker and fails the
+whole mesh over to the host fold; with the tables identity-sharded
+(PR 7) a single sick chip would take its table rows down with it.
+This module builds the per-chip failure domain on top of three
+pieces:
+
+  * a ChipBreakerBank (cilium_tpu.resilience) — one closed/open/
+    half-open breaker per device ordinal, fed by per-chip failure
+    attribution: before every launch the router probes the
+    `engine.dispatch` fault seam once PER ORDINAL (chip-scoped
+    selectors, faultinject `chip=` param), so a chaos schedule can
+    kill exactly one chip;
+  * the N+1 replica placement (compiler.partition.REPLICA_LEAVES +
+    engine.sharded.make_replica_store): each sharded leaf's rows
+    also live on a backup owner, the next shard over, and
+    make_failover_evaluator routes a dead primary's gathers to the
+    backup region — verdicts never read the sick chip's slice;
+  * batch re-splitting: a mesh row none of whose chips can serve a
+    slice (primary AND backup dead) is routed around — its tuple
+    shard re-splits across surviving rows, padding the dead row with
+    valid-masked filler so counters/telemetry count exactly the real
+    tuples.  The host lattice fold remains the TERMINAL fallback,
+    taken only when no row survives.
+
+Re-admission is a REBALANCE: a half-open probe first replays the
+rows the chip missed while out (the store's outage ledger, applied
+through the DeviceTableStore delta-scatter path — bytes proportional
+to the missed change, never a full upload), then the probe dispatch
+includes the chip; success closes its breaker.
+
+Simulation boundary: on the virtual CPU mesh the SPMD program still
+executes on a "dead" chip — what this layer proves (and the chaos
+storm asserts) is that no verdict, counter or telemetry bit DEPENDS
+on the dead chip's table slice (its primary regions can be garbage)
+and that the observable stream is bit-identical to the healthy mesh
+and the host oracle.  Re-forming the physical mesh around a truly
+absent device is the runtime's job on real hardware.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu import faultinject, tracing
+from cilium_tpu.engine.publish import next_pow2
+from cilium_tpu.logging import get_logger
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.resilience import (
+    HALF_OPEN,
+    STATE_CODES,
+    ChipBreakerBank,
+)
+
+log = get_logger("failover")
+
+
+@dataclass
+class FailoverResult:
+    """One batch through the router, stream order restored."""
+
+    verdicts: object  # engine.verdict.Verdicts (host numpy columns)
+    l4_counts: Optional[np.ndarray] = None
+    l3_counts: Optional[np.ndarray] = None
+    telemetry: Optional[np.ndarray] = None  # [dp, 2, T] or None
+    replica_hits: int = 0
+    rerouted: bool = False  # batch shard re-split across survivors
+    degraded: bool = False  # served by the terminal host fold
+    alive: Optional[np.ndarray] = None  # [dp, tp] snapshot
+    rebalanced_chips: Tuple[int, ...] = ()
+    rebalance_bytes: int = 0
+    rebalance_ms: float = 0.0
+
+
+@dataclass
+class RouterStats:
+    batches: int = 0
+    tuples: int = 0
+    rerouted_batches: int = 0
+    degraded_batches: int = 0
+    replica_hits: int = 0
+    rebalances: int = 0
+    rebalance_bytes: int = 0
+    last_rebalance_ms: float = 0.0
+    chip_failures: Dict[int, int] = field(default_factory=dict)
+
+
+class ChipFailoverRouter:
+    """Shard router in front of the mesh evaluators: consults the
+    ChipBreakerBank per dispatch, re-splits dead rows' batch shards
+    across survivors, routes dead primaries' table gathers to their
+    N+1 replicas, rebalances re-admitted chips through the store's
+    delta-scatter path, and falls back to the host lattice fold only
+    when no row survives.
+
+    `tables` (un-augmented host PolicyTables) fixes the evaluator
+    geometry; publish() installs epochs through the replica store.
+    `host_fold(ep_index, identity, dport, proto, direction,
+    is_fragment)` is the terminal fallback (e.g.
+    engine.hostpath.lattice_fold_host bound to the map states) —
+    without one, a mesh-wide outage raises instead of degrading.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        tables,
+        store=None,
+        bank: Optional[ChipBreakerBank] = None,
+        collect_telemetry: bool = False,
+        host_fold=None,
+        batch_axis: str = "batch",
+        table_axis: str = "table",
+        site: str = "engine.dispatch",
+        on_chip_transition=None,
+    ) -> None:
+        from cilium_tpu.engine.sharded import (
+            make_failover_evaluator,
+            make_replica_store,
+        )
+
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self.table_axis = table_axis
+        self.site = site
+        self.collect_telemetry = collect_telemetry
+        self.host_fold = host_fold
+        self._on_chip_transition = on_chip_transition
+        # mesh geometry: ordinal grid [dp, tp] of device ids
+        axes = list(mesh.axis_names)
+        self.dp = int(mesh.shape[batch_axis])
+        self.tp = int(mesh.shape[table_axis])
+        grid = np.empty((self.dp, self.tp), np.int64)
+        for idx, dev in np.ndenumerate(mesh.devices):
+            coord = dict(zip(axes, idx))
+            grid[coord[batch_axis], coord[table_axis]] = int(dev.id)
+        self.ordinals = grid
+        self.store = store or make_replica_store(mesh, table_axis)
+        if bank is None:
+            bank = ChipBreakerBank(
+                name=site, on_transition=self._chip_event
+            )
+        elif bank.on_transition is None:
+            bank.on_transition = self._chip_event
+        else:
+            # the router's own wiring (outage ledger, gauge, span
+            # events) is load-bearing — chain it ahead of the
+            # caller's listener rather than dropping either
+            caller = bank.on_transition
+
+            def chained(ordinal, old, new, reason, _caller=caller):
+                self._chip_event(ordinal, old, new, reason)
+                _caller(ordinal, old, new, reason)
+
+            bank.on_transition = chained
+        self.bank = bank
+        self._tables = tables
+        self._ev = make_failover_evaluator(
+            mesh, tables, batch_axis=batch_axis,
+            table_axis=table_axis,
+            collect_telemetry=collect_telemetry,
+        )
+        self._geom = (
+            tuple(tables.l4_hash_rows.shape),
+            tuple(tables.l3_allow_bits.shape),
+        )
+        self.stats = RouterStats()
+
+    # -- breaker plumbing ----------------------------------------------------
+
+    def _chip_event(self, ordinal, old, new, reason) -> None:
+        """Per-chip breaker transition: gauge + span event + the
+        store's outage ledger (an OPEN chip starts missing
+        publishes)."""
+        metrics.chip_breaker_state.set(
+            str(ordinal), value=STATE_CODES[new]
+        )
+        tracing.add_event(
+            "chip.breaker", chip=int(ordinal), old=old, new=new,
+            reason=reason,
+        )
+        if new == "open":
+            self.store.mark_chip_out(ordinal)
+        log.warning(
+            "chip breaker transition",
+            extra={"fields": {
+                "chip": int(ordinal), "from": old, "to": new,
+                "reason": reason,
+            }},
+        )
+        if self._on_chip_transition is not None:
+            self._on_chip_transition(ordinal, old, new, reason)
+
+    # -- publication ---------------------------------------------------------
+
+    def publish(self, tables, delta=None):
+        """Install host tables as the serving epoch (replica store:
+        augmentation + per-copy delta scatter happen inside).  The
+        evaluator geometry is pinned at construction — a re-grown
+        hash plane must rebuild the router, same contract as
+        make_partitioned_evaluator."""
+        got = (
+            tuple(tables.l4_hash_rows.shape),
+            tuple(tables.l3_allow_bits.shape),
+        )
+        if got != self._geom:
+            raise ValueError(
+                f"router was built for table geometry {self._geom} "
+                f"but asked to publish {got}; rebuild the router"
+            )
+        self._tables = tables
+        return self.store.publish(tables, delta)
+
+    # -- re-admission rebalance ----------------------------------------------
+
+    def _owned_row_sets(self, ordinal: int, outage) -> Dict:
+        """{leaf: (axis, aug index array)} a re-admitted chip must
+        replay: the union of the missed deltas' scatter rows
+        restricted to the chip's owned regions (primary + backup),
+        or the whole owned slice when a full upload / ledger
+        overflow happened while it was out."""
+        from cilium_tpu.compiler import partition
+
+        col = None
+        rows_r, cols_c = np.where(self.ordinals == int(ordinal))
+        if cols_c.size:
+            col = int(cols_c[0])
+        if col is None:
+            return {}
+        axes = partition.replica_axes(
+            self._tables, self.tp, self.table_axis
+        )
+        out = {}
+        for name, axis in axes.items():
+            n = getattr(self._tables, name).shape[axis] // self.tp
+            lo, hi = col * 2 * n, (col + 1) * 2 * n
+            whole_region = outage["needs_full"] or any(
+                name in d.replace for d in outage["missed"]
+            )
+            touched = []
+            for d in outage["missed"]:
+                up = d.updates.get(name)
+                if up is None:
+                    continue
+                if axis < len(up.idx):
+                    # the ledger's deltas are already in augmented
+                    # coordinates (the store records what it applied)
+                    touched.append(np.asarray(up.idx[axis], np.int64))
+                else:
+                    # slab-shaped update (values span the sharded
+                    # axis): it wrote into the chip's whole region
+                    whole_region = True
+            if whole_region:
+                idx = np.arange(lo, hi, dtype=np.int64)
+            else:
+                if not touched:
+                    continue
+                idx = np.unique(np.concatenate(touched))
+                idx = idx[(idx >= lo) & (idx < hi)]
+            if idx.size:
+                out[name] = (axis, idx)
+        return out
+
+    def _rebalance(self, ordinal: int) -> Tuple[int, float]:
+        """Replay the rows a chip missed while out, through the
+        store's repair scatter.  Returns (bytes, ms)."""
+        outage = self.store.readmit_chip(ordinal)
+        if outage is None:
+            return 0, 0.0
+        t0 = time.perf_counter()
+        try:
+            row_sets = self._owned_row_sets(ordinal, outage)
+            bytes_h2d = (
+                self.store.repair_rows(row_sets) if row_sets else 0
+            )
+        except Exception:
+            # the scatter may have partially landed — put the popped
+            # ledger back (downgraded to needs_full) so the NEXT
+            # readmission replays the whole owned regions instead of
+            # finding an empty fresh record and replaying nothing
+            self.store.restore_outage(ordinal, outage)
+            raise
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.stats.rebalances += 1
+        self.stats.rebalance_bytes += bytes_h2d
+        self.stats.last_rebalance_ms = ms
+        tracing.add_event(
+            "chip.rebalance", chip=int(ordinal),
+            bytes_h2d=bytes_h2d, ms=round(ms, 3),
+            missed_deltas=len(outage["missed"]),
+            needs_full=outage["needs_full"],
+        )
+        log.info(
+            "chip re-admission rebalance",
+            extra={"fields": {
+                "chip": int(ordinal), "bytes_h2d": bytes_h2d,
+                "ms": round(ms, 3),
+            }},
+        )
+        return bytes_h2d, ms
+
+    # -- routing -------------------------------------------------------------
+
+    def _admit(self):
+        """One admission round: per-chip fault probes (attribution),
+        per-chip breaker questions, and pre-probe rebalances for
+        half-open chips with an open outage ledger.  Returns (alive
+        [dp, tp] bool, rebalanced ordinals, bytes, ms, probed
+        ordinals whose admission consumed a half-open probe slot —
+        a dispatch that never launches must release those)."""
+        alive = np.zeros((self.dp, self.tp), bool)
+        rebalanced = []
+        probed = []
+        reb_bytes = 0
+        reb_ms = 0.0
+        for r in range(self.dp):
+            for c in range(self.tp):
+                ordinal = int(self.ordinals[r, c])
+                try:
+                    faultinject.fire(self.site, chip=ordinal)
+                except faultinject.FaultInjected as exc:
+                    self.bank.record_failure(ordinal, str(exc))
+                    self.stats.chip_failures[ordinal] = (
+                        self.stats.chip_failures.get(ordinal, 0) + 1
+                    )
+                    continue
+                was_half_open = (
+                    self.bank.state(ordinal) == HALF_OPEN
+                )
+                ok = self.bank.allow(ordinal)
+                if ok and self.store.chip_outage(ordinal) is not None:
+                    # the half-open probe may not trust the chip's
+                    # slice until the rows it missed are back — the
+                    # rebalance precedes the probe dispatch
+                    try:
+                        b, ms = self._rebalance(ordinal)
+                        rebalanced.append(ordinal)
+                        reb_bytes += b
+                        reb_ms += ms
+                    except Exception as exc:  # noqa: BLE001
+                        # record_failure releases the probe slot too
+                        self.bank.record_failure(
+                            ordinal, f"rebalance failed: {exc}"
+                        )
+                        ok = False
+                if ok and was_half_open:
+                    probed.append(ordinal)
+                alive[r, c] = ok
+        return (
+            alive, tuple(rebalanced), reb_bytes, reb_ms,
+            tuple(probed),
+        )
+
+    def _usable_rows(self, alive: np.ndarray) -> np.ndarray:
+        """A mesh row serves tuples iff every table slice has a live
+        owner within it: the primary column, or its backup (next
+        shard over).  tp == 1 degenerates to 'the row's chip is
+        alive'."""
+        if self.tp == 1:
+            return alive[:, 0].copy()
+        from cilium_tpu.compiler.partition import (
+            REPLICA_BACKUP_OFFSET,
+        )
+
+        ok = np.ones(self.dp, bool)
+        for c in range(self.tp):
+            backup = (c + REPLICA_BACKUP_OFFSET) % self.tp
+            ok &= alive[:, c] | alive[:, backup]
+        return ok
+
+    def _pack(self, cols: Dict[str, np.ndarray], usable: np.ndarray):
+        """Re-split the tuple stream over the usable rows: each gets
+        a contiguous chunk of the real stream; unusable rows carry
+        valid-masked filler (copies of tuple 0).  Returns (padded
+        cols, valid [dp*s], positions of the real tuples in stream
+        order — None for the identity).  The fully-healthy,
+        already-aligned steady state (every row usable, shard size
+        already a power of two) hands the batch straight through:
+        no column copies, no output gather."""
+        b = len(cols["ep_index"])
+        rows = np.flatnonzero(usable)
+        per = -(-b // len(rows))  # ceil
+        s = max(next_pow2(per), 1)
+        if len(rows) == self.dp and self.dp * s == b:
+            return cols, np.ones(b, bool), None
+        total = self.dp * s
+        padded = {
+            k: np.repeat(v[:1], total, axis=0).astype(v.dtype)
+            for k, v in cols.items()
+        }
+        valid = np.zeros(total, bool)
+        positions = np.empty(b, np.int64)
+        off = 0
+        for k, r in enumerate(rows):
+            take = min(s, b - off)
+            if take <= 0:
+                break
+            sl = slice(r * s, r * s + take)
+            for key, v in cols.items():
+                padded[key][sl] = v[off : off + take]
+            valid[sl] = True
+            positions[off : off + take] = np.arange(
+                r * s, r * s + take
+            )
+            off += take
+        assert off == b, "batch re-split lost tuples"
+        return padded, valid, positions
+
+    def dispatch(
+        self,
+        ep_index,
+        identity,
+        dport,
+        proto,
+        direction,
+        is_fragment=None,
+    ) -> FailoverResult:
+        """One batch through the per-chip failure domain.  Returns a
+        FailoverResult with the verdict columns in STREAM ORDER —
+        bit-identical to the healthy mesh whatever the survivor set,
+        as long as at least one owner of every slice survives; the
+        host fold serves the batch beyond that."""
+        cols = {
+            "ep_index": np.asarray(ep_index, np.int32),
+            "identity": np.asarray(identity, np.uint32),
+            "dport": np.asarray(dport, np.int32),
+            "proto": np.asarray(proto, np.int32),
+            "direction": np.asarray(direction, np.int32),
+            "is_fragment": (
+                np.zeros(len(ep_index), bool)
+                if is_fragment is None
+                else np.asarray(is_fragment, bool)
+            ),
+        }
+        if len(cols["ep_index"]) == 0:
+            # nothing to route: _pack cannot size shards for an
+            # empty stream, and consuming fault schedules / probe
+            # slots for zero tuples would skew attribution
+            from cilium_tpu.engine.verdict import Verdicts
+
+            return FailoverResult(
+                verdicts=Verdicts(
+                    allowed=np.zeros(0, np.uint8),
+                    proxy_port=np.zeros(0, np.int32),
+                    match_kind=np.zeros(0, np.uint8),
+                ),
+            )
+        self.stats.batches += 1
+        self.stats.tuples += len(cols["ep_index"])
+        alive, rebalanced, reb_bytes, reb_ms, probed = self._admit()
+        usable = self._usable_rows(alive)
+        if not usable.any():
+            # the dispatch never launches, so admitted half-open
+            # chips earn neither a success nor a failure — give
+            # their probe slots back instead of pinning them until
+            # the TTL (a healthy, already-rebalanced chip must not
+            # be locked out for probe_ttl by OTHER rows' deaths)
+            for ordinal in probed:
+                self.bank.release_probe(ordinal)
+            return self._terminal_fold(
+                cols, alive, rebalanced, reb_bytes, reb_ms,
+                reason="no mesh row can serve every table slice",
+            )
+        rerouted = not usable.all()
+        if rerouted:
+            metrics.rerouted_batches_total.inc()
+            self.stats.rerouted_batches += 1
+            tracing.add_event(
+                "chip.reroute",
+                dead_rows=int((~usable).sum()),
+                survivors=int(usable.sum()),
+            )
+        padded, valid, positions = self._pack(cols, usable)
+        current = self.store.current()
+        if current is None:
+            raise RuntimeError(
+                "no published epoch: call router.publish first"
+            )
+        _, dev_tables = current
+        from cilium_tpu.engine.verdict import TupleBatch
+
+        batch = TupleBatch(**padded)
+        n_alive = int(alive.sum())
+        with tracing.tracer.span(
+            "mesh.dispatch", site=self.site,
+            attrs={
+                "chips": n_alive, "rows": len(cols["ep_index"]),
+                "rerouted": rerouted,
+            },
+        ) as sp:
+            try:
+                out = self._ev(dev_tables, batch, alive, valid)
+                import jax
+
+                jax.block_until_ready(out)
+            except Exception as exc:  # noqa: BLE001
+                # unattributed failure: every participating chip is
+                # suspect (a mesh-wide SPMD launch has no smaller
+                # blame unit without the fault seam's attribution)
+                sp.status = "error"
+                sp.attrs["error"] = str(exc)
+                for r in range(self.dp):
+                    for c in range(self.tp):
+                        if alive[r, c]:
+                            self.bank.record_failure(
+                                int(self.ordinals[r, c]), str(exc)
+                            )
+                return self._terminal_fold(
+                    cols, alive, rebalanced, reb_bytes, reb_ms,
+                    reason=str(exc),
+                )
+        for r in range(self.dp):
+            for c in range(self.tp):
+                if alive[r, c]:
+                    self.bank.record_success(
+                        int(self.ordinals[r, c])
+                    )
+        if self.collect_telemetry:
+            v, l4c, l3c, replica_hits, trow = out
+            telemetry = np.asarray(trow)
+        else:
+            v, l4c, l3c, replica_hits = out
+            telemetry = None
+        replica_hits = int(np.asarray(replica_hits))
+        if replica_hits:
+            metrics.replica_gather_total.inc(value=replica_hits)
+            self.stats.replica_hits += replica_hits
+        from cilium_tpu.engine.verdict import Verdicts
+
+        if positions is None:
+            verdicts = Verdicts(
+                allowed=np.asarray(v.allowed),
+                proxy_port=np.asarray(v.proxy_port),
+                match_kind=np.asarray(v.match_kind),
+            )
+        else:
+            verdicts = Verdicts(
+                allowed=np.asarray(v.allowed)[positions],
+                proxy_port=np.asarray(v.proxy_port)[positions],
+                match_kind=np.asarray(v.match_kind)[positions],
+            )
+        return FailoverResult(
+            verdicts=verdicts,
+            l4_counts=np.asarray(l4c),
+            l3_counts=np.asarray(l3c),
+            telemetry=telemetry,
+            replica_hits=replica_hits,
+            rerouted=rerouted,
+            degraded=False,
+            alive=alive,
+            rebalanced_chips=rebalanced,
+            rebalance_bytes=reb_bytes,
+            rebalance_ms=reb_ms,
+        )
+
+    def _terminal_fold(
+        self, cols, alive, rebalanced, reb_bytes, reb_ms, reason=""
+    ) -> FailoverResult:
+        """The host lattice fold — taken only when no owner of some
+        slice survives (or the SPMD launch itself failed)."""
+        if self.host_fold is None:
+            raise RuntimeError(
+                f"mesh unserviceable ({reason}) and no host_fold "
+                f"terminal fallback configured"
+            )
+        with tracing.tracer.span(
+            "engine.hostpath", site="engine.hostpath",
+            attrs={"failover": True, "reason": reason},
+        ):
+            v = self.host_fold(
+                cols["ep_index"], cols["identity"], cols["dport"],
+                cols["proto"], cols["direction"],
+                cols["is_fragment"],
+            )
+        metrics.degraded_batches_total.inc()
+        self.stats.degraded_batches += 1
+        log.warning(
+            "mesh batch served by terminal host fold",
+            extra={"fields": {"reason": reason}},
+        )
+        from cilium_tpu.engine.verdict import Verdicts
+
+        verdicts = Verdicts(
+            allowed=np.asarray(v.allowed),
+            proxy_port=np.asarray(v.proxy_port),
+            match_kind=np.asarray(v.match_kind),
+        )
+        return FailoverResult(
+            verdicts=verdicts,
+            degraded=True,
+            alive=alive,
+            rebalanced_chips=rebalanced,
+            rebalance_bytes=reb_bytes,
+            rebalance_ms=reb_ms,
+        )
+
+    # -- introspection -------------------------------------------------------
+
+    def chip_states(self) -> Dict[int, str]:
+        return self.bank.states()
+
+    def snapshot(self) -> Dict:
+        return {
+            "chips": {
+                str(o): s for o, s in self.bank.states().items()
+            },
+            "stats": {
+                "batches": self.stats.batches,
+                "tuples": self.stats.tuples,
+                "rerouted_batches": self.stats.rerouted_batches,
+                "degraded_batches": self.stats.degraded_batches,
+                "replica_hits": self.stats.replica_hits,
+                "rebalances": self.stats.rebalances,
+                "rebalance_bytes": self.stats.rebalance_bytes,
+                "last_rebalance_ms": self.stats.last_rebalance_ms,
+            },
+        }

@@ -52,14 +52,22 @@ from cilium_tpu.metrics import registry as metrics
 _LAYOUT_LANES_MASK = (1 << 22) - 1
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1): the single size-class
+    rounding every scatter/repair/re-split payload shares, so the
+    jit caches keyed on padded shapes can never drift apart."""
+    size = 1
+    while size < n:
+        size <<= 1
+    return size
+
+
 def _pad_pow2(update):
     """Pad scatter payloads to the next power of two by repeating the
     last entry (duplicate identical writes are deterministic), so the
     jitted updater recompiles per size CLASS instead of per size."""
     k = len(update.values)
-    size = 1
-    while size < k:
-        size <<= 1
+    size = next_pow2(k)
     if size == k:
         return update.idx, update.values
     pad = size - k
@@ -124,10 +132,14 @@ class DeviceTableStore:
         hot_only: bool = False,
         shardings_fn=None,
         partition_digest: int = 0,
+        transform_fn=None,
+        delta_transform_fn=None,
     ) -> None:
         self._lock = threading.Lock()
         # each slot: dict(tables=<device pytree>, stamp=int,
-        # epoch=int, layout=int, chip_bytes={ordinal: bytes})
+        # epoch=int, layout=int, chip_bytes={ordinal: bytes},
+        # host=<the transformed host pytree the epoch was placed
+        # from — retained as the repair scatter's value source>)
         self._slots = [None, None]
         self._cur = 0
         self._epoch = 0
@@ -144,7 +156,32 @@ class DeviceTableStore:
         # against one partitioning can never scatter into an epoch
         # laid out under another
         self.partition_digest = int(partition_digest)
+        # device-layout transform (e.g. the N+1 replica augmentation,
+        # compiler.partition.replicate_table_leaves): applied to the
+        # host tables before placement; `delta_transform_fn(delta,
+        # pre_transform_tables)` rewrites a delta recorded against
+        # the un-transformed layout into device coordinates so the
+        # scatter path keeps every copy bit-identical
+        self._transform_fn = transform_fn
+        self._delta_transform_fn = delta_transform_fn
+        # the repair scatter (repair_rows) reads its values from the
+        # epoch's retained host pytree — only stores with a device
+        # layout seam (replica stores) have that consumer; a plain
+        # store must not pin two extra full host copies in RAM
+        self._retain_host = (
+            transform_fn is not None or delta_transform_fn is not None
+        )
+        # per-chip outage ledger (the re-admission rebalance feed):
+        # ordinal -> {"epoch": epoch at mark-out, "missed":
+        # [transformed TableDelta...], "needs_full": bool} — every
+        # publish that lands while a chip's breaker is open records
+        # what that chip missed, so readmission can replay exactly
+        # those rows through the delta-scatter path instead of a
+        # full upload
+        self._out_chips: Dict[int, Dict] = {}
+        self._missed_cap = 32
         self._apply_cache: Dict[tuple, object] = {}
+        self._repair_cache: Dict[tuple, object] = {}
 
     # -- device placement ----------------------------------------------------
 
@@ -224,6 +261,9 @@ class DeviceTableStore:
             t0 = time.perf_counter()
             if self._hot_only:
                 tables = split_hot(tables)
+            pre_transform = tables
+            if self._transform_fn is not None:
+                tables = self._transform_fn(tables)
             if self._shardings_fn is not None:
                 self._shardings = self._shardings_fn(tables)
             layout = tables_layout_version(tables) | (
@@ -246,6 +286,11 @@ class DeviceTableStore:
                 and (delta.layout & _LAYOUT_LANES_MASK)
                 == (layout & _LAYOUT_LANES_MASK)
             )
+            if use_delta and self._delta_transform_fn is not None:
+                # rewrite into device coordinates (the delta was
+                # recorded against the un-transformed layout; the
+                # geometry it maps from is the pre-transform pytree)
+                delta = self._delta_transform_fn(delta, pre_transform)
             if use_delta:
                 try:
                     dev, stats = self._publish_delta(
@@ -276,10 +321,24 @@ class DeviceTableStore:
                 "tables": dev, "stamp": stamp, "epoch": self._epoch,
                 "nbytes": tables_nbytes(tables), "layout": layout,
                 "chip_bytes": _chip_resident_bytes(dev),
+                "host": tables if self._retain_host else None,
             }
             self._cur = spare_i
             stats.epoch = self._epoch
             stats.seconds = time.perf_counter() - t0
+            # outage ledger: record what every marked-out chip just
+            # missed — a delta publish is replayable row-by-row at
+            # readmission; a full upload (or an overflowing miss
+            # list) downgrades the rebalance to a whole-slice replay
+            for rec in self._out_chips.values():
+                if (
+                    use_delta
+                    and not rec["needs_full"]
+                    and len(rec["missed"]) < self._missed_cap
+                ):
+                    rec["missed"].append(delta)
+                else:
+                    rec["needs_full"] = True
             self._sample_bytes()
             sp.attrs.update(
                 mode=stats.mode, epoch=stats.epoch,
@@ -414,6 +473,152 @@ class DeviceTableStore:
             ).items():
                 per[ordinal] = per.get(ordinal, 0) + nbytes
         return per
+
+    # -- per-chip outage / re-admission rebalance ----------------------------
+
+    def mark_chip_out(self, ordinal: int) -> None:
+        """Start the outage ledger for a chip whose breaker opened:
+        every publish from now on records what this chip missed.
+        Idempotent — a re-open after a failed half-open probe keeps
+        the original ledger (the chip still misses everything since
+        its first failure)."""
+        with self._lock:
+            self._out_chips.setdefault(
+                int(ordinal),
+                {"epoch": self._epoch, "missed": [],
+                 "needs_full": False},
+            )
+
+    def chip_outage(self, ordinal: int) -> Optional[Dict]:
+        with self._lock:
+            rec = self._out_chips.get(int(ordinal))
+            if rec is None:
+                return None
+            return {
+                "epoch": rec["epoch"],
+                "missed": list(rec["missed"]),
+                "needs_full": rec["needs_full"],
+            }
+
+    def readmit_chip(self, ordinal: int) -> Optional[Dict]:
+        """Close the outage ledger and return it (the failover
+        router converts it into the owned-row repair scatter).  The
+        SPARE epoch, if it was published during the outage, is
+        de-registered: its chip slice missed scatters recorded
+        against ITS stamp's host arrays, which are no longer
+        retained — the next publish full-uploads it instead of
+        scattering into semantically stale rows."""
+        with self._lock:
+            rec = self._out_chips.pop(int(ordinal), None)
+            if rec is None:
+                return None
+            spare = self._slots[self._cur ^ 1]
+            if spare is not None and spare["epoch"] > rec["epoch"]:
+                self._slots[self._cur ^ 1] = None
+            return rec
+
+    def restore_outage(self, ordinal: int, rec: Dict) -> None:
+        """Put a popped ledger back after a FAILED repair: the
+        scatter may have partially landed, so the restored record is
+        downgraded to needs_full — the next readmission replays the
+        chip's whole owned regions instead of trusting row-level
+        bookkeeping the failure invalidated.  Merges with any record
+        a concurrent re-open already created."""
+        rec["needs_full"] = True
+        with self._lock:
+            existing = self._out_chips.get(int(ordinal))
+            if existing is None:
+                self._out_chips[int(ordinal)] = rec
+            else:
+                existing["epoch"] = min(
+                    existing["epoch"], rec["epoch"]
+                )
+                existing["needs_full"] = True
+
+    def _repair_fn(self, fields: Tuple[str, ...],
+                   axes: Tuple[int, ...]):
+        """Jitted donated scatter rewriting whole index slices along
+        one axis per leaf — the re-admission rebalance's engine
+        (same machinery as _apply_fn, but indexing a single interior
+        axis so a chip's owned rows repair in one scatter each)."""
+        import jax
+
+        key = (fields, axes)
+        fn = self._repair_cache.get(key)
+        if fn is not None:
+            return fn
+
+        def apply(tables, payloads):
+            kw = {}
+            for name, axis, (idx, values) in zip(
+                fields, axes, payloads
+            ):
+                index = (slice(None),) * axis + (idx,)
+                kw[name] = getattr(tables, name).at[index].set(values)
+            return dataclasses.replace(tables, **kw)
+
+        fn = tracing.track_jit(
+            jax.jit(apply, donate_argnums=(0,)), "publish.repair"
+        )
+        self._repair_cache[key] = fn
+        return fn
+
+    def repair_rows(self, row_sets: Dict[str, Tuple[int, object]]) -> int:
+        """Rewrite `row_sets` ({leaf: (axis, index array)}) of the
+        LIVE epoch from its retained host arrays — the re-admission
+        rebalance: the rows a chip missed while its breaker was open
+        land back on device through the delta-scatter path, bytes
+        proportional to the missed change (never a full upload).
+
+        The live epoch's buffers are DONATED to the scatter, so the
+        caller must not have batches in flight against it (the
+        failover router rebalances at stream boundaries, before the
+        probe dispatch that re-admits the chip).  Returns bytes
+        shipped host→device (also accumulated in
+        cilium_rebalance_bytes_h2d_total)."""
+        import jax
+
+        with self._lock:
+            slot = self._slots[self._cur]
+            if slot is None:
+                raise RuntimeError("no live epoch to repair")
+            host = slot.get("host")
+            if host is None:
+                raise RuntimeError(
+                    "live epoch retains no host source; repair "
+                    "requires a publish through this store"
+                )
+            fields, axes, payloads = [], [], []
+            bytes_h2d = 0
+            for name in sorted(row_sets):
+                axis, idx = row_sets[name]
+                idx = np.asarray(idx, np.int64)
+                if idx.size == 0:
+                    continue
+                # pow2-pad by repeating the last index (duplicate
+                # identical writes are deterministic) so the repair
+                # jit recompiles per size class, like _pad_pow2
+                size = next_pow2(idx.size)
+                if size != idx.size:
+                    idx = np.concatenate(
+                        [idx, np.repeat(idx[-1:], size - idx.size)]
+                    )
+                values = np.take(
+                    np.asarray(getattr(host, name)), idx, axis=axis
+                )
+                fields.append(name)
+                axes.append(int(axis))
+                payloads.append((self._put(idx), self._put(values)))
+                bytes_h2d += idx.nbytes + values.nbytes
+            if not fields:
+                return 0
+            dev = self._repair_fn(tuple(fields), tuple(axes))(
+                slot["tables"], tuple(payloads)
+            )
+            jax.block_until_ready(dev)
+            slot["tables"] = dev
+            metrics.rebalance_bytes_h2d_total.inc(value=bytes_h2d)
+            return bytes_h2d
 
     @staticmethod
     def _norm(stamp: int) -> int:

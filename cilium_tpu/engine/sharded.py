@@ -522,6 +522,400 @@ def make_partitioned_evaluator(
     return run
 
 
+def make_replica_store(
+    mesh: Mesh,
+    table_axis: str = "table",
+    hot_only: bool = False,
+):
+    """make_partitioned_store under the N+1 replica placement rule:
+    every published epoch carries the AUGMENTED layout
+    (compiler.partition.replicate_table_leaves — each sharded
+    replica-rule leaf's shard also holds its left neighbour's slice),
+    and every delta publish scatters each changed row into BOTH its
+    primary and backup positions (partition.replica_delta), so the
+    two copies stay bit-identical through churn.  The replica
+    placement digest is folded into the epoch layout stamp — a delta
+    recorded under plain sharding can never scatter into a replica
+    epoch, and vice versa."""
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.engine.publish import DeviceTableStore
+
+    ntp = int(mesh.shape[table_axis])
+    return DeviceTableStore(
+        shardings_fn=lambda aug: partition.table_shardings(
+            mesh, aug, table_axis
+        ),
+        partition_digest=partition.replica_partition_digest(
+            table_axis
+        ),
+        transform_fn=lambda t: partition.replicate_table_leaves(
+            t, ntp, table_axis
+        ),
+        delta_transform_fn=lambda d, pre: partition.replica_delta(
+            d, pre, ntp, table_axis
+        ),
+        hot_only=hot_only,
+    )
+
+
+def make_failover_evaluator(
+    mesh: Mesh,
+    tables: PolicyTables,
+    batch_axis: str = "batch",
+    table_axis: str = "table",
+    collect_telemetry: bool = False,
+):
+    """Replica-aware routed-gather evaluator — the per-chip failure
+    domain's kernel half.  Consumes the N+1 AUGMENTED tables
+    (compiler.partition.replicate_table_leaves: each sharded leaf's
+    shard also carries a copy of its left neighbour's slice) plus two
+    routing inputs:
+
+      * `alive` bool [dp, tp] (replicated) — per-(mesh row, table
+        column) chip health from the ChipBreakerBank.  A tuple whose
+        bucket/word's primary owner is dead routes to the BACKUP
+        owner (next shard over), which gathers from its backup
+        region — the gathered rows are bit-identical copies, so
+        verdicts never depend on the dead chip's table slice.
+      * `valid` bool [B] (batch-sharded) — real-tuple mask from the
+        shard router's batch re-split: positions padding a dead
+        row's shard are excluded from counters and telemetry, so the
+        full observable surface equals the healthy mesh's.
+
+    Returns fn(tables_aug, batch, alive, valid) ->
+    (Verdicts, l4_counts [E,2,Kg] replicated, l3_counts [E,2,N]
+    replicated (N = the GLOBAL identity pad — unlike the partitioned
+    evaluator's shard-local slices, so comparators need no reassembly
+    under a changing survivor set), replica_hits u32 scalar (valid
+    tuples served from a backup region — the replica_gather_total
+    feed) [, per-chip telemetry rows [dp, 2, TELEM_COLS]]).
+
+    Verdict columns for INVALID positions are unspecified when their
+    row hosts a dead chip (the router discards them); everything the
+    valid mask covers is bit-identical to the healthy mesh and the
+    host oracle — the acceptance contract of the per-chip failover
+    plane."""
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.compiler.tables import (
+        L4H_WILD_IDX,
+        l4h_key0,
+        l4h_key1,
+    )
+    from cilium_tpu.engine.hashtable import fnv1a_device
+    from cilium_tpu.engine.verdict import (
+        _index_identity,
+        _l4hash_probe,
+        telemetry_masks,
+    )
+
+    if tables.l4_hash_rows is None:
+        raise ValueError(
+            "failover evaluator requires the hashed L4 entry tables"
+        )
+    ntp = int(mesh.shape[table_axis])
+    rep_axes = partition.replica_axes(tables, ntp, table_axis)
+    rows_sharded = "l4_hash_rows" in rep_axes
+    l3_sharded = "l3_allow_bits" in rep_axes
+    # geometry of the UN-augmented layout (hash masks / owner maps
+    # are functions of the original shapes; the augmentation only
+    # doubles the resident axis)
+    n_rows_global = int(tables.l4_hash_rows.shape[0])
+    n_row_shard = n_rows_global // ntp if rows_sharded else 0
+    w_global = int(tables.l3_allow_bits.shape[-1])
+    wn = w_global // ntp if l3_sharded else 0
+    n_ids = w_global * 32
+
+    t_specs = partition.divisible_partition_specs(
+        tables, ntp, table_axis
+    )
+    b_specs = batch_specs(batch_axis)
+    v_specs = Verdicts(
+        allowed=P(batch_axis),
+        proxy_port=P(batch_axis),
+        match_kind=P(batch_axis),
+    )
+    # a sharded L3 plane keeps its counters shard-local too — the
+    # stitched last axis is chip-major [ntp, 2*wn*32] regions the
+    # host wrapper folds back into the global counter
+    l3_spec = P(None, None, table_axis) if l3_sharded else P()
+    out_specs = (v_specs, P(), l3_spec, P())
+    if collect_telemetry:
+        out_specs = out_specs + (P(batch_axis, None, None),)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(t_specs, b_specs, P(), P(batch_axis)),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def step(tables_l: PolicyTables, batch_l: TupleBatch,
+             alive_l, valid_l):
+        idx, known = _index_identity(tables_l, batch_l)
+        proto = jnp.clip(batch_l.proto, 0, 255).astype(jnp.int32)
+        dport = jnp.clip(batch_l.dport, 0, 65535).astype(jnp.int32)
+        # this chip's coordinates + its mesh row's health vector
+        # (tuples on batch row r only ever touch row r's chips — the
+        # table-axis psum reduces within the row subgroup)
+        alive_row = alive_l[jax.lax.axis_index(batch_axis)]
+        my_col = jax.lax.axis_index(table_axis)
+
+        # -- routed exact probe with replica fallback -------------------
+        w0 = l4h_key0(
+            idx.astype(jnp.uint32), batch_l.direction,
+            batch_l.ep_index,
+        )
+        w1 = l4h_key1(dport, proto, batch_l.ep_index)
+        h = fnv1a_device(jnp.stack([w0, w1], axis=1))
+        bucket = (h & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
+        rows_l = tables_l.l4_hash_rows
+        e = rows_l.shape[1] // 3
+        replica_exact = jnp.zeros(bucket.shape, bool)
+        if rows_sharded:
+            n = n_row_shard
+            p = bucket // n
+            ap = alive_row[p]
+            owner = jnp.where(
+                ap, p, (p + partition.REPLICA_BACKUP_OFFSET) % ntp
+            )
+            owns = owner == my_col
+            # serving chip's local row: primary region [0, n) when
+            # the owner IS the primary, backup region [n, 2n) when
+            # the next shard over serves its neighbour's copy
+            bl = (bucket - p * n) + jnp.where(ap, 0, n)
+            bl = jnp.clip(bl, 0, 2 * n - 1)
+            replica_exact = owns & ~ap
+        else:
+            owns = jnp.ones(bucket.shape, bool)
+            bl = bucket
+        row = rows_l[bl]
+        hit = (
+            (row[:, :e] == w0[:, None])
+            & (row[:, e : 2 * e] == w1[:, None])
+            & owns[:, None]
+        )
+        val_local = jnp.sum(
+            jnp.where(hit, row[:, 2 * e : 3 * e], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        found_local = jnp.any(hit, axis=1)
+        if rows_sharded:
+            val1 = jax.lax.psum(val_local, table_axis)
+            found1 = (
+                jax.lax.psum(
+                    found_local.astype(jnp.int32), table_axis
+                )
+                > 0
+            )
+        else:
+            val1, found1 = val_local, found_local
+        stash = tables_l.l4_hash_stash
+        s_hit = (stash[None, :, 0] == w0[:, None]) & (
+            stash[None, :, 1] == w1[:, None]
+        )
+        val1 = val1 + jnp.sum(
+            jnp.where(s_hit, stash[None, :, 2], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        found1 = found1 | jnp.any(s_hit, axis=1)
+
+        wild_idx = jnp.full(
+            idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
+        )
+        hit3, val3 = _l4hash_probe(
+            tables_l.l4_wild_rows, tables_l.l4_wild_stash,
+            batch_l.ep_index, batch_l.direction, wild_idx,
+            dport, proto,
+        )
+        probe1 = known & found1
+        probe3 = hit3
+        val = jnp.where(probe1, val1, val3)
+        proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        j = (val >> jnp.uint32(16)).astype(jnp.int32)
+
+        # -- routed L3 probe with replica fallback ----------------------
+        word = idx >> 5
+        bit = (idx & 31).astype(jnp.uint32)
+        replica_l3 = jnp.zeros(word.shape, bool)
+        if l3_sharded:
+            wp = word // wn
+            apw = alive_row[wp]
+            owner_w = jnp.where(
+                apw, wp, (wp + partition.REPLICA_BACKUP_OFFSET) % ntp
+            )
+            owns_w = owner_w == my_col
+            wl = (word - wp * wn) + jnp.where(apw, 0, wn)
+            wl = jnp.clip(wl, 0, 2 * wn - 1)
+            replica_l3 = owns_w & ~apw
+        else:
+            owns_w = jnp.ones(word.shape, bool)
+            wl = word
+        l3_words = tables_l.l3_allow_bits[
+            batch_l.ep_index, batch_l.direction, wl
+        ]
+        p2_local = (
+            known & owns_w & ((l3_words >> bit) & 1).astype(bool)
+        )
+        if l3_sharded:
+            probe2 = (
+                jax.lax.psum(p2_local.astype(jnp.int32), table_axis)
+                > 0
+            )
+        else:
+            probe2 = p2_local
+
+        v = _combine(probe1, probe2, probe3, proxy,
+                     batch_l.is_fragment)
+
+        # -- valid-masked counters + telemetry --------------------------
+        # Padding positions (valid=False) are excluded everywhere —
+        # a re-split batch counts exactly its real tuples.
+        e_count, _, kg = tables_l.l4_meta.shape
+        hit_l4 = (
+            (v.match_kind == MATCH_L4)
+            | (v.match_kind == MATCH_L4_WILD)
+        ) & valid_l
+        l4_counts = jnp.zeros((e_count, 2, kg), jnp.uint32).at[
+            batch_l.ep_index, batch_l.direction, j
+        ].add(hit_l4.astype(jnp.uint32))
+        l4_counts = jax.lax.psum(l4_counts, batch_axis)
+        l3_hit_here = p2_local & (v.match_kind == MATCH_L3) & valid_l
+        if l3_sharded:
+            # shard-LOCAL counters at the augmented local identity
+            # index (primary region [0, g), backup region [g, 2g) —
+            # the same routing as wl): each hit lands exactly once
+            # on its serving chip, so the global [E, 2, N] tensor is
+            # never materialized on device (it would be 32x the bit
+            # plane, replicated per chip — defeating the HBM
+            # sharding this plane exists for).  The host wrapper
+            # folds the per-chip regions back into the global
+            # counter whatever mix of primary/backup each row's
+            # survivor set routed.
+            g = wn * 32
+            lid = jnp.clip(idx - wp * g, 0, g - 1) + jnp.where(
+                apw, 0, g
+            )
+            l3_counts = jnp.zeros(
+                (e_count, 2, 2 * g), jnp.uint32
+            ).at[
+                batch_l.ep_index, batch_l.direction, lid
+            ].add(l3_hit_here.astype(jnp.uint32))
+        else:
+            # replicated fallback plane: p2_local is IDENTICAL on
+            # every table chip — count at the global index and take
+            # one copy (a table-axis psum would inflate every hit
+            # by tp)
+            l3_counts = jnp.zeros(
+                (e_count, 2, n_ids), jnp.uint32
+            ).at[
+                batch_l.ep_index, batch_l.direction,
+                jnp.clip(idx, 0, n_ids - 1),
+            ].add(l3_hit_here.astype(jnp.uint32))
+        l3_counts = jax.lax.psum(l3_counts, batch_axis)
+        served_backup = (
+            ((replica_exact | replica_l3) & valid_l).astype(
+                jnp.uint32
+            )
+        )
+        replica_hits = jax.lax.psum(
+            jax.lax.psum(jnp.sum(served_backup), batch_axis),
+            table_axis,
+        )
+        out = (v, l4_counts, l3_counts, replica_hits)
+        if not collect_telemetry:
+            return out
+        zeros = jnp.zeros(v.allowed.shape, jnp.int32)
+        masks = telemetry_masks(
+            zeros, zeros, v.match_kind, v.allowed, zeros,
+            v.proxy_port, zeros, zeros,
+        )
+        ingress = (batch_l.direction == 0) & valid_l
+        row_in = jnp.stack(
+            [
+                jnp.sum(m & ingress, dtype=jnp.uint32)
+                for m in masks
+            ]
+        )
+        col_total = jnp.stack(
+            [
+                jnp.sum(m & valid_l, dtype=jnp.uint32)
+                for m in masks
+            ]
+        )
+        trow = jnp.stack([row_in, col_total - row_in])
+        return out + (trow[None],)
+
+    in_shardings = (
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), t_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), b_specs),
+        NamedSharding(mesh, P()),
+        NamedSharding(mesh, P(batch_axis)),
+    )
+    jitted = jax.jit(step, in_shardings=in_shardings)
+    built_geom = (
+        tuple(tables.l4_hash_rows.shape),
+        tuple(tables.l3_allow_bits.shape),
+    )
+    aug_rows = (
+        n_rows_global * 2 if rows_sharded else n_rows_global
+    )
+    aug_words = w_global * 2 if l3_sharded else w_global
+
+    def _fold_l3(l3_aug):
+        """[E, 2, ntp*2g] chip-major (primary region then backup
+        region per chip) → global [E, 2, N]: slice p reassembles
+        from chip p's primary region + chip (p+offset)'s backup
+        region.  Rows whose owner moved were counted in the backup
+        region, so summing both regions is exact whatever mix each
+        mesh row's survivor set routed."""
+        import numpy as np
+
+        a = np.asarray(l3_aug)
+        g = a.shape[-1] // (2 * ntp)
+        blocks = a.reshape(a.shape[0], a.shape[1], ntp, 2 * g)
+        back = np.roll(
+            blocks[..., g:],
+            -partition.REPLICA_BACKUP_OFFSET,
+            axis=2,
+        )
+        return np.ascontiguousarray(
+            (blocks[..., :g] + back).reshape(
+                a.shape[0], a.shape[1], ntp * g
+            )
+        )
+
+    def run(tables_aug: PolicyTables, batch: TupleBatch, alive,
+            valid):
+        if tables_aug.l4_hash_rows is None:
+            raise ValueError(
+                "failover evaluator requires the hashed L4 entry "
+                "tables"
+            )
+        got = (
+            int(tables_aug.l4_hash_rows.shape[0]),
+            int(tables_aug.l3_allow_bits.shape[-1]),
+        )
+        if got != (aug_rows, aug_words):
+            raise ValueError(
+                "failover evaluator was built for augmented table "
+                f"geometry {(aug_rows, aug_words)} (from un-augmented "
+                f"{built_geom}) but called with {got}; rebuild with "
+                "make_failover_evaluator"
+            )
+        out = jitted(tables_aug, batch, alive, valid)
+        if l3_sharded:
+            out = (out[0], out[1], _fold_l3(out[2])) + tuple(
+                out[3:]
+            )
+        return out
+
+    run.replica_axes = rep_axes
+    return run
+
+
 def make_async_mesh_dispatcher(
     step, mesh, batch_axis: str = "batch", depth: int = 1
 ):

@@ -12,7 +12,12 @@ Sites (dotted names; the instrumented seams):
 
   engine.dispatch   device verdict dispatch (Daemon.process_flows,
                     replay.replay) — the XLA launch that a wedged TPU
-                    runtime or dispatch failure takes down
+                    runtime or dispatch failure takes down.  Accepts
+                    the `chip=` selector (below): the mesh failover
+                    router (engine/failover.py) probes this site once
+                    per device ordinal before each launch, so a
+                    chip-scoped schedule kills exactly one chip while
+                    the unscoped daemon/replay seam never sees it
   native.decode     flow-record decode (native.decode_flow_records)
   kvstore.conn      socket transport send path (kvstore RemoteBackend)
                     — custom action: the call site severs its socket
@@ -29,6 +34,12 @@ Schedules are deterministic and composable:
   "hang:delay=0.5"           sleep `delay` then pass (watchdog bait)
   "corrupt:next=1"           data-mode: corrupt_bytes() mangles the
                              payload (truncation) instead of raising
+  "raise:chip=3"             chip-scoped: fires only for callers that
+                             identify as device ordinal 3 (the mesh
+                             router's per-chip attribution probes);
+                             call sites that pass no ordinal are
+                             never affected, and non-matching
+                             ordinals do not consume the schedule
 
 Arming surfaces: `registry.arm()` in-process, the
 CILIUM_TPU_FAULTS env var at import ("site=spec,site=spec"),
@@ -64,9 +75,11 @@ MODES = ("raise", "hang", "corrupt")
 class FaultInjected(RuntimeError):
     """An armed site fired (mode=raise)."""
 
-    def __init__(self, site: str) -> None:
-        super().__init__(f"injected fault at {site}")
+    def __init__(self, site: str, chip: Optional[int] = None) -> None:
+        where = site if chip is None else f"{site} (chip {chip})"
+        super().__init__(f"injected fault at {where}")
         self.site = site
+        self.chip = chip
 
 
 @dataclass
@@ -79,6 +92,7 @@ class FaultSpec:
     prob: float = 0.0  # seeded Bernoulli (0 = off)
     seed: int = 0
     delay: float = 0.05  # hang duration (mode=hang)
+    chip: int = -1  # device-ordinal scope (-1 = unscoped)
 
     def __post_init__(self) -> None:
         if self.mode not in MODES:
@@ -108,7 +122,7 @@ class FaultSpec:
                 key = key.strip()
                 if key == "next":
                     kw["next_n"] = int(value)
-                elif key in ("every", "seed"):
+                elif key in ("every", "seed", "chip"):
                     kw[key] = int(value)
                 elif key in ("prob", "delay"):
                     kw[key] = float(value)
@@ -126,6 +140,7 @@ class FaultSpec:
             "prob": self.prob,
             "seed": self.seed,
             "delay": self.delay,
+            "chip": self.chip,
         }
 
 
@@ -216,7 +231,18 @@ class FaultRegistry:
     # with a call may miss that one call), and dict reads are atomic
     # under the GIL.
 
-    def should_fire(self, site: str) -> bool:
+    @staticmethod
+    def _in_scope(spec: FaultSpec, chip: Optional[int]) -> bool:
+        """Chip-scope gate: a chip-scoped spec only matches callers
+        identifying as that exact ordinal (out-of-scope calls must
+        not consume the schedule — "kill chip 3" means chip 3's next
+        probe, not whichever chip happens to probe first); an
+        unscoped spec matches every caller, ordinal-passing or not."""
+        if spec.chip < 0:
+            return True
+        return chip is not None and chip == spec.chip
+
+    def should_fire(self, site: str, chip: Optional[int] = None) -> bool:
         """Count one call; True when the schedule says fail.  For
         call sites with a CUSTOM fault action (kvstore.conn severs
         its socket) — fire() applies the generic raise/hang action."""
@@ -224,34 +250,38 @@ class FaultRegistry:
             return False
         with self._lock:
             armed = self._armed.get(site)
-            if armed is None:
+            if armed is None or not self._in_scope(armed.spec, chip):
                 return False
             hit = armed.decide()
         if hit:
-            self._count(site, armed.spec.mode)
+            self._count(site, armed.spec.mode, chip)
         return hit
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str, chip: Optional[int] = None) -> None:
         """The generic instrumentation hook: no-op unless armed; an
         armed raise-site raises FaultInjected, a hang-site sleeps
         its delay (the dispatch watchdog's bait).  corrupt-mode
-        sites never act here — corrupt_bytes() is their verb."""
+        sites never act here — corrupt_bytes() is their verb.  Pass
+        `chip` (a device ordinal) from per-chip attribution probes:
+        chip-scoped specs fire only for their ordinal."""
         if not self._armed:
             return
         with self._lock:
             armed = self._armed.get(site)
             if armed is None or armed.spec.mode == "corrupt":
                 return
+            if not self._in_scope(armed.spec, chip):
+                return
             hit = armed.decide()
             mode = armed.spec.mode
             delay = armed.spec.delay
         if not hit:
             return
-        self._count(site, mode)
+        self._count(site, mode, chip)
         if mode == "hang":
             time.sleep(delay)
             return
-        raise FaultInjected(site)
+        raise FaultInjected(site, chip)
 
     def corrupt_bytes(self, site: str, buf: bytes) -> bytes:
         """Data-plane verb: an armed corrupt-site mangles the buffer
@@ -270,7 +300,7 @@ class FaultRegistry:
         return buf[:-1]
 
     @staticmethod
-    def _count(site: str, mode: str) -> None:
+    def _count(site: str, mode: str, chip: Optional[int] = None) -> None:
         # late import: metrics must stay importable without this
         # module and vice versa
         from cilium_tpu.metrics import registry as metrics
@@ -278,7 +308,8 @@ class FaultRegistry:
         metrics.fault_injections_total.inc(site, mode)
         log.warning(
             "injected fault fired",
-            extra={"fields": {"site": site, "mode": mode}},
+            extra={"fields": {"site": site, "mode": mode,
+                              "chip": chip}},
         )
 
 

@@ -305,6 +305,32 @@ class Registry:
             "Injected faults fired, by site and mode",
             ("site", "mode"),
         )
+        # -- per-chip failover plane (engine/failover.py) ----------------
+        self.chip_breaker_state = Gauge(
+            f"{ns}_chip_breaker_state",
+            "Per-chip dispatch breaker state keyed by device ordinal "
+            "(0=closed, 1=open, 2=half-open) — the mesh refinement "
+            "of cilium_circuit_breaker_state",
+            ("chip",),
+        )
+        self.rerouted_batches_total = Counter(
+            f"{ns}_rerouted_batches_total",
+            "Batches whose tuple stream was re-split across "
+            "surviving chips because at least one chip's breaker "
+            "was open",
+        )
+        self.replica_gather_total = Counter(
+            f"{ns}_replica_gather_total",
+            "Tuples whose routed table gather was served from a "
+            "backup (N+1 replica) shard region because the primary "
+            "owner's breaker was open",
+        )
+        self.rebalance_bytes_h2d_total = Counter(
+            f"{ns}_rebalance_bytes_h2d_total",
+            "Bytes scattered host->device by chip re-admission "
+            "rebalances (replaying the rows a chip missed while its "
+            "breaker was open, through the delta-scatter path)",
+        )
         # -- flow observability plane (cilium_tpu.flow) ------------------
         self.flow_records_captured_total = Counter(
             f"{ns}_flow_records_captured_total",

@@ -116,6 +116,15 @@ class CircuitBreaker:
 
     `on_transition(name, old, new, reason)` runs OUTSIDE the lock on
     every state change.
+
+    `probe_ttl` bounds how long a half-open probe slot stays
+    reserved: a probe whose owner never reports back (a dispatch
+    abandoned past its watchdog deadline whose caller thread then
+    died, a chip probe lost with its runtime) would otherwise pin
+    `_half_open_inflight` at the limit and wedge the breaker in
+    half-open forever — no probe can ever run again, so the breaker
+    can neither close nor re-open.  With a TTL, allow() reclaims
+    expired slots before answering the admission question.
     """
 
     def __init__(
@@ -127,19 +136,25 @@ class CircuitBreaker:
         half_open_max: int = 1,
         clock: Callable[[], float] = time.monotonic,
         on_transition: Optional[Callable] = None,
+        probe_ttl: Optional[float] = None,
     ) -> None:
         self.name = name
         self.failure_threshold = max(1, failure_threshold)
         self.recovery_timeout = recovery_timeout
         self.success_threshold = max(1, success_threshold)
         self.half_open_max = max(1, half_open_max)
+        self.probe_ttl = probe_ttl
         self._clock = clock
         self.on_transition = on_transition
         self._lock = threading.Lock()
         self._state = CLOSED
         self._consecutive_failures = 0
         self._half_open_successes = 0
-        self._half_open_inflight = 0
+        # one issue-timestamp per in-flight half-open probe slot
+        # (oldest first) — per-slot so a TTL reclaim of an abandoned
+        # probe can never discard a LIVE probe's reservation when
+        # half_open_max > 1
+        self._probe_issued: list = []
         self._opened_at = 0.0
         self.opened_total = 0
 
@@ -155,7 +170,7 @@ class CircuitBreaker:
             self.opened_total += 1
         if new == HALF_OPEN:
             self._half_open_successes = 0
-            self._half_open_inflight = 0
+            self._probe_issued.clear()
         if new == CLOSED:
             self._consecutive_failures = 0
         listener = self.on_transition
@@ -177,9 +192,32 @@ class CircuitBreaker:
                 else:
                     ok = False
             if self._state == HALF_OPEN:
-                ok = self._half_open_inflight < self.half_open_max
+                now = self._clock()
+                if self.probe_ttl is not None and self._probe_issued:
+                    # probes whose owner vanished without recording:
+                    # reclaim exactly the expired slots so half-open
+                    # can't wedge (see the class docstring) — live
+                    # probes keep their reservation
+                    fresh = [
+                        t for t in self._probe_issued
+                        if now - t < self.probe_ttl
+                    ]
+                    if len(fresh) < len(self._probe_issued):
+                        log.warning(
+                            "reclaiming expired half-open probe "
+                            "slot(s)",
+                            extra={"fields": {
+                                "breaker": self.name,
+                                "reclaimed": len(self._probe_issued)
+                                - len(fresh),
+                                "inflight": len(fresh),
+                                "probe_ttl_s": self.probe_ttl,
+                            }},
+                        )
+                        self._probe_issued = fresh
+                ok = len(self._probe_issued) < self.half_open_max
                 if ok:
-                    self._half_open_inflight += 1
+                    self._probe_issued.append(now)
             elif self._state == CLOSED:
                 ok = True
         if notify is not None:
@@ -199,9 +237,8 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_failures = 0
             if self._state == HALF_OPEN:
-                self._half_open_inflight = max(
-                    0, self._half_open_inflight - 1
-                )
+                if self._probe_issued:
+                    self._probe_issued.pop(0)
                 self._half_open_successes += 1
                 if (
                     self._half_open_successes
@@ -221,9 +258,8 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_failures += 1
             if self._state == HALF_OPEN:
-                self._half_open_inflight = max(
-                    0, self._half_open_inflight - 1
-                )
+                if self._probe_issued:
+                    self._probe_issued.pop(0)
                 notify = self._transition(
                     OPEN, reason or "half-open probe failed"
                 )
@@ -240,6 +276,16 @@ class CircuitBreaker:
                 )
         if notify is not None:
             notify()
+
+    def release_probe(self) -> None:
+        """Give back a half-open probe slot WITHOUT recording a
+        verdict: the admitted dispatch never ran (e.g. the mesh
+        routed the batch to the terminal host fold before launch),
+        so the chip earned neither a success nor a failure — but
+        the reservation must not pin the slot until the TTL."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probe_issued:
+                self._probe_issued.pop()  # the newest reservation
 
     def call(self, fn: Callable, *args, **kwargs):
         if not self.allow():
@@ -275,6 +321,7 @@ class CircuitBreaker:
                 "opened_total": self.opened_total,
                 "failure_threshold": self.failure_threshold,
                 "recovery_timeout": self.recovery_timeout,
+                "half_open_inflight": len(self._probe_issued),
             }
 
     def reset(self) -> None:
@@ -286,6 +333,107 @@ class CircuitBreaker:
             self._consecutive_failures = 0
         if notify is not None:
             notify()
+
+
+class ChipBreakerBank:
+    """Per-chip circuit breakers keyed by device ordinal — the mesh
+    refinement of the process-wide dispatch breaker: a mesh should
+    fail PER CHIP, losing 1/N of its capacity when one chip sickens
+    instead of failing the whole fleet over to the host fold.
+
+    One CircuitBreaker per ordinal, lazily created with shared
+    parameters; `allow(ordinal)` is the per-chip admission question
+    the shard router asks before each launch (a half-open chip's
+    allow() IS its re-admission probe — the dispatch that includes
+    it), and `record_success`/`record_failure` feed per-chip failure
+    attribution back.  `on_transition(ordinal, old, new, reason)`
+    observes every chip's state change (the daemon wires it to the
+    cilium_chip_breaker_state{chip} gauge, monitor events, and the
+    store's outage tracking).  Probes carry a `probe_ttl` so a chip
+    that dies mid-probe cannot wedge its breaker in half-open."""
+
+    def __init__(
+        self,
+        name: str = "engine.dispatch",
+        failure_threshold: int = 1,
+        recovery_timeout: float = 1.0,
+        success_threshold: int = 1,
+        probe_ttl: Optional[float] = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable] = None,
+    ) -> None:
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.recovery_timeout = recovery_timeout
+        self.success_threshold = success_threshold
+        self.probe_ttl = probe_ttl
+        self._clock = clock
+        self.on_transition = on_transition
+        self._lock = threading.Lock()
+        self._breakers: Dict[int, CircuitBreaker] = {}
+
+    def breaker(self, ordinal: int) -> CircuitBreaker:
+        ordinal = int(ordinal)
+        with self._lock:
+            b = self._breakers.get(ordinal)
+            if b is None:
+                # read self.on_transition at FIRE time, not breaker-
+                # creation time: a breaker lazily created before the
+                # failover router rewires the bank (e.g. by an early
+                # states() call) must still reach the router's
+                # ledger/gauge wiring
+                def listener(_n, old, new, why, o=ordinal):
+                    outer = self.on_transition
+                    if outer is not None:
+                        outer(o, old, new, why)
+
+                b = CircuitBreaker(
+                    name=f"{self.name}[chip={ordinal}]",
+                    failure_threshold=self.failure_threshold,
+                    recovery_timeout=self.recovery_timeout,
+                    success_threshold=self.success_threshold,
+                    probe_ttl=self.probe_ttl,
+                    clock=self._clock,
+                    on_transition=listener,
+                )
+                self._breakers[ordinal] = b
+            return b
+
+    def allow(self, ordinal: int) -> bool:
+        return self.breaker(ordinal).allow()
+
+    def record_success(self, ordinal: int) -> None:
+        self.breaker(ordinal).record_success()
+
+    def record_failure(self, ordinal: int, reason: str = "") -> None:
+        self.breaker(ordinal).record_failure(reason)
+
+    def release_probe(self, ordinal: int) -> None:
+        self.breaker(ordinal).release_probe()
+
+    def state(self, ordinal: int) -> str:
+        return self.breaker(ordinal).state
+
+    def states(self) -> Dict[int, str]:
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {o: b.state for o, b in sorted(breakers.items())}
+
+    def open_chips(self) -> Tuple[int, ...]:
+        return tuple(
+            o for o, s in self.states().items() if s != CLOSED
+        )
+
+    def snapshot(self) -> Dict[int, Dict[str, object]]:
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {o: b.snapshot() for o, b in sorted(breakers.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        for b in breakers:
+            b.reset()
 
 
 class DispatchWatchdog:

@@ -1,0 +1,845 @@
+"""Per-chip failover: chip-scoped fault selectors, the N+1 replica
+placement, the replica-aware routed-gather evaluator, and the shard
+router's survivor re-splitting + re-admission rebalance.
+
+The tentpole contract (ISSUE 8): killing any single chip must cost
+the mesh 1/N of its capacity — bit-identically.  Everything the
+survivor set serves (verdicts, both counter tensors, telemetry
+totals) must equal the healthy mesh and the host oracle, the dead
+chip's table slice must be UNREAD (its primary regions can hold
+garbage), and a re-admitted chip replays exactly the rows it missed
+through the delta-scatter path.
+
+Runs on the 8-virtual-device CPU mesh forced by conftest.py.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+
+from cilium_tpu import faultinject
+from cilium_tpu.compiler import partition
+from cilium_tpu.compiler.tables import (
+    FleetCompiler,
+    compile_map_states,
+)
+from cilium_tpu.engine.failover import ChipFailoverRouter
+from cilium_tpu.engine.hostpath import lattice_fold_host
+from cilium_tpu.engine.oracle import evaluate_batch_oracle
+from cilium_tpu.engine.sharded import (
+    make_failover_evaluator,
+    make_replica_store,
+)
+from cilium_tpu.engine.verdict import TupleBatch
+from cilium_tpu.maps.policymap import (
+    INGRESS,
+    PolicyKey,
+    PolicyMapStateEntry,
+)
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.resilience import ChipBreakerBank
+
+from tests.test_verdict_engine import random_map_state, random_tuples
+
+WIDE_IDS = [1, 2, 3, 4, 5] + [256 + i for i in range(120)] + [65536, 70000]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all_faults():
+    faultinject.disarm_all()
+    yield
+    faultinject.disarm_all()
+
+
+def _mesh(dp, tp):
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must force 8 virtual devices"
+    return jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+
+
+def _build(seed, n_eps=3, identity_pad=256, batch=768):
+    rng = np.random.default_rng(seed)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(n_eps)
+    ]
+    tables = compile_map_states(
+        states, WIDE_IDS, identity_pad=identity_pad, filter_pad=16
+    )
+    t = random_tuples(rng, batch, n_eps, WIDE_IDS)
+    return states, tables, t
+
+
+# ---------------------------------------------------------------------------
+# chip-scoped fault selectors
+# ---------------------------------------------------------------------------
+
+
+def test_chip_scoped_spec_parses_and_scopes():
+    spec = faultinject.FaultSpec.parse("raise:chip=3;next=2")
+    assert spec.chip == 3 and spec.next_n == 2
+    faultinject.arm("engine.dispatch", spec)
+    # unscoped call sites (the daemon's guarded_dispatch) never see
+    # a chip-scoped schedule, and out-of-scope ordinals don't
+    # consume it
+    faultinject.fire("engine.dispatch")
+    faultinject.fire("engine.dispatch", chip=2)
+    with pytest.raises(faultinject.FaultInjected) as err:
+        faultinject.fire("engine.dispatch", chip=3)
+    assert err.value.chip == 3
+    with pytest.raises(faultinject.FaultInjected):
+        faultinject.fire("engine.dispatch", chip=3)
+    faultinject.fire("engine.dispatch", chip=3)  # next=2 spent
+    armed = faultinject.armed()["engine.dispatch"]
+    assert armed["chip"] == 3 and armed["fired"] == 2
+
+
+def test_unscoped_spec_fires_for_any_ordinal():
+    faultinject.arm("engine.dispatch", "raise:next=1")
+    with pytest.raises(faultinject.FaultInjected):
+        faultinject.fire("engine.dispatch", chip=5)
+
+
+# ---------------------------------------------------------------------------
+# the N+1 replica placement layer
+# ---------------------------------------------------------------------------
+
+
+def test_replicate_shard_axis_layout():
+    arr = np.arange(8 * 3).reshape(8, 3)
+    aug = partition.replicate_shard_axis(arr, 4, axis=0)
+    assert aug.shape == (16, 3)
+    n = 2
+    for q in range(4):
+        np.testing.assert_array_equal(
+            aug[q * 2 * n : q * 2 * n + n],
+            arr[q * n : (q + 1) * n],
+            err_msg=f"primary region of shard {q}",
+        )
+        left = (q - 1) % 4
+        np.testing.assert_array_equal(
+            aug[q * 2 * n + n : (q + 1) * 2 * n],
+            arr[left * n : (left + 1) * n],
+            err_msg=f"backup region of shard {q}",
+        )
+
+
+def test_replica_positions_roundtrip():
+    n, ntp = 4, 4
+    idx = np.arange(16)
+    primary, backup = partition.replica_positions(idx, n, ntp)
+    arr = np.arange(16)
+    aug = partition.replicate_shard_axis(arr, ntp, 0)
+    np.testing.assert_array_equal(aug[primary], arr)
+    np.testing.assert_array_equal(aug[backup], arr)
+
+
+def test_replica_axes_honours_divisibility():
+    _, tables, _ = _build(seed=0)
+    axes = partition.replica_axes(tables, 4)
+    assert axes == {"l4_hash_rows": 0, "l3_allow_bits": 2}
+    # 5 shards divide neither leaf: nothing to replicate
+    assert partition.replica_axes(tables, 5) == {}
+
+
+def test_replica_digest_differs_from_plain():
+    assert (
+        partition.replica_partition_digest()
+        != partition.partition_digest(
+            partition.default_table_rules()
+        )
+    )
+
+
+def test_replica_bytes_model_overhead_bound():
+    _, tables, _ = _build(seed=0)
+    from cilium_tpu.compiler.delta import tables_nbytes
+
+    rows, per_chip, overhead = partition.replica_bytes_model(
+        tables, 4
+    )
+    _, plain_per_chip, _ = partition.shard_bytes_model(tables, 4)
+    assert per_chip == plain_per_chip + overhead
+    # the N+1 overhead is exactly one extra slice of each replica
+    # leaf — bounded by replicated-bytes/N
+    assert 0 < overhead <= tables_nbytes(tables) // 4
+
+
+# ---------------------------------------------------------------------------
+# replica store: both copies stay bit-identical through delta churn
+# ---------------------------------------------------------------------------
+
+
+def test_replica_store_delta_keeps_both_copies_identical():
+    rng = np.random.default_rng(3)
+    mesh = _mesh(2, 4)
+    ntp = 4
+    store = make_replica_store(mesh)
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(3)
+    ]
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            WIDE_IDS,
+        )[0]
+
+    store.publish(compile_eps())
+    store.publish(compile_eps())
+    n_delta = 0
+    for step in range(20):
+        base = store.spare_stamp()
+        states[step % 3][
+            PolicyKey(
+                int(rng.choice(WIDE_IDS)), 5000 + step, 6, INGRESS
+            )
+        ] = PolicyMapStateEntry()
+        tables = compile_eps()
+        delta = fc.delta_for(base, tables)
+        dev, st = store.publish(tables, delta)
+        if st.mode == "delta":
+            n_delta += 1
+        if step % 5 == 0 or step == 19:
+            aug = partition.replicate_table_leaves(tables, ntp)
+            for name in partition.REPLICA_LEAVES:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(dev, name)),
+                    np.asarray(getattr(aug, name)),
+                    err_msg=f"{name} at step {step}",
+                )
+    assert n_delta >= 18, n_delta
+
+
+def test_replica_digest_gates_cross_layout_delta():
+    """A delta recorded under plain sharding can't scatter into a
+    replica epoch: the replica placement digest differs, so the
+    store full-uploads instead."""
+    rng = np.random.default_rng(4)
+    mesh = _mesh(2, 4)
+    store = make_replica_store(mesh)
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    states = [random_map_state(rng, WIDE_IDS, 8, 8)]
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(0, states[0], (tok[0], 0))], WIDE_IDS
+        )[0]
+
+    store.publish(compile_eps())
+    store.publish(compile_eps())
+    base = store.spare_stamp()
+    states[0][PolicyKey(1, 7777, 6, INGRESS)] = PolicyMapStateEntry()
+    tables = compile_eps()
+    delta = fc.delta_for(base, tables)
+    store.partition_digest = partition.partition_digest(
+        partition.default_table_rules()
+    )
+    _, st = store.publish(tables, delta)
+    assert st.mode == "full"
+
+
+# ---------------------------------------------------------------------------
+# the replica-aware evaluator: a dead chip's slice is never read
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 4), (4, 2)])
+def test_failover_evaluator_dead_column_scribbled_primary(dp, tp):
+    """Kill a whole table column AND scribble its primary regions
+    with garbage: the routed gathers must serve every tuple from the
+    backup copies, bit-identical to the oracle on the full surface —
+    the proof that no verdict depends on the dead chip's slice."""
+    states, tables, t = _build(seed=0)
+    mesh = _mesh(dp, tp)
+    aug = partition.replicate_table_leaves(tables, tp)
+    ev = make_failover_evaluator(mesh, tables, collect_telemetry=True)
+    batch = TupleBatch.from_numpy(**t)
+    valid = np.ones(len(t["ep_index"]), bool)
+
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    alive = np.ones((dp, tp), bool)
+    v, l4, l3, rh, trow = ev(aug, batch, alive, valid)
+    np.testing.assert_array_equal(np.asarray(v.allowed), want[0])
+    np.testing.assert_array_equal(np.asarray(v.proxy_port), want[1])
+    np.testing.assert_array_equal(np.asarray(v.match_kind), want[2])
+    assert int(np.asarray(rh)) == 0
+
+    dead_col = 1
+    aug2 = copy.deepcopy(aug)
+    n = tables.l4_hash_rows.shape[0] // tp
+    rows = np.array(aug2.l4_hash_rows)
+    rows[dead_col * 2 * n : dead_col * 2 * n + n] = 0xDEADBEEF
+    aug2.l4_hash_rows = rows
+    wn = tables.l3_allow_bits.shape[-1] // tp
+    words = np.array(aug2.l3_allow_bits)
+    words[:, :, dead_col * 2 * wn : dead_col * 2 * wn + wn] = (
+        0xFFFFFFFF
+    )
+    aug2.l3_allow_bits = words
+    alive2 = np.ones((dp, tp), bool)
+    alive2[:, dead_col] = False
+    v2, l42, l32, rh2, trow2 = ev(aug2, batch, alive2, valid)
+    np.testing.assert_array_equal(np.asarray(v2.allowed), want[0])
+    np.testing.assert_array_equal(np.asarray(v2.proxy_port), want[1])
+    np.testing.assert_array_equal(np.asarray(v2.match_kind), want[2])
+    np.testing.assert_array_equal(np.asarray(l42), np.asarray(l4))
+    np.testing.assert_array_equal(np.asarray(l32), np.asarray(l3))
+    np.testing.assert_array_equal(
+        np.asarray(trow2), np.asarray(trow)
+    )
+    assert int(np.asarray(rh2)) > 0
+
+
+def test_failover_evaluator_valid_mask_excludes_padding():
+    """Counters and telemetry count exactly the valid tuples: the
+    same batch with half the positions masked must equal the
+    half-batch's own counts."""
+    states, tables, t = _build(seed=1, batch=512)
+    mesh = _mesh(2, 4)
+    aug = partition.replicate_table_leaves(tables, 4)
+    ev = make_failover_evaluator(mesh, tables, collect_telemetry=True)
+    alive = np.ones((2, 4), bool)
+
+    half = {k: v[:256] for k, v in t.items()}
+    half_padded = {
+        k: np.concatenate([v[:256], v[:256]]) for k, v in t.items()
+    }
+    valid = np.concatenate(
+        [np.ones(256, bool), np.zeros(256, bool)]
+    )
+    _, l4_h, l3_h, _, trow_h = ev(
+        aug, TupleBatch.from_numpy(**half_padded), alive, valid
+    )
+    _, l4_w, l3_w, _, trow_w = ev(
+        aug, TupleBatch.from_numpy(**half), alive,
+        np.ones(256, bool),
+    )
+    np.testing.assert_array_equal(np.asarray(l4_h), np.asarray(l4_w))
+    np.testing.assert_array_equal(np.asarray(l3_h), np.asarray(l3_w))
+    np.testing.assert_array_equal(
+        np.asarray(trow_h).astype(np.uint64).sum(axis=0),
+        np.asarray(trow_w).astype(np.uint64).sum(axis=0),
+    )
+
+
+def test_failover_evaluator_rejects_stale_geometry():
+    _, tables, t = _build(seed=0)
+    mesh = _mesh(2, 4)
+    ev = make_failover_evaluator(mesh, tables)
+    with pytest.raises(ValueError, match="geometry"):
+        # un-augmented tables are the wrong layout
+        ev(
+            tables, TupleBatch.from_numpy(**t),
+            np.ones((2, 4), bool),
+            np.ones(len(t["ep_index"]), bool),
+        )
+
+
+# ---------------------------------------------------------------------------
+# the shard router
+# ---------------------------------------------------------------------------
+
+
+def _router_world(seed=0, dp=2, tp=4, batch=768, telemetry=True):
+    states, tables, t = _build(seed=seed, batch=batch)
+    mesh = _mesh(dp, tp)
+
+    def fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            states, ep, ident, dport, proto, dirn, is_fragment=frag
+        )
+
+    bank = ChipBreakerBank(
+        recovery_timeout=0.02, failure_threshold=1
+    )
+    router = ChipFailoverRouter(
+        mesh, tables, bank=bank, collect_telemetry=telemetry,
+        host_fold=fold,
+    )
+    router.publish(tables)
+    router.publish(tables)
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    return router, bank, states, tables, t, want
+
+
+def _check(res, want, tag, degraded=False):
+    np.testing.assert_array_equal(
+        res.verdicts.allowed, want[0], err_msg=tag
+    )
+    np.testing.assert_array_equal(
+        res.verdicts.proxy_port, want[1], err_msg=tag
+    )
+    np.testing.assert_array_equal(
+        res.verdicts.match_kind, want[2], err_msg=tag
+    )
+    assert res.degraded == degraded, (tag, res.degraded)
+
+
+def test_router_single_chip_kill_serves_from_replicas():
+    router, bank, _, _, t, want = _router_world()
+    healthy = router.dispatch(**t)
+    _check(healthy, want, "healthy")
+    assert healthy.replica_hits == 0 and not healthy.rerouted
+
+    victim = int(router.ordinals[0, 1])
+    replica_before = metrics.replica_gather_total.get()
+    faultinject.arm("engine.dispatch", f"raise:chip={victim}")
+    killed = router.dispatch(**t)
+    _check(killed, want, "one chip dead")
+    assert bank.state(victim) != "closed"
+    assert killed.replica_hits > 0
+    assert not killed.rerouted  # the row still serves via backups
+    assert metrics.replica_gather_total.get() > replica_before
+    np.testing.assert_array_equal(
+        killed.l4_counts, healthy.l4_counts
+    )
+    np.testing.assert_array_equal(
+        killed.l3_counts, healthy.l3_counts
+    )
+    np.testing.assert_array_equal(
+        killed.telemetry.astype(np.uint64).sum(axis=0),
+        healthy.telemetry.astype(np.uint64).sum(axis=0),
+    )
+
+
+def test_router_dead_row_resplits_across_survivors():
+    """Primary AND backup owners dead in one mesh row: its batch
+    shard re-splits across the surviving rows — counted in
+    rerouted_batches_total, stream still bit-identical."""
+    router, bank, _, _, t, want = _router_world()
+    healthy = router.dispatch(**t)
+    # kill (0, 1) and its backup owner (0, 2): slice 1 has no owner
+    # within row 0
+    for col in (1, 2):
+        bank.record_failure(
+            int(router.ordinals[0, col]), "test kill"
+        )
+    rerouted_before = metrics.rerouted_batches_total.get()
+    killed = router.dispatch(**t)
+    _check(killed, want, "dead row")
+    assert killed.rerouted
+    assert metrics.rerouted_batches_total.get() > rerouted_before
+    np.testing.assert_array_equal(
+        killed.l4_counts, healthy.l4_counts
+    )
+    np.testing.assert_array_equal(
+        killed.l3_counts, healthy.l3_counts
+    )
+    np.testing.assert_array_equal(
+        killed.telemetry.astype(np.uint64).sum(axis=0),
+        healthy.telemetry.astype(np.uint64).sum(axis=0),
+    )
+
+
+def test_router_mesh_wide_outage_host_folds():
+    router, bank, _, _, t, want = _router_world(telemetry=False)
+    faultinject.arm("engine.dispatch", "raise")  # every chip probe
+    try:
+        res = router.dispatch(**t)
+    finally:
+        faultinject.disarm("engine.dispatch")
+    _check(res, want, "terminal fold", degraded=True)
+    assert router.stats.degraded_batches == 1
+
+
+def test_router_readmission_rebalances_missed_rows():
+    """Kill a chip, churn deltas while it is out, readmit: the
+    half-open probe replays exactly the missed rows through the
+    repair scatter — and the repair genuinely rewrites the device
+    rows (poisoned resident buffers come back equal to the host
+    compile)."""
+    rng = np.random.default_rng(5)
+    mesh = _mesh(2, 4)
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(3)
+    ]
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            WIDE_IDS,
+        )[0]
+
+    tables = compile_eps()
+    t = random_tuples(rng, 768, 3, WIDE_IDS)
+
+    def fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            states, ep, ident, dport, proto, dirn, is_fragment=frag
+        )
+
+    bank = ChipBreakerBank(
+        recovery_timeout=0.02, failure_threshold=1
+    )
+    router = ChipFailoverRouter(
+        mesh, tables, bank=bank, host_fold=fold,
+        collect_telemetry=False,
+    )
+    router.publish(tables)
+    router.publish(compile_eps())
+
+    victim = int(router.ordinals[1, 0])
+    faultinject.arm("engine.dispatch", f"raise:chip={victim};next=1")
+    router.dispatch(**t)
+    assert bank.state(victim) != "closed"
+    assert router.store.chip_outage(victim) is not None
+
+    # two delta publishes while out
+    bytes_per_delta = []
+    for step in range(2):
+        base = router.store.spare_stamp()
+        states[0][
+            PolicyKey(
+                int(rng.choice(WIDE_IDS)), 7000 + step, 6, INGRESS
+            )
+        ] = PolicyMapStateEntry()
+        tables = compile_eps()
+        delta = fc.delta_for(base, tables)
+        _, st = router.publish(tables, delta)
+        assert st.mode == "delta"
+        bytes_per_delta.append(st.bytes_h2d)
+    outage = router.store.chip_outage(victim)
+    assert len(outage["missed"]) == 2 and not outage["needs_full"]
+
+    import time
+
+    time.sleep(0.05)
+    reb_before = metrics.rebalance_bytes_h2d_total.get()
+    res = router.dispatch(**t)
+    assert victim in res.rebalanced_chips
+    assert bank.state(victim) == "closed"
+    assert router.store.chip_outage(victim) is None
+    from cilium_tpu.compiler.delta import tables_nbytes
+
+    assert 0 < res.rebalance_bytes < tables_nbytes(tables)
+    assert (
+        metrics.rebalance_bytes_h2d_total.get() - reb_before
+        == res.rebalance_bytes
+    )
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    _check(res, want, "after readmission")
+    # a failed probe would have re-opened; one more dispatch stays
+    # clean and replica-free
+    again = router.dispatch(**t)
+    _check(again, want, "steady after readmission")
+    assert again.replica_hits == 0
+
+
+def test_repair_rows_rewrites_poisoned_device_rows():
+    """The repair scatter is real: poison the live epoch's resident
+    hash rows (device side), repair a row set, and only those rows
+    come back — the rest stay poisoned."""
+    import dataclasses
+
+    import jax as _jax
+
+    rng = np.random.default_rng(6)
+    mesh = _mesh(2, 4)
+    store = make_replica_store(mesh)
+    states = [random_map_state(rng, WIDE_IDS, 8, 8)]
+    tables = compile_map_states(
+        states, WIDE_IDS, identity_pad=256, filter_pad=16
+    )
+    store.publish(tables)
+    aug = partition.replicate_table_leaves(tables, 4)
+    slot = store._slots[store._cur]
+    poisoned = np.array(np.asarray(slot["tables"].l4_hash_rows))
+    poisoned[:] = 0xBADC0DE
+    slot["tables"] = dataclasses.replace(
+        slot["tables"],
+        l4_hash_rows=_jax.device_put(
+            poisoned, store._shardings.l4_hash_rows
+        ),
+    )
+    idx = np.arange(0, 8, dtype=np.int64)
+    got_bytes = store.repair_rows({"l4_hash_rows": (0, idx)})
+    assert got_bytes > 0
+    resident = np.asarray(
+        store._slots[store._cur]["tables"].l4_hash_rows
+    )
+    np.testing.assert_array_equal(
+        resident[:8], np.asarray(aug.l4_hash_rows)[:8]
+    )
+    assert (resident[8:] == 0xBADC0DE).all()
+
+
+def test_full_upload_while_out_downgrades_to_whole_slice():
+    """A full (non-delta) publish while a chip is out marks its
+    ledger needs_full: readmission replays the chip's whole owned
+    regions — still below a full upload."""
+    rng = np.random.default_rng(7)
+    mesh = _mesh(2, 4)
+    store = make_replica_store(mesh)
+    states = [random_map_state(rng, WIDE_IDS, 8, 8)]
+    tables = compile_map_states(
+        states, WIDE_IDS, identity_pad=256, filter_pad=16
+    )
+    store.publish(tables)
+    store.mark_chip_out(3)
+    store.publish(tables)  # no delta -> full
+    outage = store.chip_outage(3)
+    assert outage["needs_full"]
+
+
+def test_dispatch_empty_batch_returns_empty_result():
+    router, _, _, _, _, _ = _router_world(telemetry=False)
+    res = router.dispatch(
+        ep_index=[], identity=[], dport=[], proto=[], direction=[]
+    )
+    assert len(res.verdicts.allowed) == 0
+    assert not res.degraded and not res.rerouted
+
+
+def test_failover_l3_counts_exact_when_l3_plane_replicated():
+    """identity_pad=160 → 5 bit-words, indivisible by tp=2: the L3
+    plane replicates (rule-layer fallback) while the 64 hash rows
+    still shard.  Every MATCH_L3 tuple must count exactly ONCE — a
+    replicated plane makes p2_local identical on every table chip,
+    so summing it over the table axis would inflate each hit by
+    tp."""
+    from cilium_tpu.engine.oracle import MATCH_L3
+
+    states, tables, t = _build(seed=3, identity_pad=160)
+    assert tables.l3_allow_bits.shape[-1] == 5
+    mesh = _mesh(4, 2)
+    ev = make_failover_evaluator(mesh, tables)
+    assert "l3_allow_bits" not in ev.replica_axes
+    assert "l4_hash_rows" in ev.replica_axes
+    aug = partition.replicate_table_leaves(tables, 2)
+    valid = np.ones(len(t["ep_index"]), bool)
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    n_l3 = int((want[2] == MATCH_L3).sum())
+    assert n_l3 > 0
+    for dead in (None, (0, 0)):
+        alive = np.ones((4, 2), bool)
+        if dead is not None:
+            alive[dead] = False
+        v, _, l3c, _ = ev(
+            aug, TupleBatch.from_numpy(**t), alive, valid
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v.allowed), want[0], err_msg=str(dead)
+        )
+        assert int(np.asarray(l3c).sum()) == n_l3, dead
+
+
+def test_failover_l3_counts_fold_matches_partitioned_reference():
+    """The sharded L3 counter plane stays shard-local on device
+    (primary/backup regions) and is folded back to the global
+    [E, 2, N] counter on host: the fold must equal the partitioned
+    evaluator's statically-owned global counter — healthy AND with
+    a dead column whose hits were counted in backup regions."""
+    from cilium_tpu.engine.sharded import make_partitioned_evaluator
+
+    states, tables, t = _build(seed=4)
+    valid = np.ones(len(t["ep_index"]), bool)
+    mesh = _mesh(2, 4)
+    batch = TupleBatch.from_numpy(**t)
+    _, _, l3_ref = make_partitioned_evaluator(mesh, tables)(
+        tables, batch
+    )
+    l3_ref = np.asarray(l3_ref)
+    assert int(l3_ref.sum()) > 0
+    ev = make_failover_evaluator(mesh, tables)
+    assert "l3_allow_bits" in ev.replica_axes
+    aug = partition.replicate_table_leaves(tables, 4)
+    for dead_col in (None, 2):
+        alive = np.ones((2, 4), bool)
+        if dead_col is not None:
+            alive[:, dead_col] = False
+        _, _, l3c, _ = ev(aug, batch, alive, valid)
+        np.testing.assert_array_equal(
+            np.asarray(l3c), l3_ref, err_msg=str(dead_col)
+        )
+
+
+def test_terminal_fold_releases_half_open_probe_slots():
+    """A dispatch that ends in the terminal host fold never launches
+    the probe it admitted: the half-open slot must be given back, or
+    a healthy, already-rebalanced chip stays locked out for
+    probe_ttl after the OTHER chips' deaths forced the fold."""
+    import time
+
+    router, bank, _, _, t, want = _router_world(telemetry=False)
+    victim = int(router.ordinals[0, 0])
+    bank.record_failure(victim, "test kill")
+    time.sleep(0.05)  # past recovery_timeout: next allow is a probe
+    # every OTHER chip dies at the fault seam this dispatch, so no
+    # mesh row is usable and the batch takes the terminal fold; the
+    # victim's half-open admission must not leak its probe slot
+    others = [
+        int(o) for o in router.ordinals.ravel() if int(o) != victim
+    ]
+    for o in others:
+        bank.record_failure(o, "test kill")
+    res = router.dispatch(**t)
+    _check(res, want, "terminal fold", degraded=True)
+    snap = bank.snapshot()[victim]
+    assert snap["half_open_inflight"] == 0, snap
+    # the victim is NOT locked out: once the others recover it is
+    # probed and closes
+    for o in others:
+        bank.breaker(o).reset()
+    again = router.dispatch(**t)
+    _check(again, want, "after recovery")
+    assert bank.state(victim) == "closed"
+
+
+def test_failed_rebalance_restores_outage_ledger():
+    """A repair scatter that FAILS mid-readmission must not lose the
+    chip's outage ledger: readmit_chip pops the record before the
+    scatter runs, so the failure path puts it back (downgraded to
+    needs_full — the scatter may have partially landed) and the NEXT
+    readmission replays the whole owned regions instead of finding
+    an empty fresh record and replaying nothing."""
+    import time
+
+    router, bank, _, tables, t, want = _router_world()
+    store = router.store
+    victim = int(router.ordinals[1, 2])
+    bank.record_failure(victim, "test kill")  # opens -> ledger starts
+    router.publish(tables)  # full publish while out -> needs_full
+    assert store.chip_outage(victim)["needs_full"]
+
+    real_repair = store.repair_rows
+
+    def broken_repair(row_sets):
+        raise RuntimeError("transient device error")
+
+    store.repair_rows = broken_repair
+    time.sleep(0.05)
+    res = router.dispatch(**t)  # half-open probe: rebalance fails
+    _check(res, want, "failed rebalance")
+    assert victim not in res.rebalanced_chips
+    assert bank.state(victim) != "closed"  # probe failed, re-opened
+    outage = store.chip_outage(victim)
+    assert outage is not None and outage["needs_full"]
+
+    store.repair_rows = real_repair
+    time.sleep(0.05)
+    reb_before = metrics.rebalance_bytes_h2d_total.get()
+    res = router.dispatch(**t)
+    _check(res, want, "second readmission")
+    assert victim in res.rebalanced_chips
+    assert res.rebalance_bytes > 0  # the whole-region replay ran
+    assert (
+        metrics.rebalance_bytes_h2d_total.get() - reb_before
+        == res.rebalance_bytes
+    )
+    assert bank.state(victim) == "closed"
+    assert store.chip_outage(victim) is None
+
+
+def test_router_chains_caller_bank_listener():
+    """A bank handed in with its OWN on_transition must not displace
+    the router's wiring: both the caller's listener and the outage
+    ledger / breaker gauge fire on a transition."""
+    seen = []
+    states, tables, t = _build(seed=1)
+    mesh = _mesh(2, 4)
+    bank = ChipBreakerBank(
+        recovery_timeout=60.0, failure_threshold=1,
+        on_transition=lambda o, old, new, why: seen.append(
+            (int(o), old, new)
+        ),
+    )
+    router = ChipFailoverRouter(mesh, tables, bank=bank)
+    router.publish(tables)
+    victim = int(router.ordinals[0, 0])
+    bank.record_failure(victim, "test kill")
+    assert seen and seen[-1] == (victim, "closed", "open")
+    # the router's own wiring still ran: the ledger opened and the
+    # gauge was set
+    assert router.store.chip_outage(victim) is not None
+    assert "cilium_chip_breaker_state" in metrics.expose()
+
+
+def test_plain_store_does_not_retain_host_pytree():
+    """Only stores with a device-layout seam (replica stores) have a
+    repair consumer for the retained host arrays; a plain store must
+    not pin extra full host copies."""
+    from cilium_tpu.engine.publish import DeviceTableStore
+
+    rng = np.random.default_rng(9)
+    states = [random_map_state(rng, WIDE_IDS, 8, 8)]
+    tables = compile_map_states(
+        states, WIDE_IDS, identity_pad=256, filter_pad=16
+    )
+    plain = DeviceTableStore()
+    plain.publish(tables)
+    assert plain._slots[plain._cur]["host"] is None
+    with pytest.raises(RuntimeError, match="host source"):
+        plain.repair_rows({"l4_hash_rows": (0, np.arange(4))})
+    replica = make_replica_store(_mesh(2, 4))
+    replica.publish(tables)
+    assert replica._slots[replica._cur]["host"] is not None
+
+
+def test_pack_identity_fast_path():
+    """The fully-healthy, already-aligned batch (every row usable,
+    per-row shard size a power of two) skips the re-split copies and
+    the output gather — and stays bit-identical end to end."""
+    router, bank, _, _, t, want = _router_world(seed=2, batch=1024)
+    cols = {
+        "ep_index": np.asarray(t["ep_index"], np.int32),
+        "identity": np.asarray(t["identity"], np.uint32),
+        "dport": np.asarray(t["dport"], np.int32),
+        "proto": np.asarray(t["proto"], np.int32),
+        "direction": np.asarray(t["direction"], np.int32),
+        "is_fragment": np.zeros(1024, bool),
+    }
+    padded, valid, positions = router._pack(
+        cols, np.ones(router.dp, bool)
+    )
+    assert positions is None and valid.all()
+    assert padded["ep_index"] is cols["ep_index"]  # no copy
+    # a dead row forces the general path
+    usable = np.ones(router.dp, bool)
+    usable[0] = False
+    _, _, positions = router._pack(cols, usable)
+    assert positions is not None
+    res = router.dispatch(**t)  # 1024/2 rows = 512 = pow2: fast path
+    _check(res, want, "fast path healthy")
+
+
+def test_router_health_surfaces_in_daemon():
+    """attach_mesh_router: chip transitions publish AgentNotify
+    events and health() names the sick ordinal."""
+    from cilium_tpu.daemon import Daemon
+    from cilium_tpu.monitor.events import AgentNotify
+
+    router, bank, _, _, t, want = _router_world(telemetry=False)
+    d = Daemon()
+    d.attach_mesh_router(router)
+    q = d.monitor.subscribe_queue()
+    victim = int(router.ordinals[0, 0])
+    bank.record_failure(victim, "test kill")
+    health = d.health()
+    assert health["status"] == "degraded"
+    assert any(
+        f"chip {victim}" in r for r in health["reasons"]
+    )
+    assert health["chips"][str(victim)] != "closed"
+    assert any(
+        isinstance(e, AgentNotify) and e.kind == "chip-breaker"
+        for e in q
+    )
+    assert "cilium_chip_breaker_state" in metrics.expose()
+    bank.breaker(victim).reset()
+    assert d.health()["status"] == "ok"

@@ -173,6 +173,161 @@ def test_breaker_call_wrapper():
     assert b.call(lambda: "ok") == "ok"
 
 
+def test_half_open_probe_watchdog_timeout_releases_slot():
+    """Regression (ISSUE 8 satellite): a half-open probe timed out
+    by the DispatchWatchdog must release its _half_open_inflight
+    slot when the caller records the failure — a hung probe must not
+    wedge the breaker in half-open forever."""
+    b = _ticking_breaker(failure_threshold=1, recovery_timeout=0.05)
+    b.record_failure()
+    while not b.allow():  # the half-open probe slot is taken
+        pass
+    assert b.snapshot()["half_open_inflight"] == 1
+    wd = DispatchWatchdog(timeout=0.05)
+    with pytest.raises(DeadlineExceeded):
+        wd.run(lambda: time.sleep(1.0))  # the probe hangs
+    b.record_failure("probe exceeded watchdog deadline")
+    snap = b.snapshot()
+    assert snap["half_open_inflight"] == 0
+    assert snap["state"] == OPEN
+    # the breaker is NOT wedged: after the recovery timeout a fresh
+    # probe is admitted again
+    while not b.allow():
+        pass
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_half_open_probe_ttl_reclaims_abandoned_slot():
+    """The accounting fix: a probe whose OWNER vanishes without ever
+    recording (caller thread died with its abandoned watchdog
+    worker) would pin the slot forever; probe_ttl lets allow()
+    reclaim it so half-open cannot wedge."""
+    clock = itertools.count(0.0, 0.1)
+    b = CircuitBreaker(
+        "t",
+        failure_threshold=1,
+        recovery_timeout=0.3,
+        probe_ttl=0.5,
+        clock=lambda: next(clock),
+    )
+    b.record_failure()
+    while not b.allow():
+        pass
+    # owner never reports back.  Without the TTL every further
+    # allow() would return False forever; with it, the slot expires
+    # on the fake clock and a new probe is admitted.
+    admitted = False
+    for _ in range(20):
+        if b.allow():
+            admitted = True
+            break
+    assert admitted, "breaker wedged in half-open"
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_no_ttl_probe_slot_stays_reserved():
+    """Without probe_ttl the slot is only released by record_*"""
+    clock = itertools.count(0.0, 0.1)
+    b = CircuitBreaker(
+        "t", failure_threshold=1, recovery_timeout=0.3,
+        clock=lambda: next(clock),
+    )
+    b.record_failure()
+    while not b.allow():
+        pass
+    assert not any(b.allow() for _ in range(20))
+
+
+def test_probe_ttl_multi_slot_keeps_live_probe_reservation():
+    """half_open_max > 1: the TTL reclaim must expire exactly the
+    abandoned slot(s).  One shared issue-timestamp would let a newer
+    probe refresh the window and keep an older abandoned slot alive
+    forever; wholesale zeroing would discard a LIVE probe's
+    reservation and over-admit."""
+    t = [0.0]
+    b = CircuitBreaker(
+        "t", failure_threshold=1, recovery_timeout=1.0,
+        half_open_max=2, success_threshold=2,
+        probe_ttl=5.0, clock=lambda: t[0],
+    )
+    b.record_failure()
+    t[0] = 1.0
+    assert b.allow()  # probe A @1.0 — its owner will vanish
+    t[0] = 4.5
+    assert b.allow()  # probe B @4.5 — live
+    assert b.snapshot()["half_open_inflight"] == 2
+    assert not b.allow()  # both slots held
+    t[0] = 6.5  # A expired (ttl 5), B still fresh
+    assert b.allow()  # reclaims ONLY A's slot, admits probe C
+    assert b.snapshot()["half_open_inflight"] == 2
+    assert not b.allow()  # B's live reservation was kept
+    b.record_success()  # B reports
+    b.record_success()  # C reports
+    assert b.state == CLOSED
+
+
+# -- ChipBreakerBank ----------------------------------------------------------
+
+
+def test_bank_listener_rebind_reaches_existing_breakers():
+    """The bank reads on_transition at FIRE time: a breaker lazily
+    created before the failover router rewires the bank (e.g. by an
+    early states() read) must still reach the router's ledger/gauge
+    wiring."""
+    from cilium_tpu.resilience import ChipBreakerBank
+
+    bank = ChipBreakerBank(
+        failure_threshold=1, recovery_timeout=1e9
+    )
+    assert bank.state(3) == CLOSED  # lazily creates chip 3's breaker
+    events = []
+    bank.on_transition = (
+        lambda o, old, new, why: events.append((o, new))
+    )
+    bank.record_failure(3, "boom")
+    assert events == [(3, OPEN)]
+
+
+def test_chip_breaker_bank_independent_chips():
+    from cilium_tpu.resilience import ChipBreakerBank
+
+    events = []
+    bank = ChipBreakerBank(
+        failure_threshold=1,
+        recovery_timeout=1e9,
+        on_transition=lambda o, old, new, why: events.append(
+            (o, old, new)
+        ),
+    )
+    assert bank.allow(0) and bank.allow(1)
+    bank.record_failure(3, "boom")
+    assert bank.state(3) == OPEN
+    assert bank.states()[3] == OPEN
+    assert bank.open_chips() == (3,)
+    # other ordinals unaffected
+    assert bank.allow(0) and not bank.allow(3)
+    assert events == [(3, CLOSED, OPEN)]
+    assert bank.breaker(3).name == "engine.dispatch[chip=3]"
+    bank.reset()
+    assert bank.open_chips() == ()
+
+
+def test_chip_breaker_bank_half_open_recovery():
+    from cilium_tpu.resilience import ChipBreakerBank
+
+    bank = ChipBreakerBank(
+        failure_threshold=1, recovery_timeout=0.01
+    )
+    bank.record_failure(2, "boom")
+    deadline = time.monotonic() + 2.0
+    while not bank.allow(2) and time.monotonic() < deadline:
+        time.sleep(0.005)
+    bank.record_success(2)
+    assert bank.state(2) == CLOSED
+
+
 def test_breaker_success_threshold():
     b = _ticking_breaker(
         failure_threshold=1,

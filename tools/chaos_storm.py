@@ -24,6 +24,17 @@ Also storms the satellite seams: overload shedding under a bounded
 admission gate (shed flows counted under the canonical Overload drop
 reason) and a corrupt record buffer rejected with a clean ValueError.
 
+`--mesh` runs the PER-CHIP storm instead (engine/failover.py): a
+chip-scoped fault kills exactly one device ordinal mid-stream at
+table-axis sizes {2, 4}, and the storm asserts the per-chip failure
+domain's whole contract — stream bit-identity to the healthy mesh
+and the host oracle (verdicts, counters, telemetry totals), replica
+gathers serving the dead primary's rows, exactly-once batch
+accounting (no dropped or duplicated batch), and a half-open
+re-admission that rebalances the chip through the delta-scatter path
+with bytes_h2d strictly below a full upload, leaving every chip's
+resident slice equal to the host compile.
+
 Fast single-cycle coverage runs in tier-1
 (tests/test_chaos_storm.py); THIS standalone form is the full storm —
 bigger stream, multiple breaker cycles:  python tools/chaos_storm.py
@@ -38,6 +49,15 @@ import time
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+
+# the mesh storm needs virtual devices BEFORE jax initializes; the
+# flag only affects XLA's host platform, so a real accelerator run
+# is untouched (and the daemon storm is single-device either way)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import numpy as np  # noqa: E402
 
@@ -231,7 +251,302 @@ def run_storm(
     return result
 
 
+# ---------------------------------------------------------------------------
+# per-chip mesh storm (engine/failover.py)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_world(seed: int, n_eps: int = 3, identity_pad: int = 256):
+    """Self-contained policy world at routed-gather scale: wide
+    identities so the L3 bit plane spans several words, enough L4
+    keys that the hashed rows spread over every shard slice."""
+    from cilium_tpu.compiler.tables import FleetCompiler
+    from cilium_tpu.maps.policymap import (
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    rng = np.random.default_rng(seed)
+    ids = [1, 2, 3, 4, 5] + [256 + i for i in range(120)] + [65536]
+    states = []
+    for _ in range(n_eps):
+        state = {}
+        for _ in range(16):
+            d = int(rng.integers(0, 2))
+            port = int(rng.choice([53, 80, 443, 8080, 9090]))
+            proto = int(rng.choice([6, 17]))
+            proxy = 15001 if (port + proto + d) % 3 == 0 else 0
+            for num_id in rng.choice(ids, size=3, replace=True):
+                state[PolicyKey(int(num_id), port, proto, d)] = (
+                    PolicyMapStateEntry(proxy_port=proxy)
+                )
+        for _ in range(24):
+            d = int(rng.integers(0, 2))
+            state[PolicyKey(int(rng.choice(ids)), 0, 0, d)] = (
+                PolicyMapStateEntry()
+            )
+        states.append(state)
+    fc = FleetCompiler(identity_pad=identity_pad, filter_pad=16)
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            ids,
+        )[0]
+
+    return states, ids, fc, compile_eps
+
+
+def _mesh_tuples(rng, b, n_eps, ids):
+    return dict(
+        ep_index=rng.integers(0, n_eps, size=b),
+        identity=rng.choice(
+            np.concatenate([np.asarray(ids), [999999, 7]]), size=b
+        ).astype(np.uint32),
+        dport=rng.choice([53, 80, 443, 8080, 9090, 1234], size=b),
+        proto=rng.choice([6, 17, 1], size=b),
+        direction=rng.integers(0, 2, size=b),
+        is_fragment=rng.random(size=b) < 0.1,
+    )
+
+
+def _stream(router, tuples, batch_size):
+    """Drive the tuple stream through the router batch by batch;
+    returns (per-field concatenated verdict columns, summed counter
+    tensors, summed telemetry rows, per-batch tuple counts, results).
+    The per-batch counts are the exactly-once ledger: their sum must
+    equal the stream length, with every batch represented once."""
+    cols = {}
+    counts = []
+    results = []
+    l4 = l3 = telem = None
+    n = len(tuples["ep_index"])
+    for start in range(0, n, batch_size):
+        sl = slice(start, min(start + batch_size, n))
+        res = router.dispatch(
+            **{k: v[sl] for k, v in tuples.items()}
+        )
+        results.append(res)
+        counts.append(len(res.verdicts.allowed))
+        for f in ("allowed", "proxy_port", "match_kind"):
+            cols.setdefault(f, []).append(
+                np.asarray(getattr(res.verdicts, f))
+            )
+        if res.l4_counts is not None:
+            l4 = res.l4_counts if l4 is None else l4 + res.l4_counts
+            l3 = res.l3_counts if l3 is None else l3 + res.l3_counts
+        if res.telemetry is not None:
+            t = res.telemetry.astype(np.uint64).sum(axis=0)
+            telem = t if telem is None else telem + t
+    return (
+        {f: np.concatenate(v) for f, v in cols.items()},
+        l4, l3, telem, counts, results,
+    )
+
+
+def _assert_streams_equal(want, got, tag):
+    for f in ("allowed", "proxy_port", "match_kind"):
+        np.testing.assert_array_equal(
+            want[0][f], got[0][f],
+            err_msg=f"{tag}: verdict stream diverged in {f}",
+        )
+    for name, w, g in (("l4", want[1], got[1]), ("l3", want[2], got[2])):
+        if w is not None:
+            np.testing.assert_array_equal(
+                w, g, err_msg=f"{tag}: {name} counters diverged"
+            )
+    if want[3] is not None:
+        np.testing.assert_array_equal(
+            want[3], got[3],
+            err_msg=f"{tag}: telemetry totals diverged",
+        )
+
+
+def _assert_resident_equals_host(router, tables, ntp):
+    """Every chip's resident slice of each replica leaf equals the
+    owning slice of the augmented host compile (the post-rebalance
+    acceptance assertion)."""
+    from cilium_tpu.compiler import partition
+
+    aug = partition.replicate_table_leaves(tables, ntp)
+    _, dev = router.store.current()
+    pos = {
+        int(d.id): tuple(idx)
+        for idx, d in np.ndenumerate(router.mesh.devices)
+    }
+    for name, axis in partition.replica_axes(tables, ntp).items():
+        h = np.asarray(getattr(aug, name))
+        d = getattr(dev, name)
+        np.testing.assert_array_equal(
+            np.asarray(d), h, err_msg=f"{name} global"
+        )
+        per_shard = h.shape[axis] // ntp
+        for sh in d.addressable_shards:
+            col = pos[int(sh.device.id)][1]
+            sl = [slice(None)] * h.ndim
+            sl[axis] = slice(col * per_shard, (col + 1) * per_shard)
+            np.testing.assert_array_equal(
+                np.asarray(sh.data), h[tuple(sl)],
+                err_msg=f"{name} shard on device {sh.device.id}",
+            )
+
+
+def run_mesh_storm(
+    tp: int = 4,
+    n_flows: int = 2048,
+    batch_size: int = 256,
+    churn_steps: int = 3,
+    seed: int = 7,
+    verbose: bool = True,
+) -> dict:
+    """One per-chip storm cycle at table-axis size `tp` (the asserts
+    ARE the test): healthy reference stream → kill one chip
+    mid-stream via the chip-scoped fault site → bit-identical
+    degraded stream (replica gathers + survivor re-split, exactly
+    once per batch) → churn deltas while the chip is out → half-open
+    re-admission rebalances it through the delta-scatter path with
+    bytes below a full upload and resident slices equal to the host
+    compile → a final healthy stream matches the reference again."""
+    import copy
+
+    import jax
+
+    from cilium_tpu import faultinject
+    from cilium_tpu.compiler.delta import tables_nbytes
+    from cilium_tpu.engine.failover import ChipFailoverRouter
+    from cilium_tpu.engine.hostpath import lattice_fold_host
+    from cilium_tpu.engine.oracle import evaluate_batch_oracle
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+    from cilium_tpu.metrics import registry as metrics
+    from cilium_tpu.resilience import ChipBreakerBank
+
+    devs = jax.devices()
+    assert len(devs) % tp == 0, (len(devs), tp)
+    dp = len(devs) // tp
+    mesh = jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+    rng = np.random.default_rng(seed)
+    states, ids, fc, compile_eps = _mesh_world(seed)
+    tables = compile_eps()
+
+    def fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            states, ep, ident, dport, proto, dirn, is_fragment=frag
+        )
+
+    bank = ChipBreakerBank(
+        recovery_timeout=0.02, failure_threshold=1
+    )
+    router = ChipFailoverRouter(
+        mesh, tables, bank=bank, collect_telemetry=True,
+        host_fold=fold,
+    )
+    router.publish(tables)
+    router.publish(compile_eps())  # prime both epochs
+    tuples = _mesh_tuples(rng, n_flows, len(states), ids)
+
+    # ---- healthy reference stream (gated against the host oracle) ------
+    want = _stream(router, tuples, batch_size)
+    assert sum(want[4]) == n_flows
+    oracle = evaluate_batch_oracle(copy.deepcopy(states), **tuples)
+    np.testing.assert_array_equal(want[0]["allowed"], oracle[0])
+    np.testing.assert_array_equal(want[0]["proxy_port"], oracle[1])
+    np.testing.assert_array_equal(want[0]["match_kind"], oracle[2])
+    assert router.stats.degraded_batches == 0
+
+    # ---- kill one chip mid-stream --------------------------------------
+    victim = int(router.ordinals[dp - 1, tp - 1])
+    replica_before = metrics.replica_gather_total.get()
+    faultinject.arm("engine.dispatch", f"raise:chip={victim}")
+    try:
+        got = _stream(router, tuples, batch_size)
+    finally:
+        faultinject.disarm("engine.dispatch")
+    # exactly-once accounting: every batch served once, no tuple
+    # dropped or duplicated, and none of it fell to the host fold
+    assert got[4] == want[4], (got[4], want[4])
+    assert sum(got[4]) == n_flows
+    assert router.stats.degraded_batches == 0
+    _assert_streams_equal(want, got, f"tp={tp} one chip dead")
+    assert bank.state(victim) != "closed"
+    if tp > 1:
+        # the dead primary's rows served from its backup owner
+        assert metrics.replica_gather_total.get() > replica_before
+
+    # ---- churn deltas while the chip is out ----------------------------
+    n_delta = 0
+    for step in range(churn_steps):
+        base = router.store.spare_stamp()
+        states[step % len(states)][
+            PolicyKey(int(rng.choice(ids)), 6000 + step, 6, INGRESS)
+        ] = PolicyMapStateEntry()
+        fresh = compile_eps()
+        delta = fc.delta_for(base, fresh)
+        _, st = router.publish(fresh, delta)
+        if st.mode == "delta":
+            n_delta += 1
+        tables = fresh
+    assert n_delta == churn_steps, (
+        f"churn fell off the delta path ({n_delta}/{churn_steps})"
+    )
+    outage = router.store.chip_outage(victim)
+    assert outage is not None and len(outage["missed"]) == n_delta
+
+    # ---- re-admission: half-open probe rebalances through the
+    # delta-scatter path --------------------------------------------------
+    time.sleep(bank.recovery_timeout * 2)
+    want2 = evaluate_batch_oracle(copy.deepcopy(states), **tuples)
+    after = _stream(router, tuples, batch_size)
+    assert bank.state(victim) == "closed", bank.states()
+    readmitted = [
+        r for r in after[5] if victim in r.rebalanced_chips
+    ]
+    assert len(readmitted) == 1, "rebalance must run exactly once"
+    reb = readmitted[0]
+    full_bytes = tables_nbytes(tables)
+    assert 0 < reb.rebalance_bytes < full_bytes, (
+        reb.rebalance_bytes, full_bytes,
+    )
+    np.testing.assert_array_equal(after[0]["allowed"], want2[0])
+    np.testing.assert_array_equal(after[0]["proxy_port"], want2[1])
+    np.testing.assert_array_equal(after[0]["match_kind"], want2[2])
+    _assert_resident_equals_host(router, tables, tp)
+
+    result = {
+        "tp": tp,
+        "flows": n_flows,
+        "batches": len(want[4]),
+        "victim_chip": victim,
+        "replica_hits": router.stats.replica_hits,
+        "rerouted_batches": router.stats.rerouted_batches,
+        "rebalance_bytes": reb.rebalance_bytes,
+        "rebalance_ms": round(reb.rebalance_ms, 2),
+        "full_upload_bytes": full_bytes,
+        "chips": {str(k): v for k, v in bank.states().items()},
+    }
+    if verbose:
+        print(f"mesh storm (tp={tp}): all invariants held")
+        for k, v in result.items():
+            print(f"  {k}: {v}")
+    return result
+
+
 def main() -> int:
+    if "--mesh" in sys.argv:
+        # the per-chip failover storm at both acceptance table-axis
+        # sizes; one chip dies mid-stream, survivors + replicas keep
+        # the stream bit-identical, re-admission rebalances
+        for tp in (2, 4):
+            run_mesh_storm(tp=tp)
+        print("OK")
+        return 0
     run_storm()
     # a second, harsher cycle: schedule longer than the stream's
     # batch count — the whole tail serves from the host path

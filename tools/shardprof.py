@@ -148,6 +148,15 @@ def main() -> None:
         else:
             entry["entry_load_per_shard"] = None
             entry["entry_load_skew"] = None
+        # the N+1 replica layout (per-chip failover placement): each
+        # replica-rule leaf's chip slice doubles (its own rows + the
+        # left neighbour's backup copy) — the HBM price of losing a
+        # chip without losing its table rows
+        rep_rows, rep_per_chip, rep_overhead = (
+            partition.replica_bytes_model(tables, ntp)
+        )
+        entry["replica_bytes_per_chip_model"] = rep_per_chip
+        entry["replica_overhead_per_chip"] = rep_overhead
         # measured per-chip bytes from a real partitioned publish
         if len(devs) % ntp == 0:
             mesh = jax.sharding.Mesh(
@@ -162,6 +171,14 @@ def main() -> None:
             )
             entry["bytes_skew"] = round(
                 skew(list(per_chip.values())), 3
+            )
+            # ... and from a real N+1 replica publish
+            from cilium_tpu.engine.sharded import make_replica_store
+
+            rstore = make_replica_store(mesh)
+            rstore.publish(tables)
+            entry["replica_bytes_per_chip_measured"] = max(
+                rstore.chip_bytes().values()
             )
         report["shards"].append(entry)
 
@@ -209,6 +226,13 @@ def main() -> None:
                     f"  measured bytes/chip {vals[0] / 1e6:.1f} MB "
                     f"(skew {entry['bytes_skew']}x, both epochs)"
                 )
+            print(
+                f"  N+1 replica layout "
+                f"{entry['replica_bytes_per_chip_model'] / 1e6:.1f}"
+                f" MB/chip (replica overhead "
+                f"{entry['replica_overhead_per_chip'] / 1e6:.1f}"
+                f" MB/chip)"
+            )
 
     for entry in report["shards"]:
         if entry["entry_load_skew"] is not None:
@@ -236,6 +260,31 @@ def main() -> None:
             assert measured <= bound, (
                 f"{entry['num_shards']}-shard measured per-chip "
                 f"{measured} over the acceptance bound {bound}"
+            )
+        # N+1 replica acceptance bound: the replica overhead per
+        # chip (the backup copies) stays within replicated-bytes/N,
+        # so the whole replica layout fits in
+        # 2 * replicated-bytes/N + the replicated-leaf overhead
+        ntp = entry["num_shards"]
+        assert entry["replica_overhead_per_chip"] <= full // ntp, (
+            f"{ntp}-shard replica overhead "
+            f"{entry['replica_overhead_per_chip']} over "
+            f"replicated-bytes/N = {full // ntp}"
+        )
+        replica_bound = 2 * (full // ntp) + (
+            entry["replicated_leaf_overhead"]
+        )
+        assert (
+            entry["replica_bytes_per_chip_model"] <= replica_bound
+        )
+        if "replica_bytes_per_chip_measured" in entry:
+            assert (
+                entry["replica_bytes_per_chip_measured"]
+                <= replica_bound
+            ), (
+                f"{ntp}-shard measured replica per-chip "
+                f"{entry['replica_bytes_per_chip_measured']} over "
+                f"the N+1 bound {replica_bound}"
             )
     print("shardprof OK")
 
