@@ -397,6 +397,21 @@ def make_flow_pool(args, rng, ep_ip, id_ips, vips, all_ports, index,
     }
 
 
+def zipf_picks(prng, n: int, size: int, s: float) -> np.ndarray:
+    """Ranked-Zipf sample of pool rows: rank r (1-based) drawn with
+    probability ∝ r^-s, ranks mapped through a per-prng random
+    permutation so the head flows are arbitrary pool rows, not row 0.
+    s≈1.1 is the trace-skew shape real identity-pair/port traffic
+    shows (millions of tuples, few distinct policy keys); s=0 is
+    uniform.  Shared with tools/cacheprof.py so the hit-rate curve
+    and the bench's effective line sample the same distribution."""
+    ranks = np.arange(1, n + 1, dtype=np.float64)
+    w = ranks ** -float(s)
+    w /= w.sum()
+    perm = prng.permutation(n)
+    return perm[prng.choice(n, size=size, p=w)]
+
+
 def encode_pool_sample(pool, picks):
     from cilium_tpu.native import encode_flow_records
 
@@ -1484,6 +1499,353 @@ def run_config5(args) -> None:
         ),
     )
 
+    # --- verdict memoization: intra-batch dedup + device verdict cache -----
+    # (engine/memo.py).  The headline verdicts_per_sec_per_chip above
+    # stays the skew-INDEPENDENT baseline (uniform pool replay through
+    # the uncached program); this section measures what the two-level
+    # memo plane buys on Zipf/trace-skewed traffic — bit-identity
+    # gated first on the FULL verdict/counter/telemetry surface, on
+    # uniform AND Zipf flows, across an interleaved churn publish.
+    from cilium_tpu.compiler.tables import tables_layout_version
+    from cilium_tpu.engine import memo as vm
+
+    half_m = chosen_bs // 2
+    memo_verdict_cols = (
+        "allowed", "proxy_port", "match_kind", "sec_id", "ct_result",
+        "pre_dropped", "final_daddr", "final_dport", "rev_nat",
+        "lb_slave", "ct_create", "ct_delete", "l4_slot",
+        "ipcache_miss",
+    )
+
+    def _host_pairs_zipf(prng, half_c, k, s):
+        """Zipf-skewed sibling of _host_pairs_packed: per-direction
+        pool rows drawn rank-Zipf(s) instead of uniform."""
+        pairs = []
+        for _ in range(k):
+            pair = np.empty((2, 4, half_c), np.uint32)
+            for row, subset in enumerate((idx_ingress, idx_egress)):
+                picks = subset[
+                    zipf_picks(prng, len(subset), half_c, s)
+                ]
+                pair[row] = pack_flow_records4(
+                    ep_index=pool["ep_index"][picks],
+                    saddr=pool["saddr"][picks],
+                    daddr=pool["daddr"][picks],
+                    sport=pool["sport"][picks],
+                    dport=pool["dport"][picks],
+                    proto=pool["proto"][picks],
+                    direction=pool["direction"][picks],
+                    is_fragment=pool["is_fragment"][picks],
+                )
+            pairs.append(pair)
+        return pairs
+
+    def _memo_stamp(t):
+        return (
+            int(np.asarray(t.policy.generation)) & 0xFFFFFFFF,
+            tables_layout_version(t.policy),
+        )
+
+    memo_cache = vm.VerdictCache(n_rows=1 << 14)
+    memo_cache.ensure(_memo_stamp(tables_chosen))
+    # the GATE kernel runs at full compaction capacity (rep_cap ==
+    # half-batch): overflow is impossible, so bit-identity there is
+    # unconditional — the tuned-down capacity class is gated
+    # separately below on the Zipf pair it will actually serve
+    gate_kern = vm.memo_pair_packed4_kernel(rep_cap=half_m)
+
+    def _memo_gate(t_full, pair_host):
+        """One pair through the memoized kernel AND the uncached
+        reference: every verdict column + counters + telemetry must
+        be bit-identical.  Folds the batch's stats into memo_cache
+        and returns the host stats row."""
+        k = gate_kern
+        pair_dev = jax.device_put(pair_host)
+        acc_m = jax.device_put(make_counter_buffers(tables.policy))
+        tel_m = jax.device_put(make_telemetry_buffers())
+        g_i, g_e, acc_m, tel_m, rows, hit_i, hit_e, st = k(
+            t_full, pair_dev, memo_cache.rows, acc_m, tel_m
+        )
+        row = memo_cache.account(st)
+        assert row["overflow"] == 0, (
+            f"memo gate overflowed: {row} (rep_cap {half_m})"
+        )
+        memo_cache.rows = rows
+        acc_u = jax.device_put(make_counter_buffers(tables.policy))
+        tel_u = jax.device_put(make_telemetry_buffers())
+        r_i, r_e, acc_u, tel_u = (
+            datapath_step_accum_pair_telem_packed4_stacked(
+                t_full, pair_dev, acc_u, tel_u
+            )
+        )
+        for got, ref in ((g_i, r_i), (g_e, r_e)):
+            for col in memo_verdict_cols:
+                assert np.array_equal(
+                    np.asarray(getattr(got, col)),
+                    np.asarray(getattr(ref, col)),
+                ), f"memoized pipeline diverges in {col}"
+        assert np.array_equal(np.asarray(acc_m), np.asarray(acc_u)), (
+            "memoized pipeline counter divergence"
+        )
+        assert np.array_equal(np.asarray(tel_m), np.asarray(tel_u)), (
+            "memoized pipeline telemetry divergence"
+        )
+        # per-tuple hit flags must be consistent with the stats row
+        nh = int(np.asarray(hit_i).sum()) + int(np.asarray(hit_e).sum())
+        assert nh == row["hits"], (nh, row)
+        return row
+
+    # uniform flows: cold pass then warm pass (repeats must hit)
+    row0 = _memo_gate(tables_chosen, host_pairs[0])
+    assert row0["hits"] == 0, "cold cache served a hit"
+    row1 = _memo_gate(tables_chosen, host_pairs[0])
+    assert row1["hits"] > 0, "warm cache served no hits"
+
+    # Zipf flows at the bench skew
+    zrng = np.random.default_rng(53)
+    zpairs = _host_pairs_zipf(
+        zrng, half_m, min(max(args.tuples // chosen_bs, 1), 4),
+        args.zipf_s,
+    )
+    _memo_gate(tables_chosen, zpairs[0])
+    zrow = _memo_gate(tables_chosen, zpairs[0])
+    assert zrow["hits"] > 0
+
+    # interleaved churn publish: a delta publish through the real
+    # control plane changes the epoch stamp; the cache MUST flush and
+    # the first post-publish batch must serve zero (stale) hits while
+    # staying bit-identical to the uncached program on the NEW tables
+    flushes_before = memo_cache.flushes
+    add_one_rule(d, 4311, label_prefix="bench-memo")
+    d.regenerate_all("verdict-memo bench churn")
+    em.published_device()
+    _, host_pol, _, _ = em.published_with_states()
+    tables_pub = jax.device_put(
+        DatapathTables(
+            prefilter=tables.prefilter,
+            ipcache=tables.ipcache,
+            ct=tables.ct,
+            lb=tables.lb,
+            policy=split_hot(
+                repack_hash_lanes(host_pol, chosen_lanes)
+            ),
+        )
+    )
+    assert _memo_stamp(tables_pub) != _memo_stamp(tables_chosen), (
+        "delta publish did not change the epoch stamp"
+    )
+    assert memo_cache.ensure(_memo_stamp(tables_pub)), (
+        "stamp change did not flush the verdict cache"
+    )
+    assert memo_cache.flushes == flushes_before + 1
+    prow = _memo_gate(tables_pub, zpairs[0])
+    assert prow["hits"] == 0, (
+        "post-publish batch served hits from a flushed cache"
+    )
+    prow2 = _memo_gate(tables_pub, zpairs[0])
+    assert prow2["hits"] > 0, "hit rate did not recover post-publish"
+
+    # back to the bench world for the timed section (flushes again)
+    memo_cache.ensure(_memo_stamp(tables_chosen))
+
+    # --- tuner: cache capacity + enable threshold join the autotuned
+    # shape class — None (uncached) is a candidate, so a workload
+    # whose sort+probe overhead beats the gathers saved keeps the
+    # uncached program -----------------------------------------------------
+    def _run_memo_candidate(params):
+        if not params.get("memo"):
+            state = {
+                "acc": jax.device_put(
+                    make_counter_buffers(tables.policy)
+                ),
+                "telem": jax.device_put(make_telemetry_buffers()),
+                "i": 0,
+            }
+
+            def step(pair):
+                o_i, o_e, state["acc"], state["telem"] = (
+                    datapath_step_accum_pair_telem_packed4_stacked(
+                        tables_chosen, jnp_dev(pair),
+                        state["acc"], state["telem"],
+                    )
+                )
+                return o_i.allowed, o_e.allowed
+        else:
+            kern_c = vm.memo_pair_packed4_kernel(
+                rep_cap=params["rep_cap"]
+            )
+            state = {
+                "acc": jax.device_put(
+                    make_counter_buffers(tables.policy)
+                ),
+                "telem": jax.device_put(make_telemetry_buffers()),
+                "cache": jax.device_put(
+                    vm.make_cache_rows(params["rows"])
+                ),
+                "i": 0,
+            }
+
+            def step(pair):
+                (
+                    o_i, o_e, state["acc"], state["telem"],
+                    state["cache"], _, _, _,
+                ) = kern_c(
+                    tables_chosen, jnp_dev(pair),
+                    state["cache"], state["acc"], state["telem"],
+                )
+                return o_i.allowed, o_e.allowed
+
+        def make_args():
+            state["i"] += 1
+            return (zpairs[state["i"] % len(zpairs)],)
+
+        return at.measure_dispatch(
+            step, make_args, chosen_bs, reps=3,
+            outstanding=2, sync_reps=2,
+        )
+
+    memo_rep_cap = max(half_m >> 2, 1 << 10)
+    memo_cands = at.memo_candidates(half_m)
+    memo_choice = at.autotune(
+        memo_cands,
+        _run_memo_candidate,
+        p99_bound_ms=args.autotune_p99_ms,
+        cache_key=("memo", round(float(args.zipf_s), 3))
+        + at.shape_class_key(tables_chosen.policy),
+        log=lambda msg: print(f"# {msg}", file=sys.stderr),
+    )
+    uncached_zipf = next(
+        (
+            t.verdicts_per_sec
+            for t in memo_choice.trials
+            if not t.params.get("memo")
+        ),
+        0.0,
+    )
+
+    # --- timed memoized loop on Zipf traffic (the effective line):
+    # the headline's double-buffered async staging loop with the
+    # tuned memo class in front of the lattice ------------------------------
+    timed_kern = vm.memo_pair_packed4_kernel(rep_cap=memo_rep_cap)
+    mstate = {
+        "acc": jax.device_put(make_counter_buffers(tables.policy)),
+        "telem": jax.device_put(make_telemetry_buffers()),
+        "cache": jax.device_put(vm.make_cache_rows(1 << 14)),
+        "last": None,
+    }
+    memo_stats_rows = []
+
+    def _m_dispatch(pair_dev):
+        (
+            o_i, o_e, mstate["acc"], mstate["telem"],
+            mstate["cache"], h_i, h_e, st,
+        ) = timed_kern(
+            tables_chosen, pair_dev,
+            mstate["cache"], mstate["acc"], mstate["telem"],
+        )
+        memo_stats_rows.append(st)
+        mstate["last"] = (o_i, o_e)
+        return (o_i, o_e)
+
+    mdisp = AsyncBatchDispatcher(
+        pack_fn=lambda pair: (jax.device_put(pair),),
+        dispatch_fn=_m_dispatch,
+        depth=max(args.async_depth, 0),
+    )
+    n_batches_m = max(args.tuples // chosen_bs, 1)
+    # warmup (compile the timed class + first-touch the cache), then
+    # fresh stats so the measured hit rate is the steady state
+    _m_dispatch(jax.device_put(zpairs[0]))
+    jax.block_until_ready(mstate["last"])
+    memo_stats_rows.clear()
+    t0 = time.perf_counter()
+    for i in range(n_batches_m):
+        for _, _, exc in mdisp.submit((zpairs[i % len(zpairs)],)):
+            if exc is not None:
+                raise exc
+    for _, _, exc in mdisp.flush():
+        if exc is not None:
+            raise exc
+    jax.block_until_ready((mstate["acc"], mstate["telem"]))
+    dt_m = time.perf_counter() - t0
+    eff_vps = n_batches_m * chosen_bs / dt_m
+    folded = np.zeros(vm.STATS, np.int64)
+    for st in memo_stats_rows:
+        folded += np.asarray(st).astype(np.int64)
+    overflow_batches = sum(
+        1
+        for st in memo_stats_rows
+        if int(np.asarray(st)[vm.STAT_OVERFLOW])
+    )
+    hit_rate = float(folded[vm.STAT_HIT]) / max(
+        int(folded[vm.STAT_TUPLES]), 1
+    )
+    dedup = float(folded[vm.STAT_TUPLES]) / max(
+        int(folded[vm.STAT_UNIQUE]), 1
+    )
+    emit(
+        "verdict_cache_hit_rate",
+        round(hit_rate, 4),
+        "fraction",
+        zipf_s=args.zipf_s,
+        insertions=int(folded[vm.STAT_INSERT]),
+        overflow_batches=overflow_batches,
+        cache_rows=1 << 14,
+        cache_bytes=int((1 << 14) + 1) * vm.CACHE_WORDS * 8 * 4,
+        flushes=memo_cache.flushes,
+        note=(
+            "tuples served from the device verdict cache on the "
+            "timed Zipf loop (distinct policy keys evaluated once "
+            "per epoch; any publish flushes)"
+        ),
+    )
+    emit(
+        "dedup_factor",
+        round(dedup, 2),
+        "x",
+        zipf_s=args.zipf_s,
+        unique_keys_per_batch=int(
+            folded[vm.STAT_UNIQUE] / max(len(memo_stats_rows), 1)
+        ),
+        effective_hot_bytes_per_tuple=round(
+            at.effective_hot_bytes_per_tuple(tables_chosen, dedup), 1
+        ),
+        hot_bytes_per_tuple=round(hot_bpt, 1),
+        note=(
+            "batch tuples per distinct policy key (intra-batch "
+            "dedup): the lattice gather chain runs once per key, so "
+            "effective gathered bytes/tuple = hot_bytes_per_tuple / "
+            "dedup_factor"
+        ),
+    )
+    emit(
+        "effective_verdicts_per_sec_per_chip",
+        round(eff_vps),
+        "verdicts/s",
+        vs_baseline=round(eff_vps / BASELINE_PER_CHIP, 3),
+        zipf_s=args.zipf_s,
+        verdict_cache_hit_rate=round(hit_rate, 4),
+        dedup_factor=round(dedup, 2),
+        rep_cap=memo_rep_cap,
+        uncached_zipf_verdicts_per_sec=round(uncached_zipf),
+        memo_enabled=bool(memo_choice.params.get("memo")),
+        tuner_trials=[
+            {
+                "params": t.params,
+                "verdicts_per_sec": round(t.verdicts_per_sec),
+                "p99_batch_ms": round(t.p99_batch_ms, 1),
+            }
+            for t in memo_choice.trials
+        ],
+        note=(
+            "double-buffered async staging loop with the two-level "
+            "verdict memo plane (intra-batch dedup + epoch-stamped "
+            "device cache) on Zipf-skewed flows; "
+            "verdicts_per_sec_per_chip above stays the "
+            "skew-independent uncached baseline"
+        ),
+    )
+
 
 # ---------------------------------------------------------------------------
 # per-chip failover bench: degraded throughput + re-admission cost
@@ -2530,6 +2892,14 @@ def main() -> None:
         "--autotune-p99-ms", type=float, default=2000.0,
         help="p99 batch-latency bound the autotuner must respect "
         "when maximizing verdicts/s",
+    )
+    ap.add_argument(
+        "--zipf-s", type=float, default=1.1,
+        help="skew parameter of the rank-Zipf flow generator behind "
+        "the verdict-memoization lines (verdict_cache_hit_rate, "
+        "dedup_factor, effective_verdicts_per_sec_per_chip); the "
+        "uncached verdicts_per_sec_per_chip headline stays on the "
+        "uniform pool replay",
     )
     ap.add_argument(
         "--async-depth", type=int, default=2,

@@ -253,6 +253,8 @@ def _format_flow_compact(flow: dict) -> str:
         line += f" ({flow['drop_reason']})"
     if flow.get("proxy_port"):
         line += f" -> proxy {flow['proxy_port']}"
+    if flow.get("cache_hit"):
+        line += " [cached]"
     return line
 
 
@@ -275,6 +277,8 @@ def cmd_observe(api, args) -> int:
     ):
         if val is not None:
             params[key] = val
+    if getattr(args, "cache_hit", False):
+        params["cache-hit"] = "1"
     params["last"] = args.last
 
     def emit(flows) -> None:
@@ -493,6 +497,9 @@ def make_parser() -> argparse.ArgumentParser:
     obs.add_argument("--trace-id", default=None,
                      help="only flows captured under this trace "
                      "(the /debug/traces join key)")
+    obs.add_argument("--cache-hit", action="store_true",
+                     help="only flows whose verdict was served from "
+                     "the device verdict cache")
     obs.add_argument("--timeout", type=float, default=5.0,
                      help="follow-mode poll timeout")
     obs.add_argument("--summary", action="store_true",

@@ -233,6 +233,32 @@ class Daemon:
         # monitor events, health()/status() degraded reasons) — the
         # mesh refinement of the dispatch breaker above
         self.mesh_router = None
+        # verdict memoization (engine/memo.py): when enabled, the
+        # serving dispatch dedups each batch's policy keys in-jit
+        # and serves repeats from a device-resident verdict cache,
+        # epoch-stamped so any publish flushes it; an overflowing
+        # batch (more distinct keys than the compaction capacity)
+        # falls back to the uncached program — bit-identity is
+        # unconditional either way.  Off by default: PATCH /config
+        # {"verdict_cache": true} turns it on.
+        self.verdict_cache_enabled = False
+        self.verdict_cache = None  # engine.memo.VerdictCache, lazy
+        self.verdict_cache_rows = 1 << 12
+        # rep/miss compaction capacity as a fraction of the batch
+        # (1/4 keeps lattice-gather savings real while Zipf-skewed
+        # batches virtually never overflow), floored at 1024 keys
+        # (matching autotune.memo_candidates) so tiny batches don't
+        # overflow on trivially small key sets
+        self.verdict_cache_rep_shift = 2
+        # overflow backoff: a workload whose distinct-key count
+        # keeps exceeding the compaction capacity pays the memo
+        # sort+probe AND the uncached re-dispatch per batch; after
+        # `streak_limit` consecutive refusals the memo attempt is
+        # skipped, re-probed once every `retry_period` batches
+        self.verdict_cache_overflow_streak = 0
+        self.verdict_cache_streak_limit = 8
+        self.verdict_cache_retry_period = 64
+        self._memo_batch_seq = 0
         # bounded admission: flows in flight across concurrent
         # process_flows calls; excess batches shed under the
         # canonical Overload drop reason (None = unbounded)
@@ -1008,8 +1034,105 @@ class Daemon:
 
         router._on_chip_transition = _notify
 
+    def _ensure_verdict_cache(self, tables):
+        """The daemon's VerdictCache, stamped to the tables about to
+        be dispatched: the stamp is (publish generation, table
+        layout), so any publish / repack flushes before a stale
+        verdict could be served.  Returns (cache, stamp) — the
+        dispatch binds its probe AND its write-back to this stamp —
+        or (None, None) when the feature was disabled after this
+        batch's target selection: re-creating the cache here would
+        silently undo `PATCH /config {"verdict_cache": false}`'s
+        promise to drop the device buffer."""
+        import numpy as np
+
+        from cilium_tpu.compiler.tables import tables_layout_version
+        from cilium_tpu.engine import memo as vm
+
+        if not self.verdict_cache_enabled:
+            return None, None
+        if self.verdict_cache is None:
+            self.verdict_cache = vm.VerdictCache(
+                n_rows=self.verdict_cache_rows
+            )
+        gen = int(np.asarray(tables.generation)) & 0xFFFFFFFF
+        stamp = (gen, tables_layout_version(tables))
+        self.verdict_cache.ensure(stamp)
+        return self.verdict_cache, stamp
+
+    def _memo_evaluate(self, tables, batch):
+        """Memoized lattice dispatch (engine/memo.py): intra-batch
+        dedup + the device verdict cache in front of evaluate_batch,
+        bit-identical by construction.  Returns a Verdicts-like
+        namespace carrying the per-tuple `cache_hit` column the flow
+        plane records and the DEVICE stats row (`cache_stats`).
+
+        NO host read happens here — the double-buffered pipeline
+        keeps its host-pack/device-compute overlap.  The drain (one
+        batch behind, where the verdict columns sync anyway) folds
+        the stats exactly once per served batch, corrects hit/miss
+        accounting to the batch's valid prefix (padding rows all
+        share one key and would drown the metrics in synthetic
+        hits), and — when the kernel REFUSED the batch because it
+        held more distinct policy keys than the compaction capacity
+        — re-dispatches it through the uncached program.  The
+        commit below is safe in that case: the kernel returns the
+        carried cache unchanged on overflow by construction.
+
+        Concurrency safety: the probe and the write-back are both
+        bound to OUR tables' epoch stamp — `acquire()` reads
+        (stamp, rows) atomically (a concurrent publish between
+        ensure and the read hands us another epoch's cache, so we
+        bypass memoization for this batch) and `commit()` refuses
+        the write-back when a publish flushed mid-dispatch, so
+        pre-publish entries can never resurrect under the new
+        stamp."""
+        from types import SimpleNamespace
+
+        import numpy as np
+
+        from cilium_tpu.engine import memo as vm
+
+        b = int(batch.ep_index.shape[0])
+        rep_cap = max(b >> self.verdict_cache_rep_shift, min(b, 1 << 10))
+        self._memo_batch_seq += 1
+        backoff = (
+            self.verdict_cache_overflow_streak
+            >= self.verdict_cache_streak_limit
+            and self._memo_batch_seq % self.verdict_cache_retry_period
+        )
+        cache, stamp = self._ensure_verdict_cache(tables)
+        if cache is None:  # disabled mid-flight
+            out = self._traced_evaluate(tables, batch)
+            return SimpleNamespace(
+                allowed=out.allowed,
+                proxy_port=out.proxy_port,
+                match_kind=out.match_kind,
+                cache_hit=np.zeros(b, bool),
+            )
+        cur_stamp, rows_in = cache.acquire()
+        if backoff or cur_stamp != stamp:
+            out = self._traced_evaluate(tables, batch)
+            return SimpleNamespace(
+                allowed=out.allowed,
+                proxy_port=out.proxy_port,
+                match_kind=out.match_kind,
+                cache_hit=np.zeros(b, bool),
+            )
+        kernel = vm.memo_evaluate_kernel(rep_cap=rep_cap)
+        v, rows, hit, stats = kernel(tables, batch, rows_in)
+        cache.commit(stamp, rows)
+        return SimpleNamespace(
+            allowed=v.allowed,
+            proxy_port=v.proxy_port,
+            match_kind=v.match_kind,
+            cache_hit=hit,
+            cache_stats=stats,
+        )
+
     def _dispatch_or_degrade(
-        self, tables, batch, host_args, pad_to: int
+        self, tables, batch, host_args, pad_to: int,
+        use_memo: bool = True,
     ):
         """One batch through the guarded device dispatch: the
         engine.dispatch fault seam fires first, the watchdog bounds
@@ -1039,13 +1162,18 @@ class Daemon:
             self._traced_evaluate = tracing.track_jit(
                 evaluate_batch, "engine.dispatch"
             )
+        target = (
+            self._memo_evaluate
+            if (self.verdict_cache_enabled and use_memo)
+            else self._traced_evaluate
+        )
         if self.dispatch_breaker.allow():
             with self.tracer.span(
                 "engine.dispatch", site="engine.dispatch"
             ) as sp:
                 try:
                     out = guarded_dispatch(
-                        self._traced_evaluate,
+                        target,
                         tables,
                         batch,
                         retries=self.dispatch_retries,
@@ -1057,6 +1185,12 @@ class Daemon:
                 except Exception as exc:
                     sp.status = "error"
                     sp.attrs["error"] = str(exc)
+                    # a memoized attempt may have committed lazy
+                    # rows tied to the failed computation
+                    if use_memo and self.verdict_cache is not None:
+                        self.verdict_cache.flush(
+                            reason="dispatch-failure"
+                        )
                     self.dispatch_breaker.record_failure(str(exc))
                     log.warning(
                         "device dispatch failed; serving batch from "
@@ -1142,6 +1276,14 @@ class Daemon:
                 raise ValueError(
                     f"unknown enforcement mode {enforcement!r}"
                 )
+            verdict_cache = changes.get("verdict_cache")
+            if verdict_cache is not None and not isinstance(
+                verdict_cache, bool
+            ):
+                raise ValueError(
+                    "verdict_cache must be a boolean, got "
+                    f"{verdict_cache!r}"
+                )
             if raw_opts:
                 ct_before = option.Config.opts.is_enabled(
                     option.CONNTRACK
@@ -1163,6 +1305,19 @@ class Daemon:
                     option.Config.policy_enforcement = enforcement
                     applied += 1
                     verdict_affecting = True
+            # verdict memoization toggle: bit-identical by
+            # construction, so no regeneration sweep (counted after
+            # the regen trigger below); disabling drops the cache
+            # (and its HBM) immediately
+            vc_applied = 0
+            if (
+                verdict_cache is not None
+                and verdict_cache != self.verdict_cache_enabled
+            ):
+                self.verdict_cache_enabled = verdict_cache
+                if not verdict_cache:
+                    self.verdict_cache = None
+                vc_applied = 1
             # fault arming applies last and never triggers a regen
             # sweep (it changes no compiled state)
             fault_applied = 0
@@ -1179,12 +1334,13 @@ class Daemon:
             self.trigger_policy_updates(
                 "configuration changed", full=verdict_affecting
             )
-        applied += fault_applied
+        applied += fault_applied + vc_applied
         return {
             "applied": applied,
             "policy_enforcement": option.Config.policy_enforcement,
             "options": dict(option.Config.opts),
             "faults": faultinject.armed(),
+            "verdict_cache": self.verdict_cache_enabled,
         }
 
     def _option_changed(self, name: str, value: int) -> None:
@@ -1552,8 +1708,9 @@ class Daemon:
 
         def _drain_oldest():
             from cilium_tpu.engine.hostpath import lattice_fold_host
+            from cilium_tpu.engine import memo as vm
 
-            out, degraded, start, end, valid, batch_t0 = (
+            out, degraded, start, end, valid, batch_t0, dev_batch = (
                 pending.popleft()
             )
             try:
@@ -1561,17 +1718,76 @@ class Daemon:
                     spans, "drain", site="daemon", trc=self.tracer,
                 ).start()
                 try:
+                    hit_col = getattr(out, "cache_hit", None)
                     v = SimpleNamespace(
                         allowed=np.asarray(out.allowed)[:valid],
                         match_kind=np.asarray(out.match_kind)[:valid],
                         proxy_port=np.asarray(out.proxy_port)[:valid],
+                        cache_hit=(
+                            None
+                            if hit_col is None
+                            else np.asarray(hit_col)[:valid]
+                        ),
                     )
+                    # deferred memo fold (one per served batch — the
+                    # dispatch target never syncs): correct hit/miss
+                    # accounting to the valid prefix, and when the
+                    # kernel REFUSED the batch (more distinct keys
+                    # than the compaction capacity; its verdict
+                    # columns are unspecified, carried cache state
+                    # untouched) re-dispatch through the uncached
+                    # program
+                    cstats = getattr(out, "cache_stats", None)
+                    if cstats is not None:
+                        s = np.asarray(cstats).astype(np.int64)
+                        if int(s[vm.STAT_OVERFLOW]):
+                            self.verdict_cache_overflow_streak += 1
+
+                            def _ha(s0=start, e0=end):
+                                return _host_args_for(s0, e0)
+
+                            out2, deg2 = self._dispatch_or_degrade(
+                                tables, dev_batch, _ha, batch_size,
+                                use_memo=False,
+                            )
+                            degraded = degraded or deg2
+                            v = SimpleNamespace(
+                                allowed=np.asarray(
+                                    out2.allowed
+                                )[:valid],
+                                match_kind=np.asarray(
+                                    out2.match_kind
+                                )[:valid],
+                                proxy_port=np.asarray(
+                                    out2.proxy_port
+                                )[:valid],
+                                cache_hit=np.zeros(valid, bool),
+                            )
+                        else:
+                            self.verdict_cache_overflow_streak = 0
+                            if valid < int(out.allowed.shape[0]):
+                                s = s.copy()
+                                s[vm.STAT_HIT] = int(
+                                    v.cache_hit.sum()
+                                )
+                                s[vm.STAT_TUPLES] = int(valid)
+                        if self.verdict_cache is not None:
+                            self.verdict_cache.account(s)
                 except Exception as exc:
                     # the overlapped batch died ON DEVICE after a
                     # successful enqueue: the breaker learns the
                     # failure and the in-flight batch drains through
                     # the bit-identical host fold instead of
-                    # vanishing mid-pipeline
+                    # vanishing mid-pipeline.  A memoized dispatch
+                    # committed its (lazy) output rows before the
+                    # failure surfaced — drop them, or every later
+                    # kernel feeds the poisoned buffer back in and
+                    # serving stays degraded until an unrelated
+                    # publish changes the stamp
+                    if self.verdict_cache is not None:
+                        self.verdict_cache.flush(
+                            reason="drain-failure"
+                        )
                     self.dispatch_breaker.record_failure(str(exc))
                     log.warning(
                         "async drain failed; serving in-flight "
@@ -1655,6 +1871,7 @@ class Daemon:
                     allowed=v.allowed,
                     match_kind=v.match_kind,
                     proxy_port=v.proxy_port,
+                    cache_hit=getattr(v, "cache_hit", None),
                     allow_sample=flow_allow_sample,
                     metrics_registry=metrics,
                     trace_id=trace_ctx,
@@ -1715,8 +1932,11 @@ class Daemon:
                 except Exception:
                     self.admission.release(valid)
                     raise
+                # the device batch rides `pending` so a drain-time
+                # overflow refusal can re-dispatch it uncached
                 pending.append(
-                    (out, degraded, start, end, valid, batch_t0)
+                    (out, degraded, start, end, valid, batch_t0,
+                     batch)
                 )
                 while len(pending) > depth:
                     _drain_oldest()

@@ -279,3 +279,52 @@ def cold_bytes_per_tuple(tables) -> float:
         for r in hot_gather_profile(tables)
         if r["plane"] == "cold"
     )
+
+
+# ---------------------------------------------------------------------------
+# Verdict memoization (engine/memo.py) tuning
+# ---------------------------------------------------------------------------
+
+
+def memo_candidates(
+    batch: int,
+    include_off: bool = True,
+    rows_options: Sequence[int] = (1 << 14,),
+    rep_shifts: Sequence[int] = (2,),
+) -> List[dict]:
+    """Verdict-memoization candidates for the tuner (the schema
+    bench's `_run_memo_candidate` consumes): cache row counts ×
+    rep/miss compaction capacities (batch >> shift, so the lattice
+    gather chain shrinks when the workload's key skew covers it).
+    `{"memo": False}` is the ENABLE THRESHOLD: when the sort+probe
+    overhead beats the gathers saved on this workload the tuner
+    keeps the uncached program — the choice is cached per table
+    shape class like the batch/pack-width choice, so a long-running
+    server decides once per layout."""
+    cands: List[dict] = [{"memo": False}] if include_off else []
+    for rows in rows_options:
+        for shift in rep_shifts:
+            cands.append(
+                {
+                    "memo": True,
+                    "rows": int(rows),
+                    "rep_cap": max(int(batch) >> shift, 1 << 10),
+                }
+            )
+    return cands
+
+
+def effective_hot_bytes_per_tuple(
+    tables, dedup_factor: float, packed_io: bool = True
+) -> float:
+    """The gather-byte model under intra-batch dedup: gatherprof's
+    hot_bytes_per_tuple divided by the measured dedup factor — the
+    bytes the lattice ACTUALLY moves per tuple once duplicates
+    collapse onto one representative.  Cache hits shrink it further
+    (a hit gathers one cache row instead of the lattice rows); this
+    line deliberately prices only the dedup level so the bench's
+    `effective_verdicts_per_sec_per_chip` stays the measured truth
+    and the model stays conservative."""
+    return hot_bytes_per_tuple(tables, packed_io=packed_io) / max(
+        float(dedup_factor), 1.0
+    )

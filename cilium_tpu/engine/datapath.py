@@ -333,6 +333,7 @@ def _datapath_core(
     static_direction=None,
     defer_counters: bool = False,
     collect_telemetry: bool = False,
+    lattice_fn=None,
 ):
     """The fused per-packet pipeline.  With an idx-form ipcache
     (specialize_ipcache_to_idx) the identity lookup yields the dense
@@ -485,9 +486,19 @@ def _datapath_core(
         direction=flows.direction,
         is_fragment=flows.is_fragment,
     )
-    probe1, probe2, probe3, proxy, j, idx = _probes(
-        tables.policy, resolved, idx_known=idx_known
-    )
+    # `lattice_fn` swaps the probe chain for a memoized equivalent
+    # (engine/memo.py: intra-batch dedup + device verdict cache) —
+    # same (probe1, probe2, probe3, proxy, j, idx) contract, so the
+    # combine / counter / telemetry stages below are shared code and
+    # the bit-identity surface is the probe outputs alone
+    if lattice_fn is None:
+        probe1, probe2, probe3, proxy, j, idx = _probes(
+            tables.policy, resolved, idx_known=idx_known
+        )
+    else:
+        probe1, probe2, probe3, proxy, j, idx = lattice_fn(
+            tables.policy, resolved, idx_known
+        )
     v = _combine(probe1, probe2, probe3, proxy, resolved.is_fragment)
     deferred = None
     if with_counters:

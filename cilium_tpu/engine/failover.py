@@ -171,16 +171,39 @@ class ChipFailoverRouter:
             tuple(tables.l3_allow_bits.shape),
         )
         self.stats = RouterStats()
+        # batch re-split plans keyed on the survivor set: the
+        # steady-state degraded loop re-splits the SAME survivor
+        # layout every dispatch, so the routing plan (usable rows,
+        # shard size, valid mask, stream positions) computes once
+        # per alive-matrix change instead of per batch
+        self._pack_plans: Dict[tuple, tuple] = {}
+        # attached verdict cache (engine/memo.py): flushed on every
+        # chip breaker transition — a kill or readmission changes
+        # routing (and readmission rewrites the live epoch in place
+        # through the repair scatter), so the flush keeps the
+        # cached-verdict staleness argument airtight
+        self._verdict_cache = None
 
     # -- breaker plumbing ----------------------------------------------------
+
+    def attach_verdict_cache(self, cache) -> None:
+        """Bind a VerdictCache (engine/memo.py): any chip breaker
+        transition — kill OR readmission — flushes it, so no cached
+        verdict can outlive a routing/repair event."""
+        self._verdict_cache = cache
 
     def _chip_event(self, ordinal, old, new, reason) -> None:
         """Per-chip breaker transition: gauge + span event + the
         store's outage ledger (an OPEN chip starts missing
-        publishes)."""
+        publishes) + verdict-cache flush + re-split plan reset."""
         metrics.chip_breaker_state.set(
             str(ordinal), value=STATE_CODES[new]
         )
+        self._pack_plans.clear()
+        if self._verdict_cache is not None:
+            self._verdict_cache.flush(
+                reason=f"chip {int(ordinal)} {old}->{new}"
+            )
         tracing.add_event(
             "chip.breaker", chip=int(ordinal), old=old, new=new,
             reason=reason,
@@ -267,9 +290,26 @@ class ChipFailoverRouter:
                 out[name] = (axis, idx)
         return out
 
+    def _whole_owned_row_sets(self, ordinal: int) -> Dict:
+        """{leaf: (axis, aug index array)} covering a chip's ENTIRE
+        owned regions (primary + backup) — the spare-epoch repair's
+        row set: the standby missed an unknown mix of scatters
+        recorded against alternating slots, so the safe replay is
+        the whole owned slice from the spare's retained host.
+        Delegates to _owned_row_sets' needs_full branch so the
+        owned-region layout arithmetic lives in one place."""
+        return self._owned_row_sets(
+            ordinal, {"needs_full": True, "missed": []}
+        )
+
     def _rebalance(self, ordinal: int) -> Tuple[int, float]:
         """Replay the rows a chip missed while out, through the
-        store's repair scatter.  Returns (bytes, ms)."""
+        store's repair scatter — the LIVE epoch from the outage
+        ledger, and (when publishes landed during the outage) the
+        SPARE epoch's whole owned slice from its retained host
+        snapshot, so the next publish stays on the delta path
+        instead of paying a full upload for a de-registered
+        standby.  Returns (bytes, ms)."""
         outage = self.store.readmit_chip(ordinal)
         if outage is None:
             return 0, 0.0
@@ -279,6 +319,13 @@ class ChipFailoverRouter:
             bytes_h2d = (
                 self.store.repair_rows(row_sets) if row_sets else 0
             )
+            if outage.get("spare_stale"):
+                spare_sets = self._whole_owned_row_sets(ordinal)
+                if spare_sets:
+                    bytes_h2d += self.store.repair_rows(
+                        spare_sets, spare=True,
+                        expect_epoch=outage.get("spare_epoch"),
+                    )
         except Exception:
             # the scatter may have partially landed — put the popped
             # ledger back (downgraded to needs_full) so the NEXT
@@ -295,6 +342,7 @@ class ChipFailoverRouter:
             bytes_h2d=bytes_h2d, ms=round(ms, 3),
             missed_deltas=len(outage["missed"]),
             needs_full=outage["needs_full"],
+            spare_repaired=bool(outage.get("spare_stale")),
         )
         log.info(
             "chip re-admission rebalance",
@@ -374,6 +422,45 @@ class ChipFailoverRouter:
             ok &= alive[:, c] | alive[:, backup]
         return ok
 
+    def _pack_plan(self, b: int, usable: np.ndarray):
+        """Routing plan for a (batch length, survivor set) pair:
+        shard size, valid mask, stream-order positions and the
+        per-row copy chunks.  Cached — the steady-state degraded
+        loop re-splits the same survivor layout every dispatch, and
+        replanning (flatnonzero + per-row position arithmetic) was
+        a measurable slice of degraded_verdicts_per_sec_per_chip.
+        The cache clears on every breaker transition."""
+        key = (b, usable.tobytes())
+        plan = self._pack_plans.get(key)
+        if plan is not None:
+            return plan
+        rows = np.flatnonzero(usable)
+        per = -(-b // len(rows))  # ceil
+        s = max(next_pow2(per), 1)
+        if len(rows) == self.dp and self.dp * s == b:
+            plan = None, None, None, None  # identity pass-through
+        else:
+            total = self.dp * s
+            valid = np.zeros(total, bool)
+            positions = np.empty(b, np.int64)
+            chunks = []  # (dst slice, src slice)
+            off = 0
+            for r in rows:
+                take = min(s, b - off)
+                if take <= 0:
+                    break
+                sl = slice(r * s, r * s + take)
+                chunks.append((sl, slice(off, off + take)))
+                valid[sl] = True
+                positions[off : off + take] = np.arange(
+                    r * s, r * s + take
+                )
+                off += take
+            assert off == b, "batch re-split lost tuples"
+            plan = total, valid, positions, tuple(chunks)
+        self._pack_plans[key] = plan
+        return plan
+
     def _pack(self, cols: Dict[str, np.ndarray], usable: np.ndarray):
         """Re-split the tuple stream over the usable rows: each gets
         a contiguous chunk of the real stream; unusable rows carry
@@ -382,34 +469,20 @@ class ChipFailoverRouter:
         order — None for the identity).  The fully-healthy,
         already-aligned steady state (every row usable, shard size
         already a power of two) hands the batch straight through:
-        no column copies, no output gather."""
+        no column copies, no output gather.  The routing plan is
+        cached per survivor set (_pack_plan); only the column
+        copies run per batch."""
         b = len(cols["ep_index"])
-        rows = np.flatnonzero(usable)
-        per = -(-b // len(rows))  # ceil
-        s = max(next_pow2(per), 1)
-        if len(rows) == self.dp and self.dp * s == b:
+        total, valid, positions, chunks = self._pack_plan(b, usable)
+        if total is None:
             return cols, np.ones(b, bool), None
-        total = self.dp * s
         padded = {
             k: np.repeat(v[:1], total, axis=0).astype(v.dtype)
             for k, v in cols.items()
         }
-        valid = np.zeros(total, bool)
-        positions = np.empty(b, np.int64)
-        off = 0
-        for k, r in enumerate(rows):
-            take = min(s, b - off)
-            if take <= 0:
-                break
-            sl = slice(r * s, r * s + take)
+        for dst, src in chunks:
             for key, v in cols.items():
-                padded[key][sl] = v[off : off + take]
-            valid[sl] = True
-            positions[off : off + take] = np.arange(
-                r * s, r * s + take
-            )
-            off += take
-        assert off == b, "batch re-split lost tuples"
+                padded[key][dst] = v[src]
         return padded, valid, positions
 
     def dispatch(

@@ -502,19 +502,32 @@ class DeviceTableStore:
 
     def readmit_chip(self, ordinal: int) -> Optional[Dict]:
         """Close the outage ledger and return it (the failover
-        router converts it into the owned-row repair scatter).  The
-        SPARE epoch, if it was published during the outage, is
-        de-registered: its chip slice missed scatters recorded
-        against ITS stamp's host arrays, which are no longer
-        retained — the next publish full-uploads it instead of
-        scattering into semantically stale rows."""
+        router converts it into the owned-row repair scatter).  A
+        SPARE epoch published during the outage is semantically
+        stale on the chip's slice; when the slot retains its host
+        pytree (replica stores do — it is the repair value source)
+        the record comes back with ``spare_stale`` set and the
+        router REPAIRS the chip's whole owned regions of the spare
+        from that retained snapshot (`repair_rows(..., spare=True)`)
+        — bytes proportional to one chip's slice, not a full
+        upload.  Only a plain store without a retained host still
+        de-registers the spare (the next publish full-uploads)."""
         with self._lock:
             rec = self._out_chips.pop(int(ordinal), None)
             if rec is None:
                 return None
             spare = self._slots[self._cur ^ 1]
             if spare is not None and spare["epoch"] > rec["epoch"]:
-                self._slots[self._cur ^ 1] = None
+                if spare.get("host") is not None:
+                    rec["spare_stale"] = True
+                    # the repair must land on THIS epoch: a publish
+                    # interleaved before the repair flips the slots
+                    # (repair_rows verifies the epoch and refuses);
+                    # the store's own counter, not the table stamp —
+                    # distinct epochs can share a stamp
+                    rec["spare_epoch"] = spare["epoch"]
+                else:
+                    self._slots[self._cur ^ 1] = None
             return rec
 
     def restore_outage(self, ordinal: int, rec: Dict) -> None:
@@ -563,15 +576,32 @@ class DeviceTableStore:
         self._repair_cache[key] = fn
         return fn
 
-    def repair_rows(self, row_sets: Dict[str, Tuple[int, object]]) -> int:
+    def repair_rows(
+        self,
+        row_sets: Dict[str, Tuple[int, object]],
+        spare: bool = False,
+        expect_epoch: Optional[int] = None,
+    ) -> int:
         """Rewrite `row_sets` ({leaf: (axis, index array)}) of the
         LIVE epoch from its retained host arrays — the re-admission
         rebalance: the rows a chip missed while its breaker was open
         land back on device through the delta-scatter path, bytes
         proportional to the missed change (never a full upload).
+        With `spare=True` the STANDBY epoch repairs instead, from
+        ITS retained host snapshot — the spare-epoch repair at chip
+        readmission that keeps the next publish on the delta path
+        (a de-registered spare would cost one full upload).
+        `expect_epoch` pins the repair to the slot fill the caller
+        observed (readmit_chip's `spare_epoch` — the store's own
+        monotonic counter, since distinct epochs can share a table
+        stamp): a publish interleaved since then flipped the slots,
+        and scattering into whatever occupies the slot NOW would
+        leave the stale epoch live-and-unrepaired — the repair
+        refuses instead, and the caller's recovery path replays the
+        whole slice on the next probe.
 
-        The live epoch's buffers are DONATED to the scatter, so the
-        caller must not have batches in flight against it (the
+        The repaired epoch's buffers are DONATED to the scatter, so
+        the caller must not have batches in flight against it (the
         failover router rebalances at stream boundaries, before the
         probe dispatch that re-admits the chip).  Returns bytes
         shipped host→device (also accumulated in
@@ -579,13 +609,23 @@ class DeviceTableStore:
         import jax
 
         with self._lock:
-            slot = self._slots[self._cur]
+            slot = self._slots[self._cur ^ 1 if spare else self._cur]
+            which = "spare" if spare else "live"
             if slot is None:
-                raise RuntimeError("no live epoch to repair")
+                raise RuntimeError(f"no {which} epoch to repair")
+            if (
+                expect_epoch is not None
+                and slot["epoch"] != expect_epoch
+            ):
+                raise RuntimeError(
+                    f"{which} epoch changed since readmission "
+                    f"(epoch {slot['epoch']} != expected "
+                    f"{expect_epoch}); repair refused"
+                )
             host = slot.get("host")
             if host is None:
                 raise RuntimeError(
-                    "live epoch retains no host source; repair "
+                    f"{which} epoch retains no host source; repair "
                     "requires a publish through this store"
                 )
             fields, axes, payloads = [], [], []

@@ -522,6 +522,409 @@ def make_partitioned_evaluator(
     return run
 
 
+def make_partitioned_cache(
+    mesh: Mesh,
+    n_rows_local: int = 1 << 10,
+    entries: int = 8,
+    batch_axis: str = "batch",
+    table_axis: str = "table",
+):
+    """VerdictCache (engine/memo.py) laid out for the partitioned
+    memo evaluator: rows [dp, tp, n_rows_local + 1, 5 * entries]
+    sharded P(batch, table) — each chip owns its batch row's slice of
+    the bucket-row space (co-located with the table shard that owns
+    the same hashed rows), plus its private scratch row.  Batch rows
+    warm independent copies (their tuple streams differ), so capacity
+    scales with the mesh in both axes."""
+    from cilium_tpu.engine.memo import (
+        CACHE_WORDS,
+        EMPTY,
+        VerdictCache,
+    )
+
+    if n_rows_local & (n_rows_local - 1):
+        raise ValueError(
+            f"cache rows per shard must be a power of two: "
+            f"{n_rows_local}"
+        )
+    dp = int(mesh.shape[batch_axis])
+    tp = int(mesh.shape[table_axis])
+
+    def factory():
+        import numpy as np
+
+        return np.full(
+            (dp, tp, n_rows_local + 1, CACHE_WORDS * entries),
+            EMPTY, np.uint32,
+        )
+
+    sharding = NamedSharding(mesh, P(batch_axis, table_axis))
+    return VerdictCache(rows_factory=factory, sharding=sharding)
+
+
+def make_partitioned_memo_evaluator(
+    mesh: Mesh,
+    tables: PolicyTables,
+    cache_rows,
+    rep_cap: int,
+    miss_cap: int = None,
+    batch_axis: str = "batch",
+    table_axis: str = "table",
+    collect_telemetry: bool = False,
+):
+    """make_partitioned_evaluator with the verdict-memoization plane
+    in front (engine/memo.py): each batch shard dedups its own tuple
+    stream in-jit, the representatives probe a cache whose bucket
+    rows shard along the table axis exactly like l4_hash_rows (the
+    owning chip gathers, one psum pair returns the hit + value
+    words), and only the missed representatives run the routed
+    lattice gathers.  Cache inserts land on the owning chip only.
+
+    `cache_rows` fixes the cache geometry (a make_partitioned_cache
+    rows array: [dp, tp, R_local + 1, 5e]); `rep_cap`/`miss_cap` are
+    the per-batch-shard compaction capacities.  All tp chips of a
+    mesh row compute identical dedup/probe decisions from identical
+    replicated inputs, so the routing stays SPMD-uniform.
+
+    Returns fn(tables, batch, cache_rows) -> (Verdicts, l4_counts,
+    l3_counts, cache_rows', hit bool [B], stats u32 [STATS]
+    [, per-chip telemetry rows]) — same counter/telemetry contract
+    as make_partitioned_evaluator; when stats[STAT_OVERFLOW] != 0
+    every output except cache_rows' (returned unchanged) is
+    unspecified and the caller must re-dispatch through the uncached
+    evaluator."""
+    from cilium_tpu.compiler.partition import (
+        divisible_partition_specs,
+    )
+    from cilium_tpu.compiler.tables import (
+        L4H_WILD_IDX,
+        l4h_key0,
+        l4h_key1,
+    )
+    from cilium_tpu.engine.hashtable import fnv1a_device
+    from cilium_tpu.engine import memo as vm
+    from cilium_tpu.engine.verdict import (
+        _index_identity,
+        _l4hash_probe,
+    )
+
+    if tables.l4_hash_rows is None:
+        raise ValueError(
+            "partitioned memo evaluator requires the hashed L4 "
+            "entry tables"
+        )
+    if miss_cap is None:
+        miss_cap = rep_cap
+    ntp = int(mesh.shape[table_axis])
+    ndp = int(mesh.shape[batch_axis])
+    t_specs = divisible_partition_specs(tables, ntp, table_axis)
+    rows_sharded = table_axis in tuple(
+        ax for ax in t_specs.l4_hash_rows
+    )
+    l3_sharded = table_axis in tuple(
+        ax for ax in t_specs.l3_allow_bits
+    )
+    n_rows_global = int(tables.l4_hash_rows.shape[0])
+    cshape = tuple(cache_rows.shape)
+    if cshape[0] != ndp or cshape[1] != ntp:
+        raise ValueError(
+            f"cache rows {cshape} do not match the mesh "
+            f"({ndp}, {ntp})"
+        )
+    c_local = int(cshape[2]) - 1  # per-chip bucket rows (last=scratch)
+    c_global = c_local * ntp
+    entries = int(cshape[3]) // vm.CACHE_WORDS
+
+    b_specs = batch_specs(batch_axis)
+    v_specs = Verdicts(
+        allowed=P(batch_axis),
+        proxy_port=P(batch_axis),
+        match_kind=P(batch_axis),
+    )
+    l3c_spec = P(None, None, table_axis) if l3_sharded else P()
+    cache_spec = P(batch_axis, table_axis)
+    out_specs = (
+        v_specs, P(), l3c_spec, cache_spec, P(batch_axis), P(),
+    )
+    if collect_telemetry:
+        out_specs = out_specs + (P(batch_axis, None, None),)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(t_specs, b_specs, cache_spec),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def step(tables_l: PolicyTables, batch_l: TupleBatch, cache_l):
+        cache2 = cache_l[0, 0]  # [R_local + 1, 5e]
+        my_col = jax.lax.axis_index(table_axis)
+        idx, known = _index_identity(tables_l, batch_l)
+        proto = jnp.clip(batch_l.proto, 0, 255).astype(jnp.int32)
+        dport = jnp.clip(batch_l.dport, 0, 65535).astype(jnp.int32)
+
+        # -- Level A: per-batch-shard dedup (identical on every
+        # table chip of the row: same replicated inputs) --------------
+        k0, k1, k2 = vm.memo_key_words(
+            idx, known, None, batch_l.ep_index, batch_l.direction,
+            dport, proto,
+        )
+        g = vm.dedup_groups(k0, k1, k2, rep_cap)
+        rep_orig = g["rep_orig"]
+        r = rep_orig[:rep_cap]
+        rk0, rk1, rk2 = k0[r], k1[r], k2[r]
+
+        # -- Level B: routed cache probe (bucket rows shard along
+        # the table axis like l4_hash_rows) ---------------------------
+        h = fnv1a_device(jnp.stack([rk0, rk1, rk2], axis=1))
+        bucket = (h & jnp.uint32(c_global - 1)).astype(jnp.int32)
+        if ntp > 1:
+            pc = bucket // c_local
+            owns_c = pc == my_col
+            cl = jnp.clip(bucket - pc * c_local, 0, c_local - 1)
+        else:
+            pc = jnp.zeros(bucket.shape, jnp.int32)
+            owns_c = jnp.ones(bucket.shape, bool)
+            cl = bucket
+        crow = cache2[cl]  # [U, 5e] local gather
+        e = entries
+        lane_hit = (
+            (crow[:, :e] == rk0[:, None])
+            & (crow[:, e : 2 * e] == rk1[:, None])
+            & (crow[:, 2 * e : 3 * e] == rk2[:, None])
+            & owns_c[:, None]
+        )
+        hit_local = jnp.any(lane_hit, axis=1)
+        cv0_l = jnp.sum(
+            jnp.where(lane_hit, crow[:, 3 * e : 4 * e], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        cv1_l = jnp.sum(
+            jnp.where(lane_hit, crow[:, 4 * e : 5 * e], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        if ntp > 1:
+            hit = (
+                jax.lax.psum(
+                    hit_local.astype(jnp.int32), table_axis
+                )
+                > 0
+            )
+            cv0 = jax.lax.psum(cv0_l, table_axis)
+            cv1 = jax.lax.psum(cv1_l, table_axis)
+        else:
+            hit, cv0, cv1 = hit_local, cv0_l, cv1_l
+        hit = hit & g["rep_valid"]
+        # owner-local insert-lane choice (only the owner's is used);
+        # bucket_insert_lanes guarantees distinct (bucket, lane)
+        # targets per batch — the duplicate-index scatter atomicity
+        # argument lives in ONE place (engine/memo.py)
+        ins_lane, ins_ok = vm.bucket_insert_lanes(
+            (crow[:, :e] == vm.EMPTY) & owns_c[:, None], bucket, e
+        )
+
+        # -- miss compaction + routed lattice on missed reps ----------
+        miss = g["rep_valid"] & ~hit
+        n_miss = jnp.sum(miss.astype(jnp.int32))
+        (miss_pos,) = jnp.nonzero(
+            miss, size=miss_cap, fill_value=rep_cap
+        )
+        m_orig = rep_orig[miss_pos]
+        m_idx = idx[m_orig]
+        m_known = known[m_orig]
+        m_ep = batch_l.ep_index[m_orig]
+        m_dir = batch_l.direction[m_orig]
+        m_dport = dport[m_orig]
+        m_proto = proto[m_orig]
+
+        w0 = l4h_key0(m_idx.astype(jnp.uint32), m_dir, m_ep)
+        w1 = l4h_key1(m_dport, m_proto, m_ep)
+        hh = fnv1a_device(jnp.stack([w0, w1], axis=1))
+        hb = (hh & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
+        rows_l = tables_l.l4_hash_rows
+        n_local = rows_l.shape[0]
+        eh = rows_l.shape[1] // 3
+        if rows_sharded:
+            off = jax.lax.axis_index(table_axis) * n_local
+            bl = hb - off
+            owns = (bl >= 0) & (bl < n_local)
+            bl = jnp.clip(bl, 0, n_local - 1)
+        else:
+            owns = jnp.ones(hb.shape, bool)
+            bl = hb
+        row = rows_l[bl]
+        hitx = (
+            (row[:, :eh] == w0[:, None])
+            & (row[:, eh : 2 * eh] == w1[:, None])
+            & owns[:, None]
+        )
+        val_local = jnp.sum(
+            jnp.where(hitx, row[:, 2 * eh : 3 * eh], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        found_local = jnp.any(hitx, axis=1)
+        if rows_sharded:
+            val1 = jax.lax.psum(val_local, table_axis)
+            found1 = (
+                jax.lax.psum(
+                    found_local.astype(jnp.int32), table_axis
+                )
+                > 0
+            )
+        else:
+            val1, found1 = val_local, found_local
+        stash = tables_l.l4_hash_stash
+        s_hit = (stash[None, :, 0] == w0[:, None]) & (
+            stash[None, :, 1] == w1[:, None]
+        )
+        val1 = val1 + jnp.sum(
+            jnp.where(s_hit, stash[None, :, 2], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        found1 = found1 | jnp.any(s_hit, axis=1)
+        wild_idx = jnp.full(
+            m_idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
+        )
+        hit3, val3 = _l4hash_probe(
+            tables_l.l4_wild_rows, tables_l.l4_wild_stash,
+            m_ep, m_dir, wild_idx, m_dport, m_proto,
+        )
+        p1m = m_known & found1
+        p3m = hit3
+        val = jnp.where(p1m, val1, val3)
+        m_proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        m_j = (val >> jnp.uint32(16)).astype(jnp.int32)
+        # routed L3 probe for the missed reps
+        m_word = m_idx >> 5
+        m_bit = (m_idx & 31).astype(jnp.uint32)
+        w_local = tables_l.l3_allow_bits.shape[-1]
+        if l3_sharded:
+            offw = jax.lax.axis_index(table_axis) * w_local
+            wl = m_word - offw
+            owns_w = (wl >= 0) & (wl < w_local)
+            wl = jnp.clip(wl, 0, w_local - 1)
+        else:
+            offw = 0
+            owns_w = jnp.ones(m_word.shape, bool)
+            wl = m_word
+        l3_words = tables_l.l3_allow_bits[m_ep, m_dir, wl]
+        p2m_local = (
+            m_known & owns_w & ((l3_words >> m_bit) & 1).astype(bool)
+        )
+        if l3_sharded:
+            p2m = (
+                jax.lax.psum(
+                    p2m_local.astype(jnp.int32), table_axis
+                )
+                > 0
+            )
+        else:
+            p2m = p2m_local
+        mv0, mv1 = vm.pack_value_words(p1m, p2m, p3m, m_proxy, m_j)
+
+        # -- rep values -> per-tuple scatter-back (shared helper:
+        # the bit-identity index arithmetic lives in engine/memo.py)
+        bsz = k0.shape[0]
+        v0, v1, tuple_hit = vm.scatter_back(
+            g, rep_cap, hit, cv0, cv1, miss_pos, mv0, mv1
+        )
+
+        overflow = g["overflow"] + jnp.maximum(n_miss - miss_cap, 0)
+        ok = overflow == 0
+        # -- owner-local insert of missed reps ------------------------
+        do_ins = (jnp.arange(miss_cap) < n_miss) & ok
+        mp = miss_pos
+        pc_p = vm.pad_rep(pc, mp)
+        cl_p = vm.pad_rep(cl, mp)
+        lane_p = vm.pad_rep(ins_lane, mp)
+        ok_p = vm.pad_rep(ins_ok, mp)
+        own_ins = do_ins & ok_p & (pc_p == my_col)
+        k0_p = vm.pad_rep(rk0, mp)
+        k1_p = vm.pad_rep(rk1, mp)
+        k2_p = vm.pad_rep(rk2, mp)
+        ins_row = jnp.where(own_ins, cl_p, c_local)
+        rows_idx = jnp.concatenate([ins_row] * vm.CACHE_WORDS)
+        lanes_idx = jnp.concatenate(
+            [lane_p + c * e for c in range(vm.CACHE_WORDS)]
+        )
+        vals = jnp.concatenate([k0_p, k1_p, k2_p, mv0, mv1])
+        cache_out = cache2.at[rows_idx, lanes_idx].set(vals)
+        cache_out = jnp.where(ok, cache_out, cache2)[None, None]
+
+        # -- combine + the shared counter/telemetry epilogue ----------
+        probe1, probe2, probe3, t_proxy, t_j = vm.unpack_value_words(
+            v0, v1
+        )
+        v = _combine(
+            probe1, probe2, probe3, t_proxy, batch_l.is_fragment
+        )
+        # p2_local for the shard-local L3 counter: each identity
+        # word has ONE owner, so the global probe2 restricted to the
+        # owned word range IS the local hit (no gather needed)
+        t_word = idx >> 5
+        if l3_sharded:
+            t_offw = jax.lax.axis_index(table_axis) * w_local
+            t_owns = ((t_word - t_offw) >= 0) & (
+                (t_word - t_offw) < w_local
+            )
+        else:
+            t_offw = 0
+            t_owns = jnp.ones(t_word.shape, bool)
+        p2_local_t = probe2 & t_owns
+        stats = jnp.stack(
+            [
+                g["n_unique"].astype(jnp.uint32),
+                jnp.sum(tuple_hit, dtype=jnp.uint32),
+                jnp.sum((do_ins & ok_p).astype(jnp.uint32)),
+                overflow.astype(jnp.uint32),
+                jnp.uint32(bsz),
+            ]
+        )
+        stats = jax.lax.psum(stats, batch_axis)
+        epilogue = _counts_and_telemetry(
+            v, tables_l, batch_l, t_j, idx, p2_local_t, t_offw,
+            w_local, batch_axis, collect_telemetry,
+        )
+        if collect_telemetry:
+            v, l4c, l3c, trow = epilogue
+            return (
+                v, l4c, l3c, cache_out, tuple_hit, stats, trow,
+            )
+        v, l4c, l3c = epilogue
+        return v, l4c, l3c, cache_out, tuple_hit, stats
+
+    in_shardings = (
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), t_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), b_specs),
+        NamedSharding(mesh, cache_spec),
+    )
+    jitted = jax.jit(step, in_shardings=in_shardings)
+    built_geom = (
+        tuple(tables.l4_hash_rows.shape),
+        tuple(tables.l3_allow_bits.shape),
+        cshape,
+    )
+
+    def run(tables_in: PolicyTables, batch: TupleBatch, cache_in):
+        got = (
+            tuple(tables_in.l4_hash_rows.shape),
+            tuple(tables_in.l3_allow_bits.shape),
+            tuple(cache_in.shape),
+        )
+        if got != built_geom:
+            raise ValueError(
+                "partitioned memo evaluator was built for geometry "
+                f"{built_geom} but called with {got}; rebuild with "
+                "make_partitioned_memo_evaluator"
+            )
+        return jitted(tables_in, batch, cache_in)
+
+    return run
+
+
 def make_replica_store(
     mesh: Mesh,
     table_axis: str = "table",

@@ -102,6 +102,7 @@ def capture_batch(
     now: Optional[float] = None,
     metrics_registry=None,
     trace_id: str = "",
+    cache_hit=None,
 ) -> int:
     """Fold one batch's per-tuple columns into the store.  All
     columns are host arrays of one length (the batch's VALID prefix —
@@ -112,7 +113,10 @@ def capture_batch(
     flow_records_captured_total / flow_store_evicted (None = no
     metrics — tools and benches that must not touch the process
     registry).  ``trace_id`` stamps the span-plane join key on every
-    record of a traced batch (GET /flows?trace-id=...).  Returns the
+    record of a traced batch (GET /flows?trace-id=...).
+    ``cache_hit`` is the per-tuple verdict-cache hit column of a
+    memoized dispatch (None = uncached path, records carry False) —
+    `cilium-tpu observe --cache-hit` filters on it.  Returns the
     number of records captured."""
     allowed = np.asarray(allowed).astype(bool)
     kind = np.asarray(match_kind)
@@ -162,6 +166,11 @@ def capture_batch(
         if not np.isscalar(chip)
         else np.full(b, int(chip), np.int32)
     )
+    hits = (
+        np.zeros(b, bool)
+        if cache_hit is None
+        else np.asarray(cache_hit).astype(bool)
+    )
     ts = time.time() if now is None else now
     records = [
         FlowRecord(
@@ -181,6 +190,7 @@ def capture_batch(
             proxy_port=int(proxy[i]),
             ct_state=int(ct_res[i]),
             trace_id=trace_id,
+            cache_hit=bool(hits[i]),
         )
         for i in idx
     ]

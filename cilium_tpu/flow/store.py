@@ -54,6 +54,9 @@ class FlowRecord:
     ct_state: int = 0  # CT_* result (0 = stateless/audit path)
     seq: int = 0  # store-assigned monotonic sequence
     trace_id: str = ""  # span-plane join key ("" when untraced)
+    # verdict served from the device verdict cache (engine/memo.py);
+    # False on uncached paths and degraded host-fold batches
+    cache_hit: bool = False
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -124,6 +127,7 @@ class FlowFilter:
     since: Optional[float] = None
     chip: Optional[int] = None
     trace_id: Optional[str] = None
+    cache_hit: Optional[bool] = None
 
     # GET /flows query-param name → field + parser
     PARAM_FIELDS = {
@@ -137,6 +141,11 @@ class FlowFilter:
         "since": ("since", _parse_since),
         "chip": ("chip", int),
         "trace-id": ("trace_id", lambda v: str(v).lower()),
+        "cache-hit": (
+            "cache_hit",
+            lambda v: str(v).strip().lower()
+            in ("1", "true", "yes", "on"),
+        ),
     }
 
     @classmethod
@@ -186,6 +195,11 @@ class FlowFilter:
         if self.chip is not None and r.chip != self.chip:
             return False
         if self.trace_id is not None and r.trace_id != self.trace_id:
+            return False
+        if (
+            self.cache_hit is not None
+            and bool(r.cache_hit) != self.cache_hit
+        ):
             return False
         return True
 

@@ -331,6 +331,28 @@ class Registry:
             "rebalances (replaying the rows a chip missed while its "
             "breaker was open, through the delta-scatter path)",
         )
+        # -- verdict memoization plane (engine/memo.py) ------------------
+        self.verdict_cache_hits_total = Counter(
+            f"{ns}_verdict_cache_hits_total",
+            "Tuples whose policy verdict was served from the "
+            "device-resident verdict cache (lattice gathers skipped)",
+        )
+        self.verdict_cache_misses_total = Counter(
+            f"{ns}_verdict_cache_misses_total",
+            "Tuples whose policy key missed the verdict cache and "
+            "was evaluated through the lattice",
+        )
+        self.verdict_cache_insertions_total = Counter(
+            f"{ns}_verdict_cache_insertions_total",
+            "Entries inserted into the verdict cache (missed "
+            "representatives after intra-batch dedup)",
+        )
+        self.verdict_cache_flushes_total = Counter(
+            f"{ns}_verdict_cache_flushes_total",
+            "Verdict-cache flushes (epoch-stamp change on a delta "
+            "publish / repack / partition change, or a chip "
+            "kill/readmission)",
+        )
         # -- flow observability plane (cilium_tpu.flow) ------------------
         self.flow_records_captured_total = Counter(
             f"{ns}_flow_records_captured_total",

@@ -1,0 +1,801 @@
+"""Verdict memoization (engine/memo.py): intra-batch dedup + the
+device-resident policy-verdict cache with epoch-stamped invalidation.
+
+The tentpole contract (ISSUE 9): the memoized programs are
+bit-identical to the uncached reference on the full verdict surface —
+on uniform AND skewed flows, across interleaved delta publishes
+(every post-publish batch proves the stale cache was flushed), at
+table-axis sizes {1, 2, 4}, and through chip kill/readmission (the
+failover router flushes the attached cache on every breaker
+transition).  A hash-collision adversarial case proves two distinct
+policy keys forced into one bucket can never alias — a collision only
+costs a miss.
+
+Runs on the 8-virtual-device CPU mesh forced by conftest.py.
+"""
+
+import copy
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from cilium_tpu import faultinject, tracing
+from cilium_tpu.compiler import partition
+from cilium_tpu.compiler.tables import (
+    FleetCompiler,
+    compile_map_states,
+    tables_layout_version,
+)
+from cilium_tpu.engine import memo as vm
+from cilium_tpu.engine.failover import ChipFailoverRouter
+from cilium_tpu.engine.hostpath import lattice_fold_host
+from cilium_tpu.engine.oracle import evaluate_batch_oracle
+from cilium_tpu.engine.sharded import (
+    make_partitioned_cache,
+    make_partitioned_evaluator,
+    make_partitioned_memo_evaluator,
+    make_replica_store,
+)
+from cilium_tpu.engine.verdict import TupleBatch, evaluate_batch
+from cilium_tpu.maps.policymap import (
+    INGRESS,
+    PolicyKey,
+    PolicyMapStateEntry,
+)
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.resilience import ChipBreakerBank
+
+from tests.test_verdict_engine import random_map_state, random_tuples
+
+WIDE_IDS = [1, 2, 3, 4, 5] + [256 + i for i in range(120)] + [65536, 70000]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_all_faults():
+    faultinject.disarm_all()
+    yield
+    faultinject.disarm_all()
+
+
+def _mesh(dp, tp):
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must force 8 virtual devices"
+    return jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+
+
+def _build(seed, n_eps=3, identity_pad=256, batch=768):
+    rng = np.random.default_rng(seed)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(n_eps)
+    ]
+    tables = compile_map_states(
+        states, WIDE_IDS, identity_pad=identity_pad, filter_pad=16
+    )
+    t = random_tuples(rng, batch, n_eps, WIDE_IDS)
+    return states, tables, t
+
+
+def _skew(t, rng, n_keys):
+    """Collapse a uniform tuple dict onto `n_keys` distinct rows —
+    the Zipf-head shape the dedup level exists for."""
+    b = len(t["ep_index"])
+    picks = rng.integers(0, n_keys, size=b)
+    return {k: np.asarray(v)[picks] for k, v in t.items()}
+
+
+def _assert_verdicts_equal(got, ref, tag=""):
+    for col in ("allowed", "proxy_port", "match_kind"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, col)),
+            np.asarray(getattr(ref, col)),
+            err_msg=f"{tag}:{col}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# the memoized evaluator: dedup + cache, bit-identity, overflow refusal
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_memo_evaluator_bit_identical_uniform_and_skewed(seed):
+    states, tables, t = _build(seed)
+    rng = np.random.default_rng(seed + 100)
+    b = len(t["ep_index"])
+    kern = vm.memo_evaluate_kernel(rep_cap=b)
+    cache = jax.device_put(vm.make_cache_rows(1 << 8, 8))
+
+    for tag, td in (
+        ("uniform", t),
+        ("skewed", _skew(t, rng, 24)),
+    ):
+        batch = TupleBatch.from_numpy(**td)
+        ref = evaluate_batch(tables, batch)
+        want = evaluate_batch_oracle(copy.deepcopy(states), **td)
+        # cold pass, then a warm pass over the same batch: repeats
+        # must be served from the cache without changing one bit
+        for p in range(2):
+            v, cache, hit, stats = kern(tables, batch, cache)
+            _assert_verdicts_equal(v, ref, f"{tag}:pass{p}")
+            np.testing.assert_array_equal(
+                np.asarray(v.allowed), want[0], err_msg=tag
+            )
+            s = np.asarray(stats)
+            assert int(s[vm.STAT_OVERFLOW]) == 0
+            assert int(s[vm.STAT_TUPLES]) == b
+            assert int(s[vm.STAT_HIT]) == int(
+                np.asarray(hit).sum()
+            )
+        # warm pass: every tuple's key is resident now
+        assert int(np.asarray(hit).sum()) == b, tag
+    # the skewed batch collapsed onto few representatives
+    assert int(np.asarray(stats)[vm.STAT_UNIQUE]) <= 24
+
+
+def test_memo_overflow_refuses_batch_and_preserves_cache():
+    """A batch with more distinct keys than the compaction capacity
+    is refused: overflow reported, carried cache state untouched —
+    the host wrapper re-dispatches through the uncached program."""
+    _, tables, t = _build(seed=2)
+    b = len(t["ep_index"])
+    kern = vm.memo_evaluate_kernel(rep_cap=8)
+    cache0 = jax.device_put(vm.make_cache_rows(1 << 6, 4))
+    before = np.asarray(cache0)
+    _, cache1, _, stats = kern(
+        tables, TupleBatch.from_numpy(**t), cache0
+    )
+    assert int(np.asarray(stats)[vm.STAT_OVERFLOW]) > 0
+    np.testing.assert_array_equal(np.asarray(cache1), before)
+
+
+def test_hash_collision_never_aliases():
+    """Adversarial: a 1-row cache forces EVERY distinct policy key
+    into the same bucket.  Collisions may only cost misses — across
+    repeated passes with more distinct keys than the bucket has
+    lanes, every verdict stays bit-identical to the uncached
+    reference."""
+    states, tables, t = _build(seed=3, batch=512)
+    b = len(t["ep_index"])
+    kern = vm.memo_evaluate_kernel(rep_cap=b)
+    # 1 bucket row x 4 lanes (+ scratch): worst-case collision table
+    cache = jax.device_put(vm.make_cache_rows(1, 4))
+    batch = TupleBatch.from_numpy(**t)
+    ref = evaluate_batch(tables, batch)
+    hits = []
+    for p in range(3):
+        v, cache, hit, stats = kern(tables, batch, cache)
+        _assert_verdicts_equal(v, ref, f"collision:pass{p}")
+        s = np.asarray(stats)
+        assert int(s[vm.STAT_OVERFLOW]) == 0
+        # at most `entries` same-batch inserts land per bucket — the
+        # rest are dropped so no two inserts share one (bucket,
+        # lane) within a scatter (entry-word atomicity)
+        assert int(s[vm.STAT_INSERT]) <= 4
+        hits.append(int(np.asarray(hit).sum()))
+    assert hits[0] == 0
+    # SOME keys survive in the 4 lanes; the rest miss — never alias
+    assert 0 < hits[-1] < b
+
+
+def test_cache_probe_unit_collision():
+    """Unit-level: insert key A into bucket 0, probe key B mapping
+    to the same bucket — must miss, never return A's value."""
+    import jax.numpy as jnp
+
+    rows = jax.device_put(vm.make_cache_rows(1, 2))
+    ka = (jnp.uint32(5), jnp.uint32(7), jnp.uint32(9))
+    kb = (jnp.uint32(6), jnp.uint32(7), jnp.uint32(9))
+    one = lambda x: jnp.asarray([x])
+    valid = jnp.asarray([True])
+    hit, v0, v1, bucket, lane, ok = vm.cache_probe(
+        rows, one(ka[0]), one(ka[1]), one(ka[2]), valid
+    )
+    assert not bool(np.asarray(hit)[0])
+    assert bool(np.asarray(ok)[0])
+    rows = vm.cache_insert(
+        rows, bucket, lane,
+        one(ka[0]), one(ka[1]), one(ka[2]),
+        one(jnp.uint32(0xAB)), one(jnp.uint32(0x3)), valid,
+    )
+    hit_a, v0_a, _, _, _, _ = vm.cache_probe(
+        rows, one(ka[0]), one(ka[1]), one(ka[2]), valid
+    )
+    assert bool(np.asarray(hit_a)[0])
+    assert int(np.asarray(v0_a)[0]) == 0xAB
+    hit_b, _, _, _, _, _ = vm.cache_probe(
+        rows, one(kb[0]), one(kb[1]), one(kb[2]), valid
+    )
+    assert not bool(np.asarray(hit_b)[0]), (
+        "colliding key aliased a resident entry"
+    )
+
+
+# ---------------------------------------------------------------------------
+# 60-step churn: delta publishes interleaved with cached dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_churn_60_steps_flush_and_recovery():
+    """Interleave policy churn (republished tables, generation
+    bumps) with cached dispatch: every post-publish batch proves the
+    stale cache was flushed (zero hits + bit-identity vs the host
+    oracle on the NEW tables) and the hit rate recovers on the next
+    dispatch; steps without churn keep serving hits."""
+    rng = np.random.default_rng(11)
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=12, n_l3=16)
+        for _ in range(3)
+    ]
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            WIDE_IDS,
+        )[0]
+
+    tables = compile_eps()
+    cache = vm.VerdictCache(n_rows=1 << 8)
+
+    def stamp(tb):
+        return (
+            int(np.asarray(tb.generation)) & 0xFFFFFFFF,
+            tables_layout_version(tb),
+        )
+
+    cache.ensure(stamp(tables))
+    b = 256
+    kerns = {}
+
+    def dispatch(tb, td):
+        rep = len(td["ep_index"])
+        k = kerns.setdefault(
+            rep, vm.memo_evaluate_kernel(rep_cap=rep)
+        )
+        v, rows, hit, stats = k(
+            tb, TupleBatch.from_numpy(**td), cache.rows
+        )
+        row = cache.account(stats)
+        assert row["overflow"] == 0
+        cache.rows = rows
+        return v, row
+
+    # one warm tuple universe, skewed: dispatches repeat keys
+    base = random_tuples(rng, b, 3, WIDE_IDS)
+    td = _skew(base, rng, 48)
+    ports = iter(range(20000, 20600))
+    for step in range(60):
+        churn = step % 3 != 2  # 2 churn steps for each quiet one
+        if churn:
+            ep = int(rng.integers(0, 3))
+            if rng.random() < 0.25 and len(states[ep]) > 4:
+                del states[ep][
+                    list(states[ep].keys())[
+                        int(rng.integers(0, len(states[ep])))
+                    ]
+                ]
+            else:
+                states[ep][
+                    PolicyKey(
+                        int(rng.choice(WIDE_IDS)),
+                        next(ports), 6, INGRESS,
+                    )
+                ] = PolicyMapStateEntry()
+            tables = compile_eps()
+            flushed = cache.ensure(stamp(tables))
+            assert flushed, f"step {step}: publish did not flush"
+
+        v, row = dispatch(tables, td)
+        if churn:
+            assert row["hits"] == 0, (
+                f"step {step}: stale cache served hits post-publish"
+            )
+        want = evaluate_batch_oracle(copy.deepcopy(states), **td)
+        np.testing.assert_array_equal(
+            np.asarray(v.allowed), want[0],
+            err_msg=f"step {step} (churn={churn})",
+        )
+        _assert_verdicts_equal(
+            v, evaluate_batch(tables, TupleBatch.from_numpy(**td)),
+            f"step {step}",
+        )
+        # hit-rate recovery: the SAME stream dispatched again is
+        # served from the (re)warmed cache
+        _, row2 = dispatch(tables, td)
+        assert row2["hits"] == b, f"step {step}: no recovery"
+    # 2 of every 3 steps churned; the very first ensure() adopts the
+    # stamp on the fresh (never-written) buffer without a flush event
+    assert cache.flushes >= 39
+
+
+# ---------------------------------------------------------------------------
+# partitioned memo evaluator: table-axis sizes {1, 2, 4}
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dp,tp", [(8, 1), (4, 2), (2, 4)])
+def test_partitioned_memo_bit_identical(dp, tp):
+    """The memo plane over the partitioned evaluator: verdicts and
+    both counter tensors bit-identical to the routed-gather
+    reference and the host oracle at every table-axis size, cold and
+    warm."""
+    states, tables, t = _build(seed=7)
+    mesh = _mesh(dp, tp)
+    batch = TupleBatch.from_numpy(**t)
+    b = len(t["ep_index"])
+
+    ref_v, ref_l4, ref_l3 = make_partitioned_evaluator(mesh, tables)(
+        tables, batch
+    )
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+
+    cache = make_partitioned_cache(mesh, n_rows_local=256, entries=8)
+    run = make_partitioned_memo_evaluator(
+        mesh, tables, cache.rows, rep_cap=b // dp
+    )
+    hits_seen = []
+    rows = cache.rows
+    for p in range(2):
+        v, l4c, l3c, rows, hit, stats = run(tables, batch, rows)
+        _assert_verdicts_equal(v, ref_v, f"tp{tp}:pass{p}")
+        np.testing.assert_array_equal(np.asarray(v.allowed), want[0])
+        np.testing.assert_array_equal(
+            np.asarray(l4c), np.asarray(ref_l4)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(l3c), np.asarray(ref_l3)
+        )
+        s = np.asarray(stats)
+        assert int(s[vm.STAT_OVERFLOW]) == 0
+        assert int(s[vm.STAT_TUPLES]) == b
+        hits_seen.append(int(np.asarray(hit).sum()))
+    assert hits_seen[0] == 0 and hits_seen[1] == b
+    # flushing (fresh rows) drops back to zero hits — the partition
+    # stamp seam the VerdictCache wrapper rides
+    cache.flush(reason="test")
+    _, _, _, _, hit, _ = run(tables, batch, cache.rows)
+    assert int(np.asarray(hit).sum()) == 0
+
+
+def test_partitioned_memo_geometry_guard():
+    _, tables, t = _build(seed=8)
+    mesh = _mesh(2, 4)
+    cache = make_partitioned_cache(mesh, n_rows_local=256)
+    run = make_partitioned_memo_evaluator(
+        mesh, tables, cache.rows, rep_cap=96
+    )
+    wrong = make_partitioned_cache(mesh, n_rows_local=128)
+    with pytest.raises(ValueError, match="geometry"):
+        run(tables, TupleBatch.from_numpy(**t), wrong.rows)
+
+
+# ---------------------------------------------------------------------------
+# failover: breaker transitions flush the attached cache
+# ---------------------------------------------------------------------------
+
+
+def test_router_breaker_transitions_flush_verdict_cache():
+    states, tables, t = _build(seed=9)
+    mesh = _mesh(2, 4)
+
+    def fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            states, ep, ident, dport, proto, dirn, is_fragment=frag
+        )
+
+    bank = ChipBreakerBank(recovery_timeout=0.02, failure_threshold=1)
+    router = ChipFailoverRouter(
+        mesh, tables, bank=bank, host_fold=fold,
+        collect_telemetry=False,
+    )
+    router.publish(tables)
+    router.publish(tables)
+    cache = vm.VerdictCache(n_rows=1 << 6)
+    cache.ensure(("epoch", 1))
+    router.attach_verdict_cache(cache)
+
+    victim = int(router.ordinals[0, 1])
+    flushes0 = cache.flushes
+    bank.record_failure(victim, "test kill")  # closed -> open
+    assert cache.flushes == flushes0 + 1
+    assert cache.stamp is None  # stamp dropped: next ensure() reloads
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    res = router.dispatch(**t)
+    np.testing.assert_array_equal(res.verdicts.allowed, want[0])
+    time.sleep(0.05)
+    res = router.dispatch(**t)  # half-open -> closed (readmission)
+    np.testing.assert_array_equal(res.verdicts.allowed, want[0])
+    assert bank.state(victim) == "closed"
+    # open -> half_open and half_open -> closed both flushed
+    assert cache.flushes >= flushes0 + 3
+
+
+# ---------------------------------------------------------------------------
+# spare-epoch repair at chip readmission (ISSUE 9 satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_spare_epoch_repaired_from_host_snapshot_on_readmit():
+    """Poison-then-readmit: publishes land while a chip is out (the
+    standby becomes semantically stale on its slice), the spare's
+    device rows are poisoned, and re-admission repairs the chip's
+    whole owned slice of the SPARE from the retained host snapshot —
+    instead of de-registering it — so the NEXT publish stays on the
+    delta path (no full upload)."""
+    import dataclasses
+
+    rng = np.random.default_rng(10)
+    mesh = _mesh(2, 4)
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(3)
+    ]
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            WIDE_IDS,
+        )[0]
+
+    tables = compile_eps()
+    t = random_tuples(rng, 768, 3, WIDE_IDS)
+
+    def fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            states, ep, ident, dport, proto, dirn, is_fragment=frag
+        )
+
+    bank = ChipBreakerBank(recovery_timeout=0.02, failure_threshold=1)
+    router = ChipFailoverRouter(
+        mesh, tables, bank=bank, host_fold=fold,
+        collect_telemetry=False,
+    )
+    router.publish(tables)
+    router.publish(compile_eps())
+    store = router.store
+
+    victim = int(router.ordinals[1, 0])
+    faultinject.arm("engine.dispatch", f"raise:chip={victim};next=1")
+    router.dispatch(**t)
+    assert bank.state(victim) != "closed"
+
+    # TWO delta publishes while out: after them the SPARE slot holds
+    # an epoch published during the outage — stale on the victim's
+    # slice
+    hist = []
+    for step in range(2):
+        base = store.spare_stamp()
+        states[0][
+            PolicyKey(
+                int(rng.choice(WIDE_IDS)), 7800 + step, 6, INGRESS
+            )
+        ] = PolicyMapStateEntry()
+        tables = compile_eps()
+        hist.append(tables)
+        delta = fc.delta_for(base, tables)
+        _, st = router.publish(tables, delta)
+        assert st.mode == "delta"
+
+    # poison the victim's owned slice of the SPARE epoch's resident
+    # hash rows (device side)
+    tp = 4
+    spare_i = store._cur ^ 1
+    slot = store._slots[spare_i]
+    assert slot is not None and slot.get("host") is not None
+    cols = np.where(router.ordinals == victim)[1]
+    col = int(cols[0])
+    aug_spare = partition.replicate_table_leaves(hist[0], tp)
+    n = np.asarray(aug_spare.l4_hash_rows).shape[0] // (2 * tp)
+    lo, hi = col * 2 * n, (col + 1) * 2 * n
+    poisoned = np.array(np.asarray(slot["tables"].l4_hash_rows))
+    poisoned[lo:hi] = 0xBADC0DE
+    slot["tables"] = dataclasses.replace(
+        slot["tables"],
+        l4_hash_rows=jax.device_put(
+            poisoned, store._shardings.l4_hash_rows
+        ),
+    )
+
+    time.sleep(0.05)
+    res = router.dispatch(**t)
+    assert victim in res.rebalanced_chips
+    assert bank.state(victim) == "closed"
+
+    # the spare survived readmission (NOT de-registered) and the
+    # poisoned owned slice was repaired from the retained host
+    spare_after = store._slots[store._cur ^ 1]
+    assert spare_after is not None, "spare was de-registered"
+    resident = np.asarray(spare_after["tables"].l4_hash_rows)
+    np.testing.assert_array_equal(
+        resident[lo:hi], np.asarray(aug_spare.l4_hash_rows)[lo:hi]
+    )
+
+    # and the next publish stays on the delta path — the readmission
+    # did NOT cost the full upload a de-registered standby would
+    base = store.spare_stamp()
+    assert base is not None
+    states[0][
+        PolicyKey(int(rng.choice(WIDE_IDS)), 7900, 6, INGRESS)
+    ] = PolicyMapStateEntry()
+    tables = compile_eps()
+    delta = fc.delta_for(base, tables)
+    _, st = router.publish(tables, delta)
+    assert st.mode == "delta", (
+        "post-readmission publish fell off the delta path"
+    )
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    res = router.dispatch(**t)
+    np.testing.assert_array_equal(res.verdicts.allowed, want[0])
+
+
+def test_spare_repair_refuses_when_slots_flipped():
+    """Store-level TOCTOU guard: readmit_chip records the stale
+    spare's stamp; a publish that lands before the repair flips the
+    slots, and repair_rows(spare=True, expect_stamp=...) must REFUSE
+    rather than scatter into whatever occupies the slot now."""
+    rng = np.random.default_rng(12)
+    mesh = _mesh(2, 4)
+    store = make_replica_store(mesh)
+    states = [random_map_state(rng, WIDE_IDS, 8, 8)]
+
+    def compile_once():
+        return compile_map_states(
+            states, WIDE_IDS, identity_pad=256, filter_pad=16
+        )
+
+    store.publish(compile_once())
+    store.publish(compile_once())
+    store.mark_chip_out(3)
+    # two publishes during the outage: the spare now holds an epoch
+    # published while the chip was out
+    store.publish(compile_once())
+    store.publish(compile_once())
+    rec = store.readmit_chip(3)
+    assert rec is not None and rec.get("spare_stale")
+    assert "spare_epoch" in rec
+    # an interleaved publish flips the slots before the repair lands
+    store.publish(compile_once())
+    with pytest.raises(RuntimeError, match="repair refused"):
+        store.repair_rows(
+            {"l4_hash_rows": (0, np.arange(4, dtype=np.int64))},
+            spare=True, expect_epoch=rec["spare_epoch"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# observability: flow bit + filter, metrics, span event
+# ---------------------------------------------------------------------------
+
+
+def test_flow_filter_cache_hit_param():
+    from cilium_tpu.flow import FlowFilter, FlowRecord, FlowStore
+
+    store = FlowStore()
+    for i, hit in enumerate((True, False, True)):
+        store.append(
+            FlowRecord(
+                ts=float(i), ep_id=1, src_identity=2,
+                dst_identity=3, dport=80, proto=6, direction=0,
+                verdict="FORWARDED", chip=0, match_kind=1,
+                cache_hit=hit,
+            )
+        )
+    f = FlowFilter.from_params({"cache-hit": "1"})
+    got = [r for r in store.snapshot() if f.matches(r)]
+    assert len(got) == 2 and all(r.cache_hit for r in got)
+    f0 = FlowFilter.from_params({"cache-hit": "false"})
+    got = [r for r in store.snapshot() if f0.matches(r)]
+    assert len(got) == 1 and not got[0].cache_hit
+    # record dicts carry the bit (the API/CLI surface)
+    assert store.snapshot()[0].to_dict()["cache_hit"] is True
+
+
+def test_verdict_cache_metrics_and_flush_span_event():
+    cache = vm.VerdictCache(n_rows=1 << 6)
+    hits0 = metrics.verdict_cache_hits_total.get()
+    miss0 = metrics.verdict_cache_misses_total.get()
+    ins0 = metrics.verdict_cache_insertions_total.get()
+    fl0 = metrics.verdict_cache_flushes_total.get()
+    # a fresh (never-written) cache ADOPTS its first stamp without a
+    # phantom flush event / second allocation
+    assert cache.ensure(("gen", 1)) is True
+    assert metrics.verdict_cache_flushes_total.get() == fl0
+    stats = np.zeros(vm.STATS, np.uint32)
+    stats[vm.STAT_UNIQUE] = 4
+    stats[vm.STAT_HIT] = 10
+    stats[vm.STAT_INSERT] = 4
+    stats[vm.STAT_TUPLES] = 16
+    row = cache.account(stats)
+    assert row["hits"] == 10
+    assert metrics.verdict_cache_hits_total.get() == hits0 + 10
+    assert metrics.verdict_cache_misses_total.get() == miss0 + 6
+    assert metrics.verdict_cache_insertions_total.get() == ins0 + 4
+    assert cache.hit_rate() == pytest.approx(10 / 16)
+    assert cache.dedup_factor() == pytest.approx(4.0)
+
+    # once rows have been written back, a stamp change FLUSHES
+    cache.rows = cache.rows
+    tracer = tracing.Tracer(seed=0, sample_rate=1.0)
+    with tracer.span("dispatch", site="test") as sp:
+        cache.ensure(("gen", 2))
+    assert metrics.verdict_cache_flushes_total.get() == fl0 + 1
+    events = [e for e in sp.events if e["name"] == "cache.flush"]
+    assert events and events[0]["new_stamp"] == str(("gen", 2))
+    # and the flush left the buffer fresh: the NEXT stamp change
+    # adopts without flushing again (no double flush per event)
+    assert cache.ensure(("gen", 3)) is True
+    assert metrics.verdict_cache_flushes_total.get() == fl0 + 1
+    # overflowed batches contribute nothing but the overflow count
+    stats = np.zeros(vm.STATS, np.uint32)
+    stats[vm.STAT_OVERFLOW] = 3
+    stats[vm.STAT_TUPLES] = 16
+    cache.account(stats)
+    assert cache.overflows == 3
+    snap = cache.snapshot()
+    assert snap["overflows"] == 3 and snap["flushes"] == cache.flushes
+
+
+# ---------------------------------------------------------------------------
+# daemon: PATCH /config toggle, end-to-end bit-identity + flow bit
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_verdict_cache_toggle_end_to_end():
+    from tests.test_replay import _daemon_with_policy, _make_buf
+
+    d, server, client = _daemon_with_policy()
+    rng = np.random.default_rng(4)
+    cid = client.security_identity.id
+    # 96 records at batch_size 64: the second batch is HALF padding,
+    # which must not leak into the hit/miss accounting
+    buf = _make_buf(rng, 96, [10], [cid, 999999])
+
+    ref = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    assert not d.verdict_cache_enabled
+
+    out = d.config_patch({"verdict_cache": True})
+    assert out["verdict_cache"] is True and out["applied"] >= 1
+    hits0 = metrics.verdict_cache_hits_total.get()
+    miss0 = metrics.verdict_cache_misses_total.get()
+    cold = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    warm = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    # exactly the real tuples accounted — padding rows excluded
+    assert (
+        metrics.verdict_cache_hits_total.get()
+        - hits0
+        + metrics.verdict_cache_misses_total.get()
+        - miss0
+    ) == 2 * 96
+    for got in (cold, warm):
+        for field in ref.verdicts:
+            np.testing.assert_array_equal(
+                got.verdicts[field], ref.verdicts[field],
+                err_msg=field,
+            )
+    assert metrics.verdict_cache_hits_total.get() > hits0
+    # the flow plane records the hit bit on the warm pass
+    hit_records = [
+        r for r in d.flow_store.snapshot() if r.cache_hit
+    ]
+    assert hit_records, "no flow record carried cache_hit"
+
+    # churn: a republish flushes before the next dispatch serves
+    fl0 = metrics.verdict_cache_flushes_total.get()
+    from cilium_tpu.labels import LabelArray
+    from cilium_tpu.policy.api import (
+        EndpointSelector,
+        IngressRule,
+        PortProtocol,
+        PortRule,
+        Rule,
+    )
+
+    d.policy_add(
+        [
+            Rule(
+                endpoint_selector=EndpointSelector(
+                    match_labels={"k8s.app": "server"}
+                ),
+                ingress=[
+                    IngressRule(
+                        from_endpoints=[
+                            EndpointSelector(
+                                match_labels={"k8s.app": "client"}
+                            )
+                        ],
+                        to_ports=[
+                            PortRule(ports=[
+                                PortProtocol(port="443", protocol="TCP")
+                            ])
+                        ],
+                    )
+                ],
+                labels=LabelArray.parse("memo-churn"),
+            )
+        ]
+    )
+    d.regenerate_all("verdict-memo churn")
+    # the publish changed the epoch stamp: the next memoized pass
+    # flushes (warm entries dropped) and serves the NEW tables
+    post = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    assert metrics.verdict_cache_flushes_total.get() > fl0
+    d.config_patch({"verdict_cache": False})
+    assert d.verdict_cache is None  # cache (and its HBM) dropped
+    base = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    for field in base.verdicts:
+        np.testing.assert_array_equal(
+            post.verdicts[field], base.verdicts[field],
+            err_msg=field,
+        )
+    # the 443 rule changed real verdicts vs the original stream
+    assert not np.array_equal(
+        base.verdicts["allowed"], ref.verdicts["allowed"]
+    )
+
+
+def test_daemon_memo_overflow_redispatches_uncached():
+    """A batch with more distinct policy keys than the compaction
+    capacity (rep_cap = max(batch >> 2, 1024)) is refused by the
+    kernel; the DRAIN re-dispatches it through the uncached program
+    — the verdict stream stays bit-identical, no tuple carries a
+    hit bit, the refusals are counted (not served degraded), and a
+    sustained refusal streak backs the memo attempt off."""
+    from tests.test_replay import _daemon_with_policy
+
+    from cilium_tpu.native import encode_flow_records
+
+    d, server, client = _daemon_with_policy()
+    rng = np.random.default_rng(6)
+    # ~1900 distinct (identity, dport) keys >> rep_cap=1024 at
+    # batch_size 2048
+    n = 2048
+    cid = client.security_identity.id
+    buf = encode_flow_records(
+        ep_id=np.full(n, 10, np.uint32),
+        identity=rng.choice([cid, 999999], size=n).astype(np.uint32),
+        saddr=np.zeros(n, np.uint32),
+        daddr=np.zeros(n, np.uint32),
+        sport=np.full(n, 40000, np.uint16),
+        dport=rng.integers(80, 50000, size=n).astype(np.uint16),
+        proto=np.full(n, 6, np.uint8),
+        direction=np.zeros(n, np.uint8),
+        is_fragment=np.zeros(n, np.uint8),
+    )
+    ref = d.process_flows(buf, batch_size=2048, collect_verdicts=True)
+    d.config_patch({"verdict_cache": True})
+    got = d.process_flows(buf, batch_size=2048, collect_verdicts=True)
+    for field in ref.verdicts:
+        np.testing.assert_array_equal(
+            got.verdicts[field], ref.verdicts[field], err_msg=field
+        )
+    assert d.verdict_cache.overflows > 0
+    assert d.verdict_cache_overflow_streak > 0
+    assert got.degraded_batches == 0  # uncached DEVICE re-dispatch
+    assert not any(r.cache_hit for r in d.flow_store.snapshot())
+
+    # sustained refusals back off: once the streak passes the limit
+    # the memo attempt is skipped, so overflows stop accumulating
+    d.verdict_cache_streak_limit = 2
+    d.process_flows(buf, batch_size=2048)
+    assert d.verdict_cache_overflow_streak >= 2
+    ov = d.verdict_cache.overflows
+    skipped = d.process_flows(
+        buf, batch_size=2048, collect_verdicts=True
+    )
+    assert d.verdict_cache.overflows == ov, "backoff did not skip"
+    for field in ref.verdicts:
+        np.testing.assert_array_equal(
+            skipped.verdicts[field], ref.verdicts[field],
+            err_msg=field,
+        )
