@@ -199,17 +199,37 @@ class APIClient:
         )
         return self._request("DELETE", path)
 
-    def process_flows(self, buf: bytes, traceparent=None):
+    def process_flows(
+        self, buf: bytes, traceparent=None, tenant=None,
+        stream=False, deadline_ms=None,
+    ):
         """POST a binary flow-record buffer through the serving
         plane; malformed buffers surface as APIError(400).
         `traceparent` (a `00-<trace>-<span>-01` string) propagates
         the caller's trace context — the reply's `trace_id` and the
-        batch's spans/flow records then carry the caller's ids."""
+        batch's spans/flow records then carry the caller's ids.
+        `stream=True` submits through the CONTINUOUS serving plane
+        (`?stream=1`): the daemon coalesces concurrent submissions
+        into SLO-bounded device batches with per-tenant fair
+        admission; `tenant` names the submitting tenant/namespace
+        (stamped on flow records either way) and `deadline_ms`
+        overrides the plane's default SLO for this submission."""
+        from urllib.parse import urlencode
+
         headers = (
             {"traceparent": traceparent} if traceparent else None
         )
+        params = {}
+        if tenant:
+            params["tenant"] = tenant
+        if stream:
+            params["stream"] = 1
+            if deadline_ms is not None:
+                params["deadline-ms"] = deadline_ms
+        qs = urlencode(params)
+        path = f"/datapath/flows?{qs}" if qs else "/datapath/flows"
         return self._request(
-            "POST", "/datapath/flows", body=buf, headers=headers
+            "POST", path, body=buf, headers=headers
         )
 
     # -- span plane (GET /debug/traces, /debug/profile) -----------------------

@@ -649,15 +649,16 @@ class DaemonAPI:
             "armed": faultinject.armed(),
         }
 
-    def process_flows(self, buf: bytes) -> dict:
+    def process_flows(self, buf: bytes, tenant: str = "") -> dict:
         """POST /datapath/flows: run a binary flow-record buffer
         through the serving plane (the audit-path ingress over REST).
         Malformed buffers raise ValueError → HTTP 400 at the route;
         the stream itself completes even under dispatch faults
-        (host-path failover)."""
+        (host-path failover).  ``tenant`` stamps the batch's flow
+        records with the submitting tenant/namespace."""
         from cilium_tpu import tracing
 
-        stats = self.daemon.process_flows(buf)
+        stats = self.daemon.process_flows(buf, tenant=tenant)
         return {
             "total": stats.total,
             "allowed": stats.allowed,
@@ -669,6 +670,49 @@ class DaemonAPI:
             "seconds": stats.seconds,
             # the span-plane join key of THIS request (also in the
             # traceparent/X-Trace-Id response headers)
+            "trace_id": tracing.current_trace_id(),
+        }
+
+    STREAM_WAIT_MAX = 60.0
+
+    def process_flows_stream(
+        self,
+        buf: bytes,
+        tenant: str = "default",
+        deadline_ms: float = None,
+    ) -> dict:
+        """POST /datapath/flows?stream=1: submit the buffer to the
+        CONTINUOUS serving plane (cilium_tpu.serve) instead of
+        dispatching it as its own batch — the daemon coalesces
+        concurrent submissions into right-sized device batches under
+        the latency SLO, with per-tenant fair admission.  Blocks
+        until this submission's flows are served (or shed under
+        Overload backpressure) and replies with the same counters as
+        the one-shot route plus queueing detail."""
+        from cilium_tpu import tracing
+
+        r = self.daemon.serving_plane().submit(
+            buf,
+            tenant=tenant,
+            deadline_ms=deadline_ms,
+            wait=True,
+            timeout=self.STREAM_WAIT_MAX,
+        )
+        served = int((~r.shed_mask).sum()) if not r.shed else 0
+        n_allowed = int(r.allowed[~r.shed_mask].sum())
+        shed = r.n - served
+        return {
+            "total": served,
+            "allowed": n_allowed,
+            "denied": served - n_allowed,
+            "dropped": r.dropped_unknown,
+            "prefiltered": r.prefiltered,
+            "shed": shed,
+            "tenant": tenant,
+            "batches": r.batches,
+            "degraded_batches": r.degraded_batches,
+            "queue_delay_ms": r.queue_delay_s * 1000.0,
+            "seconds": r.latency_s,
             "trace_id": tracing.current_trace_id(),
         }
 
@@ -1018,9 +1062,36 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/datapath/flows":
                 # a truncated/corrupt record buffer is the CLIENT's
                 # fault: clean 400, never a daemon crash
+                from urllib.parse import parse_qs
+
+                qs = parse_qs(query)
+                tenant = qs.get("tenant", [""])[0]
+                stream = qs.get("stream", ["0"])[0] in (
+                    "1", "true", "yes", "on",
+                )
                 try:
+                    if stream:
+                        deadline_raw = qs.get(
+                            "deadline-ms", [None]
+                        )[0]
+                        deadline_ms = (
+                            float(deadline_raw)
+                            if deadline_raw is not None
+                            else None
+                        )
+                        return self._reply(
+                            200,
+                            api.process_flows_stream(
+                                self._body_raw(),
+                                tenant=tenant or "default",
+                                deadline_ms=deadline_ms,
+                            ),
+                        )
                     return self._reply(
-                        200, api.process_flows(self._body_raw())
+                        200,
+                        api.process_flows(
+                            self._body_raw(), tenant=tenant
+                        ),
                     )
                 except ValueError as exc:
                     return self._reply(

@@ -274,6 +274,7 @@ def cmd_observe(api, args) -> int:
         ("since", args.since),
         ("chip", args.chip),
         ("trace-id", args.trace_id),
+        ("tenant", args.tenant),
     ):
         if val is not None:
             params[key] = val
@@ -390,6 +391,53 @@ def cmd_fault_disarm(api, args) -> int:
     return 0
 
 
+def cmd_serve_bench(api, args) -> int:
+    """`cilium-tpu serve-bench` — the continuous-serving-plane
+    driver: a self-contained demo daemon, open-loop (Poisson)
+    arrivals split across tenants, the coalescing serve loop in
+    front of the real dispatch path.  Prints the sustained-QPS
+    serving metrics (serving_p99_ms, sustained_verdicts_per_sec,
+    batch fill, per-tenant admitted/shed) as JSON.  Runs in-process
+    (no agent socket needed): the serving plane is a daemon-side
+    loop, and this is its standalone bench harness."""
+    from cilium_tpu.serve import (
+        build_demo_daemon,
+        demo_record_maker,
+        run_serve_bench,
+    )
+
+    tenants = {}
+    for part in (args.tenants or "default=1").split(","):
+        name, _, share = part.partition("=")
+        tenants[name.strip()] = float(share or 1.0)
+    d, client = build_demo_daemon()
+    if args.weights:
+        weights = {}
+        for part in args.weights.split(","):
+            name, _, w = part.partition("=")
+            weights[name.strip()] = float(w or 1.0)
+        d.config_patch({"tenant_weights": weights})
+    try:
+        out = run_serve_bench(
+            d,
+            seconds=args.seconds,
+            qps=args.qps,
+            flows_per_submit=args.flows,
+            tenants=tenants,
+            batch_size=args.batch_size,
+            slo_ms=args.slo_ms,
+            make_records=demo_record_maker(
+                client.security_identity.id
+            ),
+            seed=args.seed,
+        )
+    finally:
+        if d.serving is not None:
+            d.serving.stop()
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_status(api, args) -> int:
     print(json.dumps(api.status(), indent=2))
     return 0
@@ -500,6 +548,10 @@ def make_parser() -> argparse.ArgumentParser:
     obs.add_argument("--cache-hit", action="store_true",
                      help="only flows whose verdict was served from "
                      "the device verdict cache")
+    obs.add_argument("--tenant", default=None,
+                     help="only flows submitted by this tenant/"
+                     "namespace (the serving plane's fairness unit; "
+                     "shed flows carry it on their Overload record)")
     obs.add_argument("--timeout", type=float, default=5.0,
                      help="follow-mode poll timeout")
     obs.add_argument("--summary", action="store_true",
@@ -563,6 +615,32 @@ def make_parser() -> argparse.ArgumentParser:
     fdisarm.add_argument("site", nargs="?", default=None)
     fdisarm.add_argument("--all", action="store_true")
     fdisarm.set_defaults(func=cmd_fault_disarm)
+
+    sbench = sub.add_parser(
+        "serve-bench",
+        help="sustained-QPS bench of the continuous serving plane "
+        "(open-loop arrivals, SLO-aware dynamic batching, "
+        "multi-tenant fair dispatch) — in-process demo world",
+    )
+    sbench.add_argument("--seconds", type=float, default=5.0)
+    sbench.add_argument("--qps", type=float, default=200.0,
+                        help="offered submissions/second across all "
+                        "tenants (open loop)")
+    sbench.add_argument("--flows", type=int, default=64,
+                        help="flows per submission")
+    sbench.add_argument("--tenants", default="default=1",
+                        help='offered-load shares, e.g. '
+                        '"compliant=1,noisy=10"')
+    sbench.add_argument("--weights", default=None,
+                        help='fairness weights (DRR), e.g. '
+                        '"compliant=1,noisy=1"')
+    sbench.add_argument("--batch-size", type=int, default=1 << 12,
+                        help="coalesced device batch jit class")
+    sbench.add_argument("--slo-ms", type=float, default=50.0,
+                        help="per-flow deadline the dynamic batcher "
+                        "dispatches early to protect")
+    sbench.add_argument("--seed", type=int, default=7)
+    sbench.set_defaults(func=cmd_serve_bench)
 
     status = sub.add_parser("status")
     status.set_defaults(func=cmd_status)

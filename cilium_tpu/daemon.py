@@ -233,6 +233,20 @@ class Daemon:
         # monitor events, health()/status() degraded reasons) — the
         # mesh refinement of the dispatch breaker above
         self.mesh_router = None
+        # when a router is attached with route_dispatch=True (the
+        # default), the production dispatch loop (process_flows +
+        # the serving plane) sends each batch THROUGH the router's
+        # per-chip failure domain instead of the single-chip
+        # evaluate_batch — the PR 8 remainder closed.  Routing only
+        # engages once the router holds a published epoch.
+        self.mesh_route_dispatch = False
+        # continuous serving plane (cilium_tpu.serve.ServingPlane):
+        # lazy — POST /datapath/flows?stream=1 and `cilium-tpu
+        # serve-bench` start it on first use.  Tenant fairness
+        # weights live on the daemon so PATCH /config can set them
+        # before (or after) the plane spins up.
+        self.serving = None
+        self.tenant_weights: Dict[str, float] = {}
         # verdict memoization (engine/memo.py): when enabled, the
         # serving dispatch dedups each batch's policy keys in-jit
         # and serves repeats from a device-resident verdict cache,
@@ -1009,15 +1023,27 @@ class Daemon:
             }},
         )
 
-    def attach_mesh_router(self, router) -> None:
+    def attach_mesh_router(self, router, route_dispatch: bool = True) -> None:
         """Adopt a ChipFailoverRouter (engine/failover.py): per-chip
         breaker transitions publish AgentNotify monitor events beside
         the router's own gauge/span-event wiring, and health() gains
         per-chip degraded reasons — a mesh losing one chip reports
-        WHICH ordinal is out, not just "degraded"."""
+        WHICH ordinal is out, not just "degraded".
+
+        With `route_dispatch` (default) the daemon's PRODUCTION
+        dispatch loop also routes every batch through the router —
+        survivor re-split, replica gathers and per-chip breakers
+        serve the stream instead of the single-chip program — once
+        the router holds a published epoch (`router.publish`); until
+        then, and on any router error, batches fall back to the
+        single-chip path under the process-wide breaker.  The
+        operator owns keeping the router's published tables in step
+        with the daemon's (publish on regenerate), exactly as the
+        sharded store factory seam does."""
         from cilium_tpu.monitor.events import AgentNotify
 
         self.mesh_router = router
+        self.mesh_route_dispatch = route_dispatch
         outer = router._on_chip_transition
 
         def _notify(ordinal, old, new, reason):
@@ -1132,7 +1158,7 @@ class Daemon:
 
     def _dispatch_or_degrade(
         self, tables, batch, host_args, pad_to: int,
-        use_memo: bool = True,
+        use_memo: bool = True, host_cols=None,
     ):
         """One batch through the guarded device dispatch: the
         engine.dispatch fault seam fires first, the watchdog bounds
@@ -1143,8 +1169,18 @@ class Daemon:
         (engine.hostpath.lattice_fold_host) — the stream completes,
         degraded_batches_total counts the failover.
 
+        Mesh routing: when a ChipFailoverRouter is attached with
+        route_dispatch and holds a published epoch, the batch goes
+        THROUGH the per-chip failure domain instead — `host_cols`
+        (a thunk returning the UNPADDED host tuple columns) feeds
+        router.dispatch, whose verdicts come back in stream order
+        and bit-identical whatever the survivor set; a router error
+        falls back to the single-chip path below.
+
         Returns (verdicts, degraded flag); verdicts satisfy the
-        Verdicts contract (allowed/proxy_port/match_kind, padded).
+        Verdicts contract (allowed/proxy_port/match_kind, padded on
+        the single-chip path, exactly valid-length on the mesh
+        path — callers slice [:valid] either way).
 
         Span-plane attribution: the device attempt runs under an
         `engine.dispatch` span (error status + breaker events when it
@@ -1155,6 +1191,27 @@ class Daemon:
         from cilium_tpu.engine.verdict import evaluate_batch
         from cilium_tpu.resilience import guarded_dispatch
 
+        if (
+            self.mesh_router is not None
+            and self.mesh_route_dispatch
+            and host_cols is not None
+            and self.mesh_router.store.current() is not None
+        ):
+            try:
+                res = self.mesh_router.dispatch(*host_cols())
+            except Exception as exc:  # router unserviceable: fall
+                # back to the single-chip path under the
+                # process-wide breaker (the router's own terminal
+                # fold only fires when it CAN host-fold)
+                log.warning(
+                    "mesh router dispatch failed; serving batch "
+                    "from the single-chip path",
+                    extra={"fields": {"error": str(exc)}},
+                )
+            else:
+                if res.degraded:
+                    self.degraded_batches += 1
+                return res.verdicts, res.degraded
         if self._traced_evaluate is None:
             # jit-cache hit/miss accounting on the serving entry
             # point (a fresh batch shape class = an XLA recompile the
@@ -1284,6 +1341,26 @@ class Daemon:
                     "verdict_cache must be a boolean, got "
                     f"{verdict_cache!r}"
                 )
+            # serving-plane tenant fairness weights ({"tenant_
+            # weights": {name: weight}}): validated up front like
+            # the options; weight must be a positive number
+            tenant_weights = changes.get("tenant_weights")
+            if tenant_weights is not None:
+                if not isinstance(tenant_weights, dict):
+                    raise ValueError(
+                        "tenant_weights must be an object of "
+                        f"name: weight, got {tenant_weights!r}"
+                    )
+                for name, w in tenant_weights.items():
+                    if (
+                        isinstance(w, bool)
+                        or not isinstance(w, (int, float))
+                        or w <= 0
+                    ):
+                        raise ValueError(
+                            f"tenant weight {name!r} must be a "
+                            f"positive number, got {w!r}"
+                        )
             if raw_opts:
                 ct_before = option.Config.opts.is_enabled(
                     option.CONNTRACK
@@ -1318,6 +1395,18 @@ class Daemon:
                 if not verdict_cache:
                     self.verdict_cache = None
                 vc_applied = 1
+            # fairness weights apply immediately to the live plane
+            # (verdict-neutral — no regeneration)
+            tw_applied = 0
+            if tenant_weights is not None:
+                for name, w in tenant_weights.items():
+                    if self.tenant_weights.get(name) != float(w):
+                        tw_applied += 1
+                    self.tenant_weights[name] = float(w)
+                if self.serving is not None:
+                    self.serving.set_tenant_weights(
+                        self.tenant_weights
+                    )
             # fault arming applies last and never triggers a regen
             # sweep (it changes no compiled state)
             fault_applied = 0
@@ -1334,13 +1423,14 @@ class Daemon:
             self.trigger_policy_updates(
                 "configuration changed", full=verdict_affecting
             )
-        applied += fault_applied + vc_applied
+        applied += fault_applied + vc_applied + tw_applied
         return {
             "applied": applied,
             "policy_enforcement": option.Config.policy_enforcement,
             "options": dict(option.Config.opts),
             "faults": faultinject.armed(),
             "verdict_cache": self.verdict_cache_enabled,
+            "tenant_weights": dict(self.tenant_weights),
         }
 
     def _option_changed(self, name: str, value: int) -> None:
@@ -1413,12 +1503,161 @@ class Daemon:
             if ep.opts.is_enabled(POLICY_VERDICT_NOTIFICATION)
         }
 
+    # -- serving-path building blocks (shared with cilium_tpu.serve) ---------
+
+    def _resolve_serving_tables(self):
+        """One serving snapshot: (version, dispatch tables, endpoint
+        index, host map states) — the tables AND the states they were
+        compiled from, read under one lock so the degraded host fold
+        stays bit-identical to the device path whatever regenerations
+        land mid-stream.  The dispatch tables are the device-resident
+        epoch when publication succeeds (delta-scoped scatter for a
+        policy change since the last call); a failed publication
+        latches a 30 s backoff and dispatches the host arrays.
+        Shared by process_flows and the serving plane's batch loop."""
+        import time as _time
+
+        version, tables, index, host_states = (
+            self.endpoint_manager.published_with_states()
+        )
+        if tables is None:
+            raise RuntimeError("no published tables")
+        if _time.monotonic() >= self._device_publish_retry_at:
+            try:
+                # epoch lookup/publication under its own span: a
+                # trace distinguishes "the batch was slow" from "the
+                # batch paid a delta scatter / full upload first"
+                with self.tracer.span(
+                    "publish.epoch_lookup", site="engine.publish",
+                    attrs={"version": version},
+                ):
+                    tables = self.endpoint_manager.device_tables_for(
+                        tables
+                    )
+            except Exception as exc:  # device down → numpy tables
+                self._device_publish_retry_at = (
+                    _time.monotonic() + 30.0
+                )
+                log.warning(
+                    "device table publication failed; dispatching "
+                    "host arrays (retrying in 30s)",
+                    extra={"fields": {"error": str(exc)}},
+                )
+        return version, tables, index, host_states
+
+    def _flow_luts(self, index):
+        """Endpoint-axis LUTs the verdict folds translate through:
+        (local identity per axis slot, endpoint id per axis slot).
+        Flow records orient each tuple as src→dst — the local
+        endpoint is the DESTINATION of an ingress flow and the
+        SOURCE of an egress one (the send_trace_notify convention).
+        Shared by process_flows and the serving plane."""
+        import numpy as np
+
+        size = max(index.values(), default=0) + 1
+        local_ident_lut = np.zeros(size, dtype=np.int64)
+        rev_lut = np.zeros(size, dtype=np.int64)
+        for ep_id, idx in index.items():
+            rev_lut[idx] = ep_id
+            ep = self.endpoint_manager.lookup(ep_id)
+            if ep is not None and ep.security_identity is not None:
+                local_ident_lut[idx] = ep.security_identity.id
+        return local_ident_lut, rev_lut
+
+    def _prefilter_records(
+        self, rec, index, local_ident_lut, tenant="", trace_id="",
+    ):
+        """XDP prefilter over a decoded record SoA (the daemon-owned
+        deny-by-CIDR set, bpf_xdp.c): flows from denied sources drop
+        BEFORE the policy program, count under the canonical CIDR
+        reason, and land in the flow plane as real drops.  Returns
+        (filtered rec, n_prefiltered).  Shared by process_flows and
+        the serving plane's submit path."""
+        import numpy as np
+
+        from cilium_tpu.flow import capture_batch
+        from cilium_tpu.replay import _ep_index_of
+
+        prefilter_cidrs = self.prefilter.dump()
+        if not prefilter_cidrs:
+            return rec, 0
+        import ipaddress as _ipaddress
+
+        from cilium_tpu.monitor.events import drop_reason_name
+
+        hit = np.zeros(len(rec["saddr"]), bool)
+        saddr = rec["saddr"].astype(np.uint64)
+        for cidr in prefilter_cidrs:
+            net = _ipaddress.ip_network(cidr, strict=False)
+            if net.version != 4:
+                continue
+            hit |= (saddr & int(net.netmask)) == int(
+                net.network_address
+            )
+        n_prefiltered = int(hit.sum())
+        if not n_prefiltered:
+            return rec, 0
+        for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
+            count = int((hit & (rec["direction"] == dirv)).sum())
+            if count:
+                metrics.drop_count.inc(
+                    drop_reason_name(-162), dname, value=count,
+                )
+        pre_idx = _ep_index_of(
+            {"ep_id": rec["ep_id"][hit]}, dict(index)
+        )
+        pre_dirs = rec["direction"][hit]
+        pre_peer = rec["identity"][hit].astype(np.int64)
+        pre_local = local_ident_lut[pre_idx]
+        capture_batch(
+            self.flow_store,
+            ep_ids=rec["ep_id"][hit],
+            src_identities=np.where(
+                pre_dirs == 0, pre_peer, pre_local
+            ),
+            dst_identities=np.where(
+                pre_dirs == 0, pre_local, pre_peer
+            ),
+            dports=rec["dport"][hit],
+            protos=rec["proto"][hit],
+            directions=pre_dirs,
+            allowed=np.zeros(n_prefiltered, bool),
+            match_kind=np.zeros(n_prefiltered, np.int32),
+            pre_dropped=np.ones(n_prefiltered, bool),
+            allow_sample=0,
+            metrics_registry=metrics,
+            trace_id=trace_id,
+            tenant=tenant,
+        )
+        rec = {k: v[~hit] for k, v in rec.items()}
+        return rec, n_prefiltered
+
+    def serving_plane(self, **overrides):
+        """The daemon's continuous serving plane
+        (cilium_tpu.serve.ServingPlane), created and started on
+        first use — the steady-state ingest pipeline behind
+        `POST /datapath/flows?stream=1` and `cilium-tpu
+        serve-bench`.  Constructor overrides apply only on first
+        creation (the plane is one shared queue)."""
+        with self.lock:
+            if self.serving is None:
+                from cilium_tpu.serve import ServingPlane
+
+                self.serving = ServingPlane(
+                    self,
+                    tenant_weights=dict(self.tenant_weights),
+                    **overrides,
+                )
+                self.serving.start()
+            return self.serving
+
     def process_flows(
         self,
         buf: bytes,
         batch_size: int = 1 << 20,
         collect_verdicts: bool = False,
         async_depth: "Optional[int]" = None,
+        tenant: str = "",
     ) -> "object":
         """Datapath execution under the agent with monitor folding —
         the production path behind `cilium monitor`: replay the
@@ -1477,12 +1716,12 @@ class Daemon:
         ) as proc_span:
             return self._process_flows_traced(
                 buf, batch_size, collect_verdicts, proc_span,
-                async_depth,
+                async_depth, tenant,
             )
 
     def _process_flows_traced(
         self, buf, batch_size, collect_verdicts, proc_span,
-        async_depth=None,
+        async_depth=None, tenant="",
     ):
         import time as _time
         from types import SimpleNamespace
@@ -1499,45 +1738,11 @@ class Daemon:
         )
 
         # tables AND the map-state snapshot they were compiled from,
-        # read under one lock: the degraded host fold evaluates
-        # against these exact states, so its verdicts stay
-        # bit-identical to the device path no matter what
-        # regenerations land mid-stream
+        # read under one lock (see _resolve_serving_tables — the
+        # block the serving plane shares)
         version, tables, index, host_states = (
-            self.endpoint_manager.published_with_states()
+            self._resolve_serving_tables()
         )
-        if tables is None:
-            raise RuntimeError("no published tables")
-        # dispatch against the device-resident epoch of THIS snapshot:
-        # repeated process_flows calls stop re-uploading the world per
-        # batch stream, and a policy publish since the last call lands
-        # as a delta-scoped scatter into the standby epoch
-        # (endpoint/manager.published_device); host_states stays the
-        # degraded fold's bit-identical substrate either way.  A
-        # failed publication latches a backoff: with the device down,
-        # per-batch delta attempts (fresh row copies + a WARNING each)
-        # would hammer exactly the degraded hot path.
-        if _time.monotonic() >= self._device_publish_retry_at:
-            try:
-                # epoch lookup/publication under its own span: a
-                # trace distinguishes "the batch was slow" from "the
-                # batch paid a delta scatter / full upload first"
-                with self.tracer.span(
-                    "publish.epoch_lookup", site="engine.publish",
-                    attrs={"version": version},
-                ):
-                    tables = self.endpoint_manager.device_tables_for(
-                        tables
-                    )
-            except Exception as exc:  # device down → numpy tables
-                self._device_publish_retry_at = (
-                    _time.monotonic() + 30.0
-                )
-                log.warning(
-                    "device table publication failed; dispatching "
-                    "host arrays (retrying in 30s)",
-                    extra={"fields": {"error": str(exc)}},
-                )
         # records for endpoints this node doesn't own are dropped up
         # front (the index→axis mapping sends unknown ids to axis 0,
         # which would evaluate them under — and attribute their
@@ -1555,94 +1760,23 @@ class Daemon:
         n_dropped = int((~known).sum())
         if n_dropped:
             rec = {k: v[known] for k, v in rec.items()}
-        # endpoint-axis → local endpoint identity LUT: flow records
-        # orient each tuple as src→dst (the local endpoint is the
-        # DESTINATION of an ingress flow and the SOURCE of an egress
-        # one, the send_trace_notify convention)
-        local_ident_lut = np.zeros(
-            max(index.values(), default=0) + 1, dtype=np.int64
-        )
-        for lut_ep_id, lut_idx in index.items():
-            lut_ep = self.endpoint_manager.lookup(lut_ep_id)
-            if (
-                lut_ep is not None
-                and lut_ep.security_identity is not None
-            ):
-                local_ident_lut[lut_idx] = lut_ep.security_identity.id
+        # endpoint-axis LUTs (identity orientation + index→ep-id),
+        # shared with the serving plane
+        local_ident_lut, rev_lut = self._flow_luts(index)
         # allowed-flow record budget per batch — the SAME aggregation
         # knob that gates the monitor fold's per-packet traces; drops
         # are never sampled
         flow_allow_sample = allow_sample_for_level(
             option.Config.opts.level(option.MONITOR_AGGREGATION)
         )
-        # XDP prefilter (the daemon-owned deny-by-CIDR set,
-        # bpf_xdp.c): flows from denied sources drop BEFORE the
-        # policy program and count under the canonical CIDR reason —
+        # XDP prefilter (shared _prefilter_records): denied sources
+        # drop before the policy program, recorded as real drops —
         # keeps this audit path in agreement with trace_tuple's
         # prefilter stage
-        n_prefiltered = 0
-        prefilter_cidrs = self.prefilter.dump()
-        if prefilter_cidrs:
-            import ipaddress as _ipaddress
-
-            from cilium_tpu.monitor.events import drop_reason_name
-
-            hit = np.zeros(len(rec["saddr"]), bool)
-            saddr = rec["saddr"].astype(np.uint64)
-            for cidr in prefilter_cidrs:
-                net = _ipaddress.ip_network(cidr, strict=False)
-                if net.version != 4:
-                    continue
-                hit |= (saddr & int(net.netmask)) == int(
-                    net.network_address
-                )
-            n_prefiltered = int(hit.sum())
-            if n_prefiltered:
-                for dirv, dname in ((0, "INGRESS"), (1, "EGRESS")):
-                    count = int(
-                        (hit & (rec["direction"] == dirv)).sum()
-                    )
-                    if count:
-                        metrics.drop_count.inc(
-                            drop_reason_name(-162), dname,
-                            value=count,
-                        )
-                # prefiltered flows are real drops: record them in
-                # the flow plane (pre_dropped mask → the canonical
-                # CIDR reason) before they leave the stream
-                pre_idx = _ep_index_of(
-                    {"ep_id": rec["ep_id"][hit]}, dict(index)
-                )
-                pre_dirs = rec["direction"][hit]
-                pre_peer = rec["identity"][hit].astype(np.int64)
-                pre_local = local_ident_lut[pre_idx]
-                capture_batch(
-                    self.flow_store,
-                    ep_ids=rec["ep_id"][hit],
-                    src_identities=np.where(
-                        pre_dirs == 0, pre_peer, pre_local
-                    ),
-                    dst_identities=np.where(
-                        pre_dirs == 0, pre_local, pre_peer
-                    ),
-                    dports=rec["dport"][hit],
-                    protos=rec["proto"][hit],
-                    directions=pre_dirs,
-                    allowed=np.zeros(n_prefiltered, bool),
-                    match_kind=np.zeros(n_prefiltered, np.int32),
-                    pre_dropped=np.ones(n_prefiltered, bool),
-                    allow_sample=0,
-                    metrics_registry=metrics,
-                    trace_id=tracing.current_trace_id(),
-                )
-                rec = {k: v[~hit] for k, v in rec.items()}
-        # vectorized index→endpoint-id translation (inverse of
-        # replay._ep_index_of's LUT)
-        rev_lut = np.zeros(
-            max(index.values(), default=0) + 1, dtype=np.int64
+        rec, n_prefiltered = self._prefilter_records(
+            rec, index, local_ident_lut, tenant=tenant,
+            trace_id=tracing.current_trace_id(),
         )
-        for ep_id, idx in index.items():
-            rev_lut[idx] = ep_id
         verdict_eps = self.verdict_notification_endpoints()
         # CT occupancy check on the serving path (the watermark
         # trigger must not wait for the 30 s GC controller tick)
@@ -1875,6 +2009,7 @@ class Daemon:
                     allow_sample=flow_allow_sample,
                     metrics_registry=metrics,
                     trace_id=trace_ctx,
+                    tenant=tenant,
                 )
                 flow_capture.end()
             finally:
@@ -1925,8 +2060,21 @@ class Daemon:
                     def _host_args(s=start, e=end):
                         return _host_args_for(s, e)
 
+                    def _host_cols(s=start, e=end):
+                        # the UNPADDED host tuple columns the mesh
+                        # router re-splits across survivors
+                        return (
+                            ep_idx_host[s:e],
+                            rec["identity"][s:e],
+                            rec["dport"][s:e],
+                            rec["proto"][s:e],
+                            rec["direction"][s:e],
+                            rec["is_fragment"][s:e].astype(bool),
+                        )
+
                     out, degraded = self._dispatch_or_degrade(
-                        tables, batch, _host_args, batch_size
+                        tables, batch, _host_args, batch_size,
+                        host_cols=_host_cols,
                     )
                     dispatch_span.end(success=not degraded)
                 except Exception:
@@ -2028,7 +2176,7 @@ class Daemon:
             self.endpoint_manager.build_failure_snapshot()
         )
         health = self.health()
-        return {
+        out = {
             "node": self.node_name,
             "health": health["status"],
             "health_reasons": health["reasons"],
@@ -2059,3 +2207,6 @@ class Daemon:
                 for name, s in self.controllers.statuses().items()
             },
         }
+        if self.serving is not None:
+            out["serving"] = self.serving.snapshot()
+        return out

@@ -66,6 +66,18 @@ EMPTY = np.uint32(0xFFFFFFFF)
 CACHE_KEY_WORDS = 3
 CACHE_WORDS = 5
 
+# per-row hit-rank word: one trailing u32 per bucket row, a 4-bit
+# recency nibble per lane (entries <= 8).  A hit bumps its lane's
+# nibble (saturating at 15); an insert into a FULL bucket evicts the
+# lane with the LOWEST nibble — least-recently-hit — and resets the
+# victim's nibble, so a hot entry survives colliding cold inserts.
+# The word is heuristic metadata only: key/value words never depend
+# on it, so a lost rank update can cost a future miss, never a wrong
+# verdict.
+RANK_NIBBLE_BITS = 4
+RANK_NIBBLE_MAX = 15
+RANK_MAX_LANES = 8
+
 # stats vector columns (u32 [5]) every memo kernel returns
 STAT_UNIQUE = 0  # distinct policy keys in the batch (dedup groups)
 STAT_HIT = 1  # tuples whose representative hit the cache
@@ -78,23 +90,36 @@ STATS = 5
 def cache_entries(rows) -> int:
     """Entries per bucket row, derived from the row width — probe
     and insert share the layout through the array shape itself, the
-    same contract as the hashed L4 entry tables."""
+    same contract as the hashed L4 entry tables.  Works for both the
+    rank-word layout (5e + 1 words) and the legacy bare layout (5e):
+    the +1 vanishes under the floor division."""
     return int(rows.shape[-1]) // CACHE_WORDS
+
+
+def has_rank_word(rows) -> bool:
+    """True when the row layout carries the trailing hit-rank word
+    (5e + 1 wide).  Legacy 5e-wide rows keep the rotation-eviction
+    behavior — the two layouts are distinguishable by width alone,
+    so probe/insert never need a flag."""
+    return int(rows.shape[-1]) % CACHE_WORDS == 1
 
 
 def make_cache_rows(
     n_rows: int = 1 << 12, entries: int = 8
 ) -> np.ndarray:
-    """Host-side empty cache: [n_rows + 1, 5 * entries] u32 filled
-    with the EMPTY sentinel.  Row `n_rows` is the SCRATCH row:
-    invalid/overflow inserts are routed there so the jitted insert
-    scatter needs no masking; probes mask the bucket index to
-    [0, n_rows) and can never read it."""
+    """Host-side empty cache: [n_rows + 1, 5 * entries + 1] u32 —
+    per lane 3 key + 2 value words (EMPTY-filled) plus ONE trailing
+    hit-rank word per row (zeroed: all lanes equally cold).  Row
+    `n_rows` is the SCRATCH row: invalid/overflow inserts are routed
+    there so the jitted insert scatter needs no masking; probes mask
+    the bucket index to [0, n_rows) and can never read it."""
     if n_rows & (n_rows - 1):
         raise ValueError(f"cache rows must be a power of two: {n_rows}")
-    return np.full(
-        (n_rows + 1, CACHE_WORDS * entries), EMPTY, np.uint32
+    rows = np.full(
+        (n_rows + 1, CACHE_WORDS * entries + 1), EMPTY, np.uint32
     )
+    rows[:, -1] = 0
+    return rows
 
 
 def memo_key_words(idx, known, l3_bit, ep, dirn, dport, proto, xp=None):
@@ -190,7 +215,22 @@ def dedup_groups(k0, k1, k2, rep_cap: int):
     )
 
 
-def bucket_insert_lanes(empty, bucket, entries):
+def rank_nibbles(rank_word, entries):
+    """[U] rank words -> [U, entries] per-lane recency nibbles
+    (lanes beyond RANK_MAX_LANES share nibbles modulo 8 — callers
+    disable LRU eviction past 8 lanes)."""
+    import jax.numpy as jnp
+
+    shifts = jnp.uint32(RANK_NIBBLE_BITS) * (
+        jnp.arange(entries, dtype=jnp.uint32) % RANK_MAX_LANES
+    )
+    return (
+        (rank_word[:, None] >> shifts[None, :])
+        & jnp.uint32(RANK_NIBBLE_MAX)
+    ).astype(jnp.int32)
+
+
+def bucket_insert_lanes(empty, bucket, entries, rank_word=None):
     """Per-key insert lane + validity for same-batch inserts.
     `empty` is the [U, entries] EMPTY-key-lane mask of each key's
     gathered bucket row (owner-masked in the partitioned kernel —
@@ -198,13 +238,15 @@ def bucket_insert_lanes(empty, bucket, entries):
 
     Same-bucket keys gather the SAME row, so every per-key input
     here is bucket-uniform, and the base lane must stay that way:
-    the bucket's first empty lane, else a BUCKET-derived rotation —
-    never a per-key hash way, whose per-key variance would let two
-    same-bucket inserts collide on one lane when the bucket is
-    full.  Ranking each key within its bucket (one tiny [U] sort)
-    and rotating by the rank then yields DISTINCT (bucket, lane)
-    targets for ranks < entries, so entry words stay atomic even
-    though XLA leaves duplicate-index scatter order
+    the bucket's first empty lane, else — with a `rank_word` — the
+    LEAST-RECENTLY-HIT lane (lowest recency nibble; the per-row
+    hit-rank word is bucket-uniform too), else a BUCKET-derived
+    rotation.  Never a per-key hash way, whose per-key variance
+    would let two same-bucket inserts collide on one lane when the
+    bucket is full.  Ranking each key within its bucket (one tiny
+    [U] sort) and rotating by the rank then yields DISTINCT
+    (bucket, lane) targets for ranks < entries, so entry words stay
+    atomic even though XLA leaves duplicate-index scatter order
     implementation-defined (interleaved key/value words from two
     entries would alias).  Keys ranked past the lane count get
     ok=False and must route to the scratch row (they just miss next
@@ -213,13 +255,6 @@ def bucket_insert_lanes(empty, bucket, entries):
     import jax
     import jax.numpy as jnp
 
-    first_empty = jnp.argmax(empty, axis=1).astype(jnp.int32)
-    full_rot = (
-        bucket.astype(jnp.uint32) % jnp.uint32(entries)
-    ).astype(jnp.int32)
-    base_lane = jnp.where(
-        jnp.any(empty, axis=1), first_empty, full_rot
-    )
     u = bucket.shape[0]
     pos = jnp.arange(u, dtype=jnp.int32)
     sb, sidx = jax.lax.sort(
@@ -230,7 +265,31 @@ def bucket_insert_lanes(empty, bucket, entries):
     )
     seg_start = jax.lax.cummax(jnp.where(newb, pos, 0))
     rank = jnp.zeros(u, jnp.int32).at[sidx].set(pos - seg_start)
-    lane = (base_lane + rank) % jnp.int32(entries)
+    if rank_word is not None and entries <= RANK_MAX_LANES:
+        # coldest-first lane permutation: empty lanes (score -1)
+        # ahead of occupied lanes ordered by recency nibble — the
+        # k-th same-bucket insert takes the k-th coldest lane, so
+        # the hottest lane is the LAST to be overwritten and ranks
+        # still map to distinct lanes (score is bucket-uniform, the
+        # permutation is too)
+        score = jnp.where(
+            empty, jnp.int32(-1), rank_nibbles(rank_word, entries)
+        )
+        order = jnp.argsort(score, axis=1).astype(jnp.int32)
+        lane = jnp.take_along_axis(
+            order,
+            jnp.clip(rank, 0, entries - 1)[:, None],
+            axis=1,
+        )[:, 0]
+    else:
+        first_empty = jnp.argmax(empty, axis=1).astype(jnp.int32)
+        full_rot = (
+            bucket.astype(jnp.uint32) % jnp.uint32(entries)
+        ).astype(jnp.int32)
+        base_lane = jnp.where(
+            jnp.any(empty, axis=1), first_empty, full_rot
+        )
+        lane = (base_lane + rank) % jnp.int32(entries)
     return lane, rank < entries
 
 
@@ -238,11 +297,13 @@ def cache_probe(cache_rows, k0, k1, k2, valid):
     """Level B probe (traced): one bucket-row gather per key + lane
     compares over ALL THREE key words — a colliding key can only
     miss, never alias.  Returns (hit, v0, v1, bucket, ins_lane,
-    ins_ok): `ins_lane` is the lane an insert of this key should
-    take (bucket_insert_lanes: bucket-uniform base + rank within
-    the bucket); `ins_ok` False means the bucket already absorbed
+    ins_ok, hit_lane, rank_word): `ins_lane` is the lane an insert
+    of this key should take (bucket_insert_lanes: bucket-uniform
+    base — first empty, else least-recently-hit — + rank within the
+    bucket); `ins_ok` False means the bucket already absorbed
     `entries` same-batch inserts and this key must skip (scratch
-    row)."""
+    row); `hit_lane`/`rank_word` feed apply_rank_updates (zeros on
+    the legacy rank-less layout)."""
     import jax.numpy as jnp
 
     from cilium_tpu.engine.hashtable import fnv1a_device
@@ -251,7 +312,7 @@ def cache_probe(cache_rows, k0, k1, k2, valid):
     n_rows = cache_rows.shape[0] - 1  # last row is scratch
     h = fnv1a_device(jnp.stack([k0, k1, k2], axis=1))
     bucket = (h & jnp.uint32(n_rows - 1)).astype(jnp.int32)
-    rowv = cache_rows[bucket]  # [U, 5e] — 1 gather
+    rowv = cache_rows[bucket]  # [U, 5e(+1)] — 1 gather
     lane_hit = (
         (rowv[:, :e] == k0[:, None])
         & (rowv[:, e : 2 * e] == k1[:, None])
@@ -266,10 +327,70 @@ def cache_probe(cache_rows, k0, k1, k2, valid):
         jnp.where(lane_hit, rowv[:, 4 * e : 5 * e], 0),
         axis=1, dtype=jnp.uint32,
     )
-    ins_lane, ins_ok = bucket_insert_lanes(
-        rowv[:, :e] == EMPTY, bucket, e
+    hit_lane = jnp.argmax(lane_hit, axis=1).astype(jnp.int32)
+    rank_word = (
+        rowv[:, CACHE_WORDS * e]
+        if has_rank_word(cache_rows)
+        else jnp.zeros(bucket.shape, jnp.uint32)
     )
-    return hit, v0, v1, bucket, ins_lane, ins_ok
+    ins_lane, ins_ok = bucket_insert_lanes(
+        rowv[:, :e] == EMPTY, bucket, e,
+        rank_word=(
+            rank_word if has_rank_word(cache_rows) else None
+        ),
+    )
+    return hit, v0, v1, bucket, ins_lane, ins_ok, hit_lane, rank_word
+
+
+def apply_rank_updates(
+    cache_rows, bucket, hit, hit_lane, rank_word,
+    ins_row, ins_lane, ins_rank_word, do_insert,
+):
+    """Maintain the per-row hit-rank word (traced).  Two commuting
+    `.add` scatters on the rank column:
+
+      * every HIT bumps its lane's recency nibble by one, saturating
+        at 15 — at most one bump per (row, lane) per batch, because
+        representatives are distinct keys and a lane holds one key,
+        so the guard is exact (no nibble carry is possible);
+      * every INSERT subtracts its target lane's current nibble
+        exactly (uint32 wraparound subtract borrows nothing past the
+        nibble), resetting the victim to cold — the entry must earn
+        its heat through hits, so a stream of colliding cold inserts
+        churns one lane instead of walking over the hot ones.
+
+    All adds commute, so XLA's implementation-defined duplicate-index
+    order cannot corrupt the word.  No-op on the legacy rank-less
+    layout (or past RANK_MAX_LANES lanes)."""
+    import jax.numpy as jnp
+
+    e = cache_entries(cache_rows)
+    if not has_rank_word(cache_rows) or e > RANK_MAX_LANES:
+        return cache_rows
+    col = CACHE_WORDS * e
+    nb = jnp.uint32(RANK_NIBBLE_BITS)
+    # hit bump
+    h_shift = nb * (hit_lane.astype(jnp.uint32) % RANK_MAX_LANES)
+    h_nib = (rank_word >> h_shift) & jnp.uint32(RANK_NIBBLE_MAX)
+    h_delta = jnp.where(
+        hit & (h_nib < RANK_NIBBLE_MAX),
+        jnp.uint32(1) << h_shift,
+        jnp.uint32(0),
+    )
+    # insert reset (scratch-routed rows get delta from the scratch
+    # rank word, which stays 0 — harmless either way)
+    i_shift = nb * (ins_lane.astype(jnp.uint32) % RANK_MAX_LANES)
+    i_nib = (ins_rank_word >> i_shift) & jnp.uint32(RANK_NIBBLE_MAX)
+    i_delta = jnp.where(
+        do_insert,
+        jnp.uint32(0) - (i_nib << i_shift),
+        jnp.uint32(0),
+    )
+    return (
+        cache_rows
+        .at[bucket, col].add(h_delta)
+        .at[ins_row, col].add(i_delta)
+    )
 
 
 def cache_insert(
@@ -374,9 +495,9 @@ def memo_lattice(
     rep_orig = g["rep_orig"]  # [rep_cap + 1]
     r = rep_orig[:rep_cap]
     rk0, rk1, rk2 = k0[r], k1[r], k2[r]
-    hit, cv0, cv1, bucket, ins_lane, ins_ok = cache_probe(
-        cache_rows, rk0, rk1, rk2, g["rep_valid"]
-    )
+    (
+        hit, cv0, cv1, bucket, ins_lane, ins_ok, hit_lane, rank_word,
+    ) = cache_probe(cache_rows, rk0, rk1, rk2, g["rep_valid"])
 
     # -- miss compaction: lattice gathers only for missed reps ----------
     miss = g["rep_valid"] & ~hit
@@ -410,8 +531,20 @@ def memo_lattice(
         do_ins = (
             jnp.arange(miss_cap) < n_miss
         ) & pad_rep(ins_ok, mp)
+        n_rows = cache_rows.shape[0] - 1
+        ins_row = jnp.where(
+            do_ins & ok, pad_rep(bucket, mp), n_rows
+        )
+        # hit-rank maintenance first (the LRU eviction metadata),
+        # then the entry scatter; an overflow discards BOTH through
+        # the same where — carried state commits only when ok
+        ranked = apply_rank_updates(
+            cache_rows, bucket, hit & ok, hit_lane, rank_word,
+            ins_row, pad_rep(ins_lane, mp),
+            pad_rep(rank_word, mp), do_ins & ok,
+        )
         inserted = cache_insert(
-            cache_rows,
+            ranked,
             pad_rep(bucket, mp), pad_rep(ins_lane, mp),
             pad_rep(rk0, mp), pad_rep(rk1, mp), pad_rep(rk2, mp),
             mv0, mv1,

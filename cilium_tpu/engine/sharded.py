@@ -553,10 +553,15 @@ def make_partitioned_cache(
     def factory():
         import numpy as np
 
-        return np.full(
-            (dp, tp, n_rows_local + 1, CACHE_WORDS * entries),
+        rows = np.full(
+            (dp, tp, n_rows_local + 1, CACHE_WORDS * entries + 1),
             EMPTY, np.uint32,
         )
+        # trailing hit-rank word (engine/memo.py layout): zeroed;
+        # the partitioned kernel keeps the rotation eviction today
+        # (rank maintenance is single-chip), so the word stays cold
+        rows[..., -1] = 0
+        return rows
 
     sharding = NamedSharding(mesh, P(batch_axis, table_axis))
     return VerdictCache(rows_factory=factory, sharding=sharding)

@@ -103,6 +103,7 @@ def capture_batch(
     metrics_registry=None,
     trace_id: str = "",
     cache_hit=None,
+    tenant="",
 ) -> int:
     """Fold one batch's per-tuple columns into the store.  All
     columns are host arrays of one length (the batch's VALID prefix —
@@ -116,8 +117,11 @@ def capture_batch(
     record of a traced batch (GET /flows?trace-id=...).
     ``cache_hit`` is the per-tuple verdict-cache hit column of a
     memoized dispatch (None = uncached path, records carry False) —
-    `cilium-tpu observe --cache-hit` filters on it.  Returns the
-    number of records captured."""
+    `cilium-tpu observe --cache-hit` filters on it.  ``tenant`` is
+    the submitting tenant/namespace — a scalar string (the one-shot
+    REST path) or a per-tuple object array (the serving plane's
+    coalesced multi-tenant batches); `observe --tenant` filters on
+    it.  Returns the number of records captured."""
     allowed = np.asarray(allowed).astype(bool)
     kind = np.asarray(match_kind)
     b = len(allowed)
@@ -171,6 +175,11 @@ def capture_batch(
         if cache_hit is None
         else np.asarray(cache_hit).astype(bool)
     )
+    tenants = (
+        np.asarray(tenant, dtype=object)
+        if not isinstance(tenant, str)
+        else np.full(b, tenant, dtype=object)
+    )
     ts = time.time() if now is None else now
     records = [
         FlowRecord(
@@ -191,6 +200,7 @@ def capture_batch(
             ct_state=int(ct_res[i]),
             trace_id=trace_id,
             cache_hit=bool(hits[i]),
+            tenant=str(tenants[i]),
         )
         for i in idx
     ]

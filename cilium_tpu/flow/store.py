@@ -57,6 +57,11 @@ class FlowRecord:
     # verdict served from the device verdict cache (engine/memo.py);
     # False on uncached paths and degraded host-fold batches
     cache_hit: bool = False
+    # submitting tenant/namespace (the serving plane's fairness
+    # unit; "" on paths without tenant attribution) — fairness
+    # decisions are debuggable end to end: a shed flow's Overload
+    # record names WHO was shed
+    tenant: str = ""
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -128,6 +133,7 @@ class FlowFilter:
     chip: Optional[int] = None
     trace_id: Optional[str] = None
     cache_hit: Optional[bool] = None
+    tenant: Optional[str] = None
 
     # GET /flows query-param name → field + parser
     PARAM_FIELDS = {
@@ -146,6 +152,7 @@ class FlowFilter:
             lambda v: str(v).strip().lower()
             in ("1", "true", "yes", "on"),
         ),
+        "tenant": ("tenant", str),
     }
 
     @classmethod
@@ -200,6 +207,8 @@ class FlowFilter:
             self.cache_hit is not None
             and bool(r.cache_hit) != self.cache_hit
         ):
+            return False
+        if self.tenant is not None and r.tenant != self.tenant:
             return False
         return True
 
@@ -341,12 +350,18 @@ class FlowStore:
         pairs: _Counter = _Counter()
         chips: _Counter = _Counter()
         verdicts: _Counter = _Counter()
+        tenants: _Counter = _Counter()
+        tenant_sheds: _Counter = _Counter()
         for r in snap:
             verdicts[r.verdict] += 1
             chips[r.chip] += 1
+            if r.tenant:
+                tenants[r.tenant] += 1
             if r.verdict == VERDICT_DROPPED:
                 reasons[r.drop_reason] += 1
                 pairs[(r.src_identity, r.dst_identity)] += 1
+                if r.tenant and r.drop_reason == "Overload":
+                    tenant_sheds[r.tenant] += 1
         chip_counts = {str(c): n for c, n in sorted(chips.items())}
         imbalance = (
             max(chips.values()) / max(1, min(chips.values()))
@@ -372,4 +387,6 @@ class FlowStore:
             ],
             "per_chip": chip_counts,
             "chip_imbalance": round(imbalance, 3),
+            "per_tenant": dict(tenants),
+            "per_tenant_overload": dict(tenant_sheds),
         }

@@ -353,6 +353,65 @@ class Registry:
             "publish / repack / partition change, or a chip "
             "kill/readmission)",
         )
+        # -- continuous serving plane (cilium_tpu.serve) -----------------
+        self.serve_queue_depth = Gauge(
+            f"{ns}_serve_queue_depth",
+            "Flows queued in the serving plane's ingest queue, per "
+            "tenant (the dynamic-batching backlog)",
+            ("tenant",),
+        )
+        self.serve_queue_delay_seconds = WindowedHistogram(
+            f"{ns}_serve_queue_delay_seconds",
+            "Per-flow time from submission to device dispatch in "
+            "the serving plane (the batching wait the SLO bounds)",
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+        self.serve_latency_seconds = WindowedHistogram(
+            f"{ns}_serve_latency_seconds",
+            "Per-submission time from submission to completed reply "
+            "in the serving plane (what serving_p99_ms summarizes)",
+            buckets=(
+                0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0,
+            ),
+        )
+        self.serving_p99_ms = Gauge(
+            f"{ns}_serving_p99_ms",
+            "p99 submission-to-reply latency over the serving "
+            "plane's rolling window, milliseconds",
+        )
+        self.serve_batch_fill_pct = Gauge(
+            f"{ns}_serve_batch_fill_pct",
+            "Valid-tuple fill of the most recent coalesced device "
+            "batch (100 = the jit class dispatched full)",
+        )
+        self.serve_batches_total = Counter(
+            f"{ns}_serve_batches_total",
+            "Coalesced device batches dispatched by the serving "
+            "plane",
+        )
+        self.serve_deadline_dispatch_total = Counter(
+            f"{ns}_serve_deadline_dispatch_total",
+            "Serving-plane batches dispatched EARLY (below the "
+            "target fill) because the oldest queued flow's deadline "
+            "no longer allowed waiting",
+        )
+        self.serve_admitted_flows_total = Counter(
+            f"{ns}_serve_admitted_flows_total",
+            "Flows admitted into the serving plane's ingest queue, "
+            "per tenant",
+            ("tenant",),
+        )
+        self.serve_shed_flows_total = Counter(
+            f"{ns}_serve_shed_flows_total",
+            "Flows shed by the serving plane under the canonical "
+            "Overload drop reason, per tenant (backlog bound or "
+            "admission gate)",
+            ("tenant",),
+        )
         # -- flow observability plane (cilium_tpu.flow) ------------------
         self.flow_records_captured_total = Counter(
             f"{ns}_flow_records_captured_total",

@@ -600,6 +600,15 @@ class AdmissionGate:
         with self._lock:
             self._inflight = max(0, self._inflight - n)
 
+    def charge_shed(self, n: int) -> None:
+        """Account flows shed by a gate OTHER than this one (the
+        serving plane's per-tenant backlog bound) so shed_total
+        stays the one number health()/status() report — without
+        double-counting a reserve() refusal, which already
+        charged."""
+        with self._lock:
+            self.shed_total += n
+
     @property
     def inflight(self) -> int:
         with self._lock:

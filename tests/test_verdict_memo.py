@@ -193,7 +193,7 @@ def test_cache_probe_unit_collision():
     kb = (jnp.uint32(6), jnp.uint32(7), jnp.uint32(9))
     one = lambda x: jnp.asarray([x])
     valid = jnp.asarray([True])
-    hit, v0, v1, bucket, lane, ok = vm.cache_probe(
+    hit, v0, v1, bucket, lane, ok, _, _ = vm.cache_probe(
         rows, one(ka[0]), one(ka[1]), one(ka[2]), valid
     )
     assert not bool(np.asarray(hit)[0])
@@ -203,12 +203,12 @@ def test_cache_probe_unit_collision():
         one(ka[0]), one(ka[1]), one(ka[2]),
         one(jnp.uint32(0xAB)), one(jnp.uint32(0x3)), valid,
     )
-    hit_a, v0_a, _, _, _, _ = vm.cache_probe(
+    hit_a, v0_a, *_ = vm.cache_probe(
         rows, one(ka[0]), one(ka[1]), one(ka[2]), valid
     )
     assert bool(np.asarray(hit_a)[0])
     assert int(np.asarray(v0_a)[0]) == 0xAB
-    hit_b, _, _, _, _, _ = vm.cache_probe(
+    hit_b, *_ = vm.cache_probe(
         rows, one(kb[0]), one(kb[1]), one(kb[2]), valid
     )
     assert not bool(np.asarray(hit_b)[0]), (
@@ -799,3 +799,152 @@ def test_daemon_memo_overflow_redispatches_uncached():
             skipped.verdicts[field], ref.verdicts[field],
             err_msg=field,
         )
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10 satellites: LRU-ish lane eviction + cross-class cache warmth
+# ---------------------------------------------------------------------------
+
+
+def test_lru_eviction_hot_key_survives_cold_collision():
+    """Bucket-row collision eviction (PR 9 remainder): with every
+    lane occupied, a colliding cold insert must evict the
+    LEAST-RECENTLY-HIT lane (tracked in the per-row hit-rank word),
+    never the hot one — whichever lane the hot key happens to sit
+    in."""
+    import jax.numpy as jnp
+
+    def one(x, dt=jnp.uint32):
+        return jnp.asarray([x], dt)
+
+    valid = jnp.asarray([True])
+    novals = (one(0xAA), one(0x1))
+
+    def insert(rows, key, vals=novals):
+        (
+            hit, _, _, bucket, lane, ok, hlane, rword,
+        ) = vm.cache_probe(rows, one(key[0]), one(key[1]),
+                           one(key[2]), valid)
+        assert bool(np.asarray(ok)[0])
+        n_rows = rows.shape[0] - 1
+        ins_row = jnp.where(valid, bucket, n_rows)
+        rows = vm.apply_rank_updates(
+            rows, bucket, hit & False, hlane, rword,
+            ins_row, lane, rword, valid,
+        )
+        return vm.cache_insert(
+            rows, bucket, lane, one(key[0]), one(key[1]),
+            one(key[2]), *vals, valid,
+        ), int(np.asarray(lane)[0])
+
+    def hit_once(rows, key):
+        (
+            hit, _, _, bucket, lane, ok, hlane, rword,
+        ) = vm.cache_probe(rows, one(key[0]), one(key[1]),
+                           one(key[2]), valid)
+        scratch = jnp.asarray([rows.shape[0] - 1], jnp.int32)
+        rows = vm.apply_rank_updates(
+            rows, bucket, hit, hlane, rword,
+            scratch, lane, jnp.zeros(1, jnp.uint32),
+            jnp.asarray([False]),
+        )
+        return rows, bool(np.asarray(hit)[0])
+
+    A, B, C = (5, 7, 9), (6, 7, 9), (8, 7, 9)
+    for hot, cold_resident in ((A, B), (B, A)):
+        # 1 bucket x 2 lanes: both keys land in the same row
+        rows = jax.device_put(vm.make_cache_rows(1, 2))
+        rows, _ = insert(rows, A)
+        rows, lane_b = insert(rows, B)
+        assert lane_b == 1  # filled the remaining empty lane
+        for _ in range(3):  # make one key hot
+            rows, h = hit_once(rows, hot)
+            assert h
+        # colliding cold insert into the FULL bucket
+        rows, lane_c = insert(rows, C)
+        rows, hot_alive = hit_once(rows, hot)
+        assert hot_alive, "hot key evicted by a colliding cold insert"
+        _, cold_alive = hit_once(rows, cold_resident)
+        assert not cold_alive, "victim was not the cold lane"
+        _, c_alive = hit_once(rows, C)
+        assert c_alive
+
+
+def test_lru_eviction_through_memo_kernel():
+    """The same property end to end through memo_evaluate_kernel: a
+    hot policy key served for many batches survives bursts of
+    distinct cold keys hashed over a 1-row cache (every insert
+    collides), because same-batch inserts fill coldest lanes first
+    — rotation eviction would have walked over it."""
+    states, tables, t = _build(seed=9, batch=256)
+    hot = {k: np.repeat(np.asarray(v)[:1], 256) for k, v in t.items()}
+    kern = vm.memo_evaluate_kernel(rep_cap=256)
+    cache = jax.device_put(vm.make_cache_rows(1, 4))
+    batch_hot = TupleBatch.from_numpy(**hot)
+    # warm the hot key and give it rank heat
+    for _ in range(3):
+        _, cache, hit, stats = kern(tables, batch_hot, cache)
+    assert int(np.asarray(hit).sum()) == 256
+    # cold bursts: 2 FRESH distinct keys per burst (never repeated,
+    # so they never earn heat), every one colliding into the one row
+    for burst in range(4):
+        cold = {k: v.copy() for k, v in hot.items()}
+        cold["dport"] = np.full(256, 10000 + 2 * burst, np.int32)
+        cold["dport"][128:] = 10001 + 2 * burst
+        _, cache, _, stats = kern(
+            tables, TupleBatch.from_numpy(**cold), cache
+        )
+        assert int(np.asarray(stats)[vm.STAT_OVERFLOW]) == 0
+        assert int(np.asarray(stats)[vm.STAT_UNIQUE]) == 2
+    _, cache, hit, _ = kern(tables, batch_hot, cache)
+    assert int(np.asarray(hit).sum()) == 256, (
+        "hot key did not survive colliding cold inserts"
+    )
+
+
+def test_cache_warm_across_batch_size_classes():
+    """PR 9 remainder: switching jit batch classes (the autotuner /
+    serving-plane move) must NOT flush a still-valid epoch's cache —
+    stamp checks, not shape checks, gate reuse."""
+    from tests.test_replay import _daemon_with_policy, _make_buf
+
+    d, server, client = _daemon_with_policy()
+    rng = np.random.default_rng(11)
+    cid = client.security_identity.id
+    buf = _make_buf(rng, 128, [10], [cid, 999999])
+    d.config_patch({"verdict_cache": True})
+    ref = d.process_flows(buf, batch_size=128, collect_verdicts=True)
+    fl0 = metrics.verdict_cache_flushes_total.get()
+    hits0 = metrics.verdict_cache_hits_total.get()
+    # a DIFFERENT jit class (batch 64 -> different rep_cap kernel)
+    # over the same tuples: the epoch stamp is unchanged, so the
+    # warm entries must serve hits — and nothing may flush
+    got = d.process_flows(buf, batch_size=64, collect_verdicts=True)
+    assert metrics.verdict_cache_flushes_total.get() == fl0, (
+        "batch-class switch flushed a still-valid epoch's cache"
+    )
+    assert metrics.verdict_cache_hits_total.get() > hits0
+    for field in ref.verdicts:
+        np.testing.assert_array_equal(
+            got.verdicts[field], ref.verdicts[field], err_msg=field
+        )
+
+
+def test_engine_kernels_share_cache_across_rep_caps():
+    """Engine-level form of the cross-class warmth: two
+    memo_evaluate_kernel jit classes (different rep_cap) share one
+    cache rows buffer — entries written by one serve hits in the
+    other."""
+    states, tables, t = _build(seed=12, batch=256)
+    cache = jax.device_put(vm.make_cache_rows(1 << 8, 8))
+    batch = TupleBatch.from_numpy(**t)
+    k1 = vm.memo_evaluate_kernel(rep_cap=256)
+    _, cache, hit, _ = k1(tables, batch, cache)
+    assert int(np.asarray(hit).sum()) == 0
+    k2 = vm.memo_evaluate_kernel(rep_cap=128)
+    half = {k: np.asarray(v)[:128] for k, v in t.items()}
+    _, cache, hit2, stats2 = k2(
+        tables, TupleBatch.from_numpy(**half), cache
+    )
+    if int(np.asarray(stats2)[vm.STAT_OVERFLOW]) == 0:
+        assert int(np.asarray(hit2).sum()) == 128
