@@ -1466,6 +1466,78 @@ def run_config5(args) -> None:
             "probe verdict column + L3 word bit)"
         ),
     )
+    # the WHOLE-datapath extension: CT/ipcache/LB planes sharded
+    # under the family rules + the N+1 replica placement
+    # (engine/datapath_mesh.py) — per-chip HBM and universe headroom
+    # now honest for the FULL fused pipeline, not just the lattice
+    try:
+        _dp_rows, dp_per_chip, dp_repl, dp_ovh = (
+            pt_rules.datapath_bytes_model(tables, n_chips)
+        )
+        dp_full = sum(
+            int(
+                getattr(leaf, "nbytes", None)
+                or np.asarray(leaf).nbytes
+            )
+            for leaf in jax.tree.leaves(tables)
+        )
+        emit(
+            "datapath_table_bytes_per_chip",
+            int(dp_per_chip),
+            "bytes",
+            num_shards=n_chips,
+            replicated_bytes_per_chip=int(dp_full),
+            replicated_leaf_overhead=int(dp_repl),
+            replica_overhead_per_chip=int(dp_ovh),
+            note=(
+                "per-chip HBM of the WHOLE fused datapath "
+                "(policy + CT/ipcache/LB planes) under the family "
+                "partition rules with N+1 replicas"
+            ),
+        )
+        emit(
+            "datapath_universe_max_identities",
+            int(
+                pt_rules.datapath_universe_max_identities(
+                    tables, n_chips
+                )
+            ),
+            "identities",
+            num_shards=n_chips,
+            curve={
+                str(ns): int(
+                    pt_rules.datapath_universe_max_identities(
+                        tables, ns
+                    )
+                )
+                for ns in (1, 8, 64)
+            },
+            note=(
+                "identity-universe cap at 16 GB HBM/chip for the "
+                "WHOLE datapath footprint (ipcache buckets scale "
+                "with the universe; CT/LB planes divide as "
+                "constants)"
+            ),
+        )
+        n_range_classes = len(
+            getattr(tables.ipcache, "range_class_plens", ()) or ()
+        )
+        emit(
+            "datapath_alltoall_bytes_per_tuple",
+            pt_rules.datapath_alltoall_bytes_per_tuple(
+                n_chips, range_classes=n_range_classes
+            ),
+            "bytes",
+            num_shards=n_chips,
+            note=(
+                "collective bytes per tuple of the fused routed "
+                "pipeline (CT svc+flow probes, LB resolution, "
+                "ipcache exact + range classes, lattice psums)"
+            ),
+        )
+    except Exception as dp_exc:  # pragma: no cover — model only
+        print(f"# datapath bytes model skipped: {dp_exc}",
+              file=sys.stderr)
     emit(
         "verdicts_per_sec_per_chip",
         round(vps),
@@ -1705,7 +1777,30 @@ def run_config5(args) -> None:
         )
 
     memo_rep_cap = max(half_m >> 2, 1 << 10)
-    memo_cands = at.memo_candidates(half_m)
+
+    # ROADMAP lever (d): cache capacity bounded by the measured
+    # per-chip HBM headroom (resident table bytes subtracted from
+    # the HBM budget) instead of a fixed list; rows_cap keeps the
+    # single candidate proportionate to the batch's key universe so
+    # smoke-scale runs don't allocate a 1M-row buffer for nothing
+    from cilium_tpu.engine.publish import next_pow2 as _np2
+
+    class _ResidentBytes:
+        def chip_bytes(self):
+            import jax as _jax
+
+            return {
+                0: sum(
+                    int(np.asarray(leaf).nbytes)
+                    for leaf in _jax.tree.leaves(tables_chosen)
+                )
+            }
+
+    memo_cands = at.memo_candidates(
+        half_m,
+        store=_ResidentBytes(),
+        rows_cap=max(1 << 14, _np2(4 * half_m)),
+    )
     memo_choice = at.autotune(
         memo_cands,
         _run_memo_candidate,

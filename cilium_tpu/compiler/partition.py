@@ -91,6 +91,10 @@ IPCACHE_LEAF_NAMES = (
     "buckets", "stash", "range_base", "range_mask", "range_plen",
     "range_value", "range_l3_in", "range_l3_out", "range_rows",
 )
+# remaining fused-datapath leaf families (tree_flatten child order)
+CT_LEAF_NAMES = ("buckets", "stash")
+LB_INLINE_LEAF_NAMES = ("rows", "stash")
+LB_CLASSIC_LEAF_NAMES = ("buckets", "stash", "backend_rows")
 
 
 # -- the rule tables ---------------------------------------------------------
@@ -120,10 +124,36 @@ def default_table_rules(table_axis: str = TABLE_AXIS) -> List[tuple]:
 
 
 def default_ipcache_rules(table_axis: str = TABLE_AXIS) -> List[tuple]:
-    """IPCacheDevice rule table: the /32 bucket-row plane shards; the
-    small range-class plane and stash replicate."""
+    """IPCacheDevice rule table: the /32 bucket-row plane AND the
+    hashed range-class rows shard along their bucket-row axis; the
+    (base, mask, plen, value) broadcast-fallback arrays and the
+    stash replicate (they are small and every shard compares them)."""
+    return [
+        (r"^(buckets|range_rows)$", P(table_axis)),
+        (r".*", P()),
+    ]
+
+
+def default_ct_rules(table_axis: str = TABLE_AXIS) -> List[tuple]:
+    """CTSnapshot rule table: the [Cb, 128] bucket-row plane shards
+    along the bucket-row axis (rows spread uniformly by the
+    direction-normalized tuple hash); the overflow stash replicates
+    (broadcast-compared by every probe)."""
     return [
         (r"^buckets$", P(table_axis)),
+        (r".*", P()),
+    ]
+
+
+def default_lb_rules(table_axis: str = TABLE_AXIS) -> List[tuple]:
+    """LB rule table: the INLINE layout's service rows (service key +
+    backends in one 128-lane row) shard along the bucket-row axis.
+    The classic two-gather layout replicates wholesale — its backend
+    rows are indexed by the service entry's stored row index, not a
+    hash, so a split would need a second routing hop for the rare
+    >40-backend fallback; the stash replicates like every stash."""
+    return [
+        (r"^rows$", P(table_axis)),
         (r".*", P()),
     ]
 
@@ -512,6 +542,418 @@ def universe_max_identities(
     if per_id <= 0 or budget <= 0:
         return 0
     return int(budget * num_shards / per_id)
+
+
+# -- fused-datapath leaf families (ipcache / CT / LB planes) -----------------
+#
+# The same declarative layer extended to the REMAINING DatapathTables
+# families: every hashed bucket-row plane the fused pipeline gathers
+# (CT buckets, ipcache /32 buckets + range-class rows, LB service
+# rows) shards along the same table axis as l4_hash_rows, and the hot
+# ones join the N+1 replica placement so a dead chip's CT/ipcache/LB
+# rows serve from their backup owner exactly like the policy rows.
+# Everything else — stashes, broadcast-fallback range arrays, the
+# classic LB backend-row table, prefilter, tunnel — replicates.
+
+# the datapath leaves the N+1 failover layout augments, as
+# (family, leaf) pairs; entries whose family lacks the leaf (lb.rows
+# on the classic layout, lb.buckets on the inline one) are skipped
+DATAPATH_REPLICA_LEAVES = (
+    ("ct", "buckets"),
+    ("ipcache", "buckets"),
+    ("ipcache", "range_rows"),
+    ("lb", "rows"),
+)
+
+
+def _family_spec_children(children, names, rules, ntp, table_axis):
+    """Per-child PartitionSpecs for one table family with the
+    shard-axis divisibility fallback applied; None children keep a
+    None spec (empty subtrees must stay empty subtrees so the spec
+    tree's structure matches the value tree's)."""
+    specs = match_partition_rules(rules, names, children)
+    out = []
+    for leaf, spec in zip(children, specs):
+        if leaf is None:
+            out.append(None)
+            continue
+        if not _divisible(spec, np.shape(leaf), ntp, table_axis):
+            spec = P()
+        out.append(spec)
+    return tuple(out)
+
+
+def _replicated_specs(tree):
+    """All-replicated spec tree matching `tree`'s structure."""
+    return jax.tree.map(lambda _: P(), tree)
+
+
+def ct_family_specs(ct, ntp: int, table_axis: str = TABLE_AXIS):
+    """CTSnapshot of PartitionSpecs under default_ct_rules."""
+    children, aux = ct.tree_flatten()
+    return type(ct).tree_unflatten(
+        aux,
+        _family_spec_children(
+            children, CT_LEAF_NAMES, default_ct_rules(table_axis),
+            ntp, table_axis,
+        ),
+    )
+
+
+def lb_family_specs(lb, ntp: int, table_axis: str = TABLE_AXIS):
+    """LBInline/LBTables of PartitionSpecs under default_lb_rules."""
+    from cilium_tpu.lb.device import LBInline
+
+    children, aux = lb.tree_flatten()
+    names = (
+        LB_INLINE_LEAF_NAMES
+        if isinstance(lb, LBInline)
+        else LB_CLASSIC_LEAF_NAMES
+    )
+    return type(lb).tree_unflatten(
+        aux,
+        _family_spec_children(
+            children, names, default_lb_rules(table_axis), ntp,
+            table_axis,
+        ),
+    )
+
+
+def ipcache_family_specs(dev, ntp: int, table_axis: str = TABLE_AXIS):
+    """IPCacheDevice of PartitionSpecs under default_ipcache_rules
+    (divisibility-checked); the DIR-24-8 fallback form replicates."""
+    from cilium_tpu.ipcache.lpm import IPCacheDevice
+
+    children, aux = dev.tree_flatten()
+    if not isinstance(dev, IPCacheDevice):
+        return type(dev).tree_unflatten(
+            aux, tuple(None if c is None else P() for c in children)
+        )
+    return type(dev).tree_unflatten(
+        aux,
+        _family_spec_children(
+            children, IPCACHE_LEAF_NAMES,
+            default_ipcache_rules(table_axis), ntp, table_axis,
+        ),
+    )
+
+
+def datapath_partition_specs(
+    dtables, ntp: int, table_axis: str = TABLE_AXIS
+):
+    """PartitionSpecs for a full DatapathTables pytree: every family
+    resolved under its own rule table, prefilter/tunnel replicated,
+    the policy sub-tree under the existing policy rules."""
+    from cilium_tpu.engine.datapath import DatapathTables
+
+    return DatapathTables(
+        prefilter=_replicated_specs(dtables.prefilter),
+        ipcache=ipcache_family_specs(
+            dtables.ipcache, ntp, table_axis
+        ),
+        ct=ct_family_specs(dtables.ct, ntp, table_axis),
+        lb=lb_family_specs(dtables.lb, ntp, table_axis),
+        policy=divisible_partition_specs(
+            dtables.policy, ntp, table_axis
+        ),
+        tunnel=(
+            None
+            if dtables.tunnel is None
+            else _replicated_specs(dtables.tunnel)
+        ),
+    )
+
+
+def datapath_table_shardings(
+    mesh: Mesh, dtables, table_axis: str = TABLE_AXIS
+):
+    """NamedShardings for a DatapathTables pytree under the family
+    rule tables (the datapath store's placement resolver)."""
+    specs = datapath_partition_specs(
+        dtables, int(mesh.shape[table_axis]), table_axis
+    )
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def datapath_replica_axes(
+    dtables, ntp: int, table_axis: str = TABLE_AXIS
+):
+    """{(family, leaf): sharded-axis position} for the datapath
+    leaves the N+1 layout augments: DATAPATH_REPLICA_LEAVES that the
+    divisibility-checked rule layer actually shards at `ntp`."""
+    specs = datapath_partition_specs(dtables, ntp, table_axis)
+    out = {}
+    for fam, leaf in DATAPATH_REPLICA_LEAVES:
+        fobj = getattr(dtables, fam)
+        if fobj is None or not hasattr(fobj, leaf):
+            continue
+        if getattr(fobj, leaf, None) is None:
+            continue
+        spec = getattr(getattr(specs, fam), leaf, None)
+        if spec is None:
+            continue
+        for axis, ax in enumerate(spec):
+            if ax == table_axis:
+                out[(fam, leaf)] = axis
+                break
+    return out
+
+
+def datapath_all_replica_axes(
+    dtables, ntp: int, table_axis: str = TABLE_AXIS
+):
+    """{(family, leaf): sharded-axis} over the WHOLE datapath tree —
+    the datapath families (datapath_replica_axes) merged with the
+    policy replica leaves keyed as ("policy", name).  THE augmented-
+    leaf enumeration the delta publish, the chip repair and the
+    residency assertions all share, so they can never disagree about
+    which leaves carry N+1 copies."""
+    out = dict(datapath_replica_axes(dtables, ntp, table_axis))
+    out.update(
+        {
+            ("policy", name): axis
+            for name, axis in replica_axes(
+                dtables.policy, ntp, table_axis
+            ).items()
+        }
+    )
+    return out
+
+
+def replicate_datapath_leaves(
+    dtables, ntp: int, table_axis: str = TABLE_AXIS
+):
+    """DatapathTables with every datapath replica-rule leaf augmented
+    along its sharded axis (replicate_shard_axis: each shard's slice
+    plus its left neighbour's backup copy) and the policy sub-tree
+    augmented by replicate_table_leaves — the device layout the fused
+    failover evaluator consumes."""
+    import dataclasses
+
+    axes = datapath_replica_axes(dtables, ntp, table_axis)
+    fam_updates = {}
+    for (fam, leaf), axis in axes.items():
+        fam_updates.setdefault(fam, {})[leaf] = replicate_shard_axis(
+            getattr(getattr(dtables, fam), leaf), ntp, axis
+        )
+    new_fams = {
+        fam: dataclasses.replace(getattr(dtables, fam), **ups)
+        for fam, ups in fam_updates.items()
+    }
+    return dataclasses.replace(
+        dtables,
+        policy=replicate_table_leaves(
+            dtables.policy, ntp, table_axis
+        ),
+        **new_fams,
+    )
+
+
+def datapath_partition_digest(table_axis: str = TABLE_AXIS) -> int:
+    """Digest of the WHOLE fused-datapath placement — every family's
+    rule table plus both replica sets and the backup offset — folded
+    into the datapath store's epoch layout, so a delta recorded under
+    one partitioning can never scatter into an epoch laid out under
+    another (the cross-layout refusal the policy store already has,
+    extended to the CT/ipcache/LB planes)."""
+    parts = []
+    for fam, rules in (
+        ("policy", default_table_rules(table_axis)),
+        ("ipcache", default_ipcache_rules(table_axis)),
+        ("ct", default_ct_rules(table_axis)),
+        ("lb", default_lb_rules(table_axis)),
+    ):
+        parts.append(
+            fam + ":" + ";".join(
+                f"{pat}->{tuple(spec)}" for pat, spec in rules
+            )
+        )
+    parts.append("replicas=" + ",".join(REPLICA_LEAVES))
+    parts.append(
+        "dp_replicas="
+        + ",".join(f"{f}.{l}" for f, l in DATAPATH_REPLICA_LEAVES)
+    )
+    parts.append(f"backup_offset={REPLICA_BACKUP_OFFSET}")
+    return zlib.crc32("|".join(parts).encode()) & 0xFFFFFFFF
+
+
+def _family_byte_rows(
+    fam, obj, names, rules, ntp, table_axis, rep_axes
+):
+    children, _ = obj.tree_flatten()
+    specs = _family_spec_children(
+        children, names, rules, ntp, table_axis
+    )
+    rows = []
+    for name, leaf, spec in zip(names, children, specs):
+        if leaf is None:
+            continue
+        # leaf.nbytes avoids a D2H copy when the model runs over a
+        # device-resident tree (bench does)
+        nbytes = int(
+            getattr(leaf, "nbytes", None) or np.asarray(leaf).nbytes
+        )
+        sharded = spec is not None and any(
+            ax == table_axis for ax in spec
+        )
+        chip = (nbytes + ntp - 1) // ntp if sharded else nbytes
+        rep = (fam, name) in rep_axes
+        if rep:
+            chip *= 2
+        rows.append(
+            {
+                "leaf": f"{fam}.{name}",
+                "sharded": sharded,
+                "replicated_n_plus_1": rep,
+                "bytes_total": nbytes,
+                "bytes_per_chip": chip,
+            }
+        )
+    return rows
+
+
+def datapath_bytes_model(
+    dtables, num_shards: int, table_axis: str = TABLE_AXIS
+):
+    """Per-leaf per-chip bytes of the WHOLE fused datapath under the
+    family rule tables + the N+1 replica placement (policy leaves via
+    replica_bytes_model, CT/ipcache/LB via their family rules).
+    Returns (rows, per_chip_total, replicated_total, overhead):
+    `replicated_total` is the per-chip constant the acceptance bound
+    allows on top of replicated-bytes / num_shards; `overhead` is
+    exactly the backup copies' bytes — bounded by replicated/N."""
+    from cilium_tpu.lb.device import LBInline
+
+    rep_axes = datapath_replica_axes(dtables, num_shards, table_axis)
+    pol_rows, pol_per_chip, pol_overhead = replica_bytes_model(
+        dtables.policy, num_shards, table_axis
+    )
+    rows = [
+        {**r, "leaf": f"policy.{r['leaf']}"} for r in pol_rows
+    ]
+    per_chip = pol_per_chip
+    overhead = pol_overhead
+    replicated = sum(
+        r["bytes_per_chip"] for r in rows if not r["sharded"]
+    )
+    fam_args = [
+        ("ct", dtables.ct, CT_LEAF_NAMES,
+         default_ct_rules(table_axis)),
+        (
+            "lb", dtables.lb,
+            LB_INLINE_LEAF_NAMES
+            if isinstance(dtables.lb, LBInline)
+            else LB_CLASSIC_LEAF_NAMES,
+            default_lb_rules(table_axis),
+        ),
+    ]
+    from cilium_tpu.ipcache.lpm import IPCacheDevice
+
+    if isinstance(dtables.ipcache, IPCacheDevice):
+        fam_args.append(
+            ("ipcache", dtables.ipcache, IPCACHE_LEAF_NAMES,
+             default_ipcache_rules(table_axis))
+        )
+    for fam, obj, names, rules in fam_args:
+        frows = _family_byte_rows(
+            fam, obj, names, rules, num_shards, table_axis, rep_axes
+        )
+        rows.extend(frows)
+        for r in frows:
+            per_chip += r["bytes_per_chip"]
+            if not r["sharded"]:
+                replicated += r["bytes_per_chip"]
+            elif r["replicated_n_plus_1"]:
+                overhead += r["bytes_per_chip"] // 2
+    # prefilter / tunnel / a DIR-24-8 ipcache: replicated constants
+    extra = [dtables.prefilter, dtables.tunnel]
+    if not isinstance(dtables.ipcache, IPCacheDevice):
+        extra.append(dtables.ipcache)
+    for tree in extra:
+        if tree is None:
+            continue
+        nbytes = sum(
+            int(getattr(l, "nbytes", None) or np.asarray(l).nbytes)
+            for l in jax.tree.leaves(tree)
+        )
+        if nbytes:
+            per_chip += nbytes
+            replicated += nbytes
+    return rows, per_chip, replicated, overhead
+
+
+def datapath_universe_max_identities(
+    dtables,
+    num_shards: int,
+    hbm_bytes: int = 16 << 30,
+    table_axis: str = TABLE_AXIS,
+) -> int:
+    """universe_max_identities extended to the WHOLE datapath
+    footprint.  Identity-scaling bytes = the policy identity-major
+    leaves (by rule intent, as universe_max_identities classifies)
+    PLUS the ipcache /32 bucket plane (every identity is reachable
+    at ≥ 1 /32 entry, so the bucket table grows linearly with the
+    universe) — N+1 replica leaves count twice.  The CT/LB planes
+    scale with flows and services, not identities: their sharded
+    leaves divide by num_shards (×2 where replicated N+1) and join
+    the per-chip constant alongside the replicated leaves."""
+    children, _ = dtables.policy.tree_flatten()
+    specs = match_partition_rules(
+        default_table_rules(table_axis), POLICY_LEAF_NAMES, children
+    )
+    n = int(dtables.policy.id_table.shape[0])
+    id_bytes = 0.0
+    constant = 0.0
+    for name, leaf, spec in zip(POLICY_LEAF_NAMES, children, specs):
+        if leaf is None:
+            continue
+        nbytes = int(
+            getattr(leaf, "nbytes", None) or np.asarray(leaf).nbytes
+        )
+        if any(ax == table_axis for ax in spec):
+            id_bytes += nbytes * (2 if name in REPLICA_LEAVES else 1)
+        else:
+            constant += nbytes
+    rows, per_chip, _replicated, _overhead = datapath_bytes_model(
+        dtables, num_shards, table_axis
+    )
+    for r in rows:
+        if r["leaf"].startswith("policy."):
+            continue  # accounted above (slope or constant)
+        if r["leaf"] == "ipcache.buckets" and r["sharded"]:
+            id_bytes += r["bytes_total"] * (
+                2 if r["replicated_n_plus_1"] else 1
+            )
+        else:
+            constant += r["bytes_per_chip"]
+    # prefilter / tunnel constants datapath_bytes_model folded into
+    # per_chip but not into rows: recover them from the totals
+    row_chip = sum(r["bytes_per_chip"] for r in rows)
+    constant += max(per_chip - row_chip, 0)
+    per_id = id_bytes / max(n, 1)
+    budget = hbm_bytes - constant
+    if per_id <= 0 or budget <= 0:
+        return 0
+    return int(budget * num_shards / per_id)
+
+
+def datapath_alltoall_bytes_per_tuple(
+    num_shards: int, range_classes: int = 2
+) -> float:
+    """Collective bytes the fused routed-gather pipeline moves per
+    tuple along the table axis: the lattice's exact+L3 psum pair
+    (12 B, alltoall_bytes_per_tuple) plus the CT service probe
+    (found + value, 8 B), the CT flow probe (fwd/rev found + values,
+    16 B), the LB service resolution (found/slave/daddr/dport/rev_nat,
+    20 B), the ipcache exact probe (found + value, 8 B) and one
+    (found + value) pair per hashed range-length class.  A 1-shard
+    mesh moves nothing."""
+    if num_shards <= 1:
+        return 0.0
+    return 12.0 + 8.0 + 16.0 + 20.0 + 8.0 + 8.0 * range_classes
 
 
 def alltoall_bytes_per_tuple(num_shards: int) -> float:

@@ -418,21 +418,13 @@ def ct_lookup_batch(
     )
 
 
-def ct_probe_rows(
-    snapshot: CTSnapshot,
-    rows,  # u32 [B, 128] from ct_fetch_rows
-    daddr,
-    saddr,
-    dport,
-    sport,
-    proto,
-    direction,
-    related_icmp=None,
+def ct_probe_keys(
+    daddr, saddr, dport, sport, proto, direction, related_icmp=None
 ):
-    """Probe pre-fetched bucket rows for the given tuple/direction —
-    see ct_lookup_batch.  The rows need not have been fetched with
-    THIS tuple's hash: the merged egress path probes the pre-DNAT
-    row for the post-DNAT key (dual-homed entries)."""
+    """Device probe-key computation shared by the single-chip and
+    routed (mesh) CT probes: the normalized compare words and the
+    forward/reverse w3 flag words.  Returns (lo_a, hi_a, ports_w,
+    w3_fwd, w3_rev, probed_related)."""
     import jax.numpy as jnp
 
     base_flags = jnp.where(
@@ -452,9 +444,6 @@ def ct_probe_rows(
         daddr, saddr, dport, sport
     )
     proto_u = proto.astype(jnp.uint32) & 0xFF
-    n_e = ENTRIES_PER_BUCKET
-    # planar extraction: word k of all entries = one contiguous slice
-    ew = [rows[:, k * n_e : (k + 1) * n_e] for k in range(ENTRY_WORDS)]
 
     # probe w3 values: the forward key's swapped bit is the flow's
     # own orientation; the reverse key's is the opposite (unless the
@@ -475,43 +464,84 @@ def ct_probe_rows(
         | (rev_sw.astype(jnp.uint32) * _SWAPPED_BIT)
         | rev_flags
     )
+    probed_related = (base_flags & jnp.uint32(TUPLE_F_RELATED)) != 0
+    return lo_a, hi_a, (lo_p << 16) | hi_p, w3_fwd, w3_rev, probed_related
 
+
+def ct_probe_row_parts(rows, lo_a, hi_a, ports_w, w3_fwd, w3_rev,
+                       owns=None):
+    """Bucket-ROW half of the CT probe: lane compares against
+    pre-fetched rows, with an optional ownership mask (the routed
+    mesh kernel gathers each row on its owning shard only and masks
+    every other shard's contribution to zero, so an integer psum of
+    these parts reconstructs the single-chip result exactly).
+    Returns (fwd_found bool [B], rev_found bool [B], fwd_val u32 [B],
+    rev_val u32 [B])."""
+    import jax.numpy as jnp
+
+    n_e = ENTRIES_PER_BUCKET
+    # planar extraction: word k of all entries = one contiguous slice
+    ew = [rows[:, k * n_e : (k + 1) * n_e] for k in range(ENTRY_WORDS)]
     key_eq = (
         (ew[0] == lo_a[:, None])
         & (ew[1] == hi_a[:, None])
-        & (ew[2] == ((lo_p << 16) | hi_p)[:, None])
+        & (ew[2] == ports_w[:, None])
     )
+    if owns is not None:
+        key_eq = key_eq & owns[:, None]
     fwd_hit = key_eq & (ew[3] == w3_fwd[:, None])  # [B, E]
     rev_hit = key_eq & (ew[3] == w3_rev[:, None])
+    fwd_val = jnp.sum(
+        jnp.where(fwd_hit, ew[4], 0), axis=1, dtype=jnp.uint32
+    )
+    rev_val = jnp.sum(
+        jnp.where(rev_hit, ew[4], 0), axis=1, dtype=jnp.uint32
+    )
+    return (
+        jnp.any(fwd_hit, axis=1), jnp.any(rev_hit, axis=1),
+        fwd_val, rev_val,
+    )
 
-    # stash: broadcast compare (shape-stable, no gather)
+
+def ct_probe_stash_parts(snapshot, lo_a, hi_a, ports_w, w3_fwd, w3_rev):
+    """Overflow-stash half of the CT probe (broadcast compare; the
+    stash replicates on a mesh, so these parts are computed once per
+    shard and added AFTER the row-part psum — never summed across
+    the table axis).  Same return contract as ct_probe_row_parts."""
+    import jax.numpy as jnp
+
     stash = jnp.asarray(snapshot.stash)  # [S, 5]
     s_key_eq = (
         (stash[None, :, 0] == lo_a[:, None])
         & (stash[None, :, 1] == hi_a[:, None])
-        & (stash[None, :, 2] == ((lo_p << 16) | hi_p)[:, None])
+        & (stash[None, :, 2] == ports_w[:, None])
     )
     s_fwd = s_key_eq & (stash[None, :, 3] == w3_fwd[:, None])
     s_rev = s_key_eq & (stash[None, :, 3] == w3_rev[:, None])
+    fwd_val = jnp.sum(
+        jnp.where(s_fwd, stash[None, :, 4], 0), axis=1,
+        dtype=jnp.uint32,
+    )
+    rev_val = jnp.sum(
+        jnp.where(s_rev, stash[None, :, 4], 0), axis=1,
+        dtype=jnp.uint32,
+    )
+    return (
+        jnp.any(s_fwd, axis=1), jnp.any(s_rev, axis=1),
+        fwd_val, rev_val,
+    )
 
-    def _pick_val(hits, s_hits):
-        v = jnp.sum(
-            jnp.where(hits, ew[4], 0), axis=1, dtype=jnp.uint32
-        ) + jnp.sum(
-            jnp.where(s_hits, stash[None, :, 4], 0),
-            axis=1,
-            dtype=jnp.uint32,
-        )
-        return v
 
-    fwd_found = jnp.any(fwd_hit, axis=1) | jnp.any(s_fwd, axis=1)
-    rev_found = jnp.any(rev_hit, axis=1) | jnp.any(s_rev, axis=1)
-    fwd_val = _pick_val(fwd_hit, s_fwd)
-    rev_val = _pick_val(rev_hit, s_rev)
+def ct_probe_combine(
+    fwd_found, rev_found, fwd_val, rev_val, probed_related
+):
+    """Combine probe parts into the CT lookup result — the terminal
+    shared step of both the single-chip and routed probes.  Returns
+    (result u8 [B], rev_nat i32 [B], slave i32 [B])."""
+    import jax.numpy as jnp
 
     # the probe itself carried the RELATED bit (exact key equality),
     # so a hit on a RELATED probe IS a RELATED entry
-    probed_related = (base_flags & jnp.uint32(TUPLE_F_RELATED)) != 0
     result = jnp.where(
         rev_found,
         jnp.where(probed_related, CT_RELATED, CT_REPLY),
@@ -521,12 +551,43 @@ def ct_probe_rows(
             CT_NEW,
         ),
     ).astype(jnp.uint8)
-
     val = jnp.where(rev_found, rev_val, fwd_val)
     hit = rev_found | fwd_found
     rev_nat = jnp.where(hit, val >> 16, 0).astype(jnp.int32)
     slave = jnp.where(hit, val & 0xFFFF, 0).astype(jnp.int32)
     return result, rev_nat, slave
+
+
+def ct_probe_rows(
+    snapshot: CTSnapshot,
+    rows,  # u32 [B, 128] from ct_fetch_rows
+    daddr,
+    saddr,
+    dport,
+    sport,
+    proto,
+    direction,
+    related_icmp=None,
+):
+    """Probe pre-fetched bucket rows for the given tuple/direction —
+    see ct_lookup_batch.  The rows need not have been fetched with
+    THIS tuple's hash: the merged egress path probes the pre-DNAT
+    row for the post-DNAT key (dual-homed entries)."""
+    lo_a, hi_a, ports_w, w3_fwd, w3_rev, probed_related = (
+        ct_probe_keys(
+            daddr, saddr, dport, sport, proto, direction,
+            related_icmp,
+        )
+    )
+    rf, rr, rfv, rrv = ct_probe_row_parts(
+        rows, lo_a, hi_a, ports_w, w3_fwd, w3_rev
+    )
+    sf, sr, sfv, srv = ct_probe_stash_parts(
+        snapshot, lo_a, hi_a, ports_w, w3_fwd, w3_rev
+    )
+    return ct_probe_combine(
+        rf | sf, rr | sr, rfv + sfv, rrv + srv, probed_related
+    )
 
 
 def apply_new_flows(

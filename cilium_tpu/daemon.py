@@ -1023,7 +1023,12 @@ class Daemon:
             }},
         )
 
-    def attach_mesh_router(self, router, route_dispatch: bool = True) -> None:
+    def attach_mesh_router(
+        self,
+        router,
+        route_dispatch: bool = True,
+        auto_publish: bool = True,
+    ) -> None:
         """Adopt a ChipFailoverRouter (engine/failover.py): per-chip
         breaker transitions publish AgentNotify monitor events beside
         the router's own gauge/span-event wiring, and health() gains
@@ -1034,12 +1039,19 @@ class Daemon:
         dispatch loop also routes every batch through the router —
         survivor re-split, replica gathers and per-chip breakers
         serve the stream instead of the single-chip program — once
-        the router holds a published epoch (`router.publish`); until
-        then, and on any router error, batches fall back to the
-        single-chip path under the process-wide breaker.  The
-        operator owns keeping the router's published tables in step
-        with the daemon's (publish on regenerate), exactly as the
-        sharded store factory seam does."""
+        the router holds a published epoch; until then, and on any
+        router error, batches fall back to the single-chip path
+        under the process-wide breaker.
+
+        With `auto_publish` (default) the router's published tables
+        TRACK daemon regenerates automatically: every device-epoch
+        publish the endpoint manager performs also lands in the
+        router's replica store, with a delta computed against the
+        ROUTER store's own standby stamp (its epoch cadence differs
+        from the manager store's) — no operator publish.  The
+        current published tables, if any, are pushed immediately, so
+        attaching to a warm daemon engages mesh routing on the very
+        next batch."""
         from cilium_tpu.monitor.events import AgentNotify
 
         self.mesh_router = router
@@ -1059,6 +1071,55 @@ class Daemon:
                 outer(ordinal, old, new, reason)
 
         router._on_chip_transition = _notify
+        if not auto_publish:
+            return
+
+        def _sync_router(tables):
+            """Publish a fresh host compile into the router's
+            replica store, delta-scoped against ITS standby."""
+            try:
+                delta = self.endpoint_manager.delta_for(
+                    router.store.spare_stamp(), tables
+                )
+            except Exception:  # pragma: no cover — compiler churn
+                delta = None
+            try:
+                _, stats = router.publish(tables, delta)
+                metrics.table_publish_total.inc(
+                    f"router_{stats.mode}"
+                )
+            except Exception as exc:  # noqa: BLE001
+                log.warning(
+                    "router auto-publish failed; mesh routing will "
+                    "serve the previous epoch",
+                    extra={"fields": {"error": str(exc)}},
+                )
+                return
+            if router.dp_store is None:
+                return
+            # the fused plane tracks regenerates too: rebuild the
+            # datapath world from live daemon state (the new policy
+            # tables + current ipcache/CT/LB) and republish — the
+            # row-diff store keeps it a delta, so fused serving
+            # never answers with pre-regenerate policy
+            try:
+                _, dstats = router.publish_datapath(
+                    self.datapath_tables(policy=tables)
+                )
+                metrics.table_publish_total.inc(
+                    f"datapath_{dstats.mode}"
+                )
+            except Exception as exc:  # noqa: BLE001
+                log.warning(
+                    "fused datapath auto-publish failed; fused "
+                    "serving will use the previous epoch",
+                    extra={"fields": {"error": str(exc)}},
+                )
+
+        self.endpoint_manager.on_device_publish = _sync_router
+        version, tables, _index = self.endpoint_manager.published()
+        if tables is not None:
+            _sync_router(tables)
 
     def _ensure_verdict_cache(self, tables):
         """The daemon's VerdictCache, stamped to the tables about to
@@ -1155,6 +1216,48 @@ class Daemon:
             cache_hit=hit,
             cache_stats=stats,
         )
+
+    def _fold_memo_drain(
+        self, cache_stats, v, valid, padded_len, redispatch
+    ):
+        """THE drain-time memo fold, shared by the one-shot drain
+        (_process_flows_traced._drain_oldest) and the serving
+        plane's drain (serve.ServingPlane._complete) so the two can
+        never diverge: when the kernel REFUSED the batch (more
+        distinct keys than the compaction capacity — its verdict
+        columns are unspecified, carried cache state untouched) the
+        batch re-dispatches through `redispatch()` (a thunk running
+        the uncached program, returning (out, degraded)); otherwise
+        hit/miss accounting lands exactly once, corrected to the
+        batch's valid prefix (padding rows all share one key and
+        would drown the metrics in synthetic hits).  Returns
+        (v, extra_degraded)."""
+        from types import SimpleNamespace
+
+        import numpy as np
+
+        from cilium_tpu.engine import memo as vm
+
+        s = np.asarray(cache_stats).astype(np.int64)
+        deg = False
+        if int(s[vm.STAT_OVERFLOW]):
+            self.verdict_cache_overflow_streak += 1
+            out2, deg = redispatch()
+            v = SimpleNamespace(
+                allowed=np.asarray(out2.allowed)[:valid],
+                match_kind=np.asarray(out2.match_kind)[:valid],
+                proxy_port=np.asarray(out2.proxy_port)[:valid],
+                cache_hit=np.zeros(valid, bool),
+            )
+        else:
+            self.verdict_cache_overflow_streak = 0
+            if valid < int(padded_len):
+                s = s.copy()
+                s[vm.STAT_HIT] = int(v.cache_hit.sum())
+                s[vm.STAT_TUPLES] = int(valid)
+        if self.verdict_cache is not None:
+            self.verdict_cache.account(s)
+        return v, deg
 
     def _dispatch_or_degrade(
         self, tables, batch, host_args, pad_to: int,
@@ -1632,6 +1735,59 @@ class Daemon:
         rec = {k: v[~hit] for k, v in rec.items()}
         return rec, n_prefiltered
 
+    def datapath_tables(self, policy=None):
+        """Assemble the FUSED DatapathTables from the daemon's
+        current state — published policy tables + the ipcache
+        listener's CIDR→identity view (idx-specialized) + the CT map
+        snapshot + compiled services + the prefilter set.  This is
+        the world ChipFailoverRouter.attach_datapath serves, and
+        what the fused serving plane re-publishes on churn.
+
+        `policy` pins the policy tables to an EXACT snapshot (the
+        auto-publish listener passes the tables it just installed,
+        so the router's lattice epoch and its fused epoch can never
+        come from two different regenerates); None reads the current
+        published tables.  The CT entry dict and the service map are
+        shallow-snapshotted before compilation — the ct-gc
+        controller thread mutates the live CTMap without the daemon
+        lock, and iterating it directly would race."""
+        import copy
+
+        from cilium_tpu.ct.device import compile_ct
+        from cilium_tpu.engine.datapath import DatapathTables
+        from cilium_tpu.ipcache.lpm import (
+            build_ipcache,
+            specialize_ipcache_to_idx,
+        )
+        from cilium_tpu.lb.device import compile_lb
+        from cilium_tpu.prefilter import build_prefilter
+
+        pol = policy
+        if pol is None:
+            _, pol, _ = self.endpoint_manager.published()
+        if pol is None:
+            raise RuntimeError("no published tables")
+        with self.lock:
+            mappings = dict(self.lpm_builder.mappings)
+            prefilter_cidrs = self.prefilter.dump()
+            services = copy.copy(self.services)
+            services.by_frontend = dict(self.services.by_frontend)
+        # dict() of the entries is atomic under the GIL; entry
+        # values are only ever replaced, not mutated in the packed
+        # fields, so the shallow snapshot is a consistent view
+        ct_snap = copy.copy(self.ct)
+        ct_snap.entries = dict(self.ct.entries)
+        ipc = specialize_ipcache_to_idx(
+            build_ipcache(mappings), pol
+        )
+        return DatapathTables(
+            prefilter=build_prefilter(prefilter_cidrs),
+            ipcache=ipc,
+            ct=compile_ct(ct_snap),
+            lb=compile_lb(services),
+            policy=pol,
+        )
+
     def serving_plane(self, **overrides):
         """The daemon's continuous serving plane
         (cilium_tpu.serve.ServingPlane), created and started on
@@ -1842,7 +1998,6 @@ class Daemon:
 
         def _drain_oldest():
             from cilium_tpu.engine.hostpath import lattice_fold_host
-            from cilium_tpu.engine import memo as vm
 
             out, degraded, start, end, valid, batch_t0, dev_batch = (
                 pending.popleft()
@@ -1873,40 +2028,22 @@ class Daemon:
                     # program
                     cstats = getattr(out, "cache_stats", None)
                     if cstats is not None:
-                        s = np.asarray(cstats).astype(np.int64)
-                        if int(s[vm.STAT_OVERFLOW]):
-                            self.verdict_cache_overflow_streak += 1
 
-                            def _ha(s0=start, e0=end):
+                        def _redispatch(s0=start, e0=end):
+                            def _ha():
                                 return _host_args_for(s0, e0)
 
-                            out2, deg2 = self._dispatch_or_degrade(
-                                tables, dev_batch, _ha, batch_size,
-                                use_memo=False,
+                            return self._dispatch_or_degrade(
+                                tables, dev_batch, _ha,
+                                batch_size, use_memo=False,
                             )
-                            degraded = degraded or deg2
-                            v = SimpleNamespace(
-                                allowed=np.asarray(
-                                    out2.allowed
-                                )[:valid],
-                                match_kind=np.asarray(
-                                    out2.match_kind
-                                )[:valid],
-                                proxy_port=np.asarray(
-                                    out2.proxy_port
-                                )[:valid],
-                                cache_hit=np.zeros(valid, bool),
-                            )
-                        else:
-                            self.verdict_cache_overflow_streak = 0
-                            if valid < int(out.allowed.shape[0]):
-                                s = s.copy()
-                                s[vm.STAT_HIT] = int(
-                                    v.cache_hit.sum()
-                                )
-                                s[vm.STAT_TUPLES] = int(valid)
-                        if self.verdict_cache is not None:
-                            self.verdict_cache.account(s)
+
+                        v, deg2 = self._fold_memo_drain(
+                            cstats, v, valid,
+                            int(out.allowed.shape[0]),
+                            _redispatch,
+                        )
+                        degraded = degraded or deg2
                 except Exception as exc:
                     # the overlapped batch died ON DEVICE after a
                     # successful enqueue: the breaker learns the

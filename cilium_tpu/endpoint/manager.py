@@ -67,6 +67,14 @@ class EndpointManager:
         # chip's shard only
         self.device_store_factory = None
         self.last_publish_stats = None
+        # optional listener fired after every device-epoch publish
+        # with the HOST tables just installed: the daemon wires the
+        # attached ChipFailoverRouter here so the router's published
+        # tables track regenerates AUTOMATICALLY (no operator
+        # publish).  Called outside the manager's main lock but
+        # under the device lock; must not call back into
+        # published_device.
+        self.on_device_publish = None
         # builder failure bookkeeping (endpoint.go's bpf.go:442 retry
         # counter analog): (endpoint_id, reason, repr(exc)) of the
         # most recent failed builds, surfaced via daemon status
@@ -329,6 +337,14 @@ class EndpointManager:
             return version, None, index
         return version, self._device_tables(tables), index
 
+    def delta_for(self, base_stamp, tables):
+        """TableDelta from `base_stamp` to `tables`
+        (FleetCompiler.delta_for passthrough) — lets a SECOND device
+        store (the failover router's replica store) compute its own
+        delta against ITS standby epoch's stamp instead of reusing
+        the manager store's delta, whose base differs."""
+        return self._fleet_compiler.delta_for(base_stamp, tables)
+
     def device_tables_for(self, tables):
         """Device-resident epoch for an EXACT published host snapshot
         (the daemon's serving path reads tables + host states under
@@ -355,6 +371,16 @@ class EndpointManager:
                 stats.mode, value=stats.bytes_h2d
             )
             metrics.table_publish_seconds.set(value=stats.seconds)
+            listener = self.on_device_publish
+            if listener is not None:
+                try:
+                    listener(tables)
+                except Exception as exc:  # noqa: BLE001 — a router
+                    # sync failure must not take down the publish
+                    log.warning(
+                        "on_device_publish listener failed",
+                        extra={"fields": {"error": str(exc)}},
+                    )
             log.info(
                 "device table epoch published",
                 extra={"fields": {

@@ -286,11 +286,46 @@ def cold_bytes_per_tuple(tables) -> float:
 # ---------------------------------------------------------------------------
 
 
+MEMO_ROWS_MIN = 1 << 10
+MEMO_ROWS_MAX = 1 << 20
+
+
+def memo_rows_for_headroom(
+    headroom_bytes: int,
+    entries: int = 8,
+    headroom_frac: float = 0.25,
+) -> int:
+    """Largest pow2 verdict-cache row count whose device buffer fits
+    within `headroom_frac` of the given HBM headroom (the ROADMAP's
+    lever (d): size the cache for the access pattern AND the budget,
+    not a fixed list).  Row cost mirrors engine/memo.py's layout:
+    CACHE_WORDS * entries + 1 u32 words per row, one scratch row.
+    Clamped to [MEMO_ROWS_MIN, MEMO_ROWS_MAX]; returns 0 when even
+    the minimum doesn't fit (the tuner then keeps memo off)."""
+    from cilium_tpu.engine.memo import CACHE_WORDS
+
+    row_bytes = (CACHE_WORDS * int(entries) + 1) * 4
+    budget = max(int(headroom_bytes * headroom_frac), 0)
+    rows = MEMO_ROWS_MIN
+    if (rows + 1) * row_bytes > budget:
+        return 0
+    while (
+        rows < MEMO_ROWS_MAX
+        and (rows * 2 + 1) * row_bytes <= budget
+    ):
+        rows <<= 1
+    return rows
+
+
 def memo_candidates(
     batch: int,
     include_off: bool = True,
-    rows_options: Sequence[int] = (1 << 14,),
+    rows_options: "Optional[Sequence[int]]" = None,
     rep_shifts: Sequence[int] = (2,),
+    store=None,
+    hbm_bytes: int = 16 << 30,
+    headroom_frac: float = 0.25,
+    rows_cap: Optional[int] = None,
 ) -> List[dict]:
     """Verdict-memoization candidates for the tuner (the schema
     bench's `_run_memo_candidate` consumes): cache row counts ×
@@ -300,7 +335,32 @@ def memo_candidates(
     overhead beats the gathers saved on this workload the tuner
     keeps the uncached program — the choice is cached per table
     shape class like the batch/pack-width choice, so a long-running
-    server decides once per layout."""
+    server decides once per layout.
+
+    Capacity is HBM-aware: with a `store` (any object exposing
+    chip_bytes() → {ordinal: resident bytes}, e.g. the daemon's
+    DeviceTableStore or the router's DatapathStore), the candidate
+    row counts derive from the MEASURED per-shard headroom —
+    hbm_bytes minus the worst chip's resident table bytes — instead
+    of a fixed list, so the cache never competes with the sharded
+    table planes for the same HBM.  An explicit `rows_options`
+    overrides."""
+    if rows_options is None:
+        if store is not None:
+            try:
+                per_chip = store.chip_bytes() or {}
+            except Exception:  # pragma: no cover — defensive
+                per_chip = {}
+            worst = max(per_chip.values()) if per_chip else 0
+            rows = memo_rows_for_headroom(
+                max(hbm_bytes - worst, 0),
+                headroom_frac=headroom_frac,
+            )
+            if rows and rows_cap:
+                rows = min(rows, int(rows_cap))
+            rows_options = (rows,) if rows else ()
+        else:
+            rows_options = (1 << 14,)
     cands: List[dict] = [{"memo": False}] if include_off else []
     for rows in rows_options:
         for shift in rep_shifts:

@@ -183,6 +183,19 @@ class ChipFailoverRouter:
         # through the repair scatter), so the flush keeps the
         # cached-verdict staleness argument airtight
         self._verdict_cache = None
+        # fused-datapath plane (engine/datapath_mesh.py): attached
+        # via attach_datapath — the router then serves the FULL
+        # pipeline (prefilter + LB/DNAT + CT + ipcache + lattice)
+        # through dispatch_flows over the same admit/re-split/replica
+        # machinery
+        self.dp_store = None
+        self._dp_ev = None
+        self._dp_geom = None
+        self._host_datapath_fold = None
+        # chips whose breaker opened while a fused epoch is resident:
+        # their datapath slices repair at readmission (bytes ∝ one
+        # chip's owned rows, not a full upload)
+        self._dp_out = set()
 
     # -- breaker plumbing ----------------------------------------------------
 
@@ -210,6 +223,8 @@ class ChipFailoverRouter:
         )
         if new == "open":
             self.store.mark_chip_out(ordinal)
+            if self.dp_store is not None:
+                self._dp_out.add(int(ordinal))
         log.warning(
             "chip breaker transition",
             extra={"fields": {
@@ -224,21 +239,254 @@ class ChipFailoverRouter:
 
     def publish(self, tables, delta=None):
         """Install host tables as the serving epoch (replica store:
-        augmentation + per-copy delta scatter happen inside).  The
-        evaluator geometry is pinned at construction — a re-grown
-        hash plane must rebuild the router, same contract as
-        make_partitioned_evaluator."""
+        augmentation + per-copy delta scatter happen inside).  A
+        changed table GEOMETRY (hash-plane regrow, identity-pad
+        growth) rebuilds the failover evaluator in place — the daemon
+        auto-publish hook must survive a regenerate that crosses a
+        shape class, and the store's layout stamp already forces the
+        full upload such a publish needs."""
+        from cilium_tpu.engine.sharded import make_failover_evaluator
+
         got = (
             tuple(tables.l4_hash_rows.shape),
             tuple(tables.l3_allow_bits.shape),
         )
         if got != self._geom:
-            raise ValueError(
-                f"router was built for table geometry {self._geom} "
-                f"but asked to publish {got}; rebuild the router"
+            log.warning(
+                "table geometry changed; rebuilding failover "
+                "evaluator",
+                extra={"fields": {
+                    "from": str(self._geom), "to": str(got),
+                }},
             )
+            self._ev = make_failover_evaluator(
+                self.mesh, tables, batch_axis=self.batch_axis,
+                table_axis=self.table_axis,
+                collect_telemetry=self.collect_telemetry,
+            )
+            self._geom = got
+            self._pack_plans.clear()
         self._tables = tables
         return self.store.publish(tables, delta)
+
+    # -- the fused datapath plane (engine/datapath_mesh.py) ------------------
+
+    def attach_datapath(self, dtables, host_fold=None) -> None:
+        """Adopt the FULL fused pipeline: build the DatapathStore
+        and the fused failover evaluator, and publish `dtables` as
+        the serving datapath epoch.  dispatch_flows then serves raw
+        5-tuple flows through prefilter + LB/DNAT + CT + ipcache +
+        lattice over the partitioned N+1 tables, with the same
+        per-chip breakers / survivor re-split / replica gathers as
+        the lattice path.  `host_fold(ep_index, saddr, daddr, sport,
+        dport, proto, direction, is_fragment)` is the optional
+        terminal fallback when no mesh row can serve."""
+        from cilium_tpu.engine.datapath_mesh import DatapathStore
+
+        self.dp_store = DatapathStore(self.mesh, self.table_axis)
+        self._dp_ev = None
+        self._dp_geom = None
+        self._host_datapath_fold = host_fold
+        # prime BOTH epoch slots (the policy-store idiom) so the
+        # very next churn publish rides the row-diff delta path
+        self.publish_datapath(dtables)
+        self.publish_datapath(dtables)
+
+    def publish_datapath(self, dtables):
+        """Install a fused-datapath world (host, un-augmented) as
+        the serving epoch: steady-state churn rides the store's
+        row-diff delta scatter; a geometry change rebuilds the fused
+        evaluator and full-uploads."""
+        from cilium_tpu.engine.datapath_mesh import (
+            _geometry,
+            make_failover_datapath_evaluator,
+        )
+
+        if self.dp_store is None:
+            raise RuntimeError(
+                "no datapath plane attached: call attach_datapath"
+            )
+        geom = _geometry(dtables)
+        if self._dp_ev is None or geom != self._dp_geom:
+            self._dp_ev = make_failover_datapath_evaluator(
+                self.mesh, dtables, batch_axis=self.batch_axis,
+                table_axis=self.table_axis,
+                collect_telemetry=self.collect_telemetry,
+            )
+            self._dp_geom = geom
+        return self.dp_store.publish(dtables)
+
+    def dispatch_flows(
+        self,
+        ep_index,
+        saddr,
+        daddr,
+        sport,
+        dport,
+        proto,
+        direction,
+        is_fragment=None,
+    ) -> FailoverResult:
+        """One raw-flow batch through the FULL fused pipeline on the
+        mesh.  Returns a FailoverResult whose `verdicts` is an
+        engine.datapath.DatapathVerdicts of host columns in STREAM
+        ORDER — bit-identical to the single-device fused program
+        whatever the survivor set, as long as one owner of every
+        table slice survives."""
+        import jax
+
+        from cilium_tpu.engine.datapath import (
+            DatapathVerdicts,
+            FlowBatch,
+        )
+
+        if self.dp_store is None:
+            raise RuntimeError(
+                "no datapath plane attached: call attach_datapath"
+            )
+        cols = {
+            "ep_index": np.asarray(ep_index, np.int32),
+            "saddr": np.asarray(saddr, np.uint32),
+            "daddr": np.asarray(daddr, np.uint32),
+            "sport": np.asarray(sport, np.int32),
+            "dport": np.asarray(dport, np.int32),
+            "proto": np.asarray(proto, np.int32),
+            "direction": np.asarray(direction, np.int32),
+            "is_fragment": (
+                np.zeros(len(ep_index), bool)
+                if is_fragment is None
+                else np.asarray(is_fragment, bool)
+            ),
+        }
+        b = len(cols["ep_index"])
+        if b == 0:
+            zero = lambda dt: np.zeros(0, dt)  # noqa: E731
+            return FailoverResult(
+                verdicts=DatapathVerdicts(
+                    allowed=zero(np.uint8),
+                    proxy_port=zero(np.int32),
+                    match_kind=zero(np.uint8),
+                    ct_result=zero(np.uint8),
+                    pre_dropped=zero(bool),
+                    sec_id=zero(np.uint32),
+                    final_daddr=zero(np.uint32),
+                    final_dport=zero(np.int32),
+                    rev_nat=zero(np.int32),
+                    lb_slave=zero(np.int32),
+                    ct_create=zero(bool),
+                    ct_delete=zero(bool),
+                    tunnel_endpoint=zero(np.uint32),
+                    l4_slot=zero(np.int32),
+                    ipcache_miss=zero(bool),
+                ),
+            )
+        plan, fold_args = self._plan_batch(cols)
+        if plan is None:
+            return self._terminal_flow_fold(
+                cols, *fold_args,
+                reason="no mesh row can serve every table slice",
+            )
+        alive = plan["alive"]
+        dev = self.dp_store.current()
+        if dev is None:
+            raise RuntimeError(
+                "no published datapath epoch: call publish_datapath"
+            )
+        batch = FlowBatch(**plan["padded"])
+        with tracing.tracer.span(
+            "mesh.dispatch", site=self.site,
+            attrs={
+                "chips": int(alive.sum()), "rows": b,
+                "rerouted": plan["rerouted"], "fused": True,
+            },
+        ) as sp:
+            try:
+                out = self._dp_ev(dev, batch, alive, plan["valid"])
+                jax.block_until_ready(out)
+            except Exception as exc:  # noqa: BLE001
+                sp.status = "error"
+                sp.attrs["error"] = str(exc)
+                self._blame_alive(alive, exc)
+                return self._terminal_flow_fold(
+                    cols, alive, plan["rebalanced"],
+                    plan["reb_bytes"], plan["reb_ms"],
+                    reason=str(exc),
+                )
+        self._credit_alive(alive)
+        if self.collect_telemetry:
+            v, l4c, l3c, replica_hits, trow = out
+            telemetry = np.asarray(trow)
+        else:
+            v, l4c, l3c, replica_hits = out
+            telemetry = None
+        replica_hits = self._count_replica_hits(replica_hits)
+        positions = plan["positions"]
+
+        def col(x):
+            a = np.asarray(x)
+            return a if positions is None else a[positions]
+
+        verdicts = DatapathVerdicts(
+            **{
+                f: col(getattr(v, f))
+                for f in (
+                    "allowed", "proxy_port", "match_kind",
+                    "ct_result", "pre_dropped", "sec_id",
+                    "final_daddr", "final_dport", "rev_nat",
+                    "lb_slave", "ct_create", "ct_delete",
+                    "tunnel_endpoint", "l4_slot", "ipcache_miss",
+                )
+            }
+        )
+        return FailoverResult(
+            verdicts=verdicts,
+            l4_counts=np.asarray(l4c),
+            l3_counts=np.asarray(l3c),
+            telemetry=telemetry,
+            replica_hits=replica_hits,
+            rerouted=plan["rerouted"],
+            degraded=False,
+            alive=alive,
+            rebalanced_chips=plan["rebalanced"],
+            rebalance_bytes=plan["reb_bytes"],
+            rebalance_ms=plan["reb_ms"],
+        )
+
+    def _terminal_flow_fold(
+        self, cols, alive, rebalanced, reb_bytes, reb_ms, reason=""
+    ) -> FailoverResult:
+        """Host composed-pipeline fold for the fused path — taken
+        only when no owner of some slice survives (or the SPMD
+        launch failed); raises without a configured host_fold."""
+        if self._host_datapath_fold is None:
+            raise RuntimeError(
+                f"fused mesh unserviceable ({reason}) and no "
+                f"host datapath fold configured"
+            )
+        with tracing.tracer.span(
+            "engine.hostpath", site="engine.hostpath",
+            attrs={"failover": True, "fused": True,
+                   "reason": reason},
+        ):
+            v = self._host_datapath_fold(
+                cols["ep_index"], cols["saddr"], cols["daddr"],
+                cols["sport"], cols["dport"], cols["proto"],
+                cols["direction"], cols["is_fragment"],
+            )
+        metrics.degraded_batches_total.inc()
+        self.stats.degraded_batches += 1
+        log.warning(
+            "fused mesh batch served by terminal host fold",
+            extra={"fields": {"reason": reason}},
+        )
+        return FailoverResult(
+            verdicts=v,
+            degraded=True,
+            alive=alive,
+            rebalanced_chips=rebalanced,
+            rebalance_bytes=reb_bytes,
+            rebalance_ms=reb_ms,
+        )
 
     # -- re-admission rebalance ----------------------------------------------
 
@@ -397,6 +645,31 @@ class ChipFailoverRouter:
                             ordinal, f"rebalance failed: {exc}"
                         )
                         ok = False
+                if ok and ordinal in self._dp_out:
+                    # the fused-datapath half of the rebalance: the
+                    # chip's owned CT/ipcache/LB/policy slices of
+                    # the datapath epoch replay from the store's
+                    # retained host snapshot (bytes ∝ one chip's
+                    # slice, never a full upload)
+                    try:
+                        t0 = time.perf_counter()
+                        db = self.dp_store.repair_chip(c)
+                        reb_ms += (time.perf_counter() - t0) * 1e3
+                        reb_bytes += db
+                        self._dp_out.discard(ordinal)
+                        if ordinal not in rebalanced:
+                            rebalanced.append(ordinal)
+                        self.stats.rebalance_bytes += db
+                        tracing.add_event(
+                            "chip.rebalance", chip=ordinal,
+                            bytes_h2d=db, datapath=True,
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        self.bank.record_failure(
+                            ordinal,
+                            f"datapath repair failed: {exc}",
+                        )
+                        ok = False
                 if ok and was_half_open:
                     probed.append(ordinal)
                 alive[r, c] = ok
@@ -485,6 +758,76 @@ class ChipFailoverRouter:
                 padded[key][dst] = v[src]
         return padded, valid, positions
 
+    def _plan_batch(self, cols: Dict[str, np.ndarray]):
+        """The admission + re-split front half SHARED by dispatch
+        (lattice) and dispatch_flows (fused): stats, per-chip fault
+        probes/breaker questions/rebalances, the usable-row rule
+        (with probe-slot release when nothing can serve), reroute
+        accounting and the batch re-split.  Returns (plan, None) on
+        a servable mesh — plan carries alive/padded/valid/positions/
+        rerouted + the rebalance record — or (None, fold_args) when
+        no row can serve and the caller must take its terminal
+        fold."""
+        self.stats.batches += 1
+        self.stats.tuples += len(cols["ep_index"])
+        alive, rebalanced, reb_bytes, reb_ms, probed = self._admit()
+        usable = self._usable_rows(alive)
+        if not usable.any():
+            # the dispatch never launches, so admitted half-open
+            # chips earn neither a success nor a failure — give
+            # their probe slots back instead of pinning them until
+            # the TTL (a healthy, already-rebalanced chip must not
+            # be locked out for probe_ttl by OTHER rows' deaths)
+            for ordinal in probed:
+                self.bank.release_probe(ordinal)
+            return None, (alive, rebalanced, reb_bytes, reb_ms)
+        rerouted = not usable.all()
+        if rerouted:
+            metrics.rerouted_batches_total.inc()
+            self.stats.rerouted_batches += 1
+            tracing.add_event(
+                "chip.reroute",
+                dead_rows=int((~usable).sum()),
+                survivors=int(usable.sum()),
+            )
+        padded, valid, positions = self._pack(cols, usable)
+        return {
+            "alive": alive,
+            "rebalanced": rebalanced,
+            "reb_bytes": reb_bytes,
+            "reb_ms": reb_ms,
+            "rerouted": rerouted,
+            "padded": padded,
+            "valid": valid,
+            "positions": positions,
+        }, None
+
+    def _blame_alive(self, alive, exc) -> None:
+        """Unattributed launch failure: every participating chip is
+        suspect (a mesh-wide SPMD launch has no smaller blame unit
+        without the fault seam's attribution)."""
+        for r in range(self.dp):
+            for c in range(self.tp):
+                if alive[r, c]:
+                    self.bank.record_failure(
+                        int(self.ordinals[r, c]), str(exc)
+                    )
+
+    def _credit_alive(self, alive) -> None:
+        for r in range(self.dp):
+            for c in range(self.tp):
+                if alive[r, c]:
+                    self.bank.record_success(
+                        int(self.ordinals[r, c])
+                    )
+
+    def _count_replica_hits(self, replica_hits) -> int:
+        replica_hits = int(np.asarray(replica_hits))
+        if replica_hits:
+            metrics.replica_gather_total.inc(value=replica_hits)
+            self.stats.replica_hits += replica_hits
+        return replica_hits
+
     def dispatch(
         self,
         ep_index,
@@ -524,32 +867,13 @@ class ChipFailoverRouter:
                     match_kind=np.zeros(0, np.uint8),
                 ),
             )
-        self.stats.batches += 1
-        self.stats.tuples += len(cols["ep_index"])
-        alive, rebalanced, reb_bytes, reb_ms, probed = self._admit()
-        usable = self._usable_rows(alive)
-        if not usable.any():
-            # the dispatch never launches, so admitted half-open
-            # chips earn neither a success nor a failure — give
-            # their probe slots back instead of pinning them until
-            # the TTL (a healthy, already-rebalanced chip must not
-            # be locked out for probe_ttl by OTHER rows' deaths)
-            for ordinal in probed:
-                self.bank.release_probe(ordinal)
+        plan, fold_args = self._plan_batch(cols)
+        if plan is None:
             return self._terminal_fold(
-                cols, alive, rebalanced, reb_bytes, reb_ms,
+                cols, *fold_args,
                 reason="no mesh row can serve every table slice",
             )
-        rerouted = not usable.all()
-        if rerouted:
-            metrics.rerouted_batches_total.inc()
-            self.stats.rerouted_batches += 1
-            tracing.add_event(
-                "chip.reroute",
-                dead_rows=int((~usable).sum()),
-                survivors=int(usable.sum()),
-            )
-        padded, valid, positions = self._pack(cols, usable)
+        alive = plan["alive"]
         current = self.store.current()
         if current is None:
             raise RuntimeError(
@@ -558,54 +882,42 @@ class ChipFailoverRouter:
         _, dev_tables = current
         from cilium_tpu.engine.verdict import TupleBatch
 
-        batch = TupleBatch(**padded)
-        n_alive = int(alive.sum())
+        batch = TupleBatch(**plan["padded"])
         with tracing.tracer.span(
             "mesh.dispatch", site=self.site,
             attrs={
-                "chips": n_alive, "rows": len(cols["ep_index"]),
-                "rerouted": rerouted,
+                "chips": int(alive.sum()),
+                "rows": len(cols["ep_index"]),
+                "rerouted": plan["rerouted"],
             },
         ) as sp:
             try:
-                out = self._ev(dev_tables, batch, alive, valid)
+                out = self._ev(
+                    dev_tables, batch, alive, plan["valid"]
+                )
                 import jax
 
                 jax.block_until_ready(out)
             except Exception as exc:  # noqa: BLE001
-                # unattributed failure: every participating chip is
-                # suspect (a mesh-wide SPMD launch has no smaller
-                # blame unit without the fault seam's attribution)
                 sp.status = "error"
                 sp.attrs["error"] = str(exc)
-                for r in range(self.dp):
-                    for c in range(self.tp):
-                        if alive[r, c]:
-                            self.bank.record_failure(
-                                int(self.ordinals[r, c]), str(exc)
-                            )
+                self._blame_alive(alive, exc)
                 return self._terminal_fold(
-                    cols, alive, rebalanced, reb_bytes, reb_ms,
+                    cols, alive, plan["rebalanced"],
+                    plan["reb_bytes"], plan["reb_ms"],
                     reason=str(exc),
                 )
-        for r in range(self.dp):
-            for c in range(self.tp):
-                if alive[r, c]:
-                    self.bank.record_success(
-                        int(self.ordinals[r, c])
-                    )
+        self._credit_alive(alive)
         if self.collect_telemetry:
             v, l4c, l3c, replica_hits, trow = out
             telemetry = np.asarray(trow)
         else:
             v, l4c, l3c, replica_hits = out
             telemetry = None
-        replica_hits = int(np.asarray(replica_hits))
-        if replica_hits:
-            metrics.replica_gather_total.inc(value=replica_hits)
-            self.stats.replica_hits += replica_hits
+        replica_hits = self._count_replica_hits(replica_hits)
         from cilium_tpu.engine.verdict import Verdicts
 
+        positions = plan["positions"]
         if positions is None:
             verdicts = Verdicts(
                 allowed=np.asarray(v.allowed),
@@ -624,12 +936,12 @@ class ChipFailoverRouter:
             l3_counts=np.asarray(l3c),
             telemetry=telemetry,
             replica_hits=replica_hits,
-            rerouted=rerouted,
+            rerouted=plan["rerouted"],
             degraded=False,
             alive=alive,
-            rebalanced_chips=rebalanced,
-            rebalance_bytes=reb_bytes,
-            rebalance_ms=reb_ms,
+            rebalanced_chips=plan["rebalanced"],
+            rebalance_bytes=plan["reb_bytes"],
+            rebalance_ms=plan["reb_ms"],
         )
 
     def _terminal_fold(

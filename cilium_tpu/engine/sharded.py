@@ -930,6 +930,241 @@ def make_partitioned_memo_evaluator(
     return run
 
 
+def failover_lattice_probes(
+    tables_l: PolicyTables,
+    ep_index,
+    direction,
+    dport,
+    proto,
+    idx,
+    known,
+    alive_row,
+    my_col,
+    ntp: int,
+    rows_sharded: bool,
+    l3_sharded: bool,
+    n_rows_global: int,
+    n_row_shard: int,
+    wn: int,
+    table_axis: str,
+):
+    """The replica-aware routed 3-probe lattice — the kernel body
+    shared by make_failover_evaluator (post-ipcache TupleBatch form)
+    and the fused datapath evaluator (engine/datapath_mesh.py, which
+    derives `idx`/`known` from the routed ipcache lookup instead of
+    id_direct).  Consumes the N+1 AUGMENTED l4_hash_rows /
+    l3_allow_bits planes plus the mesh row's `alive_row` health
+    vector; a dead primary's bucket/word routes to the backup owner
+    next shard over.
+
+    Returns a dict: probe1/probe2/probe3/proxy/j (the _combine
+    inputs + counter slot), p2_local (this chip's L3 hit — feeds the
+    shard-local counter scatter), wp/apw (the L3 word's primary
+    owner + its liveness; None on a replicated L3 plane) and
+    `replica` (bool [B]: the tuple was served from a backup
+    region)."""
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.compiler.tables import (
+        L4H_WILD_IDX,
+        l4h_key0,
+        l4h_key1,
+    )
+    from cilium_tpu.engine.hashtable import fnv1a_device
+    from cilium_tpu.engine.verdict import _l4hash_probe
+
+    # -- routed exact probe with replica fallback -------------------
+    w0 = l4h_key0(idx.astype(jnp.uint32), direction, ep_index)
+    w1 = l4h_key1(dport, proto, ep_index)
+    h = fnv1a_device(jnp.stack([w0, w1], axis=1))
+    bucket = (h & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
+    rows_l = tables_l.l4_hash_rows
+    e = rows_l.shape[1] // 3
+    replica_exact = jnp.zeros(bucket.shape, bool)
+    if rows_sharded:
+        n = n_row_shard
+        p = bucket // n
+        ap = alive_row[p]
+        owner = jnp.where(
+            ap, p, (p + partition.REPLICA_BACKUP_OFFSET) % ntp
+        )
+        owns = owner == my_col
+        # serving chip's local row: primary region [0, n) when
+        # the owner IS the primary, backup region [n, 2n) when
+        # the next shard over serves its neighbour's copy
+        bl = (bucket - p * n) + jnp.where(ap, 0, n)
+        bl = jnp.clip(bl, 0, 2 * n - 1)
+        replica_exact = owns & ~ap
+    else:
+        owns = jnp.ones(bucket.shape, bool)
+        bl = bucket
+    row = rows_l[bl]
+    hit = (
+        (row[:, :e] == w0[:, None])
+        & (row[:, e : 2 * e] == w1[:, None])
+        & owns[:, None]
+    )
+    val_local = jnp.sum(
+        jnp.where(hit, row[:, 2 * e : 3 * e], 0),
+        axis=1, dtype=jnp.uint32,
+    )
+    found_local = jnp.any(hit, axis=1)
+    if rows_sharded:
+        val1 = jax.lax.psum(val_local, table_axis)
+        found1 = (
+            jax.lax.psum(found_local.astype(jnp.int32), table_axis)
+            > 0
+        )
+    else:
+        val1, found1 = val_local, found_local
+    stash = tables_l.l4_hash_stash
+    s_hit = (stash[None, :, 0] == w0[:, None]) & (
+        stash[None, :, 1] == w1[:, None]
+    )
+    val1 = val1 + jnp.sum(
+        jnp.where(s_hit, stash[None, :, 2], 0),
+        axis=1, dtype=jnp.uint32,
+    )
+    found1 = found1 | jnp.any(s_hit, axis=1)
+
+    wild_idx = jnp.full(
+        idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
+    )
+    hit3, val3 = _l4hash_probe(
+        tables_l.l4_wild_rows, tables_l.l4_wild_stash,
+        ep_index, direction, wild_idx, dport, proto,
+    )
+    probe1 = known & found1
+    probe3 = hit3
+    val = jnp.where(probe1, val1, val3)
+    proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    j = (val >> jnp.uint32(16)).astype(jnp.int32)
+
+    # -- routed L3 probe with replica fallback ----------------------
+    word = idx >> 5
+    bit = (idx & 31).astype(jnp.uint32)
+    replica_l3 = jnp.zeros(word.shape, bool)
+    wp = apw = None
+    if l3_sharded:
+        wp = word // wn
+        apw = alive_row[wp]
+        owner_w = jnp.where(
+            apw, wp, (wp + partition.REPLICA_BACKUP_OFFSET) % ntp
+        )
+        owns_w = owner_w == my_col
+        wl = (word - wp * wn) + jnp.where(apw, 0, wn)
+        wl = jnp.clip(wl, 0, 2 * wn - 1)
+        replica_l3 = owns_w & ~apw
+    else:
+        owns_w = jnp.ones(word.shape, bool)
+        wl = word
+    l3_words = tables_l.l3_allow_bits[ep_index, direction, wl]
+    p2_local = known & owns_w & ((l3_words >> bit) & 1).astype(bool)
+    if l3_sharded:
+        probe2 = (
+            jax.lax.psum(p2_local.astype(jnp.int32), table_axis) > 0
+        )
+    else:
+        probe2 = p2_local
+    return {
+        "probe1": probe1,
+        "probe2": probe2,
+        "probe3": probe3,
+        "proxy": proxy,
+        "j": j,
+        "p2_local": p2_local,
+        "wp": wp,
+        "apw": apw,
+        "replica": replica_exact | replica_l3,
+    }
+
+
+def failover_counts(
+    tables_l: PolicyTables,
+    ep_index,
+    direction,
+    match_kind,
+    j,
+    idx,
+    p2_local,
+    valid_l,
+    l3_sharded: bool,
+    wn: int,
+    wp,
+    apw,
+    n_ids: int,
+    batch_axis: str,
+):
+    """Valid-masked counter epilogue of the failover kernels: L4-slot
+    hits from the globally-combined verdict columns; L3 hits
+    shard-LOCAL at the augmented local identity index (primary region
+    [0, g), backup region [g, 2g) — the same routing as the word
+    gather), folded back to the global [E, 2, N] surface host-side
+    by fold_l3_aug.  Padding positions (valid=False) are excluded
+    everywhere — a re-split batch counts exactly its real tuples."""
+    e_count, _, kg = tables_l.l4_meta.shape
+    hit_l4 = (
+        (match_kind == MATCH_L4) | (match_kind == MATCH_L4_WILD)
+    ) & valid_l
+    l4_counts = jnp.zeros((e_count, 2, kg), jnp.uint32).at[
+        ep_index, direction, j
+    ].add(hit_l4.astype(jnp.uint32))
+    l4_counts = jax.lax.psum(l4_counts, batch_axis)
+    l3_hit_here = p2_local & (match_kind == MATCH_L3) & valid_l
+    if l3_sharded:
+        # shard-LOCAL counters at the augmented local identity
+        # index: each hit lands exactly once on its serving chip, so
+        # the global [E, 2, N] tensor is never materialized on
+        # device (it would be 32x the bit plane, replicated per
+        # chip — defeating the HBM sharding this plane exists for).
+        g = wn * 32
+        lid = jnp.clip(idx - wp * g, 0, g - 1) + jnp.where(
+            apw, 0, g
+        )
+        l3_counts = jnp.zeros(
+            (e_count, 2, 2 * g), jnp.uint32
+        ).at[
+            ep_index, direction, lid
+        ].add(l3_hit_here.astype(jnp.uint32))
+    else:
+        # replicated fallback plane: p2_local is IDENTICAL on
+        # every table chip — count at the global index and take
+        # one copy (a table-axis psum would inflate every hit
+        # by tp)
+        l3_counts = jnp.zeros(
+            (e_count, 2, n_ids), jnp.uint32
+        ).at[
+            ep_index, direction, jnp.clip(idx, 0, n_ids - 1),
+        ].add(l3_hit_here.astype(jnp.uint32))
+    l3_counts = jax.lax.psum(l3_counts, batch_axis)
+    return l4_counts, l3_counts
+
+
+def fold_l3_aug(l3_aug, ntp: int):
+    """[E, 2, ntp*2g] chip-major (primary region then backup region
+    per chip) → global [E, 2, N]: slice p reassembles from chip p's
+    primary region + chip (p+offset)'s backup region.  Rows whose
+    owner moved were counted in the backup region, so summing both
+    regions is exact whatever mix each mesh row's survivor set
+    routed."""
+    import numpy as np
+
+    from cilium_tpu.compiler import partition
+
+    a = np.asarray(l3_aug)
+    g = a.shape[-1] // (2 * ntp)
+    blocks = a.reshape(a.shape[0], a.shape[1], ntp, 2 * g)
+    back = np.roll(
+        blocks[..., g:],
+        -partition.REPLICA_BACKUP_OFFSET,
+        axis=2,
+    )
+    return np.ascontiguousarray(
+        (blocks[..., :g] + back).reshape(
+            a.shape[0], a.shape[1], ntp * g
+        )
+    )
+
+
 def make_replica_store(
     mesh: Mesh,
     table_axis: str = "table",
@@ -1004,15 +1239,8 @@ def make_failover_evaluator(
     host oracle — the acceptance contract of the per-chip failover
     plane."""
     from cilium_tpu.compiler import partition
-    from cilium_tpu.compiler.tables import (
-        L4H_WILD_IDX,
-        l4h_key0,
-        l4h_key1,
-    )
-    from cilium_tpu.engine.hashtable import fnv1a_device
     from cilium_tpu.engine.verdict import (
         _index_identity,
-        _l4hash_probe,
         telemetry_masks,
     )
 
@@ -1068,163 +1296,23 @@ def make_failover_evaluator(
         alive_row = alive_l[jax.lax.axis_index(batch_axis)]
         my_col = jax.lax.axis_index(table_axis)
 
-        # -- routed exact probe with replica fallback -------------------
-        w0 = l4h_key0(
-            idx.astype(jnp.uint32), batch_l.direction,
-            batch_l.ep_index,
+        lat = failover_lattice_probes(
+            tables_l, batch_l.ep_index, batch_l.direction, dport,
+            proto, idx, known, alive_row, my_col, ntp,
+            rows_sharded, l3_sharded, n_rows_global, n_row_shard,
+            wn, table_axis,
         )
-        w1 = l4h_key1(dport, proto, batch_l.ep_index)
-        h = fnv1a_device(jnp.stack([w0, w1], axis=1))
-        bucket = (h & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
-        rows_l = tables_l.l4_hash_rows
-        e = rows_l.shape[1] // 3
-        replica_exact = jnp.zeros(bucket.shape, bool)
-        if rows_sharded:
-            n = n_row_shard
-            p = bucket // n
-            ap = alive_row[p]
-            owner = jnp.where(
-                ap, p, (p + partition.REPLICA_BACKUP_OFFSET) % ntp
-            )
-            owns = owner == my_col
-            # serving chip's local row: primary region [0, n) when
-            # the owner IS the primary, backup region [n, 2n) when
-            # the next shard over serves its neighbour's copy
-            bl = (bucket - p * n) + jnp.where(ap, 0, n)
-            bl = jnp.clip(bl, 0, 2 * n - 1)
-            replica_exact = owns & ~ap
-        else:
-            owns = jnp.ones(bucket.shape, bool)
-            bl = bucket
-        row = rows_l[bl]
-        hit = (
-            (row[:, :e] == w0[:, None])
-            & (row[:, e : 2 * e] == w1[:, None])
-            & owns[:, None]
+        v = _combine(
+            lat["probe1"], lat["probe2"], lat["probe3"],
+            lat["proxy"], batch_l.is_fragment,
         )
-        val_local = jnp.sum(
-            jnp.where(hit, row[:, 2 * e : 3 * e], 0),
-            axis=1, dtype=jnp.uint32,
+        l4_counts, l3_counts = failover_counts(
+            tables_l, batch_l.ep_index, batch_l.direction,
+            v.match_kind, lat["j"], idx, lat["p2_local"], valid_l,
+            l3_sharded, wn, lat["wp"], lat["apw"], n_ids,
+            batch_axis,
         )
-        found_local = jnp.any(hit, axis=1)
-        if rows_sharded:
-            val1 = jax.lax.psum(val_local, table_axis)
-            found1 = (
-                jax.lax.psum(
-                    found_local.astype(jnp.int32), table_axis
-                )
-                > 0
-            )
-        else:
-            val1, found1 = val_local, found_local
-        stash = tables_l.l4_hash_stash
-        s_hit = (stash[None, :, 0] == w0[:, None]) & (
-            stash[None, :, 1] == w1[:, None]
-        )
-        val1 = val1 + jnp.sum(
-            jnp.where(s_hit, stash[None, :, 2], 0),
-            axis=1, dtype=jnp.uint32,
-        )
-        found1 = found1 | jnp.any(s_hit, axis=1)
-
-        wild_idx = jnp.full(
-            idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
-        )
-        hit3, val3 = _l4hash_probe(
-            tables_l.l4_wild_rows, tables_l.l4_wild_stash,
-            batch_l.ep_index, batch_l.direction, wild_idx,
-            dport, proto,
-        )
-        probe1 = known & found1
-        probe3 = hit3
-        val = jnp.where(probe1, val1, val3)
-        proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
-        j = (val >> jnp.uint32(16)).astype(jnp.int32)
-
-        # -- routed L3 probe with replica fallback ----------------------
-        word = idx >> 5
-        bit = (idx & 31).astype(jnp.uint32)
-        replica_l3 = jnp.zeros(word.shape, bool)
-        if l3_sharded:
-            wp = word // wn
-            apw = alive_row[wp]
-            owner_w = jnp.where(
-                apw, wp, (wp + partition.REPLICA_BACKUP_OFFSET) % ntp
-            )
-            owns_w = owner_w == my_col
-            wl = (word - wp * wn) + jnp.where(apw, 0, wn)
-            wl = jnp.clip(wl, 0, 2 * wn - 1)
-            replica_l3 = owns_w & ~apw
-        else:
-            owns_w = jnp.ones(word.shape, bool)
-            wl = word
-        l3_words = tables_l.l3_allow_bits[
-            batch_l.ep_index, batch_l.direction, wl
-        ]
-        p2_local = (
-            known & owns_w & ((l3_words >> bit) & 1).astype(bool)
-        )
-        if l3_sharded:
-            probe2 = (
-                jax.lax.psum(p2_local.astype(jnp.int32), table_axis)
-                > 0
-            )
-        else:
-            probe2 = p2_local
-
-        v = _combine(probe1, probe2, probe3, proxy,
-                     batch_l.is_fragment)
-
-        # -- valid-masked counters + telemetry --------------------------
-        # Padding positions (valid=False) are excluded everywhere —
-        # a re-split batch counts exactly its real tuples.
-        e_count, _, kg = tables_l.l4_meta.shape
-        hit_l4 = (
-            (v.match_kind == MATCH_L4)
-            | (v.match_kind == MATCH_L4_WILD)
-        ) & valid_l
-        l4_counts = jnp.zeros((e_count, 2, kg), jnp.uint32).at[
-            batch_l.ep_index, batch_l.direction, j
-        ].add(hit_l4.astype(jnp.uint32))
-        l4_counts = jax.lax.psum(l4_counts, batch_axis)
-        l3_hit_here = p2_local & (v.match_kind == MATCH_L3) & valid_l
-        if l3_sharded:
-            # shard-LOCAL counters at the augmented local identity
-            # index (primary region [0, g), backup region [g, 2g) —
-            # the same routing as wl): each hit lands exactly once
-            # on its serving chip, so the global [E, 2, N] tensor is
-            # never materialized on device (it would be 32x the bit
-            # plane, replicated per chip — defeating the HBM
-            # sharding this plane exists for).  The host wrapper
-            # folds the per-chip regions back into the global
-            # counter whatever mix of primary/backup each row's
-            # survivor set routed.
-            g = wn * 32
-            lid = jnp.clip(idx - wp * g, 0, g - 1) + jnp.where(
-                apw, 0, g
-            )
-            l3_counts = jnp.zeros(
-                (e_count, 2, 2 * g), jnp.uint32
-            ).at[
-                batch_l.ep_index, batch_l.direction, lid
-            ].add(l3_hit_here.astype(jnp.uint32))
-        else:
-            # replicated fallback plane: p2_local is IDENTICAL on
-            # every table chip — count at the global index and take
-            # one copy (a table-axis psum would inflate every hit
-            # by tp)
-            l3_counts = jnp.zeros(
-                (e_count, 2, n_ids), jnp.uint32
-            ).at[
-                batch_l.ep_index, batch_l.direction,
-                jnp.clip(idx, 0, n_ids - 1),
-            ].add(l3_hit_here.astype(jnp.uint32))
-        l3_counts = jax.lax.psum(l3_counts, batch_axis)
-        served_backup = (
-            ((replica_exact | replica_l3) & valid_l).astype(
-                jnp.uint32
-            )
-        )
+        served_backup = (lat["replica"] & valid_l).astype(jnp.uint32)
         replica_hits = jax.lax.psum(
             jax.lax.psum(jnp.sum(served_backup), batch_axis),
             table_axis,
@@ -1272,29 +1360,6 @@ def make_failover_evaluator(
     )
     aug_words = w_global * 2 if l3_sharded else w_global
 
-    def _fold_l3(l3_aug):
-        """[E, 2, ntp*2g] chip-major (primary region then backup
-        region per chip) → global [E, 2, N]: slice p reassembles
-        from chip p's primary region + chip (p+offset)'s backup
-        region.  Rows whose owner moved were counted in the backup
-        region, so summing both regions is exact whatever mix each
-        mesh row's survivor set routed."""
-        import numpy as np
-
-        a = np.asarray(l3_aug)
-        g = a.shape[-1] // (2 * ntp)
-        blocks = a.reshape(a.shape[0], a.shape[1], ntp, 2 * g)
-        back = np.roll(
-            blocks[..., g:],
-            -partition.REPLICA_BACKUP_OFFSET,
-            axis=2,
-        )
-        return np.ascontiguousarray(
-            (blocks[..., :g] + back).reshape(
-                a.shape[0], a.shape[1], ntp * g
-            )
-        )
-
     def run(tables_aug: PolicyTables, batch: TupleBatch, alive,
             valid):
         if tables_aug.l4_hash_rows is None:
@@ -1315,7 +1380,7 @@ def make_failover_evaluator(
             )
         out = jitted(tables_aug, batch, alive, valid)
         if l3_sharded:
-            out = (out[0], out[1], _fold_l3(out[2])) + tuple(
+            out = (out[0], out[1], fold_l3_aug(out[2], ntp)) + tuple(
                 out[3:]
             )
         return out

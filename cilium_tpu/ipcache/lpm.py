@@ -293,6 +293,73 @@ def _build_range_rows(base, mask, plen, value, l3_in=None, l3_out=None):
     return rows, plens
 
 
+def range_class_key(ips, sp):
+    """(masked ips, row hash) of one range-length-class probe —
+    shared by the single-chip and routed (mesh) range probes."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.engine.hashtable import fnv1a_device
+
+    raw = int(sp) - 1
+    m = jnp.uint32(
+        (0xFFFFFFFF << (32 - raw)) & 0xFFFFFFFF if raw else 0
+    )
+    w0 = ips & m
+    w1 = jnp.full(ips.shape, jnp.uint32(sp), jnp.uint32)
+    h = fnv1a_device(jnp.stack([w0, w1], axis=1))
+    return w0, h
+
+
+def range_row_parts(row, w0, sp, planes, owns=None):
+    """Lane compares of one gathered range-class row, with an
+    optional ownership mask (the routed mesh probe gathers each row
+    on its owning shard only; an integer psum of these parts
+    reconstructs the single-chip class result).  Returns (hit [B],
+    val [B], l3_in [B], l3_out [B])."""
+    import jax.numpy as jnp
+
+    e = row.shape[1] // planes
+    hit = (row[:, :e] == w0[:, None]) & (
+        row[:, e : 2 * e] == jnp.uint32(sp)
+    )
+    if owns is not None:
+        hit = hit & owns[:, None]
+
+    def msum(p):
+        return jnp.sum(
+            jnp.where(hit, row[:, p * e : (p + 1) * e], 0),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+
+    zero = jnp.zeros(w0.shape, jnp.uint32)
+    return (
+        jnp.any(hit, axis=1),
+        msum(2),
+        msum(3) if planes == 5 else zero,
+        msum(4) if planes == 5 else zero,
+    )
+
+
+def range_take_fold(classes, shape):
+    """Longest-first selection over per-class (hit, val, l3i, l3o)
+    results — the shared terminal step of the hashed range probe
+    (`classes` ordered longest first, exactly the class schedule)."""
+    import jax.numpy as jnp
+
+    found = jnp.zeros(shape, bool)
+    val = jnp.zeros(shape, jnp.uint32)
+    l3i = jnp.zeros(shape, jnp.uint32)
+    l3o = jnp.zeros(shape, jnp.uint32)
+    for hitc, v, li, lo in classes:
+        take = hitc & ~found
+        val = jnp.where(take, v, val)
+        l3i = jnp.where(take, li, l3i)
+        l3o = jnp.where(take, lo, l3o)
+        found = found | hitc
+    return found, val, l3i, l3o
+
+
 def _range_hash_probe(dev: "IPCacheDevice", ips):
     """Device half of the hashed range classes: one row gather +
     lane compares per distinct prefix length (≤ RANGE_CLASS_MAX),
@@ -300,44 +367,15 @@ def _range_hash_probe(dev: "IPCacheDevice", ips):
     l3_out [B]) — the same selection the broadcast scan computes."""
     import jax.numpy as jnp
 
-    from cilium_tpu.engine.hashtable import fnv1a_device
-
     rows = jnp.asarray(dev.range_rows)
     planes = 5 if dev.l3_planes else 3
-    e = rows.shape[1] // planes
     n_rows = rows.shape[0]
-    found = jnp.zeros(ips.shape, bool)
-    val = jnp.zeros(ips.shape, jnp.uint32)
-    l3i = jnp.zeros(ips.shape, jnp.uint32)
-    l3o = jnp.zeros(ips.shape, jnp.uint32)
+    classes = []
     for sp in dev.range_class_plens:  # static schedule, longest first
-        raw = int(sp) - 1
-        m = jnp.uint32(
-            (0xFFFFFFFF << (32 - raw)) & 0xFFFFFFFF if raw else 0
-        )
-        w0 = ips & m
-        w1 = jnp.full(ips.shape, jnp.uint32(sp), jnp.uint32)
-        h = fnv1a_device(jnp.stack([w0, w1], axis=1))
+        w0, h = range_class_key(ips, sp)
         row = rows[(h & jnp.uint32(n_rows - 1)).astype(jnp.int32)]
-        hit = (row[:, :e] == w0[:, None]) & (
-            row[:, e : 2 * e] == jnp.uint32(sp)
-        )
-        hitc = jnp.any(hit, axis=1)
-
-        def msum(p, hit=hit, row=row):
-            return jnp.sum(
-                jnp.where(hit, row[:, p * e : (p + 1) * e], 0),
-                axis=1,
-                dtype=jnp.uint32,
-            )
-
-        take = hitc & ~found
-        val = jnp.where(take, msum(2), val)
-        if planes == 5:
-            l3i = jnp.where(take, msum(3), l3i)
-            l3o = jnp.where(take, msum(4), l3o)
-        found = found | hitc
-    return found, val, l3i, l3o
+        classes.append(range_row_parts(row, w0, sp, planes))
+    return range_take_fold(classes, ips.shape)
 
 
 def _trim_ip_stash(stash: np.ndarray, fill: int) -> np.ndarray:
@@ -642,6 +680,59 @@ def specialize_ipcache_to_idx(
     )
 
 
+def ipcache_bucket_parts(dev, rows, ips, ingress=None, owns=None):
+    """Exact-/32 probe parts from gathered bucket rows, with an
+    optional ownership mask (the routed mesh probe gathers each
+    bucket row on its owning shard only; an integer psum of these
+    parts reconstructs the single-chip result).  Returns (found [B],
+    val u32 [B], l3 u32 [B] — zeros unless the table carries l3
+    planes, selected by `ingress`)."""
+    import jax.numpy as jnp
+
+    per = 32 if dev.l3_planes else IP_ENTRIES_PER_BUCKET
+    hit = rows[:, :per] == ips[:, None]  # [B, per]
+    if owns is not None:
+        hit = hit & owns[:, None]
+
+    def msum(plane):  # masked extraction of a planar word
+        return jnp.sum(
+            jnp.where(hit, plane, 0), axis=1, dtype=jnp.uint32
+        )
+
+    val = msum(rows[:, per : 2 * per])
+    l3 = jnp.zeros(ips.shape, jnp.uint32)
+    if dev.l3_planes:
+        l3_plane = jnp.where(
+            jnp.asarray(ingress)[:, None],
+            rows[:, 2 * per : 3 * per],
+            rows[:, 3 * per : 4 * per],
+        )
+        l3 = msum(l3_plane)
+    return jnp.any(hit, axis=1), val, l3
+
+
+def ipcache_stash_parts(dev, ips, ingress=None):
+    """Stash half of the exact probe (replicated on a mesh — added
+    AFTER the row-part psum).  Same return contract as
+    ipcache_bucket_parts."""
+    import jax.numpy as jnp
+
+    stash = jnp.asarray(dev.stash)
+    s_hit = stash[None, :, 0] == ips[:, None]
+
+    def ssum(col):
+        return jnp.sum(
+            jnp.where(s_hit, stash[None, :, col], 0),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+
+    l3 = jnp.zeros(ips.shape, jnp.uint32)
+    if dev.l3_planes:
+        l3 = jnp.where(jnp.asarray(ingress), ssum(2), ssum(3))
+    return jnp.any(s_hit, axis=1), ssum(1), l3
+
+
 def ipcache_lookup_fused(dev: IPCacheDevice, ips, ingress=None):
     """Batched ipcache lookup: one bucket row gather + stash/range
     broadcasts.  Returns (value u32 [B]; 0 = miss, l3_word u32 [B] or
@@ -656,28 +747,14 @@ def ipcache_lookup_fused(dev: IPCacheDevice, ips, ingress=None):
     h = fnv1a_device(ips[:, None])
     bucket = (h & jnp.uint32(dev.n_buckets - 1)).astype(jnp.int32)
     rows = jnp.asarray(dev.buckets)[bucket]  # [B, 128] — 1 gather
-    per = 32 if dev.l3_planes else IP_ENTRIES_PER_BUCKET
-    hit = rows[:, :per] == ips[:, None]  # [B, per]
-    exact_found = jnp.any(hit, axis=1)
-
-    def msum(plane):  # masked extraction of a planar word
-        return jnp.sum(
-            jnp.where(hit, plane, 0), axis=1, dtype=jnp.uint32
-        )
-
-    exact_val = msum(rows[:, per : 2 * per])
-    stash = jnp.asarray(dev.stash)
-    s_hit = stash[None, :, 0] == ips[:, None]
-    exact_found = exact_found | jnp.any(s_hit, axis=1)
-
-    def ssum(col):
-        return jnp.sum(
-            jnp.where(s_hit, stash[None, :, col], 0),
-            axis=1,
-            dtype=jnp.uint32,
-        )
-
-    exact_val = exact_val + ssum(1)
+    b_found, b_val, b_l3 = ipcache_bucket_parts(
+        dev, rows, ips, ingress=ingress
+    )
+    s_found, s_val, s_l3 = ipcache_stash_parts(
+        dev, ips, ingress=ingress
+    )
+    exact_found = b_found | s_found
+    exact_val = b_val + s_val
 
     # ranges: longest matching prefix wins.  The hashed class table
     # resolves it in ≤ RANGE_CLASS_MAX row gathers (one per distinct
@@ -718,14 +795,7 @@ def ipcache_lookup_fused(dev: IPCacheDevice, ips, ingress=None):
     if not dev.l3_planes:
         return value, None
 
-    l3_plane = jnp.where(
-        jnp.asarray(ingress)[:, None],
-        rows[:, 2 * per : 3 * per],
-        rows[:, 3 * per : 4 * per],
-    )
-    l3_exact = msum(l3_plane) + jnp.where(
-        jnp.asarray(ingress), ssum(2), ssum(3)
-    )
+    l3_exact = b_l3 + s_l3
     l3_range = jnp.where(jnp.asarray(ingress), r_l3i, r_l3o)
     l3 = jnp.where(
         exact_found, l3_exact, jnp.where(range_found, l3_range, 0)

@@ -271,50 +271,32 @@ def flow_hash(saddr, daddr, sport, dport, proto):
     return fnv1a_device(words)
 
 
-def _lb_select_inline(
-    tables: "LBInline",
-    saddr,
-    daddr,
-    sport,
-    dport,
-    proto,
-    ct_slave=None,
-):
-    """Inline-layout select: ONE row gather resolves the service AND
-    its backends; the matching 64-lane slot is combined in-register."""
+def lb_service_key(daddr, dport, proto):
+    """(vip, w1) compare words of the service probe — shared by the
+    single-chip and routed (mesh) selects."""
     import jax.numpy as jnp
 
     vip = daddr.astype(jnp.uint32)
     w1 = ((dport.astype(jnp.uint32) & 0xFFFF) << 16) | (
         proto.astype(jnp.uint32) & 0xFF
     )
-    h = fnv1a_device(jnp.stack([vip, w1], axis=1))
-    bucket = (h & jnp.uint32(tables.n_buckets - 1)).astype(jnp.int32)
-    rows = jnp.asarray(tables.rows)[bucket]  # [B, 128] — THE gather
-    half = rows.reshape(-1, 2, INLINE_SLOT)  # [B, 2, 64]
-    hit2 = (half[:, :, 0] == vip[:, None]) & (
-        half[:, :, 1] == w1[:, None]
-    )  # [B, 2]
-    slot = jnp.sum(
-        jnp.where(hit2[:, :, None], half, 0), axis=1, dtype=jnp.uint32
-    )  # [B, 64]
-    stash = jnp.asarray(tables.stash)  # [S, 64]
-    s_hit = (stash[None, :, 0] == vip[:, None]) & (
-        stash[None, :, 1] == w1[:, None]
-    )  # [B, S]
-    slot = slot + jnp.sum(
-        jnp.where(s_hit[:, :, None], stash[None, :, :], 0),
-        axis=1,
-        dtype=jnp.uint32,
-    )
-    found = jnp.any(hit2, axis=1) | jnp.any(s_hit, axis=1)
+    return vip, w1
+
+
+def lb_slot_outputs(slot, found, fh, ct_slave=None):
+    """Backend selection from a resolved 64-lane inline service slot.
+    Returns RAW outputs (found, slave, new_daddr, new_dport, rev_nat)
+    with every column zero-masked by `found` and the not-found
+    passthrough NOT applied — so two disjoint slot sources (a bucket
+    row on its owning mesh shard, the replicated stash) sum exactly,
+    and the caller applies the passthrough once after combining."""
+    import jax.numpy as jnp
 
     meta = slot[:, 2]
     count = (meta & 0xFFFF).astype(jnp.int32)
     rev_nat = (meta >> 16).astype(jnp.int32)
     found = found & (count > 0)
 
-    fh = flow_hash(saddr, daddr, sport, dport, proto)
     slave = (fh % jnp.maximum(count, 1).astype(jnp.uint32)).astype(
         jnp.int32
     ) + 1
@@ -346,11 +328,78 @@ def _lb_select_inline(
     new_dport = (
         (port_pair >> (16 * (k & 1)).astype(jnp.uint32)) & 0xFFFF
     ).astype(jnp.int32)
+    return (
+        found,
+        jnp.where(found, slave, 0),
+        jnp.where(found, new_daddr, 0),
+        jnp.where(found, new_dport, 0),
+        jnp.where(found, rev_nat, 0),
+    )
 
+
+def lb_inline_slot(rows, vip, w1, owns=None):
+    """Resolve the matching 64-lane service slot from gathered
+    inline bucket rows (with an optional ownership mask for the
+    routed mesh probe).  Returns (slot u32 [B, 64], found [B])."""
+    import jax.numpy as jnp
+
+    half = rows.reshape(-1, 2, INLINE_SLOT)  # [B, 2, 64]
+    hit2 = (half[:, :, 0] == vip[:, None]) & (
+        half[:, :, 1] == w1[:, None]
+    )  # [B, 2]
+    if owns is not None:
+        hit2 = hit2 & owns[:, None]
+    slot = jnp.sum(
+        jnp.where(hit2[:, :, None], half, 0), axis=1, dtype=jnp.uint32
+    )  # [B, 64]
+    return slot, jnp.any(hit2, axis=1)
+
+
+def lb_inline_stash_slot(tables, vip, w1):
+    """Stash half of the inline service probe (replicated on a mesh
+    — computed once per shard, added after the row-part psum)."""
+    import jax.numpy as jnp
+
+    stash = jnp.asarray(tables.stash)  # [S, 64]
+    s_hit = (stash[None, :, 0] == vip[:, None]) & (
+        stash[None, :, 1] == w1[:, None]
+    )  # [B, S]
+    slot = jnp.sum(
+        jnp.where(s_hit[:, :, None], stash[None, :, :], 0),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    return slot, jnp.any(s_hit, axis=1)
+
+
+def _lb_select_inline(
+    tables: "LBInline",
+    saddr,
+    daddr,
+    sport,
+    dport,
+    proto,
+    ct_slave=None,
+):
+    """Inline-layout select: ONE row gather resolves the service AND
+    its backends; the matching 64-lane slot is combined in-register."""
+    import jax.numpy as jnp
+
+    vip, w1 = lb_service_key(daddr, dport, proto)
+    h = fnv1a_device(jnp.stack([vip, w1], axis=1))
+    bucket = (h & jnp.uint32(tables.n_buckets - 1)).astype(jnp.int32)
+    rows = jnp.asarray(tables.rows)[bucket]  # [B, 128] — THE gather
+    slot, row_found = lb_inline_slot(rows, vip, w1)
+    s_slot, s_found = lb_inline_stash_slot(tables, vip, w1)
+    slot = slot + s_slot
+    found = row_found | s_found
+
+    fh = flow_hash(saddr, daddr, sport, dport, proto)
+    found, slave, new_daddr, new_dport, rev_nat = lb_slot_outputs(
+        slot, found, fh, ct_slave
+    )
     new_daddr = jnp.where(found, new_daddr, daddr.astype(jnp.uint32))
     new_dport = jnp.where(found, new_dport, dport.astype(jnp.int32))
-    rev_nat = jnp.where(found, rev_nat, 0)
-    slave = jnp.where(found, slave, 0)
     return found, slave, new_daddr, new_dport, rev_nat
 
 

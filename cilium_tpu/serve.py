@@ -162,7 +162,7 @@ class _Submission:
 class _Tenant:
     __slots__ = (
         "name", "weight", "queue", "backlog", "deficit",
-        "admitted", "shed", "dispatched",
+        "admitted", "shed", "dispatched", "cache_hits", "served",
     )
 
     def __init__(self, name: str, weight: float = 1.0) -> None:
@@ -174,6 +174,10 @@ class _Tenant:
         self.admitted = 0
         self.shed = 0
         self.dispatched = 0
+        # verdict-cache hits among this tenant's SERVED flows (the
+        # cross-tenant memo plane's per-tenant observability)
+        self.cache_hits = 0
+        self.served = 0
 
 
 class ServingPlane:
@@ -194,8 +198,17 @@ class ServingPlane:
         async_depth: Optional[int] = None,
         tenant_weights: Optional[Dict[str, float]] = None,
         quantum: Optional[int] = None,
+        fused: bool = False,
     ) -> None:
         self.daemon = daemon
+        # fused serving: coalesced batches carry the RAW 5-tuple
+        # columns (saddr/daddr/sport ride the staged batch) and
+        # dispatch through the attached ChipFailoverRouter's fused
+        # datapath plane (router.dispatch_flows) — the FULL pipeline
+        # (prefilter + LB/DNAT + CT + ipcache + lattice) served over
+        # the partitioned N+1 tables, replica gathers and all.
+        # Requires daemon.attach_mesh_router + router.attach_datapath.
+        self.fused = bool(fused)
         self.batch_size = int(
             batch_size
             if batch_size is not None
@@ -648,14 +661,20 @@ class ServingPlane:
         dict + bookkeeping meta.  Applies the AdmissionGate: a plan
         the gate refuses is shed whole (exactly-once Overload per
         flow, replies complete with shed_mask set)."""
+        fields = (
+            "ep_id", "identity", "dport", "proto",
+            "direction", "is_fragment",
+        )
+        if self.fused:
+            # the fused pipeline consumes the raw 5-tuple: the
+            # address/sport columns every decoded record already
+            # carries ride the staged batch
+            fields = fields + ("saddr", "daddr", "sport")
         cols = {
             f: np.concatenate(
                 [sub.rec[f][s:e] for sub, s, e in spans]
             )
-            for f in (
-                "ep_id", "identity", "dport", "proto",
-                "direction", "is_fragment",
-            )
+            for f in fields
         }
         valid = len(cols["ep_id"])
         if not self.daemon.admission.reserve(valid):
@@ -716,6 +735,10 @@ class ServingPlane:
             cols["ep_id"], np.fromiter(index, dtype=np.int64)
         )
         meta["stale"] = stale if stale.any() else None
+        if self.fused:
+            # the fused router path packs/pads internally (its
+            # batch re-split owns the padding); nothing to stage
+            return (meta, tables, None)
         b = self.batch_size
 
         def pad(a, fill=0):
@@ -739,11 +762,41 @@ class ServingPlane:
         """Device half: the daemon's guarded dispatch — breaker +
         retry + watchdog, the memo plane, and the mesh router when
         one is attached (non-blocking enqueue on the single-chip
-        path; the drain reads the columns one batch behind)."""
+        path; the drain reads the columns one batch behind).  In
+        fused mode the batch goes through the router's FULL fused
+        pipeline instead (dispatch_flows: prefilter + LB/DNAT + CT +
+        ipcache + lattice over the partitioned N+1 tables)."""
         cols = meta["cols"]
         ep_idx = meta["ep_idx"]
         host_states = meta["snap"][3]
         valid = meta["valid"]
+        if self.fused:
+            router = self.daemon.mesh_router
+            if router is None or router.dp_store is None:
+                raise RuntimeError(
+                    "fused serving requires an attached mesh "
+                    "router with a published datapath epoch "
+                    "(attach_mesh_router + attach_datapath)"
+                )
+            res = router.dispatch_flows(
+                ep_index=ep_idx,
+                saddr=cols["saddr"],
+                daddr=cols["daddr"],
+                sport=cols["sport"].astype(np.int32),
+                dport=cols["dport"].astype(np.int32),
+                proto=cols["proto"].astype(np.int32),
+                direction=cols["direction"].astype(np.int32),
+                is_fragment=cols["is_fragment"].astype(bool),
+            )
+            meta["degraded"] = res.degraded
+            meta["fused_result"] = res
+            return (
+                res.verdicts.allowed,
+                res.verdicts.match_kind,
+                res.verdicts.proxy_port,
+                None,
+                None,
+            )
 
         def host_args():
             return (
@@ -771,11 +824,16 @@ class ServingPlane:
             host_cols=host_cols,
         )
         meta["degraded"] = degraded
+        # the (tables, batch) pair rides the meta so a drain-time
+        # memo overflow refusal can re-dispatch THIS batch uncached
+        meta["tables"] = tables
+        meta["batch"] = batch
         return (
             out.allowed,
             out.match_kind,
             out.proxy_port,
             getattr(out, "cache_hit", None),
+            getattr(out, "cache_stats", None),
         )
 
     def _shed_span(
@@ -829,6 +887,16 @@ class ServingPlane:
         ep_idx = meta.get("ep_idx")
         degraded = bool(meta.get("degraded"))
         try:
+            if exc is not None and self.fused:
+                # fused mode has no bit-identical host fold (the
+                # lattice fold computes a DIFFERENT function than
+                # the full pipeline) — error the replies instead of
+                # silently serving lattice verdicts as fused ones
+                for sub, _s, _e in spans:
+                    if not sub.result.done:
+                        sub.result.error = exc
+                        sub.result._event.set()
+                return
             if exc is not None:
                 # pack/enqueue/drain failure: the in-flight batch
                 # serves from the bit-identical host fold under the
@@ -889,7 +957,8 @@ class ServingPlane:
                     cache_hit=np.zeros(valid, bool),
                 )
             else:
-                allowed, match_kind, proxy_port, cache_hit = result
+                (allowed, match_kind, proxy_port, cache_hit,
+                 cache_stats) = result
                 v = SimpleNamespace(
                     allowed=np.asarray(allowed)[:valid],
                     match_kind=np.asarray(match_kind)[:valid],
@@ -900,6 +969,36 @@ class ServingPlane:
                         else np.asarray(cache_hit)[:valid]
                     ),
                 )
+                # deferred memo fold — THE shared drain seam
+                # (Daemon._fold_memo_drain), applied to the
+                # COALESCED multi-tenant batch: overflow refusal
+                # re-dispatches uncached, hit/miss accounting lands
+                # once corrected to the valid prefix
+                if cache_stats is not None:
+
+                    def _redispatch():
+                        def _ha():
+                            return (
+                                meta["snap"][3],
+                                ep_idx,
+                                cols["identity"],
+                                cols["dport"],
+                                cols["proto"],
+                                cols["direction"],
+                                cols["is_fragment"].astype(bool),
+                            )
+
+                        return self.daemon._dispatch_or_degrade(
+                            meta["tables"], meta["batch"], _ha,
+                            self.batch_size, use_memo=False,
+                        )
+
+                    v, deg2 = self.daemon._fold_memo_drain(
+                        cache_stats, v, valid,
+                        int(np.asarray(allowed).shape[0]),
+                        _redispatch,
+                    )
+                    degraded = degraded or deg2
             # -- the shared fold (monitor + flow + metrics) -----------
             snap = meta["snap"]
             version, _, index, _ = snap
@@ -975,6 +1074,32 @@ class ServingPlane:
             metrics.serve_batch_fill_pct.set(value=fill)
             self.batch_mix.append(meta["mix"])
             # -- demux to per-submission replies ----------------------
+            # per-tenant verdict-cache hits: the cross-tenant memo
+            # plane's observability — batch_mix rows carry each
+            # tenant's hit count beside its DRR share (one lock
+            # acquisition for the whole batch, not one per span)
+            off = 0
+            mix = meta["mix"]
+            tenant_stats: Dict[str, list] = {}
+            for sub, s, e in spans:
+                seg_hits = int(
+                    v.cache_hit[off : off + (e - s)].sum()
+                )
+                row = mix.get(sub.tenant)
+                if row is not None:
+                    row["cache_hits"] = (
+                        row.get("cache_hits", 0) + seg_hits
+                    )
+                agg = tenant_stats.setdefault(sub.tenant, [0, 0])
+                agg[0] += seg_hits
+                agg[1] += e - s
+                off += e - s
+            with self._lock:
+                for name, (hits, served) in tenant_stats.items():
+                    t = self._tenants.get(name)
+                    if t is not None:
+                        t.cache_hits += hits
+                        t.served += served
             off = 0
             for sub, s, e in spans:
                 n = e - s
@@ -1030,6 +1155,10 @@ class ServingPlane:
                     "admitted": t.admitted,
                     "dispatched": t.dispatched,
                     "shed": t.shed,
+                    "cache_hits": t.cache_hits,
+                    "cache_hit_rate": (
+                        t.cache_hits / t.served if t.served else 0.0
+                    ),
                 }
                 for t in self._tenants.values()
             }

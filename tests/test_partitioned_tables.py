@@ -170,9 +170,9 @@ def test_named_tree_map_real_key_paths():
 
 
 def test_ipcache_partition_specs_both_forms():
-    """The bucketized IPCacheDevice shards its /32 bucket plane; the
-    DIR-24-8 fallback form replicates everything (the rule table for
-    the ROADMAP's ipcache-plane sharding follow-on)."""
+    """The bucketized IPCacheDevice shards its /32 bucket plane AND
+    its hashed range-class rows (the fused-datapath family rules);
+    the DIR-24-8 fallback form replicates everything."""
     from cilium_tpu.ipcache.lpm import IPCacheDevice, build_ipcache, build_lpm
 
     dev = build_ipcache({"10.0.0.1/32": 7, "10.1.0.0/16": 9})
@@ -180,7 +180,7 @@ def test_ipcache_partition_specs_both_forms():
     specs = partition.ipcache_partition_specs(dev)
     assert specs.buckets == P("table")
     assert specs.stash == P()
-    assert specs.range_rows == P()
+    assert specs.range_rows == P("table")
 
     lpm = build_lpm({"10.0.0.1/32": 7})
     lpm_specs = partition.ipcache_partition_specs(lpm)

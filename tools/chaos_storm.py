@@ -539,6 +539,491 @@ def run_mesh_storm(
 
 
 # ---------------------------------------------------------------------------
+# fused-datapath storm: the FULL pipeline (prefilter + LB/DNAT + CT +
+# ipcache + lattice + counters + telemetry) over the partitioned N+1
+# tables, served through the router — ISSUE 11's acceptance gate
+# ---------------------------------------------------------------------------
+
+
+def _fused_world(seed: int, identity_pad: int = 256,
+                 n_ids: int = 48, n_eps: int = 3):
+    """Self-contained fused-datapath world: policy + /32-dense
+    ipcache (idx-specialized) + seeded CT + inline LB services +
+    prefilter.  Returns (dtables, parts) where parts carries the
+    mutable host state the churn steps re-compile from."""
+    import ipaddress
+
+    from cilium_tpu.compiler.tables import compile_map_states
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.ct.table import CTMap, CTTuple
+    from cilium_tpu.engine.datapath import DatapathTables
+    from cilium_tpu.ipcache.lpm import (
+        build_ipcache,
+        specialize_ipcache_to_idx,
+    )
+    from cilium_tpu.lb.device import compile_lb
+    from cilium_tpu.lb.service import L3n4Addr, ServiceManager
+    from cilium_tpu.maps.policymap import (
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+    from cilium_tpu.prefilter import build_prefilter
+
+    rng = np.random.default_rng(seed)
+    ids = [1, 2, 3, 4, 5] + [256 + i for i in range(n_ids - 5)]
+    states = []
+    for _ in range(n_eps):
+        st = {}
+        for _ in range(20):
+            d = int(rng.integers(0, 2))
+            port = int(rng.choice([53, 80, 443, 8080]))
+            proto = int(rng.choice([6, 17]))
+            proxy = 15001 if (port + proto + d) % 3 == 0 else 0
+            st[PolicyKey(int(rng.choice(ids)), port, proto, d)] = (
+                PolicyMapStateEntry(proxy_port=proxy)
+            )
+        for _ in range(8):
+            st[
+                PolicyKey(
+                    int(rng.choice(ids)), 0, 0,
+                    int(rng.integers(0, 2)),
+                )
+            ] = PolicyMapStateEntry()
+        states.append(st)
+    pol = compile_map_states(
+        states, ids, identity_pad=identity_pad, filter_pad=16
+    )
+    base = int(ipaddress.ip_address("10.0.0.1"))
+    ipc_map = {}
+    for i, num in enumerate(ids):
+        ipc_map[str(ipaddress.ip_address(base + i)) + "/32"] = num
+    ipc_map["172.16.0.0/12"] = ids[5]
+    ipc_map["192.168.4.0/24"] = ids[6]
+    ct = CTMap(max_entries=512)
+    for _ in range(48):
+        ct.create_best_effort(
+            CTTuple(
+                base + int(rng.integers(0, n_ids)),
+                base + int(rng.integers(0, n_ids)),
+                int(rng.choice([53, 80, 443, 8080])),
+                int(rng.integers(1024, 60000)),
+                int(rng.choice([6, 17])),
+            ),
+            int(rng.integers(0, 2)),
+            now=0,
+        )
+    mgr = ServiceManager()
+    mgr.upsert(
+        L3n4Addr("192.168.0.10", 80, 6),
+        [
+            L3n4Addr("10.0.0.5", 8080, 6),
+            L3n4Addr("10.0.0.6", 8080, 6),
+            L3n4Addr("10.0.0.7", 8080, 6),
+        ],
+    )
+    mgr.upsert(
+        L3n4Addr("192.168.0.11", 443, 6),
+        [L3n4Addr("10.0.0.8", 443, 6)],
+    )
+
+    def build(states=states, ids=ids):
+        p = compile_map_states(
+            states, ids, identity_pad=identity_pad, filter_pad=16
+        )
+        return DatapathTables(
+            prefilter=build_prefilter(["9.9.9.0/24"]),
+            ipcache=specialize_ipcache_to_idx(
+                build_ipcache(ipc_map), p
+            ),
+            ct=compile_ct(ct),
+            lb=compile_lb(mgr),
+            policy=p,
+        )
+
+    parts = {
+        "states": states, "ids": ids, "ipc_map": ipc_map,
+        "ct": ct, "mgr": mgr, "build": build, "base": base,
+        "n_eps": n_eps,
+    }
+    return build(), parts
+
+
+def _fused_flows(rng, b, parts):
+    base = parts["base"]
+    n_ids = len(parts["ids"])
+    saddr = np.where(
+        rng.random(b) < 0.08,
+        int(3154116608),  # 188.0.0.0 — outside every ipcache entry
+        base + rng.integers(0, n_ids + 8, size=b),
+    ).astype(np.uint32)
+    saddr = np.where(
+        rng.random(b) < 0.05, int(151587081), saddr
+    ).astype(np.uint32)  # 9.9.9.9 — prefiltered
+    daddr = np.where(
+        rng.random(b) < 0.25,
+        int(3232235530),  # 192.168.0.10 — the LB VIP
+        base + rng.integers(0, n_ids + 8, size=b),
+    ).astype(np.uint32)
+    return dict(
+        ep_index=rng.integers(0, parts["n_eps"], size=b),
+        saddr=saddr,
+        daddr=daddr,
+        sport=rng.integers(1024, 60000, size=b),
+        dport=rng.choice([53, 80, 443, 8080, 9999], size=b),
+        proto=rng.choice([6, 17], size=b),
+        direction=rng.integers(0, 2, size=b),
+        is_fragment=rng.random(size=b) < 0.05,
+    )
+
+
+_FUSED_COLS = (
+    "allowed", "proxy_port", "match_kind", "ct_result",
+    "pre_dropped", "sec_id", "final_daddr", "final_dport",
+    "rev_nat", "lb_slave", "ct_create", "ct_delete",
+    "tunnel_endpoint", "l4_slot", "ipcache_miss",
+)
+
+
+def _fused_reference(dtables, tuples, batch_size):
+    """Single-device fused reference stream (itself gated against
+    the composed host oracle by tests/test_datapath.py): per-field
+    concatenated columns + summed counters + telemetry totals."""
+    from cilium_tpu.engine.datapath import (
+        FlowBatch,
+        datapath_step_telem,
+        datapath_step_with_counters,
+    )
+
+    cols = {}
+    l4 = l3 = telem = None
+    n = len(tuples["ep_index"])
+    for start in range(0, n, batch_size):
+        sl = slice(start, min(start + batch_size, n))
+        fb = FlowBatch.from_numpy(
+            **{k: v[sl] for k, v in tuples.items()}
+        )
+        out, l4b, l3b = datapath_step_with_counters(dtables, fb)
+        _, trow = datapath_step_telem(dtables, fb)
+        for f in _FUSED_COLS:
+            cols.setdefault(f, []).append(
+                np.asarray(getattr(out, f))
+            )
+        l4 = np.asarray(l4b) if l4 is None else l4 + np.asarray(l4b)
+        l3 = np.asarray(l3b) if l3 is None else l3 + np.asarray(l3b)
+        t = np.asarray(trow).astype(np.uint64)
+        telem = t if telem is None else telem + t
+    return (
+        {f: np.concatenate(v) for f, v in cols.items()},
+        l4, l3, telem,
+    )
+
+
+def _fused_stream(router, tuples, batch_size):
+    cols = {}
+    counts = []
+    results = []
+    l4 = l3 = telem = None
+    n = len(tuples["ep_index"])
+    for start in range(0, n, batch_size):
+        sl = slice(start, min(start + batch_size, n))
+        res = router.dispatch_flows(
+            **{k: v[sl] for k, v in tuples.items()}
+        )
+        results.append(res)
+        counts.append(len(res.verdicts.allowed))
+        for f in _FUSED_COLS:
+            cols.setdefault(f, []).append(
+                np.asarray(getattr(res.verdicts, f))
+            )
+        if res.l4_counts is not None:
+            l4 = res.l4_counts if l4 is None else l4 + res.l4_counts
+            l3 = res.l3_counts if l3 is None else l3 + res.l3_counts
+        if res.telemetry is not None:
+            t = res.telemetry.astype(np.uint64).sum(axis=0)
+            telem = t if telem is None else telem + t
+    return (
+        {f: np.concatenate(v) for f, v in cols.items()},
+        l4, l3, telem, counts, results,
+    )
+
+
+def _assert_fused_equal(want, got, tag):
+    for f in _FUSED_COLS:
+        np.testing.assert_array_equal(
+            want[0][f], got[0][f],
+            err_msg=f"{tag}: fused stream diverged in {f}",
+        )
+    np.testing.assert_array_equal(
+        want[1], got[1], err_msg=f"{tag}: l4 counters"
+    )
+    np.testing.assert_array_equal(
+        want[2], got[2], err_msg=f"{tag}: l3 counters"
+    )
+    np.testing.assert_array_equal(
+        want[3], got[3], err_msg=f"{tag}: telemetry totals"
+    )
+
+
+def _assert_datapath_resident_equals_host(router, dtables, ntp):
+    """Every chip's resident slice of each sharded datapath plane
+    equals the owning slice of the augmented host compile."""
+    from cilium_tpu.compiler import partition
+
+    aug = partition.replicate_datapath_leaves(dtables, ntp)
+    dev = router.dp_store.current()
+    pos = {
+        int(d.id): tuple(idx)
+        for idx, d in np.ndenumerate(router.mesh.devices)
+    }
+    rep = partition.datapath_all_replica_axes(dtables, ntp)
+    for (fam, name), axis in rep.items():
+        h = np.asarray(getattr(getattr(aug, fam), name))
+        d = getattr(getattr(dev, fam), name)
+        np.testing.assert_array_equal(
+            np.asarray(d), h, err_msg=f"{fam}.{name} global"
+        )
+        per = h.shape[axis] // ntp
+        for sh in d.addressable_shards:
+            colp = pos[int(sh.device.id)][1]
+            sl = [slice(None)] * h.ndim
+            sl[axis] = slice(colp * per, (colp + 1) * per)
+            np.testing.assert_array_equal(
+                np.asarray(sh.data), h[tuple(sl)],
+                err_msg=f"{fam}.{name} shard dev {sh.device.id}",
+            )
+
+
+def run_mesh_fused_storm(
+    tp: int = 4,
+    n_flows: int = 1024,
+    batch_size: int = 256,
+    seed: int = 7,
+    verbose: bool = True,
+) -> dict:
+    """ISSUE 11 acceptance: the FULL fused datapath (prefilter +
+    LB/DNAT + CT + ipcache + lattice + counters + telemetry) served
+    through the router over the partitioned N+1 tables — healthy
+    stream bit-identical to the single-device fused reference → one
+    chip killed mid-stream stays bit-identical with replica gathers
+    and NO host-fold fallback → CT/ipcache churn publishes ride the
+    row-diff delta path while the chip is out → re-admission repairs
+    the chip's datapath slices with bytes ≪ a full upload and every
+    resident slice equal to the host compile."""
+    import dataclasses
+
+    import jax
+
+    from cilium_tpu import faultinject
+    from cilium_tpu.engine.datapath import apply_ct_writeback_host
+    from cilium_tpu.engine.failover import ChipFailoverRouter
+    from cilium_tpu.ipcache.lpm import (
+        build_ipcache,
+        specialize_ipcache_to_idx,
+    )
+    from cilium_tpu.metrics import registry as metrics
+    from cilium_tpu.resilience import ChipBreakerBank
+
+    devs = jax.devices()
+    assert len(devs) % tp == 0, (len(devs), tp)
+    dp = len(devs) // tp
+    mesh = jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+    rng = np.random.default_rng(seed)
+    dtables, parts = _fused_world(seed)
+    bank = ChipBreakerBank(
+        recovery_timeout=0.02, failure_threshold=1
+    )
+    router = ChipFailoverRouter(
+        mesh, dtables.policy, bank=bank, collect_telemetry=True,
+    )
+    router.publish(dtables.policy)
+    router.attach_datapath(dtables)
+    tuples = _fused_flows(rng, n_flows, parts)
+
+    # ---- healthy stream vs the single-device fused reference -----------
+    want = _fused_reference(dtables, tuples, batch_size)
+    got = _fused_stream(router, tuples, batch_size)
+    assert sum(got[4]) == n_flows
+    _assert_fused_equal(want, got, f"tp={tp} healthy")
+    assert router.stats.degraded_batches == 0
+
+    # ---- kill one chip mid-stream --------------------------------------
+    victim = int(router.ordinals[dp - 1, tp - 1])
+    replica_before = metrics.replica_gather_total.get()
+    faultinject.arm("engine.dispatch", f"raise:chip={victim}")
+    try:
+        dead = _fused_stream(router, tuples, batch_size)
+    finally:
+        faultinject.disarm("engine.dispatch")
+    assert dead[4] == got[4]
+    assert router.stats.degraded_batches == 0, (
+        "fused storm must serve from replicas, not the host fold"
+    )
+    _assert_fused_equal(want, dead, f"tp={tp} one chip dead")
+    assert bank.state(victim) != "closed"
+    if tp > 1:
+        assert metrics.replica_gather_total.get() > replica_before
+
+    # ---- CT/ipcache churn while the chip is out (delta path) -----------
+    full = router.dp_store.full_bytes()
+    n_delta = 0
+    churn_bytes = 0
+    for step in range(3):
+        # CT writeback from real dispatch outputs + an ipcache upsert
+        v = dead[0]
+        apply_ct_writeback_host(
+            parts["ct"],
+            v["ct_create"], v["ct_delete"], v["final_daddr"],
+            v["final_dport"], tuples["saddr"], tuples["sport"],
+            tuples["proto"], tuples["direction"], v["rev_nat"],
+            v["lb_slave"], now=step + 1,
+            orig_daddr=tuples["daddr"], orig_dport=tuples["dport"],
+        )
+        parts["ipc_map"][f"10.77.0.{step + 1}/32"] = parts["ids"][
+            (step + 1) % len(parts["ids"])
+        ]
+        dtables = parts["build"]()
+        _, st = router.publish_datapath(dtables)
+        churn_bytes += st.bytes_h2d
+        if st.mode == "delta":
+            n_delta += 1
+        assert st.bytes_h2d < full / 10, (
+            f"churn step {step}: {st.bytes_h2d} B ≥ full/10 "
+            f"({full} B full)"
+        )
+    assert n_delta == 3, "churn fell off the delta path"
+
+    # ---- re-admission repairs the datapath slices ----------------------
+    time.sleep(bank.recovery_timeout * 2)
+    want2 = _fused_reference(dtables, tuples, batch_size)
+    after = _fused_stream(router, tuples, batch_size)
+    assert bank.state(victim) == "closed", bank.states()
+    readmitted = [
+        r for r in after[5] if victim in r.rebalanced_chips
+    ]
+    assert len(readmitted) == 1
+    reb = readmitted[0]
+    assert 0 < reb.rebalance_bytes < full, (
+        reb.rebalance_bytes, full,
+    )
+    _assert_fused_equal(want2, after, f"tp={tp} post-readmission")
+    _assert_datapath_resident_equals_host(router, dtables, tp)
+
+    result = {
+        "tp": tp,
+        "flows": n_flows,
+        "victim_chip": victim,
+        "replica_hits": router.stats.replica_hits,
+        "churn_delta_bytes": churn_bytes,
+        "full_upload_bytes": full,
+        "rebalance_bytes": reb.rebalance_bytes,
+        "chips": {str(k): v for k, v in bank.states().items()},
+    }
+    if verbose:
+        print(f"fused mesh storm (tp={tp}): all invariants held")
+        for k, v in result.items():
+            print(f"  {k}: {v}")
+    return result
+
+
+def run_fused_churn(
+    tp: int = 2,
+    steps: int = 60,
+    batch_size: int = 128,
+    seed: int = 13,
+    verbose: bool = True,
+) -> dict:
+    """The 60-step fused churn gate: every step mutates the CT map
+    (writeback from real dispatch outputs), upserts the ipcache, and
+    periodically flips an LB backend; every publish must ride the
+    row-diff delta path with bytes < full/10, every chip's resident
+    CT/ipcache/LB slice must equal the host compile's owning slice,
+    and the served stream stays bit-identical to the single-device
+    fused program over the CURRENT world."""
+    import jax
+
+    from cilium_tpu.engine.datapath import apply_ct_writeback_host
+    from cilium_tpu.engine.failover import ChipFailoverRouter
+    from cilium_tpu.lb.service import L3n4Addr
+    from cilium_tpu.resilience import ChipBreakerBank
+
+    devs = jax.devices()
+    assert len(devs) % tp == 0
+    dp = len(devs) // tp
+    mesh = jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+    rng = np.random.default_rng(seed)
+    dtables, parts = _fused_world(seed, n_ids=32)
+    router = ChipFailoverRouter(
+        mesh, dtables.policy,
+        bank=ChipBreakerBank(
+            recovery_timeout=0.02, failure_threshold=1
+        ),
+    )
+    router.publish(dtables.policy)
+    router.attach_datapath(dtables)
+    full = router.dp_store.full_bytes()
+    n_delta = 0
+    total_bytes = 0
+    for step in range(steps):
+        tuples = _fused_flows(rng, batch_size, parts)
+        res = router.dispatch_flows(**tuples)
+        want = _fused_reference(dtables, tuples, batch_size)
+        for f in _FUSED_COLS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(res.verdicts, f)), want[0][f],
+                err_msg=f"churn step {step}: {f}",
+            )
+        v = {
+            f: np.asarray(getattr(res.verdicts, f))
+            for f in _FUSED_COLS
+        }
+        apply_ct_writeback_host(
+            parts["ct"],
+            v["ct_create"], v["ct_delete"], v["final_daddr"],
+            v["final_dport"], tuples["saddr"], tuples["sport"],
+            tuples["proto"], tuples["direction"], v["rev_nat"],
+            v["lb_slave"], now=step + 1,
+            orig_daddr=tuples["daddr"], orig_dport=tuples["dport"],
+        )
+        if step % 3 == 0:
+            parts["ipc_map"][
+                f"10.88.{step // 250}.{step % 250}/32"
+            ] = parts["ids"][step % len(parts["ids"])]
+        if step % 10 == 5:
+            parts["mgr"].upsert(
+                L3n4Addr("192.168.0.10", 80, 6),
+                [
+                    L3n4Addr("10.0.0.5", 8080, 6),
+                    L3n4Addr(f"10.0.1.{step % 200}", 8080, 6),
+                ],
+            )
+        dtables = parts["build"]()
+        _, st = router.publish_datapath(dtables)
+        total_bytes += st.bytes_h2d
+        if st.mode == "delta":
+            n_delta += 1
+        assert st.bytes_h2d < full / 10, (
+            f"churn step {step}: {st.bytes_h2d} ≥ {full}/10"
+        )
+        _assert_datapath_resident_equals_host(router, dtables, tp)
+    assert n_delta == steps, (n_delta, steps)
+    result = {
+        "tp": tp, "steps": steps, "delta_publishes": n_delta,
+        "avg_delta_bytes": total_bytes // max(steps, 1),
+        "full_upload_bytes": full,
+    }
+    if verbose:
+        print(f"fused churn ({steps} steps, tp={tp}): all delta, "
+              f"all resident slices exact")
+        for k, v in result.items():
+            print(f"  {k}: {v}")
+    return result
+
+
+# ---------------------------------------------------------------------------
 # bursty multi-tenant arrival storm (the serving plane's fairness seam)
 # ---------------------------------------------------------------------------
 
@@ -744,6 +1229,12 @@ def main() -> int:
         # the stream bit-identical, re-admission rebalances
         for tp in (2, 4):
             run_mesh_storm(tp=tp)
+        # ISSUE 11: the FULL fused datapath over the partitioned N+1
+        # tables at every acceptance table-axis size, plus the
+        # 60-step churn gate on the row-diff delta path
+        for tp in (1, 2, 4):
+            run_mesh_fused_storm(tp=tp)
+        run_fused_churn(tp=2, steps=60)
         print("OK")
         return 0
     run_storm()

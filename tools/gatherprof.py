@@ -122,6 +122,32 @@ def main() -> None:
     assert hot_a < hot_b + cold_b, (
         "the split+pack must strictly reduce gathered bytes"
     )
+
+    # sharded-plane model: per-tuple HOT bytes are unchanged by the
+    # fused mesh sharding (each row gather still happens exactly
+    # once, on the owning chip); what routing ADDS is the small
+    # per-probe psum traffic — priced per shard count so the
+    # roofline comparison (gathered bytes vs collective bytes) is
+    # explicit for the CT/ipcache/LB planes too
+    from cilium_tpu.compiler import partition as pt
+
+    n_classes = len(
+        getattr(tables.ipcache, "range_class_plens", ()) or ()
+    )
+    print("sharded fused-datapath collective model:")
+    for ns in (1, 4, 8):
+        aa = pt.datapath_alltoall_bytes_per_tuple(
+            ns, range_classes=n_classes
+        )
+        print(
+            f"  {ns} shards: {aa:5.0f} B/tuple psum traffic "
+            f"({100.0 * aa / max(hot_a, 1e-9):.1f}% of the "
+            f"{hot_a:.0f} B hot gathers)"
+        )
+        assert aa < hot_a / 10, (
+            "routed-psum traffic must stay an order of magnitude "
+            "below the hot gathers"
+        )
     print("gatherprof OK")
 
 
