@@ -1130,69 +1130,115 @@ def run_config5(args) -> None:
         ),
     )
 
-    # --- HEADLINE: double-buffered async staging loop ----------------------
-    # The host stages batch N+1 ([4, B] u32 packed columns,
-    # jax.device_put) while the device computes batch N; results
-    # drain one batch behind (engine.publish.AsyncBatchDispatcher —
-    # the epoch ping-pong applied to batches).
     from cilium_tpu.engine.publish import AsyncBatchDispatcher
 
+    # --- sub-word hot planes: one layout stamp, gated ----------------------
+    # The headline world shrinks every hot gathered row to the bits
+    # the verdict actually reads (compact 2-word L4 entries, 4-word
+    # CT lanes, packed ipcache idx/l3/prefix-class planes) — applied
+    # where semantics allow, full-surface bit-identity gated below
+    # before a single timed tuple.
+    from cilium_tpu.engine.datapath import (
+        PersistentPairDispatcher,
+        subword_datapath_tables,
+    )
+
+    persist_k = max(int(args.persist_pairs), 1)
+    host_headline = DatapathTables(
+        prefilter=tables.prefilter,
+        ipcache=tables.ipcache,
+        ct=tables.ct,
+        lb=tables.lb,
+        policy=split_hot(
+            tables.policy if chosen_lanes == cur_lanes
+            else repack_hash_lanes(tables.policy, chosen_lanes)
+        ),
+    )
+    subword_report = {"disabled": "--no-subword"}
+    if not args.no_subword:
+        host_headline, subword_report = subword_datapath_tables(
+            host_headline
+        )
+    tables_headline = jax.device_put(host_headline)
+
+    # --- HEADLINE: persistent fused-pair program ---------------------------
+    # ONE launch evaluates --persist-pairs staged pair batches via a
+    # donated-carry lax.scan (zero per-pair dispatch, no
+    # per-direction launches); the counter/telemetry carry stays
+    # device-resident and commits once per drain.  The host stages
+    # super-batch N+1 while the device computes N (jax async
+    # dispatch — the launch returns immediately, the only sync is
+    # the final drain).
     half_h = chosen_bs // 2
     n_batches_h = max(args.tuples // chosen_bs, 1)
     host_pairs = _host_pairs_packed(
         np.random.default_rng(41), half_h, min(n_batches_h, 6)
     )
-    acc = jax.device_put(make_counter_buffers(tables.policy))
-    telem = jax.device_put(make_telemetry_buffers())
-    hstate = {"acc": acc, "telem": telem, "last": None}
 
-    def _h_dispatch(pair_dev):
-        o_i, o_e, hstate["acc"], hstate["telem"] = (
+    # bit-identity gate: the sub-word + persistent program against
+    # the reference per-pair program on the SAME pairs — all 14
+    # verdict columns + counters + telemetry, before any timing
+    gate_pairs = host_pairs[: min(len(host_pairs), persist_k + 1)]
+    acc_g = jax.device_put(make_counter_buffers(tables.policy))
+    tel_g = jax.device_put(make_telemetry_buffers())
+    pd_gate = PersistentPairDispatcher(
+        tables_headline, persist_k, acc_g, tel_g,
+        site="datapath.persistent",
+    )
+    got_pairs = []
+    for p in gate_pairs:
+        got_pairs.extend(pd_gate.submit(p))
+    rem, acc_g, tel_g = pd_gate.flush()
+    got_pairs.extend(rem)
+    acc_r = jax.device_put(make_counter_buffers(tables.policy))
+    tel_r = jax.device_put(make_telemetry_buffers())
+    ref_pairs = []
+    for p in gate_pairs:
+        r_i, r_e, acc_r, tel_r = (
             datapath_step_accum_pair_telem_packed4_stacked(
-                tables_chosen, pair_dev,
-                hstate["acc"], hstate["telem"],
+                tables_chosen, jax.device_put(p), acc_r, tel_r
             )
         )
-        hstate["last"] = (o_i, o_e)
-        return (o_i, o_e)
+        ref_pairs.append((r_i, r_e))
+    for (g_i, g_e), (r_i, r_e) in zip(got_pairs, ref_pairs):
+        for got, ref in ((g_i, r_i), (g_e, r_e)):
+            for col in (
+                "allowed", "proxy_port", "match_kind", "sec_id",
+                "ct_result", "pre_dropped", "final_daddr",
+                "final_dport", "rev_nat", "lb_slave", "ct_create",
+                "ct_delete", "l4_slot", "ipcache_miss",
+            ):
+                assert np.array_equal(
+                    np.asarray(getattr(got, col)),
+                    np.asarray(getattr(ref, col)),
+                ), f"sub-word/persistent divergence in {col}"
+    assert np.array_equal(np.asarray(pd_gate.acc), np.asarray(acc_r))
+    assert np.array_equal(np.asarray(pd_gate.telem), np.asarray(tel_r))
+    del pd_gate, acc_g, tel_g, acc_r, tel_r, got_pairs, ref_pairs
 
-    disp = AsyncBatchDispatcher(
-        pack_fn=lambda pair: (jax.device_put(pair),),
-        dispatch_fn=_h_dispatch,
-        depth=max(args.async_depth, 0),
+    # fresh carry so counter_hits/telemetry reflect exactly the
+    # timed tuples (the gate warmed both jit classes)
+    pdisp = PersistentPairDispatcher(
+        tables_headline, persist_k,
+        jax.device_put(make_counter_buffers(tables.policy)),
+        jax.device_put(make_telemetry_buffers()),
+        site="datapath.persistent",
     )
-    # warmup the chosen class (autotune already compiled it unless
-    # --no-autotune picked a fresh shape)
-    w_i, w_e, hstate["acc"], hstate["telem"] = (
-        datapath_step_accum_pair_telem_packed4_stacked(
-            tables_chosen,
-            jax.device_put(host_pairs[0]),
-            hstate["acc"], hstate["telem"],
-        )
-    )
-    jax.block_until_ready((w_i, w_e))
-    del w_i, w_e
-    # fresh accumulators so counter_hits/telemetry reflect exactly
-    # the timed tuples
-    hstate["acc"] = jax.device_put(make_counter_buffers(tables.policy))
-    hstate["telem"] = jax.device_put(make_telemetry_buffers())
+    hstate = {"last": None}
     bench_spans.span("async_dispatch").start()
     t0 = time.perf_counter()
     for i in range(n_batches_h):
-        drained = disp.submit((host_pairs[i % len(host_pairs)],))
-        for _, _, exc in drained:
-            if exc is not None:
-                raise exc
-    for _, _, exc in disp.flush():
-        if exc is not None:
-            raise exc
-    jax.block_until_ready((hstate["acc"], hstate["telem"]))
+        drained = pdisp.submit(host_pairs[i % len(host_pairs)])
+        if drained:
+            hstate["last"] = drained[-1]
+    rem, acc, telem = pdisp.flush()
+    if rem:
+        hstate["last"] = rem[-1]
+    jax.block_until_ready((acc, telem))
     dt = time.perf_counter() - t0
     bench_spans.span("async_dispatch").end()
     total = n_batches_h * chosen_bs
     vps = total / dt
-    acc = hstate["acc"]
-    telem = hstate["telem"]
     out_i, out_e = hstate["last"]
 
     # --- windowed batch latency + overlap efficiency -----------------------
@@ -1209,7 +1255,7 @@ def run_config5(args) -> None:
         b0 = time.perf_counter()
         s_i, s_e, acc_s, telem_s = (
             datapath_step_accum_pair_telem_packed4_stacked(
-                tables_chosen, dev_pair, acc_s, telem_s,
+                tables_headline, dev_pair, acc_s, telem_s,
             )
         )
         jax.block_until_ready((s_i, s_e))
@@ -1220,12 +1266,13 @@ def run_config5(args) -> None:
     p50_batch_s = metrics_registry.batch_duration.window_quantile(0.5)
     p99_batch_s = metrics_registry.batch_duration.window_quantile(0.99)
     device_est_s = float(np.median(sync_lat)) * n_batches_h
-    overlap_pct = disp.overlap_efficiency_pct(device_est_s)
+    overlap_pct = min(100.0, 100.0 * device_est_s / max(dt, 1e-9))
 
-    # gather-byte accounting: the bytes-moved model behind the split
-    profile = at.hot_gather_profile(tables_chosen, packed_io=True)
-    hot_bpt = at.hot_bytes_per_tuple(tables_chosen, packed_io=True)
-    cold_bpt = at.cold_bytes_per_tuple(tables_chosen)
+    # gather-byte accounting: the bytes-moved model behind the
+    # sub-word split (per-width per-leaf breakdown)
+    profile = at.hot_gather_profile(tables_headline, packed_io=True)
+    hot_bpt = at.hot_bytes_per_tuple(tables_headline, packed_io=True)
+    cold_bpt = at.cold_bytes_per_tuple(tables_headline)
 
     # --- scatter fold: device accumulators → host registry -----------------
     bench_spans.span("scatter_fold").start()
@@ -1560,14 +1607,19 @@ def run_config5(args) -> None:
         hot_bytes_per_tuple=round(hot_bpt, 1),
         gathered_gb_per_sec=round(vps * hot_bpt / 1e9, 1),
         overlap_efficiency_pct=round(overlap_pct, 1),
-        staging_pack_s=round(disp.pack_s, 3),
-        drain_block_s=round(disp.block_s, 3),
+        pair_mode="persistent",
+        persist_pairs=persist_k,
+        persistent_launches=pdisp.launches,
+        subword=subword_report,
         pipeline=(
-            "autotuned hot-plane pipeline: packed4 staged columns + "
-            "hot/cold-split tables through the instrumented paired "
-            "per-direction program (one dispatch, one merged counter "
-            "scatter, fused [2, T] telemetry), double-buffered async "
-            "staging overlapping host pack with device compute"
+            "sub-word hot planes (compact 2-word L4 entries, 4-word "
+            "CT lanes, packed ipcache idx/l3/prefix-class words) "
+            "through the PERSISTENT fused-pair program: one "
+            "donated-carry lax.scan launch per --persist-pairs pair "
+            "batches (zero per-pair dispatch, no per-direction "
+            "launches), carry committed once at drain; packed4 "
+            "staged columns, merged counter scatter, fused [2, T] "
+            "telemetry"
         ),
     )
 
@@ -3198,6 +3250,18 @@ def main() -> None:
         "--async-depth", type=int, default=2,
         help="batches in flight beyond the drain point in the "
         "double-buffered headline dispatch loop",
+    )
+    ap.add_argument(
+        "--no-subword", action="store_true",
+        help="skip the sub-word hot-plane transform (compact L4 / "
+        "CT / ipcache lanes) and run the headline on the 3-word "
+        "layouts",
+    )
+    ap.add_argument(
+        "--persist-pairs", type=int, default=4,
+        help="pair batches evaluated per launch by the persistent "
+        "fused-pair program (lax.scan super-batch); 1 = one launch "
+        "per pair, still no per-direction dispatch",
     )
     ap.add_argument(
         "--serve-batch", type=int, default=1 << 12,

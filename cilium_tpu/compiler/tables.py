@@ -108,16 +108,83 @@ def l4h_load(lanes: int) -> int:
 
 
 def trim_stash(stash: np.ndarray) -> np.ndarray:
-    """Trim a [L4H_STASH, 3] stash to the pow2 prefix that holds its
-    occupied rows (front-filled; empty rows carry w1 = 0xFFFFFFFF).
+    """Trim a [L4H_STASH, 3 or 2] stash to the pow2 prefix that holds
+    its occupied rows (front-filled; empty rows carry w1 = 0xFFFFFFFF
+    in the 3-word layout, cw1 = L4C_EMPTY_W1 in the compact one).
     The probe broadcast-compares EVERY stash lane against every tuple,
     so an empty stash shipped at capacity charges the hot path 64
     never-matching compares per table per tuple; verdicts are
     unchanged by construction (trimmed lanes can never match)."""
     from cilium_tpu.engine.hashtable import trim_pow2_prefix
 
-    used = int((stash[:, 1] != np.uint32(0xFFFFFFFF)).sum())
+    if stash.shape[-1] == 2:
+        used = int((stash[:, 1] != L4C_EMPTY_W1).sum())
+    else:
+        used = int((stash[:, 1] != np.uint32(0xFFFFFFFF)).sum())
     return trim_pow2_prefix(stash, used)
+
+
+# -- sub-word (compact, 2-word) L4 entries -----------------------------------
+# The 3-word entry spends a full u32 on `value = j << 16 | proxy`, but
+# the proxy port is ALREADY resident in the hot l4_meta plane at
+# [ep, d, j] (lower_map_state writes it there for every entry, and the
+# proxy-consistency check guarantees the two copies agree) — so the
+# sub-word form stores only the 12-bit slot index, folded into the
+# spare bits of key word 1 ("row metadata" packed beside the key):
+#
+#   cw0 = idx18            | (dport & 0x3FFF) << 18
+#   cw1 = dport >> 14      bits 0-1
+#         | proto << 2     bits 2-9
+#         | ep << 10       bits 10-17
+#         | dir << 18      bit  18
+#         | j << 19        bits 19-30   (VALUE, masked out of compares)
+#         bit 31 = 0; empty lanes hold cw1 = 0x80000000 (bit 31 set —
+#         unreachable for any real entry, the exact-marker discipline
+#         of the 3-word layout's key1 trick)
+#
+# 2 words/entry instead of 3 → the same bucket load fits a 32-lane row
+# (16 entries) where the 3-word layout needs 64 lanes: the dominant
+# lattice gathers halve again.  The probe reconstructs proxy with ONE
+# l4_meta element gather at the combined j (+4 B/tuple, priced by
+# gatherprof).  Semantics allow it only when idx < 2^18-1 (universe
+# ≤ 262142 padded identities), ep < 2^8, j < 2^12 — repack_l4_subword
+# verifies and refuses otherwise.  The stash ships 2-word entries too:
+# its width (2 vs 3) is the LAYOUT MARKER the kernels branch on
+# (l4_entry_words: a static jit-cache axis that travels with the
+# pytree, no aux-structure change).
+L4C_LANES = 32
+L4C_WILD_IDX18 = np.uint32((1 << 18) - 1)
+L4C_KEY_MASK = np.uint32((1 << 19) - 1)
+L4C_EMPTY_W1 = np.uint32(1 << 31)
+L4C_CMP_MASK = np.uint32(L4C_KEY_MASK | L4C_EMPTY_W1)
+
+
+def l4_entry_words(tables_or_stash) -> int:
+    """Entry word count of the hashed L4 layout (3 legacy, 2 compact)
+    read from the stash width — the shape-borne layout marker shared
+    by build, probe and the layout stamp."""
+    stash = getattr(tables_or_stash, "l4_hash_stash", tables_or_stash)
+    if stash is None:
+        return 3
+    return 2 if int(stash.shape[-1]) == 2 else 3
+
+
+def l4c_key0(idx, dport):
+    """Compact key word 0 (dtype-generic; build and probe share)."""
+    return (
+        (idx.astype(np.uint32) & np.uint32(0x3FFFF))
+        | ((dport.astype(np.uint32) & np.uint32(0x3FFF)) << np.uint32(18))
+    )
+
+
+def l4c_key1(dport, proto, ep, d):
+    """Compact key word 1, KEY BITS ONLY (j is ORed in at build)."""
+    return (
+        (dport.astype(np.uint32) >> np.uint32(14))
+        | ((proto.astype(np.uint32) & np.uint32(0xFF)) << np.uint32(2))
+        | ((ep.astype(np.uint32) & np.uint32(0xFF)) << np.uint32(10))
+        | ((d.astype(np.uint32) & np.uint32(1)) << np.uint32(18))
+    )
 
 
 def l4h_key0(idx, d, ep):
@@ -434,24 +501,15 @@ def tables_layout_version(tables) -> int:
     for i, leaf in enumerate(COLD_LEAVES):
         if getattr(tables, leaf, None) is None:
             cold_bits |= 1 << i
-    return lanes | (wlanes << 11) | (cold_bits << 22)
-
-
-def _hash_entry_cols(
-    rows: np.ndarray, stash: np.ndarray
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """(w0, w1, value) columns of every occupied entry of one hashed
-    table, in (bucket, lane) order then stash order."""
-    e = rows.shape[1] // 3
-    w0 = rows[:, :e].reshape(-1)
-    w1 = rows[:, e : 2 * e].reshape(-1)
-    val = rows[:, 2 * e : 3 * e].reshape(-1)
-    keep = w1 != np.uint32(0xFFFFFFFF)
-    skeep = stash[:, 1] != np.uint32(0xFFFFFFFF)
+    # sub-word marker: the compact 2-word entry form at the same lane
+    # count is a DIFFERENT layout (a delta recorded against one can
+    # never scatter into the other)
+    compact_bit = (
+        1 if l4_entry_words(tables) == 2 else 0
+    ) if getattr(tables, "l4_hash_stash", None) is not None else 0
     return (
-        np.concatenate([w0[keep], stash[skeep, 0]]),
-        np.concatenate([w1[keep], stash[skeep, 1]]),
-        np.concatenate([val[keep], stash[skeep, 2]]),
+        lanes | (wlanes << 11) | (cold_bits << 22)
+        | (compact_bit << 24)
     )
 
 
@@ -459,28 +517,218 @@ def repack_hash_lanes(
     tables: "PolicyTables", lanes: int
 ) -> "PolicyTables":
     """Re-place both hashed entry tables at a different hot-plane
-    pack width — the autotuner's layout knob.  Entry keys/values are
-    read back from the existing rows, so no compiler state is needed;
-    verdicts are identical by construction (probe hits are keyed, not
-    positional).  The result's layout stamp differs from the source
-    compiler's, so delta publication refuses it (full upload) — the
-    repacked layout is a dispatch-side choice, not a new compile."""
+    pack width IN THE 3-WORD LAYOUT — the autotuner's layout knob.
+    Entry fields are read back from the existing rows (either layout
+    — a compact input is expanded through l4_entry_records), so no
+    compiler state is needed; verdicts are identical by construction
+    (probe hits are keyed, not positional).  The result's layout
+    stamp differs from the source compiler's, so delta publication
+    refuses it (full upload) — the repacked layout is a
+    dispatch-side choice, not a new compile."""
     import dataclasses
 
     if tables.l4_hash_rows is None:
         raise ValueError("no hashed entry tables to repack")
+    recs = l4_entry_records(tables)
     out = {}
-    for rows_leaf, stash_leaf, min_rows in (
-        ("l4_hash_rows", "l4_hash_stash", 64),
-        ("l4_wild_rows", "l4_wild_stash", 16),
+    for key, rows_leaf, stash_leaf, min_rows in (
+        ("exact", "l4_hash_rows", "l4_hash_stash", 64),
+        ("wild", "l4_wild_rows", "l4_wild_stash", 16),
     ):
-        w0, w1, val = _hash_entry_cols(
-            np.asarray(getattr(tables, rows_leaf)),
-            np.asarray(getattr(tables, stash_leaf)),
-        )
+        r = recs[key]
+        w0 = l4h_key0(r["idx"], r["d"], r["ep"])
+        w1 = l4h_key1(r["dport"], r["proto"], r["ep"])
+        val = (r["j"] << np.uint32(16)) | r["proxy"]
         h = _fnv1a_host_2(w0, w1)
         rows, stash, _, _ = place_l4_hash(
             w0, w1, val, h, min_rows, lanes=lanes
+        )
+        out[rows_leaf] = rows
+        out[stash_leaf] = trim_stash(stash)
+    return dataclasses.replace(tables, **out)
+
+
+def place_l4_hash_compact(
+    cw0: np.ndarray,
+    cw1_key: np.ndarray,
+    j: np.ndarray,
+    h: np.ndarray,
+    min_rows: int,
+    lanes: int = L4C_LANES,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Compact sibling of place_l4_hash: 2-word planar entries (cw0
+    plane then cw1 plane, the slot index ORed into cw1's value bits).
+    Same sizing rule (lanes/8 target load, rows double until the
+    overflow fits the stash); returns (rows, stash untrimmed)."""
+    t = len(cw0)
+    entries = lanes // 2
+    cw1 = cw1_key | (j.astype(np.uint32) << np.uint32(19))
+    n_rows = _pow2_at_least(max(t // l4h_load(lanes), 1), min_rows)
+    while True:
+        b = (h & np.uint32(n_rows - 1)).astype(np.int64)
+        order = np.argsort(b, kind="stable")
+        sb = b[order]
+        first = np.searchsorted(sb, sb)
+        rank = np.arange(t, dtype=np.int64) - first
+        main = rank < entries
+        if int((~main).sum()) <= L4H_STASH:
+            break
+        n_rows <<= 1
+    rows = np.zeros((n_rows, lanes), dtype=np.uint32)
+    rows[:, entries : 2 * entries] = L4C_EMPTY_W1
+    flat = rows.reshape(-1)
+    mo = order[main]
+    base = sb[main] * lanes + rank[main]
+    flat[base] = cw0[mo]
+    flat[base + entries] = cw1[mo]
+    stash = np.zeros((L4H_STASH, 2), dtype=np.uint32)
+    stash[:, 1] = L4C_EMPTY_W1
+    so = order[~main]
+    stash[: len(so), 0] = cw0[so]
+    stash[: len(so), 1] = cw1[so]
+    return rows, stash
+
+
+def l4_entry_records(tables: "PolicyTables") -> Dict[str, dict]:
+    """Decode every realized hashed-pair entry — either layout — back
+    to its field columns: {"exact"/"wild": {ep, d, idx, dport, proto,
+    j, proxy}} (idx carries L4H_WILD_IDX for wildcard entries).  The
+    repack helpers rebuild ANY layout from these, so the autotuner can
+    sweep widths and forms without recompiling policy."""
+    if tables.l4_hash_rows is None:
+        raise ValueError("no hashed entry tables to decode")
+    ew = l4_entry_words(tables)
+    meta = np.asarray(tables.l4_meta)
+    out = {}
+    for key, rows_leaf, stash_leaf in (
+        ("exact", "l4_hash_rows", "l4_hash_stash"),
+        ("wild", "l4_wild_rows", "l4_wild_stash"),
+    ):
+        rows = np.asarray(getattr(tables, rows_leaf))
+        stash = np.asarray(getattr(tables, stash_leaf))
+        e = rows.shape[1] // ew
+        if ew == 3:
+            w0 = np.concatenate(
+                [rows[:, :e].reshape(-1), stash[:, 0]]
+            )
+            w1 = np.concatenate(
+                [rows[:, e : 2 * e].reshape(-1), stash[:, 1]]
+            )
+            val = np.concatenate(
+                [rows[:, 2 * e : 3 * e].reshape(-1), stash[:, 2]]
+            )
+            keep = w1 != np.uint32(0xFFFFFFFF)
+            w0, w1, val = w0[keep], w1[keep], val[keep]
+            # key1's low byte holds ep >> 9 (8 bits — build guards
+            # ep < 2^16, but decode the encoder's full field width)
+            ep = ((w0 >> 23) & 0x1FF) | ((w1 & 0xFF) << 9)
+            rec = {
+                "ep": ep.astype(np.uint32),
+                "d": ((w0 >> 22) & 1).astype(np.uint32),
+                "idx": (w0 & np.uint32(0x3FFFFF)).astype(np.uint32),
+                "dport": (w1 >> 16).astype(np.uint32),
+                "proto": ((w1 >> 8) & 0xFF).astype(np.uint32),
+                "j": (val >> 16).astype(np.uint32),
+                "proxy": (val & 0xFFFF).astype(np.uint32),
+            }
+        else:
+            cw0 = np.concatenate(
+                [rows[:, :e].reshape(-1), stash[:, 0]]
+            )
+            cw1 = np.concatenate(
+                [rows[:, e : 2 * e].reshape(-1), stash[:, 1]]
+            )
+            keep = (cw1 & L4C_EMPTY_W1) == 0
+            cw0, cw1 = cw0[keep], cw1[keep]
+            idx18 = cw0 & np.uint32(0x3FFFF)
+            idx = np.where(
+                idx18 == L4C_WILD_IDX18, L4H_WILD_IDX, idx18
+            ).astype(np.uint32)
+            ep = ((cw1 >> 10) & 0xFF).astype(np.uint32)
+            d = ((cw1 >> 18) & 1).astype(np.uint32)
+            j = ((cw1 >> 19) & 0xFFF).astype(np.uint32)
+            rec = {
+                "ep": ep,
+                "d": d,
+                "idx": idx,
+                "dport": (
+                    (cw0 >> 18) | ((cw1 & 3) << 14)
+                ).astype(np.uint32),
+                "proto": ((cw1 >> 2) & 0xFF).astype(np.uint32),
+                "j": j,
+                # proxy rides the l4_meta plane in the compact form
+                "proxy": (
+                    meta[
+                        ep.astype(np.int64), d.astype(np.int64),
+                        j.astype(np.int64),
+                    ]
+                    >> 1
+                ).astype(np.uint32),
+            }
+        out[key] = rec
+    return out
+
+
+def repack_l4_subword(
+    tables: "PolicyTables", lanes: int = L4C_LANES
+) -> "PolicyTables":
+    """Re-place both hashed entry tables in the SUB-WORD (2-word)
+    layout — nibble/byte-packed verdict lanes for the lattice probe.
+    Verdicts are identical by construction (keys compare exactly; the
+    proxy port is reconstructed from the l4_meta plane, which the
+    lowering keeps bit-equal to the entry's copy).  Raises ValueError
+    when the world's ranges don't fit the compact fields (universe
+    > 2^18-2 padded identities, > 256 endpoints, > 4096 L4 slots) —
+    semantics first, the caller keeps the 3-word layout then.  The
+    result's layout stamp differs, so delta publication refuses it
+    (full upload), exactly like repack_hash_lanes."""
+    import dataclasses
+
+    n = int(tables.id_table.shape[0])
+    e_count, _, kg = tables.l4_meta.shape
+    if n > (1 << 18) - 2:
+        raise ValueError(
+            f"identity axis {n} exceeds the compact 18-bit idx field"
+        )
+    if e_count > 256:
+        raise ValueError(
+            f"endpoint axis {e_count} exceeds the compact 8-bit field"
+        )
+    if kg > (1 << 12):
+        raise ValueError(
+            f"L4 slot axis {kg} exceeds the compact 12-bit field"
+        )
+    recs = l4_entry_records(tables)
+    meta = np.asarray(tables.l4_meta)
+    out = {}
+    for key, rows_leaf, stash_leaf, min_rows in (
+        ("exact", "l4_hash_rows", "l4_hash_stash", 64),
+        ("wild", "l4_wild_rows", "l4_wild_stash", 16),
+    ):
+        r = recs[key]
+        # the compact form DROPS the per-entry proxy copy: verify the
+        # l4_meta plane agrees (the lowering invariant) so the probe's
+        # reconstruction is provably exact
+        meta_proxy = (
+            meta[
+                r["ep"].astype(np.int64), r["d"].astype(np.int64),
+                r["j"].astype(np.int64),
+            ]
+            >> 1
+        )
+        if not np.array_equal(meta_proxy, r["proxy"]):
+            raise ValueError(
+                "entry proxy diverges from the l4_meta plane — "
+                "compact layout would change verdicts"
+            )
+        idx18 = np.where(
+            r["idx"] == L4H_WILD_IDX, L4C_WILD_IDX18, r["idx"]
+        ).astype(np.uint32)
+        cw0 = l4c_key0(idx18, r["dport"])
+        cw1k = l4c_key1(r["dport"], r["proto"], r["ep"], r["d"])
+        h = _fnv1a_host_2(cw0, cw1k)
+        rows, stash = place_l4_hash_compact(
+            cw0, cw1k, r["j"], h, min_rows, lanes=lanes
         )
         out[rows_leaf] = rows
         out[stash_leaf] = trim_stash(stash)

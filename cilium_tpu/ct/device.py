@@ -122,22 +122,46 @@ def _pack_entry(key: CTTuple, entry) -> Tuple[int, int, int, int, int]:
 
 @dataclass
 class CTSnapshot:
-    """Compiled CT: bucket rows + overflow stash (pytree; n_buckets is
-    static aux so churn rebuilds share one jit cache entry)."""
+    """Compiled CT: bucket rows + overflow stash (pytree; n_buckets
+    and the entry layout are static aux so churn rebuilds share one
+    jit cache entry and the probe branches at trace time).
 
-    buckets: "np.ndarray"  # u32 [Cb, 128]
+    `entry_words` selects the row layout: 5 = the legacy planar
+    5-word entries above; 4 = the SUB-WORD compact form
+    (compact_ct_snapshot) whose state/flags lane is packed to a
+    halfword beside the rev_nat/slave bytes:
+
+      w3c = (proto << 8 | swapped << 7 | flags) << 16
+            | rev_nat8 << 8 | slave8
+
+    — 4 words/entry, so the same bucket load fits a 64-lane row
+    (16 entries) instead of 128 lanes, halving the dominant CT
+    gather.  Empty lanes hold w3c with the state halfword 0xFFFF
+    (the packer verifies no real entry produces it)."""
+
+    buckets: "np.ndarray"  # u32 [Cb, 128 (legacy) | lanes (compact)]
     # u32 [S, ENTRY_WORDS]: the occupied pow2 prefix of the
     # STASH_ENTRIES-capacity overflow stash (trim_ct_stash) — empty
-    # at the default envelope, so S is 1 in the steady state
+    # at the default envelope, so S is 1 in the steady state.  The
+    # stash keeps the legacy 5-word layout in BOTH forms (it is a
+    # tiny broadcast compare, not a gather).
     stash: "np.ndarray"
     n_buckets: int
+    entry_words: int = ENTRY_WORDS
 
     def tree_flatten(self):
-        return ((self.buckets, self.stash), self.n_buckets)
+        return (
+            (self.buckets, self.stash),
+            (self.n_buckets, self.entry_words),
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux)
+        if isinstance(aux, tuple):
+            nb, ew = aux
+        else:  # pre-sub-word aux: bare bucket count
+            nb, ew = aux, ENTRY_WORDS
+        return cls(children[0], children[1], nb, ew)
 
 
 def _register_pytree() -> None:
@@ -347,6 +371,168 @@ def compile_ct(ct: CTMap) -> CTSnapshot:
     return CTBucketIndex(ct).full_snapshot()
 
 
+# compact (4-word) layout: empty-lane marker of the packed w3c word —
+# the state halfword 0xFFFF, which compact_ct_snapshot PROVES no real
+# entry produces before packing (exactness first, like _EMPTY_W3)
+_EMPTY_W3C = np.uint32(0xFFFF0000)
+CT_COMPACT_LANES = 64
+
+
+def _decode_per_bucket(snapshot: CTSnapshot):
+    """(per-bucket entry lists, stash entry list) of a snapshot,
+    every entry as its 5 LEGACY words.  Bucket membership is
+    preserved verbatim — crucial for the dual-homed DNAT copies,
+    whose pre-DNAT home is NOT the hash of their stored tuple."""
+    ew = snapshot.entry_words
+    rows = np.asarray(snapshot.buckets)
+    n_e = rows.shape[1] // ew
+    per_bucket = []
+    for b in range(snapshot.n_buckets):
+        row = rows[b]
+        entries = []
+        for k in range(n_e):
+            w3p = row[3 * n_e + k]
+            if ew == ENTRY_WORDS:
+                if w3p == _EMPTY_W3:
+                    continue
+                entries.append(
+                    tuple(int(row[p * n_e + k]) for p in range(5))
+                )
+            else:
+                if (w3p & np.uint32(0xFFFF0000)) == _EMPTY_W3C:
+                    continue
+                w3 = int(w3p) >> 16
+                w4 = ((int(w3p) >> 8) & 0xFF) << 16 | (
+                    int(w3p) & 0xFF
+                )
+                entries.append(
+                    (
+                        int(row[k]), int(row[n_e + k]),
+                        int(row[2 * n_e + k]), w3, w4,
+                    )
+                )
+        per_bucket.append(entries)
+    stash = np.asarray(snapshot.stash)
+    stash_entries = [
+        tuple(int(v) for v in stash[i])
+        for i in range(stash.shape[0])
+        if stash[i, 3] != _EMPTY_W3
+    ]
+    return per_bucket, stash_entries
+
+
+def _place_ct_layout(
+    per_bucket, stash_entries, nb: int, lanes: int, entry_words: int
+) -> CTSnapshot:
+    """Bucket-preserving placement into either layout.  An entry
+    whose bucket copy would overflow moves to the stash — and so do
+    its OTHER bucket copies (dual-homed DNAT entries), because a row
+    copy plus a stash copy would double-count in the masked value
+    sums; the stash holds exactly one copy."""
+    n_e = lanes // entry_words
+    # first pass: find entries that overflow anywhere
+    overflowed = set()
+    for entries in per_bucket:
+        if len(entries) > n_e:
+            overflowed.update(entries[n_e:])
+    buckets = np.zeros((nb, lanes), dtype=np.uint32)
+    empty3 = _EMPTY_W3 if entry_words == ENTRY_WORDS else _EMPTY_W3C
+    buckets[:, 3 * n_e : 4 * n_e] = empty3
+    stash = np.zeros((STASH_ENTRIES, ENTRY_WORDS), dtype=np.uint32)
+    stash[:, 3] = _EMPTY_W3
+    sfill = 0
+    stashed = set()
+    for b, entries in enumerate(per_bucket):
+        k = 0
+        for ent in entries:
+            if ent in overflowed:
+                if ent not in stashed:
+                    if sfill >= STASH_ENTRIES:
+                        raise ValueError(
+                            "CT bucket and stash overflow — keep "
+                            "the wider layout"
+                        )
+                    stash[sfill] = ent
+                    sfill += 1
+                    stashed.add(ent)
+                continue
+            w0, w1, w2, w3, w4 = ent
+            if entry_words == ENTRY_WORDS:
+                for p, w in enumerate(ent):
+                    buckets[b, p * n_e + k] = w
+            else:
+                buckets[b, k] = w0
+                buckets[b, n_e + k] = w1
+                buckets[b, 2 * n_e + k] = w2
+                buckets[b, 3 * n_e + k] = (
+                    (w3 << 16)
+                    | (((w4 >> 16) & 0xFF) << 8)
+                    | (w4 & 0xFF)
+                )
+            k += 1
+    for ent in stash_entries:
+        if ent in stashed:
+            continue
+        if sfill >= STASH_ENTRIES:
+            raise ValueError(
+                "CT bucket and stash overflow — keep the wider "
+                "layout"
+            )
+        stash[sfill] = ent
+        sfill += 1
+    return CTSnapshot(
+        buckets=buckets,
+        stash=trim_ct_stash(stash),
+        n_buckets=nb,
+        entry_words=entry_words,
+    )
+
+
+def compact_ct_snapshot(
+    snapshot: CTSnapshot, lanes: int = CT_COMPACT_LANES
+) -> CTSnapshot:
+    """Re-place a snapshot in the SUB-WORD compact layout: 4-word
+    entries (state/flags halfword packed beside the rev_nat/slave
+    bytes) in `lanes`-wide rows — same bucket count and the SAME
+    bucket membership per entry (hashes and dual-homed DNAT copies
+    unchanged, so churn deltas still touch only their bucket), row
+    overflow spilling to the legacy stash.  Semantics must allow it:
+    rev_nat and slave must fit a byte and no state halfword may
+    equal the empty marker — verified, ValueError otherwise (the
+    caller keeps the 5-word layout).  Lookups are bit-identical by
+    construction (same keys, same hash, same combine)."""
+    per_bucket, stash_entries = _decode_per_bucket(snapshot)
+    for ent in (
+        e for entries in per_bucket for e in entries
+    ):
+        w3, w4 = ent[3], ent[4]
+        if ((w4 >> 16) & 0xFFFF) > 0xFF or (w4 & 0xFFFF) > 0xFF:
+            raise ValueError(
+                "rev_nat/slave exceed the compact byte fields — "
+                "keeping the 5-word CT layout"
+            )
+        if w3 >= 0xFFFF:
+            raise ValueError(
+                "CT state halfword collides with the compact empty "
+                "marker — keeping the 5-word CT layout"
+            )
+    return _place_ct_layout(
+        per_bucket, stash_entries, snapshot.n_buckets, lanes, 4
+    )
+
+
+def expand_ct_snapshot(snapshot: CTSnapshot) -> CTSnapshot:
+    """Compact -> legacy 5-word 128-lane layout (round-trip seam for
+    the autotuner's width sweep)."""
+    if snapshot.entry_words == ENTRY_WORDS:
+        return snapshot
+    per_bucket, stash_entries = _decode_per_bucket(snapshot)
+    return _place_ct_layout(
+        per_bucket, stash_entries, snapshot.n_buckets, BUCKET_LANES,
+        ENTRY_WORDS,
+    )
+
+
 def apply_bucket_delta(snapshot, idx, rows, stash=None):
     """Scatter changed bucket rows (and optionally a new stash) into a
     device-resident snapshot.  Callers jit this with the snapshot
@@ -356,7 +542,9 @@ def apply_bucket_delta(snapshot, idx, rows, stash=None):
     buckets = snapshot.buckets.at[idx].set(rows)
     new_stash = snapshot.stash if stash is None else jnp.asarray(stash)
     return CTSnapshot(
-        buckets=buckets, stash=new_stash, n_buckets=snapshot.n_buckets
+        buckets=buckets, stash=new_stash,
+        n_buckets=snapshot.n_buckets,
+        entry_words=snapshot.entry_words,
     )
 
 
@@ -469,19 +657,25 @@ def ct_probe_keys(
 
 
 def ct_probe_row_parts(rows, lo_a, hi_a, ports_w, w3_fwd, w3_rev,
-                       owns=None):
+                       owns=None, entry_words: int = ENTRY_WORDS):
     """Bucket-ROW half of the CT probe: lane compares against
     pre-fetched rows, with an optional ownership mask (the routed
     mesh kernel gathers each row on its owning shard only and masks
     every other shard's contribution to zero, so an integer psum of
     these parts reconstructs the single-chip result exactly).
-    Returns (fwd_found bool [B], rev_found bool [B], fwd_val u32 [B],
-    rev_val u32 [B])."""
+    Layout-generic: `entry_words` 5 = legacy, 4 = the sub-word
+    compact form, whose state halfword and rev/slave bytes unpack
+    in-jit back to the legacy compare/value encoding — results are
+    bit-identical by construction.  Returns (fwd_found bool [B],
+    rev_found bool [B], fwd_val u32 [B], rev_val u32 [B])."""
     import jax.numpy as jnp
 
-    n_e = ENTRIES_PER_BUCKET
+    n_e = rows.shape[1] // entry_words
     # planar extraction: word k of all entries = one contiguous slice
-    ew = [rows[:, k * n_e : (k + 1) * n_e] for k in range(ENTRY_WORDS)]
+    ew = [
+        rows[:, k * n_e : (k + 1) * n_e]
+        for k in range(entry_words)
+    ]
     key_eq = (
         (ew[0] == lo_a[:, None])
         & (ew[1] == hi_a[:, None])
@@ -489,13 +683,25 @@ def ct_probe_row_parts(rows, lo_a, hi_a, ports_w, w3_fwd, w3_rev,
     )
     if owns is not None:
         key_eq = key_eq & owns[:, None]
-    fwd_hit = key_eq & (ew[3] == w3_fwd[:, None])  # [B, E]
-    rev_hit = key_eq & (ew[3] == w3_rev[:, None])
+    if entry_words == ENTRY_WORDS:
+        w3_plane = ew[3]
+        val_plane = ew[4]
+    else:
+        # compact: w3c = state16 << 16 | rev8 << 8 | slave8 — the
+        # in-jit unpack shim (the packed4 precedent applied to the
+        # CT state/flags lane)
+        w3_plane = ew[3] >> jnp.uint32(16)
+        val_plane = (
+            ((ew[3] >> jnp.uint32(8)) & jnp.uint32(0xFF))
+            << jnp.uint32(16)
+        ) | (ew[3] & jnp.uint32(0xFF))
+    fwd_hit = key_eq & (w3_plane == w3_fwd[:, None])  # [B, E]
+    rev_hit = key_eq & (w3_plane == w3_rev[:, None])
     fwd_val = jnp.sum(
-        jnp.where(fwd_hit, ew[4], 0), axis=1, dtype=jnp.uint32
+        jnp.where(fwd_hit, val_plane, 0), axis=1, dtype=jnp.uint32
     )
     rev_val = jnp.sum(
-        jnp.where(rev_hit, ew[4], 0), axis=1, dtype=jnp.uint32
+        jnp.where(rev_hit, val_plane, 0), axis=1, dtype=jnp.uint32
     )
     return (
         jnp.any(fwd_hit, axis=1), jnp.any(rev_hit, axis=1),
@@ -580,7 +786,8 @@ def ct_probe_rows(
         )
     )
     rf, rr, rfv, rrv = ct_probe_row_parts(
-        rows, lo_a, hi_a, ports_w, w3_fwd, w3_rev
+        rows, lo_a, hi_a, ports_w, w3_fwd, w3_rev,
+        entry_words=snapshot.entry_words,
     )
     sf, sr, sfv, srv = ct_probe_stash_parts(
         snapshot, lo_a, hi_a, ports_w, w3_fwd, w3_rev

@@ -223,6 +223,13 @@ class Daemon:
         # host pack of batch N+1 with device compute of batch N);
         # 0 = fully synchronous per-batch serving
         self.dispatch_async_depth = 1
+        # sub-word hot planes for the FUSED datapath world
+        # (engine.datapath.subword_datapath_tables): opt-in default
+        # of datapath_tables() — flip before attach_mesh_router /
+        # ServingPlane(fused=True) so every fused epoch ships the
+        # compact row layouts (planes whose ranges don't fit keep
+        # the wide layout automatically)
+        self.datapath_subword = False
         # device table-publication backoff (monotonic deadline): a
         # failed epoch publish must not be retried per batch
         self._device_publish_retry_at = 0.0
@@ -1735,7 +1742,7 @@ class Daemon:
         rec = {k: v[~hit] for k, v in rec.items()}
         return rec, n_prefiltered
 
-    def datapath_tables(self, policy=None):
+    def datapath_tables(self, policy=None, subword=None):
         """Assemble the FUSED DatapathTables from the daemon's
         current state — published policy tables + the ipcache
         listener's CIDR→identity view (idx-specialized) + the CT map
@@ -1750,7 +1757,17 @@ class Daemon:
         published tables.  The CT entry dict and the service map are
         shallow-snapshotted before compilation — the ct-gc
         controller thread mutates the live CTMap without the daemon
-        lock, and iterating it directly would race."""
+        lock, and iterating it directly would race.
+
+        `subword` (default: the `datapath_subword` config option)
+        applies the sub-word hot-lane transform
+        (engine.datapath.subword_datapath_tables) to the assembled
+        world — planes whose semantics don't fit their compact
+        fields keep the wide layout.  The transform is a pure,
+        deterministic function of the assembled tables, so the
+        DatapathStore's row-diff delta still ships O(change) bytes
+        through churn, and every width joins the layout stamp the
+        store refuses cross-layout deltas on."""
         import copy
 
         from cilium_tpu.ct.device import compile_ct
@@ -1780,13 +1797,22 @@ class Daemon:
         ipc = specialize_ipcache_to_idx(
             build_ipcache(mappings), pol
         )
-        return DatapathTables(
+        dt = DatapathTables(
             prefilter=build_prefilter(prefilter_cidrs),
             ipcache=ipc,
             ct=compile_ct(ct_snap),
             lb=compile_lb(services),
             policy=pol,
         )
+        if subword is None:
+            subword = bool(getattr(self, "datapath_subword", False))
+        if subword:
+            from cilium_tpu.engine.datapath import (
+                subword_datapath_tables,
+            )
+
+            dt, _report = subword_datapath_tables(dt)
+        return dt
 
     def serving_plane(self, **overrides):
         """The daemon's continuous serving plane

@@ -189,7 +189,12 @@ def hot_gather_profile(tables, packed_io: bool = True) -> List[dict]:
 
     # CT: one bucket-row gather serves the service + flow probes
     ct_lanes = int(np.asarray(tables.ct.buckets).shape[1])
-    add("ct", "ct.buckets", "hot", ct_lanes * 4, "1 row gather")
+    ct_ew = int(getattr(tables.ct, "entry_words", 5))
+    add(
+        "ct", "ct.buckets", "hot", ct_lanes * 4,
+        "1 row gather"
+        + (f", sub-word {ct_ew}-word entries" if ct_ew != 5 else ""),
+    )
     # LB: service bucket row gather (egress only — averaged at 1/2);
     # the inline layout keys+backends in one row, the classic layout
     # pays a second backend-row gather on service hits (rare, priced
@@ -212,9 +217,15 @@ def hot_gather_profile(tables, packed_io: bool = True) -> List[dict]:
     ipc = getattr(tables, "ipcache", None)
     if isinstance(ipc, IPCacheDevice):
         ip_lanes = int(np.asarray(ipc.buckets).shape[1])
+        sub_note = ""
+        if getattr(ipc, "bucket_entries", 0):
+            sub_note = (
+                f", sub-word val{ipc.value_width}/"
+                f"l3w{ipc.l3_width}"
+            )
         add(
             "ipcache", "ipcache.buckets", "hot", ip_lanes * 4,
-            "1 bucket-row gather",
+            "1 bucket-row gather" + sub_note,
         )
         if ipc.range_rows is not None:
             n_classes = len(ipc.range_class_plens)
@@ -233,16 +244,29 @@ def hot_gather_profile(tables, packed_io: bool = True) -> List[dict]:
         add("ipcache", "ipcache.dir24_8", "hot", 8, "2 element gathers")
     hash_rows = getattr(pol, "l4_hash_rows", None)
     if hash_rows is not None:
+        from cilium_tpu.compiler.tables import l4_entry_words
+
         lanes = int(np.asarray(hash_rows).shape[1])
         wlanes = int(np.asarray(pol.l4_wild_rows).shape[1])
+        ew = l4_entry_words(pol)
         add(
             "lattice", "l4_hash_rows", "hot", lanes * 4,
-            f"pack width {lanes}",
+            f"pack width {lanes}"
+            + (", sub-word 2-word entries" if ew == 2 else ""),
         )
         add(
             "lattice", "l4_wild_rows", "hot", wlanes * 4,
-            f"pack width {wlanes}",
+            f"pack width {wlanes}"
+            + (", sub-word 2-word entries" if ew == 2 else ""),
         )
+        if ew == 2:
+            # the compact form drops the per-entry proxy copy and
+            # reconstructs it with ONE l4_meta element gather at the
+            # combined slot index — priced honestly
+            add(
+                "lattice", "l4_meta", "hot", 4,
+                "compact-entry proxy reconstruction",
+            )
         # identity index rides the idx-form ipcache when present;
         # otherwise one id_direct element gather
         add("lattice", "id_direct", "hot", 4, "skipped w/ idx ipcache")

@@ -38,6 +38,7 @@ the kernel-owned CT map asynchronously.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -800,6 +801,234 @@ datapath_step_accum_pair_telem_packed4_stacked = jax.jit(
     _datapath_kernel_accum_pair_telem_packed4_stacked,
     donate_argnums=(2, 3),
 )
+
+
+# ---------------------------------------------------------------------------
+# Sub-word hot planes: the whole-datapath transform + layout stamp
+# ---------------------------------------------------------------------------
+
+
+def subword_datapath_tables(
+    dtables: DatapathTables,
+    l4_lanes: "int | None" = None,
+    ct_lanes: "int | None" = None,
+    strict: bool = False,
+) -> Tuple[DatapathTables, dict]:
+    """Apply every sub-word hot-lane transform the world's semantics
+    allow — ONE entry point, ONE layout stamp: the compact 2-word
+    hashed L4 pair (compiler.tables.repack_l4_subword), the 4-word
+    CT bucket rows (ct.device.compact_ct_snapshot) and the packed
+    idx/l3/prefix-class ipcache planes (ipcache.lpm.subword_ipcache).
+
+    Each plane transforms independently; one whose ranges don't fit
+    its compact fields keeps its wide layout (or raises when
+    `strict`).  Returns (tables, report) — report maps plane ->
+    "packed"/"kept: <why>" so bench/gatherprof can emit the
+    per-width model honestly.  Verdicts are bit-identical by
+    construction (each transform's contract), and every changed
+    plane moves the layout stamp (datapath_layout_version) so
+    delta publication refuses across the seam."""
+    import dataclasses
+
+    from cilium_tpu.compiler.tables import (
+        L4C_LANES,
+        repack_l4_subword,
+    )
+    from cilium_tpu.ct.device import (
+        CT_COMPACT_LANES,
+        compact_ct_snapshot,
+    )
+    from cilium_tpu.ipcache.lpm import IPCacheDevice, subword_ipcache
+
+    report = {}
+    out = dtables
+    try:
+        pol = repack_l4_subword(
+            dtables.policy, lanes=l4_lanes or L4C_LANES
+        )
+        out = dataclasses.replace(out, policy=pol)
+        report["l4_hash"] = "packed"
+    except ValueError as exc:
+        if strict:
+            raise
+        report["l4_hash"] = f"kept: {exc}"
+    try:
+        ct = compact_ct_snapshot(
+            dtables.ct, lanes=ct_lanes or CT_COMPACT_LANES
+        )
+        out = dataclasses.replace(out, ct=ct)
+        report["ct"] = "packed"
+    except ValueError as exc:
+        if strict:
+            raise
+        report["ct"] = f"kept: {exc}"
+    ipc = dtables.ipcache
+    if isinstance(ipc, IPCacheDevice) and ipc.values_are_idx:
+        try:
+            out = dataclasses.replace(
+                out, ipcache=subword_ipcache(ipc)
+            )
+            report["ipcache"] = "packed"
+        except ValueError as exc:
+            if strict:
+                raise
+            report["ipcache"] = f"kept: {exc}"
+    else:
+        report["ipcache"] = "kept: not an idx-form IPCacheDevice"
+    return out, report
+
+
+def datapath_layout_version(dtables: DatapathTables) -> tuple:
+    """The whole-datapath layout stamp: policy layout version (lane
+    widths + coldness + compact bit) plus every sub-word marker of
+    the CT/ipcache planes.  Joins the partition digest in
+    DatapathStore's geometry check — a delta recorded under one
+    layout can never scatter into an epoch holding another."""
+    from cilium_tpu.compiler.tables import tables_layout_version
+    from cilium_tpu.ipcache.lpm import IPCacheDevice
+
+    ipc = dtables.ipcache
+    return (
+        tables_layout_version(dtables.policy),
+        int(getattr(dtables.ct, "entry_words", 5)),
+        int(np.asarray(dtables.ct.buckets).shape[1]),
+        (
+            int(getattr(ipc, "bucket_entries", 0)),
+            int(getattr(ipc, "value_width", 32)),
+            int(getattr(ipc, "l3_width", 32)),
+            tuple(getattr(ipc, "range_widths", ()) or ()),
+        )
+        if isinstance(ipc, IPCacheDevice) else (),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Persistent fused-pair program: zero per-pair dispatch
+# ---------------------------------------------------------------------------
+# The headline loop's remaining host cost is the PER-PAIR dispatch
+# floor: one launch + one drain round trip per pair batch, which the
+# async overlap hides only partially (the host still touches the
+# executable K times).  The persistent program evaluates K staged
+# pairs in ONE launch: a lax.scan walks the [K, 2, 4, B] super-batch,
+# the counter/telemetry carry is donated device-resident state woven
+# through the scan, and the stacked verdict outputs stay on device
+# until the caller drains — carry state commits once per drain, not
+# once per pair.
+
+_PERSISTENT_CACHE = {}
+
+
+def persistent_pair_program(k_pairs: int):
+    """Jitted persistent fused-pair program.
+
+    fn(tables, pairs [K, 2, 4, B] u32, acc, telem) ->
+        (out_i stacked [K, ...], out_e stacked [K, ...], acc', telem')
+
+    acc/telem are donated; verdict columns for pair i sit at leading
+    index i of every output leaf — bit-identical per pair to
+    datapath_step_accum_pair_telem_packed4_stacked over the same
+    pairs (scan order matches submission order, counter scatter adds
+    commute)."""
+    key = int(k_pairs)
+    fn = _PERSISTENT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def program(tables, pairs, acc, telem):
+        def step(carry, pair):
+            acc, telem = carry
+            out_i, out_e, acc, telem = (
+                _datapath_kernel_accum_pair_telem_packed4(
+                    tables, pair[0], pair[1], acc, telem
+                )
+            )
+            return (acc, telem), (out_i, out_e)
+
+        (acc, telem), (outs_i, outs_e) = jax.lax.scan(
+            step, (acc, telem), pairs
+        )
+        return outs_i, outs_e, acc, telem
+
+    fn = jax.jit(program, donate_argnums=(2, 3))
+    _PERSISTENT_CACHE[key] = fn
+    return fn
+
+
+class PersistentPairDispatcher:
+    """Host driver of the persistent program: stages up to `k_pairs`
+    packed4 pair batches, ships them as ONE [K, 2, 4, B] device_put
+    and ONE launch, and keeps the counter/telemetry carry
+    device-resident across launches (donated) — zero per-pair
+    dispatch, zero per-pair host sync.  `submit(pair)` returns a
+    list of drained (out_i, out_e) results (empty until a super-batch
+    completes); `flush()` runs any staged remainder through the
+    per-pair program (same jit class as the reference pair — padding
+    the scan would corrupt the carried counters) and returns the
+    final (results, acc, telem).
+
+    The jit-tracking proof rides `site`: wrap-tracked launches land
+    in cilium_jit_cache_*{site} so a test (or the bench) can assert
+    K pairs cost exactly one executable call."""
+
+    def __init__(
+        self, tables, k_pairs: int, acc, telem,
+        site: str = "datapath.persistent",
+    ) -> None:
+        from cilium_tpu import tracing
+
+        self.tables = tables
+        self.k = max(int(k_pairs), 1)
+        self.acc = acc
+        self.telem = telem
+        self._staged = []
+        self._program = tracing.track_jit(
+            persistent_pair_program(self.k), site
+        )
+        self._pair_fallback = tracing.track_jit(
+            datapath_step_accum_pair_telem_packed4_stacked,
+            site + ".remainder",
+        )
+        self.launches = 0
+
+    def submit(self, pair_host: np.ndarray):
+        """Stage one [2, 4, B] host pair; when the K-th arrives the
+        super-batch launches (one dispatch for all K).  Returns the
+        drained per-pair (out_i, out_e) tuples, [] while staging."""
+        self._staged.append(pair_host)
+        if len(self._staged) < self.k:
+            return []
+        stacked = jax.device_put(
+            np.stack(self._staged)
+        )
+        self._staged = []
+        outs_i, outs_e, self.acc, self.telem = self._program(
+            self.tables, stacked, self.acc, self.telem
+        )
+        self.launches += 1
+        return [
+            (
+                jax.tree.map(lambda a: a[i], outs_i),
+                jax.tree.map(lambda a: a[i], outs_e),
+            )
+            for i in range(self.k)
+        ]
+
+    def flush(self):
+        """Drain the staged remainder through the per-pair program
+        (one launch per leftover pair — still no per-direction
+        dispatch) and return (results, acc, telem).  This is the
+        ONE carry commit point: callers host-read acc/telem here."""
+        results = []
+        for pair in self._staged:
+            out_i, out_e, self.acc, self.telem = (
+                self._pair_fallback(
+                    self.tables, jax.device_put(pair),
+                    self.acc, self.telem,
+                )
+            )
+            results.append((out_i, out_e))
+        self._staged = []
+        return results, self.acc, self.telem
 
 
 def _unique_rows(cols: list, sel: np.ndarray) -> np.ndarray:

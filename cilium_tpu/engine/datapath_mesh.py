@@ -138,6 +138,19 @@ def _geometry(dtables: DatapathTables) -> tuple:
         type(dtables.prefilter).__name__,
         tuple(np.asarray(dtables.policy.l4_hash_rows).shape),
         tuple(np.asarray(dtables.policy.l3_allow_bits).shape),
+        # sub-word layout markers: a width flip at an unchanged
+        # shape is still a different program AND a different
+        # resident encoding — must rebuild + full upload
+        int(getattr(dtables.ct, "entry_words", 5)),
+        (
+            int(getattr(ipc, "bucket_entries", 0)),
+            int(getattr(ipc, "value_width", 32)),
+            int(getattr(ipc, "l3_width", 32)),
+            tuple(getattr(ipc, "range_widths", ()) or ()),
+        )
+        if isinstance(ipc, IPCacheDevice) else (),
+        int(np.asarray(dtables.policy.l4_hash_stash).shape[-1]),
+        int(np.asarray(dtables.policy.l4_wild_stash).shape[-1]),
     )
 
 
@@ -177,6 +190,10 @@ def _fused_geom(dtables: DatapathTables, ntp: int, table_axis: str):
     return {
         "ntp": ntp,
         "ct_sharded": ("ct", "buckets") in rep_axes,
+        "ct_ew": int(getattr(dtables.ct, "entry_words", 5)),
+        "range_widths": tuple(
+            getattr(ipc, "range_widths", ()) or ()
+        ),
         "n_ct": int(np.asarray(dtables.ct.buckets).shape[0]),
         "lb_inline": isinstance(dtables.lb, LBInline),
         "lb_sharded": ("lb", "rows") in rep_axes,
@@ -306,7 +323,8 @@ def _fused_core(
             flows_l.proto, direction_v,
         )
         rf, rr, rfv, rrv = ct_probe_row_parts(
-            ct_rows, ka, kb, kw, w3f, w3r, owns=owns_ct
+            ct_rows, ka, kb, kw, w3f, w3r, owns=owns_ct,
+            entry_words=g["ct_ew"],
         )
         if g["ct_sharded"]:
             rf, rr = psum_i(rf), psum_i(rr)
@@ -427,7 +445,8 @@ def _fused_core(
             )
             backup = backup | rep_r
             hitc, rv, _li, _lo = range_row_parts(
-                r_row, w0c, sp, g["range_planes"], owns=owns_r
+                r_row, w0c, sp, g["range_planes"], owns=owns_r,
+                widths=g["range_widths"],
             )
             if g["range_sharded"]:
                 hitc, rv = psum_i(hitc), psum_u(rv)
@@ -803,12 +822,19 @@ class DatapathStore:
         self._lock = threading.Lock()
         # each slot: {"dev": device pytree, "host": augmented host
         # pytree (the diff base + repair value source), "geom":
-        # geometry signature, "digest": partition digest}
+        # geometry signature, "digest": partition digest,
+        # "epoch": publish counter at install}
         self._slots = [None, None]
         self._cur = 0
         self.epoch = 0
         self._scatter_cache: Dict[tuple, object] = {}
         self._shardings = None
+        # per-epoch change records (publish(changes=...)): epoch ->
+        # {family: {leaf: row-idx array | True}} or None (= no
+        # record, that publish was full-diffed).  A scoped publish
+        # unions the records since the SPARE slot's epoch — the
+        # ping-pong means the spare is two publishes old.
+        self._change_log: Dict[int, object] = {}
 
     # -- internals -----------------------------------------------------------
 
@@ -846,7 +872,7 @@ class DatapathStore:
     # -- API -----------------------------------------------------------------
 
     def publish(
-        self, dtables: DatapathTables
+        self, dtables: DatapathTables, changes=None
     ) -> Tuple[DatapathTables, DatapathPublishStats]:
         """Install `dtables` (host, UN-augmented) as the serving
         datapath epoch — into the SPARE slot (in-flight batches
@@ -854,35 +880,73 @@ class DatapathStore:
         Steady-state churn (CT writeback, ipcache upserts, LB
         backend flips, policy deltas) rides the row-diff scatter
         against the spare's retained snapshot; geometry changes
-        full-upload."""
+        full-upload.
+
+        `changes` is an optional per-subsystem CHANGE RECORD —
+        {family: {leaf: sharded-row index array | True}} — the
+        compiler-delta pattern applied to the fused plane: with a
+        record the publish diffs ONLY the named rows (publish CPU is
+        O(change), not O(world); no re-augmentation of unchanged
+        leaves), shipping exactly the rows that really moved.  The
+        caller WARRANTS every unlisted leaf unchanged since the
+        previous publish (the churn gate proves resident equality).
+        The record is logged per epoch so the ping-pong unions the
+        right set against the two-publishes-old spare; any
+        intervening record-less publish falls back to the full
+        row-diff, as does a geometry/digest change."""
         _check_fused_world(dtables)
         with self._lock, tracing.tracer.span(
             "datapath.publish", site="engine.datapath_mesh"
         ) as sp:
             t0 = time.perf_counter()
-            aug = partition.replicate_datapath_leaves(
-                dtables, self.ntp, self.table_axis
-            )
             geom = _geometry(dtables)
             self.epoch += 1
+            self._change_log[self.epoch] = changes
+            for e in list(self._change_log):
+                if e <= self.epoch - 8:
+                    del self._change_log[e]
             spare_i = self._cur ^ 1
             spare = self._slots[spare_i]
+            union = (
+                None if spare is None
+                else self._union_changes(spare.get("epoch", 0))
+            )
             if (
                 spare is None
                 or geom != spare["geom"]
                 or spare["digest"] != self.partition_digest
             ):
+                aug = partition.replicate_datapath_leaves(
+                    dtables, self.ntp, self.table_axis
+                )
                 dev, nbytes = self._full_place(aug)
                 stats = DatapathPublishStats(
                     epoch=self.epoch, mode="full",
                     bytes_h2d=nbytes, seconds=0.0,
                 )
+                slot = {
+                    "dev": dev, "host": aug, "geom": geom,
+                    "digest": self.partition_digest,
+                    "epoch": self.epoch,
+                }
+            elif union is not None:
+                dev, stats = self._publish_scoped(
+                    dtables, spare, union
+                )
+                slot = dict(
+                    spare, dev=dev, geom=geom, epoch=self.epoch
+                )
             else:
+                aug = partition.replicate_datapath_leaves(
+                    dtables, self.ntp, self.table_axis
+                )
                 dev, stats = self._publish_delta(aug, spare)
-            self._slots[spare_i] = {
-                "dev": dev, "host": aug, "geom": geom,
-                "digest": self.partition_digest,
-            }
+                slot = {
+                    "dev": dev, "host": aug, "geom": geom,
+                    "digest": self.partition_digest,
+                    "epoch": self.epoch,
+                }
+            self._slots[spare_i] = slot
             self._cur = spare_i
             stats.seconds = time.perf_counter() - t0
             sp.attrs.update(
@@ -891,6 +955,143 @@ class DatapathStore:
                 scattered_rows=stats.scattered_rows,
             )
             return dev, stats
+
+    def _union_changes(self, spare_epoch: int):
+        """Union of the change records for every publish since the
+        spare slot's epoch, or None when any of them is missing
+        (record-less publish → the caller made no warranty and the
+        full row-diff must run)."""
+        union: Dict[str, Dict[str, object]] = {}
+        for e in range(spare_epoch + 1, self.epoch + 1):
+            rec = self._change_log.get(e)
+            if rec is None:
+                return None
+            for fam, leafmap in rec.items():
+                dst = union.setdefault(fam, {})
+                for leaf, idx in leafmap.items():
+                    prev = dst.get(leaf)
+                    if idx is True or prev is True:
+                        dst[leaf] = True
+                    elif prev is None:
+                        dst[leaf] = np.asarray(idx, np.int64)
+                    else:
+                        dst[leaf] = np.concatenate(
+                            [prev, np.asarray(idx, np.int64)]
+                        )
+        return union
+
+    def _publish_scoped(
+        self, dtables: DatapathTables, spare: dict, changes
+    ):
+        """The O(change) publish: compare/scatter ONLY the rows the
+        change records name, against (and into) the spare slot's
+        retained augmented snapshot — no re-augmentation, no
+        whole-world compare.  Sharded rows land at both their
+        primary and backup augmented positions; `True` records
+        re-place the whole leaf."""
+        dev = spare["dev"]
+        aug_host = spare["host"]
+        rep_axes = partition.datapath_all_replica_axes(
+            aug_host, self.ntp, self.table_axis
+        )
+        n_rows = 0
+        bytes_h2d = 0
+        replaced = 0
+        fam_new: Dict[str, Dict[str, object]] = {}
+        for fam, leafmap in changes.items():
+            new_f = getattr(dtables, fam)
+            host_f = getattr(aug_host, fam)
+            dev_f = getattr(dev, fam)
+            for leaf, rec in leafmap.items():
+                new_arr = np.asarray(getattr(new_f, leaf))
+                host_leaf = np.asarray(getattr(host_f, leaf))
+                axis = rep_axes.get((fam, leaf))
+                dev_leaf = getattr(dev_f, leaf)
+                if axis is None or rec is True:
+                    if axis is not None:
+                        new_arr = partition.replicate_shard_axis(
+                            new_arr, self.ntp, axis
+                        )
+                    if host_leaf.shape == new_arr.shape and (
+                        np.array_equal(host_leaf, new_arr)
+                    ):
+                        continue
+                    sharding = getattr(
+                        getattr(self._shardings, fam), leaf, None
+                    ) or NamedSharding(self.mesh, P())
+                    fam_new.setdefault(fam, {})[leaf] = (
+                        jax.device_put(new_arr, sharding)
+                    )
+                    setattr(host_f, leaf, new_arr)
+                    bytes_h2d += int(new_arr.nbytes)
+                    replaced += 1
+                    continue
+                idx = np.unique(np.asarray(rec, np.int64))
+                nb = new_arr.shape[axis] // self.ntp
+                primary, backup = partition.replica_positions(
+                    idx, nb, self.ntp
+                )
+                rows = np.take(new_arr, idx, axis=axis)
+                prev_rows = np.take(host_leaf, primary, axis=axis)
+                moved = np.moveaxis(rows, axis, 0).reshape(
+                    len(idx), -1
+                ) != np.moveaxis(prev_rows, axis, 0).reshape(
+                    len(idx), -1
+                )
+                chg = np.flatnonzero(np.any(moved, axis=1))
+                if chg.size == 0:
+                    continue
+                rows = np.take(rows, chg, axis=axis)
+                aug_idx = np.concatenate(
+                    [primary[chg], backup[chg]]
+                )
+                aug_rows = np.concatenate([rows, rows], axis=axis)
+                size = next_pow2(aug_idx.size)
+                if size != aug_idx.size:
+                    pad = size - aug_idx.size
+                    aug_idx = np.concatenate(
+                        [aug_idx, np.repeat(aug_idx[-1:], pad)]
+                    )
+                    aug_rows = np.concatenate(
+                        [
+                            aug_rows,
+                            np.repeat(
+                                np.take(
+                                    aug_rows, [-1], axis=axis
+                                ),
+                                pad, axis=axis,
+                            ),
+                        ],
+                        axis=axis,
+                    )
+                # keep the retained snapshot exact (the next diff
+                # base + the chip-repair value source)
+                host_index = (slice(None),) * axis + (aug_idx,)
+                host_leaf[host_index] = aug_rows
+                idx_dev = jax.device_put(
+                    aug_idx, NamedSharding(self.mesh, P())
+                )
+                rows_dev = jax.device_put(
+                    aug_rows, NamedSharding(self.mesh, P())
+                )
+                new_leaf = self._scatter_fn(
+                    (fam, leaf, int(size), int(axis)), int(axis)
+                )(dev_leaf, idx_dev, rows_dev)
+                fam_new.setdefault(fam, {})[leaf] = new_leaf
+                n_rows += int(chg.size)
+                bytes_h2d += int(aug_rows.nbytes + aug_idx.nbytes)
+        if fam_new:
+            fam_objs = {
+                fam: dataclasses.replace(getattr(dev, fam), **ups)
+                for fam, ups in fam_new.items()
+            }
+            dev = dataclasses.replace(dev, **fam_objs)
+            jax.block_until_ready(dev)
+        return dev, DatapathPublishStats(
+            epoch=self.epoch, mode="delta-scoped",
+            bytes_h2d=bytes_h2d, seconds=0.0,
+            scattered_rows=n_rows, replaced_leaves=replaced,
+        )
 
     def _publish_delta(self, aug: DatapathTables, spare: dict):
         prev = spare["host"]

@@ -76,6 +76,9 @@ class FailoverResult:
     rebalanced_chips: Tuple[int, ...] = ()
     rebalance_bytes: int = 0
     rebalance_ms: float = 0.0
+    # routed memo plane (attach_memo): per-tuple cache-hit flags in
+    # stream order, None when the batch was served uncached
+    cache_hit: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -183,6 +186,9 @@ class ChipFailoverRouter:
         # through the repair scatter), so the flush keeps the
         # cached-verdict staleness argument airtight
         self._verdict_cache = None
+        # routed memo plane (attach_memo): sharded verdict cache +
+        # alive-masked memo evaluator on the dispatch path
+        self._memo = None
         # fused-datapath plane (engine/datapath_mesh.py): attached
         # via attach_datapath — the router then serves the FULL
         # pipeline (prefilter + LB/DNAT + CT + ipcache + lattice)
@@ -204,6 +210,72 @@ class ChipFailoverRouter:
         transition — kill OR readmission — flushes it, so no cached
         verdict can outlive a routing/repair event."""
         self._verdict_cache = cache
+
+    def attach_memo(
+        self,
+        n_rows_local: int = 1 << 10,
+        entries: int = 8,
+        rep_shift: int = 2,
+    ) -> None:
+        """Put the PARTITIONED verdict-memoization plane on the
+        router's dispatch path: a sharded verdict cache
+        (make_partitioned_cache — bucket rows co-located with the
+        table shards) probed/inserted by an ALIVE-masked memo
+        evaluator (make_failover_memo_evaluator), so routed lattice
+        dispatch serves repeated policy keys from the cache and runs
+        the replica-aware gathers only for missed representatives.
+
+        Bit-identity is unconditional: a compaction overflow REFUSES
+        the batch (carried cache unchanged) and dispatch re-runs it
+        through the uncached failover evaluator; breaker transitions
+        still flush (attach_verdict_cache wiring); the cache is
+        epoch-stamped against the replica store, so a publish or
+        repair can never serve a stale verdict."""
+        from cilium_tpu.engine.sharded import make_partitioned_cache
+
+        cache = make_partitioned_cache(
+            self.mesh, n_rows_local, entries,
+            batch_axis=self.batch_axis, table_axis=self.table_axis,
+        )
+        self.attach_verdict_cache(cache)
+        self._memo = {
+            "cache": cache,
+            "rep_shift": int(rep_shift),
+            "evs": {},  # (geom, rep_cap) -> evaluator
+            "hits": 0,
+            "misses": 0,
+            "overflow_redispatches": 0,
+        }
+
+    def _memo_evaluator(self, rep_cap: int):
+        """The alive-masked memo evaluator for the CURRENT table
+        geometry at a given per-shard compaction capacity (cached;
+        rebuilt when publish() crosses a shape class)."""
+        from cilium_tpu.engine.sharded import (
+            make_failover_memo_evaluator,
+        )
+
+        key = (self._geom, rep_cap)
+        ev = self._memo["evs"].get(key)
+        if ev is None:
+            # evict evaluators of OTHER geometries (their jit
+            # executables are stale), but keep every rep_cap class
+            # of the current one — a stream alternating batch-size
+            # classes must not retrace per dispatch
+            self._memo["evs"] = {
+                k: v
+                for k, v in self._memo["evs"].items()
+                if k[0] == self._geom
+            }
+            ev = make_failover_memo_evaluator(
+                self.mesh, self._tables,
+                np.asarray(self._memo["cache"].rows), rep_cap,
+                batch_axis=self.batch_axis,
+                table_axis=self.table_axis,
+                collect_telemetry=self.collect_telemetry,
+            )
+            self._memo["evs"][key] = ev
+        return ev
 
     def _chip_event(self, ordinal, old, new, reason) -> None:
         """Per-chip breaker transition: gauge + span event + the
@@ -292,10 +364,12 @@ class ChipFailoverRouter:
         self.publish_datapath(dtables)
         self.publish_datapath(dtables)
 
-    def publish_datapath(self, dtables):
+    def publish_datapath(self, dtables, changes=None):
         """Install a fused-datapath world (host, un-augmented) as
         the serving epoch: steady-state churn rides the store's
-        row-diff delta scatter; a geometry change rebuilds the fused
+        row-diff delta scatter — or, with a per-subsystem change
+        record (`changes`, see DatapathStore.publish), the O(change)
+        scoped scatter; a geometry change rebuilds the fused
         evaluator and full-uploads."""
         from cilium_tpu.engine.datapath_mesh import (
             _geometry,
@@ -314,7 +388,7 @@ class ChipFailoverRouter:
                 collect_telemetry=self.collect_telemetry,
             )
             self._dp_geom = geom
-        return self.dp_store.publish(dtables)
+        return self.dp_store.publish(dtables, changes=changes)
 
     def dispatch_flows(
         self,
@@ -891,22 +965,29 @@ class ChipFailoverRouter:
                 "rerouted": plan["rerouted"],
             },
         ) as sp:
-            try:
-                out = self._ev(
-                    dev_tables, batch, alive, plan["valid"]
+            out = hit_padded = None
+            if self._memo is not None:
+                out, hit_padded = self._memo_dispatch(
+                    current[0], dev_tables, batch, alive,
+                    plan["valid"], sp,
                 )
-                import jax
+            if out is None:
+                try:
+                    out = self._ev(
+                        dev_tables, batch, alive, plan["valid"]
+                    )
+                    import jax
 
-                jax.block_until_ready(out)
-            except Exception as exc:  # noqa: BLE001
-                sp.status = "error"
-                sp.attrs["error"] = str(exc)
-                self._blame_alive(alive, exc)
-                return self._terminal_fold(
-                    cols, alive, plan["rebalanced"],
-                    plan["reb_bytes"], plan["reb_ms"],
-                    reason=str(exc),
-                )
+                    jax.block_until_ready(out)
+                except Exception as exc:  # noqa: BLE001
+                    sp.status = "error"
+                    sp.attrs["error"] = str(exc)
+                    self._blame_alive(alive, exc)
+                    return self._terminal_fold(
+                        cols, alive, plan["rebalanced"],
+                        plan["reb_bytes"], plan["reb_ms"],
+                        reason=str(exc),
+                    )
         self._credit_alive(alive)
         if self.collect_telemetry:
             v, l4c, l3c, replica_hits, trow = out
@@ -924,11 +1005,16 @@ class ChipFailoverRouter:
                 proxy_port=np.asarray(v.proxy_port),
                 match_kind=np.asarray(v.match_kind),
             )
+            cache_hit = hit_padded
         else:
             verdicts = Verdicts(
                 allowed=np.asarray(v.allowed)[positions],
                 proxy_port=np.asarray(v.proxy_port)[positions],
                 match_kind=np.asarray(v.match_kind)[positions],
+            )
+            cache_hit = (
+                None if hit_padded is None
+                else hit_padded[positions]
             )
         return FailoverResult(
             verdicts=verdicts,
@@ -942,7 +1028,60 @@ class ChipFailoverRouter:
             rebalanced_chips=plan["rebalanced"],
             rebalance_bytes=plan["reb_bytes"],
             rebalance_ms=plan["reb_ms"],
+            cache_hit=cache_hit,
         )
+
+    def _memo_dispatch(
+        self, stamp, dev_tables, batch, alive, valid, sp
+    ):
+        """One attempt through the alive-masked memo evaluator.
+        Returns (out, hit) with `out` shaped exactly like the
+        uncached evaluator's result tuple, or (None, None) when the
+        batch must be served uncached: stamp raced a publish,
+        compaction overflow (the kernel refused — carried cache
+        provably unchanged), or a launch failure (the uncached path
+        re-runs under its own blame/terminal-fold machinery)."""
+        import jax
+
+        from cilium_tpu.engine import memo as vm
+
+        cache = self._memo["cache"]
+        cache.ensure(stamp)
+        cur_stamp, rows_in = cache.acquire()
+        if cur_stamp != stamp:
+            return None, None
+        local_b = int(batch.ep_index.shape[0]) // self.dp
+        rep_cap = max(
+            local_b >> self._memo["rep_shift"], min(local_b, 256)
+        )
+        try:
+            ev = self._memo_evaluator(rep_cap)
+            out = ev(dev_tables, batch, alive, valid, rows_in)
+            jax.block_until_ready(out)
+        except Exception as exc:  # noqa: BLE001
+            sp.attrs["memo_error"] = str(exc)
+            cache.flush(reason="memo-dispatch-failure")
+            return None, None
+        if self.collect_telemetry:
+            v, l4c, l3c, hits, cache2, hit, stats, trow = out
+            rest = (trow,)
+        else:
+            v, l4c, l3c, hits, cache2, hit, stats = out
+            rest = ()
+        s = np.asarray(stats)
+        if int(s[vm.STAT_OVERFLOW]):
+            # the kernel refused: more distinct keys than the
+            # compaction capacity — re-dispatch uncached (exactly
+            # once; bit-identity is unconditional)
+            self._memo["overflow_redispatches"] += 1
+            cache.account(s)
+            return None, None
+        cache.commit(stamp, cache2)
+        row = cache.account(s)
+        self._memo["hits"] += row["hits"]
+        self._memo["misses"] += row["tuples"] - row["hits"]
+        sp.attrs["cache_hits"] = row["hits"]
+        return (v, l4c, l3c, hits) + rest, np.asarray(hit)
 
     def _terminal_fold(
         self, cols, alive, rebalanced, reb_bytes, reb_ms, reason=""

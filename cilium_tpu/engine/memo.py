@@ -87,34 +87,64 @@ STAT_TUPLES = 4  # batch tuples the stats row covers
 STATS = 5
 
 
+def cache_layout(rows):
+    """(entries, has_rank, subword) of a cache-row array, solved from
+    the row width alone — the widths are mutually exclusive by
+    construction: legacy 5E, rank layout 5E + 1, SUB-WORD layout
+    4E + ceil(E/8) + 1 (the three probe bits of every entry packed
+    into a NIBBLE plane instead of a full value word; E a multiple
+    of 8)."""
+    w = int(rows.shape[-1])
+    for e in (8, 16, 32):
+        if w == 4 * e + e // 8 + 1:
+            return e, True, True
+    if w % CACHE_WORDS == 0:
+        return w // CACHE_WORDS, False, False
+    return (w - 1) // CACHE_WORDS, True, False
+
+
 def cache_entries(rows) -> int:
     """Entries per bucket row, derived from the row width — probe
     and insert share the layout through the array shape itself, the
-    same contract as the hashed L4 entry tables.  Works for both the
-    rank-word layout (5e + 1 words) and the legacy bare layout (5e):
-    the +1 vanishes under the floor division."""
-    return int(rows.shape[-1]) // CACHE_WORDS
+    same contract as the hashed L4 entry tables."""
+    return cache_layout(rows)[0]
 
 
 def has_rank_word(rows) -> bool:
-    """True when the row layout carries the trailing hit-rank word
-    (5e + 1 wide).  Legacy 5e-wide rows keep the rotation-eviction
-    behavior — the two layouts are distinguishable by width alone,
-    so probe/insert never need a flag."""
-    return int(rows.shape[-1]) % CACHE_WORDS == 1
+    """True when the row layout carries the trailing hit-rank word.
+    Legacy 5e-wide rows keep the rotation-eviction behavior — the
+    layouts are distinguishable by width alone, so probe/insert
+    never need a flag."""
+    return cache_layout(rows)[1]
 
 
 def make_cache_rows(
-    n_rows: int = 1 << 12, entries: int = 8
+    n_rows: int = 1 << 12, entries: int = 8, subword: bool = False
 ) -> np.ndarray:
-    """Host-side empty cache: [n_rows + 1, 5 * entries + 1] u32 —
-    per lane 3 key + 2 value words (EMPTY-filled) plus ONE trailing
-    hit-rank word per row (zeroed: all lanes equally cold).  Row
-    `n_rows` is the SCRATCH row: invalid/overflow inserts are routed
-    there so the jitted insert scatter needs no masking; probes mask
-    the bucket index to [0, n_rows) and can never read it."""
+    """Host-side empty cache: [n_rows + 1, W] u32 — per lane 3 key
+    words + the value words (EMPTY-filled) plus ONE trailing
+    hit-rank word per row (zeroed: all lanes equally cold).  With
+    `subword` the second value word (three probe bits) lives in a
+    packed NIBBLE plane (W = 4*entries + entries//8 + 1 instead of
+    5*entries + 1) — the verdict-cache key/value lanes shrink to the
+    bits a probe actually reads.  Row `n_rows` is the SCRATCH row:
+    invalid/overflow inserts are routed there so the jitted insert
+    scatter needs no masking; probes mask the bucket index to
+    [0, n_rows) and can never read it."""
     if n_rows & (n_rows - 1):
         raise ValueError(f"cache rows must be a power of two: {n_rows}")
+    if subword:
+        if entries % 8:
+            raise ValueError(
+                "sub-word cache rows need entries % 8 == 0"
+            )
+        rows = np.full(
+            (n_rows + 1, 4 * entries + entries // 8 + 1),
+            EMPTY, np.uint32,
+        )
+        # nibble plane + rank word start cold/zero
+        rows[:, 4 * entries :] = 0
+        return rows
     rows = np.full(
         (n_rows + 1, CACHE_WORDS * entries + 1), EMPTY, np.uint32
     )
@@ -306,13 +336,14 @@ def cache_probe(cache_rows, k0, k1, k2, valid):
     the legacy rank-less layout)."""
     import jax.numpy as jnp
 
+    from cilium_tpu.engine import subword as sw
     from cilium_tpu.engine.hashtable import fnv1a_device
 
-    e = cache_entries(cache_rows)
+    e, ranked, subw = cache_layout(cache_rows)
     n_rows = cache_rows.shape[0] - 1  # last row is scratch
     h = fnv1a_device(jnp.stack([k0, k1, k2], axis=1))
     bucket = (h & jnp.uint32(n_rows - 1)).astype(jnp.int32)
-    rowv = cache_rows[bucket]  # [U, 5e(+1)] — 1 gather
+    rowv = cache_rows[bucket]  # [U, W] — 1 gather
     lane_hit = (
         (rowv[:, :e] == k0[:, None])
         & (rowv[:, e : 2 * e] == k1[:, None])
@@ -323,21 +354,28 @@ def cache_probe(cache_rows, k0, k1, k2, valid):
         jnp.where(lane_hit, rowv[:, 3 * e : 4 * e], 0),
         axis=1, dtype=jnp.uint32,
     )
+    if subw:
+        # the nibble plane unpacks in-jit (sub-word hot lanes); the
+        # three probe bits fit a nibble exactly
+        v1_lanes = sw.unpack_lanes(
+            rowv[:, 4 * e : 4 * e + e // 8], 4, e, xp=jnp
+        )
+        rank_col = 4 * e + e // 8
+    else:
+        v1_lanes = rowv[:, 4 * e : 5 * e]
+        rank_col = CACHE_WORDS * e
     v1 = jnp.sum(
-        jnp.where(lane_hit, rowv[:, 4 * e : 5 * e], 0),
-        axis=1, dtype=jnp.uint32,
+        jnp.where(lane_hit, v1_lanes, 0), axis=1, dtype=jnp.uint32
     )
     hit_lane = jnp.argmax(lane_hit, axis=1).astype(jnp.int32)
     rank_word = (
-        rowv[:, CACHE_WORDS * e]
-        if has_rank_word(cache_rows)
+        rowv[:, rank_col]
+        if ranked
         else jnp.zeros(bucket.shape, jnp.uint32)
     )
     ins_lane, ins_ok = bucket_insert_lanes(
         rowv[:, :e] == EMPTY, bucket, e,
-        rank_word=(
-            rank_word if has_rank_word(cache_rows) else None
-        ),
+        rank_word=(rank_word if ranked else None),
     )
     return hit, v0, v1, bucket, ins_lane, ins_ok, hit_lane, rank_word
 
@@ -364,10 +402,10 @@ def apply_rank_updates(
     layout (or past RANK_MAX_LANES lanes)."""
     import jax.numpy as jnp
 
-    e = cache_entries(cache_rows)
-    if not has_rank_word(cache_rows) or e > RANK_MAX_LANES:
+    e, ranked, subw = cache_layout(cache_rows)
+    if not ranked or e > RANK_MAX_LANES:
         return cache_rows
-    col = CACHE_WORDS * e
+    col = (4 * e + e // 8) if subw else CACHE_WORDS * e
     nb = jnp.uint32(RANK_NIBBLE_BITS)
     # hit bump
     h_shift = nb * (hit_lane.astype(jnp.uint32) % RANK_MAX_LANES)
@@ -405,15 +443,32 @@ def cache_insert(
     another's value words."""
     import jax.numpy as jnp
 
-    e = cache_entries(cache_rows)
+    e, _ranked, subw = cache_layout(cache_rows)
     n_rows = cache_rows.shape[0] - 1
     b = jnp.where(do_insert, bucket, n_rows)
-    rows_idx = jnp.concatenate([b] * CACHE_WORDS)
-    lanes_idx = jnp.concatenate(
-        [lane + c * e for c in range(CACHE_WORDS)]
+    if not subw:
+        rows_idx = jnp.concatenate([b] * CACHE_WORDS)
+        lanes_idx = jnp.concatenate(
+            [lane + c * e for c in range(CACHE_WORDS)]
+        )
+        vals = jnp.concatenate([k0, k1, k2, v0, v1])
+        return cache_rows.at[rows_idx, lanes_idx].set(vals)
+    # sub-word layout: the three key words + v0 scatter as whole
+    # lanes; v1 lands in its NIBBLE via a commuting add-delta (two
+    # same-batch inserts into one row share the nibble WORD but
+    # never the nibble — bucket_insert_lanes guarantees distinct
+    # lanes, so the wraparound deltas compose exactly)
+    rows_idx = jnp.concatenate([b] * 4)
+    lanes_idx = jnp.concatenate([lane + c * e for c in range(4)])
+    vals = jnp.concatenate([k0, k1, k2, v0])
+    out = cache_rows.at[rows_idx, lanes_idx].set(vals)
+    word_col = 4 * e + lane // 8
+    shift = (jnp.uint32(4) * (lane.astype(jnp.uint32) % 8))
+    old = (cache_rows[b, word_col] >> shift) & jnp.uint32(0xF)
+    delta = ((v1 & jnp.uint32(0xF)) - old) << shift
+    return out.at[b, word_col].add(
+        jnp.where(do_insert, delta, jnp.uint32(0))
     )
-    vals = jnp.concatenate([k0, k1, k2, v0, v1])
-    return cache_rows.at[rows_idx, lanes_idx].set(vals)
 
 
 def pad_rep(x, mp):
@@ -753,10 +808,11 @@ class VerdictCache:
         entries: int = 8,
         rows_factory=None,
         sharding=None,
+        subword: bool = False,
     ) -> None:
         self._lock = threading.Lock()
         self._factory = rows_factory or (
-            lambda: make_cache_rows(n_rows, entries)
+            lambda: make_cache_rows(n_rows, entries, subword=subword)
         )
         self._sharding = sharding
         self._stamp = None

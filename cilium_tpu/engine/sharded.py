@@ -326,16 +326,16 @@ def make_partitioned_evaluator(
     from cilium_tpu.compiler.partition import (
         divisible_partition_specs,
     )
-    from cilium_tpu.compiler.tables import (
-        L4H_WILD_IDX,
-        l4h_key0,
-        l4h_key1,
-    )
+    from cilium_tpu.compiler.tables import L4H_WILD_IDX
     from cilium_tpu.engine.hashtable import fnv1a_device
     from cilium_tpu.engine.verdict import (
         MATCH_L3,
         _index_identity,
         _l4hash_probe,
+        l4hash_probe_keys,
+        l4hash_row_parts,
+        l4hash_stash_parts,
+        l4hash_value_decode,
     )
 
     if tables.l4_hash_rows is None:
@@ -380,16 +380,17 @@ def make_partitioned_evaluator(
         dport = jnp.clip(batch_l.dport, 0, 65535).astype(jnp.int32)
 
         # -- routed exact probe: the bucket row lives on ONE shard ------
-        w0 = l4h_key0(
-            idx.astype(jnp.uint32), batch_l.direction,
-            batch_l.ep_index,
+        from cilium_tpu.compiler.tables import l4_entry_words
+
+        entry_words = l4_entry_words(tables_l)
+        w0, w1 = l4hash_probe_keys(
+            entry_words, batch_l.ep_index, batch_l.direction,
+            idx.astype(jnp.uint32), dport, proto,
         )
-        w1 = l4h_key1(dport, proto, batch_l.ep_index)
         h = fnv1a_device(jnp.stack([w0, w1], axis=1))
         bucket = (h & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
         rows_l = tables_l.l4_hash_rows
         n_local = rows_l.shape[0]
-        e = rows_l.shape[1] // 3
         if rows_sharded:
             off = jax.lax.axis_index(table_axis) * n_local
             bl = bucket - off
@@ -398,17 +399,10 @@ def make_partitioned_evaluator(
         else:
             owns = jnp.ones(bucket.shape, bool)
             bl = bucket
-        row = rows_l[bl]  # local gather: only the owning shard's hit
-        hit = (
-            (row[:, :e] == w0[:, None])
-            & (row[:, e : 2 * e] == w1[:, None])
-            & owns[:, None]
+        # local gather: only the owning shard's hit
+        found_local, val_local = l4hash_row_parts(
+            rows_l[bl], w0, w1, entry_words, owns=owns
         )
-        val_local = jnp.sum(
-            jnp.where(hit, row[:, 2 * e : 3 * e], 0),
-            axis=1, dtype=jnp.uint32,
-        )
-        found_local = jnp.any(hit, axis=1)
         if rows_sharded:
             # return the verdict column to the originating shard:
             # the key lives in exactly one shard, so the sums are
@@ -424,15 +418,11 @@ def make_partitioned_evaluator(
         else:
             val1, found1 = val_local, found_local
         # overflow stash replicates (≤64 rows): same on every shard
-        stash = tables_l.l4_hash_stash
-        s_hit = (stash[None, :, 0] == w0[:, None]) & (
-            stash[None, :, 1] == w1[:, None]
+        s_found, s_val = l4hash_stash_parts(
+            tables_l.l4_hash_stash, w0, w1, entry_words
         )
-        val1 = val1 + jnp.sum(
-            jnp.where(s_hit, stash[None, :, 2], 0),
-            axis=1, dtype=jnp.uint32,
-        )
-        found1 = found1 | jnp.any(s_hit, axis=1)
+        val1 = val1 + s_val
+        found1 = found1 | s_found
 
         # -- wildcard probe: identity-free, tiny, replicated ------------
         wild_idx = jnp.full(
@@ -445,9 +435,10 @@ def make_partitioned_evaluator(
         )
         probe1 = known & found1
         probe3 = hit3
-        val = jnp.where(probe1, val1, val3)
-        proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
-        j = (val >> jnp.uint32(16)).astype(jnp.int32)
+        proxy, j = l4hash_value_decode(
+            tables_l, batch_l.ep_index, batch_l.direction,
+            probe1, val1, hit3, val3, entry_words,
+        )
 
         # -- routed L3 probe: the identity's bit-word has one owner -----
         word = idx >> 5
@@ -601,16 +592,16 @@ def make_partitioned_memo_evaluator(
     from cilium_tpu.compiler.partition import (
         divisible_partition_specs,
     )
-    from cilium_tpu.compiler.tables import (
-        L4H_WILD_IDX,
-        l4h_key0,
-        l4h_key1,
-    )
+    from cilium_tpu.compiler.tables import L4H_WILD_IDX
     from cilium_tpu.engine.hashtable import fnv1a_device
     from cilium_tpu.engine import memo as vm
     from cilium_tpu.engine.verdict import (
         _index_identity,
         _l4hash_probe,
+        l4hash_probe_keys,
+        l4hash_row_parts,
+        l4hash_stash_parts,
+        l4hash_value_decode,
     )
 
     if tables.l4_hash_rows is None:
@@ -742,13 +733,17 @@ def make_partitioned_memo_evaluator(
         m_dport = dport[m_orig]
         m_proto = proto[m_orig]
 
-        w0 = l4h_key0(m_idx.astype(jnp.uint32), m_dir, m_ep)
-        w1 = l4h_key1(m_dport, m_proto, m_ep)
+        from cilium_tpu.compiler.tables import l4_entry_words
+
+        entry_words = l4_entry_words(tables_l)
+        w0, w1 = l4hash_probe_keys(
+            entry_words, m_ep, m_dir, m_idx.astype(jnp.uint32),
+            m_dport, m_proto,
+        )
         hh = fnv1a_device(jnp.stack([w0, w1], axis=1))
         hb = (hh & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
         rows_l = tables_l.l4_hash_rows
         n_local = rows_l.shape[0]
-        eh = rows_l.shape[1] // 3
         if rows_sharded:
             off = jax.lax.axis_index(table_axis) * n_local
             bl = hb - off
@@ -757,17 +752,9 @@ def make_partitioned_memo_evaluator(
         else:
             owns = jnp.ones(hb.shape, bool)
             bl = hb
-        row = rows_l[bl]
-        hitx = (
-            (row[:, :eh] == w0[:, None])
-            & (row[:, eh : 2 * eh] == w1[:, None])
-            & owns[:, None]
+        found_local, val_local = l4hash_row_parts(
+            rows_l[bl], w0, w1, entry_words, owns=owns
         )
-        val_local = jnp.sum(
-            jnp.where(hitx, row[:, 2 * eh : 3 * eh], 0),
-            axis=1, dtype=jnp.uint32,
-        )
-        found_local = jnp.any(hitx, axis=1)
         if rows_sharded:
             val1 = jax.lax.psum(val_local, table_axis)
             found1 = (
@@ -778,15 +765,11 @@ def make_partitioned_memo_evaluator(
             )
         else:
             val1, found1 = val_local, found_local
-        stash = tables_l.l4_hash_stash
-        s_hit = (stash[None, :, 0] == w0[:, None]) & (
-            stash[None, :, 1] == w1[:, None]
+        s_found, s_val = l4hash_stash_parts(
+            tables_l.l4_hash_stash, w0, w1, entry_words
         )
-        val1 = val1 + jnp.sum(
-            jnp.where(s_hit, stash[None, :, 2], 0),
-            axis=1, dtype=jnp.uint32,
-        )
-        found1 = found1 | jnp.any(s_hit, axis=1)
+        val1 = val1 + s_val
+        found1 = found1 | s_found
         wild_idx = jnp.full(
             m_idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
         )
@@ -796,9 +779,10 @@ def make_partitioned_memo_evaluator(
         )
         p1m = m_known & found1
         p3m = hit3
-        val = jnp.where(p1m, val1, val3)
-        m_proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
-        m_j = (val >> jnp.uint32(16)).astype(jnp.int32)
+        m_proxy, m_j = l4hash_value_decode(
+            tables_l, m_ep, m_dir, p1m, val1, hit3, val3,
+            entry_words,
+        )
         # routed L3 probe for the missed reps
         m_word = m_idx >> 5
         m_bit = (m_idx & 31).astype(jnp.uint32)
@@ -964,21 +948,29 @@ def failover_lattice_probes(
     `replica` (bool [B]: the tuple was served from a backup
     region)."""
     from cilium_tpu.compiler import partition
-    from cilium_tpu.compiler.tables import (
-        L4H_WILD_IDX,
-        l4h_key0,
-        l4h_key1,
-    )
+    from cilium_tpu.compiler.tables import L4H_WILD_IDX
     from cilium_tpu.engine.hashtable import fnv1a_device
-    from cilium_tpu.engine.verdict import _l4hash_probe
+    from cilium_tpu.engine.verdict import (
+        _l4hash_probe,
+        l4hash_probe_keys,
+        l4hash_row_parts,
+        l4hash_stash_parts,
+        l4hash_value_decode,
+    )
 
-    # -- routed exact probe with replica fallback -------------------
-    w0 = l4h_key0(idx.astype(jnp.uint32), direction, ep_index)
-    w1 = l4h_key1(dport, proto, ep_index)
+    # -- routed exact probe with replica fallback (layout-generic:
+    # the 3-word and the sub-word compact entry forms share one
+    # compare/psum body — the stash width is the marker) ------------
+    from cilium_tpu.compiler.tables import l4_entry_words as _l4ew
+
+    entry_words = _l4ew(tables_l)
+    w0, w1 = l4hash_probe_keys(
+        entry_words, ep_index, direction, idx.astype(jnp.uint32),
+        dport, proto,
+    )
     h = fnv1a_device(jnp.stack([w0, w1], axis=1))
     bucket = (h & jnp.uint32(n_rows_global - 1)).astype(jnp.int32)
     rows_l = tables_l.l4_hash_rows
-    e = rows_l.shape[1] // 3
     replica_exact = jnp.zeros(bucket.shape, bool)
     if rows_sharded:
         n = n_row_shard
@@ -998,16 +990,9 @@ def failover_lattice_probes(
         owns = jnp.ones(bucket.shape, bool)
         bl = bucket
     row = rows_l[bl]
-    hit = (
-        (row[:, :e] == w0[:, None])
-        & (row[:, e : 2 * e] == w1[:, None])
-        & owns[:, None]
+    found_local, val_local = l4hash_row_parts(
+        row, w0, w1, entry_words, owns=owns
     )
-    val_local = jnp.sum(
-        jnp.where(hit, row[:, 2 * e : 3 * e], 0),
-        axis=1, dtype=jnp.uint32,
-    )
-    found_local = jnp.any(hit, axis=1)
     if rows_sharded:
         val1 = jax.lax.psum(val_local, table_axis)
         found1 = (
@@ -1016,15 +1001,11 @@ def failover_lattice_probes(
         )
     else:
         val1, found1 = val_local, found_local
-    stash = tables_l.l4_hash_stash
-    s_hit = (stash[None, :, 0] == w0[:, None]) & (
-        stash[None, :, 1] == w1[:, None]
+    s_found, s_val = l4hash_stash_parts(
+        tables_l.l4_hash_stash, w0, w1, entry_words
     )
-    val1 = val1 + jnp.sum(
-        jnp.where(s_hit, stash[None, :, 2], 0),
-        axis=1, dtype=jnp.uint32,
-    )
-    found1 = found1 | jnp.any(s_hit, axis=1)
+    val1 = val1 + s_val
+    found1 = found1 | s_found
 
     wild_idx = jnp.full(
         idx.shape, jnp.uint32(L4H_WILD_IDX), jnp.uint32
@@ -1035,9 +1016,10 @@ def failover_lattice_probes(
     )
     probe1 = known & found1
     probe3 = hit3
-    val = jnp.where(probe1, val1, val3)
-    proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
-    j = (val >> jnp.uint32(16)).astype(jnp.int32)
+    proxy, j = l4hash_value_decode(
+        tables_l, ep_index, direction, probe1, val1, hit3, val3,
+        entry_words,
+    )
 
     # -- routed L3 probe with replica fallback ----------------------
     word = idx >> 5
@@ -1386,6 +1368,317 @@ def make_failover_evaluator(
         return out
 
     run.replica_axes = rep_axes
+    return run
+
+
+def make_failover_memo_evaluator(
+    mesh: Mesh,
+    tables: PolicyTables,
+    cache_rows,
+    rep_cap: int,
+    miss_cap: int = None,
+    batch_axis: str = "batch",
+    table_axis: str = "table",
+    collect_telemetry: bool = False,
+):
+    """make_failover_evaluator with the verdict-memoization plane in
+    front — the serving-plane memo carried onto the PRODUCTION
+    router path (ChipFailoverRouter.dispatch).  Each batch shard
+    dedups its tuple stream in-jit; representatives probe a cache
+    whose bucket rows shard along the table axis (the owning chip
+    gathers, one psum pair returns hit + value words) with the
+    ALIVE mask folded into ownership — a dead chip's cache slice
+    contributes nothing (those keys just miss) and its inserts
+    route to the scratch row, so cache routing can never depend on
+    a dead chip; only the MISSED representatives run the
+    replica-aware routed lattice (failover_lattice_probes).
+
+    Returns run(tables_aug, batch, alive, valid, cache_rows) ->
+    (Verdicts, l4_counts, l3_counts GLOBAL, replica_hits, cache',
+    hit bool [B], stats u32 [STATS] [, per-chip telemetry rows]) —
+    the failover evaluator's counter/telemetry contract plus the
+    memo plane's.  On stats[STAT_OVERFLOW] != 0 every output except
+    cache' (returned unchanged) is unspecified: the caller
+    re-dispatches through the uncached failover evaluator.
+    replica_hits counts backup-region gathers on the missed-rep
+    lattice path (cache hits gather no table rows at all)."""
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.engine import memo as vm
+    from cilium_tpu.engine.hashtable import fnv1a_device
+    from cilium_tpu.engine.verdict import (
+        _index_identity,
+        telemetry_masks,
+    )
+
+    if tables.l4_hash_rows is None:
+        raise ValueError(
+            "failover memo evaluator requires the hashed L4 entry "
+            "tables"
+        )
+    if miss_cap is None:
+        miss_cap = rep_cap
+    ntp = int(mesh.shape[table_axis])
+    ndp = int(mesh.shape[batch_axis])
+    rep_axes = partition.replica_axes(tables, ntp, table_axis)
+    rows_sharded = "l4_hash_rows" in rep_axes
+    l3_sharded = "l3_allow_bits" in rep_axes
+    n_rows_global = int(tables.l4_hash_rows.shape[0])
+    n_row_shard = n_rows_global // ntp if rows_sharded else 0
+    w_global = int(tables.l3_allow_bits.shape[-1])
+    wn = w_global // ntp if l3_sharded else 0
+    n_ids = w_global * 32
+    t_specs = partition.divisible_partition_specs(
+        tables, ntp, table_axis
+    )
+    cshape = tuple(cache_rows.shape)
+    if cshape[0] != ndp or cshape[1] != ntp:
+        raise ValueError(
+            f"cache rows {cshape} do not match the mesh "
+            f"({ndp}, {ntp})"
+        )
+    c_local = int(cshape[2]) - 1
+    c_global = c_local * ntp
+    entries = int(cshape[3]) // vm.CACHE_WORDS
+
+    b_specs = batch_specs(batch_axis)
+    v_specs = Verdicts(
+        allowed=P(batch_axis),
+        proxy_port=P(batch_axis),
+        match_kind=P(batch_axis),
+    )
+    l3_spec = P(None, None, table_axis) if l3_sharded else P()
+    cache_spec = P(batch_axis, table_axis)
+    out_specs = (
+        v_specs, P(), l3_spec, P(), cache_spec, P(batch_axis), P(),
+    )
+    if collect_telemetry:
+        out_specs = out_specs + (P(batch_axis, None, None),)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(t_specs, b_specs, P(), P(batch_axis), cache_spec),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def step(tables_l, batch_l, alive_l, valid_l, cache_l):
+        cache2 = cache_l[0, 0]  # [R_local + 1, 5e]
+        alive_row = alive_l[jax.lax.axis_index(batch_axis)]
+        my_col = jax.lax.axis_index(table_axis)
+        idx, known = _index_identity(tables_l, batch_l)
+        proto = jnp.clip(batch_l.proto, 0, 255).astype(jnp.int32)
+        dport = jnp.clip(batch_l.dport, 0, 65535).astype(jnp.int32)
+
+        # -- Level A: per-batch-shard dedup ---------------------------
+        k0, k1, k2 = vm.memo_key_words(
+            idx, known, None, batch_l.ep_index, batch_l.direction,
+            dport, proto,
+        )
+        g = vm.dedup_groups(k0, k1, k2, rep_cap)
+        rep_orig = g["rep_orig"]
+        r = rep_orig[:rep_cap]
+        rk0, rk1, rk2 = k0[r], k1[r], k2[r]
+
+        # -- Level B: alive-masked routed cache probe -----------------
+        h = fnv1a_device(jnp.stack([rk0, rk1, rk2], axis=1))
+        bucket = (h & jnp.uint32(c_global - 1)).astype(jnp.int32)
+        if ntp > 1:
+            pc = bucket // c_local
+            owns_c = (pc == my_col) & alive_row[pc]
+            cl = jnp.clip(bucket - pc * c_local, 0, c_local - 1)
+        else:
+            pc = jnp.zeros(bucket.shape, jnp.int32)
+            owns_c = jnp.ones(bucket.shape, bool) & alive_row[0]
+            cl = bucket
+        crow = cache2[cl]
+        e = entries
+        lane_hit = (
+            (crow[:, :e] == rk0[:, None])
+            & (crow[:, e : 2 * e] == rk1[:, None])
+            & (crow[:, 2 * e : 3 * e] == rk2[:, None])
+            & owns_c[:, None]
+        )
+        hit_local = jnp.any(lane_hit, axis=1)
+        cv0_l = jnp.sum(
+            jnp.where(lane_hit, crow[:, 3 * e : 4 * e], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        cv1_l = jnp.sum(
+            jnp.where(lane_hit, crow[:, 4 * e : 5 * e], 0),
+            axis=1, dtype=jnp.uint32,
+        )
+        if ntp > 1:
+            hit = (
+                jax.lax.psum(
+                    hit_local.astype(jnp.int32), table_axis
+                )
+                > 0
+            )
+            cv0 = jax.lax.psum(cv0_l, table_axis)
+            cv1 = jax.lax.psum(cv1_l, table_axis)
+        else:
+            hit, cv0, cv1 = hit_local, cv0_l, cv1_l
+        hit = hit & g["rep_valid"]
+        ins_lane, ins_ok = vm.bucket_insert_lanes(
+            (crow[:, :e] == vm.EMPTY) & owns_c[:, None], bucket, e
+        )
+
+        # -- miss compaction + replica-aware routed lattice -----------
+        miss = g["rep_valid"] & ~hit
+        n_miss = jnp.sum(miss.astype(jnp.int32))
+        (miss_pos,) = jnp.nonzero(
+            miss, size=miss_cap, fill_value=rep_cap
+        )
+        m_orig = rep_orig[miss_pos]
+        lat = failover_lattice_probes(
+            tables_l, batch_l.ep_index[m_orig],
+            batch_l.direction[m_orig], dport[m_orig], proto[m_orig],
+            idx[m_orig], known[m_orig], alive_row, my_col, ntp,
+            rows_sharded, l3_sharded, n_rows_global, n_row_shard,
+            wn, table_axis,
+        )
+        mv0, mv1 = vm.pack_value_words(
+            lat["probe1"], lat["probe2"], lat["probe3"],
+            lat["proxy"], lat["j"],
+        )
+
+        v0, v1, tuple_hit = vm.scatter_back(
+            g, rep_cap, hit, cv0, cv1, miss_pos, mv0, mv1
+        )
+        overflow = g["overflow"] + jnp.maximum(n_miss - miss_cap, 0)
+        ok = overflow == 0
+
+        # -- owner-local insert of missed reps ------------------------
+        do_ins = (jnp.arange(miss_cap) < n_miss) & ok
+        mp = miss_pos
+        pc_p = vm.pad_rep(pc, mp)
+        cl_p = vm.pad_rep(cl, mp)
+        lane_p = vm.pad_rep(ins_lane, mp)
+        ok_p = vm.pad_rep(ins_ok, mp)
+        own_alive = alive_row[jnp.clip(pc_p, 0, ntp - 1)]
+        own_ins = (
+            do_ins & ok_p & (pc_p == my_col) & own_alive
+        )
+        ins_row = jnp.where(own_ins, cl_p, c_local)
+        rows_idx = jnp.concatenate([ins_row] * vm.CACHE_WORDS)
+        lanes_idx = jnp.concatenate(
+            [lane_p + c * e for c in range(vm.CACHE_WORDS)]
+        )
+        vals = jnp.concatenate(
+            [
+                vm.pad_rep(rk0, mp), vm.pad_rep(rk1, mp),
+                vm.pad_rep(rk2, mp), mv0, mv1,
+            ]
+        )
+        cache_out = cache2.at[rows_idx, lanes_idx].set(vals)
+        cache_out = jnp.where(ok, cache_out, cache2)[None, None]
+
+        # -- combine + the failover counter epilogue ------------------
+        probe1, probe2, probe3, t_proxy, t_j = (
+            vm.unpack_value_words(v0, v1)
+        )
+        v = _combine(
+            probe1, probe2, probe3, t_proxy, batch_l.is_fragment
+        )
+        # full-batch L3 ownership under the alive routing: each
+        # identity word has exactly one SERVING owner (backup when
+        # the primary is dead), so restricting the global probe2 to
+        # the owned words reproduces the shard-local hit without a
+        # gather
+        word = idx >> 5
+        if l3_sharded:
+            wp = word // wn
+            apw = alive_row[wp]
+            owner_w = jnp.where(
+                apw, wp,
+                (wp + partition.REPLICA_BACKUP_OFFSET) % ntp,
+            )
+            owns_w = owner_w == my_col
+        else:
+            wp = apw = None
+            owns_w = jnp.ones(word.shape, bool)
+        p2_local = probe2 & owns_w
+        l4_counts, l3_counts = failover_counts(
+            tables_l, batch_l.ep_index, batch_l.direction,
+            v.match_kind, t_j, idx, p2_local, valid_l,
+            l3_sharded, wn, wp, apw, n_ids, batch_axis,
+        )
+        miss_live = jnp.arange(miss_cap) < n_miss
+        replica_hits = jax.lax.psum(
+            jax.lax.psum(
+                jnp.sum(
+                    (lat["replica"] & miss_live).astype(jnp.uint32)
+                ),
+                batch_axis,
+            ),
+            table_axis,
+        )
+        stats = jnp.stack(
+            [
+                g["n_unique"].astype(jnp.uint32),
+                jnp.sum(
+                    (tuple_hit & valid_l).astype(jnp.uint32)
+                ),
+                jnp.sum((do_ins & ok_p).astype(jnp.uint32)),
+                overflow.astype(jnp.uint32),
+                jnp.sum(valid_l.astype(jnp.uint32)),
+            ]
+        )
+        stats = jax.lax.psum(stats, batch_axis)
+        out = (
+            v, l4_counts, l3_counts, replica_hits, cache_out,
+            tuple_hit, stats,
+        )
+        if not collect_telemetry:
+            return out
+        zeros = jnp.zeros(v.allowed.shape, jnp.int32)
+        masks = telemetry_masks(
+            zeros, zeros, v.match_kind, v.allowed, zeros,
+            v.proxy_port, zeros, zeros,
+        )
+        ingress = (batch_l.direction == 0) & valid_l
+        row_in = jnp.stack(
+            [jnp.sum(m & ingress, dtype=jnp.uint32) for m in masks]
+        )
+        col_total = jnp.stack(
+            [jnp.sum(m & valid_l, dtype=jnp.uint32) for m in masks]
+        )
+        trow = jnp.stack([row_in, col_total - row_in])
+        return out + (trow[None],)
+
+    in_shardings = (
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), t_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), b_specs),
+        NamedSharding(mesh, P()),
+        NamedSharding(mesh, P(batch_axis)),
+        NamedSharding(mesh, cache_spec),
+    )
+    jitted = jax.jit(step, in_shardings=in_shardings)
+    aug_rows = n_rows_global * 2 if rows_sharded else n_rows_global
+    aug_words = w_global * 2 if l3_sharded else w_global
+
+    def run(tables_aug, batch, alive, valid, cache_in):
+        got = (
+            int(tables_aug.l4_hash_rows.shape[0]),
+            int(tables_aug.l3_allow_bits.shape[-1]),
+        )
+        if got != (aug_rows, aug_words) or tuple(
+            cache_in.shape
+        ) != cshape:
+            raise ValueError(
+                "failover memo evaluator geometry mismatch; rebuild "
+                "with make_failover_memo_evaluator"
+            )
+        out = jitted(tables_aug, batch, alive, valid, cache_in)
+        if l3_sharded:
+            out = (out[0], out[1], fold_l3_aug(out[2], ntp)) + tuple(
+                out[3:]
+            )
+        return out
+
     return run
 
 

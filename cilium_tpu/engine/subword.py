@@ -1,0 +1,95 @@
+"""Sub-word hot lanes: pack/unpack helpers for nibble/byte/halfword
+table planes.
+
+The fused pipeline is memory-bound on ROW GATHERS: every probe moves
+`lanes * 4` bytes per tuple whatever the entry fields actually need.
+PR 6/7 shrank the rows by cutting entries per row (the pack-width
+lever); this module cuts the BITS PER FIELD — verdict-deciding fields
+whose semantic range fits a nibble/byte/halfword (CT state+flags,
+ipcache identity indices, prefix-class lengths, verdict-cache probe
+bits) are packed k-per-u32-lane on the host and unpacked INSIDE the
+jit, exactly like the packed4 staging precedent
+(engine/datapath.pack_flow_records4): host-visible semantics are
+unchanged and bit-identity gated, only the gathered footprint shrinks.
+
+The packing is positional and exact: `pack_lanes` / `unpack_lanes`
+round-trip every value in [0, 2^width) at any supported width — the
+property suite in tests/test_subword.py pins widths {4, 8, 16} over
+their full ranges.  Widths must divide 32 so no field straddles a
+lane.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SUPPORTED_WIDTHS = (1, 2, 4, 8, 16, 32)
+
+
+def lanes_for(entries: int, width: int) -> int:
+    """u32 lanes needed for `entries` fields of `width` bits."""
+    if width not in SUPPORTED_WIDTHS:
+        raise ValueError(f"unsupported sub-word width {width}")
+    per = 32 // width
+    return (entries + per - 1) // per
+
+
+def pack_lanes(values: np.ndarray, width: int) -> np.ndarray:
+    """Host half: pack the last axis of `values` (uints < 2^width)
+    into u32 lanes, `32 // width` fields per lane, field i at bit
+    `(i % per) * width` of lane `i // per`.  Trailing partial lanes
+    are zero-padded (padding fields decode to 0)."""
+    if width not in SUPPORTED_WIDTHS:
+        raise ValueError(f"unsupported sub-word width {width}")
+    v = np.asarray(values, dtype=np.uint64)
+    if width < 32 and v.size and int(v.max()) >= (1 << width):
+        raise ValueError(
+            f"value {int(v.max())} exceeds the {width}-bit sub-word "
+            f"field"
+        )
+    if width == 32:
+        return v.astype(np.uint32)
+    per = 32 // width
+    e = v.shape[-1]
+    n_lanes = lanes_for(e, width)
+    pad = n_lanes * per - e
+    if pad:
+        v = np.concatenate(
+            [v, np.zeros(v.shape[:-1] + (pad,), np.uint64)], axis=-1
+        )
+    v = v.reshape(v.shape[:-1] + (n_lanes, per))
+    shifts = (np.arange(per, dtype=np.uint64) * width)
+    return (v << shifts).sum(axis=-1).astype(np.uint32)
+
+
+def unpack_lanes(words, width: int, entries: int, xp=None):
+    """Device/host half: u32 lanes -> the original fields along the
+    last axis ([..., entries]).  Traced-safe (xp=jnp inside a jit);
+    exact inverse of pack_lanes for values < 2^width."""
+    if xp is None:
+        import jax.numpy as jnp
+
+        xp = jnp
+    if width == 32:
+        return words[..., :entries]
+    per = 32 // width
+    lane = xp.arange(entries) // per
+    shift = ((xp.arange(entries) % per) * width).astype(xp.uint32)
+    mask = xp.uint32((1 << width) - 1)
+    return (words[..., lane] >> shift) & mask
+
+
+def unpack_lanes_np(words: np.ndarray, width: int, entries: int):
+    """NumPy spelling of unpack_lanes (host-side round-trip checks
+    and table decoders)."""
+    return np.asarray(unpack_lanes(words, width, entries, xp=np))
+
+
+def width_for_max(max_value: int, floor: int = 4) -> int:
+    """Smallest supported width (>= floor) holding `max_value` —
+    the "where semantics allow" decision, made from the REALIZED
+    values at pack time, never assumed."""
+    for w in SUPPORTED_WIDTHS:
+        if w >= floor and max_value < (1 << w):
+            return w
+    return 32

@@ -186,42 +186,132 @@ def _index(tables: PolicyTables, batch: TupleBatch):
     return idx, word, bit, known, j, has_port
 
 
+def l4hash_probe_keys(entry_words, ep, dirn, idx, dport, proto):
+    """(w0, w1) probe key words for either hashed-entry layout —
+    build side and probe side MUST stay one implementation.  `idx`
+    may carry the L4H_WILD_IDX sentinel; the compact layout remaps it
+    to its own 18-bit sentinel."""
+    from cilium_tpu.compiler.tables import (
+        L4C_WILD_IDX18,
+        L4H_WILD_IDX,
+        l4c_key0,
+        l4c_key1,
+        l4h_key0,
+        l4h_key1,
+    )
+
+    if entry_words == 2:
+        idx18 = jnp.where(
+            idx == jnp.uint32(L4H_WILD_IDX),
+            jnp.uint32(L4C_WILD_IDX18),
+            idx.astype(jnp.uint32),
+        )
+        return l4c_key0(idx18, dport), l4c_key1(dport, proto, ep, dirn)
+    return l4h_key0(idx, dirn, ep), l4h_key1(dport, proto, ep)
+
+
+def l4hash_row_parts(rows, w0, w1, entry_words, owns=None):
+    """Lane compares against pre-gathered hashed-entry rows, either
+    layout, with an optional ownership mask (the routed mesh kernels
+    gather each row on its owning shard only and psum these parts).
+    Returns (found [B], val u32 [B]) — val is `j << 16 | proxy` in
+    the 3-word layout and the bare slot index `j` in the compact one
+    (decode with l4hash_value_decode)."""
+    from cilium_tpu.compiler.tables import L4C_CMP_MASK
+
+    e = rows.shape[1] // entry_words
+    if entry_words == 2:
+        hit = (rows[:, :e] == w0[:, None]) & (
+            (rows[:, e : 2 * e] & jnp.uint32(L4C_CMP_MASK))
+            == w1[:, None]
+        )
+        vals = (rows[:, e : 2 * e] >> jnp.uint32(19)) & jnp.uint32(
+            0xFFF
+        )
+    else:
+        hit = (rows[:, :e] == w0[:, None]) & (
+            rows[:, e : 2 * e] == w1[:, None]
+        )
+        vals = rows[:, 2 * e : 3 * e]
+    if owns is not None:
+        hit = hit & owns[:, None]
+    val = jnp.sum(jnp.where(hit, vals, 0), axis=1, dtype=jnp.uint32)
+    return jnp.any(hit, axis=1), val
+
+
+def l4hash_stash_parts(stash, w0, w1, entry_words):
+    """Broadcast-compare half of the probe (the stash replicates on a
+    mesh — added AFTER the row-part psum).  Same value contract as
+    l4hash_row_parts."""
+    from cilium_tpu.compiler.tables import L4C_CMP_MASK
+
+    stash = jnp.asarray(stash)
+    if entry_words == 2:
+        s_hit = (stash[None, :, 0] == w0[:, None]) & (
+            (stash[None, :, 1] & jnp.uint32(L4C_CMP_MASK))
+            == w1[:, None]
+        )
+        vals = (stash[None, :, 1] >> jnp.uint32(19)) & jnp.uint32(
+            0xFFF
+        )
+    else:
+        s_hit = (stash[None, :, 0] == w0[:, None]) & (
+            stash[None, :, 1] == w1[:, None]
+        )
+        vals = stash[None, :, 2]
+    val = jnp.sum(
+        jnp.where(s_hit, vals, 0), axis=1, dtype=jnp.uint32
+    )
+    return jnp.any(s_hit, axis=1), val
+
+
+def l4hash_value_decode(
+    tables, ep, dirn, probe1, val1, hit3, val3, entry_words
+):
+    """Fold the exact/wild probe values into (proxy, j) — the shared
+    terminal step of every lattice probe.  The 3-word layout splits
+    the matched value word; the compact layout takes the matched slot
+    index and reconstructs the proxy port with ONE l4_meta element
+    gather (the plane the lowering keeps bit-equal to the dropped
+    per-entry copy — gated by repack_l4_subword at pack time)."""
+    val = jnp.where(probe1, val1, val3)
+    if entry_words == 3:
+        return (
+            (val & jnp.uint32(0xFFFF)).astype(jnp.int32),
+            (val >> jnp.uint32(16)).astype(jnp.int32),
+        )
+    j = val.astype(jnp.int32)
+    meta = tables.l4_meta[ep, dirn, j]
+    proxy = jnp.where(
+        probe1 | hit3, (meta >> jnp.uint32(1)).astype(jnp.int32), 0
+    )
+    return proxy, j
+
+
 def _l4hash_probe(hash_rows, hash_stash, ep, dirn, idx, dport, proto):
     """One probe of a hashed L4 entry table: a single row gather +
     lane compares (+ a small stash broadcast).  Returns (hit bool
-    [B], value u32 [B] = j << 16 | proxy_port).  The entry count per
-    bucket derives from the row width (the hot-plane pack width,
-    compiler.tables.L4H_LANES by default) — probe and build share the
-    layout through the array shape itself."""
-    from cilium_tpu.compiler.tables import l4h_key0, l4h_key1
+    [B], value u32 [B] — `j << 16 | proxy_port` in the 3-word layout,
+    the bare slot index in the compact 2-word one).  The entry count
+    per bucket derives from the row width and the layout from the
+    stash width (compiler.tables.l4_entry_words) — probe and build
+    share the layout through the array shapes themselves."""
+    from cilium_tpu.compiler.tables import l4_entry_words
     from cilium_tpu.engine.hashtable import fnv1a_device
 
-    e = hash_rows.shape[1] // 3
-    # the key packing helpers are dtype-generic — build side and
-    # probe side MUST stay one implementation
-    w0 = l4h_key0(idx, dirn, ep)
-    w1 = l4h_key1(dport, proto, ep)
+    entry_words = l4_entry_words(hash_stash)
+    w0, w1 = l4hash_probe_keys(
+        entry_words, ep, dirn, idx, dport, proto
+    )
     h = fnv1a_device(jnp.stack([w0, w1], axis=1))
     n_rows = hash_rows.shape[0]
     b = (h & jnp.uint32(n_rows - 1)).astype(jnp.int32)
     rows = jnp.asarray(hash_rows)[b]  # [B, lanes] — 1 gather
-    hit = (rows[:, :e] == w0[:, None]) & (
-        rows[:, e : 2 * e] == w1[:, None]
+    found, val = l4hash_row_parts(rows, w0, w1, entry_words)
+    s_found, s_val = l4hash_stash_parts(
+        hash_stash, w0, w1, entry_words
     )
-    val = jnp.sum(
-        jnp.where(hit, rows[:, 2 * e : 3 * e], 0),
-        axis=1,
-        dtype=jnp.uint32,
-    )
-    stash = jnp.asarray(hash_stash)
-    s_hit = (stash[None, :, 0] == w0[:, None]) & (
-        stash[None, :, 1] == w1[:, None]
-    )
-    val = val + jnp.sum(
-        jnp.where(s_hit, stash[None, :, 2], 0), axis=1, dtype=jnp.uint32
-    )
-    found = jnp.any(hit, axis=1) | jnp.any(s_hit, axis=1)
-    return found, val
+    return found | s_found, val + s_val
 
 
 def _probes(tables: PolicyTables, batch: TupleBatch, idx_known=None):
@@ -257,6 +347,9 @@ def _probes(tables: PolicyTables, batch: TupleBatch, idx_known=None):
         # and probe1 is masked by `known`; a real idx never equals the
         # wildcard sentinel — the compilers bound the identity axis
         # below L4H_WILD_IDX)
+        from cilium_tpu.compiler.tables import l4_entry_words
+
+        entry_words = l4_entry_words(tables)
         hit1, val1 = _l4hash_probe(
             tables.l4_hash_rows, tables.l4_hash_stash,
             batch.ep_index, batch.direction,
@@ -272,9 +365,10 @@ def _probes(tables: PolicyTables, batch: TupleBatch, idx_known=None):
         )
         probe1 = known & hit1
         probe3 = hit3
-        val = jnp.where(probe1, val1, val3)
-        proxy = (val & jnp.uint32(0xFFFF)).astype(jnp.int32)
-        j = (val >> jnp.uint32(16)).astype(jnp.int32)
+        proxy, j = l4hash_value_decode(
+            tables, batch.ep_index, batch.direction,
+            probe1, val1, hit3, val3, entry_words,
+        )
     else:
         # dense fallback (hand-built tables without the hash)
         from cilium_tpu.compiler.tables import NO_SLOT

@@ -178,6 +178,18 @@ class IPCacheDevice:
     # STORED (+1) prefix lengths, longest first.
     range_rows: "np.ndarray | None" = None
     range_class_plens: tuple = ()
+    # -- sub-word hot lanes (subword_ipcache) --------------------------
+    # bucket_entries != 0 marks the SUB-WORD bucket layout: planar
+    # planes (ips at u32, values at value_width bits, l3 words at
+    # l3_width bits) with `bucket_entries` entries per row — the
+    # identity-index and prefix-class words packed to the minimum
+    # bits their realized values need, unpacked in-jit.
+    # range_widths non-empty marks the sub-word range-row layout
+    # (per-plane bit widths, base plane always 32).
+    bucket_entries: int = 0
+    value_width: int = 32
+    l3_width: int = 32
+    range_widths: tuple = ()
 
     def tree_flatten(self):
         return (
@@ -200,11 +212,16 @@ class IPCacheDevice:
                 self.world_l3_in,
                 self.world_l3_out,
                 self.range_class_plens,
+                self.bucket_entries,
+                self.value_width,
+                self.l3_width,
+                self.range_widths,
             ),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
+        sub = aux[7:] if len(aux) > 7 else (0, 32, 32, ())
         return cls(
             *children[:6],
             n_buckets=aux[0],
@@ -217,6 +234,10 @@ class IPCacheDevice:
             range_l3_out=children[7],
             range_rows=children[8],
             range_class_plens=aux[6],
+            bucket_entries=sub[0],
+            value_width=sub[1],
+            l3_width=sub[2],
+            range_widths=sub[3],
         )
 
 
@@ -310,34 +331,76 @@ def range_class_key(ips, sp):
     return w0, h
 
 
-def range_row_parts(row, w0, sp, planes, owns=None):
+def range_row_parts(row, w0, sp, planes, owns=None, widths=()):
     """Lane compares of one gathered range-class row, with an
     optional ownership mask (the routed mesh probe gathers each row
     on its owning shard only; an integer psum of these parts
-    reconstructs the single-chip class result).  Returns (hit [B],
-    val [B], l3_in [B], l3_out [B])."""
+    reconstructs the single-chip class result).  `widths` non-empty
+    selects the sub-word plane layout (per-plane bit widths; the
+    plen/value/l3 planes unpack in-jit).  Returns (hit [B], val [B],
+    l3_in [B], l3_out [B])."""
     import jax.numpy as jnp
 
-    e = row.shape[1] // planes
-    hit = (row[:, :e] == w0[:, None]) & (
-        row[:, e : 2 * e] == jnp.uint32(sp)
-    )
+    e = RANGE_ENTRIES_PER_BUCKET if widths else row.shape[1] // planes
+    zero = jnp.zeros(w0.shape, jnp.uint32)
+    if not widths:
+        hit = (row[:, :e] == w0[:, None]) & (
+            row[:, e : 2 * e] == jnp.uint32(sp)
+        )
+        if owns is not None:
+            hit = hit & owns[:, None]
+
+        def msum(p):
+            return jnp.sum(
+                jnp.where(hit, row[:, p * e : (p + 1) * e], 0),
+                axis=1,
+                dtype=jnp.uint32,
+            )
+
+        return (
+            jnp.any(hit, axis=1),
+            msum(2),
+            msum(3) if planes == 5 else zero,
+            msum(4) if planes == 5 else zero,
+        )
+
+    from cilium_tpu.engine import subword as sw
+
+    offs = []
+    off = 0
+    for wdt in widths:
+        offs.append(off)
+        off += sw.lanes_for(e, wdt)
+
+    def plane(p):
+        wdt = widths[p]
+        lanes = sw.lanes_for(e, wdt)
+        return sw.unpack_lanes(
+            row[:, offs[p] : offs[p] + lanes], wdt, e, xp=jnp
+        )
+
+    hit = (row[:, :e] == w0[:, None]) & (plane(1) == jnp.uint32(sp))
     if owns is not None:
         hit = hit & owns[:, None]
 
-    def msum(p):
+    def msum(vals):
         return jnp.sum(
-            jnp.where(hit, row[:, p * e : (p + 1) * e], 0),
-            axis=1,
-            dtype=jnp.uint32,
+            jnp.where(hit, vals, 0), axis=1, dtype=jnp.uint32
         )
 
-    zero = jnp.zeros(w0.shape, jnp.uint32)
+    found = jnp.any(hit, axis=1)
+    val = msum(plane(2))
+    if widths[2] == 16:
+        val = jnp.where(
+            found & (val == jnp.uint32(_VAL16_UNKNOWN)),
+            jnp.uint32(UNKNOWN_IDX),
+            val,
+        )
     return (
-        jnp.any(hit, axis=1),
-        msum(2),
-        msum(3) if planes == 5 else zero,
-        msum(4) if planes == 5 else zero,
+        found,
+        val,
+        msum(plane(3)) if len(widths) == 5 else zero,
+        msum(plane(4)) if len(widths) == 5 else zero,
     )
 
 
@@ -374,7 +437,11 @@ def _range_hash_probe(dev: "IPCacheDevice", ips):
     for sp in dev.range_class_plens:  # static schedule, longest first
         w0, h = range_class_key(ips, sp)
         row = rows[(h & jnp.uint32(n_rows - 1)).astype(jnp.int32)]
-        classes.append(range_row_parts(row, w0, sp, planes))
+        classes.append(
+            range_row_parts(
+                row, w0, sp, planes, widths=dev.range_widths
+            )
+        )
     return range_take_fold(classes, ips.shape)
 
 
@@ -680,16 +747,188 @@ def specialize_ipcache_to_idx(
     )
 
 
+# sub-word entry counts: load stays ~4 per bucket (the compact rows
+# hold fewer entries, the transform re-buckets to keep the Poisson
+# overflow tail far below the stash)
+SUBWORD_IP_ENTRIES = 32  # idx-only form
+SUBWORD_IP_L3_ENTRIES = 16  # idx + l3-plane form
+_VAL16_UNKNOWN = np.uint32(0xFFFF)
+
+
+def subword_ipcache(dev: "IPCacheDevice") -> "IPCacheDevice":
+    """Re-place an idx-form IPCacheDevice in the SUB-WORD layout:
+    identity-index values packed to halfwords when the universe
+    allows (< 0xFFFF, with the UNKNOWN sentinel remapped to 0xFFFF),
+    per-endpoint L3 words packed to the narrowest lane their
+    realized values need (nibble/byte/halfword), and the hashed
+    range-class rows repacked the same way — the verdict-deciding
+    ipcache words shrink to the bits the fused kernel actually
+    reads.  Bucket rows re-place at SUBWORD_IP_*_ENTRIES per row
+    (load ~4); the stash keeps its legacy u32 layout (broadcast
+    compare, not a gather).  Lookups are bit-identical by
+    construction; a non-idx-form input is returned unchanged."""
+    from cilium_tpu.engine import subword as sw
+    from cilium_tpu.engine.hashtable import _fnv1a_host
+
+    if not isinstance(dev, IPCacheDevice) or not dev.values_are_idx:
+        return dev
+    if dev.bucket_entries:
+        return dev  # already sub-word
+
+    per_old = 32 if dev.l3_planes else IP_ENTRIES_PER_BUCKET
+    ips = dev.buckets[:, :per_old].reshape(-1)
+    vals = dev.buckets[:, per_old : 2 * per_old].reshape(-1)
+    live = ips != _EMPTY_IP
+    cols = [ips[live], vals[live]]
+    if dev.l3_planes:
+        cols.append(
+            dev.buckets[:, 2 * per_old : 3 * per_old].reshape(-1)[
+                live
+            ]
+        )
+        cols.append(
+            dev.buckets[:, 3 * per_old : 4 * per_old].reshape(-1)[
+                live
+            ]
+        )
+    # fold the stash entries in: re-placement may seat them in rows
+    s = dev.stash
+    s_live = s[:, 0] != _EMPTY_IP
+    cols[0] = np.concatenate([cols[0], s[s_live, 0]])
+    cols[1] = np.concatenate([cols[1], s[s_live, 1]])
+    if dev.l3_planes:
+        cols[2] = np.concatenate([cols[2], s[s_live, 2]])
+        cols[3] = np.concatenate([cols[3], s[s_live, 3]])
+
+    real = cols[1] != UNKNOWN_IDX
+    vmax = int(cols[1][real].max()) if real.any() else 0
+    vmax = max(vmax, int(dev.world_plus1))
+    rv_real = dev.range_value != UNKNOWN_IDX
+    if rv_real.any():
+        vmax = max(vmax, int(dev.range_value[rv_real].max()))
+    value_width = 16 if vmax < int(_VAL16_UNKNOWN) else 32
+    l3_width = 32
+    if dev.l3_planes:
+        l3_max = max(
+            int(cols[2].max()) if len(cols[2]) else 0,
+            int(cols[3].max()) if len(cols[3]) else 0,
+            int(dev.world_l3_in), int(dev.world_l3_out),
+            int(dev.range_l3_in.max()) if dev.range_l3_in is not None
+            and len(dev.range_l3_in) else 0,
+            int(dev.range_l3_out.max()) if dev.range_l3_out is not None
+            and len(dev.range_l3_out) else 0,
+        )
+        l3_width = sw.width_for_max(l3_max, floor=4)
+
+    def enc_val(v: np.ndarray) -> np.ndarray:
+        if value_width == 32:
+            return v.astype(np.uint32)
+        return np.where(
+            v == UNKNOWN_IDX, _VAL16_UNKNOWN, v
+        ).astype(np.uint32)
+
+    per = (
+        SUBWORD_IP_L3_ENTRIES if dev.l3_planes
+        else SUBWORD_IP_ENTRIES
+    )
+    nb = 16
+    while nb * 4 < max(len(cols[0]), 1):
+        nb *= 2
+    lanes_v = sw.lanes_for(per, value_width)
+    lanes_l = sw.lanes_for(per, l3_width) if dev.l3_planes else 0
+    width = per + lanes_v + 2 * lanes_l
+    # staged per-bucket planes, packed at the end
+    b_ips = np.full((nb, per), _EMPTY_IP, np.uint32)
+    b_val = np.zeros((nb, per), np.uint32)
+    b_l3i = np.zeros((nb, per), np.uint32)
+    b_l3o = np.zeros((nb, per), np.uint32)
+    stash = np.zeros(
+        (IP_STASH, 4 if dev.l3_planes else 2), np.uint32
+    )
+    stash[:, 0] = _EMPTY_IP
+    fill = np.zeros(nb, np.int64)
+    sfill = 0
+    hs = _fnv1a_host(cols[0][:, None].astype(np.uint32))
+    for i in range(len(cols[0])):
+        b = int(hs[i]) & (nb - 1)
+        k = int(fill[b])
+        if k < per:
+            b_ips[b, k] = cols[0][i]
+            b_val[b, k] = enc_val(cols[1][i : i + 1])[0]
+            if dev.l3_planes:
+                b_l3i[b, k] = cols[2][i]
+                b_l3o[b, k] = cols[3][i]
+            fill[b] = k + 1
+        elif sfill < IP_STASH:
+            # stash keeps LEGACY (unencoded) values
+            if dev.l3_planes:
+                stash[sfill] = (
+                    cols[0][i], cols[1][i], cols[2][i], cols[3][i],
+                )
+            else:
+                stash[sfill] = (cols[0][i], cols[1][i])
+            sfill += 1
+        else:
+            raise ValueError("sub-word ipcache bucket/stash overflow")
+    planes = [b_ips, sw.pack_lanes(b_val, value_width)]
+    if dev.l3_planes:
+        planes.append(sw.pack_lanes(b_l3i, l3_width))
+        planes.append(sw.pack_lanes(b_l3o, l3_width))
+    buckets = np.concatenate(planes, axis=1)
+    assert buckets.shape[1] == width
+
+    rrows = dev.range_rows
+    rw: tuple = ()
+    if rrows is not None and len(dev.range_class_plens):
+        e = RANGE_ENTRIES_PER_BUCKET
+        n_planes = 5 if dev.l3_planes else 3
+        plane_widths = [32, 8, value_width] + (
+            [l3_width, l3_width] if dev.l3_planes else []
+        )
+        packed = []
+        for p in range(n_planes):
+            plane = rrows[:, p * e : (p + 1) * e]
+            if p == 2 and value_width == 16:
+                plane = np.where(
+                    plane == UNKNOWN_IDX, _VAL16_UNKNOWN, plane
+                ).astype(np.uint32)
+            packed.append(sw.pack_lanes(plane, plane_widths[p]))
+        rrows = np.concatenate(packed, axis=1)
+        rw = tuple(plane_widths)
+
+    import dataclasses
+
+    return dataclasses.replace(
+        dev,
+        buckets=buckets,
+        stash=_trim_ip_stash(stash, sfill),
+        n_buckets=nb,
+        range_rows=rrows,
+        bucket_entries=per,
+        value_width=value_width,
+        l3_width=l3_width,
+        range_widths=rw,
+    )
+
+
 def ipcache_bucket_parts(dev, rows, ips, ingress=None, owns=None):
     """Exact-/32 probe parts from gathered bucket rows, with an
     optional ownership mask (the routed mesh probe gathers each
     bucket row on its owning shard only; an integer psum of these
-    parts reconstructs the single-chip result).  Returns (found [B],
-    val u32 [B], l3 u32 [B] — zeros unless the table carries l3
-    planes, selected by `ingress`)."""
+    parts reconstructs the single-chip result).  Layout-generic:
+    sub-word tables (dev.bucket_entries != 0) unpack their packed
+    value/l3 planes in-jit.  Returns (found [B], val u32 [B],
+    l3 u32 [B] — zeros unless the table carries l3 planes, selected
+    by `ingress`)."""
     import jax.numpy as jnp
 
-    per = 32 if dev.l3_planes else IP_ENTRIES_PER_BUCKET
+    from cilium_tpu.engine import subword as sw
+
+    sub = bool(dev.bucket_entries)
+    per = (
+        dev.bucket_entries if sub
+        else (32 if dev.l3_planes else IP_ENTRIES_PER_BUCKET)
+    )
     hit = rows[:, :per] == ips[:, None]  # [B, per]
     if owns is not None:
         hit = hit & owns[:, None]
@@ -699,16 +938,50 @@ def ipcache_bucket_parts(dev, rows, ips, ingress=None, owns=None):
             jnp.where(hit, plane, 0), axis=1, dtype=jnp.uint32
         )
 
-    val = msum(rows[:, per : 2 * per])
+    found = jnp.any(hit, axis=1)
+    if not sub:
+        val = msum(rows[:, per : 2 * per])
+        l3 = jnp.zeros(ips.shape, jnp.uint32)
+        if dev.l3_planes:
+            l3_plane = jnp.where(
+                jnp.asarray(ingress)[:, None],
+                rows[:, 2 * per : 3 * per],
+                rows[:, 3 * per : 4 * per],
+            )
+            l3 = msum(l3_plane)
+        return found, val, l3
+
+    vw, lw = dev.value_width, dev.l3_width
+    lanes_v = sw.lanes_for(per, vw)
+    off = per
+    vals = sw.unpack_lanes(
+        rows[:, off : off + lanes_v], vw, per, xp=jnp
+    )
+    val = msum(vals)
+    if vw == 16:
+        # the halfword sentinel decodes back to UNKNOWN_IDX — at
+        # most one lane hits (ips are unique per bucket), so the
+        # post-sum remap is exact
+        val = jnp.where(
+            found & (val == jnp.uint32(_VAL16_UNKNOWN)),
+            jnp.uint32(UNKNOWN_IDX),
+            val,
+        )
     l3 = jnp.zeros(ips.shape, jnp.uint32)
     if dev.l3_planes:
-        l3_plane = jnp.where(
-            jnp.asarray(ingress)[:, None],
-            rows[:, 2 * per : 3 * per],
-            rows[:, 3 * per : 4 * per],
+        off += lanes_v
+        lanes_l = sw.lanes_for(per, lw)
+        l3i = sw.unpack_lanes(
+            rows[:, off : off + lanes_l], lw, per, xp=jnp
         )
-        l3 = msum(l3_plane)
-    return jnp.any(hit, axis=1), val, l3
+        l3o = sw.unpack_lanes(
+            rows[:, off + lanes_l : off + 2 * lanes_l], lw, per,
+            xp=jnp,
+        )
+        l3 = msum(
+            jnp.where(jnp.asarray(ingress)[:, None], l3i, l3o)
+        )
+    return found, val, l3
 
 
 def ipcache_stash_parts(dev, ips, ingress=None):

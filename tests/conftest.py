@@ -21,15 +21,48 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+# tier-1 runtime guard: the driver kills the suite at 870 s, so the
+# fast tier must FAIL LOUDLY (not time out silently) when test
+# accretion pushes it past this budget — the failure names the
+# overrun so the offending additions get moved behind -m slow
+TIER1_BUDGET_S = 800.0
+_session_t0 = None
 
 
 def pytest_configure(config):
+    global _session_t0
+    _session_t0 = time.monotonic()
     config.addinivalue_line(
         "markers",
         "slow: full-scale storms/benches excluded from tier-1 "
         "(-m 'not slow')",
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail the tier-1 run when it exceeds the runtime budget.  Only
+    armed for the fast tier (-m 'not slow'): full-scale slow runs
+    are expected to take longer."""
+    markexpr = getattr(session.config.option, "markexpr", "") or ""
+    if "not slow" not in markexpr or _session_t0 is None:
+        return
+    elapsed = time.monotonic() - _session_t0
+    if elapsed > TIER1_BUDGET_S:
+        session.exitstatus = 1
+        tr = session.config.pluginmanager.get_plugin(
+            "terminalreporter"
+        )
+        msg = (
+            f"tier-1 suite took {elapsed:.0f} s, over the "
+            f"{TIER1_BUDGET_S:.0f} s budget (driver timeout 870 s) "
+            f"— move new tests behind -m slow or speed them up"
+        )
+        if tr is not None:
+            tr.write_line("ERROR: " + msg, red=True)
 
 
 @pytest.fixture(autouse=True)

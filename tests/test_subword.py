@@ -1,0 +1,792 @@
+"""Sub-word hot planes + the persistent fused-pair program.
+
+Covers the PR-13 tentpole surface:
+
+  * pack/unpack round-trip property at widths {4, 8, 16} over their
+    full value ranges (host pack, device AND host unpack);
+  * per-plane bit-identity of the sub-word transforms (compact
+    2-word L4 entries, 4-word CT lanes incl. dual-homed DNAT
+    copies, packed ipcache idx/l3/prefix-class planes, nibble
+    verdict-cache value lanes) against the legacy layouts;
+  * the fused pipeline end-to-end: sub-word world through the
+    PERSISTENT program vs the reference per-pair program — all 15
+    verdict columns + counters + telemetry, uniform and Zipf,
+    with the launch-count proof (one launch per K pair batches, no
+    per-direction dispatch) and async == sync;
+  * the routed mesh at tp=2 with a poisoned dead chip over sub-word
+    tables;
+  * the delta-publication seam: layout-stamp refusal + full-upload
+    fallback across the sub-word repack, and a churn gate at a
+    non-default pack width;
+  * the PR-11 remainders: the partitioned memo evaluator on the
+    router's dispatch path, and the change-record-scoped
+    DatapathStore publish.
+"""
+
+import dataclasses
+import ipaddress
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, "/root/repo/tools")
+
+from cilium_tpu.compiler.tables import (
+    FleetCompiler,
+    compile_map_states,
+    l4_entry_words,
+    repack_hash_lanes,
+    repack_l4_subword,
+    tables_layout_version,
+)
+from cilium_tpu.engine import subword as sw
+from cilium_tpu.engine.datapath import (
+    FlowBatch,
+    PersistentPairDispatcher,
+    datapath_layout_version,
+    datapath_step_accum_pair_telem_packed4_stacked,
+    datapath_step_with_counters,
+    pack_flow_records4,
+    subword_datapath_tables,
+)
+from cilium_tpu.engine.verdict import (
+    TupleBatch,
+    evaluate_batch,
+    make_counter_buffers,
+    make_telemetry_buffers,
+)
+from cilium_tpu.maps.policymap import PolicyKey, PolicyMapStateEntry
+
+_FUSED_COLS = (
+    "allowed", "proxy_port", "match_kind", "ct_result",
+    "pre_dropped", "sec_id", "final_daddr", "final_dport",
+    "rev_nat", "lb_slave", "ct_create", "ct_delete",
+    "tunnel_endpoint", "l4_slot", "ipcache_miss",
+)
+
+
+def _mesh(tp):
+    devs = jax.devices()
+    if len(devs) < tp:
+        pytest.skip(f"needs {tp} devices")
+    return jax.sharding.Mesh(
+        np.array(devs).reshape(len(devs) // tp, tp),
+        ("batch", "table"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# round-trip property suite
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("width", [4, 8, 16])
+def test_subword_roundtrip_full_range(width):
+    """Every packed field is exact over its full value range: 4- and
+    8-bit widths exhaustively, 16-bit over boundaries + a dense
+    sample, through the host pack and BOTH unpack shims (numpy and
+    the jitted device path)."""
+    if width <= 8:
+        vals = np.arange(1 << width, dtype=np.uint32)
+    else:
+        rng = np.random.default_rng(width)
+        vals = np.unique(
+            np.concatenate(
+                [
+                    np.array([0, 1, 0x7FFF, 0x8000, 0xFFFE, 0xFFFF]),
+                    rng.integers(0, 1 << width, 4096),
+                ]
+            )
+        ).astype(np.uint32)
+    for entries in (1, 7, 8, 16, 33):
+        cols = np.resize(vals, (3, entries)).astype(np.uint32)
+        packed = sw.pack_lanes(cols, width)
+        assert packed.shape[-1] == sw.lanes_for(entries, width)
+        back = sw.unpack_lanes_np(packed, width, entries)
+        np.testing.assert_array_equal(back, cols)
+        dev = jax.jit(
+            lambda w: sw.unpack_lanes(w, width, entries)
+        )(packed)
+        np.testing.assert_array_equal(np.asarray(dev), cols)
+    # out-of-range values must refuse, not truncate
+    if width < 32:
+        with pytest.raises(ValueError):
+            sw.pack_lanes(
+                np.array([1 << width], np.uint32), width
+            )
+
+
+def test_width_for_max():
+    assert sw.width_for_max(3) == 4
+    assert sw.width_for_max(15) == 4
+    assert sw.width_for_max(16) == 8
+    assert sw.width_for_max(0xFFFF) == 16
+    assert sw.width_for_max(0x10000) == 32
+
+
+# ---------------------------------------------------------------------------
+# per-plane transforms
+# ---------------------------------------------------------------------------
+
+
+def _policy_world(rng, n_ids=500, n_eps=5, n_entries=200):
+    ids = [256 + i for i in range(n_ids)]
+    states = []
+    for _ in range(n_eps):
+        st = {}
+        for _ in range(n_entries):
+            ident = int(rng.choice(ids)) if rng.random() < 0.9 else 0
+            dport = int(rng.integers(1, 60000))
+            proto = int(rng.choice([6, 17]))
+            d = int(rng.integers(0, 2))
+            proxy = 8080 if (dport + d) % 7 == 0 else 0
+            if rng.random() < 0.1:
+                st[PolicyKey(ident or 256, 0, 0, d)] = (
+                    PolicyMapStateEntry()
+                )
+            else:
+                st[PolicyKey(ident, dport, proto, d)] = (
+                    PolicyMapStateEntry(proxy_port=proxy)
+                )
+        states.append(st)
+    return compile_map_states(states, ids), ids, n_eps
+
+
+def test_compact_l4_bit_identity_and_roundtrip():
+    rng = np.random.default_rng(0)
+    tables, ids, n_eps = _policy_world(rng)
+    compact = repack_l4_subword(tables)
+    assert l4_entry_words(tables) == 3
+    assert l4_entry_words(compact) == 2
+    # the pack width joins the layout stamp (delta refusal seam)
+    assert tables_layout_version(compact) != tables_layout_version(
+        tables
+    )
+    b = 4096
+    batch = TupleBatch.from_numpy(
+        ep_index=rng.integers(0, n_eps, b),
+        identity=rng.choice(
+            np.array(ids + [1, 2, 9999]), b
+        ).astype(np.uint32),
+        dport=rng.integers(0, 65536, b),
+        proto=rng.choice([6, 17, 1], b),
+        direction=rng.integers(0, 2, b),
+    )
+    v1 = evaluate_batch(tables, batch)
+    v2 = evaluate_batch(compact, batch)
+    for c in ("allowed", "proxy_port", "match_kind"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(v1, c)), np.asarray(getattr(v2, c)),
+            err_msg=c,
+        )
+    # round trip back to the 3-word layout at any lane width
+    back = repack_hash_lanes(compact, 64)
+    v3 = evaluate_batch(back, batch)
+    for c in ("allowed", "proxy_port", "match_kind"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(v1, c)), np.asarray(getattr(v3, c)),
+        )
+
+
+def test_ct_compact_bit_identity_dual_home():
+    from cilium_tpu.ct.device import (
+        compact_ct_snapshot,
+        compile_ct,
+        ct_lookup_batch,
+        expand_ct_snapshot,
+    )
+    from cilium_tpu.ct.table import CTMap, CTTuple
+
+    rng = np.random.default_rng(3)
+    ct = CTMap(max_entries=2048)
+    tuples = []
+    for _ in range(800):
+        t = CTTuple(
+            int(rng.integers(1, 2**32)), int(rng.integers(1, 2**32)),
+            int(rng.integers(1, 65536)), int(rng.integers(1, 65536)),
+            int(rng.choice([6, 17])),
+        )
+        kw = {}
+        if rng.random() < 0.3:  # DNATed: dual-homed device copies
+            kw = dict(
+                rev_nat_index=int(rng.integers(1, 200)),
+                slave=int(rng.integers(1, 200)),
+                orig_daddr=int(rng.integers(1, 2**32)),
+                orig_dport=int(rng.integers(1, 65536)),
+            )
+        ct.create_best_effort(
+            t, int(rng.integers(0, 3)), now=0, **kw
+        )
+        tuples.append(t)
+    snap = compile_ct(ct)
+    csnap = compact_ct_snapshot(snap)
+    assert csnap.entry_words == 4
+    assert csnap.buckets.shape[1] == 64
+    b = 3000
+    daddr = rng.integers(1, 2**32, b).astype(np.uint32)
+    saddr = rng.integers(1, 2**32, b).astype(np.uint32)
+    dport = rng.integers(1, 65536, b)
+    sport = rng.integers(1, 65536, b)
+    proto = rng.choice([6, 17], b)
+    for i in range(0, b, 3):  # mix real tuples in
+        t = tuples[i % len(tuples)]
+        daddr[i], saddr[i] = t.daddr, t.saddr
+        dport[i], sport[i], proto[i] = t.dport, t.sport, t.nexthdr
+    direction = rng.integers(0, 3, b)
+    r1 = ct_lookup_batch(snap, daddr, saddr, dport, sport, proto,
+                         direction)
+    r2 = ct_lookup_batch(csnap, daddr, saddr, dport, sport, proto,
+                         direction)
+    for a, c in zip(r1, r2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    # round trip
+    r3 = ct_lookup_batch(
+        expand_ct_snapshot(csnap), daddr, saddr, dport, sport,
+        proto, direction,
+    )
+    for a, c in zip(r1, r3):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    # semantics guard: an oversized rev_nat refuses the compact form
+    ct2 = CTMap(max_entries=64)
+    ct2.create_best_effort(
+        CTTuple(1, 2, 3, 4, 6), 0, now=0, rev_nat_index=300, slave=1,
+    )
+    with pytest.raises(ValueError):
+        compact_ct_snapshot(compile_ct(ct2))
+
+
+def test_subword_ipcache_bit_identity():
+    from cilium_tpu.ipcache.lpm import (
+        build_ipcache,
+        ipcache_lookup_fused,
+        specialize_ipcache_to_idx,
+        subword_ipcache,
+    )
+
+    rng = np.random.default_rng(7)
+    tables, ids, n_eps = _policy_world(rng, n_ids=200, n_eps=5)
+    base = int(ipaddress.ip_address("10.0.0.1"))
+    mapping = {}
+    for i, num in enumerate(ids[:150]):
+        mapping[str(ipaddress.ip_address(base + i)) + "/32"] = num
+    mapping["172.16.0.0/12"] = ids[3]
+    mapping["192.168.4.0/24"] = ids[4]
+    mapping["10.9.0.0/16"] = ids[5]
+    dev = specialize_ipcache_to_idx(build_ipcache(mapping), tables)
+    sub = subword_ipcache(dev)
+    assert sub.bucket_entries != 0
+    assert sub.buckets.shape[1] < dev.buckets.shape[1]
+    b = 4096
+    ips = np.where(
+        rng.random(b) < 0.6,
+        base + rng.integers(0, 200, b),
+        rng.integers(1, 2**32, b),
+    ).astype(np.uint32)
+    ing = rng.random(b) < 0.5
+
+    def look(d):
+        if d.l3_planes:
+            v, l3 = jax.jit(
+                lambda dd, ii, gg: ipcache_lookup_fused(
+                    dd, ii, ingress=gg
+                )
+            )(d, jax.numpy.asarray(ips), jax.numpy.asarray(ing))
+        else:
+            v, l3 = jax.jit(
+                lambda dd, ii: ipcache_lookup_fused(dd, ii)
+            )(d, jax.numpy.asarray(ips))
+        return np.asarray(v), None if l3 is None else np.asarray(l3)
+
+    v1, l31 = look(dev)
+    v2, l32 = look(sub)
+    np.testing.assert_array_equal(v1, v2)
+    if l31 is not None:
+        np.testing.assert_array_equal(l31, l32)
+
+
+def test_subword_cache_rows_serve_hits():
+    from cilium_tpu.engine import memo as vm
+
+    rng = np.random.default_rng(5)
+    tables, ids, n_eps = _policy_world(
+        rng, n_ids=100, n_eps=3, n_entries=60
+    )
+    b = 512
+    kern = vm.memo_evaluate_kernel(rep_cap=b)
+    batches = [
+        TupleBatch.from_numpy(
+            ep_index=rng.integers(0, n_eps, b),
+            identity=rng.choice(np.array(ids), b).astype(np.uint32),
+            dport=rng.choice([53, 80, 443, 999], b),
+            proto=np.full(b, 6),
+            direction=rng.integers(0, 2, b),
+        )
+        for _ in range(3)
+    ]
+    results = {}
+    for subword in (False, True):
+        rows = jax.device_put(
+            vm.make_cache_rows(1 << 8, 8, subword=subword)
+        )
+        e, ranked, subw = vm.cache_layout(np.asarray(rows))
+        assert (e, ranked, subw) == (8, True, subword)
+        hits = 0
+        for bt in batches:
+            ref = evaluate_batch(tables, bt)
+            v, rows, hit, stats = kern(tables, bt, rows)
+            for c in ("allowed", "proxy_port", "match_kind"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(v, c)),
+                    np.asarray(getattr(ref, c)),
+                )
+            s = np.asarray(stats)
+            assert int(s[vm.STAT_OVERFLOW]) == 0
+            hits += int(s[vm.STAT_HIT])
+        results[subword] = hits
+        assert hits > 0
+    # same batches, same insert-lane discipline: identical hit counts
+    assert results[False] == results[True]
+    # and the sub-word layout is genuinely narrower
+    assert vm.make_cache_rows(64, 8, subword=True).shape[-1] < (
+        vm.make_cache_rows(64, 8).shape[-1]
+    )
+
+
+# ---------------------------------------------------------------------------
+# fused pipeline: sub-word + persistent pair
+# ---------------------------------------------------------------------------
+
+
+def _fused_subword_world(seed=7):
+    import chaos_storm as storm
+
+    dt, parts = storm._fused_world(seed)
+    sub, report = subword_datapath_tables(dt)
+    assert all(v == "packed" for v in report.values()), report
+    return dt, sub, parts
+
+
+def _mk_pair(rng, half, zipf=None):
+    base = int(ipaddress.ip_address("10.0.0.1"))
+    vip = int(ipaddress.ip_address("192.168.0.10"))
+    pair = np.empty((2, 4, half), np.uint32)
+    for r in range(2):
+        if zipf is None:
+            src = base + rng.integers(0, 64, half)
+        else:
+            ranks = np.minimum(
+                rng.zipf(zipf, half) - 1, 63
+            )
+            src = base + ranks
+        pair[r] = pack_flow_records4(
+            ep_index=rng.integers(0, 3, half),
+            saddr=src.astype(np.uint32),
+            daddr=np.where(
+                rng.random(half) < 0.3, vip,
+                base + rng.integers(0, 64, half),
+            ).astype(np.uint32),
+            sport=rng.integers(1024, 65535, half),
+            dport=rng.choice([53, 80, 443, 8080], half),
+            proto=rng.choice([6, 17], half),
+            direction=np.full(half, r),
+        )
+    return pair
+
+
+def test_subword_persistent_full_surface_bit_identity():
+    """The acceptance gate: sub-word tables through the persistent
+    fused-pair program vs the legacy reference pair — 15 verdict
+    columns + l4/l3 counters + telemetry, uniform AND Zipf pairs,
+    exactly one launch per K pair batches proven by the jit-tracking
+    counters, and async == sync."""
+    from cilium_tpu.metrics import registry as metrics
+
+    dt, sub, parts = _fused_subword_world(7)
+    rng = np.random.default_rng(1)
+    pairs = [_mk_pair(rng, 192) for _ in range(4)] + [
+        _mk_pair(rng, 192, zipf=1.3) for _ in range(3)
+    ]
+    # reference: legacy tables, per-pair program
+    acc1 = jax.device_put(make_counter_buffers(dt.policy))
+    tel1 = jax.device_put(make_telemetry_buffers())
+    ref = []
+    for p in pairs:
+        oi, oe, acc1, tel1 = (
+            datapath_step_accum_pair_telem_packed4_stacked(
+                dt, jax.device_put(p), acc1, tel1
+            )
+        )
+        ref.append((oi, oe))
+    # sub-word through the persistent K=3 program
+    site = "test.persistent"
+    h0 = metrics.jit_cache_hits.get(site)
+    m0 = metrics.jit_cache_misses.get(site)
+    acc2 = jax.device_put(make_counter_buffers(sub.policy))
+    tel2 = jax.device_put(make_telemetry_buffers())
+    disp = PersistentPairDispatcher(sub, 3, acc2, tel2, site=site)
+    got = []
+    for p in pairs:
+        got.extend(disp.submit(p))
+    rem, acc2, tel2 = disp.flush()
+    got.extend(rem)
+    # 7 pairs at K=3 → 2 super-launches + 1 remainder launch: the
+    # jit-tracked site counters (cilium_jit_cache_*) prove no
+    # per-direction dispatch and no per-pair launch inside a
+    # super-batch
+    assert disp.launches == 2
+    calls = (
+        metrics.jit_cache_hits.get(site) - h0
+        + metrics.jit_cache_misses.get(site) - m0
+    )
+    assert calls == 2, calls
+    for (ri, re_), (gi, ge) in zip(ref, got):
+        for col in _FUSED_COLS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ri, col)),
+                np.asarray(getattr(gi, col)), err_msg="in " + col,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(getattr(re_, col)),
+                np.asarray(getattr(ge, col)), err_msg="eg " + col,
+            )
+    np.testing.assert_array_equal(np.asarray(acc1), np.asarray(acc2))
+    np.testing.assert_array_equal(np.asarray(tel1), np.asarray(tel2))
+
+    # async (no intermediate sync) == sync (block every super-batch)
+    acc3 = jax.device_put(make_counter_buffers(sub.policy))
+    tel3 = jax.device_put(make_telemetry_buffers())
+    disp3 = PersistentPairDispatcher(sub, 3, acc3, tel3)
+    got3 = []
+    for p in pairs:
+        outs = disp3.submit(p)
+        if outs:
+            jax.block_until_ready(outs[-1][0].allowed)
+        got3.extend(outs)
+    rem3, acc3, tel3 = disp3.flush()
+    got3.extend(rem3)
+    for (gi, ge), (si, se) in zip(got, got3):
+        np.testing.assert_array_equal(
+            np.asarray(gi.allowed), np.asarray(si.allowed)
+        )
+    np.testing.assert_array_equal(np.asarray(acc2), np.asarray(acc3))
+    np.testing.assert_array_equal(np.asarray(tel2), np.asarray(tel3))
+
+
+def test_subword_routed_mesh_chip_out():
+    """Sub-word tables through the routed fused evaluator at tp=2:
+    bit-identical to the legacy single-device program healthy AND
+    with a dead chip whose primary regions are scribbled."""
+    import chaos_storm as storm
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.engine.datapath_mesh import (
+        make_failover_datapath_evaluator,
+    )
+
+    tp = 2
+    mesh = _mesh(tp)
+    dp = len(jax.devices()) // tp
+    rng = np.random.default_rng(11)
+    dt, sub, parts = _fused_subword_world(11)
+    tuples = storm._fused_flows(rng, 128, parts)
+    fb = FlowBatch.from_numpy(**tuples)
+    ref_out, ref_l4, ref_l3 = datapath_step_with_counters(dt, fb)
+
+    ev = make_failover_datapath_evaluator(mesh, sub)
+    aug = partition.replicate_datapath_leaves(sub, tp)
+    sh = partition.datapath_table_shardings(mesh, aug)
+    dev = jax.tree.map(
+        lambda leaf, s: jax.device_put(np.asarray(leaf), s), aug, sh
+    )
+    alive = np.ones((dp, tp), bool)
+    valid = np.ones(128, bool)
+    out, l4c, l3c, hits = ev(dev, fb, alive, valid)
+    for f in _FUSED_COLS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out, f)),
+            np.asarray(getattr(ref_out, f)), err_msg=f,
+        )
+    np.testing.assert_array_equal(np.asarray(l4c), np.asarray(ref_l4))
+    np.testing.assert_array_equal(np.asarray(l3c), np.asarray(ref_l3))
+
+    victim = tp - 1
+
+    def poison(arr, axis):
+        a = np.array(arr)
+        n = a.shape[axis] // (2 * tp)
+        sl = [slice(None)] * a.ndim
+        sl[axis] = slice(victim * 2 * n, victim * 2 * n + n)
+        a[tuple(sl)] = 0xDEADBEEF
+        return a
+
+    fam_ups = {}
+    for (fam, leaf), axis in partition.datapath_replica_axes(
+        sub, tp
+    ).items():
+        fam_ups.setdefault(fam, {})[leaf] = poison(
+            getattr(getattr(aug, fam), leaf), axis
+        )
+    pol_ups = {
+        n: poison(getattr(aug.policy, n), ax)
+        for n, ax in partition.replica_axes(sub.policy, tp).items()
+    }
+    aug_p = dataclasses.replace(
+        aug,
+        policy=dataclasses.replace(aug.policy, **pol_ups),
+        **{
+            fam: dataclasses.replace(getattr(aug, fam), **ups)
+            for fam, ups in fam_ups.items()
+        },
+    )
+    sh = partition.datapath_table_shardings(mesh, aug_p)
+    dev_p = jax.tree.map(
+        lambda leaf, s: jax.device_put(np.asarray(leaf), s),
+        aug_p, sh,
+    )
+    alive2 = np.ones((dp, tp), bool)
+    alive2[:, victim] = False
+    out2, l4c2, l3c2, hits2 = ev(dev_p, fb, alive2, valid)
+    for f in _FUSED_COLS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out2, f)),
+            np.asarray(getattr(ref_out, f)), err_msg="dead " + f,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(l4c2), np.asarray(ref_l4)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(l3c2), np.asarray(ref_l3)
+    )
+    assert int(np.asarray(hits2)) > 0
+
+
+# ---------------------------------------------------------------------------
+# the delta-publication seam
+# ---------------------------------------------------------------------------
+
+
+_CHURN_PORTS = tuple(1000 + 13 * k for k in range(40))
+
+
+def _churn_world(rng, comp, ids, n_eps, step):
+    # the (dport, proto) slot set and the endpoint set stay FIXED so
+    # the shape class holds across steps — only row CONTENT churns
+    # (which identities each endpoint allows at which fixed port),
+    # the delta-publish steady state
+    states = []
+    for e in range(n_eps):
+        st = {}
+        for k in range(20):
+            st[
+                PolicyKey(
+                    int(ids[(e * 7 + k * 3 + step) % len(ids)]),
+                    _CHURN_PORTS[(e + k) % len(_CHURN_PORTS)],
+                    6, k % 2,
+                )
+            ] = PolicyMapStateEntry()
+        states.append(st)
+    return [(e, states[e], hash((step, e)) & 0xFFFF)
+            for e in range(n_eps)], states
+
+
+def test_churn_gate_subword_seam_nondefault_width():
+    """60-step churn at a NON-DEFAULT pack width (32-lane 3-word
+    rows): delta publish stays on the scatter path, a sub-word
+    repack mid-stream is REFUSED by the layout stamp (full-upload
+    fallback), the repacked epoch serves bit-identical verdicts,
+    and churn resumes on the delta path afterwards."""
+    from cilium_tpu.engine.publish import DeviceTableStore
+
+    rng = np.random.default_rng(17)
+    ids = [256 + i for i in range(96)]
+    n_eps = 3
+    comp = FleetCompiler(
+        identity_pad=128, filter_pad=16, hash_lanes=32
+    )
+    store = DeviceTableStore()
+    prev_tables = None
+    delta_steps = 0
+    for step in range(60):
+        eps, states = _churn_world(rng, comp, ids, n_eps, step)
+        tables, index = comp.compile(eps, ids)
+        delta = (
+            None if prev_tables is None
+            else comp.delta_for(store.spare_stamp(), tables)
+        )
+        _, stats = store.publish(tables, delta)
+        if step > 1 and stats.mode == "delta":
+            delta_steps += 1
+        prev_tables = tables
+        if step == 30:
+            # the sub-word seam: repack the published world to the
+            # compact layout — its stamp differs, so the NEXT delta
+            # (recorded against the 3-word layout) must refuse
+            compact = repack_l4_subword(tables)
+            assert tables_layout_version(compact) != (
+                tables_layout_version(tables)
+            )
+            _, stats2 = store.publish(compact, delta)
+            assert stats2.mode == "full", (
+                "cross-layout delta was not refused"
+            )
+            # the compact epoch answers bit-identically
+            b = 512
+            batch = TupleBatch.from_numpy(
+                ep_index=rng.integers(0, n_eps, b),
+                identity=rng.choice(np.array(ids), b).astype(
+                    np.uint32
+                ),
+                dport=rng.integers(0, 65536, b),
+                proto=np.full(b, 6),
+                direction=rng.integers(0, 2, b),
+            )
+            v_ref = evaluate_batch(tables, batch)
+            v_sub = evaluate_batch(store.current()[1], batch)
+            for c in ("allowed", "proxy_port", "match_kind"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(v_ref, c)),
+                    np.asarray(getattr(v_sub, c)),
+                )
+            # resume the 3-word world: full upload (stamp moved),
+            # then deltas flow again
+            store.publish(tables, None)
+            store.publish(tables, None)
+    assert delta_steps >= 40, f"only {delta_steps} delta publishes"
+
+
+def test_scoped_datapath_store_publish():
+    """Satellite: the change-record-scoped DatapathStore publish —
+    CT-writeback churn ships O(change) bytes with resident slices
+    exact; a record-less publish falls back to the full row-diff."""
+    import chaos_storm as storm
+    from cilium_tpu.compiler import partition
+    from cilium_tpu.ct.device import compile_ct
+    from cilium_tpu.ct.table import CTTuple
+    from cilium_tpu.engine.datapath_mesh import DatapathStore
+
+    tp = 2
+    mesh = _mesh(tp)
+    dt, parts = storm._fused_world(23, n_ids=32)
+    store = DatapathStore(mesh)
+    store.publish(dt)
+    store.publish(dt)
+    full_b = store.full_bytes()
+    rng = np.random.default_rng(9)
+    base = int(ipaddress.ip_address("10.0.0.1"))
+    modes = []
+    for step in range(8):
+        for _ in range(4):
+            parts["ct"].create_best_effort(
+                CTTuple(
+                    base + int(rng.integers(0, 32)),
+                    base + int(rng.integers(0, 32)),
+                    int(rng.choice([53, 80])),
+                    int(rng.integers(1024, 60000)), 6,
+                ),
+                int(rng.integers(0, 2)), now=0,
+            )
+        new_ct = compile_ct(parts["ct"])
+        dt2 = dataclasses.replace(dt, ct=new_ct)
+        chg = np.flatnonzero(
+            np.any(
+                np.asarray(dt.ct.buckets)
+                != np.asarray(new_ct.buckets),
+                axis=1,
+            )
+        )
+        changes = {"ct": {"buckets": chg, "stash": True}}
+        if step == 4:
+            changes = None  # record-less: full row-diff fallback
+        dev, stats = store.publish(dt2, changes=changes)
+        modes.append(stats.mode)
+        if stats.mode == "delta-scoped":
+            assert stats.bytes_h2d < full_b / 10
+        dt = dt2
+        aug_ref = partition.replicate_datapath_leaves(dt, tp)
+        host = store.host_augmented()
+        for leaf in ("buckets", "stash"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(host.ct, leaf)),
+                np.asarray(getattr(aug_ref.ct, leaf)),
+                err_msg=leaf,
+            )
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(dev.ct.buckets)),
+            np.asarray(aug_ref.ct.buckets),
+        )
+    assert "delta-scoped" in modes
+    assert modes[4] == "delta"  # record-less fallback
+    # warranty restored after two recorded publishes
+    assert modes[-1] == "delta-scoped"
+
+
+# ---------------------------------------------------------------------------
+# the routed memo plane (PR 11 remainder)
+# ---------------------------------------------------------------------------
+
+
+def test_router_memo_dispatch():
+    """Satellite: the partitioned memo evaluator on the router's
+    production dispatch path — probes/inserts the sharded verdict
+    cache, bit-identical to the uncached path, hits on the warm
+    pass, breaker-wired flush."""
+    from cilium_tpu.engine.failover import ChipFailoverRouter
+
+    rng = np.random.default_rng(2)
+    tables, ids, n_eps = _policy_world(
+        rng, n_ids=60, n_eps=3, n_entries=40
+    )
+    tp = 2
+    mesh = _mesh(tp)
+    router = ChipFailoverRouter(mesh, tables)
+    router.publish(tables)
+    b = 512
+    cols = dict(
+        ep_index=rng.integers(0, n_eps, b),
+        identity=rng.choice(np.array(ids), b).astype(np.uint32),
+        dport=rng.choice([53, 80, 443, 999], b),
+        proto=np.full(b, 6),
+        direction=rng.integers(0, 2, b),
+    )
+    ref = router.dispatch(**cols)
+    router.attach_memo(rep_shift=1)
+    assert router._verdict_cache is not None  # breaker-flush wired
+    got1 = router.dispatch(**cols)
+    got2 = router.dispatch(**cols)
+    for c in ("allowed", "proxy_port", "match_kind"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.verdicts, c)),
+            np.asarray(getattr(got1.verdicts, c)), err_msg=c,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.verdicts, c)),
+            np.asarray(getattr(got2.verdicts, c)), err_msg=c,
+        )
+    np.testing.assert_array_equal(ref.l4_counts, got2.l4_counts)
+    np.testing.assert_array_equal(ref.l3_counts, got2.l3_counts)
+    assert got2.cache_hit is not None
+    assert int(got2.cache_hit.sum()) > 0
+    assert router._memo["hits"] > 0
+    # a flush (what every breaker transition triggers) empties it:
+    # the next pass misses, still bit-identical
+    router._verdict_cache.flush(reason="test")
+    got3 = router.dispatch(**cols)
+    np.testing.assert_array_equal(
+        np.asarray(ref.verdicts.allowed),
+        np.asarray(got3.verdicts.allowed),
+    )
+    assert int(got3.cache_hit.sum()) == 0
+
+
+def test_datapath_layout_version_moves():
+    """The whole-datapath layout stamp covers every sub-word
+    marker (the DatapathStore refusal seam)."""
+    dt, sub, _parts = _fused_subword_world(5)
+    assert datapath_layout_version(dt) != datapath_layout_version(
+        sub
+    )
+    from cilium_tpu.engine.datapath_mesh import _geometry
+
+    assert _geometry(dt) != _geometry(sub)

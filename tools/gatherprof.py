@@ -58,14 +58,14 @@ def main() -> None:
     ap.add_argument("--pool", type=int, default=5000)
     ap.add_argument("--batch", type=int, default=1 << 16)
     ap.add_argument(
-        "--budget-bytes", type=float, default=2100.0,
-        help="hot-plane bytes-gathered-per-tuple budget (assert): "
-        "the packed layout sits ~2.0 KB/tuple (CT row 512 + ipcache "
-        "bucket row 512 + hashed range classes + two 64-lane hash "
-        "rows 512 + LB/IO), the legacy unsplit layout ~2.5 KB — the "
-        "ipcache bucket row and the per-prefix-length-class range "
-        "gathers are priced since the [B, P] range broadcast became "
-        "row gathers",
+        "--budget-bytes", type=float, default=1100.0,
+        help="hot-plane bytes-gathered-per-tuple budget (hard "
+        "assert) for the SUB-WORD model at default widths: compact "
+        "4-word CT rows (256 B), sub-word ipcache value/l3 planes, "
+        "packed prefix-class rows, and the 2-word 32-lane hashed L4 "
+        "pair (128+128 B, + one 4 B l4_meta proxy gather) land "
+        "~1.0 KB/tuple — down from ~2.0 KB packed-unsub-word and "
+        "~2.5 KB legacy-unsplit",
     )
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
@@ -89,11 +89,16 @@ def main() -> None:
         tables, policy=repack_hash_lanes(tables.policy, 128)
     )
     rows_b, hot_b, cold_b = profile_tables(legacy, packed_io=False)
-    # AFTER: compiled pack width + hot/cold split + packed4 staging
+    # MIDDLE: compiled pack width + hot/cold split + packed4 staging
     packed = dataclasses.replace(
         tables, policy=split_hot(tables.policy)
     )
     rows_a, hot_a, cold_a = profile_tables(packed, packed_io=True)
+    # AFTER: the sub-word hot planes (compact L4 / CT / ipcache)
+    from cilium_tpu.engine.datapath import subword_datapath_tables
+
+    sub, sub_report = subword_datapath_tables(packed)
+    rows_s, hot_s, cold_s = profile_tables(sub, packed_io=True)
 
     if args.json:
         print(
@@ -103,24 +108,37 @@ def main() -> None:
                                "cold": cold_b},
                     "after": {"rows": rows_a, "hot": hot_a,
                               "cold": cold_a},
+                    "subword": {"rows": rows_s, "hot": hot_s,
+                                "cold": cold_s,
+                                "report": sub_report},
                 }
             )
         )
     else:
         dump("before: 128-lane rows, unsplit", rows_b, hot_b, cold_b)
-        dump("after: packed hot plane + split", rows_a, hot_a, cold_a)
+        dump("packed: hot plane + split", rows_a, hot_a, cold_a)
+        dump(
+            f"sub-word: {sub_report}", rows_s, hot_s, cold_s
+        )
         print(
             f"hot-plane reduction: {hot_b + cold_b:.0f} -> "
-            f"{hot_a:.0f} B/tuple "
-            f"({(hot_b + cold_b) / max(hot_a, 1e-9):.2f}x)"
+            f"{hot_a:.0f} -> {hot_s:.0f} B/tuple "
+            f"({(hot_b + cold_b) / max(hot_s, 1e-9):.2f}x total)"
         )
 
-    assert hot_a <= args.budget_bytes, (
-        f"hot plane gathers {hot_a:.0f} B/tuple, over the "
+    assert hot_s <= args.budget_bytes, (
+        f"sub-word hot plane gathers {hot_s:.0f} B/tuple, over the "
         f"{args.budget_bytes:.0f} B budget"
     )
     assert hot_a < hot_b + cold_b, (
         "the split+pack must strictly reduce gathered bytes"
+    )
+    assert hot_s <= 0.6 * hot_a, (
+        f"the sub-word planes must cut the packed model >= 40% "
+        f"({hot_a:.0f} -> {hot_s:.0f})"
+    )
+    assert all(v == "packed" for v in sub_report.values()), (
+        f"a default-widths plane refused to pack: {sub_report}"
     )
 
     # sharded-plane model: per-tuple HOT bytes are unchanged by the
@@ -141,10 +159,10 @@ def main() -> None:
         )
         print(
             f"  {ns} shards: {aa:5.0f} B/tuple psum traffic "
-            f"({100.0 * aa / max(hot_a, 1e-9):.1f}% of the "
-            f"{hot_a:.0f} B hot gathers)"
+            f"({100.0 * aa / max(hot_s, 1e-9):.1f}% of the "
+            f"{hot_s:.0f} B sub-word hot gathers)"
         )
-        assert aa < hot_a / 10, (
+        assert aa < hot_s / 10, (
             "routed-psum traffic must stay an order of magnitude "
             "below the hot gathers"
         )
