@@ -1215,6 +1215,21 @@ class Daemon:
             )
         kernel = vm.memo_evaluate_kernel(rep_cap=rep_cap)
         v, rows, hit, stats = kernel(tables, batch, rows_in)
+        # memo.insert fault seam — the write-back commit is the
+        # verdict-cache insert path's host half.  The fault
+        # PROPAGATES (never swallowed here): guarded_dispatch retries
+        # re-run the memoized attempt (kernel not donated, carried
+        # cache untouched), and a persistent schedule exhausts them
+        # into the dispatch breaker whose host fold serves the batch
+        # bit-identically; the dispatch-failure handler flushes the
+        # cache, so no partial insert can outlive the fault.
+        from cilium_tpu import faultinject
+
+        try:
+            faultinject.fire("memo.insert")
+        except faultinject.FaultInjected:
+            metrics.memo_insert_faults_total.inc()
+            raise
         cache.commit(stamp, rows)
         return SimpleNamespace(
             allowed=v.allowed,

@@ -245,6 +245,7 @@ class ChipFailoverRouter:
             "hits": 0,
             "misses": 0,
             "overflow_redispatches": 0,
+            "insert_faults": 0,
         }
 
     def _memo_evaluator(self, rep_cap: int):
@@ -1058,6 +1059,17 @@ class ChipFailoverRouter:
             ev = self._memo_evaluator(rep_cap)
             out = ev(dev_tables, batch, alive, valid, rows_in)
             jax.block_until_ready(out)
+        except faultinject.FaultInjected as exc:
+            # never swallow an injected fault as a generic memo
+            # error: surface it to the breaker plane — blame the
+            # chip the seam named (if any) — then serve the batch
+            # through the UNCACHED path, whose own failure handling
+            # (per-chip blame, terminal fold) applies from here
+            sp.attrs["memo_fault"] = str(exc)
+            if exc.chip is not None:
+                self.bank.record_failure(int(exc.chip), str(exc))
+            cache.flush(reason="memo-dispatch-fault")
+            return None, None
         except Exception as exc:  # noqa: BLE001
             sp.attrs["memo_error"] = str(exc)
             cache.flush(reason="memo-dispatch-failure")
@@ -1076,6 +1088,32 @@ class ChipFailoverRouter:
             self._memo["overflow_redispatches"] += 1
             cache.account(s)
             return None, None
+        # memo.insert fault seam, probed once per ALIVE ordinal
+        # before the commit (chip-scoped schedules poison only
+        # batches their chip participated in).  A fired fault drops
+        # the write-back — the carried cache is provably unchanged,
+        # exactly the overflow-refusal shape — and the batch
+        # re-dispatches through the uncached failover evaluator:
+        # surfaced (metric + span attr + per-router counter), never
+        # silently swallowed.  The per-ordinal probe loop gates on
+        # the lock-free nothing-armed read (production pays no
+        # per-dispatch grid walk).
+        if faultinject.any_armed():
+            try:
+                for r in range(self.dp):
+                    for c in range(self.tp):
+                        if alive[r, c]:
+                            faultinject.fire(
+                                "memo.insert",
+                                chip=int(self.ordinals[r, c]),
+                            )
+            except faultinject.FaultInjected as exc:
+                metrics.memo_insert_faults_total.inc()
+                sp.attrs["memo_insert_fault"] = str(exc)
+                self._memo["insert_faults"] = (
+                    self._memo.get("insert_faults", 0) + 1
+                )
+                return None, None
         cache.commit(stamp, cache2)
         row = cache.account(s)
         self._memo["hits"] += row["hits"]

@@ -35,7 +35,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from cilium_tpu import tracing
+from cilium_tpu import faultinject, tracing
 from cilium_tpu.compiler.delta import TableDelta, tables_nbytes
 from cilium_tpu.compiler.tables import (
     COLD_LEAVES,
@@ -43,7 +43,10 @@ from cilium_tpu.compiler.tables import (
     split_hot,
     tables_layout_version,
 )
+from cilium_tpu.logging import get_logger
 from cilium_tpu.metrics import registry as metrics
+
+log = get_logger("publish")
 
 # low bits of a layout stamp carrying the hashed-table pack widths;
 # the high bits are the hot/cold coldness mask (see
@@ -296,6 +299,25 @@ class DeviceTableStore:
                     dev, stats = self._publish_delta(
                         spare["tables"], tables, delta
                     )
+                except faultinject.FaultInjected as exc:
+                    # the publish.scatter seam fired: the scatter is
+                    # poisoned before the donated apply runs, but the
+                    # spare's row bookkeeping can no longer be
+                    # trusted either way — de-register the slot and
+                    # serve THIS publish through the full-upload
+                    # path.  The control plane degrades to bytes
+                    # spent, never to a half-patched epoch: the
+                    # fallback is the refusal path the chaos/fuzz
+                    # schedules assert bit-identity across.
+                    self._slots[spare_i] = None
+                    use_delta = False
+                    metrics.publish_fallback_total.inc()
+                    sp.attrs["fallback"] = str(exc)
+                    log.warning(
+                        "delta publish scatter faulted; falling "
+                        "back to full upload",
+                        extra={"fields": {"error": str(exc)}},
+                    )
                 except Exception:
                     # the donated scatter may have consumed the spare
                     # epoch's buffers before failing — de-register the
@@ -304,12 +326,13 @@ class DeviceTableStore:
                     self._slots[spare_i] = None
                     self._sample_bytes()
                     raise
-                # the standby's resident buffers were donated (patched
-                # in place) — HBM reused, not reallocated
-                metrics.device_table_retired_bytes.inc(
-                    value=spare.get("nbytes", 0)
-                )
-            else:
+                else:
+                    # the standby's resident buffers were donated
+                    # (patched in place) — HBM reused, not reallocated
+                    metrics.device_table_retired_bytes.inc(
+                        value=spare.get("nbytes", 0)
+                    )
+            if not use_delta:
                 dev = self._put_tables(tables)
                 jax.block_until_ready(dev)
                 stats = PublishStats(
@@ -376,6 +399,24 @@ class DeviceTableStore:
         delta: TableDelta,
     ):
         import jax
+
+        # the publish.scatter fault seam, probed once per device
+        # ordinal holding a slice of the spare epoch (chip-scoped
+        # schedules poison the scatter only when their chip is a
+        # recipient; unscoped schedules fire on the first probe).
+        # publish() catches the FaultInjected and falls back to a
+        # full upload — the spare's buffers are still intact here,
+        # but its bookkeeping is de-registered conservatively.
+        # Nothing-armed (production churn) must not pay the ordinal
+        # enumeration: the whole setup gates on the same lock-free
+        # emptiness read the fault verbs use.
+        if faultinject.any_armed():
+            ordinals = sorted(_chip_resident_bytes(spare_dev))
+            if ordinals:
+                for ordinal in ordinals:
+                    faultinject.fire("publish.scatter", chip=ordinal)
+            else:
+                faultinject.fire("publish.scatter")
 
         n_scatter = 0
         n_replace = 0

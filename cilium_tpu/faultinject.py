@@ -24,6 +24,22 @@ Sites (dotted names; the instrumented seams):
   ct.insert         host CT map insertion (CTMap.create)
   proxy.upcall      proxy redirect realization (Proxy.
                     update_endpoint_redirects)
+  publish.scatter   delta-publish device scatter (engine.publish
+                    DeviceTableStore._publish_delta) — probed once
+                    per resident device ordinal, so `chip=` scoped
+                    schedules poison the scatter only when that
+                    chip holds a slice of the spare epoch; the
+                    publish falls back to a FULL upload (counted in
+                    publish_fallback_total) instead of leaving a
+                    half-patched epoch
+  memo.insert       verdict-cache insert/commit path — the host
+                    commit of kernel-inserted rows (engine.memo
+                    VerdictCache.commit, unscoped) and the routed
+                    memo plane's per-chip probes before commit
+                    (engine.failover._memo_dispatch, `chip=`
+                    honored); a fired fault drops the batch's cache
+                    write-back and the batch re-dispatches uncached
+                    — bit-identity is unconditional either way
 
 Schedules are deterministic and composable:
 
@@ -67,6 +83,8 @@ SITES = (
     "kvstore.conn",
     "ct.insert",
     "proxy.upcall",
+    "publish.scatter",
+    "memo.insert",
 )
 
 MODES = ("raise", "hang", "corrupt")
@@ -242,6 +260,14 @@ class FaultRegistry:
             return True
         return chip is not None and chip == spec.chip
 
+    def any_armed(self) -> bool:
+        """Lock-free production guard: True when ANY site is armed.
+        Call sites whose PROBE SETUP itself has a cost (e.g.
+        enumerating device ordinals for per-chip attribution) gate
+        the setup on this, the same benign-race emptiness read the
+        verbs below use."""
+        return bool(self._armed)
+
     def should_fire(self, site: str, chip: Optional[int] = None) -> bool:
         """Count one call; True when the schedule says fail.  For
         call sites with a CUSTOM fault action (kvstore.conn severs
@@ -320,6 +346,7 @@ arm = registry.arm
 disarm = registry.disarm
 disarm_all = registry.disarm_all
 armed = registry.armed
+any_armed = registry.any_armed
 fire = registry.fire
 should_fire = registry.should_fire
 corrupt_bytes = registry.corrupt_bytes

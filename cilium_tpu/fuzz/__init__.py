@@ -1,0 +1,37 @@
+"""Differential policy fuzzer (tools/policyfuzz.py's engine).
+
+A seeded, grammar-based generator of random CiliumNetworkPolicy sets
+(cilium_tpu.fuzz.grammar — every generated rule round-trips the REAL
+JSON parser and sanitizer) plus flow-tuple batches (uniform and
+Zipf), driven through a randomized EVENT SCHEDULE (rule add/delete,
+identity churn, delta/full publishes, verdict-cache toggles,
+chip kills/readmissions via the chip-scoped fault sites,
+publish.scatter / memo.insert fault arming, serving-plane streamed
+submissions).  Every step asserts the full observable surface —
+verdict columns, l4/l3 counters, telemetry totals, flow-record drop
+multisets and exactly-once accounting — bit-identical to the host
+lattice oracle across the executor matrix (cilium_tpu.fuzz.executors:
+daemon single-chip, routed tp∈{1,2}, failover-with-chip-out, memo
+on/off, serving plane, fused subword/persistent-pair trio).
+
+On a mismatch the shrinker (cilium_tpu.fuzz.shrink) delta-debugs the
+(policy set, flow batch, event schedule) triple down to a small
+deterministic repro and emits a re-runnable ``repro_*.json``
+(``tools/policyfuzz.py --replay``).
+
+Seed determinism is a hard invariant: every random decision flows
+from ONE ``numpy.random.default_rng(seed)`` and every event is
+materialized into the recorded program, so a failing run replays
+byte-for-byte from its logged seed alone.  cilium_tpu.fuzz.lint
+greps the fuzzer (and the chaos/bench tooling) for unseeded RNG
+calls; tests keep it empty.
+"""
+
+from cilium_tpu.fuzz.harness import (  # noqa: F401
+    DEFAULT_EXECUTORS,
+    SMOKE_EXECUTORS,
+    FuzzFailure,
+    generate_program,
+    run_program,
+)
+from cilium_tpu.fuzz.shrink import shrink_program, write_repro  # noqa: F401

@@ -1,0 +1,581 @@
+"""The fuzzer's executor matrix: every way this system can serve a
+verdict, behind one dispatch interface.
+
+Each executor consumes a materialized flow batch (the schedule's
+JSON columns) and returns its observable surface:
+
+  * ``cols``   — verdict columns (allowed / proxy_port / match_kind)
+                 in stream order, compared bit-exact to the host
+                 lattice oracle;
+  * ``l4``/``l3``/``telem`` — counter tensors and telemetry totals
+                 (router executors), compared bit-exact ACROSS the
+                 routed matrix;
+  * exactly-once accounting, asserted internally (a lost or
+    duplicated tuple raises FuzzFailure before any column compare).
+
+Matrix members:
+
+  daemon     Daemon.process_flows — the single-chip serving path
+             (breaker/retry/watchdog, memo when enabled, flow-record
+             folding: the drop multiset the harness checks).
+  tp1/tp2    ChipFailoverRouter over a (dp, tp) virtual mesh — the
+             partitioned N+1 replica datapath; chip kills re-split
+             batches and serve dead primaries from replicas.
+  memo       a routed executor with the partitioned verdict-memo
+             plane attached (attach_memo); the harness toggles it.
+  serve      ServingPlane streamed submissions — randomized chunking
+             through the continuous serving plane, replies demuxed
+             back to stream order.
+  fusedtrio  the fused datapath compared three ways on identical
+             flows: legacy tables vs sub-word tables vs the
+             persistent fused-pair program (subword on/off and
+             persistent pairs from the tentpole matrix); internally
+             consistent across all 15 fused columns + counters +
+             telemetry.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cilium_tpu import faultinject
+
+VERDICT_FIELDS = ("allowed", "proxy_port", "match_kind")
+
+# the fused pipeline's full observable surface (the chaos storm's
+# column list) — the fused trio compares every one of these
+_FUSED_COLS = (
+    "allowed", "proxy_port", "match_kind", "ct_result",
+    "pre_dropped", "sec_id", "final_daddr", "final_dport",
+    "rev_nat", "lb_slave", "ct_create", "ct_delete",
+    "tunnel_endpoint", "l4_slot", "ipcache_miss",
+)
+
+
+class FuzzFailure(AssertionError):
+    """One step's observable surface diverged.  Carries the failure
+    signature the shrinker's predicate matches on: the executor set
+    involved and the field that diverged."""
+
+    def __init__(
+        self, executors, field: str, step: int, detail: str
+    ) -> None:
+        self.executors = tuple(sorted(executors))
+        self.field = field
+        self.step = int(step)
+        self.detail = detail
+        super().__init__(
+            f"step {step}: {'/'.join(self.executors)} diverged in "
+            f"{field}: {detail}"
+        )
+
+    def signature(self):
+        return (self.executors, self.field)
+
+
+def _flow_arrays(flows: dict, index: Dict[int, int]):
+    """Materialized JSON columns → typed arrays + endpoint-axis
+    indices."""
+    ep_id = np.asarray(flows["ep_id"], np.uint32)
+    return {
+        "ep_id": ep_id,
+        "ep_index": np.asarray(
+            [index[int(e)] for e in ep_id], np.int64
+        ),
+        "identity": np.asarray(flows["identity"], np.uint32),
+        "dport": np.asarray(flows["dport"], np.int64),
+        "proto": np.asarray(flows["proto"], np.int64),
+        "direction": np.asarray(flows["direction"], np.int64),
+        "is_fragment": np.asarray(flows["is_fragment"], bool),
+    }
+
+
+class DaemonExecutor:
+    """The single-chip serving path (Daemon.process_flows)."""
+
+    name = "daemon"
+    routed = False
+
+    def __init__(self, world, batch_size: int = 128) -> None:
+        self.world = world
+        self.batch_size = int(batch_size)
+        self.batches = 0
+
+    def publish(self, tables, states, delta_fn, force_full=False):
+        # the daemon resolves its own published epoch per dispatch
+        return None
+
+    def dispatch(self, flows: dict, index, step: int) -> dict:
+        from cilium_tpu.native import encode_flow_records
+
+        f = _flow_arrays(flows, index)
+        n = len(f["ep_id"])
+        buf = encode_flow_records(
+            ep_id=f["ep_id"],
+            identity=f["identity"],
+            saddr=np.zeros(n, np.uint32),
+            daddr=np.zeros(n, np.uint32),
+            sport=np.full(n, 40000, np.uint16),
+            dport=f["dport"].astype(np.uint16),
+            proto=f["proto"].astype(np.uint8),
+            direction=f["direction"].astype(np.uint8),
+            is_fragment=f["is_fragment"].astype(np.uint8),
+        )
+        st = self.world.daemon.process_flows(
+            buf, batch_size=self.batch_size, collect_verdicts=True
+        )
+        self.batches += int(st.batches)
+        if st.total + st.dropped + st.shed != n or st.dropped:
+            raise FuzzFailure(
+                (self.name,), "exactly-once", step,
+                f"total={st.total} dropped={st.dropped} "
+                f"shed={st.shed} of {n} submitted",
+            )
+        return {
+            "cols": {
+                k: np.asarray(st.verdicts[k]) for k in VERDICT_FIELDS
+            },
+            "degraded_batches": int(st.degraded_batches),
+        }
+
+    def close(self) -> None:
+        pass
+
+
+class RouterExecutor:
+    """ChipFailoverRouter over a (dp, tp) slice of the virtual mesh;
+    with ``memo=True`` the partitioned verdict-memo plane rides the
+    dispatch path (and can be toggled)."""
+
+    routed = True
+
+    def __init__(
+        self,
+        name: str,
+        world,
+        dp: int,
+        tp: int,
+        memo: bool = False,
+    ) -> None:
+        import jax
+
+        from cilium_tpu.engine.failover import ChipFailoverRouter
+        from cilium_tpu.resilience import ChipBreakerBank
+
+        self.name = name
+        self.world = world
+        self.dp, self.tp = int(dp), int(tp)
+        devs = jax.devices()
+        assert len(devs) >= dp * tp, (len(devs), dp, tp)
+        self.mesh = jax.sharding.Mesh(
+            np.array(devs[: dp * tp]).reshape(dp, tp),
+            ("batch", "table"),
+        )
+        version, tables, index, states = world.published()
+        self._states = list(states)
+        self.bank = ChipBreakerBank(
+            recovery_timeout=0.05, failure_threshold=1
+        )
+        self.router = ChipFailoverRouter(
+            self.mesh, tables, bank=self.bank,
+            collect_telemetry=True, host_fold=self._fold,
+        )
+        if memo:
+            self.router.attach_memo()
+            self._memo_plane = self.router._memo
+        else:
+            self._memo_plane = None
+        # prime both epoch slots so the next churn publish rides the
+        # delta path (the storm idiom)
+        self.publish(tables, states, world.delta_for)
+        self.publish(tables, states, world.delta_for)
+        self.publish_modes = {"delta": 0, "full": 0}
+        self.batches = 0
+
+    def _fold(self, ep, ident, dport, proto, dirn, frag):
+        from cilium_tpu.engine.hostpath import lattice_fold_host
+
+        return lattice_fold_host(
+            self._states, ep, ident, dport, proto, dirn,
+            is_fragment=frag,
+        )
+
+    def set_memo(self, on: bool) -> None:
+        if self._memo_plane is None:
+            return
+        self.router._memo = self._memo_plane if on else None
+
+    @property
+    def memo_on(self) -> bool:
+        return self.router._memo is not None
+
+    def publish(self, tables, states, delta_fn, force_full=False):
+        self._states = list(states)
+        delta = (
+            None
+            if force_full
+            else delta_fn(self.router.store.spare_stamp(), tables)
+        )
+        _, st = self.router.publish(tables, delta)
+        if hasattr(self, "publish_modes"):
+            self.publish_modes[st.mode] = (
+                self.publish_modes.get(st.mode, 0) + 1
+            )
+        return st
+
+    def dispatch(self, flows: dict, index, step: int) -> dict:
+        f = _flow_arrays(flows, index)
+        n = len(f["ep_id"])
+        res = self.router.dispatch(
+            ep_index=f["ep_index"],
+            identity=f["identity"],
+            dport=f["dport"],
+            proto=f["proto"],
+            direction=f["direction"],
+            is_fragment=f["is_fragment"],
+        )
+        self.batches += 1
+        if res.degraded:
+            # the routed matrix must serve from replicas/survivors;
+            # the terminal host fold firing means the failure domain
+            # machinery regressed (the schedule never kills a whole
+            # mesh row's owners)
+            raise FuzzFailure(
+                (self.name,), "degraded", step,
+                "routed executor fell to the terminal host fold",
+            )
+        got = len(np.asarray(res.verdicts.allowed))
+        if got != n:
+            raise FuzzFailure(
+                (self.name,), "exactly-once", step,
+                f"{got} verdicts for {n} tuples",
+            )
+        telem = (
+            None
+            if res.telemetry is None
+            else np.asarray(res.telemetry).astype(np.uint64).sum(
+                axis=0
+            )
+        )
+        return {
+            "cols": {
+                k: np.asarray(getattr(res.verdicts, k))
+                for k in VERDICT_FIELDS
+            },
+            "l4": np.asarray(res.l4_counts),
+            "l3": np.asarray(res.l3_counts),
+            "telem": telem,
+            "rebalanced": res.rebalanced_chips,
+            "rebalance_bytes": res.rebalance_bytes,
+            "cache_hit": res.cache_hit,
+        }
+
+    def chip_states(self) -> Dict[int, str]:
+        return self.bank.states()
+
+    def close(self) -> None:
+        pass
+
+
+class ServeExecutor:
+    """ServingPlane streamed submissions: the flow batch split into
+    the event's recorded chunk sizes, submitted through streaming
+    admission, replies demuxed back and re-concatenated in
+    submission order."""
+
+    name = "serve"
+    routed = False
+
+    def __init__(self, world, batch_size: int = 128) -> None:
+        self.world = world
+        self.plane = world.daemon.serving_plane(
+            batch_size=batch_size,
+            slo_ms=50.0,
+            max_tenant_backlog=1 << 15,
+        )
+        self.submissions = 0
+
+    def publish(self, tables, states, delta_fn, force_full=False):
+        return None
+
+    def dispatch(
+        self, flows: dict, index, step: int,
+        chunks: Optional[List[int]] = None,
+    ) -> dict:
+        from cilium_tpu.native import (
+            decode_flow_records,
+            encode_flow_records,
+        )
+
+        f = _flow_arrays(flows, index)
+        n = len(f["ep_id"])
+        if not chunks:
+            chunks = [n]
+        assert sum(chunks) == n, (chunks, n)
+        rec_all = decode_flow_records(
+            encode_flow_records(
+                ep_id=f["ep_id"],
+                identity=f["identity"],
+                saddr=np.zeros(n, np.uint32),
+                daddr=np.zeros(n, np.uint32),
+                sport=np.full(n, 40000, np.uint16),
+                dport=f["dport"].astype(np.uint16),
+                proto=f["proto"].astype(np.uint8),
+                direction=f["direction"].astype(np.uint8),
+                is_fragment=f["is_fragment"].astype(np.uint8),
+            )
+        )
+        results = []
+        off = 0
+        for i, size in enumerate(chunks):
+            chunk = {
+                k: v[off : off + size] for k, v in rec_all.items()
+            }
+            results.append(
+                self.plane.submit(
+                    rec=chunk, tenant=f"fz{i % 2}"
+                )
+            )
+            off += size
+        self.submissions += len(results)
+        cols: Dict[str, list] = {k: [] for k in VERDICT_FIELDS}
+        served = 0
+        for r in results:
+            r.wait(timeout=120)
+            if r.shed or int(r.shed_mask.sum()):
+                raise FuzzFailure(
+                    (self.name,), "exactly-once", step,
+                    "submission shed under an unbounded backlog",
+                )
+            served += r.n
+            got = r.verdict_columns()
+            for k in VERDICT_FIELDS:
+                cols[k].append(np.asarray(got[k]))
+        if served != n:
+            raise FuzzFailure(
+                (self.name,), "exactly-once", step,
+                f"{served} flows served of {n} submitted",
+            )
+        return {
+            "cols": {
+                k: np.concatenate(v) if v else np.zeros(0)
+                for k, v in cols.items()
+            }
+        }
+
+    def close(self) -> None:
+        try:
+            self.plane.stop(drain=True)
+        except Exception:
+            pass
+
+
+class FusedTrioExecutor:
+    """Subword on/off + persistent pairs from the tentpole matrix:
+    the same flow pairs through (a) the legacy fused pair program,
+    (b) sub-word tables, (c) sub-word tables via the persistent
+    K-pair program — all 15 fused verdict columns, the counter
+    accumulators and telemetry totals must be IDENTICAL across the
+    trio.  Self-referencing (no host oracle: the single-program
+    fused surface is oracle-gated by tests/test_datapath.py)."""
+
+    name = "fusedtrio"
+    routed = False
+
+    def __init__(self, world) -> None:
+        self.world = world
+        self._tables = None
+        self._dt = None
+        self._sub = None
+        self.steps = 0
+        # identity → IP (the fused path resolves identity from
+        # saddr through the device ipcache)
+        self._ip_of = {}
+        for ident, ip in world._identities.values():
+            self._ip_of[int(ident.id)] = ip
+        self._ep_ip = {
+            ep["id"]: ep["ip"] for ep in world.spec["endpoints"]
+        }
+
+    def publish(self, tables, states, delta_fn, force_full=False):
+        self._tables = tables
+        self._dt = None  # rebuilt lazily on next dispatch
+        return None
+
+    def _ensure_tables(self):
+        if self._dt is None:
+            self._dt = self.world.daemon.datapath_tables(
+                policy=self._tables, subword=False
+            )
+            self._sub = self.world.daemon.datapath_tables(
+                policy=self._tables, subword=True
+            )
+        return self._dt, self._sub
+
+    def dispatch(self, flows: dict, index, step: int) -> dict:
+        import ipaddress
+
+        import jax
+
+        from cilium_tpu.engine.datapath import (
+            PersistentPairDispatcher,
+            datapath_step_accum_pair_telem_packed4_stacked as _ref,
+            pack_flow_records4,
+        )
+        from cilium_tpu.engine.verdict import (
+            make_counter_buffers,
+            make_telemetry_buffers,
+        )
+
+        dt, sub = self._ensure_tables()
+        f = _flow_arrays(flows, index)
+        n = len(f["ep_id"])
+        saddr = np.asarray(
+            [
+                int(
+                    ipaddress.ip_address(
+                        self._ip_of.get(int(i), "188.0.0.1")
+                    )
+                )
+                for i in f["identity"]
+            ],
+            np.uint32,
+        )
+        daddr = np.asarray(
+            [
+                int(ipaddress.ip_address(self._ep_ip[int(e)]))
+                for e in f["ep_id"]
+            ],
+            np.uint32,
+        )
+        pair = np.empty((2, 4, n), np.uint32)
+        for d in range(2):
+            pair[d] = pack_flow_records4(
+                ep_index=f["ep_index"],
+                saddr=saddr,
+                daddr=daddr,
+                sport=np.full(n, 40000, np.int64),
+                dport=f["dport"],
+                proto=f["proto"],
+                direction=np.full(n, d, np.int64),
+            )
+        # every variant processes the SAME pair twice: the
+        # persistent K=2 program gets a full super-batch (exactly
+        # one launch — the zero-per-pair-dispatch proof), and the
+        # carried counter/telemetry accumulators see two commits
+        outs = {}
+        accs = {}
+        tels = {}
+        for tag, tables in (("legacy", dt), ("subword", sub)):
+            acc = jax.device_put(make_counter_buffers(tables.policy))
+            tel = jax.device_put(make_telemetry_buffers())
+            per = []
+            for _ in range(2):
+                oi, oe, acc, tel = _ref(
+                    tables, jax.device_put(pair), acc, tel
+                )
+                per.append((oi, oe))
+            outs[tag] = per
+            accs[tag] = np.asarray(acc)
+            tels[tag] = np.asarray(tel)
+        acc = jax.device_put(make_counter_buffers(sub.policy))
+        tel = jax.device_put(make_telemetry_buffers())
+        disp = PersistentPairDispatcher(sub, 2, acc, tel)
+        got = list(disp.submit(pair))
+        got.extend(disp.submit(pair))
+        rem, acc, tel = disp.flush()
+        got.extend(rem)
+        if len(got) != 2 or disp.launches != 1:
+            raise FuzzFailure(
+                ("fusedtrio",), "persistent-launches", step,
+                f"{len(got)} results / {disp.launches} launches "
+                "for a K=2 super-batch",
+            )
+        outs["persistent"] = got
+        accs["persistent"] = np.asarray(acc)
+        tels["persistent"] = np.asarray(tel)
+
+        base = outs["legacy"]
+        for tag in ("subword", "persistent"):
+            for it, ((bi, be), (ti, te)) in enumerate(
+                zip(base, outs[tag])
+            ):
+                for col in _FUSED_COLS:
+                    for want, gotv, half in (
+                        (bi, ti, "in"),
+                        (be, te, "eg"),
+                    ):
+                        w = np.asarray(getattr(want, col))
+                        g = np.asarray(getattr(gotv, col))
+                        if not np.array_equal(w, g):
+                            raise FuzzFailure(
+                                ("fusedtrio",),
+                                f"{tag}:{half}:{col}",
+                                step,
+                                f"fused trio diverged (pair {it})",
+                            )
+            if not np.array_equal(accs["legacy"], accs[tag]):
+                raise FuzzFailure(
+                    ("fusedtrio",), f"{tag}:counters", step,
+                    "fused trio counter accumulators diverged",
+                )
+            if not np.array_equal(tels["legacy"], tels[tag]):
+                raise FuzzFailure(
+                    ("fusedtrio",), f"{tag}:telemetry", step,
+                    "fused trio telemetry diverged",
+                )
+        self.steps += 1
+        return {"cols": None}
+
+    def close(self) -> None:
+        pass
+
+
+def build_executors(world, names) -> List[object]:
+    out: List[object] = []
+    for name in names:
+        if name == "daemon":
+            out.append(DaemonExecutor(world))
+        elif name == "tp1":
+            out.append(RouterExecutor("tp1", world, dp=2, tp=1))
+        elif name == "tp2":
+            out.append(RouterExecutor("tp2", world, dp=2, tp=2))
+        elif name == "memo":
+            out.append(
+                RouterExecutor("memo", world, dp=1, tp=2, memo=True)
+            )
+        elif name == "serve":
+            out.append(ServeExecutor(world))
+        elif name == "fusedtrio":
+            out.append(FusedTrioExecutor(world))
+        else:
+            raise ValueError(f"unknown executor {name!r}")
+    return out
+
+
+# the chip every kill event targets: ordinal 1 sits in every routed
+# executor's grid (tp1 row 1 / tp2 row 0 col 1 / memo col 1) and
+# never orphans a table slice — its row survives via re-split or its
+# backup owner serves (REPLICA_BACKUP_OFFSET)
+VICTIM_CHIP = 1
+
+
+def kill_chip(chip: int = VICTIM_CHIP) -> None:
+    faultinject.arm("engine.dispatch", f"raise:chip={chip}")
+
+
+def readmit_chip(executors, chip: int = VICTIM_CHIP) -> None:
+    import time
+
+    faultinject.disarm("engine.dispatch")
+    timeout = max(
+        [0.05]
+        + [
+            ex.bank.recovery_timeout
+            for ex in executors
+            if getattr(ex, "routed", False)
+        ]
+    )
+    time.sleep(timeout * 2)

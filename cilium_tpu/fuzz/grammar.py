@@ -1,0 +1,282 @@
+"""Seeded grammar for random CiliumNetworkPolicy sets + flow tuples.
+
+Rules are generated as CiliumNetworkPolicy-style JSON dicts and
+ROUND-TRIP THE REAL PARSER: every production is serialized with
+``json.dumps``, parsed back through
+``cilium_tpu.policy.api.parse.rules_from_json`` and run through
+``Rule.sanitize()`` — exactly the ``cilium policy import`` path — so
+the fuzzer can never drift from the API the daemon actually accepts.
+An invalid production (the 1.0 API rejects CIDR × ToPorts, for
+instance) is regenerated deterministically, never patched up.
+
+The grammar covers the tentpole's vocabulary:
+
+  * L3: team/tier label selectors, wildcard ({}), CIDR sets with
+    non-/32 prefix classes (/8 … /32) and optional except-carveouts;
+  * deny/allow mixes via fromRequires/toRequires (deny-precedence in
+    the resolution lattice);
+  * L4: TCP/UDP port rules from a BOUNDED port pool (bounded so the
+    compiled table geometry stays in one jit class under churn);
+  * L7: HTTP method/path and Kafka topic rules riding TCP port
+    rules (redirect entries with daemon-allocated proxy ports);
+  * ingress AND egress sections.
+
+Flow tuples are sampled from the LIVE identity universe (including
+CIDR- and world-reserved identities) plus never-allocated probe ids,
+uniformly or ranked-Zipf (the same shape bench.zipf_picks uses).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from cilium_tpu.policy.api.parse import rules_from_json
+
+TEAMS = ("red", "blue", "green", "gold")
+TIERS = ("web", "api", "db")
+# bounded port pool: new (dport, proto) keys append L4 slots, and a
+# bounded pool keeps the padded slot space (and so the table
+# geometry / jit classes) stable across schedule-long churn
+RULE_PORTS = (53, 80, 443, 8080, 9090)
+RULE_PROTOS = ("TCP", "UDP")
+# flows additionally probe ports/protos no rule ever names
+FLOW_PORTS = RULE_PORTS + (1234, 31337)
+FLOW_PROTOS = (6, 17, 1)
+# identity probes outside any allocator universe (world=2 is the
+# reserved identity unknown ipcache sources resolve to)
+UNKNOWN_IDENTITIES = (999999, 70000, 2, 7)
+
+CIDR_PREFIX_LENS = (8, 12, 16, 24, 28, 32)
+
+HTTP_METHODS = ("GET", "PUT", "POST")
+KAFKA_TOPICS = ("orders", "ledger", "audit")
+
+
+def _team_selector(team: str) -> dict:
+    return {"matchLabels": {"k8s:team": team}}
+
+
+def _tier_selector(tier: str) -> dict:
+    return {"matchLabels": {"k8s:tier": tier}}
+
+
+def _app_selector(app: str) -> dict:
+    return {"matchLabels": {"k8s:app": app}}
+
+
+class PolicyGrammar:
+    """One seeded rng in, deterministic rule/flow productions out.
+
+    The instance owns a monotonically increasing rule sequence so
+    every generated rule carries a unique ``fuzz-rule-N`` label —
+    the delete handle rule_del events use."""
+
+    def __init__(self, rng: np.random.Generator, n_endpoints: int):
+        self.rng = rng
+        self.n_endpoints = int(n_endpoints)
+        self.rule_seq = 0
+        self._cidr_seq = 0
+
+    # -- selectors -----------------------------------------------------------
+
+    def endpoint_app(self, i: int) -> str:
+        return f"fzep{i}"
+
+    def _pick(self, seq):
+        return seq[int(self.rng.integers(0, len(seq)))]
+
+    def _peer_selector(self) -> dict:
+        kind = self._pick(("team", "tier", "wild"))
+        if kind == "team":
+            return _team_selector(self._pick(TEAMS))
+        if kind == "tier":
+            return _tier_selector(self._pick(TIERS))
+        return {}  # wildcard: selects every identity
+
+    def _cidr(self) -> dict:
+        plen = self._pick(CIDR_PREFIX_LENS)
+        self._cidr_seq += 1
+        # distinct base octets so repeated CIDR rules don't collapse
+        # to one prefix; masked to the prefix length by ip_network
+        # semantics downstream (strict=False everywhere)
+        base = f"10.{80 + self._cidr_seq % 40}.{self._cidr_seq % 200}.0"
+        d = {"cidr": f"{base}/{plen}"}
+        if plen <= 24 and self.rng.random() < 0.3:
+            d["except"] = [f"{base}/{min(plen + 8, 32)}"]
+        return d
+
+    def _port_rule(self, with_l7: bool) -> dict:
+        n_ports = 1 + int(self.rng.random() < 0.3)
+        ports = []
+        for _ in range(n_ports):
+            proto = "TCP" if with_l7 else self._pick(RULE_PROTOS)
+            ports.append(
+                {"port": str(self._pick(RULE_PORTS)), "protocol": proto}
+            )
+        rule: dict = {"ports": ports}
+        if with_l7:
+            if self.rng.random() < 0.5:
+                rule["rules"] = {
+                    "http": [
+                        {
+                            "method": self._pick(HTTP_METHODS),
+                            "path": f"/fz{int(self.rng.integers(10))}"
+                            "/[a-z]+",
+                        }
+                    ]
+                }
+            else:
+                rule["rules"] = {
+                    "kafka": [{"topic": self._pick(KAFKA_TOPICS)}]
+                }
+        return rule
+
+    # -- rules ---------------------------------------------------------------
+
+    def gen_rule(self, kind: Optional[str] = None) -> dict:
+        """One valid rule dict (round-tripped through the real
+        parser+sanitizer before it is returned).  `kind` forces a
+        coverage class: l3only | l4 | l7 | cidr | wildcard |
+        requires | egress."""
+        for _ in range(16):
+            spec = self._gen_rule_once(kind)
+            try:
+                (rule,) = rules_from_json(json.dumps(spec))
+                rule.sanitize()
+            except Exception:
+                continue  # deterministically regenerate
+            return spec
+        raise AssertionError(
+            f"grammar failed to produce a valid {kind!r} rule in 16 "
+            "tries — productions and sanitizer have drifted apart"
+        )
+
+    def _gen_rule_once(self, kind: Optional[str]) -> dict:
+        if kind is None:
+            kind = self._pick(
+                (
+                    "l3only", "l4", "l4", "l7", "cidr", "wildcard",
+                    "requires", "egress", "egress",
+                )
+            )
+        self.rule_seq += 1
+        label = f"fuzz-rule-{self.rule_seq}"
+        target = _app_selector(
+            self.endpoint_app(int(self.rng.integers(self.n_endpoints)))
+        )
+        direction = "egress" if kind == "egress" else "ingress"
+        peer_key = "toEndpoints" if direction == "egress" else (
+            "fromEndpoints"
+        )
+        req_key = "toRequires" if direction == "egress" else (
+            "fromRequires"
+        )
+        cidr_key = "toCIDRSet" if direction == "egress" else (
+            "fromCIDRSet"
+        )
+        block: dict = {}
+        if kind == "cidr":
+            # the 1.0 API rejects CIDR x ToPorts: L3-only by
+            # construction
+            block[cidr_key] = [
+                self._cidr()
+                for _ in range(1 + int(self.rng.random() < 0.4))
+            ]
+        elif kind == "wildcard":
+            block[peer_key] = [{}]
+            if self.rng.random() < 0.6:
+                block["toPorts"] = [self._port_rule(with_l7=False)]
+        elif kind == "l3only":
+            block[peer_key] = [self._peer_selector()]
+        elif kind == "l7":
+            block[peer_key] = [self._peer_selector()]
+            block["toPorts"] = [self._port_rule(with_l7=True)]
+        else:  # l4 / requires / egress
+            block[peer_key] = [
+                self._peer_selector()
+                for _ in range(1 + int(self.rng.random() < 0.3))
+            ]
+            if kind == "requires" or self.rng.random() < 0.15:
+                block[req_key] = [
+                    _team_selector(self._pick(TEAMS))
+                ]
+            if self.rng.random() < 0.75:
+                block["toPorts"] = [self._port_rule(with_l7=False)]
+        return {
+            "endpointSelector": target,
+            direction: [block],
+            "labels": [label],
+            "description": f"fuzz {kind}",
+        }
+
+    def gen_initial_policies(self, n: int) -> List[dict]:
+        """The opening rule set: the first productions force one of
+        each coverage class so every schedule exercises L3-only, L4,
+        L7 redirect, non-/32 CIDR and wildcard rules regardless of
+        the seed; the rest are free draws."""
+        forced = ["l3only", "l4", "l7", "cidr", "wildcard"]
+        out = []
+        for i in range(n):
+            out.append(
+                self.gen_rule(forced[i] if i < len(forced) else None)
+            )
+        return out
+
+    def gen_identity_labels(self) -> dict:
+        """A fresh identity's label set (plain key→value; the world
+        builder adds the k8s source)."""
+        labels = {"team": self._pick(TEAMS)}
+        if self.rng.random() < 0.7:
+            labels["tier"] = self._pick(TIERS)
+        if self.rng.random() < 0.2:
+            labels["scope"] = f"s{int(self.rng.integers(4))}"
+        return labels
+
+    # -- flows ---------------------------------------------------------------
+
+    def gen_flows(
+        self,
+        n: int,
+        ep_ids: List[int],
+        identity_pool: List[int],
+        zipf_s: float = 0.0,
+    ) -> dict:
+        """One flow batch over the CURRENT identity universe.  With
+        ``zipf_s > 0`` tuples are drawn ranked-Zipf over a pool of
+        candidate tuples (the bench.zipf_picks shape: rank r with
+        probability ∝ r^-s through a seeded permutation); s=0 is
+        uniform.  Returns materialized JSON-able columns."""
+        rng = self.rng
+        pool = list(identity_pool) + list(UNKNOWN_IDENTITIES)
+        if zipf_s > 0.0:
+            # build a candidate tuple pool, then Zipf-rank into it
+            m = max(len(pool) * 4, 32)
+            cand = {
+                "identity": rng.choice(pool, size=m),
+                "dport": rng.choice(FLOW_PORTS, size=m),
+                "proto": rng.choice(FLOW_PROTOS, size=m),
+            }
+            ranks = np.arange(1, m + 1, dtype=np.float64)
+            w = ranks ** -float(zipf_s)
+            w /= w.sum()
+            picks = rng.permutation(m)[rng.choice(m, size=n, p=w)]
+            identity = cand["identity"][picks]
+            dport = cand["dport"][picks]
+            proto = cand["proto"][picks]
+        else:
+            identity = rng.choice(pool, size=n)
+            dport = rng.choice(FLOW_PORTS, size=n)
+            proto = rng.choice(FLOW_PROTOS, size=n)
+        return {
+            "ep_id": [int(x) for x in rng.choice(ep_ids, size=n)],
+            "identity": [int(x) for x in identity],
+            "dport": [int(x) for x in dport],
+            "proto": [int(x) for x in proto],
+            "direction": [int(x) for x in rng.integers(0, 2, size=n)],
+            "is_fragment": [
+                bool(x) for x in (rng.random(size=n) < 0.06)
+            ],
+        }

@@ -1,0 +1,574 @@
+"""Schedule engine: randomized events, whole-surface checks, and the
+recorded program the shrinker minimizes.
+
+One RUN is: build a FuzzWorld from a seeded spec, build the executor
+matrix, then drive a sequence of EVENTS.  Every event carries a
+materialized flow batch; applying an event means (1) apply its world
+mutation (rule/identity churn, publish, fault arming, chip kill),
+(2) republish to every executor when the world changed, (3) dispatch
+the flow batch through EVERY executor and assert the full observable
+surface:
+
+  * verdict columns bit-identical to the host lattice oracle
+    (evaluate_batch_oracle over the published map states);
+  * l4/l3 counter tensors and telemetry totals bit-identical across
+    the routed matrix;
+  * the daemon's flow-record DROP multiset equal to the oracle's
+    denial multiset (reason names included);
+  * exactly-once accounting everywhere (no lost/duplicated tuple,
+    submission, or batch).
+
+Generation EXECUTES while recording: every random decision is
+materialized into the event list, so the recorded program — spec +
+events — replays byte-for-byte with no rng at all (run_program).
+That recorded program is the (policy set, flow batch, event
+schedule) triple the shrinker delta-debugs.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from cilium_tpu import faultinject
+from cilium_tpu.fuzz import executors as X
+from cilium_tpu.fuzz import grammar as G
+from cilium_tpu.fuzz import world as W
+from cilium_tpu.fuzz.executors import (
+    VERDICT_FIELDS,
+    FuzzFailure,
+    build_executors,
+)
+
+DEFAULT_EXECUTORS = (
+    "daemon", "tp1", "tp2", "memo", "serve", "fusedtrio",
+)
+# the tier-1 smoke matrix the acceptance gate names: single-chip,
+# tp2-with-failover, memo-on
+SMOKE_EXECUTORS = ("daemon", "tp2", "memo")
+
+PROGRAM_VERSION = 1
+
+# forced coverage prefix: these ops land at fixed early positions so
+# EVERY schedule (any seed) exercises rule churn, identity churn,
+# chip kill/readmission, both new fault sites, cache toggles and a
+# forced full publish — the rest of the schedule is free draws
+_FORCED = {
+    1: "rule_add",
+    3: "ident_add",
+    5: "chip_kill",
+    7: "fault_publish",
+    9: "chip_readmit",
+    11: "fault_memo",
+    13: "memo_toggle_off",
+    15: "memo_toggle_on",
+    17: "rule_del",
+    19: "ident_del",
+    21: "publish_full",
+    23: "fault_memo_chip",
+}
+
+_FREE_OPS = (
+    "flows", "flows", "flows", "rule_add", "rule_del", "ident_add",
+    "ident_del", "publish_full", "memo_toggle", "fault_publish",
+    "fault_memo", "chip_toggle",
+)
+
+
+class _Runner:
+    """Executes events against a live world + executor matrix,
+    checking the surface after every one."""
+
+    def __init__(self, spec: dict, executor_names) -> None:
+        faultinject.disarm_all()
+        self.world = W.FuzzWorld(spec)
+        self.world.daemon.verdict_cache_enabled = True
+        self.executors = build_executors(self.world, executor_names)
+        (
+            self.version, self.tables, self.index, self.states,
+        ) = self.world.published()
+        self.chip_out = False
+        self._last_flow_seq = self._max_flow_seq()
+        self._last_evicted = self.world.daemon.flow_store.evicted
+        from cilium_tpu.metrics import registry as metrics
+
+        self._fallback0 = metrics.publish_fallback_total.get()
+        self._memo_fault0 = metrics.memo_insert_faults_total.get()
+        self.summary: Dict[str, object] = {
+            "steps": 0,
+            "flows_checked": 0,
+            "publishes": {"delta": 0, "full": 0},
+            "publish_fallbacks": 0,
+            "memo_insert_faults": 0,
+            "chip_kills": 0,
+            "chip_readmissions": 0,
+            "rebalances": 0,
+            "flow_record_checks": 0,
+            "zipf_steps": 0,
+            "events": Counter(),
+        }
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _max_flow_seq(self) -> int:
+        snap = self.world.daemon.flow_store.snapshot()
+        return max((r.seq for r in snap), default=0)
+
+    def _publish_all(self, force_full: bool = False) -> None:
+        (
+            self.version, self.tables, self.index, self.states,
+        ) = self.world.published()
+        pubs = self.summary["publishes"]
+        for ex in self.executors:
+            st = ex.publish(
+                self.tables, self.states, self.world.delta_for,
+                force_full=force_full,
+            )
+            if st is not None:
+                pubs[st.mode] = pubs.get(st.mode, 0) + 1
+
+    # -- event application ---------------------------------------------------
+
+    def apply_event(self, ev: dict, step: int) -> None:
+        op = ev["op"]
+        self.summary["events"][op] += 1
+        mutated = False
+        armed_site = None
+        if op == "rule_add":
+            self.world.add_rule(ev["rule"])
+            mutated = True
+        elif op == "rule_del":
+            self.world.del_rule(ev["label"])
+            mutated = True
+        elif op == "ident_add":
+            self.world.add_identity(ev["labels"], ev["ip"])
+            mutated = True
+        elif op == "ident_del":
+            self.world.del_identity(ev["labels"])
+            mutated = True
+        elif op == "publish_full":
+            self._publish_all(force_full=True)
+        elif op == "memo_toggle":
+            on = bool(ev["on"])
+            self.world.daemon.verdict_cache_enabled = on
+            for ex in self.executors:
+                if hasattr(ex, "set_memo"):
+                    ex.set_memo(on)
+        elif op == "chip_kill":
+            if not self.chip_out:
+                X.kill_chip(ev.get("chip", X.VICTIM_CHIP))
+                self.chip_out = True
+                self.summary["chip_kills"] += 1
+        elif op == "chip_readmit":
+            if self.chip_out:
+                X.readmit_chip(
+                    self.executors, ev.get("chip", X.VICTIM_CHIP)
+                )
+                self.chip_out = False
+                self.summary["chip_readmissions"] += 1
+        elif op == "fault_publish":
+            faultinject.arm(
+                "publish.scatter", ev.get("spec", "raise:next=1")
+            )
+            armed_site = "publish.scatter"
+            if "rule" in ev:
+                self.world.add_rule(ev["rule"])
+                mutated = True
+        elif op == "fault_memo":
+            # a verdict-cache fault is only schedulable with the
+            # cache in the path: force memo on for this step
+            self.world.daemon.verdict_cache_enabled = True
+            for ex in self.executors:
+                if hasattr(ex, "set_memo"):
+                    ex.set_memo(True)
+            faultinject.arm(
+                "memo.insert", ev.get("spec", "raise:next=1")
+            )
+            armed_site = "memo.insert"
+        elif op == "flows":
+            pass
+        else:
+            raise ValueError(f"unknown event op {op!r}")
+        if mutated:
+            self.world.regenerate()
+            self._publish_all()
+        try:
+            self.check_step(ev, step)
+        finally:
+            # fault-site arming is step-scoped: a spent (or
+            # unconsumed) schedule never leaks into later steps
+            if armed_site is not None:
+                faultinject.disarm(armed_site)
+
+    # -- the whole-surface check ---------------------------------------------
+
+    def check_step(self, ev: dict, step: int) -> None:
+        flows = ev["flows"]
+        n = len(flows["ep_id"])
+        allowed, proxy, kind = self.world.oracle(
+            flows, self.index, self.states
+        )
+        oracle_cols = {
+            "allowed": allowed.astype(np.int64),
+            "proxy_port": proxy.astype(np.int64),
+            "match_kind": kind.astype(np.int64),
+        }
+        # re-anchor the flow-record watermark: executors past the
+        # daemon (the serve plane) appended records for the PREVIOUS
+        # step's tuples after its window closed
+        self._last_flow_seq = self._max_flow_seq()
+        results: Dict[str, dict] = {}
+        for ex in self.executors:
+            if ex.name == "serve":
+                out = ex.dispatch(
+                    flows, self.index, step,
+                    chunks=ev.get("chunks"),
+                )
+            else:
+                out = ex.dispatch(flows, self.index, step)
+            results[ex.name] = out
+            if ex.name == "daemon":
+                # the drop-record window must close before the serve
+                # executor appends ITS records for the same tuples
+                self._check_flow_records(flows, oracle_cols, step)
+
+        for name, out in results.items():
+            if out.get("cols") is None:
+                continue
+            for fld in VERDICT_FIELDS:
+                got = np.asarray(out["cols"][fld]).astype(np.int64)
+                want = oracle_cols[fld]
+                if not np.array_equal(want, got):
+                    bad = np.flatnonzero(want != got)
+                    i = int(bad[0])
+                    raise FuzzFailure(
+                        (name,), fld, step,
+                        f"{bad.size}/{n} rows diverge from the "
+                        f"oracle; first at row {i}: tuple=("
+                        f"ep={flows['ep_id'][i]},"
+                        f"id={flows['identity'][i]},"
+                        f"dport={flows['dport'][i]},"
+                        f"proto={flows['proto'][i]},"
+                        f"dir={flows['direction'][i]},"
+                        f"frag={flows['is_fragment'][i]}) "
+                        f"want={want[i]} got={got[i]}",
+                    )
+
+        routed = [
+            (name, out)
+            for name, out in results.items()
+            if out.get("l4") is not None
+        ]
+        for (base_name, base), (name, out) in zip(
+            routed, routed[1:]
+        ):
+            for fld in ("l4", "l3", "telem"):
+                w, g = base.get(fld), out.get(fld)
+                if w is None or g is None:
+                    continue
+                if not np.array_equal(np.asarray(w), np.asarray(g)):
+                    raise FuzzFailure(
+                        (base_name, name), f"{fld}_counters", step,
+                        f"routed executors disagree on {fld}",
+                    )
+
+        if ev["op"] == "chip_readmit":
+            self._check_readmission(results, ev, step)
+        if ev.get("zipf_s"):
+            self.summary["zipf_steps"] += 1
+        self.summary["steps"] += 1
+        self.summary["flows_checked"] += n
+        self._refresh_fault_counters()
+
+    def _refresh_fault_counters(self) -> None:
+        from cilium_tpu.metrics import registry as metrics
+
+        self.summary["publish_fallbacks"] = int(
+            metrics.publish_fallback_total.get() - self._fallback0
+        )
+        self.summary["memo_insert_faults"] = int(
+            metrics.memo_insert_faults_total.get() - self._memo_fault0
+        )
+        self.summary["rebalances"] = sum(
+            ex.router.stats.rebalances
+            for ex in self.executors
+            if getattr(ex, "routed", False)
+        )
+
+    def _check_readmission(self, results, ev, step: int) -> None:
+        victim = int(ev.get("chip", X.VICTIM_CHIP))
+        for ex in self.executors:
+            if not getattr(ex, "routed", False):
+                continue
+            out = results.get(ex.name)
+            if out is None:
+                continue
+            state = ex.chip_states().get(victim)
+            if state != "closed":
+                raise FuzzFailure(
+                    (ex.name,), "readmission", step,
+                    f"chip {victim} is {state!r} after readmission "
+                    f"dispatch (states {ex.chip_states()})",
+                )
+
+    def _check_flow_records(self, flows, oracle_cols, step) -> None:
+        from cilium_tpu.engine import oracle as O
+        from cilium_tpu.telemetry import (
+            DROP_COLUMN_REASONS,
+            TELEM_DROP_FRAG,
+            TELEM_DROP_POLICY,
+        )
+
+        store = self.world.daemon.flow_store
+        if store.evicted != self._last_evicted:
+            # the ring wrapped mid-step: the window is incomplete,
+            # so the multiset compare would be noise — skip once and
+            # re-anchor (capacity 64k vs ~100-flow steps: only a
+            # soak that never truncates the store gets here)
+            self._last_evicted = store.evicted
+            self._last_flow_seq = self._max_flow_seq()
+            return
+        snap = store.snapshot()
+        new = [r for r in snap if r.seq > self._last_flow_seq]
+        self._last_flow_seq = max(
+            (r.seq for r in snap), default=self._last_flow_seq
+        )
+        # the window belongs to the ONE-SHOT daemon path (records
+        # carry no tenant); the serve executor's records for the
+        # same tuples are tenant-stamped (fz0/fz1) and must not
+        # double the multiset whatever the executor order
+        got = Counter(
+            (
+                int(r.ep_id),
+                int(
+                    r.src_identity
+                    if r.direction == 0
+                    else r.dst_identity
+                ),
+                int(r.dport),
+                int(r.proto),
+                int(r.direction),
+                r.drop_reason,
+            )
+            for r in new
+            if r.verdict == "DROPPED" and not r.tenant
+        )
+        frag_name = DROP_COLUMN_REASONS[TELEM_DROP_FRAG]
+        pol_name = DROP_COLUMN_REASONS[TELEM_DROP_POLICY]
+        want: Counter = Counter()
+        allowed = oracle_cols["allowed"]
+        kind = oracle_cols["match_kind"]
+        for i in range(len(allowed)):
+            if allowed[i]:
+                continue
+            reason = (
+                frag_name
+                if kind[i] == O.MATCH_FRAG_DROP
+                else pol_name
+            )
+            want[
+                (
+                    int(flows["ep_id"][i]),
+                    int(flows["identity"][i]),
+                    int(flows["dport"][i]),
+                    int(flows["proto"][i]),
+                    int(flows["direction"][i]),
+                    reason,
+                )
+            ] += 1
+        if got != want:
+            missing = want - got
+            extra = got - want
+            raise FuzzFailure(
+                ("daemon",), "flow-records", step,
+                f"drop-record multiset diverged: missing="
+                f"{dict(list(missing.items())[:3])} extra="
+                f"{dict(list(extra.items())[:3])}",
+            )
+        self.summary["flow_record_checks"] += 1
+
+    def close(self) -> None:
+        faultinject.disarm_all()
+        for ex in self.executors:
+            try:
+                ex.close()
+            except Exception:
+                pass
+        self.world.close()
+
+
+# ---------------------------------------------------------------------------
+# generation (records the program) and replay
+# ---------------------------------------------------------------------------
+
+
+def _chunk_sizes(rng, n: int) -> List[int]:
+    k = int(rng.integers(2, 6))
+    cuts = sorted(
+        int(c) for c in rng.integers(1, n, size=k - 1)
+    )
+    sizes = []
+    last = 0
+    for c in cuts + [n]:
+        if c > last:
+            sizes.append(c - last)
+            last = c
+    return sizes
+
+
+def _make_event(
+    rng, g: G.PolicyGrammar, runner: _Runner, op: str,
+    flows_per_step: int, ident_seq: List[int],
+) -> dict:
+    """Materialize one event against the CURRENT world state (raw
+    identity numbers, concrete rule JSON) so replay needs no rng."""
+    ev: dict = {"op": op}
+    if op == "rule_add" or op == "fault_publish":
+        ev_rule = g.gen_rule()
+        if op == "fault_publish":
+            ev["spec"] = "raise:next=1"
+        ev["rule"] = ev_rule
+    elif op == "rule_del":
+        labels = runner.world.live_rule_labels
+        if labels:
+            ev["label"] = labels[
+                int(rng.integers(0, len(labels)))
+            ]
+        else:
+            ev = {"op": "flows"}
+    elif op == "ident_add":
+        ident_seq[0] += 1
+        ev["labels"] = g.gen_identity_labels()
+        ev["labels"]["gen"] = f"g{ident_seq[0]}"  # keep keys unique
+        ev["ip"] = f"10.71.{ident_seq[0] // 200}.{ident_seq[0] % 200 + 1}"
+    elif op == "ident_del":
+        keys = sorted(runner.world._identities)
+        if keys:
+            key = keys[int(rng.integers(0, len(keys)))]
+            ev["labels"] = dict(
+                kv.split("=", 1) for kv in key.split(",")
+            )
+        else:
+            ev = {"op": "flows"}
+    elif op == "memo_toggle":
+        ev["on"] = bool(rng.integers(0, 2))
+    elif op == "memo_toggle_off":
+        ev = {"op": "memo_toggle", "on": False}
+    elif op == "memo_toggle_on":
+        ev = {"op": "memo_toggle", "on": True}
+    elif op == "chip_toggle":
+        ev = {
+            "op": (
+                "chip_readmit" if runner.chip_out else "chip_kill"
+            )
+        }
+    elif op == "fault_memo":
+        ev["spec"] = "raise:next=1"
+    elif op == "fault_memo_chip":
+        # chip-scoped memo fault: only the routed memo plane's
+        # per-chip probes can consume it
+        ev = {"op": "fault_memo", "spec": "raise:chip=0;next=1"}
+    zipf = 1.1 if rng.random() < 0.4 else 0.0
+    flows = g.gen_flows(
+        flows_per_step,
+        runner.world.ep_ids,
+        runner.world.identity_pool(),
+        zipf_s=zipf,
+    )
+    ev["flows"] = flows
+    ev["zipf_s"] = zipf
+    ev["chunks"] = _chunk_sizes(rng, flows_per_step)
+    return ev
+
+
+def run_fuzz(
+    seed: int,
+    steps: int = 28,
+    executors=SMOKE_EXECUTORS,
+    flows_per_step: int = 96,
+    n_endpoints: int = 3,
+    n_identities: int = 10,
+    n_rules: int = 8,
+    verbose: bool = False,
+) -> Tuple[dict, dict]:
+    """Generate-and-execute one seeded run, recording the program.
+    Returns (program, summary); on a surface mismatch raises
+    FuzzFailure with ``.program`` attached (events up to and
+    including the failing one) — the shrinker's input."""
+    spec = W.default_spec(
+        seed, n_endpoints=n_endpoints, n_identities=n_identities,
+        n_rules=n_rules,
+    )
+    program = {
+        "version": PROGRAM_VERSION,
+        "seed": int(seed),
+        "executors": list(executors),
+        "spec": spec,
+        "events": [],
+    }
+    rng = np.random.default_rng([int(seed), 1])
+    runner = _Runner(spec, executors)
+    g = G.PolicyGrammar(rng, n_endpoints)
+    g.rule_seq = spec["rule_seq"]
+    g._cidr_seq = spec["cidr_seq"]
+    ident_seq = [0]
+    try:
+        for step in range(1, int(steps) + 1):
+            op = _FORCED.get(step)
+            if op is None:
+                op = _FREE_OPS[
+                    int(rng.integers(0, len(_FREE_OPS)))
+                ]
+            ev = _make_event(
+                rng, g, runner, op, flows_per_step, ident_seq
+            )
+            program["events"].append(ev)
+            t0 = time.perf_counter()
+            try:
+                runner.apply_event(ev, step)
+            except FuzzFailure as f:
+                f.program = program
+                raise
+            if verbose:
+                print(
+                    f"  step {step:3d} {ev['op']:<14s} "
+                    f"{(time.perf_counter() - t0) * 1e3:6.0f} ms"
+                )
+        summary = dict(runner.summary)
+        summary["events"] = dict(runner.summary["events"])
+        return program, summary
+    finally:
+        runner.close()
+
+
+def run_program(program: dict) -> dict:
+    """Replay a recorded program byte-for-byte (no rng): same spec,
+    same events, same checks.  Returns the summary; raises
+    FuzzFailure (with ``.program`` attached) on mismatch."""
+    runner = _Runner(program["spec"], program["executors"])
+    try:
+        for step, ev in enumerate(program["events"], 1):
+            try:
+                runner.apply_event(ev, step)
+            except FuzzFailure as f:
+                f.program = program
+                raise
+        summary = dict(runner.summary)
+        summary["events"] = dict(runner.summary["events"])
+        return summary
+    finally:
+        runner.close()
+
+
+def generate_program(
+    seed: int, steps: int = 28, executors=SMOKE_EXECUTORS, **kw
+) -> dict:
+    """The recorded program of a (passing) seeded run — a
+    convenience wrapper for tests that want the program itself."""
+    program, _ = run_fuzz(
+        seed, steps=steps, executors=executors, **kw
+    )
+    return program

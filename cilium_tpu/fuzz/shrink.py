@@ -1,0 +1,290 @@
+"""Automatic repro shrinking: delta-debug the (policy set, flow
+batch, event schedule) triple to a minimal deterministic program.
+
+A fuzz failure arrives as a recorded program (spec + materialized
+events) plus a failure signature (the executor set and field that
+diverged).  The shrinker minimizes in decreasing-leverage order,
+re-running the REAL replay (cilium_tpu.fuzz.harness.run_program)
+as its predicate and accepting a candidate only when it fails with
+the SAME signature:
+
+  1. executors — restrict the matrix to the diverging executors
+     (one world rebuild per predicate call is the dominant cost, so
+     dropping five executors first makes everything after cheap);
+  2. events — ddmin over the schedule, after truncating every event
+     past the failing step (they never executed);
+  3. policies — ddmin over the initial rule set (rule_del events
+     referencing a removed rule degrade to no-ops by design);
+  4. flows — per surviving event, ddmin over the flow batch's rows;
+  5. identities — ddmin over the spec identity pool (attempted
+     last: removing an identity renumbers the allocator universe,
+     so most candidates are rejected — but when it works it
+     shrinks the repro's world, not just its schedule).
+
+The result replays byte-for-byte: ``write_repro`` emits a
+``repro_*.json`` that ``tools/policyfuzz.py --replay`` re-runs, and
+whose failure signature matches the original.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from cilium_tpu.fuzz.executors import FuzzFailure
+from cilium_tpu.logging import get_logger
+
+log = get_logger("fuzz.shrink")
+
+FLOW_COLS = (
+    "ep_id", "identity", "dport", "proto", "direction",
+    "is_fragment",
+)
+
+
+def replay_failure(program: dict) -> Optional[FuzzFailure]:
+    """Run a candidate program; return its FuzzFailure (None when it
+    passes).  Any non-FuzzFailure exception counts as NOT the same
+    bug — shrinking must converge to the observed divergence, not to
+    whatever crash a mangled candidate can produce."""
+    from cilium_tpu.fuzz.harness import run_program
+
+    try:
+        run_program(program)
+    except FuzzFailure as f:
+        return f
+    except Exception as exc:  # noqa: BLE001 — see docstring
+        log.warning(
+            "shrink candidate crashed (rejected)",
+            extra={"fields": {"error": repr(exc)}},
+        )
+        return None
+    return None
+
+
+def _ddmin(
+    items: Sequence,
+    fails: Callable[[List], bool],
+    budget: List[float],
+) -> List:
+    """Zeller ddmin over a list: repeatedly try dropping chunks
+    (then complements) at doubling granularity, keeping any reduced
+    list that still fails.  ``budget`` is [deadline_monotonic] — a
+    soft wall-clock guard; past it the current (still-failing) list
+    is returned as-is."""
+    items = list(items)
+    n = 2
+    while len(items) >= 2:
+        if time.monotonic() > budget[0]:
+            return items
+        chunk = max(len(items) // n, 1)
+        reduced = False
+        start = 0
+        while start < len(items):
+            candidate = items[:start] + items[start + chunk:]
+            if candidate and fails(candidate):
+                items = candidate
+                n = max(n - 1, 2)
+                reduced = True
+                start = 0
+                continue
+            start += chunk
+        if not reduced:
+            if n >= len(items):
+                break
+            n = min(n * 2, len(items))
+    # final pass: single-item removal (and try the empty list for
+    # item kinds where emptiness is legal, e.g. policies)
+    for i in reversed(range(len(items))):
+        if time.monotonic() > budget[0]:
+            break
+        candidate = items[:i] + items[i + 1:]
+        if candidate and fails(candidate):
+            items = candidate
+    return items
+
+
+def _slice_flows(flows: dict, keep: List[int]) -> dict:
+    return {
+        col: [flows[col][i] for i in keep] for col in FLOW_COLS
+    }
+
+
+def _with(program: dict, **parts) -> dict:
+    out = copy.deepcopy(program)
+    for k, v in parts.items():
+        if k in ("events", "executors"):
+            out[k] = v
+        else:
+            out["spec"][k] = v
+    return out
+
+
+def shrink_program(
+    program: dict,
+    failure: FuzzFailure,
+    time_budget_s: float = 240.0,
+    verbose: bool = False,
+) -> Tuple[dict, FuzzFailure, dict]:
+    """Minimize ``program`` while preserving ``failure``'s signature.
+    Returns (minimal program, its replayed failure, stats)."""
+    want_sig = failure.signature()
+    budget = [time.monotonic() + float(time_budget_s)]
+    stats = {"replays": 0, "accepted": 0}
+    current = copy.deepcopy(program)
+
+    def fails_program(candidate: dict) -> Optional[FuzzFailure]:
+        stats["replays"] += 1
+        got = replay_failure(candidate)
+        if got is not None and got.signature() == want_sig:
+            stats["accepted"] += 1
+            return got
+        return None
+
+    def note(tag: str) -> None:
+        if verbose:
+            print(
+                f"  shrink[{tag}]: events="
+                f"{len(current['events'])} "
+                f"policies={len(current['spec']['policies'])} "
+                f"identities={len(current['spec']['identities'])} "
+                f"replays={stats['replays']}"
+            )
+
+    # 1. executors → the diverging set (plus daemon when the serve
+    # plane is involved: it dispatches through the daemon)
+    keep = set(failure.executors)
+    if "serve" in keep:
+        keep.add("daemon")
+    keep &= set(current["executors"])
+    if keep and keep != set(current["executors"]):
+        candidate = _with(current, executors=sorted(keep))
+        if fails_program(candidate):
+            current = candidate
+    note("executors")
+
+    # 2a. truncate past the failing step (those events never ran)
+    if failure.step < len(current["events"]):
+        candidate = _with(
+            current, events=current["events"][: failure.step]
+        )
+        if fails_program(candidate):
+            current = candidate
+
+    # 2b. ddmin the event schedule
+    current["events"] = _ddmin(
+        current["events"],
+        lambda evs: fails_program(_with(current, events=evs))
+        is not None,
+        budget,
+    )
+    note("events")
+
+    # 3. ddmin the initial policies
+    current["spec"]["policies"] = _ddmin(
+        current["spec"]["policies"],
+        lambda pols: fails_program(_with(current, policies=pols))
+        is not None,
+        budget,
+    )
+    # policies can legally be empty
+    if current["spec"]["policies"]:
+        candidate = _with(current, policies=[])
+        if fails_program(candidate):
+            current["spec"]["policies"] = []
+    note("policies")
+
+    # 4. ddmin each surviving event's flow rows
+    for i, ev in enumerate(current["events"]):
+        flows = ev.get("flows")
+        if not flows:
+            continue
+        rows = list(range(len(flows["ep_id"])))
+
+        def fails_rows(keep_rows: List[int], i=i, flows=flows):
+            cand = copy.deepcopy(current)
+            cand["events"][i]["flows"] = _slice_flows(
+                flows, keep_rows
+            )
+            cand["events"][i].pop("chunks", None)
+            return fails_program(cand) is not None
+
+        kept = _ddmin(rows, fails_rows, budget)
+        if len(kept) < len(rows):
+            current["events"][i]["flows"] = _slice_flows(
+                flows, kept
+            )
+            current["events"][i].pop("chunks", None)
+    note("flows")
+
+    # 5. ddmin the identity pool (allocator renumbering rejects most
+    # candidates; harmless when it does)
+    current["spec"]["identities"] = _ddmin(
+        current["spec"]["identities"],
+        lambda ids: fails_program(_with(current, identities=ids))
+        is not None,
+        budget,
+    )
+    note("identities")
+
+    final_failure = replay_failure(current)
+    stats["replays"] += 1
+    assert (
+        final_failure is not None
+        and final_failure.signature() == want_sig
+    ), "shrinker lost the failure — ddmin acceptance is broken"
+    stats["events"] = len(current["events"])
+    stats["policies"] = len(current["spec"]["policies"])
+    stats["flows"] = max(
+        (
+            len(ev["flows"]["ep_id"])
+            for ev in current["events"]
+            if ev.get("flows")
+        ),
+        default=0,
+    )
+    return current, final_failure, stats
+
+
+def write_repro(
+    program: dict,
+    failure: FuzzFailure,
+    out_dir: str = ".",
+    stats: Optional[dict] = None,
+) -> str:
+    """Emit the re-runnable repro file: the minimal program plus the
+    failure signature it reproduces.  Returns the path."""
+    payload = dict(program)
+    payload["failure"] = {
+        "executors": list(failure.executors),
+        "field": failure.field,
+        "step": failure.step,
+        "detail": failure.detail,
+    }
+    if stats:
+        payload["shrink_stats"] = {
+            k: v for k, v in stats.items() if k != "accepted"
+        }
+    name = (
+        f"repro_seed{program.get('seed', 0)}_"
+        f"{failure.field.replace(':', '-')}.json"
+    )
+    path = os.path.join(out_dir, name)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    return path
+
+
+def replay_repro(path: str) -> Optional[FuzzFailure]:
+    """Load and replay a repro file; returns the reproduced
+    FuzzFailure (None when the bug no longer reproduces)."""
+    with open(path, "r", encoding="utf-8") as f:
+        payload = json.load(f)
+    program = {
+        k: payload[k]
+        for k in ("version", "seed", "executors", "spec", "events")
+    }
+    return replay_failure(program)

@@ -1,0 +1,220 @@
+"""The fuzzer's policy world: a real Daemon built from a recorded
+spec, mutated by schedule events, publishing real tables.
+
+The world is deliberately the WHOLE control plane, not a map-state
+stub: generated rule JSON goes through ``rules_from_json`` →
+``Daemon.policy_add`` (sanitize, CIDR identity allocation, selector
+cache) → endpoint regeneration (``compute_desired_policy_map_state``)
+→ ``FleetCompiler`` publication — so an oracle mismatch indicts the
+actual compiler/engine stack, and the shrunk repro replays the same
+stack byte-for-byte.
+
+Determinism contract: building the same spec and applying the same
+event list yields the same identity numbering (the allocator hands
+out ids in call order), the same realized map states, the same
+compiled tables and the same published stamps.  Everything the
+builder consumes is materialized JSON (no rng in this module).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from cilium_tpu.fuzz import grammar as G
+
+# fixed world shape: endpoints don't churn (their index order is the
+# executor-visible batch axis), identities and rules do
+ENDPOINT_BASE_ID = 601
+
+
+def default_spec(
+    seed: int,
+    n_endpoints: int = 3,
+    n_identities: int = 10,
+    n_rules: int = 8,
+) -> dict:
+    """Materialize the opening world from a seed: endpoint labels,
+    the identity pool, and the initial (parser-round-tripped) rule
+    set.  The returned dict is the repro file's ``spec`` section."""
+    rng = np.random.default_rng(seed)
+    g = G.PolicyGrammar(rng, n_endpoints)
+    endpoints = []
+    for i in range(n_endpoints):
+        endpoints.append(
+            {
+                "id": ENDPOINT_BASE_ID + i,
+                "app": g.endpoint_app(i),
+                "team": G.TEAMS[i % len(G.TEAMS)],
+                "ip": f"10.60.0.{i + 1}",
+            }
+        )
+    identities = []
+    for i in range(n_identities):
+        identities.append(
+            {
+                "labels": g.gen_identity_labels(),
+                "ip": f"10.70.0.{i + 1}",
+            }
+        )
+    policies = g.gen_initial_policies(n_rules)
+    return {
+        "seed": int(seed),
+        "endpoints": endpoints,
+        "identities": identities,
+        "policies": policies,
+        "rule_seq": g.rule_seq,
+        "cidr_seq": g._cidr_seq,
+    }
+
+
+class FuzzWorld:
+    """Daemon + endpoints + identity pool + live rule labels, with
+    the regenerate/publish plumbing the harness drives."""
+
+    def __init__(self, spec: dict) -> None:
+        import json
+
+        from cilium_tpu.daemon import Daemon
+        from cilium_tpu.labels import Label, Labels
+        from cilium_tpu.policy.api.parse import rules_from_json
+
+        self.spec = spec
+        self.daemon = Daemon(num_workers=2)
+        # synchronous control plane: the harness regenerates
+        # explicitly after each mutating event
+        self.daemon.policy_trigger.close(wait=True)
+        self.endpoints = []
+        for ep in spec["endpoints"]:
+            labels = Labels(
+                {
+                    "app": Label("app", ep["app"], "k8s"),
+                    "team": Label("team", ep["team"], "k8s"),
+                }
+            )
+            self.endpoints.append(
+                self.daemon.create_endpoint(
+                    ep["id"], labels, ipv4=ep["ip"], name=ep["app"]
+                )
+            )
+        self.ep_ids = [ep["id"] for ep in spec["endpoints"]]
+        # identity pool: {key: (Identity, ip)} in allocation order —
+        # ident_del events reference entries by their spec payload
+        self._identities: Dict[str, Tuple[object, str]] = {}
+        for ident in spec["identities"]:
+            self.add_identity(ident["labels"], ident["ip"])
+        for spec_rule in spec["policies"]:
+            self.daemon.policy_add(
+                rules_from_json(json.dumps(spec_rule))
+            )
+        self.live_rule_labels: List[str] = [
+            r["labels"][0] for r in spec["policies"]
+        ]
+        # monotonically applied world revision (summary/debug)
+        self.revision = 0
+        self.regenerate()
+
+    # -- identity pool -------------------------------------------------------
+
+    @staticmethod
+    def _ident_key(labels: dict) -> str:
+        return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+    def add_identity(self, labels: dict, ip: str) -> int:
+        from cilium_tpu.ipcache.ipcache import IPIdentity
+        from cilium_tpu.labels import Label, Labels
+
+        lbl = Labels(
+            {k: Label(k, v, "k8s") for k, v in labels.items()}
+        )
+        ident, _ = self.daemon.identity_allocator.allocate(lbl)
+        self._identities[self._ident_key(labels)] = (ident, ip)
+        self.daemon.ipcache.upsert(
+            ip, IPIdentity(ident.id, "kvstore")
+        )
+        return int(ident.id)
+
+    def del_identity(self, labels: dict) -> bool:
+        """Release a pooled identity (refcount 1 → gone from the
+        cache; the compiler full-resets on the shrunk universe).
+        Unknown keys are a no-op — the shrinker may have removed the
+        matching ident_add."""
+        key = self._ident_key(labels)
+        got = self._identities.pop(key, None)
+        if got is None:
+            return False
+        ident, ip = got
+        self.daemon.ipcache.delete(ip)
+        return self.daemon.identity_allocator.release(ident)
+
+    def identity_pool(self) -> List[int]:
+        """Every identity number currently in the allocator cache —
+        pooled identities AND rule-derived CIDR identities — the
+        flow sampler's live universe."""
+        return sorted(int(i) for i in self.daemon.identity_cache())
+
+    # -- policy churn --------------------------------------------------------
+
+    def add_rule(self, spec_rule: dict) -> None:
+        import json
+
+        from cilium_tpu.policy.api.parse import rules_from_json
+
+        self.daemon.policy_add(rules_from_json(json.dumps(spec_rule)))
+        self.live_rule_labels.append(spec_rule["labels"][0])
+
+    def del_rule(self, label: str) -> int:
+        from cilium_tpu.labels import LabelArray
+
+        _, n = self.daemon.policy_delete(LabelArray.parse(label))
+        if label in self.live_rule_labels:
+            self.live_rule_labels.remove(label)
+        return n
+
+    # -- publication ---------------------------------------------------------
+
+    def regenerate(self):
+        """Regenerate every endpoint and publish the fleet tables;
+        returns (version, tables, index, states) — the states list
+        (endpoint-axis order) is the oracle's substrate."""
+        self.revision += 1
+        self.daemon.regenerate_all(f"fuzz rev {self.revision}")
+        return self.published()
+
+    def published(self):
+        mgr = self.daemon.endpoint_manager
+        version, tables, index, states = mgr.published_with_states()
+        assert tables is not None, "world has no published tables"
+        return version, tables, index, states
+
+    def delta_for(self, base_stamp, tables):
+        return self.daemon.endpoint_manager.delta_for(
+            base_stamp, tables
+        )
+
+    def oracle(self, flows: dict, index: Dict[int, int], states):
+        """Host-lattice truth for one materialized flow batch: the
+        3-probe oracle over DEEP-COPIED states (the oracle bumps
+        entry counters; the published dicts must stay pristine)."""
+        from cilium_tpu.engine.oracle import evaluate_batch_oracle
+
+        ep_index = np.asarray(
+            [index[ep] for ep in flows["ep_id"]], np.int64
+        )
+        return evaluate_batch_oracle(
+            copy.deepcopy(list(states)),
+            ep_index=ep_index,
+            identity=np.asarray(flows["identity"], np.uint32),
+            dport=np.asarray(flows["dport"], np.int64),
+            proto=np.asarray(flows["proto"], np.int64),
+            direction=np.asarray(flows["direction"], np.int64),
+            is_fragment=np.asarray(flows["is_fragment"], bool),
+        )
+
+    def close(self) -> None:
+        try:
+            self.daemon.policy_trigger.close(wait=False)
+        except Exception:
+            pass

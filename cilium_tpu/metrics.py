@@ -305,6 +305,19 @@ class Registry:
             "Injected faults fired, by site and mode",
             ("site", "mode"),
         )
+        self.publish_fallback_total = Counter(
+            f"{ns}_publish_fallback_total",
+            "Delta publishes that fell back to a full upload "
+            "because an armed publish.scatter fault poisoned the "
+            "device scatter (real scatter errors de-register the "
+            "spare and propagate instead)",
+        )
+        self.memo_insert_faults_total = Counter(
+            f"{ns}_memo_insert_faults_total",
+            "Verdict-cache commits dropped by a memo.insert fault; "
+            "each such batch re-dispatched through the uncached "
+            "program (bit-identity unconditional)",
+        )
         # -- per-chip failover plane (engine/failover.py) ----------------
         self.chip_breaker_state = Gauge(
             f"{ns}_chip_breaker_state",

@@ -1,0 +1,279 @@
+"""The ISSUE 14 satellite fault sites: publish.scatter (delta-publish
+device scatter) and memo.insert (verdict-cache insert/commit path),
+chip-scoped selectors honored, fallback paths engaging instead of
+broken publishes or stale caches — and never a silently-swallowed
+FaultInjected.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu import faultinject
+from cilium_tpu.metrics import registry as metrics
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultinject.disarm_all()
+    yield
+    faultinject.disarm_all()
+
+
+def _small_world(seed=3):
+    """FleetCompiler world small enough for per-test publishes."""
+    from cilium_tpu.compiler.tables import FleetCompiler
+    from cilium_tpu.maps.policymap import PolicyKey, PolicyMapStateEntry
+
+    rng = np.random.default_rng(seed)
+    ids = [1, 2, 3] + [256 + i for i in range(13)]
+    states = []
+    for _ in range(2):
+        st = {}
+        for _ in range(12):
+            st[
+                PolicyKey(
+                    int(rng.choice(ids)),
+                    int(rng.choice([53, 80, 443])),
+                    int(rng.choice([6, 17])),
+                    int(rng.integers(0, 2)),
+                )
+            ] = PolicyMapStateEntry()
+        for _ in range(6):
+            st[
+                PolicyKey(int(rng.choice(ids)), 0, 0,
+                          int(rng.integers(0, 2)))
+            ] = PolicyMapStateEntry()
+        states.append(st)
+    fc = FleetCompiler(identity_pad=64, filter_pad=16)
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)], ids
+        )[0]
+
+    return states, ids, fc, compile_eps
+
+
+def _churn(states, ids, step):
+    from cilium_tpu.maps.policymap import (
+        INGRESS,
+        PolicyKey,
+        PolicyMapStateEntry,
+    )
+
+    states[step % len(states)][
+        PolicyKey(ids[step % len(ids)], 7000 + step, 6, INGRESS)
+    ] = PolicyMapStateEntry()
+
+
+def _tables_equal(dev, host):
+    import jax
+
+    for d, h in zip(jax.tree.leaves(dev), jax.tree.leaves(host)):
+        d, h = np.asarray(d), np.asarray(h)
+        if h.dtype == np.uint64:
+            # the generation stamp truncates to its low 32 bits on
+            # device without jax x64 (the store's documented _norm)
+            d = d.astype(np.uint64) & 0xFFFFFFFF
+            h = h & 0xFFFFFFFF
+        np.testing.assert_array_equal(d, h)
+
+
+class TestPublishScatterSite:
+    def test_fault_falls_back_to_full_upload(self):
+        """An armed publish.scatter poisons the delta scatter; the
+        publish must still land — as a FULL upload, counted in
+        publish_fallback_total, resident tables exactly the host
+        compile — and the NEXT delta publish must ride the delta
+        path again."""
+        from cilium_tpu.engine.publish import DeviceTableStore
+
+        states, ids, fc, compile_eps = _small_world()
+        store = DeviceTableStore()
+        t0 = compile_eps()
+        store.publish(t0)
+        store.publish(compile_eps())  # prime both epochs
+
+        _churn(states, ids, 1)
+        fresh = compile_eps()
+        delta = fc.delta_for(store.spare_stamp(), fresh)
+        assert delta is not None
+        before = metrics.publish_fallback_total.get()
+        faultinject.arm("publish.scatter", "raise:next=1")
+        dev, st = store.publish(fresh, delta)
+        assert st.mode == "full"
+        assert metrics.publish_fallback_total.get() == before + 1
+        _tables_equal(dev, fresh)
+
+        # the de-registered spare re-primes on the next publish (a
+        # full), after which the delta path is healthy again
+        _churn(states, ids, 2)
+        fresh2 = compile_eps()
+        dev2, st2 = store.publish(
+            fresh2, fc.delta_for(store.spare_stamp(), fresh2)
+        )
+        _tables_equal(dev2, fresh2)
+        _churn(states, ids, 3)
+        fresh3 = compile_eps()
+        delta3 = fc.delta_for(store.spare_stamp(), fresh3)
+        dev3, st3 = store.publish(fresh3, delta3)
+        assert st3.mode == "delta", (st2.mode, st3.mode)
+        _tables_equal(dev3, fresh3)
+
+    def test_chip_scope_honored(self):
+        """A chip-scoped spec for an ordinal that holds no slice of
+        the spare epoch never fires (the delta proceeds); the
+        resident ordinal's scope does fire."""
+        from cilium_tpu.engine.publish import DeviceTableStore
+
+        states, ids, fc, compile_eps = _small_world(seed=5)
+        store = DeviceTableStore()
+        store.publish(compile_eps())
+        store.publish(compile_eps())
+        resident = sorted(store.chip_bytes())
+        absent = max(resident) + 17
+
+        _churn(states, ids, 1)
+        fresh = compile_eps()
+        faultinject.arm("publish.scatter", f"raise:chip={absent}")
+        _, st = store.publish(
+            fresh, fc.delta_for(store.spare_stamp(), fresh)
+        )
+        faultinject.disarm("publish.scatter")
+        assert st.mode == "delta", (
+            "out-of-scope chip fault consumed the publish"
+        )
+
+        _churn(states, ids, 2)
+        fresh = compile_eps()
+        faultinject.arm(
+            "publish.scatter", f"raise:chip={resident[0]}"
+        )
+        _, st = store.publish(
+            fresh, fc.delta_for(store.spare_stamp(), fresh)
+        )
+        assert st.mode == "full"
+
+
+def _fuzz_daemon_world(seed=3):
+    from cilium_tpu.fuzz.world import FuzzWorld, default_spec
+
+    return FuzzWorld(default_spec(seed, n_rules=5))
+
+
+class TestMemoInsertSite:
+    def test_daemon_commit_fault_bit_identical(self):
+        """memo.insert fired at the daemon's cache commit: the
+        retry/breaker machinery absorbs it (surfaced, not
+        swallowed) and the verdict stream stays bit-identical."""
+        from cilium_tpu.native import encode_flow_records
+
+        world = _fuzz_daemon_world()
+        try:
+            d = world.daemon
+            d.verdict_cache_enabled = True
+            pool = world.identity_pool() + [999999]
+            rng = np.random.default_rng(11)
+            n = 128
+            buf = encode_flow_records(
+                ep_id=rng.choice(world.ep_ids, size=n).astype(
+                    np.uint32
+                ),
+                identity=rng.choice(pool, size=n).astype(np.uint32),
+                saddr=np.zeros(n, np.uint32),
+                daddr=np.zeros(n, np.uint32),
+                sport=np.full(n, 40000, np.uint16),
+                dport=rng.choice([53, 80, 443], size=n).astype(
+                    np.uint16
+                ),
+                proto=rng.choice([6, 17], size=n).astype(np.uint8),
+                direction=rng.integers(0, 2, size=n).astype(
+                    np.uint8
+                ),
+                is_fragment=np.zeros(n, np.uint8),
+            )
+            want = d.process_flows(
+                buf, batch_size=n, collect_verdicts=True
+            )
+            before = metrics.memo_insert_faults_total.get()
+            faultinject.arm("memo.insert", "raise:next=1")
+            got = d.process_flows(
+                buf, batch_size=n, collect_verdicts=True
+            )
+            assert metrics.memo_insert_faults_total.get() > before
+            for f in ("allowed", "match_kind", "proxy_port"):
+                np.testing.assert_array_equal(
+                    np.asarray(want.verdicts[f]),
+                    np.asarray(got.verdicts[f]),
+                    err_msg=f"memo.insert fault changed {f}",
+                )
+        finally:
+            world.close()
+
+    def test_router_chip_scoped_probe(self):
+        """The routed memo plane probes memo.insert once per ALIVE
+        ordinal: a chip-scoped fault drops that batch's write-back
+        (counted in the router's insert_faults) and the batch
+        re-dispatches uncached, bit-identical; an out-of-grid chip
+        scope never fires."""
+        from cilium_tpu.fuzz.executors import RouterExecutor
+
+        world = _fuzz_daemon_world(seed=9)
+        try:
+            ex = RouterExecutor("memo", world, dp=1, tp=2, memo=True)
+            _, _, index, states = world.published()
+            rng = np.random.default_rng(13)
+            n = 64
+            flows = {
+                "ep_id": [
+                    int(x) for x in rng.choice(world.ep_ids, size=n)
+                ],
+                "identity": [
+                    int(x)
+                    for x in rng.choice(
+                        world.identity_pool() + [999999], size=n
+                    )
+                ],
+                "dport": [
+                    int(x) for x in rng.choice([53, 80, 443], size=n)
+                ],
+                "proto": [
+                    int(x) for x in rng.choice([6, 17], size=n)
+                ],
+                "direction": [
+                    int(x) for x in rng.integers(0, 2, size=n)
+                ],
+                "is_fragment": [False] * n,
+            }
+            want = ex.dispatch(flows, index, step=0)
+
+            # out-of-grid scope: no fire, no fault accounting
+            faultinject.arm("memo.insert", "raise:chip=99;next=1")
+            out = ex.dispatch(flows, index, step=1)
+            faultinject.disarm("memo.insert")
+            assert ex.router._memo["insert_faults"] == 0
+            for f in ("allowed", "match_kind", "proxy_port"):
+                np.testing.assert_array_equal(
+                    want["cols"][f], out["cols"][f]
+                )
+
+            # in-grid scope (ordinal 0): the write-back drops and
+            # the batch re-dispatches uncached — same verdicts
+            faultinject.arm("memo.insert", "raise:chip=0;next=1")
+            out = ex.dispatch(flows, index, step=2)
+            assert ex.router._memo["insert_faults"] == 1
+            for f in ("allowed", "match_kind", "proxy_port"):
+                np.testing.assert_array_equal(
+                    want["cols"][f], out["cols"][f]
+                )
+        finally:
+            world.close()
+
+
+def test_sites_registered():
+    """Both new seams are armable SITES (the REST/CLI surface
+    validates against this tuple)."""
+    assert "publish.scatter" in faultinject.SITES
+    assert "memo.insert" in faultinject.SITES

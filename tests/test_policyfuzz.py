@@ -1,0 +1,206 @@
+"""Differential policy fuzzer: the tier-1 smoke gate, the planted-bug
+shrinker proof, the grammar round-trip, and the seed-determinism lint.
+
+The acceptance surface of ISSUE 14:
+
+  * ``policyfuzz --smoke`` semantics: a fixed seed, >= 25 randomized
+    schedule steps across >= 3 executors (single-chip daemon,
+    tp2-with-failover, memo-on), zero oracle mismatches, with
+    injected publish.scatter / memo.insert faults and chip
+    kill/readmission cycles engaging their fallback paths instead of
+    breaking bit-identity or exactly-once accounting;
+  * the shrinker, proven on a PLANTED bug (a monkeypatched executor
+    that misverdicts one specific (identity, dport) pair): converges
+    to <= 3 rules, <= 4 flows, <= 2 events, and the emitted repro
+    file replays to the same failure signature;
+  * no unseeded RNG anywhere on the fuzz/chaos/bench seed chain.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cilium_tpu.fuzz.executors import FuzzFailure
+from cilium_tpu.fuzz.harness import (
+    SMOKE_EXECUTORS,
+    run_fuzz,
+    run_program,
+)
+
+SMOKE_SEED = 7
+SMOKE_STEPS = 28
+
+
+def test_policyfuzz_smoke():
+    """The tier-1 gate: fixed seed, trimmed executor matrix, every
+    event class forced into the schedule, zero mismatches."""
+    program, summary = run_fuzz(
+        SMOKE_SEED,
+        steps=SMOKE_STEPS,
+        executors=SMOKE_EXECUTORS,
+        flows_per_step=96,
+    )
+    assert summary["steps"] >= 25
+    assert len(program["executors"]) >= 3
+    assert summary["flows_checked"] >= 25 * 96
+    # both publish modes exercised, and the injected scatter fault
+    # engaged the full-upload fallback (never a failed publish)
+    assert summary["publishes"]["delta"] > 0
+    assert summary["publishes"]["full"] > 0
+    assert summary["publish_fallbacks"] >= 1
+    # the memo.insert faults dropped write-backs and re-dispatched
+    # uncached — counted, bit-identity implicitly proven by the run
+    assert summary["memo_insert_faults"] >= 1
+    # chip kill/readmission cycles with real rebalances
+    assert summary["chip_kills"] >= 1
+    assert summary["chip_readmissions"] >= 1
+    assert summary["rebalances"] >= 1
+    # distribution + observability coverage
+    assert summary["zipf_steps"] >= 1
+    assert summary["flow_record_checks"] == summary["steps"]
+    # the recorded program replays clean (same seed, same world,
+    # byte-for-byte events) — the determinism the shrinker rests on
+    assert len(program["events"]) == SMOKE_STEPS
+
+
+def test_shrinker_planted_bug(tmp_path, monkeypatch):
+    """Plant a misverdict for one (identity, dport) pair in the
+    daemon executor; the fuzzer must catch it, the shrinker must
+    converge to <= 3 rules / <= 4 flows / <= 2 events, and the
+    emitted repro must replay to the same failure."""
+    from cilium_tpu.fuzz import executors as X
+    from cilium_tpu.fuzz.shrink import (
+        replay_repro,
+        shrink_program,
+        write_repro,
+    )
+
+    target_identity, target_dport = 263, 80
+    orig = X.DaemonExecutor.dispatch
+
+    def buggy(self, flows, index, step):
+        out = orig(self, flows, index, step)
+        ident = np.asarray(flows["identity"])
+        dport = np.asarray(flows["dport"])
+        mask = (ident == target_identity) & (dport == target_dport)
+        cols = out["cols"]
+        cols["allowed"] = np.where(
+            mask,
+            1 - cols["allowed"].astype(np.int64),
+            cols["allowed"],
+        ).astype(np.int64)
+        return out
+
+    monkeypatch.setattr(X.DaemonExecutor, "dispatch", buggy)
+
+    with pytest.raises(FuzzFailure) as exc:
+        run_fuzz(
+            5, steps=10, executors=("daemon",),
+            flows_per_step=32, n_rules=6,
+        )
+    failure = exc.value
+    assert failure.executors == ("daemon",)
+    assert failure.field == "allowed"
+    program = failure.program
+
+    mini, mini_failure, stats = shrink_program(program, failure)
+    assert mini_failure.signature() == failure.signature()
+    assert stats["events"] <= 2, stats
+    assert stats["policies"] <= 3, stats
+    assert stats["flows"] <= 4, stats
+    # the surviving flow row IS the planted pair
+    flows = next(
+        ev["flows"] for ev in mini["events"] if ev.get("flows")
+    )
+    assert target_identity in flows["identity"]
+    assert target_dport in flows["dport"]
+
+    path = write_repro(mini, mini_failure, str(tmp_path), stats=stats)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["failure"]["field"] == "allowed"
+    replayed = replay_repro(path)
+    assert replayed is not None, "repro did not reproduce"
+    assert replayed.signature() == failure.signature()
+
+    # with the planted bug removed the repro must pass clean
+    monkeypatch.setattr(X.DaemonExecutor, "dispatch", orig)
+    assert replay_repro(path) is None
+
+
+def test_grammar_round_trips_real_parser():
+    """Every grammar production parses through rules_from_json and
+    sanitizes; the forced coverage classes all appear (CIDR rules
+    include non-/32 prefix classes)."""
+    from cilium_tpu.fuzz import grammar as G
+    from cilium_tpu.policy.api.parse import rules_from_json
+
+    rng = np.random.default_rng(3)
+    g = G.PolicyGrammar(rng, n_endpoints=3)
+    kinds = (
+        "l3only", "l4", "l7", "cidr", "wildcard", "requires",
+        "egress",
+    )
+    non_slash32 = 0
+    for i in range(40):
+        kind = kinds[i % len(kinds)]
+        spec = g.gen_rule(kind)
+        (rule,) = rules_from_json(json.dumps(spec))
+        rule.sanitize()  # idempotent: already sanitized inside
+        if kind == "cidr":
+            blocks = spec.get("ingress", []) + spec.get("egress", [])
+            for b in blocks:
+                for c in b.get("fromCIDRSet", []) + b.get(
+                    "toCIDRSet", []
+                ):
+                    if not c["cidr"].endswith("/32"):
+                        non_slash32 += 1
+    assert non_slash32 > 0, "grammar never produced a non-/32 CIDR"
+    # labels are unique delete handles
+    labels = [
+        g.gen_rule()["labels"][0] for _ in range(5)
+    ]
+    assert len(set(labels)) == 5
+
+
+def test_no_unseeded_rng_on_the_fuzz_chain():
+    """The grep-able seed-determinism lint: the fuzzer package and
+    the seeded tools (policyfuzz, chaos_storm, bench) contain no
+    unseeded RNG construction or legacy global-state random call."""
+    from cilium_tpu.fuzz.lint import fuzz_lint_paths, unseeded_rng_calls
+
+    hits = unseeded_rng_calls(fuzz_lint_paths())
+    assert not hits, "unseeded RNG calls found:\n" + "\n".join(
+        f"{p}:{ln}: {src}" for p, ln, src in hits
+    )
+
+
+def test_program_replay_determinism():
+    """A recorded program replays to the same summary counters —
+    the byte-for-byte replay contract repro files rest on."""
+    program, summary = run_fuzz(
+        13, steps=6, executors=("daemon",), flows_per_step=32,
+        n_rules=5,
+    )
+    summary2 = run_program(program)
+    for key in ("steps", "flows_checked", "flow_record_checks"):
+        assert summary2[key] == summary[key], key
+
+
+@pytest.mark.slow
+def test_policyfuzz_full_matrix_soak():
+    """The open-ended form: the FULL executor matrix (adds routed
+    tp1, the serving plane, and the fused subword/persistent-pair
+    trio) over a longer randomized schedule."""
+    program, summary = run_fuzz(
+        29,
+        steps=30,
+        executors=(
+            "daemon", "tp1", "tp2", "memo", "serve", "fusedtrio",
+        ),
+        flows_per_step=96,
+    )
+    assert summary["steps"] == 30
+    assert summary["publish_fallbacks"] >= 1
+    assert summary["chip_readmissions"] >= 1

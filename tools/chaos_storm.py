@@ -1218,9 +1218,24 @@ def run_tenant_storm(
     return result
 
 
+def _arg_seed(default: int = 7) -> int:
+    """--seed N (or --seed=N): ONE seed drives every storm's rng so
+    a failing run reproduces from the logged seed alone (derived
+    cycles offset deterministically; the defaults reproduce the
+    historical 7/11/13 streams)."""
+    for i, a in enumerate(sys.argv):
+        if a == "--seed" and i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+        if a.startswith("--seed="):
+            return int(a.split("=", 1)[1])
+    return default
+
+
 def main() -> int:
+    seed = _arg_seed()
+    print(f"chaos storm seed={seed}")
     if "--tenants" in sys.argv:
-        run_tenant_storm()
+        run_tenant_storm(seed=seed)
         print("OK")
         return 0
     if "--mesh" in sys.argv:
@@ -1228,19 +1243,21 @@ def main() -> int:
         # sizes; one chip dies mid-stream, survivors + replicas keep
         # the stream bit-identical, re-admission rebalances
         for tp in (2, 4):
-            run_mesh_storm(tp=tp)
+            run_mesh_storm(tp=tp, seed=seed)
         # ISSUE 11: the FULL fused datapath over the partitioned N+1
         # tables at every acceptance table-axis size, plus the
         # 60-step churn gate on the row-diff delta path
         for tp in (1, 2, 4):
-            run_mesh_fused_storm(tp=tp)
-        run_fused_churn(tp=2, steps=60)
+            run_mesh_fused_storm(tp=tp, seed=seed)
+        run_fused_churn(tp=2, steps=60, seed=seed + 6)
         print("OK")
         return 0
-    run_storm()
+    run_storm(seed=seed)
     # a second, harsher cycle: schedule longer than the stream's
     # batch count — the whole tail serves from the host path
-    run_storm(n_flows=2048, batch_size=256, fail_next=64, seed=11)
+    run_storm(
+        n_flows=2048, batch_size=256, fail_next=64, seed=seed + 4
+    )
     print("OK")
     return 0
 

@@ -1,0 +1,229 @@
+"""Differential policy fuzzer: whole-system bit-identity under
+randomized policy/churn/fault schedules, with automatic repro
+shrinking.
+
+Every seeded run builds a REAL daemon world from grammar-generated
+CiliumNetworkPolicy JSON (round-tripped through the actual parser),
+drives a randomized event schedule — rule add/delete, identity
+churn, delta/full publishes, verdict-cache toggles, chip
+kills/readmissions via the chip-scoped fault sites, publish.scatter
+/ memo.insert fault arming, serving-plane streamed submissions —
+and asserts, after EVERY event, that the whole observable surface
+(verdict columns, l4/l3 counters, telemetry totals, flow-record
+drop multisets, exactly-once accounting) is bit-identical to the
+host lattice oracle across the executor matrix.
+
+On a mismatch the built-in shrinker delta-debugs the (policy set,
+flow batch, event schedule) triple to a minimal deterministic repro
+and writes a re-runnable ``repro_*.json``.
+
+Usage:
+  python tools/policyfuzz.py --smoke             # tier-1 gate:
+        fixed seed, trimmed matrix {single-chip, tp2-failover,
+        memo-on}, >= 25 schedule steps, ~30 s
+  python tools/policyfuzz.py --seed 42 --steps 40
+        one full-matrix run (adds tp1, serve, fusedtrio)
+  python tools/policyfuzz.py --soak --seconds 600 --seed 7
+        open-ended soak: fresh derived seed per iteration, a
+        per-iteration wall-clock guard keeps any wrapper timeout
+        (the 870 s driver convention) respected
+  python tools/policyfuzz.py --replay repro_seed7_allowed.json
+        re-run a shrunk repro byte-for-byte
+
+Exit status 0 = every schedule held bit-identity; 1 = a mismatch
+(the repro path and its seed are printed — the run is reproducible
+from the seed alone).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# virtual mesh before jax initializes (the routed executors need
+# devices); a real accelerator run is untouched
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def _print_summary(tag: str, summary: dict) -> None:
+    print(f"{tag}: all invariants held")
+    for k in (
+        "steps", "flows_checked", "publishes", "publish_fallbacks",
+        "memo_insert_faults", "chip_kills", "chip_readmissions",
+        "rebalances", "flow_record_checks", "zipf_steps",
+    ):
+        print(f"  {k}: {summary.get(k)}")
+    print(f"  events: {summary.get('events')}")
+
+
+def _fail(program, failure, out_dir: str, no_shrink: bool) -> int:
+    from cilium_tpu.fuzz import shrink_program, write_repro
+
+    print(f"MISMATCH: {failure}", file=sys.stderr)
+    print(
+        f"  seed {program.get('seed')} — reproducible from the "
+        f"seed alone",
+        file=sys.stderr,
+    )
+    stats = None
+    if not no_shrink:
+        print("shrinking ...", file=sys.stderr)
+        program, failure, stats = shrink_program(
+            program, failure, verbose=True
+        )
+        print(
+            f"  minimal: {stats['events']} events, "
+            f"{stats['policies']} rules, {stats['flows']} flows "
+            f"({stats['replays']} replays)",
+            file=sys.stderr,
+        )
+    path = write_repro(program, failure, out_dir, stats=stats)
+    print(f"repro written: {path}", file=sys.stderr)
+    print(
+        f"  replay: python tools/policyfuzz.py --replay {path}",
+        file=sys.stderr,
+    )
+    return 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--soak", action="store_true")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument(
+        "--executors",
+        type=str,
+        default=None,
+        help="comma list: daemon,tp1,tp2,memo,serve,fusedtrio",
+    )
+    ap.add_argument("--flows-per-step", type=int, default=96)
+    ap.add_argument(
+        "--seconds", type=float, default=600.0,
+        help="soak time budget",
+    )
+    ap.add_argument(
+        "--iter-guard-s", type=float, default=240.0,
+        help="soak per-iteration wall guard: no new iteration "
+        "starts unless this much budget remains",
+    )
+    ap.add_argument("--replay", type=str, default=None)
+    ap.add_argument("--no-shrink", action="store_true")
+    ap.add_argument("--out", type=str, default=".")
+    args = ap.parse_args()
+
+    from cilium_tpu.fuzz import (
+        DEFAULT_EXECUTORS,
+        SMOKE_EXECUTORS,
+        FuzzFailure,
+        run_program,
+    )
+    from cilium_tpu.fuzz.harness import run_fuzz
+
+    if args.replay:
+        with open(args.replay, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+        program = {
+            k: payload[k]
+            for k in (
+                "version", "seed", "executors", "spec", "events",
+            )
+        }
+        try:
+            summary = run_program(program)
+        except FuzzFailure as failure:
+            print(f"repro reproduced: {failure}")
+            want = payload.get("failure")
+            if want and (
+                sorted(want["executors"])
+                != list(failure.executors)
+                or want["field"] != failure.field
+            ):
+                print(
+                    f"  (signature drifted: recorded {want})",
+                    file=sys.stderr,
+                )
+            return 1
+        _print_summary("replay", summary)
+        print("repro no longer reproduces (bug fixed?)")
+        return 0
+
+    if args.smoke:
+        executors = SMOKE_EXECUTORS
+        steps = args.steps or 28
+    else:
+        executors = DEFAULT_EXECUTORS
+        steps = args.steps or 40
+    if args.executors:
+        executors = tuple(
+            s.strip() for s in args.executors.split(",") if s.strip()
+        )
+
+    if args.soak:
+        deadline = time.monotonic() + args.seconds
+        i = 0
+        iter_wall = 0.0
+        while True:
+            remaining = deadline - time.monotonic()
+            # per-iteration guard: respect the wrapper timeout —
+            # never start an iteration the budget can't fit (use
+            # the last iteration's wall as the estimate, floored
+            # by --iter-guard-s on the first)
+            if remaining < max(iter_wall * 1.25, args.iter_guard_s):
+                break
+            seed = args.seed + i
+            t0 = time.monotonic()
+            print(f"soak iteration {i} (seed {seed}) ...")
+            try:
+                program, summary = run_fuzz(
+                    seed, steps=steps, executors=executors,
+                    flows_per_step=args.flows_per_step,
+                )
+            except FuzzFailure as failure:
+                return _fail(
+                    failure.program, failure, args.out,
+                    args.no_shrink,
+                )
+            iter_wall = time.monotonic() - t0
+            print(
+                f"  ok: {summary['steps']} steps, "
+                f"{summary['flows_checked']} flows, "
+                f"{iter_wall:.1f} s"
+            )
+            i += 1
+        print(f"soak: {i} iterations, zero mismatches")
+        return 0
+
+    t0 = time.monotonic()
+    try:
+        program, summary = run_fuzz(
+            args.seed, steps=steps, executors=executors,
+            flows_per_step=args.flows_per_step, verbose=True,
+        )
+    except FuzzFailure as failure:
+        return _fail(
+            failure.program, failure, args.out, args.no_shrink
+        )
+    _print_summary(
+        f"policyfuzz seed={args.seed} "
+        f"({time.monotonic() - t0:.1f} s)",
+        summary,
+    )
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
