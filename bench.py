@@ -2197,6 +2197,63 @@ def run_serving_bench(args) -> None:
         note="the serving plane's same-tables comparator",
     )
 
+    # ---- shadow-eval overhead (dual-epoch verdict-diff canarying) ---
+    # arm a restricting candidate at sample rate 0.1 and re-measure
+    # the SAME one-shot loop: the marginal cost is the sampled
+    # batches' second lattice gather (the staged batch, H2D and all
+    # folds are shared).  The < 5% gate is judged on real hardware
+    # (this container's 2-CPU noise swamps a 10%-of-batches second
+    # gather); the DETERMINISTIC byte-model gate lives in
+    # tools/gatherprof.py (shadow second-gather priced against the
+    # hot total).
+    import json as _json
+
+    shadow_candidate = [{
+        "endpointSelector": {"matchLabels": {"app": "server"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"app": "client"}}],
+            "toPorts": [{
+                "ports": [{"port": "443", "protocol": "TCP"}]
+            }],
+        }],
+        "labels": ["serve-bench-rule"],
+    }]
+
+    def _oneshot_wall():
+        s = d.process_flows(buf, batch_size=batch, async_depth=2)
+        return s.seconds
+
+    base_wall = min(_oneshot_wall() for _ in range(3))
+    bench_seed = getattr(args, "seed", None)
+    d.shadow.arm(
+        rules_json=_json.dumps(shadow_candidate),
+        sample_rate=0.1,
+        seed=11 if bench_seed is None else int(bench_seed),
+    )
+    _oneshot_wall()  # compile the shadow program outside the timing
+    shadow_wall = min(_oneshot_wall() for _ in range(3))
+    sw = d.shadow.diff(last=0)["window"]
+    d.shadow.disarm()
+    shadow_overhead_pct = (
+        100.0 * (shadow_wall - base_wall) / max(base_wall, 1e-9)
+    )
+    emit(
+        "shadow_eval_overhead_pct",
+        round(shadow_overhead_pct, 2),
+        "%",
+        sample_rate=0.1,
+        sampled_flows=sw["sampled"],
+        sampled_batches=sw["sampled_batches"],
+        changed=sw["changed"],
+        allow_to_deny=sw["allow_to_deny"],
+        deny_to_allow=sw["deny_to_allow"],
+        gate=(
+            "< 5% at sample rate 0.1, judged on real hardware; "
+            "the deterministic second-gather byte model is "
+            "hard-gated in tools/gatherprof.py"
+        ),
+    )
+
     # ---- bit-identity gate: streamed == one-shot --------------------
     gate_rec = make(np.random.default_rng(12), batch * 2)
     gate_buf = encode_flow_records(**gate_rec)

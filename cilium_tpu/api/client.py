@@ -99,6 +99,20 @@ class APIClient:
     def trace_tuple(self, body: dict):
         return self._request("POST", "/policy/trace-tuple", body=body)
 
+    def policy_shadow(self, body: dict):
+        """POST /policy/shadow: {"action": "arm"|"disarm"|"promote",
+        "rules": [...]?, "sample_rate": f?, "seed": n?}."""
+        return self._request("POST", "/policy/shadow", body=body)
+
+    def policy_diff(self, params: dict = None):
+        """GET /policy/diff (?last=N&since-seq=C): the armed shadow
+        window's verdict-diff status, summary, and records."""
+        from urllib.parse import urlencode
+
+        qs = urlencode(dict(params or {}))
+        path = f"/policy/diff?{qs}" if qs else "/policy/diff"
+        return self._request("GET", path)
+
     def endpoint_list(self):
         return self._request("GET", "/endpoint")
 

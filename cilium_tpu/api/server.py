@@ -19,6 +19,10 @@ Routes (the api/v1 subset this framework's daemon implements):
   POST   /policy             add rules (JSON list; ?replace=1)
   DELETE /policy             delete by labels (JSON list of labels)
   POST   /policy/resolve     policy trace (the explain mode)
+  POST   /policy/shadow      shadow window lifecycle (arm candidate
+                             rules / arm standby / disarm / promote)
+  GET    /policy/diff        live verdict-diff of the armed shadow
+                             window (?last=N&since-seq=C)
   GET    /endpoint           endpoint list
   GET    /endpoint/{id}      one endpoint
   PUT    /endpoint/{id}      create endpoint (labels[, ipv4, name]; CNI ADD)
@@ -291,6 +295,58 @@ class DaemonAPI:
             direction=direction,
             sport=int(body.get("sport", 0)),
             is_fragment=bool(body.get("is_fragment", False)),
+        )
+
+    # -- shadow policy rollout (cilium_tpu.shadow) ----------------------------
+
+    def policy_shadow(self, body: dict) -> dict:
+        """POST /policy/shadow: the shadow window lifecycle.
+
+        {"action": "arm", "rules": [...]} compiles the candidate
+        rules into a shadow world (omit rules for standby mode — the
+        previous publish); optional "sample_rate" (default 1.0) and
+        "seed" drive the batch sampler.  {"action": "disarm"} closes
+        the window; {"action": "promote"} installs a candidate
+        through the normal policy path and zeroes the window
+        counters."""
+        action = body.get("action")
+        shadow = self.daemon.shadow
+        if action == "arm":
+            rules = body.get("rules")
+            rules_json = (
+                json.dumps(rules) if rules is not None else None
+            )
+            return shadow.arm(
+                rules_json=rules_json,
+                sample_rate=float(body.get("sample_rate", 1.0)),
+                seed=int(body.get("seed", 0)),
+            )
+        if action == "disarm":
+            return shadow.disarm()
+        if action == "promote":
+            return shadow.promote()
+        raise ValueError(
+            f"action must be arm, disarm or promote, got {action!r}"
+        )
+
+    def policy_diff(self, params: dict) -> dict:
+        """GET /policy/diff: the armed window's verdict-diff surface
+        — status + summary (per-column/per-direction change counts,
+        allow→deny vs deny→allow split, top re-verdicted identity
+        pairs) + the newest diff records.  Params: last=N (default
+        256), since-seq=<cursor> (follow-style reader)."""
+        params = dict(params)
+        last_raw = params.pop("last", None)
+        since_raw = params.pop("since-seq", None)
+        if params:
+            raise ValueError(
+                f"unknown diff param {sorted(params)[0]!r}"
+            )
+        return self.daemon.shadow.diff(
+            last=int(last_raw) if last_raw is not None else 256,
+            since_seq=(
+                int(since_raw) if since_raw is not None else None
+            ),
         )
 
     def policy_resolve(self, body: dict) -> dict:
@@ -941,6 +997,17 @@ class _Handler(BaseHTTPRequestHandler):
                         "text/plain; version=0.0.4; charset=utf-8"
                     ),
                 )
+            if path == "/policy/diff":
+                from urllib.parse import parse_qs
+
+                qs = parse_qs(self.path.partition("?")[2])
+                params = {k: v[0] for k, v in qs.items()}
+                try:
+                    return self._reply(200, api.policy_diff(params))
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
             if path == "/flows":
                 from urllib.parse import parse_qs
 
@@ -1047,6 +1114,28 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 except KeyError as exc:
                     return self._reply(404, {"error": str(exc)})
+            if path == "/policy/shadow":
+                try:
+                    body = json.loads(self._body() or "{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be an object")
+                except (json.JSONDecodeError, ValueError) as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                try:
+                    return self._reply(
+                        200, api.policy_shadow(body)
+                    )
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
+                except RuntimeError as exc:
+                    # lifecycle conflicts (no published tables, no
+                    # previous publish, nothing to promote) are the
+                    # caller racing the world, not a server fault
+                    return self._reply(409, {"error": str(exc)})
             if path == "/monitor":
                 return self._reply(201, api.monitor_open())
             if path == "/debug/faults":

@@ -98,6 +98,113 @@ def cmd_policy_trace_tuple(api, args) -> int:
     return 0 if got["verdict"] == "allowed" else 1
 
 
+def cmd_policy_shadow(api, args) -> int:
+    """`cilium-tpu policy shadow arm|disarm|promote` — the shadow
+    rollout lifecycle: arm a candidate rule file (or --standby for
+    the previous publish), watch `policy diff`, then promote or
+    disarm."""
+    body = {"action": args.shadow_action}
+    if args.shadow_action == "arm":
+        if args.file:
+            with open(args.file) as f:
+                body["rules"] = json.loads(f.read())
+        elif not args.standby:
+            print(
+                "error: give a candidate rule file, or --standby "
+                "to diff against the previous publish",
+                file=sys.stderr,
+            )
+            return 2
+        body["sample_rate"] = args.sample_rate
+        body["seed"] = args.seed
+    got = api.policy_shadow(body)
+    print(json.dumps(got, indent=2))
+    return 0
+
+
+def _format_diff_compact(flow: dict) -> str:
+    """One compact line per diff record: the tuple, both worlds'
+    verdicts, and the transition."""
+    from cilium_tpu.monitor.dissect import proto_name
+
+    def verdict(allowed, reason):
+        return "ALLOW" if allowed else f"DENY({reason})"
+
+    return (
+        f"identity {flow['src_identity']} -> "
+        f"{flow['dst_identity']} ep={flow['ep_id']} "
+        f":{flow['dport']}/{proto_name(flow['proto'])} "
+        f"{flow['direction']} "
+        f"{verdict(flow['live_allowed'], flow['live_reason'])} => "
+        f"{verdict(flow['shadow_allowed'], flow['shadow_reason'])} "
+        f"[{flow['transition']}]"
+    )
+
+
+def cmd_policy_diff(api, args) -> int:
+    """`cilium-tpu policy diff` — the verdict-diff canary surface:
+    summary of the armed shadow window; --live adds the captured
+    diff records; --follow tails new records (seq-cursor polls)."""
+    got = api.policy_diff({"last": args.last})
+    if args.json and not args.follow:
+        print(json.dumps(got, indent=2))
+        return 0 if got.get("state") == "armed" else 1
+    state = got.get("state")
+    w = got.get("window") or got.get("last_window") or {}
+    print(f"state: {state}")
+    if w:
+        print(
+            f"mode={w.get('mode')} live_gen={w.get('live_gen')} "
+            f"shadow_gen={w.get('shadow_gen')} "
+            f"sample_rate={w.get('sample_rate')}"
+        )
+        print(
+            f"sampled={w.get('sampled')} "
+            f"changed={w.get('changed')} "
+            f"allow->deny={w.get('allow_to_deny')} "
+            f"deny->allow={w.get('deny_to_allow')} "
+            f"refused={w.get('refused')}"
+        )
+        for row in w.get("top_reverdicted_pairs", []):
+            print(
+                f"  pair {row['src_identity']} -> "
+                f"{row['dst_identity']}: {row['count']} re-verdicts"
+            )
+    if args.live or args.follow:
+        for flow in got.get("flows", []):
+            print(
+                json.dumps(flow)
+                if args.json
+                else _format_diff_compact(flow)
+            )
+    if not args.follow:
+        return 0 if state == "armed" else 1
+    import time as _time
+
+    cursor = got.get("last_seq", 0)
+    try:
+        while True:
+            _time.sleep(args.interval)
+            got = api.policy_diff(
+                {"last": 0, "since-seq": cursor}
+            )
+            for flow in got.get("flows", []):
+                print(
+                    json.dumps(flow)
+                    if args.json
+                    else _format_diff_compact(flow)
+                )
+            cursor = max(cursor, got.get("last_seq", cursor))
+            if got.get("state") != "armed":
+                print(
+                    f"# window closed: {got.get('state')}",
+                    file=sys.stderr,
+                )
+                return 1
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_policy_trace(api, args) -> int:
     got = api.policy_resolve(
         {
@@ -255,6 +362,8 @@ def _format_flow_compact(flow: dict) -> str:
         line += f" -> proxy {flow['proxy_port']}"
     if flow.get("cache_hit"):
         line += " [cached]"
+    if flow.get("diff_status"):
+        line += f" [shadow:{flow['diff_status']}]"
     return line
 
 
@@ -275,6 +384,7 @@ def cmd_observe(api, args) -> int:
         ("chip", args.chip),
         ("trace-id", args.trace_id),
         ("tenant", args.tenant),
+        ("diff-status", args.diff_status),
     ):
         if val is not None:
             params[key] = val
@@ -491,6 +601,46 @@ def make_parser() -> argparse.ArgumentParser:
     ttuple.add_argument("--json", action="store_true",
                         help="machine-readable stage dump")
     ttuple.set_defaults(func=cmd_policy_trace_tuple)
+    pshadow = psub.add_parser(
+        "shadow",
+        help="shadow rollout lifecycle: arm a candidate policy (or "
+        "--standby), disarm, or promote the armed candidate",
+    )
+    pshadow.add_argument(
+        "shadow_action", choices=["arm", "disarm", "promote"]
+    )
+    pshadow.add_argument(
+        "file", nargs="?", default=None,
+        help="candidate rule JSON file (arm)",
+    )
+    pshadow.add_argument(
+        "--standby", action="store_true",
+        help="arm against the PREVIOUS publish instead of a "
+        "candidate file (what did my last change re-verdict)",
+    )
+    pshadow.add_argument("--sample-rate", type=float, default=1.0,
+                         help="fraction of live batches dual-"
+                         "dispatched (0 < r <= 1)")
+    pshadow.add_argument("--seed", type=int, default=0,
+                         help="batch-sampler seed")
+    pshadow.set_defaults(func=cmd_policy_shadow)
+    pdiff = psub.add_parser(
+        "diff",
+        help="live verdict-diff of the armed shadow window "
+        "(GET /policy/diff)",
+    )
+    pdiff.add_argument("--live", action="store_true",
+                       help="print the captured diff records, not "
+                       "just the summary")
+    pdiff.add_argument("--follow", action="store_true",
+                       help="tail new diff records (seq-cursor "
+                       "polls) until the window closes")
+    pdiff.add_argument("--last", type=int, default=256,
+                       help="newest N records")
+    pdiff.add_argument("--interval", type=float, default=1.0,
+                       help="follow-mode poll interval seconds")
+    pdiff.add_argument("--json", action="store_true")
+    pdiff.set_defaults(func=cmd_policy_diff)
 
     endpoint = sub.add_parser("endpoint")
     esub = endpoint.add_subparsers(dest="subcmd", required=True)
@@ -552,6 +702,10 @@ def make_parser() -> argparse.ArgumentParser:
                      help="only flows submitted by this tenant/"
                      "namespace (the serving plane's fairness unit; "
                      "shed flows carry it on their Overload record)")
+    obs.add_argument("--diff-status", default=None,
+                     help="only flows the armed shadow window "
+                     "re-verdicted: any, allow-to-deny, "
+                     "deny-to-allow, changed")
     obs.add_argument("--timeout", type=float, default=5.0,
                      help="follow-mode poll timeout")
     obs.add_argument("--summary", action="store_true",

@@ -280,6 +280,19 @@ class Daemon:
         self.verdict_cache_streak_limit = 8
         self.verdict_cache_retry_period = 64
         self._memo_batch_seq = 0
+        # shadow policy rollout (cilium_tpu.shadow): dual-epoch
+        # sampled evaluation + live verdict-diff canarying.  Armed
+        # via POST /policy/shadow; disarmed windows cost one
+        # attribute read per batch.
+        from cilium_tpu.shadow import ShadowPlane
+
+        self.shadow = ShadowPlane(self)
+        # per-tenant named SLO classes (serving tier 2): name ->
+        # {"deadline_ms", "shed_priority", "weight"} bundles and the
+        # tenant -> class assignment, both live via PATCH /config
+        # {"slo_classes": ..., "tenant_slo": ...}
+        self.slo_classes: Dict[str, Dict] = {}
+        self.tenant_slo: Dict[str, str] = {}
         # bounded admission: flows in flight across concurrent
         # process_flows calls; excess batches shed under the
         # canonical Overload drop reason (None = unbounded)
@@ -540,6 +553,13 @@ class Daemon:
             for name in spans:
                 metrics.spanstat_seconds.set(scope, name, value=0.0)
             spans.clear()
+        # the serving plane's rolling serving_p99_ms window resets
+        # with the same seam, so bench segments / before-after
+        # experiments don't bleed one load shape's tail into the
+        # next (the plane keeps its own window — see
+        # ServingPlane.reset_window)
+        if self.serving is not None:
+            self.serving.reset_window()
 
     def _regenerate_for_reasons(self, reasons: List[str]) -> None:
         self.regenerate_all(", ".join(reasons) or "trigger")
@@ -1253,7 +1273,10 @@ class Daemon:
         hit/miss accounting lands exactly once, corrected to the
         batch's valid prefix (padding rows all share one key and
         would drown the metrics in synthetic hits).  Returns
-        (v, extra_degraded)."""
+        (v, extra_degraded, overflowed) — a caller holding a shadow
+        sample REFUSES it when `overflowed` (the on-device diff was
+        computed against the refused kernel's unspecified columns,
+        so folding it would not be a two-pinned-worlds diff)."""
         from types import SimpleNamespace
 
         import numpy as np
@@ -1262,7 +1285,8 @@ class Daemon:
 
         s = np.asarray(cache_stats).astype(np.int64)
         deg = False
-        if int(s[vm.STAT_OVERFLOW]):
+        overflowed = bool(int(s[vm.STAT_OVERFLOW]))
+        if overflowed:
             self.verdict_cache_overflow_streak += 1
             out2, deg = redispatch()
             v = SimpleNamespace(
@@ -1279,11 +1303,111 @@ class Daemon:
                 s[vm.STAT_TUPLES] = int(valid)
         if self.verdict_cache is not None:
             self.verdict_cache.account(s)
-        return v, deg
+        return v, deg, overflowed
+
+    # -- shadow policy rollout (cilium_tpu.shadow) ----------------------------
+
+    @staticmethod
+    def _attach_shadow(out, ticket, scols):
+        """Wrap a single-chip dispatch result with its shadow sample
+        (lazy shadow columns + on-device diff codes): the drain folds
+        or refuses the ticket exactly once."""
+        from types import SimpleNamespace
+
+        return SimpleNamespace(
+            allowed=out.allowed,
+            proxy_port=out.proxy_port,
+            match_kind=out.match_kind,
+            cache_hit=getattr(out, "cache_hit", None),
+            cache_stats=getattr(out, "cache_stats", None),
+            shadow_ticket=ticket,
+            shadow_cols=scols,
+        )
+
+    def _attach_shadow_routed(self, out, res, ticket):
+        """The mesh-path twin of _attach_shadow: the router already
+        synced both legs' columns, so the diff codes fold host-side
+        through the SAME diff_codes definition the device kernel
+        jits."""
+        from types import SimpleNamespace
+
+        import numpy as np
+
+        from cilium_tpu import shadow as shadow_mod
+
+        sv = res.shadow_verdicts
+        if sv is None:
+            self.shadow.refuse(ticket)
+            return out
+        ca, cp, ck, trans = shadow_mod.diff_codes(
+            np.asarray(out.allowed),
+            np.asarray(out.proxy_port),
+            np.asarray(out.match_kind),
+            np.asarray(sv.allowed),
+            np.asarray(sv.proxy_port),
+            np.asarray(sv.match_kind),
+            xp=np,
+        )
+        return SimpleNamespace(
+            allowed=out.allowed,
+            proxy_port=out.proxy_port,
+            match_kind=out.match_kind,
+            shadow_ticket=ticket,
+            shadow_cols={
+                "allowed": sv.allowed,
+                "proxy_port": sv.proxy_port,
+                "match_kind": sv.match_kind,
+                "ca": ca,
+                "cp": cp,
+                "ck": ck,
+                "trans": trans,
+            },
+        )
+
+    def _fold_shadow_drain(
+        self, out, v, valid, *, ep_ids, src_identities,
+        dst_identities, dports, protos, directions, tenant,
+        trace_id, refuse=False,
+    ):
+        """THE drain-time shadow fold, shared by the one-shot drain
+        and the serving plane's drain: folds (or refuses) a sampled
+        batch's ticket exactly once and returns the per-row
+        transition codes (np.uint8, 0 = unchanged) for the flow
+        plane's diff-status join — None when unsampled/refused.
+        ``refuse`` forces a clean refusal (drain-time failover or a
+        memo overflow re-dispatch invalidated the on-device diff)."""
+        ticket = getattr(out, "shadow_ticket", None)
+        if ticket is None:
+            return None
+        scols = getattr(out, "shadow_cols", None)
+        if refuse or scols is None:
+            self.shadow.refuse(ticket)
+            return None
+        try:
+            return self.shadow.fold(
+                ticket, v, scols, valid,
+                ep_ids=ep_ids,
+                src_identities=src_identities,
+                dst_identities=dst_identities,
+                dports=dports,
+                protos=protos,
+                directions=directions,
+                tenant=tenant,
+                trace_id=trace_id,
+            )
+        except Exception as exc:  # noqa: BLE001 — the shadow fold
+            # must never take the live drain down
+            log.warning(
+                "shadow diff fold failed; sample refused",
+                extra={"fields": {"error": str(exc)}},
+            )
+            self.shadow.refuse(ticket)
+            return None
 
     def _dispatch_or_degrade(
         self, tables, batch, host_args, pad_to: int,
         use_memo: bool = True, host_cols=None,
+        shadow_sample: bool = True,
     ):
         """One batch through the guarded device dispatch: the
         engine.dispatch fault seam fires first, the watchdog bounds
@@ -1322,12 +1446,59 @@ class Daemon:
             and host_cols is not None
             and self.mesh_router.store.current() is not None
         ):
+            # shadow sampling on the routed path: the pinned-stamp
+            # ticket is drawn against the manager's published epoch
+            # (the stamp family the arm pinned); the shadow gather
+            # rides the router's re-split batch through the routed
+            # evaluators (dispatch(shadow=...)).  Drain-time
+            # re-dispatches pass shadow_sample=False — their batch's
+            # ticket already exists and must resolve exactly once.
+            ticket = (
+                self.shadow.sample_ticket(tables)
+                if shadow_sample
+                else None
+            )
+            shadow_args = None
+            if ticket is not None:
+                # the router serves ITS store's current epoch, which
+                # the auto-publish hook advances independently of the
+                # `tables` snapshot the ticket was drawn against: the
+                # router stamp must match the pinned live stamp both
+                # BEFORE and AFTER the dispatch, else the live leg
+                # may have served a third world — refuse the sample
+                # (stamps only move forward, so an equal bracket
+                # pins the served epoch exactly)
+                rstamp = self.mesh_router.store.current_stamp()
+                if (
+                    rstamp is None
+                    or (int(rstamp) & 0xFFFFFFFF)
+                    != ticket["live_gen"]
+                ):
+                    self.shadow.refuse(ticket)
+                    ticket = None
+            if ticket is not None:
+                try:
+                    shadow_args = self.shadow.routed_args(
+                        self.mesh_router
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(
+                        "shadow routed epoch unavailable; sample "
+                        "refused",
+                        extra={"fields": {"error": str(exc)}},
+                    )
+                    self.shadow.refuse(ticket)
+                    ticket = None
             try:
-                res = self.mesh_router.dispatch(*host_cols())
+                res = self.mesh_router.dispatch(
+                    *host_cols(), shadow=shadow_args
+                )
             except Exception as exc:  # router unserviceable: fall
                 # back to the single-chip path under the
                 # process-wide breaker (the router's own terminal
                 # fold only fires when it CAN host-fold)
+                if ticket is not None:
+                    self.shadow.refuse(ticket)
                 log.warning(
                     "mesh router dispatch failed; serving batch "
                     "from the single-chip path",
@@ -1336,7 +1507,24 @@ class Daemon:
             else:
                 if res.degraded:
                     self.degraded_batches += 1
-                return res.verdicts, res.degraded
+                out = res.verdicts
+                if ticket is not None:
+                    # the AFTER half of the stamp bracket: a publish
+                    # that advanced the router mid-dispatch makes
+                    # which epoch served ambiguous — refuse
+                    rstamp = self.mesh_router.store.current_stamp()
+                    if (
+                        res.degraded
+                        or rstamp is None
+                        or (int(rstamp) & 0xFFFFFFFF)
+                        != ticket["live_gen"]
+                    ):
+                        self.shadow.refuse(ticket)
+                    else:
+                        out = self._attach_shadow_routed(
+                            out, res, ticket
+                        )
+                return out, res.degraded
         if self._traced_evaluate is None:
             # jit-cache hit/miss accounting on the serving entry
             # point (a fresh batch shape class = an XLA recompile the
@@ -1385,6 +1573,26 @@ class Daemon:
                     self.tracer, sp, 1,
                     int(batch.ep_index.shape[0]), "engine.dispatch",
                 )
+                # shadow sampling (single-chip path): the SECOND
+                # dispatch rides the already-staged TupleBatch
+                # against the shadow epoch, diffed on device
+                # (shadow.dispatch / shadow.diff spans nest under
+                # this batch's dispatch span); columns stay lazy —
+                # the drain folds them one batch behind.  Drain-time
+                # re-dispatches never draw a second ticket.
+                ticket = (
+                    self.shadow.sample_ticket(tables)
+                    if shadow_sample
+                    else None
+                )
+                if ticket is not None:
+                    scols = self.shadow.evaluate(
+                        ticket, batch, dispatched
+                    )
+                    if scols is not None:
+                        dispatched = self._attach_shadow(
+                            dispatched, ticket, scols
+                        )
                 return dispatched, False
         with self.tracer.span(
             "engine.hostpath", site="engine.hostpath",
@@ -1486,6 +1694,85 @@ class Daemon:
                             f"tenant weight {name!r} must be a "
                             f"positive number, got {w!r}"
                         )
+            # named SLO classes ({"slo_classes": {name: {deadline_ms,
+            # shed_priority, weight} | null}}) + tenant assignment
+            # ({"tenant_slo": {tenant: class | null}}): validated up
+            # front; null deletes
+            slo_classes = changes.get("slo_classes")
+            if slo_classes is not None:
+                if not isinstance(slo_classes, dict):
+                    raise ValueError(
+                        "slo_classes must be an object of name: "
+                        f"bundle, got {slo_classes!r}"
+                    )
+                for cname, bundle in slo_classes.items():
+                    if bundle is None:
+                        continue
+                    if not isinstance(bundle, dict):
+                        raise ValueError(
+                            f"slo class {cname!r} must be an "
+                            f"object, got {bundle!r}"
+                        )
+                    unknown = set(bundle) - {
+                        "deadline_ms", "shed_priority", "weight",
+                    }
+                    if unknown:
+                        raise ValueError(
+                            f"slo class {cname!r}: unknown keys "
+                            f"{sorted(unknown)}"
+                        )
+                    dl = bundle.get("deadline_ms")
+                    if dl is not None and (
+                        isinstance(dl, bool)
+                        or not isinstance(dl, (int, float))
+                        or dl <= 0
+                    ):
+                        raise ValueError(
+                            f"slo class {cname!r}: deadline_ms "
+                            f"must be a positive number, got {dl!r}"
+                        )
+                    pr = bundle.get("shed_priority")
+                    if pr is not None and (
+                        isinstance(pr, bool)
+                        or not isinstance(pr, int)
+                        or pr < 0
+                    ):
+                        raise ValueError(
+                            f"slo class {cname!r}: shed_priority "
+                            f"must be an int >= 0, got {pr!r}"
+                        )
+                    w = bundle.get("weight")
+                    if w is not None and (
+                        isinstance(w, bool)
+                        or not isinstance(w, (int, float))
+                        or w <= 0
+                    ):
+                        raise ValueError(
+                            f"slo class {cname!r}: weight must be "
+                            f"a positive number, got {w!r}"
+                        )
+            tenant_slo = changes.get("tenant_slo")
+            if tenant_slo is not None:
+                if not isinstance(tenant_slo, dict):
+                    raise ValueError(
+                        "tenant_slo must be an object of tenant: "
+                        f"class, got {tenant_slo!r}"
+                    )
+                future_classes = dict(self.slo_classes)
+                for cname, bundle in (slo_classes or {}).items():
+                    if bundle is None:
+                        future_classes.pop(cname, None)
+                    else:
+                        future_classes[cname] = bundle
+                for tname, cname in tenant_slo.items():
+                    if cname is not None and (
+                        not isinstance(cname, str)
+                        or cname not in future_classes
+                    ):
+                        raise ValueError(
+                            f"tenant {tname!r} references unknown "
+                            f"slo class {cname!r}"
+                        )
             if raw_opts:
                 ct_before = option.Config.opts.is_enabled(
                     option.CONNTRACK
@@ -1532,6 +1819,32 @@ class Daemon:
                     self.serving.set_tenant_weights(
                         self.tenant_weights
                     )
+            # SLO classes + tenant assignment: live-applied like the
+            # weights (verdict-neutral)
+            slo_applied = 0
+            if slo_classes is not None:
+                for cname, bundle in slo_classes.items():
+                    if bundle is None:
+                        if self.slo_classes.pop(cname, None):
+                            slo_applied += 1
+                    elif self.slo_classes.get(cname) != bundle:
+                        self.slo_classes[cname] = dict(bundle)
+                        slo_applied += 1
+            if tenant_slo is not None:
+                for tname, cname in tenant_slo.items():
+                    if cname is None:
+                        if self.tenant_slo.pop(tname, None):
+                            slo_applied += 1
+                    elif self.tenant_slo.get(tname) != cname:
+                        self.tenant_slo[tname] = cname
+                        slo_applied += 1
+            if (
+                (slo_classes is not None or tenant_slo is not None)
+                and self.serving is not None
+            ):
+                self.serving.set_slo_classes(
+                    self.slo_classes, self.tenant_slo
+                )
             # fault arming applies last and never triggers a regen
             # sweep (it changes no compiled state)
             fault_applied = 0
@@ -1549,6 +1862,7 @@ class Daemon:
                 "configuration changed", full=verdict_affecting
             )
         applied += fault_applied + vc_applied + tw_applied
+        applied += slo_applied
         return {
             "applied": applied,
             "policy_enforcement": option.Config.policy_enforcement,
@@ -1556,6 +1870,8 @@ class Daemon:
             "faults": faultinject.armed(),
             "verdict_cache": self.verdict_cache_enabled,
             "tenant_weights": dict(self.tenant_weights),
+            "slo_classes": dict(self.slo_classes),
+            "tenant_slo": dict(self.tenant_slo),
         }
 
     def _option_changed(self, name: str, value: int) -> None:
@@ -1843,6 +2159,8 @@ class Daemon:
                 self.serving = ServingPlane(
                     self,
                     tenant_weights=dict(self.tenant_weights),
+                    slo_classes=dict(self.slo_classes),
+                    tenant_slo=dict(self.tenant_slo),
                     **overrides,
                 )
                 self.serving.start()
@@ -2043,6 +2361,7 @@ class Daemon:
             out, degraded, start, end, valid, batch_t0, dev_batch = (
                 pending.popleft()
             )
+            shadow_refuse = False
             try:
                 drain_span = tracing.stat_span(
                     spans, "drain", site="daemon", trc=self.tracer,
@@ -2077,14 +2396,18 @@ class Daemon:
                             return self._dispatch_or_degrade(
                                 tables, dev_batch, _ha,
                                 batch_size, use_memo=False,
+                                shadow_sample=False,
                             )
 
-                        v, deg2 = self._fold_memo_drain(
+                        v, deg2, overflowed = self._fold_memo_drain(
                             cstats, v, valid,
                             int(out.allowed.shape[0]),
                             _redispatch,
                         )
                         degraded = degraded or deg2
+                        # an overflow re-dispatch replaced the live
+                        # columns the device diff compared against
+                        shadow_refuse = shadow_refuse or overflowed
                 except Exception as exc:
                     # the overlapped batch died ON DEVICE after a
                     # successful enqueue: the breaker learns the
@@ -2115,6 +2438,8 @@ class Daemon:
                             pad_to=batch_size,
                         )
                     degraded = True
+                    shadow_refuse = True  # the shadow columns came
+                    # from the dead device dispatch; refuse cleanly
                     self.degraded_batches += 1
                     metrics.degraded_batches_total.inc()
                     v = SimpleNamespace(
@@ -2172,11 +2497,29 @@ class Daemon:
                 dirs = rec["direction"][start:end]
                 peer = rec["identity"][start:end].astype(np.int64)
                 local = local_ident_lut[ep_idx]
+                src_ids = np.where(dirs == 0, peer, local)
+                dst_ids = np.where(dirs == 0, local, peer)
+                # shadow verdict-diff fold (one per sampled batch,
+                # exactly once): counters + diff records land in the
+                # armed window; the returned transition codes join
+                # the flow records (observe --diff-status)
+                diff_col = self._fold_shadow_drain(
+                    out, v, valid,
+                    ep_ids=rev_lut[ep_idx],
+                    src_identities=src_ids,
+                    dst_identities=dst_ids,
+                    dports=rec["dport"][start:end],
+                    protos=rec["proto"][start:end],
+                    directions=dirs,
+                    tenant=tenant,
+                    trace_id=trace_ctx,
+                    refuse=shadow_refuse,
+                )
                 capture_batch(
                     self.flow_store,
                     ep_ids=rev_lut[ep_idx],
-                    src_identities=np.where(dirs == 0, peer, local),
-                    dst_identities=np.where(dirs == 0, local, peer),
+                    src_identities=src_ids,
+                    dst_identities=dst_ids,
                     dports=rec["dport"][start:end],
                     protos=rec["proto"][start:end],
                     directions=dirs,
@@ -2184,6 +2527,7 @@ class Daemon:
                     match_kind=v.match_kind,
                     proxy_port=v.proxy_port,
                     cache_hit=getattr(v, "cache_hit", None),
+                    diff_status=diff_col,
                     allow_sample=flow_allow_sample,
                     metrics_registry=metrics,
                     trace_id=trace_ctx,
@@ -2273,9 +2617,14 @@ class Daemon:
             # fold, host-fold failure) must not leak the reserved
             # admission units of batches still in flight — the gate's
             # outstanding count would stay inflated forever and later
-            # calls would spuriously shed
+            # calls would spuriously shed.  In-flight shadow tickets
+            # refuse (exactly-once accounting) rather than dangle.
             while pending:
-                self.admission.release(pending.popleft()[4])
+                dropped = pending.popleft()
+                tk = getattr(dropped[0], "shadow_ticket", None)
+                if tk is not None:
+                    self.shadow.refuse(tk)
+                self.admission.release(dropped[4])
         stats.seconds = _time.perf_counter() - t0
         stats.spans = spans
         proc_span.attrs.update(

@@ -290,7 +290,22 @@ class EndpointManager:
         for ep_id, idx in index.items():
             states[idx] = states_by_id.get(ep_id)
         with self._lock:
-            version = self._published[0] + 1
+            # retain the outgoing publish (the world the standby
+            # epoch slot still holds after the flip): the shadow
+            # plane's standby-arm source.  Valid for exactly one
+            # further publish — the compiler's ping-pong reuses the
+            # buffers after that, which is why the shadow plane
+            # HOST-COPIES these arrays at arm time and closes the
+            # window stale the moment the live stamp moves again.
+            prev_version, prev_tables, prev_index = self._published
+            if prev_tables is not None:
+                self._previous_published = (
+                    prev_version,
+                    prev_tables,
+                    prev_index,
+                    getattr(self, "_published_states", []),
+                )
+            version = prev_version + 1
             self._published = (version, tables, index)
             self._published_states = states
             return version
@@ -309,6 +324,15 @@ class EndpointManager:
             return version, tables, index, getattr(
                 self, "_published_states", []
             )
+
+    def published_previous(self):
+        """The PREVIOUS publish — (version, tables, index, states) of
+        the world the standby epoch slot held before the last flip,
+        or None.  One-publish-deep by construction (the compiler's
+        ping-pong buffer pair): the shadow plane copies what it needs
+        at arm time and stamp-guards everything after."""
+        with self._lock:
+            return getattr(self, "_previous_published", None)
 
     # -- device-resident epochs (engine/publish.py) ---------------------------
 

@@ -79,6 +79,10 @@ class FailoverResult:
     # routed memo plane (attach_memo): per-tuple cache-hit flags in
     # stream order, None when the batch was served uncached
     cache_hit: Optional[np.ndarray] = None
+    # shadow policy rollout (cilium_tpu.shadow): the SHADOW world's
+    # verdict columns for the same batch, stream order — None when
+    # the batch was not sampled or the shadow leg refused
+    shadow_verdicts: Optional[object] = None
 
 
 @dataclass
@@ -911,12 +915,22 @@ class ChipFailoverRouter:
         proto,
         direction,
         is_fragment=None,
+        shadow=None,
     ) -> FailoverResult:
         """One batch through the per-chip failure domain.  Returns a
         FailoverResult with the verdict columns in STREAM ORDER —
         bit-identical to the healthy mesh whatever the survivor set,
         as long as at least one owner of every slice survives; the
-        host fold serves the batch beyond that."""
+        host fold serves the batch beyond that.
+
+        ``shadow`` is an optional (evaluator, device tables) pair
+        (cilium_tpu.shadow.ShadowPlane.routed_args): the SAME
+        re-split, alive-masked, valid-padded batch additionally
+        gathers through the shadow epoch — the second gather rides
+        the staged batch through the routed evaluators — and the
+        shadow verdict columns come back on
+        ``FailoverResult.shadow_verdicts``.  A shadow-leg failure
+        never degrades the live batch (shadow_verdicts stays None)."""
         cols = {
             "ep_index": np.asarray(ep_index, np.int32),
             "identity": np.asarray(identity, np.uint32),
@@ -989,6 +1003,35 @@ class ChipFailoverRouter:
                         plan["reb_bytes"], plan["reb_ms"],
                         reason=str(exc),
                     )
+            shadow_v = None
+            if shadow is not None:
+                # the shadow leg: the same staged/padded batch, the
+                # same alive mask, the shadow epoch's tables — its
+                # gathers route through replicas exactly like the
+                # live ones.  Replica/telemetry accounting is NOT
+                # repeated (the live leg owns the observables); a
+                # shadow failure refuses the sample, never the batch.
+                shadow_ev, shadow_dev = shadow
+                with tracing.tracer.span(
+                    "shadow.dispatch", site="shadow.dispatch",
+                    attrs={
+                        "rows": len(cols["ep_index"]),
+                        "routed": True,
+                        "chips": int(alive.sum()),
+                    },
+                ) as ssp:
+                    try:
+                        sout = shadow_ev(
+                            shadow_dev, batch, alive, plan["valid"]
+                        )
+                        import jax
+
+                        jax.block_until_ready(sout)
+                        shadow_v = sout[0]
+                    except Exception as exc:  # noqa: BLE001
+                        ssp.status = "error"
+                        ssp.attrs["error"] = str(exc)
+                        shadow_v = None
         self._credit_alive(alive)
         if self.collect_telemetry:
             v, l4c, l3c, replica_hits, trow = out
@@ -1017,8 +1060,21 @@ class ChipFailoverRouter:
                 None if hit_padded is None
                 else hit_padded[positions]
             )
+        shadow_verdicts = None
+        if shadow_v is not None:
+            take = (
+                (lambda a: np.asarray(a))
+                if positions is None
+                else (lambda a: np.asarray(a)[positions])
+            )
+            shadow_verdicts = Verdicts(
+                allowed=take(shadow_v.allowed),
+                proxy_port=take(shadow_v.proxy_port),
+                match_kind=take(shadow_v.match_kind),
+            )
         return FailoverResult(
             verdicts=verdicts,
+            shadow_verdicts=shadow_verdicts,
             l4_counts=np.asarray(l4c),
             l3_counts=np.asarray(l3c),
             telemetry=telemetry,

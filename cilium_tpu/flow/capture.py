@@ -104,6 +104,7 @@ def capture_batch(
     trace_id: str = "",
     cache_hit=None,
     tenant="",
+    diff_status=None,
 ) -> int:
     """Fold one batch's per-tuple columns into the store.  All
     columns are host arrays of one length (the batch's VALID prefix —
@@ -121,7 +122,11 @@ def capture_batch(
     the submitting tenant/namespace — a scalar string (the one-shot
     REST path) or a per-tuple object array (the serving plane's
     coalesced multi-tenant batches); `observe --tenant` filters on
-    it.  Returns the number of records captured."""
+    it.  ``diff_status`` is the per-tuple shadow verdict-diff
+    transition code column (cilium_tpu.shadow TRANS_* u8; None =
+    unsampled batch, records carry "") — `observe --diff-status`
+    joins flow records to the armed diff window.  Returns the
+    number of records captured."""
     allowed = np.asarray(allowed).astype(bool)
     kind = np.asarray(match_kind)
     b = len(allowed)
@@ -180,6 +185,16 @@ def capture_batch(
         if not isinstance(tenant, str)
         else np.full(b, tenant, dtype=object)
     )
+    if diff_status is None:
+        diff_names = None
+    else:
+        from cilium_tpu.shadow import TRANS_NAMES
+
+        codes = np.asarray(diff_status)
+        diff_names = np.full(b, "", dtype=object)
+        for code, name in TRANS_NAMES.items():
+            if name:
+                diff_names[codes == code] = name
     ts = time.time() if now is None else now
     records = [
         FlowRecord(
@@ -201,6 +216,9 @@ def capture_batch(
             trace_id=trace_id,
             cache_hit=bool(hits[i]),
             tenant=str(tenants[i]),
+            diff_status=(
+                "" if diff_names is None else str(diff_names[i])
+            ),
         )
         for i in idx
     ]

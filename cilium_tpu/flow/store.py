@@ -62,6 +62,11 @@ class FlowRecord:
     # decisions are debuggable end to end: a shed flow's Overload
     # record names WHO was shed
     tenant: str = ""
+    # shadow verdict-diff status (cilium_tpu.shadow): "" when the
+    # flow was not sampled into an armed shadow window or its two
+    # worlds agree; else the transition the shadow world would apply
+    # ("allow_to_deny" | "deny_to_allow" | "changed")
+    diff_status: str = ""
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -134,6 +139,9 @@ class FlowFilter:
     trace_id: Optional[str] = None
     cache_hit: Optional[bool] = None
     tenant: Optional[str] = None
+    # "any" matches every re-verdicted flow; a specific transition
+    # name matches exactly
+    diff_status: Optional[str] = None
 
     # GET /flows query-param name → field + parser
     PARAM_FIELDS = {
@@ -153,6 +161,10 @@ class FlowFilter:
             in ("1", "true", "yes", "on"),
         ),
         "tenant": ("tenant", str),
+        "diff-status": (
+            "diff_status",
+            lambda v: str(v).strip().lower().replace("-", "_"),
+        ),
     }
 
     @classmethod
@@ -210,6 +222,12 @@ class FlowFilter:
             return False
         if self.tenant is not None and r.tenant != self.tenant:
             return False
+        if self.diff_status is not None:
+            if self.diff_status == "any":
+                if not r.diff_status:
+                    return False
+            elif r.diff_status != self.diff_status:
+                return False
         return True
 
 

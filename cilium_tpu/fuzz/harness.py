@@ -54,8 +54,10 @@ PROGRAM_VERSION = 1
 
 # forced coverage prefix: these ops land at fixed early positions so
 # EVERY schedule (any seed) exercises rule churn, identity churn,
-# chip kill/readmission, both new fault sites, cache toggles and a
-# forced full publish — the rest of the schedule is free draws
+# chip kill/readmission, both new fault sites, cache toggles, a
+# forced full publish, and the shadow-diff lifecycle (armed diff
+# checks + disarm-on-stale across the publish_full at 21) — the rest
+# of the schedule is free draws
 _FORCED = {
     1: "rule_add",
     3: "ident_add",
@@ -67,8 +69,12 @@ _FORCED = {
     15: "memo_toggle_on",
     17: "rule_del",
     19: "ident_del",
+    20: "shadow_arm",
     21: "publish_full",
+    22: "shadow_diff",
     23: "fault_memo_chip",
+    24: "shadow_arm",
+    25: "shadow_diff",
 }
 
 _FREE_OPS = (
@@ -108,6 +114,9 @@ class _Runner:
             "rebalances": 0,
             "flow_record_checks": 0,
             "zipf_steps": 0,
+            "shadow_arms": 0,
+            "shadow_diff_checks": 0,
+            "shadow_stale_checks": 0,
             "events": Counter(),
         }
 
@@ -150,6 +159,10 @@ class _Runner:
             self.world.del_identity(ev["labels"])
             mutated = True
         elif op == "publish_full":
+            # a REAL full publish: the world recompiles (the stamp
+            # moves — an armed shadow window must close stale across
+            # it), then every executor force-full republishes
+            self.world.regenerate()
             self._publish_all(force_full=True)
         elif op == "memo_toggle":
             on = bool(ev["on"])
@@ -188,6 +201,21 @@ class _Runner:
                 "memo.insert", ev.get("spec", "raise:next=1")
             )
             armed_site = "memo.insert"
+        elif op == "shadow_arm":
+            # open (or re-open) a candidate diff window at sample
+            # rate 1.0: every subsequent daemon/serve dispatch
+            # dual-evaluates until a publish closes it stale
+            import json as _json
+
+            self.world.daemon.shadow.disarm()
+            self.world.daemon.shadow.arm(
+                rules_json=_json.dumps([ev["rule"]]),
+                sample_rate=1.0,
+            )
+            self.summary["shadow_arms"] += 1
+        elif op == "shadow_diff":
+            pass  # a flows step whose check compares the window's
+            # deltas to the host oracle's diff of the two worlds
         elif op == "flows":
             pass
         else:
@@ -221,7 +249,13 @@ class _Runner:
         # step's tuples after its window closed
         self._last_flow_seq = self._max_flow_seq()
         results: Dict[str, dict] = {}
+        pre_shadow = post_shadow = None
         for ex in self.executors:
+            if ex.name == "daemon":
+                # delta window for the shadow-diff check: only the
+                # daemon executor's dispatch lands between the two
+                # snapshots (the serve executor samples too, later)
+                pre_shadow = self._shadow_snapshot()
             if ex.name == "serve":
                 out = ex.dispatch(
                     flows, self.index, step,
@@ -231,9 +265,14 @@ class _Runner:
                 out = ex.dispatch(flows, self.index, step)
             results[ex.name] = out
             if ex.name == "daemon":
+                post_shadow = self._shadow_snapshot()
                 # the drop-record window must close before the serve
                 # executor appends ITS records for the same tuples
                 self._check_flow_records(flows, oracle_cols, step)
+        if ev["op"] == "shadow_diff":
+            self._check_shadow(
+                flows, oracle_cols, pre_shadow, post_shadow, step
+            )
 
         for name, out in results.items():
             if out.get("cols") is None:
@@ -297,6 +336,152 @@ class _Runner:
             for ex in self.executors
             if getattr(ex, "routed", False)
         )
+
+    def _shadow_snapshot(self):
+        """Window-counter snapshot (None when no window is open):
+        the delta the shadow-diff check brackets one executor's
+        dispatch with."""
+        sh = self.world.daemon.shadow
+        with sh._lock:
+            w = sh._window
+            if w is None:
+                return None
+            return {
+                "id": w["id"],
+                "sampled": w["sampled"],
+                "changed": dict(w["changed"]),
+                "a2d": w["allow_to_deny"],
+                "d2a": w["deny_to_allow"],
+                "seq": w["next_seq"],
+            }
+
+    def _check_shadow(
+        self, flows, oracle_cols, pre, post, step: int
+    ) -> None:
+        """The shadow-diff invariant: the window deltas the daemon
+        executor's dispatch produced must equal the HOST ORACLE's
+        diff of the two policy worlds bit-exactly — per-column
+        change counts, the allow→deny / deny→allow split, and the
+        diff-record multiset.  On a stale/closed window (a publish
+        landed since the arm) the dispatch must have sampled NOTHING
+        (disarm-on-stale)."""
+        from cilium_tpu.shadow import (
+            TRANS_ALLOW_TO_DENY,
+            TRANS_DENY_TO_ALLOW,
+            TRANS_NONE,
+            TRANS_NAMES,
+            diff_codes,
+        )
+
+        sh = self.world.daemon.shadow
+        state = sh.status()["state"]
+        n = len(flows["ep_id"])
+        if (
+            state != "armed"
+            or pre is None
+            or post is None
+            or pre["id"] != post["id"]
+        ):
+            # disarm-on-stale: a window closed by a publish (or
+            # never open) must not have folded this dispatch
+            if (
+                pre is not None
+                and post is not None
+                and pre["id"] == post["id"]
+                and post["sampled"] != pre["sampled"]
+            ):
+                raise FuzzFailure(
+                    ("daemon",), "shadow_stale", step,
+                    f"closed shadow window folded "
+                    f"{post['sampled'] - pre['sampled']} samples",
+                )
+            self.summary["shadow_stale_checks"] += 1
+            return
+        with sh._lock:
+            shadow_states = list(sh._window["states"])
+            ring = list(sh._window["ring"])
+        s_allowed, s_proxy, s_kind = self.world.oracle(
+            flows, self.index, shadow_states
+        )
+        ca, cp, ck, trans = diff_codes(
+            oracle_cols["allowed"],
+            oracle_cols["proxy_port"],
+            oracle_cols["match_kind"],
+            s_allowed.astype(np.int64),
+            s_proxy.astype(np.int64),
+            s_kind.astype(np.int64),
+            xp=np,
+        )
+        want = {
+            "sampled": n,
+            "allowed": int(ca.sum()),
+            "proxy_port": int(cp.sum()),
+            "match_kind": int(ck.sum()),
+            "a2d": int((trans == TRANS_ALLOW_TO_DENY).sum()),
+            "d2a": int((trans == TRANS_DENY_TO_ALLOW).sum()),
+        }
+        got = {
+            "sampled": post["sampled"] - pre["sampled"],
+            "allowed": (
+                post["changed"]["allowed"] - pre["changed"]["allowed"]
+            ),
+            "proxy_port": (
+                post["changed"]["proxy_port"]
+                - pre["changed"]["proxy_port"]
+            ),
+            "match_kind": (
+                post["changed"]["match_kind"]
+                - pre["changed"]["match_kind"]
+            ),
+            "a2d": post["a2d"] - pre["a2d"],
+            "d2a": post["d2a"] - pre["d2a"],
+        }
+        if got != want:
+            raise FuzzFailure(
+                ("daemon",), "shadow_diff", step,
+                f"sampled diff diverged from the host oracle's "
+                f"two-world diff: want {want} got {got}",
+            )
+        # record multiset: every oracle-changed tuple appears
+        # exactly once with its transition (the daemon executor's
+        # delta of the ring)
+        new_recs = [
+            r
+            for r in ring
+            if pre["seq"] <= r.seq < post["seq"]
+        ]
+        got_ms = Counter(
+            (
+                r.ep_id,
+                r.src_identity if r.direction == 0 else r.dst_identity,
+                r.dport, r.proto, r.direction, r.transition,
+            )
+            for r in new_recs
+        )
+        want_ms: Counter = Counter()
+        for i in range(n):
+            if int(trans[i]) == TRANS_NONE:
+                continue
+            want_ms[
+                (
+                    int(flows["ep_id"][i]),
+                    int(flows["identity"][i]),
+                    int(flows["dport"][i]),
+                    int(flows["proto"][i]),
+                    int(flows["direction"][i]),
+                    TRANS_NAMES[int(trans[i])],
+                )
+            ] += 1
+        if got_ms != want_ms:
+            missing = want_ms - got_ms
+            extra = got_ms - want_ms
+            raise FuzzFailure(
+                ("daemon",), "shadow_records", step,
+                f"diff-record multiset diverged: missing="
+                f"{dict(list(missing.items())[:3])} extra="
+                f"{dict(list(extra.items())[:3])}",
+            )
+        self.summary["shadow_diff_checks"] += 1
 
     def _check_readmission(self, results, ev, step: int) -> None:
         victim = int(ev.get("chip", X.VICTIM_CHIP))
@@ -426,7 +611,7 @@ def _make_event(
     """Materialize one event against the CURRENT world state (raw
     identity numbers, concrete rule JSON) so replay needs no rng."""
     ev: dict = {"op": op}
-    if op == "rule_add" or op == "fault_publish":
+    if op in ("rule_add", "fault_publish", "shadow_arm"):
         ev_rule = g.gen_rule()
         if op == "fault_publish":
             ev["spec"] = "raise:next=1"

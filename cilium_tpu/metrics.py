@@ -410,7 +410,9 @@ class Registry:
             f"{ns}_serve_deadline_dispatch_total",
             "Serving-plane batches dispatched EARLY (below the "
             "target fill) because the oldest queued flow's deadline "
-            "no longer allowed waiting",
+            "no longer allowed waiting, by the SLO class of the "
+            "flow that forced it (\"default\" = no named class)",
+            ("slo_class",),
         )
         self.serve_admitted_flows_total = Counter(
             f"{ns}_serve_admitted_flows_total",
@@ -424,6 +426,48 @@ class Registry:
             "Overload drop reason, per tenant (backlog bound or "
             "admission gate)",
             ("tenant",),
+        )
+        # -- shadow policy rollout / verdict-diff canarying
+        # (cilium_tpu.shadow) --------------------------------------------
+        self.policy_diff_sampled_total = Counter(
+            f"{ns}_policy_diff_sampled_total",
+            "Flows sampled into the armed shadow window and "
+            "dual-epoch evaluated (folded exactly once each; "
+            "refused in-flight samples count in "
+            "policy_diff_refused_total instead)",
+        )
+        self.policy_diff_changed_total = Counter(
+            f"{ns}_policy_diff_changed_total",
+            "Sampled flows whose verdict column differs between the "
+            "live and shadow policy worlds, by column and direction",
+            ("column", "direction"),
+        )
+        self.policy_diff_flows_allow_to_deny_total = Counter(
+            f"{ns}_policy_diff_flows_allow_to_deny_total",
+            "Sampled flows the live world allows that the shadow "
+            "world would deny (the blast-radius line of a pending "
+            "policy change)",
+        )
+        self.policy_diff_flows_deny_to_allow_total = Counter(
+            f"{ns}_policy_diff_flows_deny_to_allow_total",
+            "Sampled flows the live world denies that the shadow "
+            "world would allow (the exposure line of a pending "
+            "policy change)",
+        )
+        self.policy_diff_stale_total = Counter(
+            f"{ns}_policy_diff_stale_total",
+            "Shadow diff windows closed with an explicit stale "
+            "status because a publish moved the live world past the "
+            "pinned epoch stamp (a diff never silently spans a "
+            "third world)",
+        )
+        self.policy_diff_refused_total = Counter(
+            f"{ns}_policy_diff_refused_total",
+            "Sampled shadow dispatches refused instead of folded "
+            "(window closed while the sample was in flight, shadow "
+            "evaluation failure, or a drain-side failover dropped "
+            "the shadow columns) — exactly-once accounting's "
+            "complement to policy_diff_sampled_total",
         )
         # -- flow observability plane (cilium_tpu.flow) ------------------
         self.flow_records_captured_total = Counter(

@@ -581,13 +581,21 @@ class AdmissionGate:
         self._inflight = 0
         self.shed_total = 0
 
-    def reserve(self, n: int) -> bool:
+    def reserve(self, n: int, charge: bool = True) -> bool:
+        """Admit `n` units, or refuse.  With `charge` (default) a
+        refusal charges `n` straight to shed_total — the one-shot
+        path's whole-batch shed.  `charge=False` is for callers that
+        retry with a SUBSET after a refusal (the serving plane's
+        shed-priority ordering): they charge exactly what they
+        finally shed via charge_shed, so accounting stays
+        exactly-once."""
         with self._lock:
             if (
                 self.limit is not None
                 and self._inflight + n > self.limit
             ):
-                self.shed_total += n
+                if charge:
+                    self.shed_total += n
                 tracing.add_event(
                     "admission.shed", flows=n,
                     inflight=self._inflight, limit=self.limit,

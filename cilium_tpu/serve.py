@@ -163,11 +163,15 @@ class _Tenant:
     __slots__ = (
         "name", "weight", "queue", "backlog", "deficit",
         "admitted", "shed", "dispatched", "cache_hits", "served",
+        "slo_class",
     )
 
     def __init__(self, name: str, weight: float = 1.0) -> None:
         self.name = name
         self.weight = float(weight)
+        # named SLO class (PATCH /config {"tenant_slo": ...}); None
+        # = the plane's default deadline/weight/shed behavior
+        self.slo_class: Optional[str] = None
         self.queue: deque = deque()
         self.backlog = 0  # flows queued, not yet planned
         self.deficit = 0.0
@@ -199,6 +203,8 @@ class ServingPlane:
         tenant_weights: Optional[Dict[str, float]] = None,
         quantum: Optional[int] = None,
         fused: bool = False,
+        slo_classes: Optional[Dict[str, Dict]] = None,
+        tenant_slo: Optional[Dict[str, str]] = None,
     ) -> None:
         self.daemon = daemon
         # fused serving: coalesced batches carry the RAW 5-tuple
@@ -233,6 +239,14 @@ class ServingPlane:
         self._cond = threading.Condition(self._lock)
         self._tenants: Dict[str, _Tenant] = {}
         self._weights = dict(tenant_weights or {})
+        # named SLO classes: {name: {"deadline_ms", "shed_priority",
+        # "weight"}} + the tenant -> class assignment.  A class
+        # bundles the deadline the EWMA batch-wall model protects
+        # (per-class early dispatch), the DRR weight, and the shed
+        # priority (HIGHER numbers shed FIRST under admission-gate
+        # pressure).
+        self._slo_classes: Dict[str, Dict] = dict(slo_classes or {})
+        self._tenant_slo: Dict[str, str] = dict(tenant_slo or {})
         self._stop = False
         self._drain_on_stop = True
         self._thread: Optional[threading.Thread] = None
@@ -309,14 +323,47 @@ class ServingPlane:
                 {k: float(v) for k, v in weights.items()}
             )
             for name, t in self._tenants.items():
-                t.weight = self._weights.get(name, 1.0)
+                t.weight = self._resolve_weight(name)
+
+    def set_slo_classes(
+        self,
+        classes: Dict[str, Dict],
+        tenant_slo: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Live-apply the named SLO class bundles + tenant
+        assignments (PATCH /config): weights re-resolve immediately;
+        deadlines apply to submissions from now on."""
+        with self._cond:
+            self._slo_classes = dict(classes)
+            if tenant_slo is not None:
+                self._tenant_slo = dict(tenant_slo)
+            for name, t in self._tenants.items():
+                t.slo_class = self._tenant_slo.get(name)
+                t.weight = self._resolve_weight(name)
+
+    def _class_of(self, tenant: str) -> Optional[Dict]:
+        cls = self._tenant_slo.get(tenant)
+        return self._slo_classes.get(cls) if cls else None
+
+    def _resolve_weight(self, name: str) -> float:
+        cls = self._class_of(name)
+        if cls is not None and cls.get("weight") is not None:
+            return float(cls["weight"])
+        return float(self._weights.get(name, 1.0))
+
+    def _shed_priority(self, tenant: str) -> int:
+        cls = self._class_of(tenant)
+        if cls is None:
+            return 0
+        return int(cls.get("shed_priority", 0))
 
     # -- admission ------------------------------------------------------------
 
     def _tenant(self, name: str) -> _Tenant:
         t = self._tenants.get(name)
         if t is None:
-            t = _Tenant(name, self._weights.get(name, 1.0))
+            t = _Tenant(name, self._resolve_weight(name))
+            t.slo_class = self._tenant_slo.get(name)
             self._tenants[name] = t
         return t
 
@@ -363,11 +410,19 @@ class ServingPlane:
         result = ServeResult(n, tenant)
         result.dropped_unknown = n_unknown
         result.prefiltered = n_prefiltered
-        deadline = time.monotonic() + (
-            self.slo_s
-            if deadline_ms is None
-            else float(deadline_ms) / 1000.0
-        )
+        if deadline_ms is None:
+            # the tenant's named SLO class (when assigned) carries
+            # the default per-flow deadline the dynamic batcher's
+            # EWMA batch-wall model protects
+            cls = self._class_of(tenant)
+            deadline_s = (
+                float(cls["deadline_ms"]) / 1000.0
+                if cls is not None and cls.get("deadline_ms")
+                else self.slo_s
+            )
+        else:
+            deadline_s = float(deadline_ms) / 1000.0
+        deadline = time.monotonic() + deadline_s
         sub = _Submission(rec, tenant, deadline, result)
         if n == 0:
             result.latency_s = 0.0
@@ -496,20 +551,25 @@ class ServingPlane:
         )
 
     def _head_deadline(self) -> float:
-        return min(
-            (
-                t.queue[0].deadline
-                for t in self._tenants.values()
-                if t.queue
-            ),
-            default=float("inf"),
-        )
+        return self._head_deadline_info()[0]
+
+    def _head_deadline_info(self):
+        """(deadline, tenant) of the oldest queued flow — the
+        submission whose SLO forces an early dispatch; its tenant's
+        class labels serve_deadline_dispatch_total."""
+        best = (float("inf"), None)
+        for t in self._tenants.values():
+            if t.queue and t.queue[0].deadline < best[0]:
+                best = (t.queue[0].deadline, t.name)
+        return best
 
     def _next_plan(self):
         """Block until a batch should dispatch.  Returns (spans,
-        early) or None at stop-with-empty-queue.  `spans` is a list
-        of (submission, sub_start, sub_end) totaling <= batch_size
-        flows, composed by deficit round robin."""
+        mix, early, early_class) or None at stop-with-empty-queue.
+        `spans` is a list of (submission, sub_start, sub_end)
+        totaling <= batch_size flows, composed by deficit round
+        robin; `early_class` names the SLO class whose deadline
+        forced an early dispatch ("default" for unclassed)."""
         with self._cond:
             while True:
                 backlog = self._backlog()
@@ -520,15 +580,22 @@ class ServingPlane:
                     continue
                 if self._stop or backlog >= self.batch_size:
                     # full batch (or draining): dispatch now
-                    return self._compose_locked() + (False,)
+                    return self._compose_locked() + (False, None)
                 now = time.monotonic()
-                latest_start = (
-                    self._head_deadline() - self._dispatch_estimate()
-                )
+                head, head_tenant = self._head_deadline_info()
+                latest_start = head - self._dispatch_estimate()
                 if now >= latest_start:
                     # SLO-forced early dispatch: growing further
                     # would blow the oldest flow's deadline
-                    return self._compose_locked() + (True,)
+                    early_class = (
+                        self._tenant_slo.get(head_tenant)
+                        or "default"
+                        if head_tenant is not None
+                        else "default"
+                    )
+                    return self._compose_locked() + (
+                        True, early_class,
+                    )
                 self._cond.wait(
                     timeout=max(
                         0.0005, min(latest_start - now, 0.05)
@@ -604,7 +671,7 @@ class ServingPlane:
                 plan = self._next_plan()
                 if plan is None:
                     break
-                spans, mix, early = plan
+                spans, mix, early, early_class = plan
                 if not spans:
                     continue
                 if not self._drain_on_stop and self._stop:
@@ -612,7 +679,7 @@ class ServingPlane:
                     for sub, s, e in spans:
                         self._shed_span(sub, s, e)
                     continue
-                meta = self._stage(spans, mix, early)
+                meta = self._stage(spans, mix, early, early_class)
                 if meta is None:
                     continue  # whole plan shed at the gate
                 for done in dispatcher.submit(
@@ -638,6 +705,10 @@ class ServingPlane:
             failed = set()
             for meta2, _res, _exc in dispatcher.flush():
                 self.daemon.admission.release(meta2["valid"])
+                so = meta2.get("shadow_out")
+                if so is not None:  # in-flight shadow sample:
+                    # refuse, exactly once
+                    self.daemon.shadow.refuse(so.shadow_ticket)
                 for sub, _s, _e in meta2["spans"]:
                     failed.add(id(sub))
                     sub.result.error = RuntimeError(
@@ -656,11 +727,49 @@ class ServingPlane:
                             )
                             sub.result._event.set()
 
-    def _stage(self, spans, mix, early):
+    def _stage(self, spans, mix, early, early_class=None):
         """Concatenate a plan's record slices into one host batch
-        dict + bookkeeping meta.  Applies the AdmissionGate: a plan
-        the gate refuses is shed whole (exactly-once Overload per
-        flow, replies complete with shed_mask set)."""
+        dict + bookkeeping meta.  Applies the AdmissionGate with
+        SHED-PRIORITY ordering: when the gate refuses the full plan,
+        whole tenants shed in DESCENDING shed priority (the SLO
+        class bundle; higher numbers shed first, unclassed tenants
+        are priority 0) until the remainder fits — so under gate
+        pressure a gold-class tenant's flows survive the overload a
+        noisy tenant created.  Every shed flow gets exactly-once
+        Overload accounting; replies complete with shed_mask set."""
+        keep = list(spans)
+        shed_spans: List[Tuple[_Submission, int, int]] = []
+        while keep:
+            n_keep = sum(e - s for _sub, s, e in keep)
+            if self.daemon.admission.reserve(n_keep, charge=False):
+                break
+            with self._lock:
+                worst = max(
+                    self._shed_priority(sub.tenant)
+                    for sub, _s, _e in keep
+                )
+                shed_now = [
+                    sp for sp in keep
+                    if self._shed_priority(sp[0].tenant) == worst
+                ]
+            shed_spans.extend(shed_now)
+            keep = [sp for sp in keep if sp not in shed_now]
+        for sub, s, e in shed_spans:
+            self._shed_span(sub, s, e)
+        if not keep:
+            return None
+        spans = keep
+        if shed_spans:
+            # the fairness evidence must describe the batch that
+            # actually dispatched
+            for name in {sub.tenant for sub, _s, _e in shed_spans}:
+                row = mix.get(name)
+                if row is not None:
+                    row["flows"] = sum(
+                        e - s
+                        for sub, s, e in spans
+                        if sub.tenant == name
+                    )
         fields = (
             "ep_id", "identity", "dport", "proto",
             "direction", "is_fragment",
@@ -677,12 +786,6 @@ class ServingPlane:
             for f in fields
         }
         valid = len(cols["ep_id"])
-        if not self.daemon.admission.reserve(valid):
-            # the gate's refusal already charged these flows to
-            # shed_total — don't charge twice
-            for sub, s, e in spans:
-                self._shed_span(sub, s, e, gate_counted=True)
-            return None
         tenants_col = np.concatenate(
             [
                 np.full(e - s, sub.tenant, dtype=object)
@@ -690,7 +793,9 @@ class ServingPlane:
             ]
         )
         if early:
-            metrics.serve_deadline_dispatch_total.inc()
+            metrics.serve_deadline_dispatch_total.inc(
+                early_class or "default"
+            )
             self.early_dispatches += 1
         return {
             "spans": spans,
@@ -828,6 +933,14 @@ class ServingPlane:
         # memo overflow refusal can re-dispatch THIS batch uncached
         meta["tables"] = tables
         meta["batch"] = batch
+        # a shadow-sampled batch carries its ticket + lazy shadow
+        # columns to the drain (cilium_tpu.shadow): folded or
+        # refused exactly once in _complete
+        meta["shadow_out"] = (
+            out
+            if getattr(out, "shadow_ticket", None) is not None
+            else None
+        )
         return (
             out.allowed,
             out.match_kind,
@@ -886,6 +999,8 @@ class ServingPlane:
         valid = meta["valid"]
         ep_idx = meta.get("ep_idx")
         degraded = bool(meta.get("degraded"))
+        shadow_out = meta.get("shadow_out")
+        shadow_refuse = False
         try:
             if exc is not None and self.fused:
                 # fused mode has no bit-identical host fold (the
@@ -900,7 +1015,10 @@ class ServingPlane:
             if exc is not None:
                 # pack/enqueue/drain failure: the in-flight batch
                 # serves from the bit-identical host fold under the
-                # breaker, same as the one-shot drain path
+                # breaker, same as the one-shot drain path.  Its
+                # shadow columns (if sampled) came from the dead
+                # dispatch — refuse the sample cleanly.
+                shadow_refuse = True
                 from cilium_tpu.engine.hostpath import (
                     lattice_fold_host,
                 )
@@ -991,14 +1109,18 @@ class ServingPlane:
                         return self.daemon._dispatch_or_degrade(
                             meta["tables"], meta["batch"], _ha,
                             self.batch_size, use_memo=False,
+                            shadow_sample=False,
                         )
 
-                    v, deg2 = self.daemon._fold_memo_drain(
+                    (
+                        v, deg2, overflowed,
+                    ) = self.daemon._fold_memo_drain(
                         cache_stats, v, valid,
                         int(np.asarray(allowed).shape[0]),
                         _redispatch,
                     )
                     degraded = degraded or deg2
+                    shadow_refuse = shadow_refuse or overflowed
             # -- the shared fold (monitor + flow + metrics) -----------
             snap = meta["snap"]
             version, _, index, _ = snap
@@ -1035,14 +1157,40 @@ class ServingPlane:
                     == option.MONITOR_AGG_NONE
                 ),
             )
-            dirs = cols["direction"][k]
-            peer = cols["identity"][k].astype(np.int64)
-            local = local_ident_lut[ep_idx[k]]
+            # full-length identity orientation computed once: the
+            # shadow fold consumes the whole valid batch, the
+            # capture below slices the stale-masked view `k` off it
+            dirs_full = cols["direction"]
+            peer_full = cols["identity"].astype(np.int64)
+            local_full = local_ident_lut[ep_idx]
+            src_full = np.where(dirs_full == 0, peer_full, local_full)
+            dst_full = np.where(dirs_full == 0, local_full, peer_full)
+            dirs = dirs_full[k]
+            # shadow verdict-diff fold (cilium_tpu.shadow), exactly
+            # once per sampled coalesced batch; a batch holding
+            # vanished-endpoint rows refuses (their axis-0 verdicts
+            # would be a meaningless diff)
+            diff_col = None
+            if shadow_out is not None:
+                diff_full = self.daemon._fold_shadow_drain(
+                    shadow_out, v, valid,
+                    ep_ids=rev_lut[ep_idx],
+                    src_identities=src_full,
+                    dst_identities=dst_full,
+                    dports=cols["dport"],
+                    protos=cols["proto"],
+                    directions=dirs_full,
+                    tenant=meta["tenants"],
+                    trace_id="",
+                    refuse=shadow_refuse or stale is not None,
+                )
+                if diff_full is not None:
+                    diff_col = diff_full[k]
             capture_batch(
                 self.daemon.flow_store,
                 ep_ids=rev_lut[ep_idx[k]],
-                src_identities=np.where(dirs == 0, peer, local),
-                dst_identities=np.where(dirs == 0, local, peer),
+                src_identities=src_full[k],
+                dst_identities=dst_full[k],
                 dports=cols["dport"][k],
                 protos=cols["proto"][k],
                 directions=dirs,
@@ -1050,6 +1198,7 @@ class ServingPlane:
                 match_kind=v.match_kind[k],
                 proxy_port=v.proxy_port[k],
                 cache_hit=v.cache_hit[k],
+                diff_status=diff_col,
                 allow_sample=allow_sample_for_level(
                     opts.level(option.MONITOR_AGGREGATION)
                 ),
@@ -1135,7 +1284,11 @@ class ServingPlane:
                 self._span_accounted(sub, n)
         except Exception as exc2:
             # a fold/demux failure must not leave submitters
-            # blocked on replies that will never fill
+            # blocked on replies that will never fill; an
+            # unresolved shadow ticket refuses (idempotent — a
+            # ticket that already folded stays folded)
+            if shadow_out is not None:
+                self.daemon.shadow.refuse(shadow_out.shadow_ticket)
             for sub, _s, _e in spans:
                 if not sub.result.done:
                     sub.result.error = exc2
@@ -1146,11 +1299,21 @@ class ServingPlane:
 
     # -- introspection --------------------------------------------------------
 
+    def reset_window(self) -> None:
+        """Zero the rolling serving_p99_ms latency window (the
+        /debug/profile?reset=1 seam applied to the serving plane):
+        bench segments and before/after experiments must not bleed
+        one load shape's tail into the next segment's p99."""
+        with self._lock:
+            self._latency_window.clear()
+        metrics.serving_p99_ms.set(value=0.0)
+
     def snapshot(self) -> Dict:
         with self._lock:
             tenants = {
                 t.name: {
                     "weight": t.weight,
+                    "slo_class": t.slo_class,
                     "backlog": t.backlog,
                     "admitted": t.admitted,
                     "dispatched": t.dispatched,
@@ -1164,6 +1327,7 @@ class ServingPlane:
             }
         return {
             "batch_size": self.batch_size,
+            "slo_classes": dict(self._slo_classes),
             "slo_ms": self.slo_s * 1000.0,
             "batches": self.batches,
             "flows_served": self.flows_served,
@@ -1217,6 +1381,9 @@ def run_serve_bench(
     plane = daemon.serving_plane(
         batch_size=batch_size, slo_ms=slo_ms
     )
+    # segment isolation: a previous bench segment's latency tail
+    # must not bleed into THIS run's serving_p99_ms
+    plane.reset_window()
     shares = tenants or {"default": 1.0}
     total_share = sum(shares.values())
     results: List[ServeResult] = []

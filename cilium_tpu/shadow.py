@@ -1,0 +1,870 @@
+"""Shadow policy rollout: dual-epoch evaluation with live verdict-diff
+canarying.
+
+An operator changing a CiliumNetworkPolicy today learns what it *did*
+only after cutover, from flow records.  This plane turns the epoch
+double-buffer into a policy-CI surface: while a SHADOW world is armed,
+the daemon samples live batches and dispatches them against BOTH
+worlds — the second gather rides the same staged batch (the TupleBatch
+is already device-resident; only the table gathers repeat) — then
+diffs all verdict columns and folds per-column / per-transition change
+counters, with every re-verdicted tuple captured as a diff record in a
+bounded ring.  `cilium-tpu policy diff --live` shows exactly which
+flows a pending change would re-verdict, on device, at line rate,
+BEFORE cutover.
+
+Two ways to arm a window:
+
+  * **candidate** (`POST /policy/shadow {"action": "arm", "rules":
+    [...]}`): the candidate rules are compiled into a full shadow
+    world against the LIVE identity universe and endpoint set — the
+    what-if form.  ``promote`` installs the candidate through the
+    normal policy path (``policy_add(replace=True)``) and closes the
+    window with its counters zeroed.
+  * **standby** (no rules): the shadow world is the PREVIOUS publish —
+    the world still held by the standby epoch slot after the last
+    cutover — so the diff reads "what did my last change re-verdict"
+    retroactively.  Nothing to promote in this mode.
+
+Stamp-guard contract (the dual-epoch seam): arming pins the pair
+(live generation, shadow generation).  Every sampled dispatch verifies
+the batch's tables still carry the pinned live stamp; any publish that
+moves the live world closes the window with an explicit ``stale``
+status — a diff can never silently span a third world.  A shadow
+dispatch already in flight across a concurrent publish either folds
+against its pinned stamps (window still open at drain) or is REFUSED
+cleanly (``policy_diff_refused_total``) — never half-world-diffed.
+Sample accounting is exactly-once: ``policy_diff_sampled_total``
+counts only folded samples, each ticket folds or refuses exactly once.
+
+Device-residency cost: the shadow world is placed as ONE extra epoch
+(a `device_put` at first sample; a replica-store publish on the routed
+path).  The per-batch marginal cost is only the second table gather —
+the staged batch, the H2D upload, the event/flow folds are all shared
+with the live dispatch.
+
+Simulation boundary: on this 2-CPU container the "device" is XLA's
+CPU backend — `shadow_eval_overhead_pct` absolutes read on real
+hardware; what the tier-1 suite pins here is the semantics
+(bit-identity of the sampled diff to the host oracle's diff of the
+two worlds, exactly-once accounting, stamp-guarded staleness).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter as _Counter
+from collections import deque
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cilium_tpu import tracing
+from cilium_tpu.logging import get_logger
+from cilium_tpu.metrics import registry as metrics
+
+log = get_logger("shadow")
+
+# diff transition codes (the device diff kernel's per-row output)
+TRANS_NONE = 0
+TRANS_ALLOW_TO_DENY = 1
+TRANS_DENY_TO_ALLOW = 2
+TRANS_CHANGED = 3  # verdict kept, match_kind/proxy_port moved
+
+TRANS_NAMES = {
+    TRANS_NONE: "",
+    TRANS_ALLOW_TO_DENY: "allow_to_deny",
+    TRANS_DENY_TO_ALLOW: "deny_to_allow",
+    TRANS_CHANGED: "changed",
+}
+
+_DIRECTION_NAMES = {0: "INGRESS", 1: "EGRESS"}
+
+# the verdict columns the diff covers — every column the lattice
+# dispatch returns (engine.verdict.Verdicts)
+DIFF_COLUMNS = ("allowed", "proxy_port", "match_kind")
+
+
+def diff_codes(
+    live_allowed,
+    live_proxy,
+    live_kind,
+    shadow_allowed,
+    shadow_proxy,
+    shadow_kind,
+    xp=np,
+):
+    """The ONE diff definition both the jitted device kernel and the
+    host oracle comparisons share (the telemetry_masks pattern):
+    per-row changed flags per verdict column plus a transition code.
+    ``xp`` is numpy or jax.numpy."""
+    ca = live_allowed.astype(xp.int32) != shadow_allowed.astype(
+        xp.int32
+    )
+    cp = live_proxy.astype(xp.int32) != shadow_proxy.astype(xp.int32)
+    ck = live_kind.astype(xp.int32) != shadow_kind.astype(xp.int32)
+    a2d = ca & (live_allowed.astype(xp.int32) != 0)
+    d2a = ca & (live_allowed.astype(xp.int32) == 0)
+    trans = xp.where(
+        a2d,
+        xp.int32(TRANS_ALLOW_TO_DENY),
+        xp.where(
+            d2a,
+            xp.int32(TRANS_DENY_TO_ALLOW),
+            xp.where(
+                cp | ck,
+                xp.int32(TRANS_CHANGED),
+                xp.int32(TRANS_NONE),
+            ),
+        ),
+    )
+    return (
+        ca.astype(xp.uint8),
+        cp.astype(xp.uint8),
+        ck.astype(xp.uint8),
+        trans.astype(xp.uint8),
+    )
+
+
+@dataclass
+class DiffRecord:
+    """One re-verdicted tuple of an armed shadow window (the changed
+    row's old/new verdict pair, Hubble-oriented identities, and the
+    drop-reason transition an operator greps for)."""
+
+    ts: float
+    ep_id: int
+    src_identity: int
+    dst_identity: int
+    dport: int
+    proto: int
+    direction: int  # 0=ingress 1=egress
+    live_allowed: bool
+    shadow_allowed: bool
+    live_match_kind: int
+    shadow_match_kind: int
+    live_proxy_port: int
+    shadow_proxy_port: int
+    transition: str  # allow_to_deny | deny_to_allow | changed
+    live_reason: str = ""  # canonical drop reason ("" = forwarded)
+    shadow_reason: str = ""
+    tenant: str = ""
+    trace_id: str = ""
+    seq: int = 0
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["direction"] = _DIRECTION_NAMES.get(
+            self.direction, str(self.direction)
+        )
+        return d
+
+
+def _drop_reason_of(allowed, kind) -> str:
+    """Canonical reason name of a denied lattice verdict — the SAME
+    classification the flow plane applies (telemetry's policy/frag
+    split; the audit path has no prefilter column)."""
+    from cilium_tpu.engine.oracle import MATCH_FRAG_DROP
+    from cilium_tpu.telemetry import (
+        DROP_COLUMN_REASONS,
+        TELEM_DROP_FRAG,
+        TELEM_DROP_POLICY,
+    )
+
+    if allowed:
+        return ""
+    return DROP_COLUMN_REASONS[
+        TELEM_DROP_FRAG if kind == MATCH_FRAG_DROP else TELEM_DROP_POLICY
+    ]
+
+
+def _norm_stamp(gen) -> int:
+    """Normalize a table generation to the store-scoped publish
+    counter bits (a device round trip without x64 truncates to u32 —
+    the engine.publish._norm convention)."""
+    return int(np.asarray(gen)) & 0xFFFFFFFF
+
+
+def compile_candidate_world(daemon, rules):
+    """Compile candidate rules into a full shadow world against the
+    LIVE identity universe and endpoint set, without touching any
+    live daemon state: live rules with same-labeled rules replaced by
+    the candidates (the ``policy_add(replace=True)`` semantics a
+    later promote applies), lowered per endpoint through the same
+    ``compute_desired_policy_map_state`` the real regeneration path
+    runs, stacked by a FRESH FleetCompiler.
+
+    Returns (tables, index, states) with ``index`` guaranteed equal
+    to the live published index (same endpoint axis — the diff
+    dispatches ONE staged batch against both worlds).
+
+    Boundary: candidate rules are resolved against the live identity
+    universe — CIDR selectors match only already-allocated prefix
+    identities, and L7 redirects not already realized on an endpoint
+    surface with proxy_port 0 (the reference defers them to port
+    allocation at real cutover).  Both are exactly what an operator
+    wants answered pre-cutover: how does THIS world's traffic
+    re-verdict."""
+    from cilium_tpu.compiler.mapstate import (
+        compute_desired_policy_map_state,
+        resolve_l4_policy,
+    )
+    from cilium_tpu.compiler.selectorcache import SelectorCache
+    from cilium_tpu.compiler.tables import FleetCompiler
+    from cilium_tpu.policy.repository import Repository
+
+    with daemon.lock:
+        live_rules = [pr.rule for pr in daemon.repo.rules]
+    keep = list(live_rules)
+    for cand in rules:
+        keep = [
+            r for r in keep if not r.labels.contains(cand.labels)
+        ]
+    repo2 = Repository()
+    repo2.add_list(keep + list(rules))
+    cache, _ = daemon.identity_allocator.identity_cache_versioned()
+    sc = SelectorCache()
+    sc.sync(cache)
+    entries = []
+    eps = sorted(
+        daemon.endpoint_manager.endpoints(), key=lambda e: e.id
+    )
+    for i, ep in enumerate(eps):
+        if ep.security_identity is None:
+            entries.append((ep.id, {}, ("shadow", i)))
+            continue
+        ep_labels = ep.security_identity.label_array
+        ing, eg = ep.compute_policy_enforcement(repo2)
+        l4 = resolve_l4_policy(repo2, ep_labels, ing, eg)
+        state = compute_desired_policy_map_state(
+            repo2,
+            cache,
+            ep_labels,
+            endpoint_id=ep.id,
+            ingress_enabled=ing,
+            egress_enabled=eg,
+            realized_redirects=dict(ep.realized_redirects),
+            l4_policy=l4,
+            selector_cache=sc,
+        )
+        entries.append((ep.id, state, ("shadow", i)))
+    tables, index = FleetCompiler().compile(entries, list(cache))
+    states_by_id = {eid: st for eid, st, _ in entries}
+    states: list = [None] * (max(index.values(), default=-1) + 1)
+    for ep_id, idx in index.items():
+        states[idx] = states_by_id.get(ep_id)
+    return tables, index, states
+
+
+class ShadowPlane:
+    """The daemon's shadow-evaluation + verdict-diff plane: one armed
+    window at a time, sampled dual-epoch dispatch, bounded diff ring,
+    stamp-guarded lifecycle (arm / disarm / promote / stale)."""
+
+    def __init__(self, daemon, ring_capacity: int = 8192) -> None:
+        self.daemon = daemon
+        self.ring_capacity = int(ring_capacity)
+        self._lock = threading.RLock()
+        self._state = "disarmed"  # disarmed | armed | stale
+        self._window: Optional[dict] = None
+        self._window_id = 0
+        self.last_window: Optional[dict] = None
+        self._eval = None  # jit-tracked evaluate_batch, lazy
+        self._diff_kernel = None  # jitted diff_codes, lazy
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def arm(
+        self,
+        rules_json: Optional[str] = None,
+        sample_rate: float = 1.0,
+        seed: int = 0,
+        replace: bool = True,
+    ) -> dict:
+        """Open a diff window.  With ``rules_json`` the shadow world
+        is the compiled CANDIDATE (live rules with same-labeled ones
+        replaced); without, it is the PREVIOUS publish (standby
+        mode).  Re-arming closes any open window first."""
+        if not (0.0 < float(sample_rate) <= 1.0):
+            raise ValueError(
+                f"sample_rate must be in (0, 1], got {sample_rate!r}"
+            )
+        mgr = self.daemon.endpoint_manager
+        version, live_tables, live_index, _ = (
+            mgr.published_with_states()
+        )
+        if live_tables is None:
+            raise RuntimeError(
+                "no published tables: nothing to shadow against"
+            )
+        if rules_json is not None:
+            from cilium_tpu.policy.api import rules_from_json
+
+            rules = rules_from_json(rules_json)
+            for r in rules:
+                r.sanitize()
+            tables, index, states = compile_candidate_world(
+                self.daemon, rules
+            )
+            mode = "candidate"
+        else:
+            prev = mgr.published_previous()
+            if prev is None:
+                raise RuntimeError(
+                    "standby shadow needs a previous publish (the "
+                    "standby epoch is empty); publish a change "
+                    "first or arm a candidate"
+                )
+            _, tables, index, states = prev
+            # own the buffers NOW: the manager's retained previous
+            # tables are the compiler's ping-pong pair, valid for
+            # exactly one further publish — a lazy device placement
+            # at first sample could read buffers a later compile is
+            # reusing in place.  One host copy at arm (a
+            # control-plane op) makes every later placement —
+            # single-chip device_put or routed replica-store
+            # publish — read plane-owned memory only.
+            import jax as _jax
+
+            tables = _jax.tree.map(
+                lambda a: (
+                    None if a is None else np.array(a, copy=True)
+                ),
+                tables,
+                is_leaf=lambda x: x is None,
+            )
+            rules_json = None
+            mode = "standby"
+        if dict(index) != dict(live_index):
+            raise RuntimeError(
+                "shadow endpoint axis diverged from the live "
+                "publish (endpoint churn during arm); retry"
+            )
+        with self._lock:
+            if self._state == "armed":
+                self._close("superseded")
+            self._window_id += 1
+            self._window = {
+                "id": self._window_id,
+                "mode": mode,
+                "live_gen": _norm_stamp(live_tables.generation),
+                "live_version": version,
+                "shadow_gen": _norm_stamp(tables.generation),
+                "sample_rate": float(sample_rate),
+                "tables": tables,
+                "states": states,
+                "index": dict(index),
+                "rules_json": rules_json,
+                "armed_at": time.time(),
+                "rng": np.random.default_rng(
+                    [int(seed), self._window_id]
+                ),
+                # lazy device placements (single-chip epoch; routed
+                # replica-store epoch + evaluator per router)
+                "single_dev": None,
+                "routed": None,
+                # window counters (GET /policy/diff; zeroed per
+                # window — the process-global registry counters
+                # stay cumulative)
+                "sampled": 0,
+                "sampled_batches": 0,
+                "refused": 0,
+                "changed": {c: 0 for c in DIFF_COLUMNS},
+                "changed_dir": _Counter(),
+                "allow_to_deny": 0,
+                "deny_to_allow": 0,
+                "ring": deque(maxlen=self.ring_capacity),
+                "ring_evicted": 0,
+                "next_seq": 1,
+                "pairs": _Counter(),
+            }
+            self._state = "armed"
+        log.info(
+            "shadow window armed",
+            extra={"fields": {
+                "mode": mode,
+                "live_gen": self._window["live_gen"],
+                "shadow_gen": self._window["shadow_gen"],
+                "sample_rate": float(sample_rate),
+            }},
+        )
+        return self.status()
+
+    def disarm(self, reason: str = "operator") -> dict:
+        with self._lock:
+            if self._window is not None:
+                self._close(reason)
+        return self.status()
+
+    def promote(self) -> dict:
+        """Install the candidate as the live policy through the
+        normal policy path and close the window with its counters
+        zeroed.  Standby windows have nothing to promote (their
+        shadow IS the previous world)."""
+        from cilium_tpu.policy.api import rules_from_json
+
+        with self._lock:
+            w = self._window
+            if w is None:
+                raise RuntimeError("no armed shadow window")
+            if w["mode"] != "candidate" or not w["rules_json"]:
+                raise RuntimeError(
+                    "nothing to promote: a standby window's shadow "
+                    "is the previous epoch, not a candidate"
+                )
+            rules_json = w["rules_json"]
+            summary = self._close("promoted")
+        # the policy path outside the plane lock (it regenerates)
+        rules = rules_from_json(rules_json)
+        revision = self.daemon.policy_add(rules, replace=True)
+        summary["promoted_revision"] = revision
+        with self._lock:
+            self.last_window = summary
+        log.info(
+            "shadow candidate promoted",
+            extra={"fields": {"revision": revision}},
+        )
+        return {"state": self._state, "promoted": summary}
+
+    def _close(self, reason: str) -> dict:
+        """Close the open window (caller holds the lock): counters
+        freeze into ``last_window``, sampling stops, device epochs
+        drop (HBM released with the refs)."""
+        w = self._window
+        summary = self._window_summary(w)
+        summary["closed"] = reason
+        self.last_window = summary
+        self._window = None
+        self._state = "stale" if reason == "stale" else "disarmed"
+        if reason == "stale":
+            metrics.policy_diff_stale_total.inc()
+            tracing.add_event(
+                "shadow.stale", live_gen=w["live_gen"],
+                shadow_gen=w["shadow_gen"],
+            )
+        log.info(
+            "shadow window closed",
+            extra={"fields": {
+                "reason": reason, "sampled": w["sampled"],
+                "changed": dict(w["changed"]),
+            }},
+        )
+        return summary
+
+    def _check_live_stamp_locked(self, gen: Optional[int]) -> bool:
+        """True while the window is open and ``gen`` (normalized, or
+        None to re-read the published tables) still matches the
+        pinned live stamp; a moved live world closes the window
+        stale — the disarm-on-stale guard."""
+        w = self._window
+        if w is None:
+            return False
+        if gen is None:
+            _, tables, _ = self.daemon.endpoint_manager.published()
+            if tables is None:
+                gen = -1
+            else:
+                gen = _norm_stamp(tables.generation)
+        if gen != w["live_gen"]:
+            self._close("stale")
+            return False
+        return True
+
+    # -- sampling -------------------------------------------------------------
+
+    def sample_ticket(self, tables) -> Optional[dict]:
+        """Sampling decision for one batch about to dispatch against
+        ``tables`` (the live epoch).  Returns a ticket pinning the
+        window + stamp pair, or None (disarmed, stale-closed, or not
+        sampled).  The fast disarmed path is one attribute read."""
+        if self._state != "armed":
+            return None
+        gen = _norm_stamp(tables.generation)
+        with self._lock:
+            if not self._check_live_stamp_locked(gen):
+                return None
+            w = self._window
+            if (
+                w["sample_rate"] < 1.0
+                and w["rng"].random() >= w["sample_rate"]
+            ):
+                return None
+            return {
+                "window": w["id"],
+                "live_gen": w["live_gen"],
+                "shadow_gen": w["shadow_gen"],
+                "done": False,
+            }
+
+    # -- the second dispatch --------------------------------------------------
+
+    def _device_diff(self, live_v, shadow_v):
+        """The on-device half of the diff: per-row changed flags per
+        verdict column + the transition code, jitted (site
+        shadow.diff) over the two lazy column sets — no sync here;
+        the drain folds the codes one batch behind."""
+        import jax
+
+        if self._diff_kernel is None:
+            import jax.numpy as jnp
+
+            def kern(la, lp, lk, sa, sp_, sk):
+                return diff_codes(la, lp, lk, sa, sp_, sk, xp=jnp)
+
+            self._diff_kernel = tracing.track_jit(
+                jax.jit(kern), "shadow.diff"
+            )
+        return self._diff_kernel(
+            live_v.allowed, live_v.proxy_port, live_v.match_kind,
+            shadow_v.allowed, shadow_v.proxy_port,
+            shadow_v.match_kind,
+        )
+
+    def evaluate(self, ticket: dict, batch, live_out):
+        """Dispatch the ALREADY-STAGED TupleBatch against the shadow
+        epoch (single-chip path) and diff on device.  Returns a dict
+        of lazy columns {allowed, proxy_port, match_kind, ca, cp, ck,
+        trans} to ride the pending queue to the drain, or None on any
+        shadow-side failure (the live batch is never degraded by its
+        shadow; the ticket refuses)."""
+        import jax
+
+        with self._lock:
+            w = self._window
+            if w is None or w["id"] != ticket["window"]:
+                self._refuse_ticket(ticket)
+                return None
+            if w["single_dev"] is None:
+                w["single_dev"] = jax.device_put(w["tables"])
+            dev = w["single_dev"]
+        if self._eval is None:
+            from cilium_tpu.engine.verdict import evaluate_batch
+
+            self._eval = tracing.track_jit(
+                evaluate_batch, "shadow.dispatch"
+            )
+        try:
+            with tracing.tracer.span(
+                "shadow.dispatch", site="shadow.dispatch",
+                attrs={
+                    "rows": int(batch.ep_index.shape[0]),
+                    "shadow_gen": ticket["shadow_gen"],
+                },
+            ):
+                sv = self._eval(dev, batch)
+                ca, cp, ck, trans = self._device_diff(live_out, sv)
+        except Exception as exc:  # noqa: BLE001 — shadow must never
+            # take the live stream down
+            log.warning(
+                "shadow dispatch failed; sample refused",
+                extra={"fields": {"error": str(exc)}},
+            )
+            self._refuse_ticket(ticket)
+            return None
+        return {
+            "allowed": sv.allowed,
+            "proxy_port": sv.proxy_port,
+            "match_kind": sv.match_kind,
+            "ca": ca,
+            "cp": cp,
+            "ck": ck,
+            "trans": trans,
+        }
+
+    def routed_args(self, router):
+        """(evaluator, augmented device tables) serving the shadow
+        world through the ROUTED failover path — the shadow gather
+        goes through the same alive-masked replica machinery as the
+        live one, on the same re-split batch.  Built lazily once per
+        window; reuses the router's evaluator when the shadow
+        geometry matches its jit class, else builds a dedicated
+        one."""
+        from cilium_tpu.engine.sharded import (
+            make_failover_evaluator,
+            make_replica_store,
+        )
+
+        with self._lock:
+            w = self._window
+            if w is None:
+                return None
+            routed = w["routed"]
+            if routed is not None and routed["router"] is router:
+                return routed["ev"], routed["dev"]
+            store = make_replica_store(router.mesh, router.table_axis)
+            _, _ = store.publish(w["tables"])
+            dev_tables = store.current()[1]
+            geom = (
+                tuple(w["tables"].l4_hash_rows.shape),
+                tuple(w["tables"].l3_allow_bits.shape),
+            )
+            ev = (
+                router._ev
+                if geom == router._geom
+                else make_failover_evaluator(
+                    router.mesh, w["tables"],
+                    batch_axis=router.batch_axis,
+                    table_axis=router.table_axis,
+                    collect_telemetry=router.collect_telemetry,
+                )
+            )
+            w["routed"] = {
+                "router": router,
+                "store": store,
+                "ev": ev,
+                "dev": dev_tables,
+            }
+            return ev, dev_tables
+
+    # -- the drain-side fold --------------------------------------------------
+
+    def _refuse_ticket(self, ticket: dict) -> None:
+        with self._lock:
+            if ticket.get("done"):
+                return
+            ticket["done"] = True
+            metrics.policy_diff_refused_total.inc()
+            w = self._window
+            if w is not None and w["id"] == ticket["window"]:
+                w["refused"] += 1
+            elif self.last_window is not None:
+                self.last_window["refused"] = (
+                    self.last_window.get("refused", 0) + 1
+                )
+
+    def refuse(self, ticket: dict) -> None:
+        """A sampled batch whose drain failed over (or whose shadow
+        columns were dropped): the ticket refuses cleanly, exactly
+        once."""
+        self._refuse_ticket(ticket)
+
+    def fold(
+        self,
+        ticket: dict,
+        live_v,
+        shadow_cols: dict,
+        valid: int,
+        *,
+        ep_ids,
+        src_identities,
+        dst_identities,
+        dports,
+        protos,
+        directions,
+        tenant="",
+        trace_id: str = "",
+    ) -> Optional[np.ndarray]:
+        """Fold one sampled batch's diff into the window, exactly
+        once per ticket: the device-diffed codes (sliced to the valid
+        prefix by the caller's [:valid] convention) become counter
+        increments + diff records.  Returns the per-row transition
+        codes (np.uint8 [valid]; 0 = unchanged) for the flow plane's
+        diff-status join, or None when the window closed since the
+        sample was taken (the in-flight-across-a-publish refusal —
+        counted, never half-folded)."""
+        trans = np.asarray(shadow_cols["trans"])[:valid]
+        ca = np.asarray(shadow_cols["ca"])[:valid]
+        cp = np.asarray(shadow_cols["cp"])[:valid]
+        ck = np.asarray(shadow_cols["ck"])[:valid]
+        with self._lock:
+            w = self._window
+            if (
+                ticket.get("done")
+                or w is None
+                or w["id"] != ticket["window"]
+            ):
+                self._refuse_ticket(ticket)
+                return None
+            ticket["done"] = True
+            w["sampled"] += valid
+            w["sampled_batches"] += 1
+            metrics.policy_diff_sampled_total.inc(value=valid)
+            dirs = np.asarray(directions)[:valid]
+            for col, flags in (
+                ("allowed", ca), ("proxy_port", cp),
+                ("match_kind", ck),
+            ):
+                n = int(flags.sum())
+                if not n:
+                    continue
+                w["changed"][col] += n
+                for dirv, dname in _DIRECTION_NAMES.items():
+                    c = int((flags.astype(bool) & (dirs == dirv)).sum())
+                    if c:
+                        w["changed_dir"][(col, dname)] += c
+                        metrics.policy_diff_changed_total.inc(
+                            col, dname, value=c
+                        )
+            n_a2d = int((trans == TRANS_ALLOW_TO_DENY).sum())
+            n_d2a = int((trans == TRANS_DENY_TO_ALLOW).sum())
+            if n_a2d:
+                w["allow_to_deny"] += n_a2d
+                metrics.policy_diff_flows_allow_to_deny_total.inc(
+                    value=n_a2d
+                )
+            if n_d2a:
+                w["deny_to_allow"] += n_d2a
+                metrics.policy_diff_flows_deny_to_allow_total.inc(
+                    value=n_d2a
+                )
+            changed_idx = np.nonzero(trans != TRANS_NONE)[0]
+            if changed_idx.size:
+                self._capture_records_locked(
+                    w, changed_idx, trans, live_v, shadow_cols,
+                    valid,
+                    ep_ids=ep_ids,
+                    src_identities=src_identities,
+                    dst_identities=dst_identities,
+                    dports=dports,
+                    protos=protos,
+                    directions=dirs,
+                    tenant=tenant,
+                    trace_id=trace_id,
+                )
+        return trans
+
+    def _capture_records_locked(
+        self, w, changed_idx, trans, live_v, shadow_cols, valid,
+        *, ep_ids, src_identities, dst_identities, dports, protos,
+        directions, tenant, trace_id,
+    ) -> None:
+        """Changed rows → DiffRecords in the bounded ring (newest
+        kept under a diff storm, excess charged to ring_evicted —
+        the capture_batch drop-storm rule) + the identity-pair
+        aggregation behind the summary."""
+        sa = np.asarray(shadow_cols["allowed"])[:valid]
+        sk = np.asarray(shadow_cols["match_kind"])[:valid]
+        sp_ = np.asarray(shadow_cols["proxy_port"])[:valid]
+        la = np.asarray(live_v.allowed)[:valid]
+        lk = np.asarray(live_v.match_kind)[:valid]
+        lp = np.asarray(live_v.proxy_port)[:valid]
+        # tuple columns converted ONCE (the loop below runs under
+        # the plane lock on the drain path — per-row asarray would
+        # stall every concurrent sample/fold during a diff storm)
+        src_col = np.asarray(src_identities)
+        dst_col = np.asarray(dst_identities)
+        ep_col = np.asarray(ep_ids)
+        dport_col = np.asarray(dports)
+        proto_col = np.asarray(protos)
+        dir_col = np.asarray(directions)
+        tenants = (
+            np.asarray(tenant, dtype=object)
+            if not isinstance(tenant, str)
+            else None
+        )
+        truncated = max(0, changed_idx.size - self.ring_capacity)
+        if truncated:
+            w["ring_evicted"] += truncated
+            changed_idx = changed_idx[-self.ring_capacity:]
+        ts = time.time()
+        for i in changed_idx:
+            i = int(i)
+            src = int(src_col[i])
+            dst = int(dst_col[i])
+            w["pairs"][(src, dst)] += 1
+            if len(w["ring"]) == self.ring_capacity:
+                w["ring_evicted"] += 1
+            rec = DiffRecord(
+                ts=ts,
+                ep_id=int(ep_col[i]),
+                src_identity=src,
+                dst_identity=dst,
+                dport=int(dport_col[i]),
+                proto=int(proto_col[i]),
+                direction=int(dir_col[i]),
+                live_allowed=bool(la[i]),
+                shadow_allowed=bool(sa[i]),
+                live_match_kind=int(lk[i]),
+                shadow_match_kind=int(sk[i]),
+                live_proxy_port=int(lp[i]),
+                shadow_proxy_port=int(sp_[i]),
+                transition=TRANS_NAMES[int(trans[i])],
+                live_reason=_drop_reason_of(bool(la[i]), int(lk[i])),
+                shadow_reason=_drop_reason_of(
+                    bool(sa[i]), int(sk[i])
+                ),
+                tenant=(
+                    str(tenants[i]) if tenants is not None
+                    else str(tenant)
+                ),
+                trace_id=trace_id,
+                seq=w["next_seq"],
+            )
+            w["next_seq"] += 1
+            w["ring"].append(rec)
+
+    # -- introspection --------------------------------------------------------
+
+    def _window_summary(self, w: dict) -> dict:
+        return {
+            "mode": w["mode"],
+            "live_gen": w["live_gen"],
+            "shadow_gen": w["shadow_gen"],
+            "sample_rate": w["sample_rate"],
+            "armed_at": w["armed_at"],
+            "sampled": w["sampled"],
+            "sampled_batches": w["sampled_batches"],
+            "refused": w["refused"],
+            "changed": dict(w["changed"]),
+            "changed_by_direction": [
+                {"column": col, "direction": d, "count": n}
+                for (col, d), n in sorted(w["changed_dir"].items())
+            ],
+            "allow_to_deny": w["allow_to_deny"],
+            "deny_to_allow": w["deny_to_allow"],
+            "records": len(w["ring"]),
+            "ring_evicted": w["ring_evicted"],
+            "top_reverdicted_pairs": [
+                {
+                    "src_identity": src,
+                    "dst_identity": dst,
+                    "count": n,
+                }
+                for (src, dst), n in w["pairs"].most_common(10)
+            ],
+        }
+
+    def status(self) -> dict:
+        """The diff window's state + counters; re-verifies the live
+        stamp so a publish flips the reply to ``stale`` immediately
+        (not only at the next sampled dispatch)."""
+        with self._lock:
+            if self._state == "armed":
+                self._check_live_stamp_locked(None)
+            out = {"state": self._state}
+            if self._window is not None:
+                out["window"] = self._window_summary(self._window)
+            elif self.last_window is not None:
+                out["last_window"] = dict(self.last_window)
+            return out
+
+    def diff(
+        self, last: int = 256, since_seq: Optional[int] = None
+    ) -> dict:
+        """GET /policy/diff: status + summary + the newest ``last``
+        diff records (``since_seq`` cursors a follow-style reader —
+        records with seq > cursor only)."""
+        out = self.status()
+        with self._lock:
+            w = self._window
+            records: List[DiffRecord] = list(w["ring"]) if w else []
+        if since_seq is not None:
+            records = [r for r in records if r.seq > since_seq]
+        if last is not None and last > 0:
+            # last=0 = untrimmed (the follow reader's shape: the
+            # since-seq cursor already bounds the window)
+            records = records[-last:]
+        out["flows"] = [r.to_dict() for r in records]
+        out["matched"] = len(records)
+        out["last_seq"] = records[-1].seq if records else (
+            (self._window or {}).get("next_seq", 1) - 1
+            if self._window
+            else 0
+        )
+        return out

@@ -1,0 +1,19 @@
+"""tools/policydiff.py as a tier-1 test: the shadow-rollout
+lifecycle smoke — arm → traffic → on-device diff == host oracle diff
+→ churn closes the window stale → promote zeroes the counters and
+the promoted world diffs to zero against itself."""
+
+import json
+
+
+def test_policydiff_smoke_tool(capsys):
+    from tools.policydiff import main
+
+    assert main(["--flows", "384", "--seed", "11"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    got = json.loads(out)
+    assert got["smoke"] == "ok"
+    assert got["sampled"] == 384
+    assert got["diff_records"] > 0
+    assert got["stale_fired"] and got["promoted"]
+    assert got["post_promote_diff_zero"]

@@ -59,6 +59,12 @@ def test_policyfuzz_smoke():
     # distribution + observability coverage
     assert summary["zipf_steps"] >= 1
     assert summary["flow_record_checks"] == summary["steps"]
+    # shadow rollout coverage: an armed window's sampled diff
+    # checked bit-exact against the host oracle's two-world diff,
+    # and disarm-on-stale fired across the forced publish_full
+    assert summary["shadow_arms"] >= 2
+    assert summary["shadow_diff_checks"] >= 1
+    assert summary["shadow_stale_checks"] >= 1
     # the recorded program replays clean (same seed, same world,
     # byte-for-byte events) — the determinism the shrinker rests on
     assert len(program["events"]) == SMOKE_STEPS

@@ -260,7 +260,9 @@ def test_slo_deadline_forces_early_dispatch():
     goes out partially filled, and the early-dispatch counter
     moves."""
     d, make = _world()
-    early0 = metrics.serve_deadline_dispatch_total.get()
+    # the early-dispatch counter is labeled by the forcing flow's
+    # SLO class; an unclassed tenant lands under "default"
+    early0 = metrics.serve_deadline_dispatch_total.get("default")
     try:
         plane = d.serving_plane(batch_size=1 << 12, slo_ms=50.0)
         t0 = time.monotonic()
@@ -269,7 +271,10 @@ def test_slo_deadline_forces_early_dispatch():
         ).wait(timeout=30)
         wall = time.monotonic() - t0
         assert r.batches == 1
-        assert metrics.serve_deadline_dispatch_total.get() > early0
+        assert (
+            metrics.serve_deadline_dispatch_total.get("default")
+            > early0
+        )
         # served well before a full 4096-batch could ever have
         # filled (it never would), in deadline-ish time: generous
         # 60x headroom for this container's CPU

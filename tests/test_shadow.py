@@ -1,0 +1,678 @@
+"""Shadow policy rollout: dual-epoch evaluation + verdict-diff
+canarying (cilium_tpu.shadow).
+
+The acceptance surface of ISSUE 15:
+
+  * the sampled on-device verdict diff is bit-identical to the host
+    oracle's diff of the two policy worlds — all verdict columns,
+    uniform AND Zipf flows, single-chip AND routed tp2 with a chip
+    out — with exactly-once sample accounting;
+  * the dual-epoch seam: a shadow dispatch in flight across a
+    concurrent delta publish either completes against its pinned
+    stamps or refuses cleanly (no half-world diff), including the
+    donated-standby-slot and chip-out cases;
+  * stamp-guarded staleness: any publish that moves the live world
+    closes the window with an explicit `stale` status;
+  * the surface: POST /policy/shadow lifecycle, GET /policy/diff,
+    FlowFilter diff-status join, shadow spans;
+  * the SLO-class satellite: PATCH /config {"slo_classes": ...}
+    bundles deadline + shed priority + DRR weight, and the
+    serving_p99 reset seam.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.engine.hostpath import lattice_fold_host
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.native import encode_flow_records
+from cilium_tpu.replay import _ep_index_of
+from cilium_tpu.serve import build_demo_daemon, demo_record_maker
+from cilium_tpu.shadow import (
+    TRANS_ALLOW_TO_DENY,
+    TRANS_DENY_TO_ALLOW,
+    TRANS_NAMES,
+    TRANS_NONE,
+    diff_codes,
+)
+
+
+def _rule(port: str):
+    return {
+        "endpointSelector": {"matchLabels": {"app": "server"}},
+        "ingress": [
+            {
+                "fromEndpoints": [
+                    {"matchLabels": {"app": "client"}}
+                ],
+                "toPorts": [
+                    {
+                        "ports": [
+                            {"port": port, "protocol": "TCP"}
+                        ]
+                    }
+                ],
+            }
+        ],
+        "labels": ["serve-bench-rule"],
+    }
+
+
+LIVE_RULE = _rule("80")
+CANDIDATE = _rule("443")
+
+
+def _world():
+    d, client = build_demo_daemon()
+    return d, demo_record_maker(client.security_identity.id)
+
+
+def _zipf_records(make, rng, n):
+    """Rank-Zipf over a small tuple pool: repeated hot tuples, the
+    skewed shape the memo plane dedups."""
+    pool = make(rng, 32)
+    ranks = np.arange(1, 33, dtype=np.float64)
+    p = ranks ** -1.1
+    p /= p.sum()
+    pick = rng.choice(32, size=n, p=p)
+    return {k: v[pick] for k, v in pool.items()}
+
+
+def _oracle_diff(d, rec, shadow_states):
+    """The host oracle's diff of the two worlds for one record SoA."""
+    _, _, index, live_states = (
+        d.endpoint_manager.published_with_states()
+    )
+    ep_idx = _ep_index_of(rec, dict(index))
+    frag = rec["is_fragment"].astype(bool)
+
+    def fold(states):
+        return lattice_fold_host(
+            states, ep_idx, rec["identity"], rec["dport"],
+            rec["proto"], rec["direction"], is_fragment=frag,
+        )
+
+    lv, sv = fold(live_states), fold(shadow_states)
+    return lv, sv, diff_codes(
+        lv.allowed, lv.proxy_port, lv.match_kind,
+        sv.allowed, sv.proxy_port, sv.match_kind, xp=np,
+    )
+
+
+def _window(d):
+    out = d.shadow.diff(last=0)
+    assert out["state"] == "armed", out
+    return out["window"], out["flows"]
+
+
+def _check_diff_against_oracle(d, rec):
+    """Window counters + record multiset vs the host oracle's
+    two-world diff for `rec` (the only flows dispatched since arm)."""
+    with d.shadow._lock:
+        shadow_states = list(d.shadow._window["states"])
+    lv, sv, (ca, cp, ck, trans) = _oracle_diff(
+        d, rec, shadow_states
+    )
+    w, flows = _window(d)
+    n = len(rec["ep_id"])
+    assert w["sampled"] == n
+    assert w["refused"] == 0
+    assert w["changed"]["allowed"] == int(ca.sum())
+    assert w["changed"]["proxy_port"] == int(cp.sum())
+    assert w["changed"]["match_kind"] == int(ck.sum())
+    assert w["allow_to_deny"] == int(
+        (trans == TRANS_ALLOW_TO_DENY).sum()
+    )
+    assert w["deny_to_allow"] == int(
+        (trans == TRANS_DENY_TO_ALLOW).sum()
+    )
+    from collections import Counter
+
+    got = Counter(
+        (
+            f["ep_id"],
+            (
+                f["src_identity"]
+                if f["direction"] == "INGRESS"
+                else f["dst_identity"]
+            ),
+            f["dport"],
+            f["transition"],
+            f["live_allowed"],
+            f["shadow_allowed"],
+        )
+        for f in flows
+    )
+    want = Counter(
+        (
+            int(rec["ep_id"][i]),
+            int(rec["identity"][i]),
+            int(rec["dport"][i]),
+            TRANS_NAMES[int(trans[i])],
+            bool(lv.allowed[i]),
+            bool(sv.allowed[i]),
+        )
+        for i in range(n)
+        if int(trans[i]) != TRANS_NONE
+    )
+    assert got == want
+
+
+def test_candidate_diff_bit_identical_uniform_and_zipf():
+    """The tentpole gate, single-chip: arm a restricting candidate,
+    dispatch uniform then Zipf flows, and the sampled on-device diff
+    must equal the host oracle's diff of the two worlds bit-exactly
+    — counters, transition split, and per-record multiset."""
+    d, make = _world()
+    rng = np.random.default_rng(11)
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    for shape in ("uniform", "zipf"):
+        rec = (
+            make(rng, 384)
+            if shape == "uniform"
+            else _zipf_records(make, rng, 384)
+        )
+        d.process_flows(encode_flow_records(**rec), batch_size=128)
+        _check_diff_against_oracle(d, rec)
+        # fresh window per distribution so each check is exact
+        d.shadow.disarm()
+        d.shadow.arm(
+            rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+        )
+    # shadow spans reached the tracer (shadow cost is traceable)
+    spans = d.tracer.query(site="shadow.dispatch", last=16)
+    assert spans, "no shadow.dispatch spans recorded"
+
+
+def test_identical_candidate_zero_diff_exactly_once():
+    """A candidate identical to the live world diffs to ZERO on
+    every column, and sample accounting is exactly-once across
+    multiple batches (sampled == flows dispatched, refused == 0)."""
+    d, make = _world()
+    rng = np.random.default_rng(3)
+    d.shadow.arm(
+        rules_json=json.dumps([LIVE_RULE]), sample_rate=1.0
+    )
+    total = 0
+    for _ in range(3):
+        rec = make(rng, 256)
+        d.process_flows(encode_flow_records(**rec), batch_size=64)
+        total += 256
+    w, flows = _window(d)
+    assert w["sampled"] == total
+    assert w["refused"] == 0
+    assert w["changed"] == {
+        "allowed": 0, "proxy_port": 0, "match_kind": 0,
+    }
+    assert not flows
+
+
+def test_sample_rate_partial_accounting():
+    """sample_rate < 1: whole batches sample or don't; the window's
+    sampled count is the sum of the sampled batches' valid flows and
+    nothing is double-counted."""
+    d, make = _world()
+    rng = np.random.default_rng(9)
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]),
+        sample_rate=0.5,
+        seed=21,
+    )
+    rec = make(rng, 512)
+    d.process_flows(encode_flow_records(**rec), batch_size=64)
+    w, _ = _window(d)
+    assert 0 < w["sampled"] < 512
+    assert w["sampled"] % 64 == 0
+    assert w["sampled"] == 64 * w["sampled_batches"]
+    assert w["refused"] == 0
+
+
+def test_stale_close_on_publish_and_rearm():
+    """Any publish that moves the live world closes the window with
+    an explicit stale status; sampling stops; re-arming opens a
+    fresh window against the new world."""
+    d, make = _world()
+    rng = np.random.default_rng(5)
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    rec = make(rng, 128)
+    d.process_flows(encode_flow_records(**rec), batch_size=128)
+    sampled0 = d.shadow.diff()["window"]["sampled"]
+    stale0 = metrics.policy_diff_stale_total.get()
+    d.regenerate_all("churn")  # a fresh publish: the stamp moves
+    assert d.shadow.status()["state"] == "stale"
+    assert metrics.policy_diff_stale_total.get() == stale0 + 1
+    # a closed window folds nothing
+    d.process_flows(encode_flow_records(**rec), batch_size=128)
+    st = d.shadow.status()
+    assert st["state"] == "stale"
+    assert st["last_window"]["sampled"] == sampled0
+    assert st["last_window"]["closed"] == "stale"
+    # re-arm works against the new world
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    d.process_flows(encode_flow_records(**rec), batch_size=128)
+    assert d.shadow.diff()["window"]["sampled"] == 128
+
+
+def test_inflight_sample_across_publish_completes_or_refuses():
+    """The dual-epoch seam: a shadow dispatch in flight across a
+    concurrent publish either completes against its pinned stamps
+    (window still open at fold) or refuses cleanly (window closed
+    first) — never a half-world diff."""
+    from cilium_tpu.engine.verdict import TupleBatch
+
+    d, make = _world()
+    rng = np.random.default_rng(7)
+    rec = make(rng, 64)
+    _, tables, index, _ = (
+        d.endpoint_manager.published_with_states()
+    )
+    ep_idx = _ep_index_of(rec, dict(index))
+    batch = TupleBatch.from_numpy(
+        ep_index=ep_idx,
+        identity=rec["identity"],
+        dport=rec["dport"].astype(np.int32),
+        proto=rec["proto"].astype(np.int32),
+        direction=rec["direction"].astype(np.int32),
+        is_fragment=rec["is_fragment"].astype(bool),
+    )
+    from cilium_tpu.engine.verdict import evaluate_batch
+
+    live_out = evaluate_batch(tables, batch)
+
+    def fold(ticket, scols):
+        dirs = rec["direction"]
+        peer = rec["identity"].astype(np.int64)
+        return d.shadow.fold(
+            ticket, live_out, scols, 64,
+            ep_ids=rec["ep_id"],
+            src_identities=peer,
+            dst_identities=peer,
+            dports=rec["dport"],
+            protos=rec["proto"],
+            directions=dirs,
+        )
+
+    # case A: publish lands BETWEEN dispatch and fold, window not
+    # yet closed — the sample completes against its pinned stamps
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    ticket = d.shadow.sample_ticket(tables)
+    assert ticket is not None
+    scols = d.shadow.evaluate(ticket, batch, live_out)
+    assert scols is not None
+    d.regenerate_all("concurrent publish")  # stamps moved
+    trans = fold(ticket, scols)
+    assert trans is not None  # completed against pinned stamps
+    assert d.shadow._window["sampled"] == 64
+    # the window closes stale at the next stamp check
+    assert d.shadow.status()["state"] == "stale"
+
+    # case B: the window CLOSES while the sample is in flight — the
+    # fold refuses cleanly, exactly once
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    _, tables2, _, _ = d.endpoint_manager.published_with_states()
+    ticket = d.shadow.sample_ticket(tables2)
+    assert ticket is not None
+    scols = d.shadow.evaluate(ticket, batch, live_out)
+    d.regenerate_all("concurrent publish 2")
+    refused0 = metrics.policy_diff_refused_total.get()
+    assert d.shadow.status()["state"] == "stale"  # closes window
+    assert fold(ticket, scols) is None
+    assert metrics.policy_diff_refused_total.get() == refused0 + 1
+    # double fold of a done ticket stays refused-once
+    assert fold(ticket, scols) is None
+    assert metrics.policy_diff_refused_total.get() == refused0 + 1
+
+
+def test_standby_arm_and_donated_slot():
+    """Standby mode: the shadow world is the PREVIOUS publish; a
+    further delta publish (which donates the manager store's standby
+    epoch buffers) closes the window stale without ever dispatching
+    a donated buffer — the plane owns its device copy."""
+    d, make = _world()
+    rng = np.random.default_rng(13)
+    rec = make(rng, 256)
+    # create a previous world: live allows 443 after the change
+    d.policy_add(
+        __import__("cilium_tpu.policy.api", fromlist=["x"])
+        .rules_from_json(json.dumps([CANDIDATE])),
+        replace=True,
+    )
+    d.regenerate_all("cutover")
+    # publish the device epoch so the standby slot is primed
+    d.process_flows(encode_flow_records(**rec), batch_size=256)
+    st = d.shadow.arm(sample_rate=1.0)  # standby: previous world
+    assert st["window"]["mode"] == "standby"
+    d.process_flows(encode_flow_records(**rec), batch_size=256)
+    _check_diff_against_oracle(d, rec)
+    w, _ = _window(d)
+    # the cutover moved 80-allow -> 443-allow: both transitions show
+    assert w["allow_to_deny"] > 0 or w["deny_to_allow"] > 0
+    # standby windows have nothing to promote
+    with pytest.raises(RuntimeError):
+        d.shadow.promote()
+    # a further publish donates the manager standby slot AND moves
+    # the live stamp: the window closes stale, dispatch never
+    # touches donated buffers
+    d.regenerate_all("post-arm publish")
+    d.process_flows(encode_flow_records(**rec), batch_size=256)
+    assert d.shadow.status()["state"] == "stale"
+
+
+def test_promote_installs_candidate_and_zeroes_counters():
+    """arm -> traffic -> promote: the candidate becomes the live
+    policy through the normal path, the window counters freeze into
+    the promoted summary, and a re-armed identical candidate diffs
+    to zero."""
+    d, make = _world()
+    rng = np.random.default_rng(17)
+    rec = make(rng, 128)
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    d.process_flows(encode_flow_records(**rec), batch_size=128)
+    assert d.shadow.diff()["window"]["sampled"] == 128
+    out = d.shadow.promote()
+    assert out["promoted"]["closed"] == "promoted"
+    assert out["promoted"]["promoted_revision"] > 0
+    d.regenerate_all("promote")
+    # the promoted world IS the candidate: identical re-arm, zero diff
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    w0 = d.shadow.diff()["window"]
+    assert w0["sampled"] == 0  # counters zeroed with the new window
+    d.process_flows(encode_flow_records(**rec), batch_size=128)
+    w, _ = _window(d)
+    assert w["changed"] == {
+        "allowed": 0, "proxy_port": 0, "match_kind": 0,
+    }
+
+
+def test_routed_tp2_chip_out_diff_bit_identical():
+    """The routed path: shadow gathers ride the failover evaluators
+    over the re-split batch — bit-identical to the host oracle's
+    two-world diff healthy AND with a chip out (replica gathers
+    serving the dead primary's rows for BOTH worlds)."""
+    import jax
+
+    from cilium_tpu import faultinject
+    from cilium_tpu.engine.failover import ChipFailoverRouter
+    from cilium_tpu.resilience import ChipBreakerBank
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    d, make = _world()
+    rng = np.random.default_rng(19)
+    tp = 2
+    dp = len(devs) // tp
+    mesh = jax.sharding.Mesh(
+        np.array(devs).reshape(dp, tp), ("batch", "table")
+    )
+    _, htables, index, host_states = (
+        d.endpoint_manager.published_with_states()
+    )
+
+    def host_fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            host_states, ep, ident, dport, proto, dirn,
+            is_fragment=frag,
+        )
+
+    router = ChipFailoverRouter(
+        mesh, htables,
+        bank=ChipBreakerBank(
+            recovery_timeout=0.05, failure_threshold=1
+        ),
+        host_fold=host_fold,
+    )
+    router.publish(htables)
+    router.publish(htables)
+    d.attach_mesh_router(router)
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    rec = make(rng, 256)
+    # healthy
+    d.process_flows(encode_flow_records(**rec), batch_size=256)
+    _check_diff_against_oracle(d, rec)
+    # chip out: kill one ordinal, dispatch the SAME flows — the
+    # window's counters double exactly (same diff, replica-served)
+    w0 = dict(d.shadow.diff()["window"])
+    victim = int(router.ordinals[dp - 1, tp - 1])
+    faultinject.arm("engine.dispatch", f"raise:chip={victim}")
+    try:
+        d.process_flows(encode_flow_records(**rec), batch_size=256)
+    finally:
+        faultinject.disarm("engine.dispatch")
+    w, _ = _window(d)
+    assert w["sampled"] == 2 * w0["sampled"]
+    assert w["refused"] == 0
+    for col in ("allowed", "proxy_port", "match_kind"):
+        assert w["changed"][col] == 2 * w0["changed"][col]
+    assert w["allow_to_deny"] == 2 * w0["allow_to_deny"]
+    assert w["deny_to_allow"] == 2 * w0["deny_to_allow"]
+    assert router.stats.replica_hits > 0
+
+
+def test_serve_plane_shadow_and_flow_diff_join():
+    """Streamed submissions sample too, and re-verdicted flows are
+    queryable through the flow plane: FlowFilter diff-status joins
+    records to the armed window."""
+    from cilium_tpu.flow import FlowFilter
+
+    d, make = _world()
+    rng = np.random.default_rng(23)
+    d.shadow.arm(
+        rules_json=json.dumps([CANDIDATE]), sample_rate=1.0
+    )
+    rec = make(rng, 192)
+    try:
+        plane = d.serving_plane(batch_size=64, slo_ms=50.0)
+        rs = [
+            plane.submit(
+                rec={k: v[i : i + 48] for k, v in rec.items()},
+                tenant="canary",
+            )
+            for i in range(0, 192, 48)
+        ]
+        for r in rs:
+            r.wait(timeout=60)
+    finally:
+        if d.serving is not None:
+            d.serving.stop()
+            d.serving = None
+    _check_diff_against_oracle(d, rec)
+    # the flow-plane join: records carry diff_status; the filter
+    # param selects exactly the re-verdicted ones
+    w, _ = _window(d)
+    n_changed = sum(
+        1
+        for r in d.flow_store.snapshot()
+        if r.diff_status
+    )
+    # allows are head-sampled by default aggregation; drops are
+    # always captured — at minimum every allow->deny transition's
+    # record is queryable
+    flt = FlowFilter.from_params({"diff-status": "any"})
+    got = [r for r in d.flow_store.snapshot() if flt.matches(r)]
+    assert len(got) == n_changed
+    a2d = [
+        r
+        for r in d.flow_store.snapshot()
+        if FlowFilter.from_params(
+            {"diff-status": "allow-to-deny"}
+        ).matches(r)
+    ]
+    assert len(a2d) == w["allow_to_deny"]
+    for r in a2d:
+        assert r.verdict == "FORWARDED"  # live allows; shadow denies
+
+
+def test_rest_lifecycle_and_diff_route():
+    """POST /policy/shadow + GET /policy/diff over the DaemonAPI
+    contract: arm (candidate), diff with cursor, promote, bad
+    action."""
+    from cilium_tpu.api.server import DaemonAPI
+
+    d, make = _world()
+    api = DaemonAPI(d)
+    rng = np.random.default_rng(29)
+    st = api.policy_shadow(
+        {
+            "action": "arm",
+            "rules": [CANDIDATE],
+            "sample_rate": 1.0,
+        }
+    )
+    assert st["state"] == "armed"
+    rec = make(rng, 128)
+    api.process_flows(encode_flow_records(**rec))
+    out = api.policy_diff({"last": "8"})
+    assert out["state"] == "armed"
+    assert out["window"]["sampled"] == 128
+    assert out["matched"] <= 8
+    # cursor: a second read past last_seq returns nothing new
+    again = api.policy_diff(
+        {"since-seq": str(out["last_seq"]), "last": "0"}
+    )
+    assert again["matched"] == 0
+    with pytest.raises(ValueError):
+        api.policy_shadow({"action": "bogus"})
+    with pytest.raises(ValueError):
+        api.policy_diff({"nope": "1"})
+    got = api.policy_shadow({"action": "promote"})
+    assert got["promoted"]["promoted_revision"] > 0
+    assert api.policy_diff({})["state"] == "disarmed"
+
+
+def test_slo_classes_config_validation_and_live_apply():
+    """PATCH /config {"slo_classes": ...} bundles deadline + shed
+    priority + DRR weight; tenant_slo assigns; both validate up
+    front and live-apply to the running plane."""
+    d, make = _world()
+    with pytest.raises(ValueError):
+        d.config_patch(
+            {"slo_classes": {"gold": {"deadline_ms": -1}}}
+        )
+    with pytest.raises(ValueError):
+        d.config_patch(
+            {"slo_classes": {"gold": {"bogus_key": 1}}}
+        )
+    with pytest.raises(ValueError):
+        d.config_patch({"tenant_slo": {"t1": "missing-class"}})
+    out = d.config_patch(
+        {
+            "slo_classes": {
+                "gold": {
+                    "deadline_ms": 10.0,
+                    "shed_priority": 0,
+                    "weight": 4.0,
+                },
+                "bulk": {
+                    "deadline_ms": 200.0,
+                    "shed_priority": 5,
+                    "weight": 1.0,
+                },
+            },
+            "tenant_slo": {"pay": "gold", "batch": "bulk"},
+        }
+    )
+    assert out["slo_classes"]["gold"]["weight"] == 4.0
+    assert out["tenant_slo"] == {"pay": "gold", "batch": "bulk"}
+    try:
+        plane = d.serving_plane(batch_size=64, slo_ms=50.0)
+        r = plane.submit(
+            rec=make(np.random.default_rng(2), 16), tenant="pay"
+        ).wait(timeout=30)
+        assert not r.shed
+        snap = plane.snapshot()
+        assert snap["tenants"]["pay"]["slo_class"] == "gold"
+        assert snap["tenants"]["pay"]["weight"] == 4.0
+        # deleting the class falls the tenant back to defaults
+        d.config_patch(
+            {
+                "slo_classes": {"gold": None},
+                "tenant_slo": {"pay": None},
+            }
+        )
+        assert plane.snapshot()["tenants"]["pay"]["weight"] == 1.0
+    finally:
+        if d.serving is not None:
+            d.serving.stop()
+            d.serving = None
+
+
+def test_slo_shed_priority_orders_gate_sheds():
+    """Under AdmissionGate pressure the HIGHER shed-priority class
+    sheds first: a contended plan keeps the gold tenant's flows and
+    sheds the bulk tenant's, with exactly-once Overload accounting."""
+    from cilium_tpu.resilience import AdmissionGate
+    from cilium_tpu.serve import ServingPlane
+
+    d, make = _world()
+    d.config_patch(
+        {
+            "slo_classes": {
+                "gold": {"shed_priority": 0},
+                "bulk": {"shed_priority": 5},
+            },
+            "tenant_slo": {"pay": "gold", "batch": "bulk"},
+        }
+    )
+    plane = ServingPlane(
+        d,
+        batch_size=128,
+        slo_ms=50.0,
+        slo_classes=dict(d.slo_classes),
+        tenant_slo=dict(d.tenant_slo),
+    )  # never started: the plan/stage path is driven by hand
+    rng = np.random.default_rng(31)
+    plane.submit(rec=make(rng, 64), tenant="pay")
+    plane.submit(rec=make(rng, 64), tenant="batch")
+    with plane._cond:
+        spans, mix = plane._compose_locked()
+    assert sum(e - s for _sub, s, e in spans) == 128
+    d.admission = AdmissionGate(limit=64)
+    shed0 = d.admission.shed_total
+    meta = plane._stage(spans, mix, False, None)
+    assert meta is not None
+    assert meta["valid"] == 64
+    assert set(meta["tenants"]) == {"pay"}
+    # the bulk tenant's whole span shed, exactly once
+    assert d.admission.shed_total == shed0 + 64
+    assert metrics.serve_shed_flows_total.get("batch") >= 64
+    d.admission.release(meta["valid"])
+
+
+def test_serving_p99_reset_seam():
+    """The rolling serving_p99_ms window resets through the same
+    seam as /debug/profile?reset=1, so bench segments don't bleed."""
+    d, make = _world()
+    try:
+        plane = d.serving_plane(batch_size=64, slo_ms=25.0)
+        plane.submit(
+            rec=make(np.random.default_rng(4), 64),
+            tenant="default",
+        ).wait(timeout=30)
+        assert plane.snapshot()["serving_p99_ms"] > 0.0
+        d.reset_profile()  # the /debug/profile?reset=1 seam
+        assert plane.snapshot()["serving_p99_ms"] == 0.0
+        assert metrics.serving_p99_ms.get() == 0.0
+    finally:
+        if d.serving is not None:
+            d.serving.stop()
+            d.serving = None

@@ -152,6 +152,33 @@ def main() -> None:
     n_classes = len(
         getattr(tables.ipcache, "range_class_plens", ()) or ()
     )
+    # shadow second-gather model (the verdict-diff canary plane):
+    # a sampled batch re-runs ONLY the lattice gathers against the
+    # shadow epoch — the staged batch, the H2D upload, CT/ipcache/LB
+    # gathers and every fold are shared with the live dispatch.  At
+    # the default 0.1 sample rate the amortized extra bytes must
+    # stay under 5% of the hot total (the bench's
+    # shadow_eval_overhead_pct gate, priced deterministically here).
+    lattice_hot = sum(
+        r["bytes_per_tuple"]
+        for r in rows_s
+        if r["stage"] == "lattice" and r["plane"] == "hot"
+    )
+    shadow_rate = 0.1
+    shadow_bytes = shadow_rate * lattice_hot
+    shadow_pct = 100.0 * shadow_bytes / max(hot_s, 1e-9)
+    print(
+        f"shadow second-gather model: {lattice_hot:.0f} B/tuple "
+        f"lattice gathers x rate {shadow_rate} = "
+        f"{shadow_bytes:.1f} B/tuple amortized "
+        f"({shadow_pct:.1f}% of the {hot_s:.0f} B hot total)"
+    )
+    assert shadow_pct < 5.0, (
+        f"shadow eval at rate {shadow_rate} would add "
+        f"{shadow_pct:.1f}% gathered bytes — over the 5% canary "
+        f"budget"
+    )
+
     print("sharded fused-datapath collective model:")
     for ns in (1, 4, 8):
         aa = pt.datapath_alltoall_bytes_per_tuple(
