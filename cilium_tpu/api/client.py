@@ -260,3 +260,12 @@ class APIClient:
     def debug_profile(self, reset: bool = False):
         path = "/debug/profile" + ("?reset=1" if reset else "")
         return self._request("GET", path)
+
+    def debug_perf(self, params: dict = None):
+        """GET /debug/perf — the live performance plane snapshot
+        (params: since=<retune cursor>, leaves=1)."""
+        from urllib.parse import urlencode
+
+        qs = urlencode(dict(params or {}))
+        path = f"/debug/perf?{qs}" if qs else "/debug/perf"
+        return self._request("GET", path)

@@ -208,6 +208,23 @@ class DaemonAPI:
             reply["reset"] = True
         return reply
 
+    def debug_perf(self, params: dict) -> dict:
+        """GET /debug/perf: the live performance plane — per-batch
+        phase windows (p50/p99/max), batch fill, queue delay, the
+        ingest-stall ledger, per-tenant SLO-class compliance, the
+        live gather-byte model against the published layout stamp,
+        dispatch-overlap bookkeeping, per-chip HBM and the retune
+        history.
+
+        Params: since=<cursor> (only retune records newer than the
+        cursor — pollers resume where they left off), leaves=1 (the
+        per-leaf byte-model breakdown rides along)."""
+        since = params.get("since")
+        return self.daemon.perf_snapshot(
+            since=None if since is None else int(since),
+            leaves=params.get("leaves") in ("1", "true"),
+        )
+
     def traces_get(self, params: dict) -> dict:
         """GET /debug/traces: the span-plane query surface.
 
@@ -1033,6 +1050,17 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/debug/profile":
                 reset = "reset=1" in (self.path.partition("?")[2] or "")
                 return self._reply(200, api.debug_profile(reset=reset))
+            if path == "/debug/perf":
+                from urllib.parse import parse_qs
+
+                qs = parse_qs(self.path.partition("?")[2])
+                params = {k: v[0] for k, v in qs.items()}
+                try:
+                    return self._reply(200, api.debug_perf(params))
+                except ValueError as exc:
+                    return self._reply(
+                        400, {"error": f"bad request: {exc}"}
+                    )
             if path == "/debug/traces":
                 from urllib.parse import parse_qs
 

@@ -135,6 +135,16 @@ def collect(daemon, out_dir: str) -> str:
                 "sample_rate": daemon_tracer.sample_rate,
             },
         )
+    # the live performance plane (the same /debug/perf document
+    # `cilium-tpu top --once -o json` prints): phase windows, stall
+    # + SLO ledgers, the live byte model and the retune history —
+    # beside metrics.prom/traces.json so a bundle carries the
+    # perf-plane state of the incident, not just the counters
+    if hasattr(daemon, "perf_snapshot"):
+        try:
+            write("perf.json", daemon.perf_snapshot(leaves=True))
+        except Exception:  # pragma: no cover — defensive
+            pass
     # the /metrics/prometheus text snapshot (same exposition a live
     # scrape sees — label sets join against traces.json/flows.json)
     with open(os.path.join(root, "metrics.prom"), "w") as f:

@@ -19,6 +19,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 SOCK_ENV = "CILIUM_TPU_SOCK"
 
@@ -548,6 +549,42 @@ def cmd_serve_bench(api, args) -> int:
     return 0
 
 
+def cmd_top(api, args) -> int:
+    """`cilium-tpu top` — the live performance-plane view: phase
+    breakdown (p50/p99/max), batch fill, queue delay, ingest-stall
+    fraction, per-tenant SLO error-budget burn, the modeled
+    gather-bytes line and the last re-tune.  Refreshes in place
+    every --interval seconds until interrupted; `--once` prints a
+    single frame, and `--once -o json` emits the raw /debug/perf
+    snapshot (the same document bugtool archives as perf.json)."""
+    from cilium_tpu.perfplane import render_top
+
+    params = {}
+    if args.leaves:
+        params["leaves"] = "1"
+
+    def frame():
+        return api.debug_perf(params)
+
+    if args.once:
+        snap = frame()
+        if args.output == "json":
+            print(json.dumps(snap, indent=2))
+        else:
+            print(render_top(snap))
+        return 0
+    try:
+        while True:
+            snap = frame()
+            # clear + home, then one frame — the classic top(1)
+            # in-place refresh
+            sys.stdout.write("\x1b[2J\x1b[H" + render_top(snap) + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_status(api, args) -> int:
     print(json.dumps(api.status(), indent=2))
     return 0
@@ -795,6 +832,24 @@ def make_parser() -> argparse.ArgumentParser:
                         "dispatches early to protect")
     sbench.add_argument("--seed", type=int, default=7)
     sbench.set_defaults(func=cmd_serve_bench)
+
+    top = sub.add_parser(
+        "top",
+        help="live performance plane: phase breakdown, batch fill, "
+        "SLO burn, stall fraction, modeled gather bytes "
+        "(GET /debug/perf, refreshed in place)",
+    )
+    top.add_argument("--once", action="store_true",
+                     help="print one frame and exit")
+    top.add_argument("-o", "--output", choices=("text", "json"),
+                     default="text",
+                     help="--once output format (json = the raw "
+                     "/debug/perf snapshot)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="refresh period in seconds")
+    top.add_argument("--leaves", action="store_true",
+                     help="include the per-leaf byte-model rows")
+    top.set_defaults(func=cmd_top)
 
     status = sub.add_parser("status")
     status.set_defaults(func=cmd_status)

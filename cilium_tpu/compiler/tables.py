@@ -976,6 +976,28 @@ class FleetCompiler:
         self._compile_lock = threading.Lock()
         self._reset()
 
+    def set_hash_lanes(self, lanes: int) -> None:
+        """Online pack-width change (the autotuner's re-tune knob,
+        applied WITHOUT a compiler reset): swap in a fresh
+        IncrementalHashPair at the new width.  The fresh pair's
+        empty row state forces build()'s full-rebuild branch on the
+        next compile, so the produced tables carry a different
+        layout stamp (tables_layout_version folds the lane counts)
+        — the device store's layout guard then refuses the delta,
+        full-uploads, and deltas resume on the publishes after.
+        Everything else (identity universe, slot space, cached
+        endpoint rows, the generation counter) is lane-agnostic and
+        survives, so verdicts are identical by construction."""
+        from cilium_tpu.compiler.delta import IncrementalHashPair
+
+        with self._compile_lock:
+            if int(lanes) == self.hash_lanes:
+                return
+            self.hash_lanes = int(lanes)
+            self._hash_pair = IncrementalHashPair(
+                lanes=self.hash_lanes
+            )
+
     def _reset(self) -> None:
         from cilium_tpu.compiler.delta import IncrementalHashPair
 

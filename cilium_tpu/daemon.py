@@ -287,6 +287,26 @@ class Daemon:
         from cilium_tpu.shadow import ShadowPlane
 
         self.shadow = ShadowPlane(self)
+        # live performance plane (cilium_tpu.perfplane): always-on
+        # per-batch phase windows, ingest-stall detector, SLO-class
+        # compliance ledger and the retune history behind GET
+        # /debug/perf and `cilium-tpu top`.  The serving plane feeds
+        # it from the overlap bookkeeping it already keeps.
+        from cilium_tpu.perfplane import PerfPlane
+
+        self.perf = PerfPlane()
+        # online re-tune (engine.autotune.online_retune): the serving
+        # loop polls maybe_online_retune() every 64 batches; off by
+        # default so steady-state daemons never swap layouts behind
+        # the operator's back.  PATCH /config {"online_retune": true}
+        # arms it; config overrides the hysteresis bounds.
+        self.online_retune_enabled = False
+        self.online_retune_config: Dict = {}
+        self._retune_inflight = threading.Lock()
+        # fused-tables byte model cache for perf_snapshot: keyed by
+        # (generation, layout) so the gatherprof walk runs once per
+        # publish, not per /debug/perf poll
+        self._perf_model_cache = None
         # per-tenant named SLO classes (serving tier 2): name ->
         # {"deadline_ms", "shed_priority", "weight"} bundles and the
         # tenant -> class assignment, both live via PATCH /config
@@ -560,6 +580,11 @@ class Daemon:
         # ServingPlane.reset_window)
         if self.serving is not None:
             self.serving.reset_window()
+        else:
+            # no plane → reset_window can't do it for us: clear the
+            # perf plane's phase/fill/stall windows directly so
+            # /debug/perf experiments get the same seam
+            self.perf.reset()
 
     def _regenerate_for_reasons(self, reasons: List[str]) -> None:
         self.regenerate_all(", ".join(reasons) or "trigger")
@@ -1674,6 +1699,14 @@ class Daemon:
                     "verdict_cache must be a boolean, got "
                     f"{verdict_cache!r}"
                 )
+            online_retune = changes.get("online_retune")
+            if online_retune is not None and not isinstance(
+                online_retune, bool
+            ):
+                raise ValueError(
+                    "online_retune must be a boolean, got "
+                    f"{online_retune!r}"
+                )
             # serving-plane tenant fairness weights ({"tenant_
             # weights": {name: weight}}): validated up front like
             # the options; weight must be a positive number
@@ -1807,6 +1840,14 @@ class Daemon:
                 if not verdict_cache:
                     self.verdict_cache = None
                 vc_applied = 1
+            # online re-tune arming: verdict-neutral (the swap
+            # itself is bit-identical by the layout-stamp seam)
+            if (
+                online_retune is not None
+                and online_retune != self.online_retune_enabled
+            ):
+                self.online_retune_enabled = online_retune
+                vc_applied += 1
             # fairness weights apply immediately to the live plane
             # (verdict-neutral — no regeneration)
             tw_applied = 0
@@ -1869,6 +1910,7 @@ class Daemon:
             "options": dict(option.Config.opts),
             "faults": faultinject.armed(),
             "verdict_cache": self.verdict_cache_enabled,
+            "online_retune": self.online_retune_enabled,
             "tenant_weights": dict(self.tenant_weights),
             "slo_classes": dict(self.slo_classes),
             "tenant_slo": dict(self.tenant_slo),
@@ -2165,6 +2207,132 @@ class Daemon:
                 )
                 self.serving.start()
             return self.serving
+
+    def maybe_online_retune(self) -> "Optional[dict]":
+        """The serving loop's retune poll (every 64 completed
+        batches): delegate to engine.autotune.online_retune when the
+        operator armed it, never concurrently, and never let a
+        controller fault take down the serve loop — a missed retune
+        is a performance bug, a dead plane is an outage."""
+        if not self.online_retune_enabled:
+            return None
+        if not self._retune_inflight.acquire(blocking=False):
+            return None  # one controller at a time
+        try:
+            from cilium_tpu.engine.autotune import online_retune
+
+            return online_retune(
+                self, config=self.online_retune_config
+            )
+        except Exception:
+            log.exception("online retune failed (serve loop kept)")
+            return None
+        finally:
+            self._retune_inflight.release()
+
+    def _perf_byte_model(self, leaves: bool = False) -> Dict:
+        """The gatherprof/autotune byte model evaluated LIVE: the
+        published layout stamp's hot/cold bytes-per-tuple, shrunk by
+        the OBSERVED verdict-cache dedup/hit factors, and priced
+        into a modeled GB/s gauge at the perf plane's measured
+        verdicts/s EWMA.  The per-leaf breakdown rides along on
+        demand (`leaves=True` ≙ /debug/perf?leaves=1).  The static
+        walk is cached per (generation, layout)."""
+        from cilium_tpu.compiler.tables import tables_layout_version
+        from cilium_tpu.engine import autotune
+
+        gen, pol, _ = self.endpoint_manager.published()
+        if pol is None:
+            return {"published": False}
+        layout = tables_layout_version(pol)
+        cached = self._perf_model_cache
+        if cached is None or cached[0] != (gen, layout):
+            try:
+                dt = self.datapath_tables(policy=pol)
+            except Exception:
+                return {"published": False}
+            profile = autotune.hot_gather_profile(dt)
+            hot = sum(
+                r["bytes_per_tuple"] for r in profile
+                if r["plane"] == "hot"
+            )
+            cold = sum(
+                r["bytes_per_tuple"] for r in profile
+                if r["plane"] == "cold"
+            )
+            cached = ((gen, layout), hot, cold, profile)
+            self._perf_model_cache = cached
+        _, hot, cold, profile = cached
+        hits = metrics.verdict_cache_hits_total.get()
+        misses = metrics.verdict_cache_misses_total.get()
+        inserts = metrics.verdict_cache_insertions_total.get()
+        lookups = hits + misses
+        hit_rate = hits / lookups if lookups else 0.0
+        # observed intra-batch dedup on the missed population:
+        # tuples evaluated per representative inserted
+        dedup = misses / inserts if inserts else 1.0
+        effective = (
+            hot / max(dedup, 1.0) * (1.0 - hit_rate)
+            if lookups
+            else hot
+        )
+        vps = self.perf.verdicts_per_sec()
+        model = {
+            "published": True,
+            "generation": gen,
+            "layout_stamp": layout,
+            "hot_bytes_per_tuple": hot,
+            "cold_bytes_per_tuple": cold,
+            "effective_bytes_per_tuple": effective,
+            "cache_hit_rate": hit_rate,
+            "dedup_factor": max(dedup, 1.0),
+            "modeled_gbps": effective * vps / 1e9,
+        }
+        metrics.perf_model_bytes_per_tuple.set("hot", value=hot)
+        metrics.perf_model_bytes_per_tuple.set("cold", value=cold)
+        metrics.perf_model_bytes_per_tuple.set(
+            "effective", value=effective
+        )
+        metrics.perf_model_gbps.set(value=model["modeled_gbps"])
+        if leaves:
+            model["leaves"] = profile
+        return model
+
+    def perf_snapshot(
+        self, since: "Optional[int]" = None, leaves: bool = False
+    ) -> Dict:
+        """GET /debug/perf — the live performance plane in one
+        document: phase windows + stall/SLO ledger (PerfPlane
+        .snapshot, since-cursor honored), the serving plane's own
+        snapshot, the live byte model, dispatch-overlap bookkeeping
+        and per-chip HBM via the store's chip_bytes seam.  Also the
+        payload behind `cilium-tpu top` and bugtool's perf.json."""
+        snap = self.perf.snapshot(since=since)
+        snap["byte_model"] = self._perf_byte_model(leaves=leaves)
+        plane = self.serving
+        if plane is not None:
+            snap["serving"] = plane.snapshot()
+            d = getattr(plane, "_dispatcher", None)
+            if d is not None:
+                snap["overlap"] = {
+                    "pack_s": d.pack_s,
+                    "block_s": d.block_s,
+                    "wall_s": d.wall_s,
+                    "submitted": d.submitted,
+                    "failed": d.failed,
+                }
+        store = self.endpoint_manager._device_store
+        if store is not None:
+            try:
+                snap["hbm"] = {
+                    "chip_bytes": {
+                        str(k): int(v)
+                        for k, v in (store.chip_bytes() or {}).items()
+                    }
+                }
+            except Exception:  # pragma: no cover — defensive
+                pass
+        return snap
 
     def process_flows(
         self,

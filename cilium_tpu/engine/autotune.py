@@ -398,6 +398,266 @@ def memo_candidates(
     return cands
 
 
+# ---------------------------------------------------------------------------
+# Online re-tune: the telemetry-driven layout loop (perf plane consumer)
+# ---------------------------------------------------------------------------
+
+
+# hysteresis bounds: drift must exceed these before a re-tune fires,
+# and the cooldown gates how often one may fire at all — the
+# README's "retune hysteresis contract"
+RETUNE_DEFAULTS = {
+    # serving-window p99 above factor x the post-swap baseline
+    "p99_factor": 1.5,
+    # batch-fill p50 below this while the plane is actually batching
+    "fill_low_pct": 30.0,
+    # windowed ingest-stall fraction above this
+    "stall_frac": 0.25,
+    # wall-clock + batch-count cooldown between swaps
+    "cooldown_s": 30.0,
+    "min_batches": 64,
+    # windows thinner than this can't witness drift
+    "min_window": 32,
+}
+
+
+def retune_trigger(perf, plane, config=None):
+    """The drift detector: reads the perf plane's serving_p99 /
+    batch-fill / stall windows against the hysteresis bounds and
+    returns a trigger name ('p99_drift' | 'fill_low' | 'stall') or
+    None.  Pure read — no side effects, so tests can drive it with
+    injected telemetry."""
+    cfg = dict(RETUNE_DEFAULTS)
+    cfg.update(config or {})
+    now = time.monotonic()
+    # cooldown: wall clock AND batch count since the last swap
+    if perf.last_retune_monotonic is not None:
+        if now - perf.last_retune_monotonic < cfg["cooldown_s"]:
+            return None
+        if perf.seq - perf.batches_at_retune < cfg["min_batches"]:
+            return None
+    wall = perf.phases["wall"].stats(now)
+    if wall["n"] < cfg["min_window"]:
+        return None
+    p99_ms = (
+        plane._window_p99_ms() if plane is not None else 0.0
+    )
+    if perf.baseline_p99_ms is None:
+        # first full window since start/swap: learn the baseline,
+        # never fire on it
+        perf.baseline_p99_ms = p99_ms
+        return None
+    if (
+        perf.baseline_p99_ms > 0
+        and p99_ms > cfg["p99_factor"] * perf.baseline_p99_ms
+    ):
+        return "p99_drift"
+    fill = perf.fill.stats(now)
+    if fill["n"] >= cfg["min_window"] and (
+        fill["p50"] < cfg["fill_low_pct"]
+    ):
+        return "fill_low"
+    if perf.stall_fraction(now) > cfg["stall_frac"]:
+        return "stall"
+    return None
+
+
+def retune_candidates(daemon, plane):
+    """The online candidate grid: batch class (half/same/double),
+    hot-plane pack width (the repack_hash_lanes widths), and memo
+    capacity (HBM-aware via the store's chip_bytes seam)."""
+    batch = plane.batch_size if plane is not None else 1 << 12
+    batches = sorted(
+        {max(batch // 2, 256), batch, min(batch * 2, 1 << 15)}
+    )
+    compiler = daemon.endpoint_manager._fleet_compiler
+    lanes_now = compiler.hash_lanes
+    lanes_opts = sorted({lanes_now, 32, 64})
+    store = getattr(
+        daemon.endpoint_manager, "_device_store", None
+    )
+    memo_rows = [daemon.verdict_cache_rows]
+    if store is not None:
+        for c in memo_candidates(
+            batch, include_off=False, store=store
+        ):
+            memo_rows.append(c["rows"])
+    memo_rows = sorted(set(memo_rows))
+    cands = []
+    for b in batches:
+        for lanes in lanes_opts:
+            for rows in memo_rows:
+                cands.append(
+                    {"batch": b, "hash_lanes": lanes,
+                     "memo_rows": rows}
+                )
+    return cands
+
+
+def _model_run_candidate(daemon, plane):
+    """Default candidate scorer when no measured `run_candidate` is
+    supplied: rank by the gatherprof byte model at each candidate's
+    pack width, scaled by the plane's measured verdicts/s EWMA —
+    deterministic and sweep-free, so the serve loop never pays a
+    device measurement campaign mid-stream.  Callers wanting a
+    MEASURED sweep (bench) pass their own run_candidate."""
+    _, tables, _ = daemon.endpoint_manager.published()
+    base_vps = max(daemon.perf.verdicts_per_sec(), 1.0)
+    base_lanes = daemon.endpoint_manager._fleet_compiler.hash_lanes
+    base_batch = plane.batch_size if plane is not None else 1 << 12
+    base_bpt = None
+    if tables is not None:
+        try:
+            base_bpt = hot_bytes_per_tuple(
+                daemon.datapath_tables(policy=tables)
+            )
+        except Exception:
+            base_bpt = None
+
+    def run(params):
+        lanes = int(params.get("hash_lanes", base_lanes))
+        batch = int(params.get("batch", base_batch))
+        # modeled bytes scale with the dominant hashed-pair lanes;
+        # throughput ~ 1/bytes, p99 ~ batch/vps
+        if base_bpt:
+            # the hashed pair contributes lanes*4 + wlanes*4; scale
+            # only that share of the model
+            delta_b = (lanes - base_lanes) * 4 * 2
+            bpt = max(base_bpt + delta_b, 1.0)
+            vps = base_vps * base_bpt / bpt
+        else:
+            vps = base_vps
+        vps *= batch / max(base_batch, 1)  # amortized floor
+        p99_ms = batch / max(vps, 1.0) * 1000.0
+        return vps, p99_ms
+
+    return run
+
+
+def online_retune(
+    daemon,
+    *,
+    trigger=None,
+    force: bool = False,
+    candidates=None,
+    run_candidate=None,
+    p99_bound_ms: Optional[float] = None,
+    config=None,
+) -> Optional[dict]:
+    """The serve-loop-driven re-tune controller: watch the perf
+    plane's serving_p99 / batch-fill / stall windows, and when drift
+    exceeds the hysteresis bounds re-run the cached autotuner over
+    the candidate grid (batch class, pack width, memo capacity) and
+    apply the choice through the existing seams —
+
+      * pack width: FleetCompiler.set_hash_lanes + regenerate_all →
+        new layout stamp → the device store refuses the delta,
+        full-uploads, deltas resume (bit-identity by construction);
+      * batch class: ServingPlane.set_batch_size (in-flight batches
+        keep their meta-snapshotted pad class);
+      * memo capacity: verdict_cache_rows + drop the device buffer
+        (the lazy _ensure_verdict_cache recreates it at the new
+        size, stamp-checked as ever).
+
+    Returns the retune record (also appended to perf.retunes and
+    counted in cilium_retune_total{trigger}), or None when the
+    hysteresis said "hold"."""
+    from cilium_tpu import tracing
+    from cilium_tpu.metrics import registry as metrics
+
+    perf = daemon.perf
+    plane = getattr(daemon, "serving", None)
+    if trigger is None:
+        if force:
+            trigger = "forced"
+        else:
+            trigger = retune_trigger(perf, plane, config)
+            if trigger is None:
+                return None
+    if candidates is None:
+        candidates = retune_candidates(daemon, plane)
+    if run_candidate is None:
+        run_candidate = _model_run_candidate(daemon, plane)
+    if p99_bound_ms is None:
+        p99_bound_ms = (
+            plane.slo_s * 1000.0 if plane is not None
+            else float("inf")
+        )
+    _, tables, _ = daemon.endpoint_manager.published()
+    cache_key = None
+    if tables is not None:
+        cache_key = shape_class_key(tables) + ("online",)
+    compiler = daemon.endpoint_manager._fleet_compiler
+    before = {
+        "batch": plane.batch_size if plane is not None else None,
+        "hash_lanes": compiler.hash_lanes,
+        "memo_rows": daemon.verdict_cache_rows,
+        "layout_stamp": (
+            tables_layout_stamp(tables)
+            if tables is not None else None
+        ),
+    }
+    with tracing.tracer.span(
+        "autotune.retune", site="autotune",
+        attrs={"trigger": trigger},
+    ) as sp:
+        choice = autotune(
+            candidates, run_candidate,
+            p99_bound_ms=p99_bound_ms, cache_key=cache_key,
+        )
+        params = choice.params
+        applied = {}
+        if (
+            plane is not None
+            and params.get("batch")
+            and int(params["batch"]) != plane.batch_size
+        ):
+            plane.set_batch_size(int(params["batch"]))
+            applied["batch"] = int(params["batch"])
+        rows = params.get("memo_rows")
+        if rows and int(rows) != daemon.verdict_cache_rows:
+            daemon.verdict_cache_rows = int(rows)
+            with daemon.lock:
+                daemon.verdict_cache = None  # lazy re-create
+            applied["memo_rows"] = int(rows)
+        lanes = params.get("hash_lanes")
+        if lanes and int(lanes) != compiler.hash_lanes:
+            compiler.set_hash_lanes(int(lanes))
+            daemon.regenerate_all(f"online retune ({trigger})")
+            applied["hash_lanes"] = int(lanes)
+        _, tables_after, _ = daemon.endpoint_manager.published()
+        after_stamp = (
+            tables_layout_stamp(tables_after)
+            if tables_after is not None else None
+        )
+        sp.attrs["applied"] = dict(applied)
+        metrics.retune_total.inc(trigger)
+        record = perf.note_retune(
+            {
+                "trigger": trigger,
+                "choice": dict(params),
+                "applied": applied,
+                "before": before,
+                "layout_stamp_after": after_stamp,
+            }
+        )
+    return record
+
+
+def tables_layout_stamp(tables) -> Optional[int]:
+    """The published tables' layout stamp (compiler.tables
+    .tables_layout_version) — None for tables without the hashed
+    pair (the stamp would not gate a delta anyway)."""
+    try:
+        from cilium_tpu.compiler.tables import (
+            tables_layout_version,
+        )
+
+        return int(tables_layout_version(tables))
+    except Exception:
+        return None
+
+
 def effective_hot_bytes_per_tuple(
     tables, dedup_factor: float, packed_io: bool = True
 ) -> float:

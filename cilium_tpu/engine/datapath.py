@@ -78,6 +78,7 @@ from cilium_tpu.identity import RESERVED_WORLD
 from cilium_tpu.ipcache.lpm import LPMTables, _lookup_kernel
 from cilium_tpu.lb.device import LBTables, lb_select_batch
 from cilium_tpu.maps.policymap import EGRESS, INGRESS
+from cilium_tpu.metrics import registry as metrics
 
 
 def _register(cls):
@@ -997,6 +998,7 @@ class PersistentPairDispatcher:
         self._staged.append(pair_host)
         if len(self._staged) < self.k:
             return []
+        staged_n = len(self._staged)
         stacked = jax.device_put(
             np.stack(self._staged)
         )
@@ -1005,6 +1007,10 @@ class PersistentPairDispatcher:
             self.tables, stacked, self.acc, self.telem
         )
         self.launches += 1
+        # persistent-program launch accounting for the perf plane:
+        # pairs/launches = realized staging depth at scrape time
+        metrics.datapath_persistent_launches.inc()
+        metrics.datapath_persistent_pairs.inc(value=staged_n)
         return [
             (
                 jax.tree.map(lambda a: a[i], outs_i),

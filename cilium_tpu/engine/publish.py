@@ -795,13 +795,22 @@ class AsyncBatchDispatcher:
             except Exception as drain_exc:  # device died mid-compute
                 out, exc = None, drain_exc
                 self.failed += 1
-            self.block_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.block_s += dt
+            if isinstance(meta, dict):
+                meta.setdefault("perf", {})["drain_s"] = dt
         self._t_last = time.perf_counter()
         return meta, out, exc
 
     def submit(self, host_args: tuple, meta=None) -> list:
         """Stage + enqueue one batch; returns the drained (meta,
-        result, exc) tuples that completed (possibly empty)."""
+        result, exc) tuples that completed (possibly empty).
+
+        Per-batch phase stamps: when `meta` is a dict, the pack /
+        enqueue / drain durations this dispatcher already measures
+        for the overlap aggregates are ALSO written into
+        `meta["perf"]` — the perf plane's per-batch phase windows
+        ride the existing bookkeeping instead of re-timing."""
         if self._t_first is None:
             self._t_first = time.perf_counter()
         self.submitted += 1
@@ -812,13 +821,21 @@ class AsyncBatchDispatcher:
         except Exception as pack_exc:
             exc = pack_exc
             self.failed += 1
-        self.pack_s += time.perf_counter() - t0
+        dt_pack = time.perf_counter() - t0
+        self.pack_s += dt_pack
+        if isinstance(meta, dict):
+            meta.setdefault("perf", {})["pack_s"] = dt_pack
         if exc is None:
+            t1 = time.perf_counter()
             try:
                 out = self.dispatch_fn(*dev_args)
             except Exception as disp_exc:
                 out, exc = None, disp_exc
                 self.failed += 1
+            if isinstance(meta, dict):
+                meta["perf"]["enqueue_s"] = (
+                    time.perf_counter() - t1
+                )
         self._pending.append((meta, out, exc))
         done = []
         while len(self._pending) > self.depth:

@@ -55,9 +55,11 @@ PROGRAM_VERSION = 1
 # forced coverage prefix: these ops land at fixed early positions so
 # EVERY schedule (any seed) exercises rule churn, identity churn,
 # chip kill/readmission, both new fault sites, cache toggles, a
-# forced full publish, and the shadow-diff lifecycle (armed diff
-# checks + disarm-on-stale across the publish_full at 21) — the rest
-# of the schedule is free draws
+# forced full publish, the shadow-diff lifecycle (armed diff
+# checks + disarm-on-stale across the publish_full at 21), and an
+# online re-tune (pack-width swap at 26: layout-stamp refusal →
+# full upload → delta resumption, bit-identical throughout) — the
+# rest of the schedule is free draws
 _FORCED = {
     1: "rule_add",
     3: "ident_add",
@@ -75,12 +77,13 @@ _FORCED = {
     23: "fault_memo_chip",
     24: "shadow_arm",
     25: "shadow_diff",
+    26: "retune",
 }
 
 _FREE_OPS = (
     "flows", "flows", "flows", "rule_add", "rule_del", "ident_add",
     "ident_del", "publish_full", "memo_toggle", "fault_publish",
-    "fault_memo", "chip_toggle",
+    "fault_memo", "chip_toggle", "retune",
 )
 
 
@@ -117,6 +120,7 @@ class _Runner:
             "shadow_arms": 0,
             "shadow_diff_checks": 0,
             "shadow_stale_checks": 0,
+            "retunes": 0,
             "events": Counter(),
         }
 
@@ -216,6 +220,17 @@ class _Runner:
         elif op == "shadow_diff":
             pass  # a flows step whose check compares the window's
             # deltas to the host oracle's diff of the two worlds
+        elif op == "retune":
+            # the online re-tune's layout half mid-schedule: swap
+            # the hot-plane pack width through the SAME seam
+            # engine.autotune.online_retune applies (FleetCompiler
+            # .set_hash_lanes), then regenerate + republish — the
+            # stores must REFUSE the cross-layout delta, full-upload
+            # and resume deltas, with every surface bit-identical
+            mgr = self.world.daemon.endpoint_manager
+            mgr._fleet_compiler.set_hash_lanes(ev["lanes"])
+            self.summary["retunes"] += 1
+            mutated = True
         elif op == "flows":
             pass
         else:
@@ -656,6 +671,14 @@ def _make_event(
         # chip-scoped memo fault: only the routed memo plane's
         # per-chip probes can consume it
         ev = {"op": "fault_memo", "spec": "raise:chip=0;next=1"}
+    elif op == "retune":
+        # materialized rng-free: toggle the pack width away from
+        # whatever the fleet compiler currently holds
+        lanes_now = (
+            runner.world.daemon.endpoint_manager
+            ._fleet_compiler.hash_lanes
+        )
+        ev["lanes"] = 32 if lanes_now != 32 else 64
     zipf = 1.1 if rng.random() < 0.4 else 0.0
     flows = g.gen_flows(
         flows_per_step,

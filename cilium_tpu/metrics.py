@@ -571,6 +571,85 @@ class Registry:
             "equals the mesh-total counters",
             ("chip", "column", "direction"),
         )
+        # -- live performance plane (cilium_tpu.perfplane) ----------------
+        self.serve_phase_seconds = Gauge(
+            f"{ns}_serve_phase_seconds",
+            "Decaying-window quantiles of per-batch serve-loop phase "
+            "durations (pack = host staging, dispatch = jit enqueue, "
+            "drain = blocked on device readback, device = enqueue + "
+            "drain, fold = drain-side event/flow/metric fold, wall = "
+            "plan-to-reply), stat in p50|p99|max",
+            ("phase", "stat"),
+        )
+        self.serve_batch_fill_window_pct = Gauge(
+            f"{ns}_serve_batch_fill_window_pct",
+            "Decaying-window quantiles of coalesced-batch fill "
+            "(serve_batch_fill_pct promoted from last-value to the "
+            "perf plane's window), stat in p50|p99|max",
+            ("stat",),
+        )
+        self.serve_queue_delay_window_seconds = Gauge(
+            f"{ns}_serve_queue_delay_window_seconds",
+            "Decaying-window quantiles of per-span queue delay "
+            "(serve_queue_delay_seconds promoted to the perf "
+            "plane's window), stat in p50|p99|max",
+            ("stat",),
+        )
+        self.serve_ingest_stall_seconds = Counter(
+            f"{ns}_serve_ingest_stall_seconds_total",
+            "Wall seconds the serve loop spent waiting with a "
+            "NONEMPTY ingest queue while nothing was in flight on "
+            "the device (the ingest-starvation accumulator: the "
+            "device idles because the host trickle-feeds it)",
+        )
+        self.serve_slo_deadline_total = Counter(
+            f"{ns}_serve_slo_deadline_total",
+            "Completed serving-plane submissions by deadline "
+            "outcome (hit = replied within the submission's "
+            "deadline, miss = reply landed late or flows shed), "
+            "per tenant and SLO class",
+            ("tenant", "slo_class", "outcome"),
+        )
+        self.serve_slo_error_budget_burn = Gauge(
+            f"{ns}_serve_slo_error_budget_burn",
+            "Per-tenant error-budget burn rate: windowed deadline "
+            "miss fraction over the SLO class's allowed miss "
+            "fraction (1 - objective); > 1 burns budget faster "
+            "than the class allows",
+            ("tenant",),
+        )
+        self.perf_model_bytes_per_tuple = Gauge(
+            f"{ns}_perf_model_bytes_per_tuple",
+            "The gatherprof byte model evaluated LIVE against the "
+            "published layout stamp: hot = modeled hot-plane gather "
+            "bytes, cold = dense-fallback bytes, effective = hot "
+            "under the observed dedup/cache-hit factors",
+            ("plane",),
+        )
+        self.perf_model_gbps = Gauge(
+            f"{ns}_perf_model_gbps",
+            "Modeled sustained gather bandwidth: effective "
+            "bytes-per-tuple x the serving plane's measured "
+            "verdicts/s EWMA (model x measurement, not a "
+            "measurement)",
+        )
+        self.retune_total = Counter(
+            f"{ns}_retune_total",
+            "Online re-tune layout swaps applied by "
+            "engine.autotune.online_retune, by drift trigger "
+            "(p99_drift | fill_low | stall | forced)",
+            ("trigger",),
+        )
+        self.datapath_persistent_launches = Counter(
+            f"{ns}_datapath_persistent_launches_total",
+            "Fused persistent-program launches (each covers K "
+            "staged batch pairs in one device program)",
+        )
+        self.datapath_persistent_pairs = Counter(
+            f"{ns}_datapath_persistent_pairs_total",
+            "Batch pairs staged into the persistent fused program "
+            "(pairs/launches = realized staging depth)",
+        )
 
     def expose(self) -> str:
         lines: List[str] = []

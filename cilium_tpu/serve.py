@@ -273,6 +273,10 @@ class ServingPlane:
         # last): the fairness gate's evidence — batches where two
         # tenants were both backlogged must show the DRR shares
         self.batch_mix: deque = deque(maxlen=1024)
+        # the loop's AsyncBatchDispatcher, exposed for the perf
+        # plane (overlap aggregates + "anything in flight?" for the
+        # ingest-stall detector)
+        self._dispatcher = None
 
     # -- construction helpers -------------------------------------------------
 
@@ -316,6 +320,17 @@ class ServingPlane:
         if self._thread is not None:
             self._thread.join(timeout=60.0)
             self._thread = None
+
+    def set_batch_size(self, batch_size: int) -> None:
+        """Live batch-class swap (the online re-tune's batch knob):
+        applies to batches COMPOSED from now on — an in-flight batch
+        keeps the pad size snapshotted into its meta at stage time,
+        so a swap never races the pack of a batch planned under the
+        old class."""
+        with self._cond:
+            self.batch_size = int(batch_size)
+            self.quantum = max(64, self.batch_size // 8)
+            self._cond.notify_all()
 
     def set_tenant_weights(self, weights: Dict[str, float]) -> None:
         with self._cond:
@@ -596,11 +611,24 @@ class ServingPlane:
                     return self._compose_locked() + (
                         True, early_class,
                     )
+                t_wait = time.monotonic()
                 self._cond.wait(
                     timeout=max(
                         0.0005, min(latest_start - now, 0.05)
                     )
                 )
+                # ingest-starvation accumulator: this wait holds a
+                # NONEMPTY queue (the coalescing-grow branch); when
+                # nothing is in flight the device sat idle for it —
+                # the line-rate-ingest symptom the perf plane counts
+                perf = getattr(self.daemon, "perf", None)
+                d = self._dispatcher
+                if (
+                    perf is not None
+                    and d is not None
+                    and not d._pending
+                ):
+                    perf.note_stall(time.monotonic() - t_wait)
 
     def _compose_locked(self):
         """Deficit round robin over the tenant queues: each round
@@ -666,6 +694,7 @@ class ServingPlane:
             dispatch_fn=self._dispatch,
             depth=self.async_depth,
         )
+        self._dispatcher = dispatcher
         try:
             while True:
                 plan = self._next_plan()
@@ -805,6 +834,11 @@ class ServingPlane:
             "valid": valid,
             "early": early,
             "t_plan": time.monotonic(),
+            # the jit/pad class THIS batch dispatches under,
+            # snapshotted so a live set_batch_size() swap never
+            # races an in-flight batch's pack/redispatch (the max
+            # guard covers a shrink landing mid-compose)
+            "pad_b": max(self.batch_size, valid),
         }
 
     def _luts_for(self, version, index):
@@ -844,7 +878,7 @@ class ServingPlane:
             # the fused router path packs/pads internally (its
             # batch re-split owns the padding); nothing to stage
             return (meta, tables, None)
-        b = self.batch_size
+        b = meta["pad_b"]
 
         def pad(a, fill=0):
             out = np.full(b, fill, dtype=a.dtype)
@@ -925,7 +959,7 @@ class ServingPlane:
             )
 
         out, degraded = self.daemon._dispatch_or_degrade(
-            tables, batch, host_args, self.batch_size,
+            tables, batch, host_args, meta["pad_b"],
             host_cols=host_cols,
         )
         meta["degraded"] = degraded
@@ -970,7 +1004,8 @@ class ServingPlane:
         sub.served += n
         if sub.served >= sub.n:
             r = sub.result
-            r.latency_s = time.monotonic() - sub.t_enqueue
+            now = time.monotonic()
+            r.latency_s = now - sub.t_enqueue
             metrics.serve_latency_seconds.observe(r.latency_s)
             with self._lock:
                 self._latency_window.append(r.latency_s)
@@ -978,6 +1013,28 @@ class ServingPlane:
             if self._completions % 32 == 0:
                 metrics.serving_p99_ms.set(
                     value=self._window_p99_ms()
+                )
+            # SLO-class compliance ledger: a submission HITS its
+            # deadline only when the reply landed in time with
+            # nothing shed — shed flows failed their service even
+            # though the reply completed early
+            perf_plane = getattr(self.daemon, "perf", None)
+            if perf_plane is not None:
+                cls = self._tenant_slo.get(sub.tenant)
+                bundle = (
+                    self._slo_classes.get(cls) if cls else None
+                ) or {}
+                perf_plane.note_deadline(
+                    sub.tenant,
+                    cls,
+                    hit=(
+                        now <= sub.deadline
+                        and not bool(r.shed_mask.any())
+                        and r.error is None
+                    ),
+                    objective=float(
+                        bundle.get("objective", 0.99)
+                    ),
                 )
             r._event.set()
 
@@ -1001,6 +1058,7 @@ class ServingPlane:
         degraded = bool(meta.get("degraded"))
         shadow_out = meta.get("shadow_out")
         shadow_refuse = False
+        t_fold0 = time.monotonic()  # the perf plane's fold phase
         try:
             if exc is not None and self.fused:
                 # fused mode has no bit-identical host fold (the
@@ -1108,7 +1166,7 @@ class ServingPlane:
 
                         return self.daemon._dispatch_or_degrade(
                             meta["tables"], meta["batch"], _ha,
-                            self.batch_size, use_memo=False,
+                            meta["pad_b"], use_memo=False,
                             shadow_sample=False,
                         )
 
@@ -1215,13 +1273,34 @@ class ServingPlane:
             )
             self.batches += 1
             self.flows_served += valid
-            fill = 100.0 * valid / self.batch_size
+            fill = 100.0 * valid / meta["pad_b"]
             self.fill_sum += fill
             if degraded:
                 self.degraded_batches += 1
             metrics.serve_batches_total.inc()
             metrics.serve_batch_fill_pct.set(value=fill)
             self.batch_mix.append(meta["mix"])
+            # feed the perf plane: the dispatcher's per-batch phase
+            # stamps (meta["perf"], written by the overlap
+            # bookkeeping) + this fold's own wall — one call per
+            # batch, windows + bounded-cadence gauge export inside
+            perf_plane = getattr(self.daemon, "perf", None)
+            if perf_plane is not None:
+                pp = meta.get("perf") or {}
+                perf_plane.observe_batch(
+                    pack_s=pp.get("pack_s", 0.0),
+                    dispatch_s=pp.get("enqueue_s", 0.0),
+                    drain_s=pp.get("drain_s", 0.0),
+                    fold_s=now - t_fold0,
+                    wall_s=wall,
+                    fill_pct=fill,
+                    valid=valid,
+                )
+                # the online re-tune controller rides the serve
+                # loop at a bounded cadence (no-op unless the
+                # daemon enabled it)
+                if self.batches % 64 == 0:
+                    self.daemon.maybe_online_retune()
             # -- demux to per-submission replies ----------------------
             # per-tenant verdict-cache hits: the cross-tenant memo
             # plane's observability — batch_mix rows carry each
@@ -1280,6 +1359,8 @@ class ServingPlane:
                 delay = meta["t_plan"] - sub.t_enqueue
                 r.queue_delay_s = max(r.queue_delay_s, delay)
                 metrics.serve_queue_delay_seconds.observe(delay)
+                if perf_plane is not None:
+                    perf_plane.observe_queue_delay(delay)
                 off += n
                 self._span_accounted(sub, n)
         except Exception as exc2:
@@ -1303,10 +1384,15 @@ class ServingPlane:
         """Zero the rolling serving_p99_ms latency window (the
         /debug/profile?reset=1 seam applied to the serving plane):
         bench segments and before/after experiments must not bleed
-        one load shape's tail into the next segment's p99."""
+        one load shape's tail into the next segment's p99.  The
+        daemon's perf-plane windows (phase/fill/queue-delay/stall)
+        reset alongside — one seam, every window."""
         with self._lock:
             self._latency_window.clear()
         metrics.serving_p99_ms.set(value=0.0)
+        perf_plane = getattr(self.daemon, "perf", None)
+        if perf_plane is not None:
+            perf_plane.reset()
 
     def snapshot(self) -> Dict:
         with self._lock:

@@ -1,0 +1,250 @@
+"""Live performance plane (cilium_tpu/perfplane.py) + its surfaces.
+
+The tentpole contract (ISSUE 16):
+
+  * per-batch phase accounting (pack/dispatch/drain/device/fold/
+    wall) lands in decaying windows served as p50/p99/max, fed from
+    the overlap dispatcher's OWN bookkeeping — `/debug/perf` numbers
+    must agree with wall clocks the test harness measures around the
+    same traffic;
+  * `serve_batch_fill_pct` / queue delay are promoted to windows
+    with the same reset seam as serving_p99_ms
+    (/debug/profile?reset=1);
+  * the SLO compliance ledger burns error budget against the PR 15
+    slo_classes' objective;
+  * every registered `cilium_*` metric appears in the README's
+    metrics reference table, and every table row is registered (the
+    PR 14 lint pattern, aimed at doc drift);
+  * `cilium-tpu top --once -o json` and bugtool's perf.json emit
+    the same /debug/perf document.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.metrics import registry as metrics
+from cilium_tpu.perfplane import PerfPlane, PhaseWindow, render_top
+from cilium_tpu.serve import build_demo_daemon, demo_record_maker
+
+
+# ---------------------------------------------------------------------------
+# window mechanics (pure host)
+# ---------------------------------------------------------------------------
+
+
+def test_phase_window_quantiles_decay_reset():
+    w = PhaseWindow(maxlen=8, horizon_s=10.0)
+    for i in range(16):  # count-bounded: only the last 8 survive
+        w.observe(float(i), now=100.0)
+    s = w.stats(now=100.0)
+    assert s["n"] == 8
+    assert s["max"] == 15.0
+    assert 8.0 <= s["p50"] <= 13.0
+    assert s["p99"] == 15.0
+    assert w.count == 16 and w.lifetime_max == 15.0
+
+    # horizon-bounded decay: observations age out by wall clock
+    s2 = w.stats(now=120.0)
+    assert s2["n"] == 0 and s2["p50"] == 0.0
+    w.observe(3.0, now=120.0)
+    assert w.stats(now=121.0)["n"] == 1
+
+    w.reset()
+    assert w.stats(now=121.0)["n"] == 0
+    # lifetime accounting survives the window reset
+    assert w.count == 17
+
+
+def test_perfplane_snapshot_shape_cursor_and_slo():
+    p = PerfPlane(window=64, horizon_s=60.0)
+    for _ in range(10):
+        p.observe_batch(
+            pack_s=0.001, dispatch_s=0.002, drain_s=0.004,
+            fold_s=0.001, wall_s=0.01, fill_pct=75.0, valid=100,
+        )
+    p.observe_queue_delay(0.003)
+    # SLO ledger: objective 0.9 → allowed miss fraction 0.1; one
+    # miss in two completions burns at 0.5/0.1 = 5x
+    p.note_deadline("acme", "gold", hit=True, objective=0.9)
+    p.note_deadline("acme", "gold", hit=False, objective=0.9)
+    snap = p.snapshot()
+    assert set(snap["phases_ms"]) == {
+        "pack", "dispatch", "drain", "device", "fold", "wall",
+    }
+    for w in snap["phases_ms"].values():
+        assert w["n"] == 10
+        assert w["p50"] <= w["p99"] <= w["max"]
+    # device = dispatch + drain by construction
+    assert snap["phases_ms"]["device"]["max"] == pytest.approx(
+        0.006 * 1000.0
+    )
+    assert snap["batch_fill_pct"]["p50"] == 75.0
+    burn = snap["slo"]["acme"]["error_budget_burn"]
+    assert burn == pytest.approx(5.0)
+    assert metrics.serve_slo_deadline_total.get(
+        "acme", "gold", "miss"
+    ) >= 1.0
+
+    # retune-history cursor: since=cursor returns only newer records
+    cur0 = snap["cursor"]
+    p.note_retune({"trigger": "forced", "applied": {}})
+    s1 = p.snapshot(since=cur0 - 1)
+    assert len(s1["retunes"]) == 1
+    assert p.snapshot(since=s1["cursor"])["retunes"] == []
+
+    # reset clears windows, keeps lifetime counters + history
+    p.reset()
+    s2 = p.snapshot()
+    assert s2["phases_ms"]["wall"]["n"] == 0
+    assert len(s2["retunes"]) == 1
+
+
+def test_stall_detector_accumulates():
+    p = PerfPlane()
+    before = metrics.serve_ingest_stall_seconds.get()
+    p.note_stall(0.25)
+    p.note_stall(0.15)
+    assert p.stall_seconds_total == pytest.approx(0.4)
+    assert metrics.serve_ingest_stall_seconds.get() - before == (
+        pytest.approx(0.4)
+    )
+    assert 0.0 < p.stall_fraction() <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# the metrics-name lint (the PR 14 unseeded-RNG lint pattern)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_readme_lint():
+    """Every metric registered at runtime appears in the README's
+    metrics reference table, and every table row is still
+    registered — the docs cannot drift from the code."""
+    import os
+    import re
+
+    from cilium_tpu.metrics import Counter, Gauge, Histogram
+
+    registered = {
+        m.name
+        for m in vars(metrics).values()
+        if isinstance(m, (Counter, Gauge, Histogram))
+    }
+    assert registered, "empty registry?"
+    readme = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "README.md",
+    )
+    with open(readme) as f:
+        text = f.read()
+    anchor = "### Metrics reference"
+    assert anchor in text, "README lost the metrics reference table"
+    table = text.split(anchor, 1)[1]
+    documented = set(
+        re.findall(r"^\| `(cilium_[a-z0-9_]+)` \|", table, re.M)
+    )
+    missing = registered - documented
+    assert not missing, (
+        "metrics registered but missing from the README metrics "
+        f"reference table: {sorted(missing)}"
+    )
+    stale = documented - registered
+    assert not stale, (
+        "README metrics reference rows no longer registered: "
+        f"{sorted(stale)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# end to end: /debug/perf vs the harness wall clock, reset seam,
+# `top --once -o json`, bugtool perf.json
+# ---------------------------------------------------------------------------
+
+
+def test_debug_perf_end_to_end(tmp_path):
+    from cilium_tpu.api.server import DaemonAPI
+    from cilium_tpu.serve import ServingPlane
+
+    d, client = build_demo_daemon()
+    make = demo_record_maker(client.security_identity.id)
+    api = DaemonAPI(d)
+    rng = np.random.default_rng(11)
+    recs = [make(rng, 64) for _ in range(12)]
+
+    # a generous deadline: the whole backlog is queued before the
+    # loop starts, so a tight SLO would (correctly) count misses
+    plane = ServingPlane(d, batch_size=128, slo_ms=30000.0)
+    d.serving = plane
+    results = [plane.submit(rec=r, tenant="acme") for r in recs]
+    t0 = time.monotonic()
+    plane.start()
+    for r in results:
+        r.wait(timeout=120)
+    harness_wall = time.monotonic() - t0
+
+    snap = api.debug_perf({"leaves": "1"})
+    psnap = plane.snapshot()
+    # the perf plane observed exactly the batches the plane counted
+    wall_w = snap["phases_ms"]["wall"]
+    assert wall_w["n"] == psnap["batches"] > 0
+    # window durations agree with the wall the harness measured
+    # around the same segment (a batch cannot outlast the segment;
+    # the summed walls cannot exceed it + scheduling slack)
+    assert wall_w["max"] <= harness_wall * 1000.0 + 1.0
+    assert wall_w["total_s"] <= harness_wall + 0.5
+    assert snap["batch_fill_pct"]["n"] == psnap["batches"]
+    # SLO ledger: every submission completed within the generous
+    # deadline → hits recorded, no burn
+    assert snap["slo"]["acme"]["hits"] == len(recs)
+    assert snap["slo"]["acme"]["error_budget_burn"] == 0.0
+    # live byte model against the published layout stamp
+    bm = snap["byte_model"]
+    assert bm["published"] is True
+    assert bm["hot_bytes_per_tuple"] > 0
+    assert bm["layout_stamp"] > 0
+    assert any(r["plane"] == "hot" for r in bm["leaves"])
+    # per-chip HBM via the store seam
+    assert sum(map(int, snap["hbm"]["chip_bytes"].values())) > 0
+    # windowed gauges exported (fill promoted from last-value)
+    assert metrics.serve_phase_seconds.get("wall", "p99") > 0.0
+    assert metrics.serve_batch_fill_window_pct.get("p50") > 0.0
+
+    # `cilium-tpu top --once -o json` emits this same document
+    from cilium_tpu import cli as cli_mod
+
+    rc = cli_mod.main(["top", "--once", "-o", "json"], api=api)
+    assert rc == 0
+    # and the text renderer carries the load-bearing lines
+    frame = render_top(api.debug_perf({}))
+    assert "phase" in frame and "wall" in frame
+    assert "byte model" in frame
+
+    # bugtool archives perf.json beside metrics.prom/traces.json
+    from cilium_tpu import bugtool
+
+    archive = bugtool.collect(d, str(tmp_path))
+    import tarfile
+
+    with tarfile.open(archive) as tar:
+        names = [n.split("/", 1)[1] for n in tar.getnames() if "/" in n]
+        assert "perf.json" in names
+        assert "metrics.prom" in names
+        f = tar.extractfile(
+            [n for n in tar.getnames() if n.endswith("perf.json")][0]
+        )
+        doc = json.load(f)
+    assert doc["phases_ms"]["wall"]["n"] == wall_w["n"]
+    assert doc["byte_model"]["layout_stamp"] == bm["layout_stamp"]
+
+    # the reset seam: /debug/profile?reset=1 clears the perf windows
+    # with serving_p99_ms; lifetime counters survive
+    api.debug_profile(reset=True)
+    snap2 = api.debug_perf({})
+    assert snap2["phases_ms"]["wall"]["n"] == 0
+    assert snap2["batch_fill_pct"]["n"] == 0
+    assert metrics.serve_phase_seconds.get("wall", "p99") == 0.0
+    plane.stop()
+    d.serving = None

@@ -65,6 +65,11 @@ def test_policyfuzz_smoke():
     assert summary["shadow_arms"] >= 2
     assert summary["shadow_diff_checks"] >= 1
     assert summary["shadow_stale_checks"] >= 1
+    # online re-tune coverage: the forced pack-width swap at step 26
+    # rode the layout-stamp refusal → full upload → delta resumption
+    # path with every surface staying bit-identical (the full is
+    # counted in publishes["full"] above)
+    assert summary["retunes"] >= 1
     # the recorded program replays clean (same seed, same world,
     # byte-for-byte events) — the determinism the shrinker rests on
     assert len(program["events"]) == SMOKE_STEPS

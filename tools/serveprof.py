@@ -30,6 +30,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -78,6 +79,7 @@ def run_profile(
     results = [
         plane.submit(rec=rec, tenant="prof") for rec in recs
     ]
+    t_seg0 = time.monotonic()
     plane.start()
     for r in results:
         r.wait(timeout=120)
@@ -105,6 +107,31 @@ def run_profile(
             got, ref.verdicts[col],
             err_msg=f"saturation stream diverged in {field}",
         )
+
+    # ---- 1b: the live perf plane agrees with the harness ---------------
+    # Every phase-1 batch fed the daemon's PerfPlane from the serve
+    # loop's own bookkeeping: the windowed counts must match the
+    # plane's batch count exactly, and no windowed duration can
+    # exceed the wall the harness measured around the segment.
+    t_seg = time.monotonic() - t_seg0
+    perf = d.perf_snapshot()
+    wall_w = perf["phases_ms"]["wall"]
+    assert wall_w["n"] == snap["batches"], (
+        wall_w["n"], snap["batches"],
+    )
+    for name, w in perf["phases_ms"].items():
+        assert w["p50"] <= w["p99"] + 1e-9 <= w["max"] + 1e-6, (
+            name, w,
+        )
+        assert w["max"] <= t_seg * 1000.0 + 1.0, (name, w, t_seg)
+    assert wall_w["total_s"] <= t_seg + 0.5, (
+        wall_w["total_s"], t_seg,
+    )
+    fill_w = perf["batch_fill_pct"]
+    assert fill_w["n"] == snap["batches"]
+    assert abs(
+        perf["batch_fill_pct"]["p50"] - snap["avg_batch_fill_pct"]
+    ) <= 25.0  # same population, mean vs median
 
     # ---- 2: queue-delay accounting vs serving_p99_ms -------------------
     from cilium_tpu.serve import quantile_ms
