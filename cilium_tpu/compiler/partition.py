@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import re
 import zlib
-from typing import List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -423,10 +423,19 @@ def replica_delta(delta, tables, ntp: int,
     )
 
 
-def replica_partition_digest(table_axis: str = TABLE_AXIS) -> int:
+def replica_partition_digest(
+    table_axis: str = TABLE_AXIS, ntp: Optional[int] = None
+) -> int:
     """Digest of the replica placement (rule table + replica set +
     backup offset): a replica-layout epoch can never accept a delta
-    recorded under plain sharding, and vice versa."""
+    recorded under plain sharding, and vice versa.  With `ntp` the
+    SHARD COUNT folds in too: the augmented leaves have the same
+    total shape [2S] at every ntp (a reshard is a pure permutation of
+    the augmented layout), so without the count in the digest a
+    source-layout delta or repair could scatter bit-compatibly — but
+    row-incorrectly — into a target-layout epoch.  The reshard
+    engine's refusal seam depends on the two layouts stamping
+    differently."""
     text = ";".join(
         f"{pat}->{tuple(spec)}"
         for pat, spec in default_table_rules(table_axis)
@@ -435,6 +444,8 @@ def replica_partition_digest(table_axis: str = TABLE_AXIS) -> int:
         f";replicas={','.join(REPLICA_LEAVES)}"
         f";backup_offset={REPLICA_BACKUP_OFFSET}"
     )
+    if ntp is not None:
+        text += f";ntp={int(ntp)}"
     return zlib.crc32(text.encode()) & 0xFFFFFFFF
 
 
@@ -752,7 +763,9 @@ def replicate_datapath_leaves(
     )
 
 
-def datapath_partition_digest(table_axis: str = TABLE_AXIS) -> int:
+def datapath_partition_digest(
+    table_axis: str = TABLE_AXIS, ntp: Optional[int] = None
+) -> int:
     """Digest of the WHOLE fused-datapath placement — every family's
     rule table plus both replica sets and the backup offset — folded
     into the datapath store's epoch layout, so a delta recorded under
@@ -777,7 +790,137 @@ def datapath_partition_digest(table_axis: str = TABLE_AXIS) -> int:
         + ",".join(f"{f}.{l}" for f, l in DATAPATH_REPLICA_LEAVES)
     )
     parts.append(f"backup_offset={REPLICA_BACKUP_OFFSET}")
+    if ntp is not None:
+        # the reshard refusal seam: same reason as
+        # replica_partition_digest(ntp=...) — augmented shapes are
+        # ntp-invariant, so only the digest separates the layouts
+        parts.append(f"ntp={int(ntp)}")
     return zlib.crc32("|".join(parts).encode()) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Elastic resharding: the owned-row delta between two shard counts
+# ---------------------------------------------------------------------------
+#
+# The augmented replica layout is ntp-INVARIANT in total shape: a
+# sharded axis [S] becomes [2S] under ANY shard count (each shard
+# holds its primary slice plus one backup copy), so migrating a leaf
+# from ntp_src to ntp_dst is a pure index permutation of the
+# augmented axis.  The owned-row delta below says which target
+# augmented positions a migration must actually MOVE.
+#
+# Byte-accounting model (the simulation boundary the reshard engine
+# documents): a target augmented row j — holding un-augmented row u,
+# owned by target LOGICAL column c — is RETAINED (a device-local
+# copy, zero H2D bytes) iff column c also existed in the source
+# layout (c < ntp_src) and the source chip at the same logical
+# column already held u in its primary or backup region.  Every
+# other row is MOVED: streamed host→device in bounded-byte steps and
+# counted into reshard_bytes_h2d.  Growth 2→4 therefore moves
+# exactly the new columns' contents — the rows whose owner changed —
+# never O(world).
+
+
+def reshard_row_map(
+    n_rows: int, ntp_src: int, ntp_dst: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """For one sharded leaf axis of un-augmented length `n_rows`:
+    (src_unaug, moved) over the TARGET augmented axis [2 * n_rows].
+
+      * src_unaug[j] — the un-augmented row index target augmented
+        position j holds under ntp_dst (primary region [0, n) of
+        each column block holds the column's own slice, backup
+        region [n, 2n) its left neighbour's);
+      * moved[j]     — True when position j must be streamed under
+        the column-identity retention model above.
+
+    Both shard counts must divide `n_rows` (the divisibility-checked
+    rule layer guarantees it for every sharded leaf)."""
+    S = int(n_rows)
+    if S % ntp_src or S % ntp_dst:
+        raise ValueError(
+            f"shard counts {ntp_src}->{ntp_dst} must divide the "
+            f"sharded axis ({S} rows)"
+        )
+    n_t = S // ntp_dst
+    n_s = S // ntp_src
+    j = np.arange(2 * S)
+    col = j // (2 * n_t)
+    within = j - col * 2 * n_t
+    primary = within < n_t
+    src_unaug = np.where(
+        primary,
+        col * n_t + within,
+        ((col - REPLICA_BACKUP_OFFSET) % ntp_dst) * n_t
+        + (within - n_t),
+    )
+    src_shard = src_unaug // n_s
+    resident = (col < ntp_src) & (
+        (src_shard == col)
+        | (src_shard == (col - REPLICA_BACKUP_OFFSET) % ntp_src)
+    )
+    return src_unaug, ~resident
+
+
+def reshard_moved_rows(
+    tables, ntp_src: int, ntp_dst: int,
+    table_axis: str = TABLE_AXIS,
+) -> Dict[str, Tuple[int, np.ndarray]]:
+    """{leaf: (axis, moved target-augmented indices)} for the policy
+    replica leaves — the owned-row delta a ReshardPlan streams.  The
+    replica leaf SET must agree between the two shard counts (a leaf
+    sharded at one count but replicated at the other is a geometry
+    change, not a permutation): the plan refuses and full-uploads
+    into the target instead."""
+    src_axes = replica_axes(tables, ntp_src, table_axis)
+    dst_axes = replica_axes(tables, ntp_dst, table_axis)
+    if set(src_axes) != set(dst_axes):
+        raise ValueError(
+            "replica leaf sets differ between shard counts "
+            f"{ntp_src} ({sorted(src_axes)}) and {ntp_dst} "
+            f"({sorted(dst_axes)}): not a permutation reshard"
+        )
+    out: Dict[str, Tuple[int, np.ndarray]] = {}
+    for name, axis in dst_axes.items():
+        n = int(
+            np.asarray(getattr(tables, name)).shape[axis]
+        )
+        _, moved = reshard_row_map(n, ntp_src, ntp_dst)
+        out[name] = (axis, np.flatnonzero(moved))
+    return out
+
+
+def datapath_reshard_moved_rows(
+    dtables, ntp_src: int, ntp_dst: int,
+    table_axis: str = TABLE_AXIS,
+) -> Dict[Tuple[str, str], Tuple[int, np.ndarray]]:
+    """reshard_moved_rows over the WHOLE datapath tree: {(family,
+    leaf): (axis, moved target-augmented indices)} for every
+    N+1-augmented leaf — policy + CT + ipcache + LB, the same
+    enumeration the delta publish and chip repair share
+    (datapath_all_replica_axes)."""
+    src_axes = datapath_all_replica_axes(
+        dtables, ntp_src, table_axis
+    )
+    dst_axes = datapath_all_replica_axes(
+        dtables, ntp_dst, table_axis
+    )
+    if set(src_axes) != set(dst_axes):
+        raise ValueError(
+            "datapath replica leaf sets differ between shard "
+            f"counts {ntp_src} and {ntp_dst}: not a permutation "
+            "reshard"
+        )
+    out: Dict[Tuple[str, str], Tuple[int, np.ndarray]] = {}
+    for (fam, leaf), axis in dst_axes.items():
+        n = int(
+            np.asarray(
+                getattr(getattr(dtables, fam), leaf)
+            ).shape[axis]
+        )
+        _, moved = reshard_row_map(n, ntp_src, ntp_dst)
+        out[(fam, leaf)] = (axis, np.flatnonzero(moved))
+    return out
 
 
 def _family_byte_rows(

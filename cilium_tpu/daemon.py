@@ -230,6 +230,15 @@ class Daemon:
         # compact row layouts (planes whose ranges don't fit keep
         # the wide layout automatically)
         self.datapath_subword = False
+        # fused-plane hot-lane overrides the online autotuner sweeps
+        # (engine.autotune.retune_candidates): CT bucket-row width
+        # for the compact layout, and a plane-scoped ipcache
+        # sub-word toggle that applies without the global
+        # datapath_subword transform.  Either change moves the
+        # datapath layout stamp, so the DatapathStore refuses the
+        # next cross-layout delta into exactly one full upload.
+        self.datapath_ct_lanes = None
+        self.datapath_ip_subword = None
         # device table-publication backoff (monotonic deadline): a
         # failed epoch publish must not be retried per batch
         self._device_publish_retry_at = 0.0
@@ -1172,6 +1181,82 @@ class Daemon:
         version, tables, _index = self.endpoint_manager.published()
         if tables is not None:
             _sync_router(tables)
+
+    def reshard_mesh(
+        self,
+        target_tp: int,
+        step_bytes: Optional[int] = None,
+        on_fault: str = "complete",
+        plane=None,
+        max_steps: int = 1 << 16,
+    ) -> Dict:
+        """Live elastic reshard of the attached mesh router's table
+        axis to `target_tp` columns — stop-free: the live epoch
+        serves throughout; moved rows stream into a staged
+        target-layout epoch in bounded-byte steps
+        (engine/reshard.ReshardPlan), and the cutover flips epochs
+        between batches (via `plane.run_at_batch_boundary` when a
+        ServingPlane is passed).  While the migration window is
+        open, every auto-publish the endpoint manager performs is
+        DUAL-APPLIED: the store's relayout-aware publish patches the
+        live epoch in place (non-donated — zero drain) and the plan
+        folds the same change into the staged target host, so churn
+        never blocks a reshard and a reshard never loses churn.
+        Returns the plan's stats dict ({outcome, steps, bytes_h2d,
+        ms, restarts, dead_cols})."""
+        from cilium_tpu.engine import reshard as reshard_mod
+
+        router = self.mesh_router
+        if router is None:
+            raise RuntimeError(
+                "no mesh router attached; call attach_mesh_router "
+                "first"
+            )
+        target_mesh = reshard_mod.reshard_target_mesh(
+            router, target_tp
+        )
+        dtables = (
+            self.datapath_tables()
+            if router.dp_store is not None else None
+        )
+        kwargs = {} if step_bytes is None else {
+            "step_bytes": int(step_bytes)
+        }
+        plan = reshard_mod.ReshardPlan(
+            router, target_mesh, on_fault=on_fault,
+            dtables=dtables, shadow=self.shadow, **kwargs,
+        )
+        prev = self.endpoint_manager.on_device_publish
+
+        def _dual_apply(tables):
+            # live-epoch patch first (the relayout-aware store
+            # path), then fold the same world into the staged target
+            if prev is not None:
+                prev(tables)
+            if plan.state == "migrating":
+                dt = (
+                    self.datapath_tables(policy=tables)
+                    if router.dp_store is not None else None
+                )
+                plan.on_publish(tables, dtables=dt)
+
+        self.endpoint_manager.on_device_publish = _dual_apply
+        try:
+            plan.begin()
+            steps = 0
+            while plan.state == "migrating":
+                if plan.pending():
+                    plan.step()
+                    steps += 1
+                    if steps > max_steps:
+                        plan.rollback(reason="max_steps exceeded")
+                elif plane is not None:
+                    plane.run_at_batch_boundary(plan.cutover)
+                else:
+                    plan.cutover()
+        finally:
+            self.endpoint_manager.on_device_publish = prev
+        return dict(plan.stats)
 
     def _ensure_verdict_cache(self, tables):
         """The daemon's VerdictCache, stamped to the tables about to
@@ -2179,12 +2264,50 @@ class Daemon:
         )
         if subword is None:
             subword = bool(getattr(self, "datapath_subword", False))
+        ct_lanes = getattr(self, "datapath_ct_lanes", None)
         if subword:
             from cilium_tpu.engine.datapath import (
                 subword_datapath_tables,
             )
 
-            dt, _report = subword_datapath_tables(dt)
+            dt, _report = subword_datapath_tables(
+                dt, ct_lanes=ct_lanes
+            )
+        else:
+            # plane-scoped lane overrides from the online autotuner
+            # sweep (retune_candidates' CT/ipcache width grid) apply
+            # without the global sub-word transform; a plane whose
+            # semantics don't fit keeps its wide layout
+            import dataclasses as _dc
+
+            if ct_lanes:
+                from cilium_tpu.ct.device import compact_ct_snapshot
+
+                try:
+                    dt = _dc.replace(
+                        dt,
+                        ct=compact_ct_snapshot(
+                            dt.ct, lanes=int(ct_lanes)
+                        ),
+                    )
+                except ValueError:
+                    pass
+            if getattr(self, "datapath_ip_subword", False):
+                from cilium_tpu.ipcache.lpm import (
+                    IPCacheDevice,
+                    subword_ipcache,
+                )
+
+                if (
+                    isinstance(dt.ipcache, IPCacheDevice)
+                    and dt.ipcache.values_are_idx
+                ):
+                    try:
+                        dt = _dc.replace(
+                            dt, ipcache=subword_ipcache(dt.ipcache)
+                        )
+                    except ValueError:
+                        pass
         return dt
 
     def serving_plane(self, **overrides):

@@ -462,10 +462,64 @@ def retune_trigger(perf, plane, config=None):
     return None
 
 
+def _datapath_lane_options(daemon):
+    """(ct_opts, ip_opts) for the fused-plane hot-lane sweep: CT
+    bucket-row widths (compact_ct_snapshot's lanes seam, priced at
+    lanes*4 bytes/tuple like every bucketized gather) and the
+    ipcache plane's wide-vs-sub-word row widths.  Each option is
+    None ("keep the current layout") or a dict of candidate params;
+    worlds with no assemblable fused datapath sweep nothing."""
+    ct_opts: List[Optional[dict]] = [None]
+    ip_opts: List[Optional[dict]] = [None]
+    try:
+        dt = daemon.datapath_tables()
+    except Exception:
+        return ct_opts, ip_opts
+    from cilium_tpu.ct.device import compact_ct_snapshot
+    from cilium_tpu.ipcache.lpm import (
+        IPCacheDevice,
+        subword_ipcache,
+    )
+
+    ct_now = int(np.asarray(dt.ct.buckets).shape[1])
+    for lanes in (32, 64):
+        if lanes == ct_now:
+            continue
+        try:  # only offer widths this snapshot can actually pack to
+            compact_ct_snapshot(dt.ct, lanes=lanes)
+        except ValueError:
+            continue
+        ct_opts.append({"ct_lanes": lanes})
+    ipc = dt.ipcache
+    if isinstance(ipc, IPCacheDevice) and hasattr(ipc, "buckets"):
+        ip_now = int(np.asarray(ipc.buckets).shape[1])
+        if getattr(ipc, "bucket_entries", 0):
+            # currently sub-word: nothing narrower to offer; the
+            # wide layout is not reachable through a lane knob
+            pass
+        elif ipc.values_are_idx:
+            try:
+                packed = subword_ipcache(ipc)
+                ip_packed = int(
+                    np.asarray(packed.buckets).shape[1]
+                )
+                if ip_packed != ip_now:
+                    ip_opts.append({
+                        "ip_lanes": ip_packed,
+                        "ip_subword": True,
+                    })
+            except ValueError:
+                pass
+    return ct_opts, ip_opts
+
+
 def retune_candidates(daemon, plane):
     """The online candidate grid: batch class (half/same/double),
-    hot-plane pack width (the repack_hash_lanes widths), and memo
-    capacity (HBM-aware via the store's chip_bytes seam)."""
+    hot-plane pack width (the repack_hash_lanes widths), memo
+    capacity (HBM-aware via the store's chip_bytes seam), and the
+    fused plane's CT / ipcache hot-lane widths (the
+    subword_datapath_tables ct_lanes seam + the ipcache sub-word
+    toggle), all scored by the same gatherprof byte model."""
     batch = plane.batch_size if plane is not None else 1 << 12
     batches = sorted(
         {max(batch // 2, 256), batch, min(batch * 2, 1 << 15)}
@@ -483,14 +537,22 @@ def retune_candidates(daemon, plane):
         ):
             memo_rows.append(c["rows"])
     memo_rows = sorted(set(memo_rows))
+    ct_opts, ip_opts = _datapath_lane_options(daemon)
     cands = []
     for b in batches:
         for lanes in lanes_opts:
             for rows in memo_rows:
-                cands.append(
-                    {"batch": b, "hash_lanes": lanes,
-                     "memo_rows": rows}
-                )
+                for ct in ct_opts:
+                    for ip in ip_opts:
+                        cand = {
+                            "batch": b, "hash_lanes": lanes,
+                            "memo_rows": rows,
+                        }
+                        if ct:
+                            cand.update(ct)
+                        if ip:
+                            cand.update(ip)
+                        cands.append(cand)
     return cands
 
 
@@ -506,11 +568,18 @@ def _model_run_candidate(daemon, plane):
     base_lanes = daemon.endpoint_manager._fleet_compiler.hash_lanes
     base_batch = plane.batch_size if plane is not None else 1 << 12
     base_bpt = None
+    base_ct_lanes = base_ip_lanes = None
     if tables is not None:
         try:
-            base_bpt = hot_bytes_per_tuple(
-                daemon.datapath_tables(policy=tables)
+            dt = daemon.datapath_tables(policy=tables)
+            base_bpt = hot_bytes_per_tuple(dt)
+            base_ct_lanes = int(
+                np.asarray(dt.ct.buckets).shape[1]
             )
+            if hasattr(dt.ipcache, "buckets"):
+                base_ip_lanes = int(
+                    np.asarray(dt.ipcache.buckets).shape[1]
+                )
         except Exception:
             base_bpt = None
 
@@ -523,6 +592,17 @@ def _model_run_candidate(daemon, plane):
             # the hashed pair contributes lanes*4 + wlanes*4; scale
             # only that share of the model
             delta_b = (lanes - base_lanes) * 4 * 2
+            # the fused plane's bucketized gathers each price one
+            # row at lanes*4 (hot_gather_profile): a candidate CT /
+            # ipcache width moves exactly that share
+            if base_ct_lanes and params.get("ct_lanes"):
+                delta_b += (
+                    int(params["ct_lanes"]) - base_ct_lanes
+                ) * 4
+            if base_ip_lanes and params.get("ip_lanes"):
+                delta_b += (
+                    int(params["ip_lanes"]) - base_ip_lanes
+                ) * 4
             bpt = max(base_bpt + delta_b, 1.0)
             vps = base_vps * base_bpt / bpt
         else:
@@ -625,6 +705,35 @@ def online_retune(
             compiler.set_hash_lanes(int(lanes))
             daemon.regenerate_all(f"online retune ({trigger})")
             applied["hash_lanes"] = int(lanes)
+        # fused-plane hot-lane widths: the CT row width / ipcache
+        # sub-word knobs feed daemon.datapath_tables, so the NEXT
+        # datapath publish ships the new layout and the store's
+        # cross-layout refusal turns it into exactly one full
+        # upload (candidates only carry these keys when they differ
+        # from the current layout — see retune_candidates)
+        dp_changed = False
+        ct_l = params.get("ct_lanes")
+        if ct_l and int(ct_l) != getattr(
+            daemon, "datapath_ct_lanes", None
+        ):
+            daemon.datapath_ct_lanes = int(ct_l)
+            applied["ct_lanes"] = int(ct_l)
+            dp_changed = True
+        if "ip_subword" in params and bool(
+            params["ip_subword"]
+        ) != bool(getattr(daemon, "datapath_ip_subword", False)):
+            daemon.datapath_ip_subword = bool(params["ip_subword"])
+            applied["ip_subword"] = bool(params["ip_subword"])
+            dp_changed = True
+        if dp_changed:
+            router = getattr(daemon, "mesh_router", None)
+            if router is not None and router.dp_store is not None:
+                try:
+                    router.publish_datapath(
+                        daemon.datapath_tables()
+                    )
+                except Exception:  # noqa: BLE001 — next churn
+                    pass  # publish re-ships the new layout
         _, tables_after, _ = daemon.endpoint_manager.published()
         after_stamp = (
             tables_layout_stamp(tables_after)

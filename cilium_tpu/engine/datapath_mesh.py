@@ -817,7 +817,7 @@ class DatapathStore:
         self.table_axis = table_axis
         self.ntp = int(mesh.shape[table_axis])
         self.partition_digest = partition.datapath_partition_digest(
-            table_axis
+            table_axis, ntp=self.ntp
         )
         self._lock = threading.Lock()
         # each slot: {"dev": device pytree, "host": augmented host
@@ -835,10 +835,17 @@ class DatapathStore:
         # unions the records since the SPARE slot's epoch — the
         # ping-pong means the spare is two publishes old.
         self._change_log: Dict[int, object] = {}
+        # open relayout window (engine/reshard.py): the spare slot
+        # holds the migration target epoch under the NEW ntp/digest;
+        # publish() patches the LIVE slot (non-donated) until the
+        # cutover rebinds mesh/ntp/digest to the target
+        self._relayout: Optional[Dict] = None
 
     # -- internals -----------------------------------------------------------
 
-    def _scatter_fn(self, key: tuple, axis: int):
+    def _scatter_fn(self, key: tuple, axis: int,
+                    donate: bool = True):
+        key = key + (bool(donate),)
         fn = self._scatter_cache.get(key)
         if fn is None:
             def apply(leaf, idx, rows):
@@ -846,8 +853,12 @@ class DatapathStore:
                 return leaf.at[index].set(rows)
 
             fn = tracing.track_jit(
-                jax.jit(apply, donate_argnums=(0,)),
-                "datapath.scatter",
+                jax.jit(
+                    apply,
+                    donate_argnums=(0,) if donate else (),
+                ),
+                "datapath.scatter" if donate
+                else "datapath.scatter_live",
             )
             self._scatter_cache[key] = fn
         return fn
@@ -905,6 +916,21 @@ class DatapathStore:
             for e in list(self._change_log):
                 if e <= self.epoch - 8:
                     del self._change_log[e]
+            if (
+                self._relayout is not None
+                and not self._relayout.get("broken")
+            ):
+                # the spare slot is the staged reshard target: churn
+                # patches the LIVE slot instead (non-donated — fused
+                # dispatches may hold the live pytree), and anything
+                # the delta path cannot absorb breaks the window so
+                # the migration plan restarts as a full upload into
+                # the target layout
+                dev, stats = self._publish_relayout_locked(
+                    dtables, geom, sp
+                )
+                stats.seconds = time.perf_counter() - t0
+                return dev, stats
             spare_i = self._cur ^ 1
             spare = self._slots[spare_i]
             union = (
@@ -928,6 +954,7 @@ class DatapathStore:
                     "dev": dev, "host": aug, "geom": geom,
                     "digest": self.partition_digest,
                     "epoch": self.epoch,
+                    "mesh": self.mesh, "ntp": self.ntp,
                 }
             elif union is not None:
                 dev, stats = self._publish_scoped(
@@ -945,6 +972,7 @@ class DatapathStore:
                     "dev": dev, "host": aug, "geom": geom,
                     "digest": self.partition_digest,
                     "epoch": self.epoch,
+                    "mesh": self.mesh, "ntp": self.ntp,
                 }
             self._slots[spare_i] = slot
             self._cur = spare_i
@@ -955,6 +983,57 @@ class DatapathStore:
                 scattered_rows=stats.scattered_rows,
             )
             return dev, stats
+
+    def _publish_relayout_locked(self, dtables, geom, sp):
+        """Publish while a relayout window is open (caller holds the
+        lock): the live slot absorbs the churn through the row-diff
+        scatter WITHOUT donation (in-flight fused dispatches keep
+        their buffers — the zero-drain seam); a geometry or digest
+        change full-uploads into the live slot and marks the window
+        broken (the plan's deterministic restart trigger)."""
+        live_i = self._cur
+        live = self._slots[live_i]
+        if (
+            live is None
+            or geom != live["geom"]
+            or live["digest"] != self.partition_digest
+        ):
+            aug = partition.replicate_datapath_leaves(
+                dtables, self.ntp, self.table_axis
+            )
+            dev, nbytes = self._full_place(aug)
+            stats = DatapathPublishStats(
+                epoch=self.epoch, mode="full",
+                bytes_h2d=nbytes, seconds=0.0,
+            )
+            slot = {
+                "dev": dev, "host": aug, "geom": geom,
+                "digest": self.partition_digest,
+                "epoch": self.epoch,
+                "mesh": self.mesh, "ntp": self.ntp,
+            }
+            self._relayout["broken"] = True
+            sp.attrs["relayout_broken"] = True
+        else:
+            aug = partition.replicate_datapath_leaves(
+                dtables, self.ntp, self.table_axis
+            )
+            dev, stats = self._publish_delta(
+                aug, live, donate=False
+            )
+            slot = {
+                "dev": dev, "host": aug, "geom": geom,
+                "digest": self.partition_digest,
+                "epoch": self.epoch,
+                "mesh": self.mesh, "ntp": self.ntp,
+            }
+        self._slots[live_i] = slot
+        sp.attrs.update(
+            mode=stats.mode, epoch=stats.epoch,
+            bytes_h2d=stats.bytes_h2d,
+            scattered_rows=stats.scattered_rows, relayout=True,
+        )
+        return dev, stats
 
     def _union_changes(self, spare_epoch: int):
         """Union of the change records for every publish since the
@@ -1093,7 +1172,9 @@ class DatapathStore:
             scattered_rows=n_rows, replaced_leaves=replaced,
         )
 
-    def _publish_delta(self, aug: DatapathTables, spare: dict):
+    def _publish_delta(
+        self, aug: DatapathTables, spare: dict, donate: bool = True
+    ):
         prev = spare["host"]
         n_rows = 0
         bytes_h2d = 0
@@ -1168,7 +1249,8 @@ class DatapathStore:
                     rows, NamedSharding(self.mesh, P())
                 )
                 new_leaf = self._scatter_fn(
-                    (fam, name, int(size), int(axis)), int(axis)
+                    (fam, name, int(size), int(axis)), int(axis),
+                    donate=donate,
                 )(dev_leaf, idx_dev, rows_dev)
                 fam_new.setdefault(fam, {})[name] = new_leaf
                 n_rows += int(changed.size)
@@ -1203,8 +1285,18 @@ class DatapathStore:
 
     def _repair_slot(self, slot: dict, col: int) -> int:
         aug = slot["host"]
+        # a slot created under a DIFFERENT layout than the store's
+        # current one (the pre-cutover source epoch, or the staged
+        # reshard target) repairs in ITS OWN coordinates — column
+        # arithmetic and payload placement follow the slot's mesh
+        ntp = int(slot.get("ntp", self.ntp))
+        mesh = slot.get("mesh", self.mesh)
+        if col >= ntp:
+            # the column does not exist under this slot's layout
+            # (e.g. a grown mesh's new chip vs the source epoch)
+            return 0
         rep_axes = partition.datapath_all_replica_axes(
-            aug, self.ntp, self.table_axis
+            aug, ntp, self.table_axis
         )
         dev = slot["dev"]
         fam_new: Dict[str, Dict[str, object]] = {}
@@ -1213,17 +1305,17 @@ class DatapathStore:
             host_leaf = np.asarray(
                 getattr(getattr(aug, fam), name)
             )
-            per = host_leaf.shape[axis] // self.ntp
+            per = host_leaf.shape[axis] // ntp
             idx = np.arange(
                 col * per, (col + 1) * per, dtype=np.int64
             )
             rows = np.take(host_leaf, idx, axis=axis)
             dev_leaf = getattr(getattr(dev, fam), name)
             idx_dev = jax.device_put(
-                idx, NamedSharding(self.mesh, P())
+                idx, NamedSharding(mesh, P())
             )
             rows_dev = jax.device_put(
-                rows, NamedSharding(self.mesh, P())
+                rows, NamedSharding(mesh, P())
             )
             new_leaf = self._scatter_fn(
                 (fam, name, int(next_pow2(idx.size)), int(axis)),
@@ -1262,6 +1354,307 @@ class DatapathStore:
                 if slot is not None:
                     bytes_h2d += self._repair_slot(slot, col)
             return bytes_h2d
+
+    # -- live elastic resharding (engine/reshard.py drives these) ------------
+
+    def begin_relayout(self, dtables: DatapathTables, target_mesh):
+        """Open a relayout window toward `target_mesh`: stage the
+        fused datapath epoch re-augmented for the target table-axis
+        size into the SPARE slot while the live epoch keeps serving.
+        The staged device epoch is seeded with every MOVED augmented
+        row (compiler.partition.datapath_reshard_moved_rows — rows
+        not device-resident under the source column assignment)
+        ZEROED; the migration scatters (`relayout_scatter`) stream
+        them in, so the cutover's bit-identity proves the streamed
+        bytes.  Returns the moved-row sets ({(family, leaf): (axis,
+        index array)}) — the plan's work queue."""
+        _check_fused_world(dtables)
+        with self._lock, tracing.tracer.span(
+            "datapath.begin_relayout", site="engine.datapath_mesh"
+        ) as sp:
+            if self._relayout is not None:
+                raise RuntimeError(
+                    "datapath relayout window already open"
+                )
+            live = self._slots[self._cur]
+            if live is None:
+                raise RuntimeError(
+                    "no live datapath epoch to reshard from"
+                )
+            ntp_dst = int(target_mesh.shape[self.table_axis])
+            aug = partition.replicate_datapath_leaves(
+                dtables, ntp_dst, self.table_axis
+            )
+            moved = partition.datapath_reshard_moved_rows(
+                dtables, self.ntp, ntp_dst, self.table_axis
+            )
+            digest = partition.datapath_partition_digest(
+                self.table_axis, ntp=ntp_dst
+            )
+            shardings = partition.datapath_table_shardings(
+                target_mesh, aug, self.table_axis
+            )
+            fam_zero: Dict[str, Dict[str, object]] = {}
+            for (fam, name), (axis, idx) in moved.items():
+                idx = np.asarray(idx, np.int64)
+                if idx.size == 0:
+                    continue
+                arr = np.array(
+                    np.asarray(getattr(getattr(aug, fam), name))
+                )
+                arr[(slice(None),) * int(axis) + (idx,)] = 0
+                fam_zero.setdefault(fam, {})[name] = arr
+            seed = aug
+            if fam_zero:
+                fam_objs = {
+                    fam: dataclasses.replace(
+                        getattr(aug, fam), **ups
+                    )
+                    for fam, ups in fam_zero.items()
+                }
+                seed = dataclasses.replace(aug, **fam_objs)
+            dev = jax.tree.map(
+                lambda leaf, s: jax.device_put(
+                    np.asarray(leaf), s
+                ),
+                seed, shardings,
+            )
+            jax.block_until_ready(dev)
+            self.epoch += 1
+            spare_i = self._cur ^ 1
+            self._slots[spare_i] = {
+                "dev": dev, "host": aug,
+                "geom": _geometry(dtables), "digest": digest,
+                "epoch": self.epoch,
+                "mesh": target_mesh, "ntp": ntp_dst,
+            }
+            self._relayout = {
+                "epoch": self.epoch, "mesh": target_mesh,
+                "ntp": ntp_dst, "digest": digest,
+                "shardings": shardings, "broken": False,
+            }
+            sp.attrs.update(
+                epoch=self.epoch, ntp_src=self.ntp,
+                ntp_dst=ntp_dst,
+            )
+            return moved
+
+    def relayout_state(self) -> Optional[Dict]:
+        with self._lock:
+            rel = self._relayout
+            if rel is None:
+                return None
+            return {
+                "epoch": rel["epoch"], "ntp": rel["ntp"],
+                "broken": bool(rel.get("broken")),
+            }
+
+    def relayout_scatter(self, row_sets) -> int:
+        """One bounded migration step: scatter `row_sets`
+        ({(family, leaf): (axis, index array)}) of the STAGED target
+        epoch from its retained augmented host — the datapath analog
+        of DeviceTableStore.repair_rows(spare=True).  The staged
+        buffers are donated (nothing serves from them until
+        cutover).  Returns bytes shipped."""
+        with self._lock:
+            rel = self._relayout
+            if rel is None or rel.get("broken"):
+                raise RuntimeError(
+                    "no open datapath relayout window; scatter "
+                    "refused"
+                )
+            spare_i = self._cur ^ 1
+            slot = self._slots[spare_i]
+            if slot is None or slot["epoch"] != rel["epoch"]:
+                raise RuntimeError(
+                    "staged datapath relayout epoch is gone"
+                )
+            aug = slot["host"]
+            dev = slot["dev"]
+            mesh = rel["mesh"]
+            fam_new: Dict[str, Dict[str, object]] = {}
+            bytes_h2d = 0
+            for fam, name in sorted(row_sets):
+                axis, idx = row_sets[(fam, name)]
+                idx = np.asarray(idx, np.int64)
+                if idx.size == 0:
+                    continue
+                size = next_pow2(idx.size)
+                if size != idx.size:
+                    idx = np.concatenate(
+                        [idx, np.repeat(idx[-1:], size - idx.size)]
+                    )
+                host_leaf = np.asarray(
+                    getattr(getattr(aug, fam), name)
+                )
+                rows = np.take(host_leaf, idx, axis=axis)
+                dev_leaf = getattr(getattr(dev, fam), name)
+                idx_dev = jax.device_put(
+                    idx, NamedSharding(mesh, P())
+                )
+                rows_dev = jax.device_put(
+                    rows, NamedSharding(mesh, P())
+                )
+                new_leaf = self._scatter_fn(
+                    (fam, name, int(size), int(axis)), int(axis)
+                )(dev_leaf, idx_dev, rows_dev)
+                fam_new.setdefault(fam, {})[name] = new_leaf
+                bytes_h2d += int(rows.nbytes + idx.nbytes)
+            if fam_new:
+                fam_objs = {
+                    fam: dataclasses.replace(
+                        getattr(dev, fam), **ups
+                    )
+                    for fam, ups in fam_new.items()
+                }
+                slot["dev"] = dataclasses.replace(dev, **fam_objs)
+                jax.block_until_ready(slot["dev"])
+            return bytes_h2d
+
+    def relayout_update(self, dtables: DatapathTables):
+        """Churn dual-apply: fold a new fused world into the STAGED
+        target epoch's retained host, returning the sharded row sets
+        whose contents changed ({(family, leaf): (axis, augmented
+        index array)}) so the plan can re-queue them (re-streaming
+        an already-migrated row is always safe).  Changed REPLICATED
+        leaves re-place on the staged device immediately.  A
+        geometry change marks the window broken and returns None —
+        the plan restarts as a full upload into the target."""
+        _check_fused_world(dtables)
+        with self._lock:
+            rel = self._relayout
+            if rel is None or rel.get("broken"):
+                raise RuntimeError(
+                    "no open datapath relayout window to update"
+                )
+            spare_i = self._cur ^ 1
+            slot = self._slots[spare_i]
+            if slot is None or slot["epoch"] != rel["epoch"]:
+                raise RuntimeError(
+                    "staged datapath relayout epoch is gone"
+                )
+            if _geometry(dtables) != slot["geom"]:
+                rel["broken"] = True
+                return None
+            ntp = rel["ntp"]
+            aug = partition.replicate_datapath_leaves(
+                dtables, ntp, self.table_axis
+            )
+            prev = slot["host"]
+            dev = slot["dev"]
+            rep_axes = partition.datapath_all_replica_axes(
+                aug, ntp, self.table_axis
+            )
+            changed_sets: Dict[tuple, tuple] = {}
+            fam_new: Dict[str, Dict[str, object]] = {}
+            for fam in (
+                "prefilter", "ipcache", "ct", "lb", "policy",
+                "tunnel",
+            ):
+                new_f = getattr(aug, fam)
+                prev_f = getattr(prev, fam)
+                if new_f is None:
+                    continue
+                new_ch, _ = new_f.tree_flatten()
+                prev_ch, _ = prev_f.tree_flatten()
+                names = _family_leaf_names(new_f)
+                for name, a, b in zip(names, new_ch, prev_ch):
+                    if a is None:
+                        continue
+                    new_np = np.asarray(a)
+                    prev_np = np.asarray(b)
+                    axis = rep_axes.get((fam, name))
+                    if new_np.shape != prev_np.shape:
+                        # shape drift outside the geometry
+                        # signature — refuse into the restart path
+                        rel["broken"] = True
+                        return None
+                    if axis is not None:
+                        mn = np.moveaxis(new_np, axis, 0)
+                        mp = np.moveaxis(prev_np, axis, 0)
+                        chg = np.flatnonzero(
+                            np.any(
+                                mn.reshape(mn.shape[0], -1)
+                                != mp.reshape(mp.shape[0], -1),
+                                axis=1,
+                            )
+                        )
+                        if chg.size:
+                            changed_sets[(fam, name)] = (
+                                int(axis), chg
+                            )
+                    elif not np.array_equal(new_np, prev_np):
+                        sharding = getattr(
+                            getattr(rel["shardings"], fam),
+                            name, None,
+                        ) or NamedSharding(rel["mesh"], P())
+                        fam_new.setdefault(fam, {})[name] = (
+                            jax.device_put(new_np, sharding)
+                        )
+            if fam_new:
+                fam_objs = {
+                    fam: dataclasses.replace(
+                        getattr(dev, fam), **ups
+                    )
+                    for fam, ups in fam_new.items()
+                }
+                slot["dev"] = dataclasses.replace(dev, **fam_objs)
+                jax.block_until_ready(slot["dev"])
+            slot["host"] = aug
+            return changed_sets
+
+    def cutover_relayout(self) -> int:
+        """Flip the staged target epoch live and rebind the store to
+        the target mesh/ntp/digest.  The previous live epoch's
+        buffers are untouched (zero drain); it remains as the
+        source-layout spare, which the next publish full-uploads
+        over (digest mismatch).  Refused while broken."""
+        with self._lock, tracing.tracer.span(
+            "datapath.cutover_relayout", site="engine.datapath_mesh"
+        ) as sp:
+            rel = self._relayout
+            if rel is None:
+                raise RuntimeError(
+                    "no open datapath relayout window"
+                )
+            if rel.get("broken"):
+                raise RuntimeError(
+                    "datapath relayout window broken; cutover "
+                    "refused — restart the migration"
+                )
+            spare_i = self._cur ^ 1
+            slot = self._slots[spare_i]
+            if slot is None or slot["epoch"] != rel["epoch"]:
+                raise RuntimeError(
+                    "staged datapath relayout epoch is gone; "
+                    "cutover refused"
+                )
+            self._cur = spare_i
+            self._relayout = None
+            self.mesh = rel["mesh"]
+            self.ntp = rel["ntp"]
+            self.partition_digest = rel["digest"]
+            self._shardings = rel["shardings"]
+            # change records were keyed against source-layout
+            # epochs; a scoped publish must not union across the
+            # layout seam
+            self._change_log.clear()
+            sp.attrs.update(epoch=slot["epoch"], ntp=self.ntp)
+            return slot["epoch"]
+
+    def rollback_relayout(self) -> bool:
+        """Abandon the staged target epoch (the live source layout
+        was never touched — rollback is a pointer drop)."""
+        with self._lock:
+            rel = self._relayout
+            if rel is None:
+                return False
+            spare_i = self._cur ^ 1
+            slot = self._slots[spare_i]
+            if slot is not None and slot["epoch"] == rel["epoch"]:
+                self._slots[spare_i] = None
+            self._relayout = None
+            return True
 
     def current(self) -> Optional[DatapathTables]:
         with self._lock:

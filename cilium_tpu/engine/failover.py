@@ -244,6 +244,10 @@ class ChipFailoverRouter:
         self.attach_verdict_cache(cache)
         self._memo = {
             "cache": cache,
+            # construction params retained so a mesh reshard can
+            # rebuild the (dp, tp)-shaped cache on the target mesh
+            "n_rows_local": int(n_rows_local),
+            "entries": int(entries),
             "rep_shift": int(rep_shift),
             "evs": {},  # (geom, rep_cap) -> evaluator
             "hits": 0,
@@ -394,6 +398,79 @@ class ChipFailoverRouter:
             )
             self._dp_geom = geom
         return self.dp_store.publish(dtables, changes=changes)
+
+    # -- elastic resharding adoption (engine/reshard.py cutover) -------------
+
+    def adopt_reshard(self, target_mesh, dtables=None) -> None:
+        """Adopt a resharded mesh at cutover: rebuild every router
+        structure that closes over the mesh geometry — the ordinal
+        grid, the failover evaluator, the re-split plan cache, the
+        partitioned memo plane (its cache rows are (dp, tp)-shaped)
+        and the fused-datapath evaluator (`dtables` is the
+        un-augmented fused world; without it the evaluator rebuilds
+        lazily on the next publish_datapath).  The stores' own
+        cutover (DeviceTableStore / DatapathStore .cutover_relayout)
+        is the plan's job — this verb only re-aims the router.  Must
+        run between dispatches (the plan cuts over at a batch
+        boundary); in-flight batches completed on the source epoch,
+        which is never touched."""
+        from cilium_tpu.engine.sharded import (
+            make_failover_evaluator,
+        )
+
+        axes = list(target_mesh.axis_names)
+        self.mesh = target_mesh
+        self.dp = int(target_mesh.shape[self.batch_axis])
+        self.tp = int(target_mesh.shape[self.table_axis])
+        grid = np.empty((self.dp, self.tp), np.int64)
+        for idx, dev in np.ndenumerate(target_mesh.devices):
+            coord = dict(zip(axes, idx))
+            grid[
+                coord[self.batch_axis], coord[self.table_axis]
+            ] = int(dev.id)
+        self.ordinals = grid
+        self._ev = make_failover_evaluator(
+            target_mesh, self._tables, batch_axis=self.batch_axis,
+            table_axis=self.table_axis,
+            collect_telemetry=self.collect_telemetry,
+        )
+        self._pack_plans.clear()
+        if self._memo is not None:
+            # the sharded cache's rows are laid out per (dp, tp)
+            # chip — rebuild it empty on the target mesh (a flush
+            # with a layout change), carrying the counters across
+            carried = self._memo
+            self.attach_memo(
+                n_rows_local=carried["n_rows_local"],
+                entries=carried["entries"],
+                rep_shift=carried["rep_shift"],
+            )
+            for k in (
+                "hits", "misses", "overflow_redispatches",
+                "insert_faults",
+            ):
+                self._memo[k] = carried[k]
+        elif self._verdict_cache is not None:
+            self._verdict_cache.flush(reason="mesh reshard cutover")
+        if self.dp_store is not None:
+            self._dp_ev = None
+            self._dp_geom = None
+            if dtables is not None:
+                from cilium_tpu.engine.datapath_mesh import (
+                    _geometry,
+                    make_failover_datapath_evaluator,
+                )
+
+                self._dp_ev = make_failover_datapath_evaluator(
+                    target_mesh, dtables,
+                    batch_axis=self.batch_axis,
+                    table_axis=self.table_axis,
+                    collect_telemetry=self.collect_telemetry,
+                )
+                self._dp_geom = _geometry(dtables)
+        tracing.add_event(
+            "reshard.adopt", dp=self.dp, tp=self.tp,
+        )
 
     def dispatch_flows(
         self,
@@ -642,9 +719,33 @@ class ChipFailoverRouter:
             return 0, 0.0
         t0 = time.perf_counter()
         try:
+            # the row arithmetic below (_owned_row_sets) runs under
+            # the ROUTER's serving layout (self.tp / self._tables);
+            # each repair must land on an epoch laid out under the
+            # SAME partition digest, or the scatter would plant rows
+            # computed under one column assignment into an epoch
+            # keyed by another — the readmit-races-reshard hazard: a
+            # mid-migration readmission sees the staged TARGET
+            # epoch in the spare slot and must refuse (the chip
+            # stays out; post-cutover readmission replays its whole
+            # owned regions under the new layout instead)
+            serving_digest = int(self.store.partition_digest)
+            for which in ("live_layout", "spare_layout"):
+                lay = outage.get(which)
+                if lay is not None and (lay >> 32) != serving_digest:
+                    raise RuntimeError(
+                        f"chip {int(ordinal)} readmission races a "
+                        f"mesh relayout ({which} digest "
+                        f"{lay >> 32:#x} != serving "
+                        f"{serving_digest:#x}); repair refused"
+                    )
             row_sets = self._owned_row_sets(ordinal, outage)
             bytes_h2d = (
-                self.store.repair_rows(row_sets) if row_sets else 0
+                self.store.repair_rows(
+                    row_sets,
+                    expect_layout=outage.get("live_layout"),
+                )
+                if row_sets else 0
             )
             if outage.get("spare_stale"):
                 spare_sets = self._whole_owned_row_sets(ordinal)
@@ -652,6 +753,7 @@ class ChipFailoverRouter:
                     bytes_h2d += self.store.repair_rows(
                         spare_sets, spare=True,
                         expect_epoch=outage.get("spare_epoch"),
+                        expect_layout=outage.get("spare_layout"),
                     )
         except Exception:
             # the scatter may have partially landed — put the popped

@@ -184,7 +184,16 @@ class DeviceTableStore:
         self._out_chips: Dict[int, Dict] = {}
         self._missed_cap = 32
         self._apply_cache: Dict[tuple, object] = {}
+        self._apply_nodonate_cache: Dict[tuple, object] = {}
         self._repair_cache: Dict[tuple, object] = {}
+        # open relayout window (engine/reshard.py): while set, the
+        # SPARE slot holds the migration target epoch (laid out
+        # under the NEW digest) and publish() must not consume it —
+        # churn deltas patch the LIVE slot in place (non-donated),
+        # full publishes replace the live slot AND mark the window
+        # broken (the plan's deterministic full-upload-into-target
+        # restart)
+        self._relayout: Optional[Dict] = None
 
     # -- device placement ----------------------------------------------------
 
@@ -220,14 +229,18 @@ class DeviceTableStore:
 
     # -- scatter updater -----------------------------------------------------
 
-    def _apply_fn(self, fields: Tuple[str, ...]):
-        """Jitted donated scatter: patch `fields` of the spare epoch
-        in place and stamp the new generation.  Cached per field set
-        (payload shapes are pow2-padded, so the per-set jit cache
-        stays small)."""
+    def _apply_fn(self, fields: Tuple[str, ...], donate: bool = True):
+        """Jitted scatter: patch `fields` of an epoch and stamp the
+        new generation.  Cached per field set (payload shapes are
+        pow2-padded, so the per-set jit cache stays small).  With
+        `donate=False` the input pytree's buffers are NOT consumed —
+        the publish-during-relayout path patches the LIVE epoch into
+        a fresh pytree while batches may still be in flight against
+        the old one (the zero-drain seam)."""
         import jax
 
-        fn = self._apply_cache.get(fields)
+        cache = self._apply_cache if donate else self._apply_nodonate_cache
+        fn = cache.get(fields)
         if fn is not None:
             return fn
 
@@ -242,9 +255,10 @@ class DeviceTableStore:
         # payload outside the known pow2 classes shows up as a miss +
         # compile seconds in the same scrape as the publish bytes
         fn = tracing.track_jit(
-            jax.jit(apply, donate_argnums=(0,)), "publish.scatter"
+            jax.jit(apply, donate_argnums=(0,) if donate else ()),
+            "publish.scatter" if donate else "publish.scatter_live",
         )
-        self._apply_cache[fields] = fn
+        cache[fields] = fn
         return fn
 
     # -- publication ---------------------------------------------------------
@@ -275,6 +289,17 @@ class DeviceTableStore:
             spare_i = self._cur ^ 1
             spare = self._slots[spare_i]
             stamp = int(np.asarray(tables.generation))
+            if (
+                self._relayout is not None
+                and not self._relayout.get("broken")
+            ):
+                # the spare slot is the staged migration target —
+                # churn must not consume it (engine/reshard.py keeps
+                # the target current through the plan's dual-apply)
+                return self._publish_relayout_locked(
+                    tables, pre_transform, delta, layout, stamp,
+                    sp, t0,
+                )
             use_delta = (
                 delta is not None
                 and spare is not None
@@ -345,6 +370,7 @@ class DeviceTableStore:
                 "nbytes": tables_nbytes(tables), "layout": layout,
                 "chip_bytes": _chip_resident_bytes(dev),
                 "host": tables if self._retain_host else None,
+                "shardings": self._shardings,
             }
             self._cur = spare_i
             stats.epoch = self._epoch
@@ -370,6 +396,296 @@ class DeviceTableStore:
                 replaced_leaves=stats.replaced_leaves,
             )
             return dev, stats
+
+    def _publish_relayout_locked(
+        self, tables, pre_transform, delta, layout, stamp, sp, t0
+    ):
+        """Publish while a relayout window is open (caller holds the
+        lock).  The spare slot is the staged migration target and
+        must not be consumed, so churn lands on the LIVE slot:
+
+          * a valid delta against the live epoch patches it through a
+            NON-donated scatter — the previous pytree's buffers stay
+            intact for every batch still in flight against them (the
+            zero-drain seam; the old pytree is simply dropped when
+            the last reference goes);
+          * anything else (stale delta, shape-class change, a fault
+            on the scatter seam) full-uploads into the live slot and
+            marks the window BROKEN: the migration plan observes the
+            flag and deterministically restarts as a full upload into
+            the target layout.
+        """
+        import jax
+
+        live_i = self._cur
+        live = self._slots[live_i]
+        use_delta = (
+            delta is not None
+            and live is not None
+            and live["stamp"] == delta.base_stamp
+            and stamp == delta.new_stamp
+            and live["layout"] == layout
+            and (delta.layout & _LAYOUT_LANES_MASK)
+            == (layout & _LAYOUT_LANES_MASK)
+        )
+        if use_delta and self._delta_transform_fn is not None:
+            delta = self._delta_transform_fn(delta, pre_transform)
+        if use_delta:
+            try:
+                dev, stats = self._publish_delta(
+                    live["tables"], tables, delta, donate=False
+                )
+            except faultinject.FaultInjected as exc:
+                # nothing was donated — the live epoch is intact,
+                # but the scatter path is poisoned: serve this
+                # publish as a full upload (which breaks the window
+                # below, the plan's deterministic restart trigger)
+                use_delta = False
+                metrics.publish_fallback_total.inc()
+                sp.attrs["fallback"] = str(exc)
+                log.warning(
+                    "delta publish scatter faulted during relayout; "
+                    "falling back to full upload",
+                    extra={"fields": {"error": str(exc)}},
+                )
+        if not use_delta:
+            dev = self._put_tables(tables)
+            jax.block_until_ready(dev)
+            stats = PublishStats(
+                epoch=0, mode="full",
+                bytes_h2d=tables_nbytes(tables), seconds=0.0,
+            )
+            self._relayout["broken"] = True
+            sp.attrs["relayout_broken"] = True
+        self._epoch += 1
+        self._slots[live_i] = {
+            "tables": dev, "stamp": stamp, "epoch": self._epoch,
+            "nbytes": tables_nbytes(tables), "layout": layout,
+            "chip_bytes": _chip_resident_bytes(dev),
+            "host": tables if self._retain_host else None,
+            "shardings": self._shardings,
+        }
+        stats.epoch = self._epoch
+        stats.seconds = time.perf_counter() - t0
+        for rec in self._out_chips.values():
+            if (
+                use_delta
+                and not rec["needs_full"]
+                and len(rec["missed"]) < self._missed_cap
+            ):
+                rec["missed"].append(delta)
+            else:
+                rec["needs_full"] = True
+        self._sample_bytes()
+        sp.attrs.update(
+            mode=stats.mode, epoch=stats.epoch,
+            bytes_h2d=stats.bytes_h2d,
+            scatter_leaves=stats.scatter_leaves,
+            replaced_leaves=stats.replaced_leaves, relayout=True,
+        )
+        return dev, stats
+
+    # -- live elastic resharding (engine/reshard.py drives these) ------------
+
+    def begin_relayout(
+        self, host_aug, moved_rows, shardings, partition_digest
+    ) -> Tuple[int, int]:
+        """Open a relayout window: install the migration TARGET
+        epoch (already transformed/augmented for the target mesh)
+        into the SPARE slot while the live epoch keeps serving.
+
+        `moved_rows` ({leaf: (axis, index array)} from
+        compiler.partition.reshard_moved_rows) names every augmented
+        row whose bytes are NOT device-resident under the source
+        column assignment.  The staged device epoch is seeded from
+        `host_aug` with those rows ZEROED — the epoch only becomes
+        correct as the migration scatters (repair_rows(spare=True))
+        stream them in, so cutover bit-identity proves the streamed
+        bytes rather than the seed.  The TRUE target host is
+        retained on the slot as the scatter's value source.
+
+        Returns (epoch, layout) — the pins every subsequent
+        migration step must present."""
+        import jax
+
+        with self._lock, tracing.tracer.span(
+            "publish.begin_relayout", site="engine.publish"
+        ) as sp:
+            if self._relayout is not None:
+                raise RuntimeError("relayout window already open")
+            if self._slots[self._cur] is None:
+                raise RuntimeError("no live epoch to reshard from")
+            layout = tables_layout_version(host_aug) | (
+                int(partition_digest) << 32
+            )
+            kw = {}
+            for name, (axis, idx) in moved_rows.items():
+                arr = np.array(np.asarray(getattr(host_aug, name)))
+                idx = np.asarray(idx, np.int64)
+                if idx.size:
+                    arr[(slice(None),) * int(axis) + (idx,)] = 0
+                kw[name] = arr
+            seed = (
+                dataclasses.replace(host_aug, **kw) if kw else host_aug
+            )
+            dev = jax.tree.map(
+                lambda leaf, s: (
+                    None if leaf is None else jax.device_put(leaf, s)
+                ),
+                seed, shardings,
+                is_leaf=lambda x: x is None,
+            )
+            jax.block_until_ready(dev)
+            self._epoch += 1
+            spare_i = self._cur ^ 1
+            self._slots[spare_i] = {
+                "tables": dev,
+                "stamp": int(np.asarray(host_aug.generation)),
+                "epoch": self._epoch,
+                "nbytes": tables_nbytes(host_aug),
+                "layout": layout,
+                "chip_bytes": _chip_resident_bytes(dev),
+                "host": host_aug,
+                "shardings": shardings,
+            }
+            self._relayout = {
+                "epoch": self._epoch, "layout": layout,
+                "broken": False, "shardings": shardings,
+                "digest": int(partition_digest),
+            }
+            self._sample_bytes()
+            sp.attrs.update(epoch=self._epoch, layout=layout)
+            return self._epoch, layout
+
+    def relayout_state(self) -> Optional[Dict]:
+        """{"epoch", "layout", "broken"} of the open relayout
+        window, or None — the plan's restart detector."""
+        with self._lock:
+            rel = self._relayout
+            if rel is None:
+                return None
+            return {
+                "epoch": rel["epoch"], "layout": rel["layout"],
+                "broken": bool(rel.get("broken")),
+            }
+
+    def relayout_update_host(self, host_aug) -> Tuple[int, int]:
+        """Replace the staged target epoch's retained host — the
+        churn dual-apply: migration scatters issued after this read
+        the NEW values (the plan re-queues rows whose contents
+        changed), and the staged epoch's generation leaf is
+        re-placed on device so its stamp tracks the live world.
+        Refused when no window is open or the window broke."""
+        import jax
+
+        with self._lock:
+            rel = self._relayout
+            if rel is None or rel.get("broken"):
+                raise RuntimeError(
+                    "no open relayout window to update"
+                )
+            spare_i = self._cur ^ 1
+            slot = self._slots[spare_i]
+            if slot is None or slot["epoch"] != rel["epoch"]:
+                raise RuntimeError("staged relayout epoch is gone")
+            stamp = int(np.asarray(host_aug.generation))
+            gen_dev = jax.device_put(
+                np.uint64(np.asarray(host_aug.generation)),
+                rel["shardings"].generation,
+            )
+            # non-donated replace: only the generation leaf is
+            # re-placed; the table leaves stay resident and the
+            # migration scatters keep patching them
+            slot["tables"] = dataclasses.replace(
+                slot["tables"], generation=gen_dev
+            )
+            layout = tables_layout_version(host_aug) | (
+                rel["digest"] << 32
+            )
+            slot["host"] = host_aug
+            slot["stamp"] = stamp
+            slot["nbytes"] = tables_nbytes(host_aug)
+            slot["layout"] = layout
+            rel["layout"] = layout
+            return slot["epoch"], layout
+
+    def cutover_relayout(
+        self,
+        shardings_fn=None,
+        partition_digest=None,
+        transform_fn=None,
+        delta_transform_fn=None,
+    ) -> int:
+        """Flip the staged target epoch live — the reshard cutover.
+        Zero-drain by construction: the previous live epoch's
+        buffers are never donated or touched; it remains resident as
+        the source-layout spare, whose next delta publish is
+        layout-refused (the digests differ by ntp) into exactly one
+        full upload, after which deltas resume.  Rebinds the store's
+        partition seams (sharding resolver, digest, augmentation,
+        delta rewrite) so subsequent publishes land under the NEW
+        layout.  Refused while broken — the migration must restart
+        instead of cutting over to a stale target."""
+        with self._lock, tracing.tracer.span(
+            "publish.cutover_relayout", site="engine.publish"
+        ) as sp:
+            rel = self._relayout
+            if rel is None:
+                raise RuntimeError("no open relayout window")
+            if rel.get("broken"):
+                raise RuntimeError(
+                    "relayout window broken by a full publish; "
+                    "cutover refused — restart the migration"
+                )
+            spare_i = self._cur ^ 1
+            slot = self._slots[spare_i]
+            if slot is None or slot["epoch"] != rel["epoch"]:
+                raise RuntimeError(
+                    "staged relayout epoch is gone; cutover refused"
+                )
+            self._cur = spare_i
+            self._relayout = None
+            self._shardings = rel["shardings"]
+            if shardings_fn is not None:
+                self._shardings_fn = shardings_fn
+            if partition_digest is not None:
+                self.partition_digest = int(partition_digest)
+            if transform_fn is not None:
+                self._transform_fn = transform_fn
+            if delta_transform_fn is not None:
+                self._delta_transform_fn = delta_transform_fn
+            self._retain_host = (
+                self._transform_fn is not None
+                or self._delta_transform_fn is not None
+            )
+            # jit entries traced against the source mesh would pin
+            # stale executables (and their donated-buffer shapes)
+            self._apply_cache.clear()
+            self._apply_nodonate_cache.clear()
+            self._repair_cache.clear()
+            self._sample_bytes()
+            sp.attrs.update(
+                epoch=slot["epoch"], layout=slot["layout"]
+            )
+            return slot["epoch"]
+
+    def rollback_relayout(self) -> bool:
+        """Abandon the staged target epoch: the spare slot is
+        dropped (nothing was ever donated from the live epoch, so
+        the fully-consistent source layout keeps serving untouched)
+        and the next publish full-uploads into the freed slot.
+        Returns True when a window was open."""
+        with self._lock:
+            rel = self._relayout
+            if rel is None:
+                return False
+            spare_i = self._cur ^ 1
+            slot = self._slots[spare_i]
+            if slot is not None and slot["epoch"] == rel["epoch"]:
+                self._slots[spare_i] = None
+            self._relayout = None
+            self._sample_bytes()
+            return True
 
     def _sample_bytes(self) -> None:
         """cilium_device_table_bytes{epoch}: per-slot resident bytes,
@@ -397,6 +713,7 @@ class DeviceTableStore:
         spare_dev: PolicyTables,
         tables: PolicyTables,
         delta: TableDelta,
+        donate: bool = True,
     ):
         import jax
 
@@ -453,7 +770,9 @@ class DeviceTableStore:
                 )
                 bytes_h2d += delta.updates[name].nbytes
                 n_scatter += 1
-            dev = self._apply_fn(fields)(base, tuple(payloads), gen_dev)
+            dev = self._apply_fn(fields, donate=donate)(
+                base, tuple(payloads), gen_dev
+            )
         else:
             dev = dataclasses.replace(base, generation=gen_dev)
         jax.block_until_ready(dev)
@@ -557,7 +876,21 @@ class DeviceTableStore:
             rec = self._out_chips.pop(int(ordinal), None)
             if rec is None:
                 return None
+            live = self._slots[self._cur]
             spare = self._slots[self._cur ^ 1]
+            # layout pins for the repair scatters: a readmission
+            # racing an in-flight migration must repair each epoch
+            # against the layout THAT slot actually holds — the
+            # caller computed its owned-row sets under one column
+            # assignment, and scattering them into an epoch laid out
+            # under another (e.g. the staged reshard target) would
+            # plant source-layout rows in a target-layout spare
+            rec["live_layout"] = (
+                None if live is None else live["layout"]
+            )
+            rec["spare_layout"] = (
+                None if spare is None else spare["layout"]
+            )
             if spare is not None and spare["epoch"] > rec["epoch"]:
                 if spare.get("host") is not None:
                     rec["spare_stale"] = True
@@ -622,6 +955,7 @@ class DeviceTableStore:
         row_sets: Dict[str, Tuple[int, object]],
         spare: bool = False,
         expect_epoch: Optional[int] = None,
+        expect_layout: Optional[int] = None,
     ) -> int:
         """Rewrite `row_sets` ({leaf: (axis, index array)}) of the
         LIVE epoch from its retained host arrays — the re-admission
@@ -663,12 +997,41 @@ class DeviceTableStore:
                     f"(epoch {slot['epoch']} != expected "
                     f"{expect_epoch}); repair refused"
                 )
+            if (
+                expect_layout is not None
+                and slot["layout"] != expect_layout
+            ):
+                # the caller's index arithmetic assumed a different
+                # column assignment / pack layout than this epoch
+                # actually holds (an in-flight reshard re-laid the
+                # slot out) — scattering would plant rows computed
+                # under one layout into an epoch keyed by another
+                raise RuntimeError(
+                    f"{which} epoch layout changed since "
+                    f"readmission (layout {slot['layout']:#x} != "
+                    f"expected {int(expect_layout):#x}); repair "
+                    "refused"
+                )
             host = slot.get("host")
             if host is None:
                 raise RuntimeError(
                     f"{which} epoch retains no host source; repair "
                     "requires a publish through this store"
                 )
+            # payloads must land on the SLOT's mesh, not the store's
+            # current one: during a relayout the staged epoch lives
+            # on the target mesh while self._shardings still resolves
+            # against the source — mixing meshes in one jit call is
+            # an error, so each slot remembers its own shardings
+            slot_sh = slot.get("shardings", self._shardings)
+
+            def put(value):
+                import jax as _jax
+
+                if slot_sh is None:
+                    return _jax.device_put(value)
+                return _jax.device_put(value, slot_sh.generation)
+
             fields, axes, payloads = [], [], []
             bytes_h2d = 0
             for name in sorted(row_sets):
@@ -689,7 +1052,7 @@ class DeviceTableStore:
                 )
                 fields.append(name)
                 axes.append(int(axis))
-                payloads.append((self._put(idx), self._put(values)))
+                payloads.append((put(idx), put(values)))
                 bytes_h2d += idx.nbytes + values.nbytes
             if not fields:
                 return 0

@@ -1,0 +1,746 @@
+"""Live elastic resharding: stop-free mesh growth/shrink with
+incremental row migration, mid-migration fault tolerance, and
+rollback.
+
+A shard-count change is DATA MOVEMENT, not a redeploy.  The N+1
+augmented replica layout is ntp-invariant in total shape (a sharded
+axis [S] is [2S] under any table-axis size — compiler/partition.py),
+so re-sharding tp_src -> tp_dst is a pure index permutation of the
+augmented layout, and the permutation's owned-row delta
+(partition.reshard_moved_rows / datapath_reshard_moved_rows) names
+exactly the augmented rows whose bytes are not already resident
+under the target column assignment.  A ReshardPlan treats that delta
+as a migration work queue:
+
+  * `begin()` opens a relayout window on the policy replica store
+    (DeviceTableStore.begin_relayout) and, when a fused plane is
+    attached, the DatapathStore — the standby epoch slot is seeded
+    with the target layout, every MOVED row zeroed, while the live
+    epoch keeps serving untouched (epoch double-buffering is the
+    cutover seam);
+  * `step()` streams one bounded-byte batch of moved rows into the
+    staged epoch through the SAME scatter machinery chip
+    re-admission uses (repair_rows / relayout_scatter), probing the
+    `reshard.migrate` fault site once per target-column chip it is
+    about to write;
+  * `on_publish()` is the churn dual-apply: a control-plane publish
+    during the window patches the LIVE epoch in place (the stores'
+    publish-during-relayout path, non-donated — zero drain) and the
+    plan folds the same change into the staged TARGET host,
+    re-queueing every augmented row whose contents changed
+    (re-streaming an already-migrated row is always safe).  Churn
+    the window cannot absorb (geometry change, full upload, a
+    publish nobody dual-applied) deterministically RESTARTS the
+    migration as a full streamed upload into the target layout —
+    never a half-consistent cutover;
+  * `cutover()` flips both stores (the staged epoch becomes live
+    under the new layout stamp, the old live epoch stays resident as
+    the source-layout spare whose next delta publish is
+    layout-refused into exactly one full upload), re-aims the router
+    (ChipFailoverRouter.adopt_reshard), and closes any armed shadow
+    window `stale` (ShadowPlane.notify_cutover) — the serving stream
+    never drains;
+  * a chip kill mid-migration (`reshard.migrate` firing, or a real
+    breaker event) either COMPLETES via the survivors' replica
+    copies — the dead column's own rows are dropped from the queue,
+    its data remains reachable through the backup copies streamed to
+    its right neighbour, and the breaker bank routes reads there
+    after cutover — or ROLLS BACK by dropping the staged epoch (the
+    fully-consistent source layout was never touched).
+
+Simulation boundary: on the virtual CPU mesh every SPMD scatter
+lands on all devices, so what the plan measures is the migration
+TRAFFIC a real topology would ship — `reshard_bytes_h2d` counts the
+streamed moved-owner rows (O(rows whose owner changed), never
+O(world) except on an explicit full restart), benched against the
+stop-the-world full-upload comparator in tools/reshardprof.py.  The
+target mesh keeps every surviving source column on its original
+devices (reshard_target_mesh), so "retained row, zero bytes" is a
+statement about real placement, not bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu import faultinject, tracing
+from cilium_tpu.compiler import partition
+from cilium_tpu.compiler.tables import split_hot
+from cilium_tpu.logging import get_logger
+from cilium_tpu.metrics import registry as metrics
+
+log = get_logger("reshard")
+
+# default per-step streaming budget: raw payload bytes per migration
+# step (pow2 padding in the scatter path can at most double it)
+DEFAULT_STEP_BYTES = 1 << 20
+
+
+def reshard_target_mesh(router, target_tp: int):
+    """Build the target mesh for a table-axis resize, keeping every
+    SURVIVING source column on its original devices (column identity
+    is what makes a retained row genuinely device-resident) and
+    assigning new columns the next free devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    target_tp = int(target_tp)
+    dp, tp_src = router.dp, router.tp
+    devs_by_id = {int(d.id): d for d in jax.devices()}
+    used = {int(x) for x in router.ordinals.ravel()}
+    free = [d for d in jax.devices() if int(d.id) not in used]
+    grow_cols = max(0, target_tp - tp_src)
+    if len(free) < dp * grow_cols:
+        raise ValueError(
+            f"reshard to tp={target_tp} needs {dp * grow_cols} free "
+            f"devices, have {len(free)}"
+        )
+    grid = np.empty((dp, target_tp), dtype=object)
+    for r in range(dp):
+        for c in range(target_tp):
+            if c < tp_src:
+                grid[r, c] = devs_by_id[int(router.ordinals[r, c])]
+            else:
+                grid[r, c] = free.pop(0)
+    return Mesh(grid, (router.batch_axis, router.table_axis))
+
+
+class ReshardPlan:
+    """One live migration tp_src -> tp_dst over a ChipFailoverRouter.
+
+    Drive it with `run()` (begin -> bounded steps -> cutover), or
+    call `begin()` / `step()` / `cutover()` / `rollback()` yourself
+    to interleave serving, churn (`on_publish`) and fault injection
+    between steps.  `on_fault` picks the mid-migration chip-kill
+    policy: "complete" (drop the dead column's own rows — its data
+    survives in the replica copies streamed to its backup owner —
+    and open its breakers so post-cutover routing reads the backups)
+    or "rollback" (drop the staged epoch; the source layout never
+    stopped serving)."""
+
+    def __init__(
+        self,
+        router,
+        target_mesh,
+        step_bytes: int = DEFAULT_STEP_BYTES,
+        on_fault: str = "complete",
+        dtables=None,
+        shadow=None,
+    ) -> None:
+        if on_fault not in ("complete", "rollback"):
+            raise ValueError(
+                f"on_fault must be 'complete' or 'rollback', got "
+                f"{on_fault!r}"
+            )
+        self.router = router
+        self.target_mesh = target_mesh
+        self.step_bytes = max(int(step_bytes), 1)
+        self.on_fault = on_fault
+        self.shadow = shadow
+        self.table_axis = router.table_axis
+        self.ntp_src = int(router.tp)
+        self.ntp_dst = int(target_mesh.shape[self.table_axis])
+        # un-augmented fused datapath world (required when the
+        # router has a datapath plane attached); refreshed by
+        # on_publish(dtables=...)
+        self._dtables = dtables
+        self._pending: deque = deque()
+        self._policy_host = None  # staged TARGET augmented host
+        self._pins: Optional[Tuple[int, int]] = None  # (epoch, layout)
+        self._live_stamp_seen = None
+        self._dp_epoch_seen = None
+        self._dead_cols: set = set()
+        self.state = "idle"  # idle|migrating|done|rolled_back
+        self.stats = {
+            "steps": 0, "bytes_h2d": 0, "restarts": 0,
+            "dead_cols": [], "outcome": None, "ms": 0.0,
+            "queued_items": 0,
+        }
+        self._t0 = None
+
+    # -- work-queue construction ---------------------------------------------
+
+    def _enqueue(self, plane: str, key, axis: int, idx, block):
+        """Split one leaf's row set into bounded-byte chunks.
+        `block` is the augmented rows-per-target-column stride (None
+        for replicated leaves, which land on every column)."""
+        idx = np.asarray(idx, np.int64)
+        if idx.size == 0:
+            return
+        host = (
+            self._policy_host if plane == "policy"
+            else self._dp_host()
+        )
+        leaf = (
+            getattr(host, key) if plane == "policy"
+            else getattr(getattr(host, key[0]), key[1])
+        )
+        arr = np.asarray(leaf)
+        row_bytes = max(arr.nbytes // max(arr.shape[axis], 1), 1)
+        per = max(1, self.step_bytes // row_bytes)
+        for lo in range(0, idx.size, per):
+            chunk = idx[lo: lo + per]
+            cols = (
+                tuple(range(self.ntp_dst)) if block is None
+                else tuple(
+                    int(c) for c in np.unique(chunk // block)
+                )
+            )
+            self._pending.append({
+                "plane": plane, "key": key, "axis": int(axis),
+                "idx": chunk, "block": block, "cols": cols,
+                "bytes": int(chunk.size * row_bytes + chunk.nbytes),
+            })
+            self.stats["queued_items"] += 1
+
+    def _dp_host(self):
+        slot = self.router.dp_store._slots[
+            self.router.dp_store._cur ^ 1
+        ]
+        return slot["host"]
+
+    def _policy_tables(self, tables):
+        """The store-visible host layout of `tables` (hot split when
+        the store is hot-only), before augmentation."""
+        store = self.router.store
+        return split_hot(tables) if store._hot_only else tables
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def begin(self) -> "ReshardPlan":
+        """Open the relayout windows and build the moved-row queue.
+        The live epochs keep serving; nothing is drained."""
+        if self.state != "idle":
+            raise RuntimeError(f"plan already {self.state}")
+        router = self.router
+        store = router.store
+        if store._transform_fn is None:
+            raise ValueError(
+                "resharding requires a replica store "
+                "(engine.sharded.make_replica_store)"
+            )
+        self._t0 = time.perf_counter()
+        t = self._policy_tables(router._tables)
+        moved = partition.reshard_moved_rows(
+            t, self.ntp_src, self.ntp_dst, self.table_axis
+        )
+        host_aug = partition.replicate_table_leaves(
+            t, self.ntp_dst, self.table_axis
+        )
+        shardings = partition.table_shardings(
+            self.target_mesh, host_aug, self.table_axis
+        )
+        digest = partition.replica_partition_digest(
+            self.table_axis, ntp=self.ntp_dst
+        )
+        self._policy_host = host_aug
+        self._pins = store.begin_relayout(
+            host_aug, moved, shardings, digest
+        )
+        for name, (axis, idx) in sorted(moved.items()):
+            n_aug = int(
+                np.asarray(getattr(host_aug, name)).shape[axis]
+            )
+            self._enqueue(
+                "policy", name, axis, idx, n_aug // self.ntp_dst
+            )
+        if router.dp_store is not None:
+            if self._dtables is None:
+                raise ValueError(
+                    "router has a fused datapath plane: pass "
+                    "dtables (the un-augmented fused world) to "
+                    "ReshardPlan"
+                )
+            dmoved = router.dp_store.begin_relayout(
+                self._dtables, self.target_mesh
+            )
+            dhost = self._dp_host()
+            for (fam, leaf), (axis, idx) in sorted(dmoved.items()):
+                n_aug = int(
+                    np.asarray(
+                        getattr(getattr(dhost, fam), leaf)
+                    ).shape[axis]
+                )
+                self._enqueue(
+                    "datapath", (fam, leaf), axis, idx,
+                    n_aug // self.ntp_dst,
+                )
+            self._dp_epoch_seen = router.dp_store.epoch
+        self._live_stamp_seen = store.current_stamp()
+        self.state = "migrating"
+        tracing.add_event(
+            "reshard.begin", ntp_src=self.ntp_src,
+            ntp_dst=self.ntp_dst,
+            queued=len(self._pending),
+        )
+        return self
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    # -- churn dual-apply ----------------------------------------------------
+
+    def on_publish(self, tables, dtables=None) -> None:
+        """Fold a control-plane publish (which just patched the LIVE
+        epochs through the stores' relayout-aware path) into the
+        staged TARGET: rebuild the target augmented host, diff it
+        against the kept one, and re-queue every augmented row whose
+        contents changed.  Churn the window cannot absorb marks the
+        plan for a deterministic full-into-target restart."""
+        if self.state != "migrating":
+            return
+        store = self.router.store
+        rel = store.relayout_state()
+        if rel is None or rel["broken"]:
+            self._restart_full()
+            return
+        t = self._policy_tables(tables)
+        new_aug = partition.replicate_table_leaves(
+            t, self.ntp_dst, self.table_axis
+        )
+        old_aug = self._policy_host
+        rep = partition.replica_axes(t, self.ntp_dst, self.table_axis)
+        queue: List[Tuple[str, int, np.ndarray, Optional[int]]] = []
+        for f in dataclasses.fields(type(new_aug)):
+            name = f.name
+            if name == "generation":
+                continue
+            old = getattr(old_aug, name)
+            new = getattr(new_aug, name)
+            if old is None and new is None:
+                continue
+            if (
+                old is None
+                or new is None
+                or np.asarray(old).shape != np.asarray(new).shape
+            ):
+                # a leaf appeared/vanished/resized: layout change
+                self._restart_full()
+                return
+            old_np, new_np = np.asarray(old), np.asarray(new)
+            axis = rep.get(name)
+            if axis is not None:
+                mo = np.moveaxis(old_np, axis, 0)
+                mn = np.moveaxis(new_np, axis, 0)
+                chg = np.flatnonzero(
+                    np.any(
+                        mn.reshape(mn.shape[0], -1)
+                        != mo.reshape(mo.shape[0], -1),
+                        axis=1,
+                    )
+                )
+                if chg.size:
+                    queue.append((
+                        name, axis, chg,
+                        new_np.shape[axis] // self.ntp_dst,
+                    ))
+            elif not np.array_equal(old_np, new_np):
+                queue.append((
+                    name, 0,
+                    np.arange(new_np.shape[0], dtype=np.int64),
+                    None,
+                ))
+        self._policy_host = new_aug
+        self._pins = store.relayout_update_host(new_aug)
+        for name, axis, idx, block in queue:
+            self._enqueue("policy", name, axis, idx, block)
+        self._live_stamp_seen = store.current_stamp()
+        if self.router.dp_store is not None and dtables is not None:
+            self._dtables = dtables
+            changed = self.router.dp_store.relayout_update(dtables)
+            if changed is None:
+                self._restart_full()
+                return
+            dhost = self._dp_host()
+            for (fam, leaf), (axis, idx) in sorted(changed.items()):
+                n_aug = int(
+                    np.asarray(
+                        getattr(getattr(dhost, fam), leaf)
+                    ).shape[axis]
+                )
+                self._enqueue(
+                    "datapath", (fam, leaf), axis, idx,
+                    n_aug // self.ntp_dst,
+                )
+            self._dp_epoch_seen = self.router.dp_store.epoch
+
+    # -- restart / drift -----------------------------------------------------
+
+    def _drifted(self) -> bool:
+        """True when the live world moved without a dual-apply (a
+        publish nobody routed through on_publish, or a window marked
+        broken): the staged target can no longer be trusted to
+        converge, so the plan restarts instead of cutting over."""
+        store = self.router.store
+        rel = store.relayout_state()
+        if rel is None or rel["broken"]:
+            return True
+        if store.current_stamp() != self._live_stamp_seen:
+            return True
+        if self.router.dp_store is not None:
+            drel = self.router.dp_store.relayout_state()
+            if drel is None or drel["broken"]:
+                return True
+            if self.router.dp_store.epoch != self._dp_epoch_seen:
+                return True
+        return False
+
+    def _restart_full(self) -> None:
+        """The deterministic refusal path: drop the staged epoch and
+        re-open the window as a FULL streamed upload into the target
+        layout (every augmented replica row queued as moved) from
+        the router's current world.  Still stop-free — the live
+        epoch serves throughout; only the byte bill becomes
+        O(world)."""
+        router = self.router
+        router.store.rollback_relayout()
+        if router.dp_store is not None:
+            router.dp_store.rollback_relayout()
+        self._pending.clear()
+        self._dead_cols.clear()
+        self.stats["restarts"] += 1
+        metrics.reshard_total.inc("restart_full")
+        tracing.add_event(
+            "reshard.restart_full", ntp_dst=self.ntp_dst
+        )
+        t = self._policy_tables(router._tables)
+        host_aug = partition.replicate_table_leaves(
+            t, self.ntp_dst, self.table_axis
+        )
+        rep = partition.replica_axes(
+            t, self.ntp_dst, self.table_axis
+        )
+        moved_all = {
+            name: (
+                axis,
+                np.arange(
+                    np.asarray(getattr(host_aug, name)).shape[axis],
+                    dtype=np.int64,
+                ),
+            )
+            for name, axis in rep.items()
+        }
+        shardings = partition.table_shardings(
+            self.target_mesh, host_aug, self.table_axis
+        )
+        digest = partition.replica_partition_digest(
+            self.table_axis, ntp=self.ntp_dst
+        )
+        self._policy_host = host_aug
+        self._pins = router.store.begin_relayout(
+            host_aug, moved_all, shardings, digest
+        )
+        for name, (axis, idx) in sorted(moved_all.items()):
+            n_aug = int(
+                np.asarray(getattr(host_aug, name)).shape[axis]
+            )
+            self._enqueue(
+                "policy", name, axis, idx, n_aug // self.ntp_dst
+            )
+        if router.dp_store is not None:
+            dmoved = router.dp_store.begin_relayout(
+                self._dtables, self.target_mesh
+            )
+            dhost = self._dp_host()
+            drep = partition.datapath_all_replica_axes(
+                self._dtables, self.ntp_dst, self.table_axis
+            )
+            for (fam, leaf), axis in sorted(drep.items()):
+                n_aug = int(
+                    np.asarray(
+                        getattr(getattr(dhost, fam), leaf)
+                    ).shape[axis]
+                )
+                self._enqueue(
+                    "datapath", (fam, leaf), axis,
+                    np.arange(n_aug, dtype=np.int64),
+                    n_aug // self.ntp_dst,
+                )
+            self._dp_epoch_seen = router.dp_store.epoch
+        self._live_stamp_seen = router.store.current_stamp()
+
+    # -- fault handling ------------------------------------------------------
+
+    def _target_ordinals_of_col(self, col: int) -> List[int]:
+        axes = list(self.target_mesh.axis_names)
+        out = []
+        for idx, dev in np.ndenumerate(self.target_mesh.devices):
+            coord = dict(zip(axes, idx))
+            if coord[self.table_axis] == col:
+                out.append(int(dev.id))
+        return out
+
+    def _col_of_ordinal(self, ordinal: int) -> Optional[int]:
+        axes = list(self.target_mesh.axis_names)
+        for idx, dev in np.ndenumerate(self.target_mesh.devices):
+            if int(dev.id) == int(ordinal):
+                return int(dict(zip(axes, idx))[self.table_axis])
+        return None
+
+    def _handle_fault(self, exc, probed_col: int) -> Optional[dict]:
+        """A chip died (fault site fired) mid-migration.  The fault
+        domain is the target table COLUMN — the unit of data
+        placement the migration streams to.  Returns a terminal
+        status dict on rollback, None to continue (complete-leg)."""
+        ordinal = getattr(exc, "chip", None)
+        col = (
+            self._col_of_ordinal(ordinal)
+            if ordinal is not None else None
+        )
+        if col is None:
+            col = int(probed_col)
+        if self.on_fault == "rollback":
+            # a REAL chip in the serving mesh still failed: open its
+            # breakers so the (untouched) source layout degrades
+            # through the normal replica routing, then drop the
+            # staged epoch
+            for o in self._target_ordinals_of_col(col):
+                if (self.router.ordinals == o).any():
+                    self.router.bank.record_failure(
+                        o, f"reshard.migrate fault: {exc}"
+                    )
+            self.rollback(reason=f"fault on column {col}")
+            return dict(self.stats)
+        # complete via survivors: the dead column's OWN rows stop
+        # streaming (nothing will read them — routing excludes dead
+        # owners), but the backup copies of its slice, resident in
+        # its right neighbour's region, keep streaming, so the data
+        # stays reachable post-cutover
+        self._dead_cols.add(col)
+        self.stats["dead_cols"] = sorted(self._dead_cols)
+        kept = deque()
+        for item in self._pending:
+            if item["block"] is None:
+                kept.append(item)
+                continue
+            idx = item["idx"]
+            mask = (idx // item["block"]) != col
+            if mask.all():
+                kept.append(item)
+            elif mask.any():
+                item = dict(item, idx=idx[mask])
+                item["cols"] = tuple(
+                    int(c)
+                    for c in np.unique(
+                        item["idx"] // item["block"]
+                    )
+                )
+                kept.append(item)
+        self._pending = kept
+        for o in self._target_ordinals_of_col(col):
+            self.router.bank.record_failure(
+                o, f"reshard.migrate fault: {exc}"
+            )
+        tracing.add_event(
+            "reshard.chip_fault", col=col,
+            action="complete_via_replicas",
+        )
+        log.warning(
+            "chip fault mid-migration; completing via replica "
+            "copies",
+            extra={"fields": {"column": col}},
+        )
+        return None
+
+    # -- migration steps -----------------------------------------------------
+
+    def step(self) -> dict:
+        """Stream one bounded-byte batch of queued rows into the
+        staged target epoch.  Returns a status dict ({"done": bool,
+        "bytes": int, ...}); a rollback-leg fault makes the plan
+        terminal (state == "rolled_back")."""
+        if self.state != "migrating":
+            raise RuntimeError(f"plan is {self.state}, not migrating")
+        if self._drifted():
+            self._restart_full()
+        if not self._pending:
+            return {"done": True, "bytes": 0}
+        batch = []
+        budget = self.step_bytes
+        while self._pending and (not batch or budget > 0):
+            item = self._pending.popleft()
+            batch.append(item)
+            budget -= item["bytes"]
+        cols = sorted({c for it in batch for c in it["cols"]})
+        # the fault seam, probed once per target-column chip this
+        # step is about to write (chip-scoped schedules fire when
+        # their chip is a recipient); nothing-armed serving pays one
+        # lock-free emptiness read
+        if faultinject.any_armed():
+            for c in cols:
+                if c in self._dead_cols:
+                    continue
+                for o in self._target_ordinals_of_col(c):
+                    try:
+                        faultinject.fire("reshard.migrate", chip=o)
+                    except faultinject.FaultInjected as exc:
+                        terminal = self._handle_fault(exc, c)
+                        if terminal is not None:
+                            return dict(terminal, done=True)
+                        # re-filter THIS step's batch too
+                        batch = [
+                            dict(
+                                it,
+                                idx=it["idx"][
+                                    (it["idx"] // it["block"])
+                                    != c
+                                ],
+                            )
+                            if it["block"] is not None
+                            else it
+                            for it in batch
+                        ]
+                        batch = [
+                            it for it in batch if it["idx"].size
+                        ]
+        policy_sets: Dict[str, Tuple[int, np.ndarray]] = {}
+        dp_sets: Dict[tuple, Tuple[int, np.ndarray]] = {}
+        for it in batch:
+            tgt = policy_sets if it["plane"] == "policy" else dp_sets
+            prev = tgt.get(it["key"])
+            if prev is None:
+                tgt[it["key"]] = (it["axis"], it["idx"])
+            else:
+                tgt[it["key"]] = (
+                    it["axis"],
+                    np.unique(
+                        np.concatenate([prev[1], it["idx"]])
+                    ),
+                )
+        bytes_h2d = 0
+        if policy_sets:
+            bytes_h2d += self.router.store.repair_rows(
+                policy_sets, spare=True,
+                expect_epoch=self._pins[0],
+                expect_layout=self._pins[1],
+            )
+        if dp_sets:
+            bytes_h2d += self.router.dp_store.relayout_scatter(
+                dp_sets
+            )
+        self.stats["steps"] += 1
+        self.stats["bytes_h2d"] += bytes_h2d
+        metrics.reshard_steps_total.inc()
+        metrics.reshard_bytes_h2d_total.inc(value=bytes_h2d)
+        return {
+            "done": not self._pending, "bytes": bytes_h2d,
+            "cols": cols,
+        }
+
+    # -- terminals -----------------------------------------------------------
+
+    def cutover(self) -> dict:
+        """Flip both stores to the staged target epoch, re-aim the
+        router, and close any armed shadow window stale.  Runs at a
+        batch boundary (the caller holds the stream between
+        dispatches — ServingPlane.run_at_batch_boundary is the
+        serving-path seam); in-flight batches completed on the
+        source epoch, whose buffers were never touched."""
+        if self.state != "migrating":
+            raise RuntimeError(f"plan is {self.state}, not migrating")
+        if self._drifted():
+            self._restart_full()
+            if self._pending:
+                # churn forced a full-into-target restart at the
+                # brink of cutover: the caller streams the refilled
+                # queue and tries again
+                return dict(self.stats, deferred=True)
+        if self._pending:
+            raise RuntimeError(
+                f"{len(self._pending)} migration chunks still "
+                "queued; stream them before cutover"
+            )
+        router = self.router
+        ntp = self.ntp_dst
+        axis = self.table_axis
+        mesh = self.target_mesh
+        router.store.cutover_relayout(
+            shardings_fn=lambda aug: partition.table_shardings(
+                mesh, aug, axis
+            ),
+            partition_digest=partition.replica_partition_digest(
+                axis, ntp=ntp
+            ),
+            transform_fn=lambda t: partition.replicate_table_leaves(
+                t, ntp, axis
+            ),
+            delta_transform_fn=lambda d, pre: partition.replica_delta(
+                d, pre, ntp, axis
+            ),
+        )
+        if router.dp_store is not None:
+            router.dp_store.cutover_relayout()
+        router.adopt_reshard(mesh, dtables=self._dtables)
+        if self.shadow is not None:
+            self.shadow.notify_cutover()
+        self.state = "done"
+        self.stats["outcome"] = "cutover"
+        self.stats["ms"] = (time.perf_counter() - self._t0) * 1000.0
+        metrics.reshard_total.inc("cutover")
+        metrics.reshard_seconds.observe(
+            self.stats["ms"] / 1000.0
+        )
+        tracing.add_event(
+            "reshard.cutover", ntp_src=self.ntp_src,
+            ntp_dst=self.ntp_dst, steps=self.stats["steps"],
+            bytes_h2d=self.stats["bytes_h2d"],
+            restarts=self.stats["restarts"],
+        )
+        log.info(
+            "reshard cutover complete",
+            extra={"fields": {
+                "tp": f"{self.ntp_src}->{self.ntp_dst}",
+                "steps": self.stats["steps"],
+                "bytes_h2d": self.stats["bytes_h2d"],
+            }},
+        )
+        return dict(self.stats)
+
+    def rollback(self, reason: str = "operator") -> dict:
+        """Abandon the migration: both staged epochs drop, the
+        fully-consistent source layout keeps serving (it was never
+        written to), and the plan is terminal."""
+        if self.state not in ("migrating", "idle"):
+            return dict(self.stats)
+        self.router.store.rollback_relayout()
+        if self.router.dp_store is not None:
+            self.router.dp_store.rollback_relayout()
+        self._pending.clear()
+        self.state = "rolled_back"
+        self.stats["outcome"] = "rollback"
+        self.stats["ms"] = (
+            (time.perf_counter() - self._t0) * 1000.0
+            if self._t0 else 0.0
+        )
+        metrics.reshard_total.inc("rollback")
+        if self._t0:
+            metrics.reshard_seconds.observe(
+                self.stats["ms"] / 1000.0
+            )
+        tracing.add_event("reshard.rollback", reason=reason)
+        log.warning(
+            "reshard rolled back",
+            extra={"fields": {"reason": reason}},
+        )
+        return dict(self.stats)
+
+    def run(self, max_steps: int = 1 << 16) -> dict:
+        """begin -> stream -> cutover, in one call.  A rollback-leg
+        fault terminates early with outcome "rollback"."""
+        if self.state == "idle":
+            self.begin()
+        steps = 0
+        while self.state == "migrating":
+            if self._pending:
+                self.step()
+                steps += 1
+                if steps > max_steps:
+                    self.rollback(reason="max_steps exceeded")
+            else:
+                self.cutover()  # deferred restarts loop back
+        return dict(self.stats)

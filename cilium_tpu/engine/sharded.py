@@ -1171,7 +1171,7 @@ def make_replica_store(
             mesh, aug, table_axis
         ),
         partition_digest=partition.replica_partition_digest(
-            table_axis
+            table_axis, ntp=ntp
         ),
         transform_fn=lambda t: partition.replicate_table_leaves(
             t, ntp, table_axis

@@ -85,6 +85,12 @@ SITES = (
     "proxy.upcall",
     "publish.scatter",
     "memo.insert",
+    # the elastic-resharding migration scatter (engine/reshard.py):
+    # probed once per target-column ordinal before each bounded-byte
+    # migration step, so chip-scoped schedules can kill a migration
+    # mid-stream (the plan then completes via the survivors' replica
+    # copies or rolls back to the source layout)
+    "reshard.migrate",
 )
 
 MODES = ("raise", "hang", "corrupt")

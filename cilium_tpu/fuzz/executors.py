@@ -167,6 +167,11 @@ class RouterExecutor:
         self.name = name
         self.world = world
         self.dp, self.tp = int(dp), int(tp)
+        # the reshard event's rng-free toggle anchor: targets are
+        # expressed as multiples of the CONSTRUCTED width, so a
+        # recorded program replays byte-for-byte after ddmin drops
+        # earlier reshard events
+        self.base_tp = int(tp)
         devs = jax.devices()
         assert len(devs) >= dp * tp, (len(devs), dp, tp)
         self.mesh = jax.sharding.Mesh(
@@ -274,6 +279,36 @@ class RouterExecutor:
 
     def chip_states(self) -> Dict[int, str]:
         return self.bank.states()
+
+    def reshard(self, target_tp: int):
+        """Live elastic reshard of this executor's table axis to
+        `target_tp` columns (engine/reshard.ReshardPlan), run to
+        completion atomically between dispatches — the live epoch
+        serves every check before and after; the harness's
+        post-event oracle compare is the bit-identity gate.  Returns
+        the plan stats, or None when the target equals the current
+        width or exceeds the device pool."""
+        import jax
+
+        from cilium_tpu.engine import reshard as rmod
+
+        target_tp = int(target_tp)
+        if (
+            target_tp == self.router.tp
+            or target_tp < 1
+            or self.dp * target_tp > len(jax.devices())
+        ):
+            return None
+        plan = rmod.ReshardPlan(
+            self.router,
+            rmod.reshard_target_mesh(self.router, target_tp),
+            step_bytes=1 << 14,
+        )
+        out = plan.run()
+        if out.get("outcome") == "cutover":
+            self.mesh = self.router.mesh
+            self.tp = self.router.tp
+        return out
 
     def close(self) -> None:
         pass

@@ -58,7 +58,10 @@ PROGRAM_VERSION = 1
 # forced full publish, the shadow-diff lifecycle (armed diff
 # checks + disarm-on-stale across the publish_full at 21), and an
 # online re-tune (pack-width swap at 26: layout-stamp refusal →
-# full upload → delta resumption, bit-identical throughout) — the
+# full upload → delta resumption, bit-identical throughout), and a
+# live elastic reshard (27: mid-stream shard-count change through
+# the routed executors — incremental row migration, cutover, then
+# the next delta publish layout-refused into one full upload) — the
 # rest of the schedule is free draws
 _FORCED = {
     1: "rule_add",
@@ -78,12 +81,13 @@ _FORCED = {
     24: "shadow_arm",
     25: "shadow_diff",
     26: "retune",
+    27: "reshard",
 }
 
 _FREE_OPS = (
     "flows", "flows", "flows", "rule_add", "rule_del", "ident_add",
     "ident_del", "publish_full", "memo_toggle", "fault_publish",
-    "fault_memo", "chip_toggle", "retune",
+    "fault_memo", "chip_toggle", "retune", "reshard",
 )
 
 
@@ -121,6 +125,7 @@ class _Runner:
             "shadow_diff_checks": 0,
             "shadow_stale_checks": 0,
             "retunes": 0,
+            "reshards": 0,
             "events": Counter(),
         }
 
@@ -231,6 +236,19 @@ class _Runner:
             mgr._fleet_compiler.set_hash_lanes(ev["lanes"])
             self.summary["retunes"] += 1
             mutated = True
+        elif op == "reshard":
+            # live elastic reshard, run to completion atomically
+            # between dispatches: every routed executor streams its
+            # moved rows into a staged target-layout epoch and cuts
+            # over with zero drain — the step's oracle compare (and
+            # every later one) is the bit-identity gate
+            for ex in self.executors:
+                if hasattr(ex, "reshard"):
+                    out = ex.reshard(
+                        ex.base_tp * int(ev["scale"])
+                    )
+                    if out and out.get("outcome") == "cutover":
+                        self.summary["reshards"] += 1
         elif op == "flows":
             pass
         else:
@@ -679,6 +697,19 @@ def _make_event(
             ._fleet_compiler.hash_lanes
         )
         ev["lanes"] = 32 if lanes_now != 32 else 64
+    elif op == "reshard":
+        # materialized rng-free: toggle the routed executors' table
+        # axis between the constructed width and 2x — recorded as a
+        # base-width multiple so replay and ddmin stay byte-exact
+        tgt = None
+        for ex in runner.executors:
+            if hasattr(ex, "reshard"):
+                tgt = 2 if ex.tp == ex.base_tp else 1
+                break
+        if tgt is None:
+            ev = {"op": "flows"}
+        else:
+            ev["scale"] = tgt
     zipf = 1.1 if rng.random() < 0.4 else 0.0
     flows = g.gen_flows(
         flows_per_step,

@@ -650,6 +650,29 @@ class Registry:
             "Batch pairs staged into the persistent fused program "
             "(pairs/launches = realized staging depth)",
         )
+        self.reshard_total = Counter(
+            f"{ns}_reshard_total",
+            "Live elastic reshard migrations by outcome (cutover | "
+            "rollback | restart_full)",
+            ("outcome",),
+        )
+        self.reshard_bytes_h2d_total = Counter(
+            f"{ns}_reshard_bytes_h2d_total",
+            "Bytes streamed host->device by reshard migration "
+            "steps (moved-owner rows only; the stop-the-world "
+            "comparator would ship the whole world)",
+        )
+        self.reshard_steps_total = Counter(
+            f"{ns}_reshard_steps_total",
+            "Bounded-byte migration steps executed by reshard "
+            "plans (each step scatters at most step_bytes into the "
+            "staged target epoch)",
+        )
+        self.reshard_seconds = Histogram(
+            f"{ns}_reshard_seconds",
+            "End-to-end reshard migration duration, plan begin "
+            "through cutover or rollback",
+        )
 
     def expose(self) -> str:
         lines: List[str] = []

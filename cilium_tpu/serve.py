@@ -277,6 +277,13 @@ class ServingPlane:
         # plane (overlap aggregates + "anything in flight?" for the
         # ingest-stall detector)
         self._dispatcher = None
+        # batch-boundary barriers (run_at_batch_boundary): callables
+        # the serve loop runs BETWEEN dispatches, after draining the
+        # in-flight overlap batch — the quiesce seam a reshard
+        # cutover flips epochs through.  Admission never pauses;
+        # queued flows simply land on whichever epoch is live when
+        # their batch composes.
+        self._barriers: deque = deque()
 
     # -- construction helpers -------------------------------------------------
 
@@ -587,6 +594,12 @@ class ServingPlane:
         forced an early dispatch ("default" for unclassed)."""
         with self._cond:
             while True:
+                if self._barriers:
+                    # a batch-boundary barrier is queued: hand the
+                    # loop an empty plan so it reaches the boundary
+                    # (flush + run barriers) even when the tenant
+                    # queues are idle
+                    return [], {}, False, None
                 backlog = self._backlog()
                 if backlog == 0:
                     if self._stop:
@@ -697,6 +710,15 @@ class ServingPlane:
         self._dispatcher = dispatcher
         try:
             while True:
+                if self._barriers:
+                    # batch boundary: the previous dispatch returned
+                    # and the overlap batch drains on ITS epoch
+                    # before the barrier runs — in-flight buffers
+                    # are never swapped out from under a batch
+                    for done in dispatcher.flush():
+                        self._complete(*done)
+                    while self._barriers:
+                        self._run_barrier(self._barriers.popleft())
                 plan = self._next_plan()
                 if plan is None:
                     break
@@ -726,6 +748,11 @@ class ServingPlane:
                         self._complete(*done)
             for done in dispatcher.flush():
                 self._complete(*done)
+            # a barrier that raced stop still runs (the stream is
+            # quiesced by definition here) so its submitter never
+            # hangs on a dead loop
+            while self._barriers:
+                self._run_barrier(self._barriers.popleft())
         except Exception as loop_exc:  # last-resort guard: nothing
             # may hang — in-flight batches release their admission
             # units and every pending reply errors out instead of
@@ -755,6 +782,51 @@ class ServingPlane:
                                 f"serve loop died: {loop_exc}"
                             )
                             sub.result._event.set()
+
+    @staticmethod
+    def _run_barrier(b: dict) -> None:
+        try:
+            b["result"] = b["fn"]()
+        except BaseException as exc:  # surfaced to the submitter
+            b["error"] = exc
+        finally:
+            b["event"].set()
+
+    def run_at_batch_boundary(self, fn, timeout_s: float = 30.0):
+        """Run `fn` on the serve loop BETWEEN batches: after the
+        in-flight overlap batch drains on its own epoch, before the
+        next plan composes.  The epoch-flip seam for a live reshard
+        cutover — admission keeps accepting throughout (queued flows
+        land on whichever epoch is live when their batch composes);
+        nothing is drained except the one overlapped batch that was
+        already dispatched.  Returns fn()'s result, re-raising its
+        exception.  Called with no loop running (not started, or
+        stopped), runs inline — the stream is trivially quiesced."""
+        thread = self._thread
+        if (
+            thread is None
+            or not thread.is_alive()
+            or threading.current_thread() is thread
+        ):
+            return fn()
+        box = {
+            "fn": fn, "event": threading.Event(),
+            "result": None, "error": None,
+        }
+        with self._cond:
+            if self._stop and self._backlog() == 0:
+                # loop may already be past its final flush
+                return fn()
+            self._barriers.append(box)
+            self._cond.notify_all()
+        if not box["event"].wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"batch-boundary barrier not reached within "
+                f"{timeout_s}s"
+            )
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
 
     def _stage(self, spans, mix, early, early_class=None):
         """Concatenate a plan's record slices into one host batch

@@ -432,6 +432,20 @@ class ShadowPlane:
         )
         return {"state": self._state, "promoted": summary}
 
+    def notify_cutover(self) -> bool:
+        """A mesh reshard cutover moved the live serving epoch out
+        from under an armed window: its pinned dual-epoch pair no
+        longer describes the serving layout, so the window closes
+        ``stale`` — exactly the moved-live-stamp rule
+        (_check_live_stamp_locked), surfaced as its own verb because
+        a cutover preserves the table STAMP while replacing the
+        layout underneath it.  Returns True when a window closed."""
+        with self._lock:
+            if self._window is None:
+                return False
+            self._close("stale")
+            return True
+
     def _close(self, reason: str) -> dict:
         """Close the open window (caller holds the lock): counters
         freeze into ``last_window``, sampling stops, device epochs

@@ -70,6 +70,12 @@ def test_policyfuzz_smoke():
     # path with every surface staying bit-identical (the full is
     # counted in publishes["full"] above)
     assert summary["retunes"] >= 1
+    # live elastic reshard coverage: the forced mid-stream
+    # shard-count change at step 27 migrated the routed executors'
+    # table axis through the staged-epoch window and cut over with
+    # every surface bit-identical (the post-cutover delta publish's
+    # layout refusal rides publishes["full"] above)
+    assert summary["reshards"] >= 2  # tp2 and memo both cut over
     # the recorded program replays clean (same seed, same world,
     # byte-for-byte events) — the determinism the shrinker rests on
     assert len(program["events"]) == SMOKE_STEPS

@@ -1,0 +1,446 @@
+"""Live elastic resharding: stop-free mesh growth with incremental
+row migration, mid-migration fault tolerance, and rollback.
+
+The acceptance surface of ISSUE 17:
+
+  * a shard-count change (tp 2 -> 4 grow, 4 -> 2 shrink) is DATA
+    MOVEMENT, not a redeploy: the owned-row delta between the source
+    and target partition specs streams in bounded-byte steps into a
+    staged epoch laid out under the NEW digest while the live epoch
+    keeps serving — verdicts bit-identical to the host oracle at
+    EVERY migration step;
+  * a chip kill mid-migration either completes from the survivors'
+    replica copies (the N+1 row lives in the right neighbour) or
+    rolls back to the fully-consistent source layout;
+  * churn during migration is dual-applied (live patch + staged
+    fold), and a full publish deterministically restarts the plan as
+    a full-upload-into-target — never a half-migrated epoch;
+  * a readmission racing an in-flight migration is REFUSED (the
+    staged target layout is not the layout the repair rows were
+    computed under) and the chip re-queues; post-cutover it repairs
+    against the epoch's actual digest;
+  * an armed shadow window closes ``stale`` at cutover — its pinned
+    dual-epoch pair no longer describes the serving layout.
+"""
+
+import copy
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from cilium_tpu import faultinject
+from cilium_tpu.compiler.tables import FleetCompiler
+from cilium_tpu.engine import reshard as rmod
+from cilium_tpu.engine.failover import ChipFailoverRouter
+from cilium_tpu.engine.hostpath import lattice_fold_host
+from cilium_tpu.engine.oracle import evaluate_batch_oracle
+from cilium_tpu.maps.policymap import (
+    INGRESS,
+    PolicyKey,
+    PolicyMapStateEntry,
+)
+from cilium_tpu.resilience import ChipBreakerBank
+from tests.test_verdict_engine import random_map_state, random_tuples
+
+WIDE_IDS = (
+    [1, 2, 3, 4, 5] + [256 + i for i in range(120)] + [65536, 70000]
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.disarm_all()
+    yield
+    faultinject.disarm_all()
+
+
+def _mesh(dp, tp):
+    devs = jax.devices()
+    if len(devs) < dp * tp:
+        pytest.skip(f"needs >= {dp * tp} virtual devices")
+    return jax.sharding.Mesh(
+        np.array(devs[: dp * tp]).reshape(dp, tp),
+        ("batch", "table"),
+    )
+
+
+def _world(dp=2, tp=2, seed=11, batch=256):
+    """A routed world whose policy can churn: (router, states,
+    compile_eps, fc, tuples, oracle want)."""
+    rng = np.random.default_rng(seed)
+    states = [
+        random_map_state(rng, WIDE_IDS, n_l4=16, n_l3=24)
+        for _ in range(3)
+    ]
+    fc = FleetCompiler(identity_pad=256, filter_pad=16)
+    tok = [0]
+
+    def compile_eps():
+        tok[0] += 1
+        return fc.compile(
+            [(i, s, (tok[0], i)) for i, s in enumerate(states)],
+            WIDE_IDS,
+        )[0]
+
+    t = random_tuples(rng, batch, 3, WIDE_IDS)
+
+    def fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            states, ep, ident, dport, proto, dirn, is_fragment=frag
+        )
+
+    router = ChipFailoverRouter(
+        _mesh(dp, tp), compile_eps(),
+        bank=ChipBreakerBank(
+            recovery_timeout=0.05, failure_threshold=1
+        ),
+        collect_telemetry=True, host_fold=fold,
+    )
+    router.publish(compile_eps())
+    tables = compile_eps()
+    router.publish(tables)
+    want = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    return router, states, compile_eps, fc, tables, t, want
+
+
+def _check(router, t, want, tag):
+    res = router.dispatch(**t)
+    np.testing.assert_array_equal(
+        res.verdicts.allowed, want[0], err_msg=tag
+    )
+    np.testing.assert_array_equal(
+        res.verdicts.proxy_port, want[1], err_msg=tag
+    )
+    np.testing.assert_array_equal(
+        res.verdicts.match_kind, want[2], err_msg=tag
+    )
+    return res
+
+
+def test_grow_bit_identical_every_step_then_shrink_back():
+    """tp 2 -> 4 with a verdict batch dispatched at EVERY bounded
+    migration step (the live epoch serves throughout), then 4 -> 2
+    back — both cutovers bit-identical to the host oracle."""
+    router, _, _, _, _, t, want = _world()
+    _check(router, t, want, "pre-reshard")
+
+    plan = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 4),
+        step_bytes=1 << 13,
+    )
+    plan.begin()
+    steps = 0
+    while plan.pending():
+        st = plan.step()
+        steps += 1
+        assert st["bytes"] > 0
+        _check(router, t, want, f"grow mid-step {steps}")
+    out = plan.cutover()
+    assert out["outcome"] == "cutover"
+    assert out["steps"] == steps >= 2  # genuinely incremental
+    assert out["bytes_h2d"] > 0
+    assert out["restarts"] == 0
+    assert (router.dp, router.tp) == (2, 4)
+    _check(router, t, want, "grow post-cutover")
+
+    out2 = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 2),
+        step_bytes=1 << 13,
+    ).run()
+    assert out2["outcome"] == "cutover"
+    assert (router.dp, router.tp) == (2, 2)
+    _check(router, t, want, "shrink post-cutover")
+
+
+def test_chip_kill_mid_migration_completes_via_replicas():
+    """A chip in a NEW target column dies mid-migration: the plan
+    marks the column dead, keeps streaming (the dead rows' N+1
+    copies live in the right neighbour), and the cutover serves the
+    dead column from replicas — bit-identical."""
+    router, _, _, _, _, t, want = _world()
+    plan = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 4),
+        step_bytes=1 << 12, on_fault="complete",
+    )
+    plan.begin()
+    # for 2 -> 4 every moved row lands in a NEW column (2 or 3): the
+    # retained columns' primary AND backup slices are source-resident
+    victim_col = 2
+    victims = plan._target_ordinals_of_col(victim_col)
+    faultinject.arm("reshard.migrate", f"raise:chip={victims[0]}")
+    steps = 0
+    while plan.pending():
+        plan.step()
+        steps += 1
+        _check(router, t, want, f"complete-leg mid {steps}")
+    out = plan.cutover()
+    assert out["outcome"] == "cutover"
+    assert out["dead_cols"] == [victim_col], out
+    res = _check(router, t, want, "complete-leg post-cutover")
+    # the dead column's rows really came from the survivors' backups
+    assert res.replica_hits > 0
+
+
+def test_chip_kill_mid_migration_rolls_back_to_source():
+    """on_fault="rollback": the staged target epoch is dropped, the
+    untouched source layout keeps serving, nothing was donated."""
+    router, _, compile_eps, _, _, t, want = _world(seed=13)
+    plan = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 4),
+        step_bytes=1 << 12, on_fault="rollback",
+    )
+    plan.begin()
+    victims = plan._target_ordinals_of_col(3)
+    faultinject.arm(
+        "reshard.migrate", f"raise:chip={victims[0]};next=1"
+    )
+    while plan.state == "migrating" and plan.pending():
+        plan.step()
+    assert plan.state == "rolled_back"
+    assert plan.stats["outcome"] == "rollback"
+    assert (router.dp, router.tp) == (2, 2)
+    faultinject.disarm_all()
+    _check(router, t, want, "rollback post")
+    # the source layout is fully consistent: churn publishes resume
+    router.publish(compile_eps())
+    _check(router, t, want, "rollback post churn")
+
+
+def test_churn_during_migration_delta_dual_applied():
+    """A DELTA publish mid-migration lands twice: a non-donated
+    patch of the live epoch (zero drain) and a fold into the staged
+    target host — the migration completes WITHOUT a restart and the
+    cutover serves the churned world bit-identical."""
+    router, states, compile_eps, fc, _, t, want = _world(seed=17)
+    plan = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 4),
+        step_bytes=1 << 12,
+    )
+    plan.begin()
+    plan.step()
+    _check(router, t, want, "churn mid 1")
+
+    base = router.store.current_stamp()
+    states[0][
+        PolicyKey(65536, 5001, 6, INGRESS)
+    ] = PolicyMapStateEntry()
+    nt = compile_eps()
+    delta = fc.delta_for(base, nt)
+    _, st = router.publish(nt, delta)  # live patch, window intact
+    assert st.mode == "delta"
+    plan.on_publish(nt)  # staged-target half of the dual-apply
+    want2 = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    _check(router, t, want2, "churn mid 2")
+
+    while plan.pending():
+        plan.step()
+        _check(router, t, want2, "churn drain")
+    out = plan.cutover()
+    assert out["outcome"] == "cutover"
+    assert out["restarts"] == 0  # the delta path keeps the window
+    assert router.tp == 4
+    _check(router, t, want2, "churn post-cutover")
+    # post-cutover the old live slot is a source-layout spare: the
+    # next publish pays exactly one layout-refused full, then serves
+    router.publish(compile_eps())
+    _check(router, t, want2, "churn post-cutover publish")
+
+
+def test_full_publish_during_migration_restarts_into_target():
+    """A FULL publish mid-migration (no delta — e.g. a shape-class
+    change) breaks the window: the plan deterministically restarts
+    as a full-upload-into-target and still cuts over bit-identical
+    on the NEW world — never a half-migrated epoch."""
+    router, states, compile_eps, _, _, t, _ = _world(seed=19)
+    plan = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 4),
+        step_bytes=1 << 12,
+    )
+    plan.begin()
+    plan.step()
+
+    states[1][
+        PolicyKey(70000, 6001, 6, INGRESS)
+    ] = PolicyMapStateEntry()
+    nt = compile_eps()
+    router.publish(nt)  # no delta: full upload, window broken
+    plan.on_publish(nt)
+    assert plan.stats["restarts"] >= 1
+    want2 = evaluate_batch_oracle(copy.deepcopy(states), **t)
+    _check(router, t, want2, "restart mid")
+    out = plan.run()
+    assert out["outcome"] == "cutover"
+    assert router.tp == 4
+    _check(router, t, want2, "restart post-cutover")
+
+
+def test_shrink_under_churn_bit_identical():
+    """tp 4 -> 2 with delta churn mid-migration: the shrink is the
+    same owned-row permutation run backwards (moved rows land in the
+    SURVIVING columns), dual-applied churn and all."""
+    router, states, compile_eps, fc, _, t, want = _world(
+        dp=2, tp=4, seed=23
+    )
+    _check(router, t, want, "pre-shrink")
+    plan = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 2),
+        step_bytes=1 << 12,
+    )
+    plan.begin()
+    plan.step()
+    _check(router, t, want, "shrink mid 1")
+
+    base = router.store.current_stamp()
+    states[2][
+        PolicyKey(256, 7001, 6, INGRESS)
+    ] = PolicyMapStateEntry()
+    nt = compile_eps()
+    _, st = router.publish(nt, fc.delta_for(base, nt))
+    assert st.mode == "delta"
+    plan.on_publish(nt)
+    want2 = evaluate_batch_oracle(copy.deepcopy(states), **t)
+
+    while plan.pending():
+        plan.step()
+        _check(router, t, want2, "shrink drain")
+    out = plan.cutover()
+    assert out["outcome"] == "cutover"
+    assert out["restarts"] == 0
+    assert (router.dp, router.tp) == (2, 2)
+    _check(router, t, want2, "shrink post-cutover")
+
+
+def test_readmit_races_migration_refused_then_repairs_post_cutover():
+    """The readmit-races-reshard regression: a chip out since before
+    the migration may NOT repair mid-window (the staged spare is the
+    target layout; its owned-row sets were computed under the source
+    assignment) — the rebalance refuses and the chip re-queues.
+    After cutover (and the one publish that refreshes the spare
+    under the new digest) readmission repairs against the epoch's
+    ACTUAL layout and the chip serves again."""
+    router, _, compile_eps, _, _, t, want = _world(seed=29)
+    victim = int(router.ordinals[0, 1])
+    faultinject.arm("engine.dispatch", f"raise:chip={victim};next=1")
+    _check(router, t, want, "kill dispatch")  # survivors re-split
+    faultinject.disarm_all()
+    assert router.store.chip_outage(victim) is not None
+
+    plan = rmod.ReshardPlan(
+        router, rmod.reshard_target_mesh(router, 4),
+        step_bytes=1 << 13,
+    )
+    plan.begin()
+    # direct probe: the repair path must refuse while the staged
+    # spare holds the target layout
+    with pytest.raises(RuntimeError, match="repair refused"):
+        router._rebalance(victim)
+    # the popped ledger went BACK (downgraded to needs_full): the
+    # chip stays out, ready for a later readmission
+    assert router.store.chip_outage(victim) is not None
+    assert router.stats.rebalances == 0
+
+    # the breaker-driven path hits the same refusal: after the
+    # recovery timeout the admission round attempts the rebalance,
+    # fails, and the chip stays out — verdicts still bit-identical
+    time.sleep(0.06)
+    _check(router, t, want, "mid-window readmit attempt")
+    assert router.stats.rebalances == 0
+    assert router.store.chip_outage(victim) is not None
+
+    out = plan.run()
+    assert out["outcome"] == "cutover"
+    assert router.tp == 4
+    _check(router, t, want, "post-cutover (chip still out)")
+    # one publish refreshes the spare slot under the target digest;
+    # the next admission round then repairs the chip's owned regions
+    # under the layout the epochs ACTUALLY hold
+    router.publish(compile_eps())
+    time.sleep(0.06)
+    _check(router, t, want, "post-cutover readmission")
+    assert router.stats.rebalances >= 1
+    assert router.store.chip_outage(victim) is None
+
+
+def test_reshard_races_shadow_window_closes_stale():
+    """Daemon integration: an armed shadow window's pinned
+    dual-epoch pair stops describing the serving layout at cutover,
+    so reshard_mesh closes it ``stale`` — and the cutover itself
+    rides the serving plane's batch boundary."""
+    from cilium_tpu.metrics import registry as metrics
+    from cilium_tpu.serve import ServingPlane, build_demo_daemon
+    from cilium_tpu.serve import demo_record_maker
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    d, client = build_demo_daemon()
+    make = demo_record_maker(client.security_identity.id)
+    rng = np.random.default_rng(31)
+
+    _, htables, _, host_states = (
+        d.endpoint_manager.published_with_states()
+    )
+
+    def host_fold(ep, ident, dport, proto, dirn, frag):
+        return lattice_fold_host(
+            host_states, ep, ident, dport, proto, dirn,
+            is_fragment=frag,
+        )
+
+    mesh = jax.sharding.Mesh(
+        np.array(devs[:4]).reshape(2, 2), ("batch", "table")
+    )
+    router = ChipFailoverRouter(
+        mesh, htables,
+        bank=ChipBreakerBank(
+            recovery_timeout=0.05, failure_threshold=1
+        ),
+        host_fold=host_fold,
+    )
+    router.publish(htables)
+    router.publish(htables)
+    d.attach_mesh_router(router)
+    d.regenerate_all("prime the standby epoch")
+    d.shadow.arm(sample_rate=1.0)  # standby: previous publish
+    assert d.shadow.state == "armed"
+    stale_before = metrics.policy_diff_stale_total.get()
+
+    plane = ServingPlane(d, batch_size=128, slo_ms=30000.0)
+    d.serving = plane
+    plane.start()
+    try:
+        r1 = plane.submit(rec=make(rng, 64), tenant="t")
+        out = d.reshard_mesh(4, step_bytes=1 << 13, plane=plane)
+        r2 = plane.submit(rec=make(rng, 64), tenant="t")
+        r1.wait(timeout=120)
+        r2.wait(timeout=120)
+    finally:
+        plane.stop()
+    assert out["outcome"] == "cutover"
+    assert router.tp == 4
+    # the armed window closed stale AT the cutover
+    assert d.shadow.state == "stale"
+    assert d.shadow.last_window["closed"] == "stale"
+    assert (
+        metrics.policy_diff_stale_total.get() - stale_before == 1
+    )
+    # serving continued across the flip
+    assert not r1.shed and not r2.shed
+
+
+def test_serving_plane_barrier_runs_inline_when_stopped():
+    """run_at_batch_boundary outside a running loop executes the
+    thunk inline (there is no batch boundary to wait for) and
+    propagates its result and exceptions."""
+    from cilium_tpu.serve import ServingPlane, build_demo_daemon
+
+    d, _ = build_demo_daemon()
+    plane = ServingPlane(d, batch_size=128, slo_ms=30000.0)
+    assert plane.run_at_batch_boundary(lambda: 41 + 1) == 42
+    with pytest.raises(ValueError, match="boom"):
+        plane.run_at_batch_boundary(
+            lambda: (_ for _ in ()).throw(ValueError("boom"))
+        )

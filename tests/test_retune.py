@@ -251,3 +251,72 @@ def test_retune_races_shadow_window_stale_close():
     st2 = d.shadow.status()
     assert st2["state"] == "stale"
     assert st2["last_window"]["sampled"] == sampled0
+
+
+def test_retune_candidates_sweep_ct_ip_lane_widths():
+    """The candidate grid carries the fused plane's CT / ipcache
+    hot-lane widths (ISSUE 17 satellite): a world whose CT snapshot
+    can compact offers alternative ct_lanes, an idx-form wide
+    ipcache offers its sub-word row width, and the byte-model scorer
+    prices both at lanes*4 — narrower rows model strictly more
+    verdicts/s."""
+    from cilium_tpu.engine.autotune import (
+        _model_run_candidate,
+        retune_candidates,
+    )
+
+    d, _mk = _world()
+    cands = retune_candidates(d, None)
+    ct_widths = sorted(
+        {c["ct_lanes"] for c in cands if "ct_lanes" in c}
+    )
+    dt = d.datapath_tables()
+    ct_now = int(np.asarray(dt.ct.buckets).shape[1])
+    assert ct_widths, "no CT lane candidates offered"
+    assert ct_now not in ct_widths  # only alternatives carry the key
+    ip_cands = [c for c in cands if "ip_lanes" in c]
+    for c in ip_cands:
+        assert c["ip_subword"] is True
+        assert c["ip_lanes"] != int(
+            np.asarray(dt.ipcache.buckets).shape[1]
+        )
+    # the model prices a narrower CT row as faster, ceteris paribus
+    run = _model_run_candidate(d, None)
+    base = dict(cands[0])
+    base.pop("ct_lanes", None)
+    base.pop("ip_lanes", None)
+    base.pop("ip_subword", None)
+    narrow = dict(base, ct_lanes=min(ct_widths))
+    if min(ct_widths) < ct_now:
+        vps_base, _ = run(base)
+        vps_narrow, _ = run(narrow)
+        assert vps_narrow > vps_base
+
+
+def test_retune_applies_ct_lanes_through_layout_refusal():
+    """Applying a swept ct_lanes choice lands in
+    daemon.datapath_ct_lanes and the next assembled fused world
+    ships the compacted CT rows — a real seam, not a score-only
+    knob."""
+    d, _mk = _world()
+    dt_wide = d.datapath_tables()
+    wide = int(np.asarray(dt_wide.ct.buckets).shape[1])
+    rec = online_retune(
+        d,
+        force=True,
+        candidates=[{"ct_lanes": 32}],
+        run_candidate=lambda p: (1.0, 1.0),
+    )
+    assert rec is not None
+    assert rec["applied"].get("ct_lanes") == 32
+    dt_new = d.datapath_tables()
+    got = int(np.asarray(dt_new.ct.buckets).shape[1])
+    assert got == 32 or got == wide  # wide kept only if semantics refuse
+    if got == 32:
+        from cilium_tpu.engine.datapath import (
+            datapath_layout_version,
+        )
+
+        assert datapath_layout_version(
+            dt_new
+        ) != datapath_layout_version(dt_wide)
